@@ -1,0 +1,24 @@
+"""kubeflow-tpu: a TPU-native Kubernetes notebook platform.
+
+A ground-up rebuild of the capabilities of the opendatahub-io/kubeflow
+notebook subsystem (notebook-controller + odh-notebook-controller, see
+reference components/notebook-controller and components/odh-notebook-controller)
+with TPUs as a first-class concept:
+
+- The ``Notebook`` CRD gains ``spec.tpu`` accelerator/topology fields
+  (kubeflow_tpu.api).
+- The core reconciler emits *indexed* StatefulSets with ``google.com/tpu``
+  resources and ``cloud.google.com/gke-tpu-topology`` nodeSelectors — one pod
+  per TPU host of the slice (kubeflow_tpu.controller).
+- The mutating webhook injects ``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` /
+  libtpu environment instead of CUDA env (kubeflow_tpu.webhook).
+- The idle culler tracks Jupyter activity across every host of a multi-host
+  slice and releases the slice atomically on cull or preemption
+  (kubeflow_tpu.controller.culling).
+- In-notebook runtime helpers bring up ``jax.distributed`` over the slice and
+  build device meshes (kubeflow_tpu.runtime), with a JAX/pallas model stack
+  (kubeflow_tpu.models, kubeflow_tpu.ops, kubeflow_tpu.parallel) for
+  benchmark parity (Llama-2-7B tokens/sec/chip).
+"""
+
+__version__ = "0.1.0"

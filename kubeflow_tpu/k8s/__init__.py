@@ -1,0 +1,30 @@
+from kubeflow_tpu.k8s.errors import (  # noqa: F401
+    ApiError,
+    NotFoundError,
+    ConflictError,
+    AlreadyExistsError,
+    InvalidError,
+    WebhookDeniedError,
+    is_not_found,
+    is_conflict,
+)
+from kubeflow_tpu.k8s.objects import (  # noqa: F401
+    name_of,
+    namespace_of,
+    labels_of,
+    annotations_of,
+    set_controller_reference,
+    owner_uid,
+    is_controlled_by,
+    matches_labels,
+    merge_patch,
+)
+from kubeflow_tpu.k8s.client import Client, retry_on_conflict  # noqa: F401
+from kubeflow_tpu.k8s.fake import FakeCluster, AdmissionRequest  # noqa: F401
+from kubeflow_tpu.k8s.manager import Manager, Reconciler, Result, FakeClock  # noqa: F401
+from kubeflow_tpu.k8s.chaos import ChaosClient, FaultConfig  # noqa: F401
+from kubeflow_tpu.k8s.fixtures import (  # noqa: F401
+    FakeKubelet,
+    add_tpu_node_pool,
+    add_cpu_node,
+)

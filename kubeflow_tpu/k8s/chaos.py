@@ -1,0 +1,95 @@
+"""Fault-injecting client wrapper: the chaos tier of the test pyramid.
+
+Mirrors the reference's operator-chaos SDK usage
+(reference components/notebook-controller/chaostests/chaos_test.go:50-59 and
+components/odh-notebook-controller/chaostests/): deterministic per-operation
+errors (ErrorRate 1.0), transient faults that deactivate mid-test
+(faultCfg.Deactivate), and seeded intermittent failure rates for
+convergence-under-flakiness tests.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+from kubeflow_tpu.k8s.client import Client
+from kubeflow_tpu.k8s.errors import ApiError
+
+
+class InjectedError(ApiError):
+    code = 500
+    reason = "ChaosInjected"
+
+
+@dataclass
+class FaultConfig:
+    """One fault rule: which ops fail, for which kinds, how often."""
+
+    operations: tuple[str, ...]  # subset of get/list/create/update/update_status/patch/delete
+    kinds: tuple[str, ...] = ()  # empty = all kinds
+    error_rate: float = 1.0
+    active: bool = True
+    injected_count: int = 0
+
+    def deactivate(self) -> None:
+        self.active = False
+
+    def activate(self) -> None:
+        self.active = True
+
+    def matches(self, op: str, kind: str, rng: random.Random) -> bool:
+        if not self.active or op not in self.operations:
+            return False
+        if self.kinds and kind not in self.kinds:
+            return False
+        return rng.random() < self.error_rate
+
+
+class ChaosClient:
+    """Wraps any Client, injecting errors per registered FaultConfig."""
+
+    def __init__(self, inner: Client, seed: int = 0):
+        self._inner = inner
+        self._faults: list[FaultConfig] = []
+        self._rng = random.Random(seed)
+
+    def add_fault(self, fault: FaultConfig) -> FaultConfig:
+        self._faults.append(fault)
+        return fault
+
+    def _maybe_fail(self, op: str, kind: str) -> None:
+        for fault in self._faults:
+            if fault.matches(op, kind, self._rng):
+                fault.injected_count += 1
+                raise InjectedError(f"injected {op} failure for {kind}")
+
+    # -- Client protocol, each op gated ------------------------------------
+
+    def get(self, kind: str, name: str, namespace: str = "") -> dict:
+        self._maybe_fail("get", kind)
+        return self._inner.get(kind, name, namespace)
+
+    def list(self, kind: str, namespace: str = "", label_selector=None) -> list[dict]:
+        self._maybe_fail("list", kind)
+        return self._inner.list(kind, namespace, label_selector)
+
+    def create(self, obj: dict) -> dict:
+        self._maybe_fail("create", obj.get("kind", ""))
+        return self._inner.create(obj)
+
+    def update(self, obj: dict) -> dict:
+        self._maybe_fail("update", obj.get("kind", ""))
+        return self._inner.update(obj)
+
+    def update_status(self, obj: dict) -> dict:
+        self._maybe_fail("update_status", obj.get("kind", ""))
+        return self._inner.update_status(obj)
+
+    def patch(self, kind: str, name: str, namespace: str, patch: dict) -> dict:
+        self._maybe_fail("patch", kind)
+        return self._inner.patch(kind, name, namespace, patch)
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        self._maybe_fail("delete", kind)
+        return self._inner.delete(kind, name, namespace)

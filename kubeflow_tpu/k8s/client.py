@@ -1,0 +1,59 @@
+"""Client interface: the seam between controllers and the API server.
+
+Controllers only ever talk through this interface, which makes the fake
+cluster (tests), the chaos wrapper (fault injection, reference
+components/notebook-controller/chaostests/chaos_test.go:50-59), and a future
+real API-server client interchangeable.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Protocol, TypeVar
+
+from kubeflow_tpu.k8s.errors import ConflictError
+
+
+class Client(Protocol):
+    def get(self, kind: str, name: str, namespace: str = "") -> dict: ...
+
+    def list(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[dict] = None,
+    ) -> list[dict]: ...
+
+    def create(self, obj: dict) -> dict: ...
+
+    def update(self, obj: dict) -> dict: ...
+
+    def update_status(self, obj: dict) -> dict: ...
+
+    def patch(self, kind: str, name: str, namespace: str, patch: dict) -> dict: ...
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None: ...
+
+
+T = TypeVar("T")
+
+
+def retry_on_conflict(
+    fn: Callable[[], T],
+    attempts: int = 5,
+    backoff_s: float = 0.0,
+) -> T:
+    """client-go retry.RetryOnConflict: re-run read-modify-write on 409.
+
+    Every annotation/finalizer mutation in the reference is wrapped in this
+    (e.g. reference culling_controller.go:170-197); same discipline here.
+    """
+    last: Exception = ConflictError("no attempts made")
+    for i in range(attempts):
+        try:
+            return fn()
+        except ConflictError as err:
+            last = err
+            if backoff_s and i < attempts - 1:
+                time.sleep(backoff_s * (2**i))
+    raise last

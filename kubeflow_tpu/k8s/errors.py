@@ -1,0 +1,60 @@
+"""Typed API errors mirroring Kubernetes apimachinery status reasons.
+
+The reference's error-handling idioms — ``apierrs.IsNotFound``,
+``apierrs.IsConflict``, ``retry.RetryOnConflict`` (e.g. reference
+components/notebook-controller/controllers/culling_controller.go:170-197) —
+are load-bearing for controller correctness, so the same vocabulary exists
+here as exception types plus predicate helpers.
+"""
+
+from __future__ import annotations
+
+
+class ApiError(Exception):
+    code: int = 500
+    reason: str = "InternalError"
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.reason)
+        self.message = message
+
+
+class NotFoundError(ApiError):
+    code = 404
+    reason = "NotFound"
+
+
+class AlreadyExistsError(ApiError):
+    code = 409
+    reason = "AlreadyExists"
+
+
+class ConflictError(ApiError):
+    """Optimistic-concurrency failure (stale resourceVersion)."""
+
+    code = 409
+    reason = "Conflict"
+
+
+class InvalidError(ApiError):
+    code = 422
+    reason = "Invalid"
+
+
+class WebhookDeniedError(ApiError):
+    """An admission webhook rejected the request."""
+
+    code = 403
+    reason = "Forbidden"
+
+
+def is_not_found(err: Exception) -> bool:
+    return isinstance(err, NotFoundError)
+
+
+def is_conflict(err: Exception) -> bool:
+    return isinstance(err, ConflictError)
+
+
+def is_already_exists(err: Exception) -> bool:
+    return isinstance(err, AlreadyExistsError)

@@ -1,0 +1,299 @@
+"""In-memory Kubernetes API server: this project's envtest analog.
+
+The reference's integration tier runs a real kube-apiserver + etcd via
+envtest with admission webhooks installed (reference
+components/odh-notebook-controller/controllers/suite_test.go:93-303). Without
+cluster binaries in this environment, FakeCluster provides the same
+contract in-process:
+
+- CRUD with uid / resourceVersion / generation bookkeeping,
+- optimistic concurrency (stale resourceVersion → 409 Conflict),
+- a status subresource (spec updates can't clobber status and vice versa),
+- finalizers + deletionTimestamp two-phase delete,
+- cascading garbage collection via ownerReferences,
+- registered mutating/validating admission webhooks invoked on create/update,
+- an ordered watch-event stream consumed by the Manager.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from kubeflow_tpu.k8s import objects as obj_util
+from kubeflow_tpu.k8s.errors import (
+    AlreadyExistsError,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+)
+
+CLUSTER_SCOPED_KINDS = {
+    "Namespace",
+    "Node",
+    "ClusterRole",
+    "ClusterRoleBinding",
+    "CustomResourceDefinition",
+    "OAuthClient",
+    "Proxy",
+    "APIServer",
+    "PriorityClass",
+}
+
+# Kinds with a status subresource: plain update() preserves stored status.
+STATUS_SUBRESOURCE_KINDS = {
+    "Notebook",
+    "StatefulSet",
+    "Deployment",
+    "Pod",
+    "HTTPRoute",
+    "Gateway",
+    "DataSciencePipelinesApplication",
+}
+
+
+@dataclass
+class AdmissionRequest:
+    operation: str  # CREATE | UPDATE | DELETE
+    object: dict
+    old_object: Optional[dict] = None
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    kind: str
+    namespace: str
+    name: str
+    object: dict
+
+
+@dataclass
+class _Webhook:
+    fn: Callable
+    operations: tuple[str, ...] = ("CREATE", "UPDATE")
+
+
+class FakeCluster:
+    """Dict-backed API server. Implements the Client protocol."""
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
+        self._objects: dict[tuple[str, str, str], dict] = {}
+        self._rv = 0
+        self._uid = 0
+        self._clock = clock or time.time
+        self._mutating: dict[str, list[_Webhook]] = {}
+        self._validating: dict[str, list[_Webhook]] = {}
+        self.events: list[WatchEvent] = []
+
+    # -- internals ---------------------------------------------------------
+
+    def _key(self, kind: str, name: str, namespace: str) -> tuple[str, str, str]:
+        if kind in CLUSTER_SCOPED_KINDS:
+            namespace = ""
+        return (kind, namespace, name)
+
+    def _now(self) -> str:
+        return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(self._clock()))
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _emit(self, event_type: str, obj: dict) -> None:
+        self.events.append(
+            WatchEvent(
+                event_type,
+                obj.get("kind", ""),
+                obj_util.namespace_of(obj),
+                obj_util.name_of(obj),
+                copy.deepcopy(obj),
+            )
+        )
+
+    def _run_admission(
+        self, operation: str, obj: dict, old: Optional[dict]
+    ) -> dict:
+        kind = obj.get("kind", "")
+        req = AdmissionRequest(operation, obj, old)
+        for hook in self._mutating.get(kind, []):
+            if operation in hook.operations:
+                result = hook.fn(req)
+                if result is not None:
+                    obj = result
+                    req = AdmissionRequest(operation, obj, old)
+        for hook in self._validating.get(kind, []):
+            if operation in hook.operations:
+                hook.fn(req)  # raises WebhookDeniedError to deny
+        return obj
+
+    # -- webhook registration (envtest WebhookInstallOptions analog) -------
+
+    def register_mutating_webhook(
+        self, kind: str, fn: Callable, operations: tuple[str, ...] = ("CREATE", "UPDATE")
+    ) -> None:
+        self._mutating.setdefault(kind, []).append(_Webhook(fn, operations))
+
+    def register_validating_webhook(
+        self, kind: str, fn: Callable, operations: tuple[str, ...] = ("CREATE", "UPDATE")
+    ) -> None:
+        self._validating.setdefault(kind, []).append(_Webhook(fn, operations))
+
+    # -- Client protocol ---------------------------------------------------
+
+    def get(self, kind: str, name: str, namespace: str = "") -> dict:
+        key = self._key(kind, name, namespace)
+        try:
+            return copy.deepcopy(self._objects[key])
+        except KeyError:
+            raise NotFoundError(f"{kind} {namespace}/{name} not found") from None
+
+    def list(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[dict] = None,
+    ) -> list[dict]:
+        out = []
+        for (k, ns, _), obj in sorted(self._objects.items()):
+            if k != kind:
+                continue
+            if namespace and ns != namespace:
+                continue
+            if not obj_util.matches_labels(obj, label_selector):
+                continue
+            out.append(copy.deepcopy(obj))
+        return out
+
+    def create(self, obj: dict) -> dict:
+        obj = copy.deepcopy(obj)
+        kind = obj.get("kind", "")
+        if not kind or not obj_util.name_of(obj):
+            raise InvalidError("object must have kind and metadata.name")
+        key = self._key(kind, obj_util.name_of(obj), obj_util.namespace_of(obj))
+        if key in self._objects:
+            raise AlreadyExistsError(f"{kind} {key[1]}/{key[2]} already exists")
+        obj = self._run_admission("CREATE", obj, None)
+        meta = obj.setdefault("metadata", {})
+        self._uid += 1
+        meta["uid"] = f"uid-{self._uid}"
+        meta["resourceVersion"] = self._next_rv()
+        meta["creationTimestamp"] = self._now()
+        meta["generation"] = 1
+        self._objects[key] = copy.deepcopy(obj)
+        self._emit("ADDED", obj)
+        return copy.deepcopy(obj)
+
+    def update(self, obj: dict) -> dict:
+        obj = copy.deepcopy(obj)
+        kind = obj.get("kind", "")
+        key = self._key(kind, obj_util.name_of(obj), obj_util.namespace_of(obj))
+        stored = self._objects.get(key)
+        if stored is None:
+            raise NotFoundError(f"{kind} {key[1]}/{key[2]} not found")
+        rv = obj.get("metadata", {}).get("resourceVersion")
+        if rv is not None and rv != stored["metadata"]["resourceVersion"]:
+            raise ConflictError(
+                f"{kind} {key[2]}: resourceVersion {rv} is stale "
+                f"(current {stored['metadata']['resourceVersion']})"
+            )
+        obj = self._run_admission("UPDATE", obj, copy.deepcopy(stored))
+        meta = obj.setdefault("metadata", {})
+        # Immutable/system-managed fields.
+        meta["uid"] = stored["metadata"]["uid"]
+        meta["creationTimestamp"] = stored["metadata"]["creationTimestamp"]
+        if "deletionTimestamp" in stored["metadata"]:
+            meta["deletionTimestamp"] = stored["metadata"]["deletionTimestamp"]
+        if kind in STATUS_SUBRESOURCE_KINDS and "status" in stored:
+            obj["status"] = copy.deepcopy(stored["status"])
+        if obj.get("spec") != stored.get("spec"):
+            meta["generation"] = stored["metadata"].get("generation", 1) + 1
+        else:
+            meta["generation"] = stored["metadata"].get("generation", 1)
+        # No-op update: nothing changed besides (possibly) the caller echoing
+        # back the stored state — skip the event so controllers quiesce.
+        meta["resourceVersion"] = stored["metadata"]["resourceVersion"]
+        if obj == stored:
+            return copy.deepcopy(obj)
+        meta["resourceVersion"] = self._next_rv()
+        # Deletion completes once finalizers are emptied.
+        if "deletionTimestamp" in meta and not meta.get("finalizers"):
+            self._remove(key, obj)
+            return copy.deepcopy(obj)
+        self._objects[key] = copy.deepcopy(obj)
+        self._emit("MODIFIED", obj)
+        return copy.deepcopy(obj)
+
+    def update_status(self, obj: dict) -> dict:
+        kind = obj.get("kind", "")
+        key = self._key(kind, obj_util.name_of(obj), obj_util.namespace_of(obj))
+        stored = self._objects.get(key)
+        if stored is None:
+            raise NotFoundError(f"{kind} {key[1]}/{key[2]} not found")
+        rv = obj.get("metadata", {}).get("resourceVersion")
+        if rv is not None and rv != stored["metadata"]["resourceVersion"]:
+            raise ConflictError(f"{kind} {key[2]}: stale resourceVersion on status")
+        if stored.get("status", {}) == obj.get("status", {}):
+            return copy.deepcopy(stored)  # no-op: no event, no RV bump
+        stored = copy.deepcopy(stored)
+        stored["status"] = copy.deepcopy(obj.get("status", {}))
+        stored["metadata"]["resourceVersion"] = self._next_rv()
+        self._objects[key] = stored
+        self._emit("MODIFIED", stored)
+        return copy.deepcopy(stored)
+
+    def patch(self, kind: str, name: str, namespace: str, patch: dict) -> dict:
+        stored = self.get(kind, name, namespace)
+        merged = obj_util.merge_patch(stored, patch)
+        # Merge patches carry no resourceVersion expectation.
+        merged["metadata"]["resourceVersion"] = stored["metadata"]["resourceVersion"]
+        return self.update(merged)
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        key = self._key(kind, name, namespace)
+        stored = self._objects.get(key)
+        if stored is None:
+            raise NotFoundError(f"{kind} {namespace}/{name} not found")
+        self._run_admission("DELETE", copy.deepcopy(stored), copy.deepcopy(stored))
+        meta = stored["metadata"]
+        if meta.get("finalizers"):
+            if "deletionTimestamp" not in meta:
+                meta["deletionTimestamp"] = self._now()
+                meta["resourceVersion"] = self._next_rv()
+                self._emit("MODIFIED", stored)
+            return
+        self._remove(key, stored)
+
+    def _remove(self, key: tuple[str, str, str], obj: dict) -> None:
+        self._objects.pop(key, None)
+        self._emit("DELETED", obj)
+        self._collect_garbage(obj["metadata"].get("uid"))
+
+    def _collect_garbage(self, owner_uid: Optional[str]) -> None:
+        if not owner_uid:
+            return
+        doomed = [
+            (k, o)
+            for k, o in list(self._objects.items())
+            if any(
+                ref.get("uid") == owner_uid
+                for ref in o.get("metadata", {}).get("ownerReferences", [])
+            )
+        ]
+        for (kind, ns, name), _ in doomed:
+            try:
+                self.delete(kind, name, ns)
+            except NotFoundError:
+                pass
+
+    # -- test conveniences -------------------------------------------------
+
+    def exists(self, kind: str, name: str, namespace: str = "") -> bool:
+        return self._key(kind, name, namespace) in self._objects
+
+    def drain_events(self, cursor: int) -> tuple[list[WatchEvent], int]:
+        """Events appended since ``cursor``; returns (events, new_cursor)."""
+        new = self.events[cursor:]
+        return new, len(self.events)

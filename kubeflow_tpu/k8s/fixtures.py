@@ -1,0 +1,279 @@
+"""Fake kubelet + TPU node-pool fixtures for the integration tier.
+
+envtest runs a real API server but no kubelet, so StatefulSets never produce
+pods; the reference works around this by asserting on STS specs only. Here
+we go one step further (SURVEY.md §4 "Implication for the tpu build"): a
+FakeKubelet turns StatefulSets into indexed pods, binds them to fake TPU
+nodes honoring ``google.com/tpu`` allocatable + topology nodeSelectors, and
+marks them Ready — so tests can assert end-to-end "Notebook CR → N Ready
+TPU-host pods" and scheduling failures (wrong topology, exhausted pool)
+surface as Pending pods, like on a real cluster.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from kubeflow_tpu.k8s import objects as obj_util
+from kubeflow_tpu.k8s.errors import AlreadyExistsError, NotFoundError
+from kubeflow_tpu.k8s.fake import FakeCluster
+from kubeflow_tpu.k8s.manager import Manager, Reconciler, Request, Result
+
+POD_INDEX_LABEL = "apps.kubernetes.io/pod-index"
+STS_POD_NAME_LABEL = "statefulset.kubernetes.io/pod-name"
+
+
+def add_tpu_node_pool(
+    cluster: FakeCluster,
+    accelerator_label: str,
+    topology: str,
+    hosts: int,
+    chips_per_host: int,
+    name_prefix: str = "tpu-node",
+) -> list[str]:
+    """Create ``hosts`` fake Nodes forming one TPU slice's node pool."""
+    names = []
+    for i in range(hosts):
+        name = f"{name_prefix}-{topology}-{i}"
+        node = {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": {
+                "name": name,
+                "labels": {
+                    "cloud.google.com/gke-tpu-accelerator": accelerator_label,
+                    "cloud.google.com/gke-tpu-topology": topology,
+                },
+            },
+            "status": {
+                "allocatable": {"google.com/tpu": str(chips_per_host)},
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        }
+        try:
+            cluster.create(node)
+        except AlreadyExistsError:
+            pass
+        names.append(name)
+    return names
+
+
+def add_cpu_node(cluster: FakeCluster, name: str = "cpu-node-0") -> str:
+    try:
+        cluster.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Node",
+                "metadata": {"name": name, "labels": {}},
+                "status": {
+                    "allocatable": {},
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                },
+            }
+        )
+    except AlreadyExistsError:
+        pass
+    return name
+
+
+class FakeKubelet(Reconciler):
+    """Reconciles StatefulSets into scheduled, Ready, indexed pods."""
+
+    def __init__(self, cluster: FakeCluster, auto_ready: bool = True):
+        self.cluster = cluster
+        self.auto_ready = auto_ready
+
+    def register(self, manager: Manager) -> None:
+        def node_event_to_all_sts(ev):
+            return [
+                Request(obj_util.name_of(s), obj_util.namespace_of(s))
+                for s in self.cluster.list("StatefulSet")
+            ]
+
+        manager.register(
+            self,
+            for_kind="StatefulSet",
+            owns=("Pod",),
+            watches=[("Node", node_event_to_all_sts)],
+            name="FakeKubelet",
+        )
+
+    def reconcile(self, req: Request) -> Result:
+        try:
+            sts = self.cluster.get("StatefulSet", req.name, req.namespace)
+        except NotFoundError:
+            return Result()
+        replicas = sts.get("spec", {}).get("replicas", 1)
+        for i in range(replicas):
+            self._ensure_pod(sts, i)
+            self._retry_pending(sts, i)
+        # Scale-down: remove pods at ordinals >= replicas (whole-slice stop).
+        for pod in self.cluster.list("Pod", req.namespace):
+            if not obj_util.is_controlled_by(sts, pod):
+                continue
+            idx = pod["metadata"].get("labels", {}).get(POD_INDEX_LABEL)
+            if idx is not None and int(idx) >= replicas:
+                try:
+                    self.cluster.delete("Pod", obj_util.name_of(pod), req.namespace)
+                except NotFoundError:
+                    pass
+        self._update_sts_status(sts)
+        return Result()
+
+    # -- pod lifecycle -----------------------------------------------------
+
+    def _ensure_pod(self, sts: dict, ordinal: int) -> None:
+        name = f"{obj_util.name_of(sts)}-{ordinal}"
+        namespace = obj_util.namespace_of(sts)
+        if self.cluster.exists("Pod", name, namespace):
+            return
+        template = copy.deepcopy(sts.get("spec", {}).get("template", {}))
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "namespace": namespace,
+                "labels": {
+                    **template.get("metadata", {}).get("labels", {}),
+                    POD_INDEX_LABEL: str(ordinal),
+                    STS_POD_NAME_LABEL: name,
+                },
+                "annotations": dict(template.get("metadata", {}).get("annotations", {})),
+            },
+            "spec": copy.deepcopy(template.get("spec", {})),
+        }
+        pod["spec"]["hostname"] = name
+        if sts.get("spec", {}).get("serviceName"):
+            pod["spec"]["subdomain"] = sts["spec"]["serviceName"]
+        obj_util.set_controller_reference(sts, pod)
+        node = self._schedule(pod)
+        if node:
+            pod["spec"]["nodeName"] = node
+            pod["status"] = self._running_status(pod) if self.auto_ready else {
+                "phase": "Pending",
+                "conditions": [{"type": "PodScheduled", "status": "True"}],
+            }
+        else:
+            pod["status"] = {
+                "phase": "Pending",
+                "conditions": [
+                    {
+                        "type": "PodScheduled",
+                        "status": "False",
+                        "reason": "Unschedulable",
+                        "message": "0/N nodes match TPU nodeSelector/allocatable",
+                    }
+                ],
+            }
+        self.cluster.create(pod)
+
+    def _retry_pending(self, sts: dict, ordinal: int) -> None:
+        """Reschedule an unschedulable Pending pod once capacity appears."""
+        name = f"{obj_util.name_of(sts)}-{ordinal}"
+        namespace = obj_util.namespace_of(sts)
+        try:
+            pod = self.cluster.get("Pod", name, namespace)
+        except NotFoundError:
+            return
+        status = pod.get("status", {})
+        if status.get("phase") != "Pending" or pod["spec"].get("nodeName"):
+            return
+        node = self._schedule(pod)
+        if not node:
+            return
+        pod["spec"]["nodeName"] = node
+        pod = self.cluster.update(pod)
+        pod["status"] = self._running_status(pod) if self.auto_ready else {
+            "phase": "Pending",
+            "conditions": [{"type": "PodScheduled", "status": "True"}],
+        }
+        self.cluster.update_status(pod)
+
+    def _schedule(self, pod: dict) -> Optional[str]:
+        """Bind to a node satisfying nodeSelector + google.com/tpu allocatable.
+
+        Terminal pods (Failed/Succeeded) release their resources, as on a
+        real cluster — preemption recovery depends on this.
+        """
+        selector = pod["spec"].get("nodeSelector", {})
+        tpu_request = _pod_tpu_request(pod)
+        used: dict[str, int] = {}
+        for existing in self.cluster.list("Pod"):
+            node_name = existing.get("spec", {}).get("nodeName")
+            phase = existing.get("status", {}).get("phase")
+            if node_name and phase not in ("Failed", "Succeeded"):
+                used[node_name] = used.get(node_name, 0) + _pod_tpu_request(existing)
+        for node in self.cluster.list("Node"):
+            labels = node.get("metadata", {}).get("labels", {})
+            if any(labels.get(k) != v for k, v in selector.items()):
+                continue
+            allocatable = int(
+                node.get("status", {}).get("allocatable", {}).get("google.com/tpu", 0)
+            )
+            if tpu_request and used.get(obj_util.name_of(node), 0) + tpu_request > allocatable:
+                continue
+            return obj_util.name_of(node)
+        return None
+
+    def _running_status(self, pod: dict) -> dict:
+        return {
+            "phase": "Running",
+            "conditions": [
+                {"type": "PodScheduled", "status": "True"},
+                {"type": "Initialized", "status": "True"},
+                {"type": "ContainersReady", "status": "True"},
+                {"type": "Ready", "status": "True"},
+            ],
+            "containerStatuses": [
+                {
+                    "name": c.get("name", ""),
+                    "ready": True,
+                    "restartCount": 0,
+                    "state": {"running": {"startedAt": "2026-01-01T00:00:00Z"}},
+                }
+                for c in pod["spec"].get("containers", [])
+            ],
+        }
+
+    def _update_sts_status(self, sts: dict) -> None:
+        ready = 0
+        for pod in self.cluster.list("Pod", obj_util.namespace_of(sts)):
+            if not obj_util.is_controlled_by(sts, pod):
+                continue
+            for cond in pod.get("status", {}).get("conditions", []):
+                if cond.get("type") == "Ready" and cond.get("status") == "True":
+                    ready += 1
+        sts = self.cluster.get("StatefulSet", obj_util.name_of(sts), obj_util.namespace_of(sts))
+        sts["status"] = {
+            "replicas": sts.get("spec", {}).get("replicas", 1),
+            "readyReplicas": ready,
+        }
+        self.cluster.update_status(sts)
+
+    # -- fault helpers for preemption tests --------------------------------
+
+    def preempt_pod(self, name: str, namespace: str, reason: str = "TerminationByKubernetes") -> None:
+        """Simulate a TPU maintenance/spot preemption: pod dies with a reason."""
+        pod = self.cluster.get("Pod", name, namespace)
+        pod["status"] = {
+            "phase": "Failed",
+            "reason": "Preempted",
+            "message": f"Pod preempted: {reason}",
+            "conditions": [
+                {
+                    "type": "DisruptionTarget",
+                    "status": "True",
+                    "reason": reason,
+                }
+            ],
+        }
+        self.cluster.update_status(pod)
+
+
+def _pod_tpu_request(pod: dict) -> int:
+    total = 0
+    for c in pod.get("spec", {}).get("containers", []):
+        total += int(c.get("resources", {}).get("limits", {}).get("google.com/tpu", 0) or 0)
+    return total

@@ -1,0 +1,7 @@
+from kubeflow_tpu.tpu.topology import (  # noqa: F401
+    Accelerator,
+    SliceTopology,
+    ACCELERATORS,
+    parse_topology,
+    slice_from_spec,
+)

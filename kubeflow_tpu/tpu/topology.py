@@ -1,0 +1,206 @@
+"""TPU accelerator catalog and slice-topology math.
+
+The reference control plane (opendatahub-io/kubeflow) treats accelerators as
+an opaque PodSpec passthrough — there is no accelerator model anywhere in it
+(reference: SURVEY.md, components/notebook-controller/controllers/
+notebook_controller.go:433-523 simply copies the user PodSpec). This module is
+the TPU-native replacement for that gap: it is the single source of truth that
+turns a user-facing ``spec.tpu: {accelerator, topology}`` into
+
+- chip / host counts (how many indexed-StatefulSet replicas a slice needs),
+- GKE scheduling metadata (``cloud.google.com/gke-tpu-accelerator`` and
+  ``cloud.google.com/gke-tpu-topology`` nodeSelectors, ``google.com/tpu``
+  resource quantities),
+- libtpu / JAX runtime environment (``TPU_WORKER_HOSTNAMES`` ordering,
+  host/chip bounds).
+
+Everything downstream (reconciler, webhook, culler, runtime bootstrap) calls
+into this module rather than re-deriving topology facts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+
+class InvalidTopologyError(ValueError):
+    """Raised when an accelerator/topology combination is not schedulable."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Accelerator:
+    """One TPU generation as exposed by GKE node pools.
+
+    ``cores_per_chip`` exists because Google's accelerator-type naming is
+    inconsistent across generations: v4/v5p type names count TensorCores
+    (``v4-8`` is 4 chips) while v5e/v6e count chips (``v5litepod-4`` is
+    4 chips).
+    """
+
+    name: str  # canonical short name: v4, v5e, v5p, v6e
+    gke_label: str  # value of cloud.google.com/gke-tpu-accelerator
+    dims: int  # topology dimensionality: 2 (v5e/v6e) or 3 (v4/v5p)
+    chips_per_host: int  # chips on one host of a multi-host slice
+    max_single_host_chips: int  # largest slice that fits on one host
+    cores_per_chip: int  # for accelerator-type naming (see docstring)
+    type_prefix: str  # accelerator-type string prefix, e.g. "v5litepod"
+    hbm_gib_per_chip: int  # per-chip HBM, used for model-fit planning
+
+    def type_name(self, chips: int) -> str:
+        """Cloud accelerator-type string, e.g. ``v5litepod-16`` / ``v4-32``."""
+        return f"{self.type_prefix}-{chips * self.cores_per_chip}"
+
+
+ACCELERATORS: dict[str, Accelerator] = {
+    "v4": Accelerator("v4", "tpu-v4-podslice", 3, 4, 4, 2, "v4", 32),
+    "v5e": Accelerator("v5e", "tpu-v5-lite-podslice", 2, 4, 8, 1, "v5litepod", 16),
+    "v5p": Accelerator("v5p", "tpu-v5p-slice", 3, 4, 4, 2, "v5p", 95),
+    "v6e": Accelerator("v6e", "tpu-v6e-slice", 2, 4, 8, 1, "v6e", 32),
+}
+
+# User-facing aliases accepted in spec.tpu.accelerator.
+_ALIASES = {
+    "v5litepod": "v5e",
+    "v5lite": "v5e",
+    "tpu-v5-lite-podslice": "v5e",
+    "tpu-v5-lite-device": "v5e",
+    "tpu-v5p-slice": "v5p",
+    "tpu-v4-podslice": "v4",
+    "trillium": "v6e",
+    "tpu-v6e-slice": "v6e",
+    "tpu-v6e-device": "v6e",
+}
+
+
+def resolve_accelerator(name: str) -> Accelerator:
+    key = name.strip().lower()
+    key = _ALIASES.get(key, key)
+    try:
+        return ACCELERATORS[key]
+    except KeyError:
+        raise InvalidTopologyError(
+            f"unknown TPU accelerator {name!r}; known: "
+            f"{sorted(ACCELERATORS)} (aliases: {sorted(_ALIASES)})"
+        ) from None
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    """Parse ``"4x4"`` / ``"2x2x2"`` into an int tuple."""
+    parts = topology.strip().lower().split("x")
+    try:
+        dims = tuple(int(p) for p in parts)
+    except ValueError:
+        raise InvalidTopologyError(f"malformed topology string {topology!r}") from None
+    if not dims or any(d < 1 for d in dims):
+        raise InvalidTopologyError(f"malformed topology string {topology!r}")
+    return dims
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """A fully-resolved TPU slice: accelerator generation + physical topology.
+
+    This is what the reconciler and webhook consume. ``hosts`` is the number
+    of pods in the indexed StatefulSet; ``chips_per_host`` is the
+    ``google.com/tpu`` resource request on each pod.
+    """
+
+    accelerator: Accelerator
+    dims: tuple[int, ...]
+
+    # -- basic counts ------------------------------------------------------
+    @property
+    def chips(self) -> int:
+        return math.prod(self.dims)
+
+    @property
+    def single_host(self) -> bool:
+        return self.chips <= self.accelerator.max_single_host_chips
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.chips if self.single_host else self.accelerator.chips_per_host
+
+    @property
+    def hosts(self) -> int:
+        return 1 if self.single_host else self.chips // self.accelerator.chips_per_host
+
+    # -- naming / scheduling metadata -------------------------------------
+    @property
+    def topology_str(self) -> str:
+        return "x".join(str(d) for d in self.dims)
+
+    @property
+    def accelerator_type(self) -> str:
+        return self.accelerator.type_name(self.chips)
+
+    @property
+    def gke_accelerator_label(self) -> str:
+        return self.accelerator.gke_label
+
+    def node_selector(self) -> dict[str, str]:
+        return {
+            "cloud.google.com/gke-tpu-accelerator": self.gke_accelerator_label,
+            "cloud.google.com/gke-tpu-topology": self.topology_str,
+        }
+
+    # -- libtpu bounds -----------------------------------------------------
+    def host_shape(self) -> tuple[int, ...]:
+        """Chip grid owned by one host, e.g. (2, 2) on multi-host v5e."""
+        if self.single_host:
+            return self.dims
+        if self.accelerator.dims == 2:
+            return (2, 2)
+        return (2, 2, 1)
+
+    def host_bounds(self) -> tuple[int, ...]:
+        """Host grid of the slice (dims / host_shape)."""
+        shape = self.host_shape()
+        return tuple(d // s for d, s in zip(self.dims, shape))
+
+    def chip_bounds_str(self) -> str:
+        """``TPU_CHIPS_PER_HOST_BOUNDS``-style string, always 3-D."""
+        shape = self.host_shape() + (1,) * (3 - len(self.dims))
+        return ",".join(str(s) for s in shape)
+
+    def host_bounds_str(self) -> str:
+        """``TPU_HOST_BOUNDS``-style string, always 3-D."""
+        bounds = self.host_bounds() + (1,) * (3 - len(self.dims))
+        return ",".join(str(b) for b in bounds)
+
+    # -- slice DNS ---------------------------------------------------------
+    def worker_hostnames(
+        self, name: str, headless_service: str, namespace: str,
+        cluster_domain: str = "cluster.local",
+    ) -> list[str]:
+        """Stable per-host DNS names in TPU_WORKER_ID order.
+
+        Pod ``{name}-{i}`` of the indexed StatefulSet is TPU worker ``i``;
+        the headless Service gives each a stable FQDN.
+        """
+        return [
+            f"{name}-{i}.{headless_service}.{namespace}.svc.{cluster_domain}"
+            for i in range(self.hosts)
+        ]
+
+
+def slice_from_spec(accelerator: str, topology: str) -> SliceTopology:
+    """Validate and resolve a user-provided accelerator/topology pair."""
+    acc = resolve_accelerator(accelerator)
+    dims = parse_topology(topology)
+    if len(dims) != acc.dims:
+        raise InvalidTopologyError(
+            f"{acc.name} topologies are {acc.dims}-D, got {topology!r}"
+        )
+    st = SliceTopology(acc, dims)
+    if not st.single_host:
+        shape = st.host_shape()
+        for d, s in zip(dims, shape):
+            if d % s != 0:
+                raise InvalidTopologyError(
+                    f"topology {topology!r} does not tile into {acc.name} hosts "
+                    f"(host shape {'x'.join(map(str, shape))})"
+                )
+    return st

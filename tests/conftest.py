@@ -1,0 +1,18 @@
+"""Test configuration.
+
+Control-plane tests are pure Python. Model/parallel tests run JAX on a
+virtual 8-device CPU mesh so multi-chip sharding is exercised without TPU
+hardware (the driver separately dry-runs the multi-chip path).
+
+The env vars must be set before jax is first imported anywhere in the test
+process, hence they live at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,349 @@
+"""Tests for the in-memory API server, manager, and chaos client."""
+
+import pytest
+
+from kubeflow_tpu import k8s
+from kubeflow_tpu.k8s import objects as obj_util
+from kubeflow_tpu.k8s.manager import Manager, Reconciler, Request, Result
+
+
+def make_cm(name="cm", ns="default", data=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": ns},
+        "data": data or {},
+    }
+
+
+class TestCrud:
+    def test_create_get_roundtrip(self):
+        c = k8s.FakeCluster()
+        created = c.create(make_cm(data={"a": "1"}))
+        assert created["metadata"]["uid"].startswith("uid-")
+        got = c.get("ConfigMap", "cm", "default")
+        assert got["data"] == {"a": "1"}
+
+    def test_create_duplicate(self):
+        c = k8s.FakeCluster()
+        c.create(make_cm())
+        with pytest.raises(k8s.AlreadyExistsError):
+            c.create(make_cm())
+
+    def test_get_not_found(self):
+        c = k8s.FakeCluster()
+        with pytest.raises(k8s.NotFoundError):
+            c.get("ConfigMap", "nope", "default")
+        assert k8s.is_not_found(k8s.NotFoundError("x"))
+
+    def test_stale_resource_version_conflicts(self):
+        c = k8s.FakeCluster()
+        c.create(make_cm())
+        a = c.get("ConfigMap", "cm", "default")
+        b = c.get("ConfigMap", "cm", "default")
+        a["data"] = {"x": "1"}
+        c.update(a)
+        b["data"] = {"y": "2"}
+        with pytest.raises(k8s.ConflictError):
+            c.update(b)
+
+    def test_retry_on_conflict(self):
+        c = k8s.FakeCluster()
+        c.create(make_cm())
+        other = c.get("ConfigMap", "cm", "default")
+
+        attempts = []
+
+        def mutate():
+            fresh = c.get("ConfigMap", "cm", "default")
+            if not attempts:
+                # Interleave a competing write on first attempt only.
+                other["data"] = {"competing": "write"}
+                c.update(dict(other))
+                attempts.append(1)
+                fresh["metadata"]["resourceVersion"] = "1"  # force staleness
+            fresh.setdefault("data", {})["mine"] = "yes"
+            return c.update(fresh)
+
+        k8s.retry_on_conflict(mutate)
+        assert c.get("ConfigMap", "cm", "default")["data"]["mine"] == "yes"
+
+    def test_merge_patch_removes_key_with_none(self):
+        c = k8s.FakeCluster()
+        c.create(make_cm(data={"keep": "1", "drop": "2"}))
+        c.patch("ConfigMap", "cm", "default", {"data": {"drop": None}})
+        assert c.get("ConfigMap", "cm", "default")["data"] == {"keep": "1"}
+
+    def test_generation_bumps_on_spec_change_only(self):
+        c = k8s.FakeCluster()
+        nb = {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "Notebook",
+            "metadata": {"name": "nb", "namespace": "ns"},
+            "spec": {"template": {"spec": {"containers": []}}},
+        }
+        c.create(nb)
+        got = c.get("Notebook", "nb", "ns")
+        obj_util.annotations_of(got)["x"] = "y"
+        c.update(got)
+        assert c.get("Notebook", "nb", "ns")["metadata"]["generation"] == 1
+        got = c.get("Notebook", "nb", "ns")
+        got["spec"]["template"]["spec"]["containers"] = [{"name": "nb"}]
+        c.update(got)
+        assert c.get("Notebook", "nb", "ns")["metadata"]["generation"] == 2
+
+    def test_status_subresource_isolation(self):
+        c = k8s.FakeCluster()
+        nb = {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "Notebook",
+            "metadata": {"name": "nb", "namespace": "ns"},
+            "spec": {},
+            "status": {"readyReplicas": 0},
+        }
+        c.create(nb)
+        got = c.get("Notebook", "nb", "ns")
+        got["status"] = {"readyReplicas": 99}  # must be ignored by update()
+        got["spec"] = {"changed": True}
+        c.update(got)
+        assert c.get("Notebook", "nb", "ns")["status"]["readyReplicas"] == 0
+        got = c.get("Notebook", "nb", "ns")
+        got["status"] = {"readyReplicas": 3}
+        c.update_status(got)
+        fresh = c.get("Notebook", "nb", "ns")
+        assert fresh["status"]["readyReplicas"] == 3
+        assert fresh["spec"] == {"changed": True}
+
+
+class TestFinalizersAndGC:
+    def test_finalizer_blocks_deletion(self):
+        c = k8s.FakeCluster()
+        cm = make_cm()
+        cm["metadata"]["finalizers"] = ["example.com/cleanup"]
+        c.create(cm)
+        c.delete("ConfigMap", "cm", "default")
+        got = c.get("ConfigMap", "cm", "default")
+        assert "deletionTimestamp" in got["metadata"]
+        got["metadata"]["finalizers"] = []
+        c.update(got)
+        assert not c.exists("ConfigMap", "cm", "default")
+
+    def test_cascading_gc(self):
+        c = k8s.FakeCluster()
+        owner = c.create(make_cm("owner"))
+        child = make_cm("child")
+        obj_util.set_controller_reference(owner, child)
+        c.create(child)
+        c.delete("ConfigMap", "owner", "default")
+        assert not c.exists("ConfigMap", "child", "default")
+
+    def test_label_selector_list(self):
+        c = k8s.FakeCluster()
+        a = make_cm("a")
+        a["metadata"]["labels"] = {"app": "x"}
+        b = make_cm("b")
+        b["metadata"]["labels"] = {"app": "y"}
+        c.create(a)
+        c.create(b)
+        assert [obj_util.name_of(o) for o in c.list("ConfigMap", "default", {"app": "x"})] == ["a"]
+
+
+class TestAdmission:
+    def test_mutating_webhook_applies(self):
+        c = k8s.FakeCluster()
+
+        def add_label(req):
+            obj_util.labels_of(req.object)["mutated"] = "true"
+            return req.object
+
+        c.register_mutating_webhook("ConfigMap", add_label)
+        c.create(make_cm())
+        assert c.get("ConfigMap", "cm", "default")["metadata"]["labels"]["mutated"] == "true"
+
+    def test_validating_webhook_denies(self):
+        c = k8s.FakeCluster()
+
+        def deny(req):
+            raise k8s.WebhookDeniedError("not allowed")
+
+        c.register_validating_webhook("ConfigMap", deny, operations=("CREATE",))
+        with pytest.raises(k8s.WebhookDeniedError):
+            c.create(make_cm())
+        assert not c.exists("ConfigMap", "cm", "default")
+
+    def test_update_webhook_sees_old_object(self):
+        c = k8s.FakeCluster()
+        seen = {}
+
+        def capture(req):
+            if req.operation == "UPDATE":
+                seen["old"] = req.old_object["data"]
+            return req.object
+
+        c.register_mutating_webhook("ConfigMap", capture)
+        c.create(make_cm(data={"v": "1"}))
+        got = c.get("ConfigMap", "cm", "default")
+        got["data"] = {"v": "2"}
+        c.update(got)
+        assert seen["old"] == {"v": "1"}
+
+
+class _CounterReconciler(Reconciler):
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self.calls = []
+
+    def reconcile(self, req: Request) -> Result:
+        self.calls.append(req)
+        return Result()
+
+
+class TestManager:
+    def test_primary_watch_enqueues(self):
+        c = k8s.FakeCluster()
+        m = Manager(c)
+        r = _CounterReconciler(c)
+        m.register(r, for_kind="ConfigMap")
+        c.create(make_cm("one"))
+        m.run_until_idle()
+        assert r.calls == [Request("one", "default")]
+
+    def test_owned_watch_maps_to_owner(self):
+        c = k8s.FakeCluster()
+        m = Manager(c)
+        r = _CounterReconciler(c)
+        m.register(r, for_kind="Notebook", owns=("ConfigMap",))
+        owner = c.create(
+            {
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "Notebook",
+                "metadata": {"name": "nb", "namespace": "ns"},
+            }
+        )
+        m.run_until_idle()
+        child = make_cm("child", "ns")
+        obj_util.set_controller_reference(owner, child)
+        c.create(child)
+        m.run_until_idle()
+        assert Request("nb", "ns") in r.calls
+        assert all(req.name == "nb" for req in r.calls)
+
+    def test_requeue_after_fires_on_tick(self):
+        c = k8s.FakeCluster()
+        m = Manager(c)
+
+        class Requeuer(Reconciler):
+            def __init__(self):
+                self.calls = 0
+
+            def reconcile(self, req):
+                self.calls += 1
+                return Result(requeue_after=30.0)
+
+        r = Requeuer()
+        m.register(r, for_kind="ConfigMap")
+        c.create(make_cm())
+        m.run_until_idle()
+        assert r.calls == 1
+        m.tick(10.0)
+        assert r.calls == 1  # not due yet
+        m.tick(25.0)
+        assert r.calls == 2  # 35s elapsed > 30s requeue
+
+
+class TestChaos:
+    def test_deterministic_failure_then_recovery(self):
+        c = k8s.FakeCluster()
+        chaos = k8s.ChaosClient(c)
+        fault = chaos.add_fault(
+            k8s.FaultConfig(operations=("create",), kinds=("ConfigMap",))
+        )
+        with pytest.raises(Exception):
+            chaos.create(make_cm())
+        assert fault.injected_count == 1
+        fault.deactivate()
+        chaos.create(make_cm())
+        assert c.exists("ConfigMap", "cm", "default")
+
+    def test_intermittent_rate(self):
+        c = k8s.FakeCluster()
+        chaos = k8s.ChaosClient(c, seed=42)
+        chaos.add_fault(
+            k8s.FaultConfig(operations=("get",), error_rate=0.5)
+        )
+        c.create(make_cm())
+        outcomes = []
+        for _ in range(100):
+            try:
+                chaos.get("ConfigMap", "cm", "default")
+                outcomes.append(True)
+            except Exception:
+                outcomes.append(False)
+        assert 20 < sum(outcomes) < 80  # roughly half succeed
+
+
+class TestFakeKubelet:
+    def _mini_sts(self, replicas=2, tpu=None, selector=None):
+        container = {"name": "nb", "image": "jupyter"}
+        if tpu:
+            container["resources"] = {"limits": {"google.com/tpu": tpu}}
+        spec = {"containers": [container]}
+        if selector:
+            spec["nodeSelector"] = selector
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {"name": "nb", "namespace": "ns"},
+            "spec": {
+                "replicas": replicas,
+                "serviceName": "nb-hosts",
+                "template": {"metadata": {"labels": {"app": "nb"}}, "spec": spec},
+            },
+        }
+
+    def test_pods_created_ready_and_indexed(self):
+        c = k8s.FakeCluster()
+        m = Manager(c)
+        k8s.add_cpu_node(c)
+        k8s.FakeKubelet(c).register(m)
+        c.create(self._mini_sts(replicas=2))
+        m.run_until_idle()
+        pods = sorted(c.list("Pod", "ns"), key=obj_util.name_of)
+        assert [obj_util.name_of(p) for p in pods] == ["nb-0", "nb-1"]
+        assert pods[0]["metadata"]["labels"]["apps.kubernetes.io/pod-index"] == "0"
+        assert pods[0]["status"]["phase"] == "Running"
+        sts = c.get("StatefulSet", "nb", "ns")
+        assert sts["status"]["readyReplicas"] == 2
+
+    def test_tpu_scheduling_requires_matching_pool(self):
+        c = k8s.FakeCluster()
+        m = Manager(c)
+        k8s.FakeKubelet(c).register(m)
+        sel = {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "4x4",
+        }
+        c.create(self._mini_sts(replicas=4, tpu="4", selector=sel))
+        m.run_until_idle()
+        pods = c.list("Pod", "ns")
+        assert all(p["status"]["phase"] == "Pending" for p in pods)
+        # Adding the pool reschedules the Pending pods without manual cleanup.
+        k8s.add_tpu_node_pool(c, "tpu-v5-lite-podslice", "4x4", hosts=4, chips_per_host=4)
+        m.run_until_idle()
+        pods = c.list("Pod", "ns")
+        assert all(p["status"]["phase"] == "Running" for p in pods)
+        nodes_used = {p["spec"]["nodeName"] for p in pods}
+        assert len(nodes_used) == 4  # one host-pod per TPU node
+
+    def test_scale_to_zero_deletes_all_pods(self):
+        c = k8s.FakeCluster()
+        m = Manager(c)
+        k8s.add_cpu_node(c)
+        k8s.FakeKubelet(c).register(m)
+        created = c.create(self._mini_sts(replicas=2))
+        m.run_until_idle()
+        sts = c.get("StatefulSet", "nb", "ns")
+        sts["spec"]["replicas"] = 0
+        c.update(sts)
+        m.run_until_idle()
+        assert c.list("Pod", "ns") == []

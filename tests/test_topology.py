@@ -1,0 +1,122 @@
+"""Unit tests for TPU slice-topology math (SURVEY.md §7 step 1/2 matrices)."""
+
+import pytest
+
+from kubeflow_tpu.tpu import topology as T
+
+
+class TestParse:
+    def test_2d(self):
+        assert T.parse_topology("4x4") == (4, 4)
+
+    def test_3d(self):
+        assert T.parse_topology("2x2x4") == (2, 2, 4)
+
+    @pytest.mark.parametrize("bad", ["", "4x", "x4", "axb", "0x4", "-1x2"])
+    def test_malformed(self, bad):
+        with pytest.raises(T.InvalidTopologyError):
+            T.parse_topology(bad)
+
+
+class TestResolveAccelerator:
+    @pytest.mark.parametrize(
+        "alias,canonical",
+        [
+            ("v5e", "v5e"),
+            ("V5E", "v5e"),
+            ("v5litepod", "v5e"),
+            ("tpu-v5-lite-podslice", "v5e"),
+            ("trillium", "v6e"),
+            ("v5p", "v5p"),
+            ("v4", "v4"),
+        ],
+    )
+    def test_aliases(self, alias, canonical):
+        assert T.resolve_accelerator(alias).name == canonical
+
+    def test_unknown(self):
+        with pytest.raises(T.InvalidTopologyError):
+            T.resolve_accelerator("h100")
+
+
+# The BASELINE.json evaluation matrix and a few more, as a spec-gen table:
+# (accelerator, topology, chips, hosts, chips_per_host, type_name)
+SLICE_MATRIX = [
+    ("v5e", "1x1", 1, 1, 1, "v5litepod-1"),
+    ("v5e", "2x2", 4, 1, 4, "v5litepod-4"),
+    ("v5e", "2x4", 8, 1, 8, "v5litepod-8"),  # fits one 8-chip host
+    ("v5e", "4x4", 16, 4, 4, "v5litepod-16"),  # the north-star config
+    ("v5e", "4x8", 32, 8, 4, "v5litepod-32"),
+    ("v5e", "8x8", 64, 16, 4, "v5litepod-64"),
+    ("v5e", "16x16", 256, 64, 4, "v5litepod-256"),
+    ("v5p", "2x2x1", 4, 1, 4, "v5p-8"),
+    ("v5p", "2x2x2", 8, 2, 4, "v5p-16"),
+    ("v5p", "2x2x4", 16, 4, 4, "v5p-32"),  # BASELINE config 5
+    ("v5p", "4x4x4", 64, 16, 4, "v5p-128"),
+    ("v4", "2x2x1", 4, 1, 4, "v4-8"),
+    ("v4", "2x2x4", 16, 4, 4, "v4-32"),
+    ("v6e", "2x2", 4, 1, 4, "v6e-4"),
+    ("v6e", "4x4", 16, 4, 4, "v6e-16"),
+]
+
+
+@pytest.mark.parametrize("acc,topo,chips,hosts,cph,tname", SLICE_MATRIX)
+def test_slice_matrix(acc, topo, chips, hosts, cph, tname):
+    st = T.slice_from_spec(acc, topo)
+    assert st.chips == chips
+    assert st.hosts == hosts
+    assert st.chips_per_host == cph
+    assert st.accelerator_type == tname
+    assert st.hosts * st.chips_per_host == st.chips
+
+
+class TestValidation:
+    def test_wrong_dimensionality(self):
+        with pytest.raises(T.InvalidTopologyError):
+            T.slice_from_spec("v5e", "2x2x2")  # v5e is 2-D
+        with pytest.raises(T.InvalidTopologyError):
+            T.slice_from_spec("v5p", "4x4")  # v5p is 3-D
+
+    def test_untileable(self):
+        # 3x4 = 12 chips > 8 single-host max, but 3 doesn't tile into 2x2 hosts
+        with pytest.raises(T.InvalidTopologyError):
+            T.slice_from_spec("v5e", "3x4")
+
+
+class TestSchedulingMetadata:
+    def test_node_selector(self):
+        st = T.slice_from_spec("v5e", "4x4")
+        assert st.node_selector() == {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "4x4",
+        }
+
+    def test_bounds_multihost_v5e(self):
+        st = T.slice_from_spec("v5e", "4x4")
+        assert st.host_shape() == (2, 2)
+        assert st.host_bounds() == (2, 2)
+        assert st.chip_bounds_str() == "2,2,1"
+        assert st.host_bounds_str() == "2,2,1"
+
+    def test_bounds_v5p(self):
+        st = T.slice_from_spec("v5p", "2x2x4")
+        assert st.chip_bounds_str() == "2,2,1"
+        assert st.host_bounds_str() == "1,1,4"
+
+    def test_bounds_single_host(self):
+        st = T.slice_from_spec("v5e", "2x4")
+        assert st.chip_bounds_str() == "2,4,1"
+        assert st.host_bounds_str() == "1,1,1"
+
+
+class TestWorkerHostnames:
+    def test_ordering_and_fqdn(self):
+        st = T.slice_from_spec("v5e", "4x4")
+        names = st.worker_hostnames("nb", "nb-hosts", "user-ns")
+        assert len(names) == 4
+        assert names[0] == "nb-0.nb-hosts.user-ns.svc.cluster.local"
+        assert names[3] == "nb-3.nb-hosts.user-ns.svc.cluster.local"
+
+    def test_single_host(self):
+        st = T.slice_from_spec("v5e", "2x2")
+        assert len(st.worker_hostnames("nb", "nb-hosts", "ns")) == 1
