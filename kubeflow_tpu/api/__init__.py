@@ -1,0 +1,11 @@
+from kubeflow_tpu.api.notebook import (  # noqa: F401
+    GROUP,
+    KIND,
+    HUB_VERSION,
+    VERSIONS,
+    Notebook,
+    TPUSpec,
+    new_notebook,
+    convert,
+)
+from kubeflow_tpu.api import annotations  # noqa: F401

@@ -1,0 +1,47 @@
+"""The annotation/label vocabulary: the user-facing config surface.
+
+Per-notebook annotations and labels are the reference's third config layer
+(SURVEY.md §5 "Config/flag system"); the names below preserve the reference's
+wire contract (dashboards and users already speak it) and add the TPU-native
+extensions under ``notebooks.kubeflow.org/tpu-*``.
+
+Reference anchors: stop annotation
+components/notebook-controller/pkg/culler/culler.go:41; restart
+components/notebook-controller/controllers/notebook_controller.go:259-294;
+activity stamps culling_controller.go:142-154; webhook annotations
+components/odh-notebook-controller/controllers/notebook_mutating_webhook.go.
+"""
+
+# -- lifecycle ---------------------------------------------------------------
+STOP = "kubeflow-resource-stopped"  # present → slice scaled to 0
+RECONCILIATION_LOCK_VALUE = "odh-notebook-controller-lock"
+RESTART = "notebooks.opendatahub.io/notebook-restart"
+UPDATE_PENDING = "notebooks.opendatahub.io/update-pending"
+
+# -- culling -----------------------------------------------------------------
+LAST_ACTIVITY = "notebooks.kubeflow.org/last-activity"
+LAST_ACTIVITY_CHECK = "notebooks.kubeflow.org/last_activity_check_timestamp"
+
+# -- auth / webhook ----------------------------------------------------------
+INJECT_AUTH = "notebooks.opendatahub.io/inject-auth"
+AUTH_SIDECAR_CPU_REQUEST = "notebooks.opendatahub.io/auth-sidecar-cpu-request"
+AUTH_SIDECAR_CPU_LIMIT = "notebooks.opendatahub.io/auth-sidecar-cpu-limit"
+AUTH_SIDECAR_MEMORY_REQUEST = "notebooks.opendatahub.io/auth-sidecar-memory-request"
+AUTH_SIDECAR_MEMORY_LIMIT = "notebooks.opendatahub.io/auth-sidecar-memory-limit"
+LAST_IMAGE_SELECTION = "notebooks.opendatahub.io/last-image-selection"
+WORKBENCH_IMAGE_NAMESPACE = "notebooks.opendatahub.io/workbench-image-namespace"
+INJECT_OAUTH_LEGACY = "notebooks.opendatahub.io/inject-oauth"
+
+# -- integrations ------------------------------------------------------------
+MLFLOW_INSTANCE = "opendatahub.io/mlflow-instance"
+FEAST_INTEGRATION_LABEL = "opendatahub.io/feast-integration"
+
+# -- TPU-native extensions ---------------------------------------------------
+# Set by the culler when a slice host is preempted/evicted; cleared on recovery.
+TPU_SLICE_INTERRUPTED = "notebooks.kubeflow.org/tpu-slice-interrupted"
+# Webhook records the resolved slice shape so updates can be diffed cheaply.
+TPU_RESOLVED_TOPOLOGY = "notebooks.kubeflow.org/tpu-resolved-topology"
+
+# -- labels ------------------------------------------------------------------
+NOTEBOOK_NAME_LABEL = "notebook-name"
+ODH_DASHBOARD_LABEL = "opendatahub.io/dashboard"

@@ -1,0 +1,192 @@
+"""Notebook CRD: typed accessors, versions, and conversion.
+
+Mirrors the reference API surface (reference
+components/notebook-controller/api/v1beta1/notebook_types.go:27-75 —
+``NotebookSpec{Template.Spec: corev1.PodSpec}`` passthrough plus
+``NotebookStatus{Conditions, ReadyReplicas, ContainerState}``) with the
+TPU-native addition of ``spec.tpu`` and ``status.tpu``:
+
+    spec:
+      template:
+        spec: <PodSpec passthrough, exactly as in the reference>
+      tpu:              # new, optional — absent means a plain CPU notebook
+        accelerator: v5e | v5p | v4 | v6e (+aliases)
+        topology: "4x4"
+        runtimeVersion: optional libtpu/runtime hint
+        spot: bool
+    status:
+      conditions: [...]            # mirrored pod conditions, as in reference
+      readyReplicas: int
+      containerState: {...}        # state of the container named like the CR
+      tpu:
+        hosts: int
+        readyHosts: int
+        sliceHealth: Healthy | Forming | Interrupted | Stopped
+        jaxCoordinator: host:port of worker 0
+
+Version scheme follows the reference: three served versions with identical
+shape, v1beta1 as the conversion hub (reference
+api/v1beta1/notebook_conversion.go:19, api/v1/notebook_conversion.go:25-69).
+Because the shapes are identical, conversion rewrites apiVersion and
+validates the tpu block.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.k8s import objects as obj_util
+from kubeflow_tpu.tpu.topology import SliceTopology, slice_from_spec
+
+GROUP = "kubeflow.org"
+KIND = "Notebook"
+HUB_VERSION = "v1beta1"
+VERSIONS = ("v1alpha1", "v1beta1", "v1")
+
+# StatefulSet names above this length break the controller-generated pod
+# hostnames (reference notebook_controller.go:59 MaxStatefulSetNameLength).
+MAX_NAME_LENGTH = 52
+
+
+@dataclass(frozen=True)
+class TPUSpec:
+    accelerator: str
+    topology: str
+    runtime_version: str = ""
+    spot: bool = False
+
+    def slice_topology(self) -> SliceTopology:
+        """Resolve and validate; raises InvalidTopologyError on bad input."""
+        return slice_from_spec(self.accelerator, self.topology)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TPUSpec":
+        return cls(
+            accelerator=d.get("accelerator", ""),
+            topology=d.get("topology", ""),
+            runtime_version=d.get("runtimeVersion", ""),
+            spot=bool(d.get("spot", False)),
+        )
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {"accelerator": self.accelerator, "topology": self.topology}
+        if self.runtime_version:
+            out["runtimeVersion"] = self.runtime_version
+        if self.spot:
+            out["spot"] = True
+        return out
+
+
+class Notebook:
+    """Typed view over a dict-shaped Notebook object (shared storage)."""
+
+    def __init__(self, obj: dict):
+        self.obj = obj
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return obj_util.name_of(self.obj)
+
+    @property
+    def namespace(self) -> str:
+        return obj_util.namespace_of(self.obj)
+
+    @property
+    def annotations(self) -> dict:
+        return obj_util.annotations_of(self.obj)
+
+    @property
+    def labels(self) -> dict:
+        return obj_util.labels_of(self.obj)
+
+    # -- spec --------------------------------------------------------------
+    @property
+    def pod_spec(self) -> dict:
+        return (
+            self.obj.setdefault("spec", {})
+            .setdefault("template", {})
+            .setdefault("spec", {})
+        )
+
+    @property
+    def containers(self) -> list[dict]:
+        return self.pod_spec.setdefault("containers", [])
+
+    def primary_container(self) -> Optional[dict]:
+        """The notebook container: the one named like the CR (reference
+        notebook_controller.go:350-360 mirrors exactly this container)."""
+        for c in self.containers:
+            if c.get("name") == self.name:
+                return c
+        return self.containers[0] if self.containers else None
+
+    @property
+    def tpu(self) -> Optional[TPUSpec]:
+        d = self.obj.get("spec", {}).get("tpu")
+        return TPUSpec.from_dict(d) if d else None
+
+    # -- lifecycle annotations --------------------------------------------
+    @property
+    def stopped(self) -> bool:
+        return ann.STOP in self.obj.get("metadata", {}).get("annotations", {})
+
+    @property
+    def lock_held(self) -> bool:
+        return (
+            self.obj.get("metadata", {}).get("annotations", {}).get(ann.STOP)
+            == ann.RECONCILIATION_LOCK_VALUE
+        )
+
+    # -- status ------------------------------------------------------------
+    @property
+    def status(self) -> dict:
+        return self.obj.setdefault("status", {})
+
+
+def new_notebook(
+    name: str,
+    namespace: str,
+    image: str = "jupyter-minimal:latest",
+    tpu: Optional[TPUSpec] = None,
+    version: str = "v1",
+    annotations: Optional[dict] = None,
+    labels: Optional[dict] = None,
+    container_overrides: Optional[dict] = None,
+) -> dict:
+    """Build a Notebook object the way a dashboard/user would."""
+    container = {
+        "name": name,
+        "image": image,
+        "resources": {"requests": {"cpu": "500m", "memory": "1Gi"}},
+    }
+    if container_overrides:
+        container.update(container_overrides)
+    obj = obj_util.new_object(
+        f"{GROUP}/{version}", KIND, name, namespace,
+        labels=labels, annotations=annotations,
+    )
+    obj["spec"] = {"template": {"spec": {"containers": [container]}}}
+    if tpu:
+        obj["spec"]["tpu"] = tpu.to_dict()
+    return obj
+
+
+def convert(obj: dict, to_version: str) -> dict:
+    """Convert a Notebook between served versions through the hub.
+
+    All versions share one shape (as in the reference, where ConvertTo /
+    ConvertFrom copy fields 1:1 — reference api/v1/notebook_conversion.go:
+    25-69), so conversion is an apiVersion rewrite with validation.
+    """
+    if to_version not in VERSIONS:
+        raise ValueError(f"unknown Notebook version {to_version!r}; known {VERSIONS}")
+    current = obj.get("apiVersion", "")
+    if current.split("/")[0] not in (GROUP,):
+        raise ValueError(f"not a {GROUP} object: apiVersion={current!r}")
+    out = copy.deepcopy(obj)
+    out["apiVersion"] = f"{GROUP}/{to_version}"
+    return out

@@ -1,0 +1,6 @@
+from kubeflow_tpu.controller.notebook import (  # noqa: F401
+    NotebookReconciler,
+    ControllerConfig,
+)
+from kubeflow_tpu.controller.culling import CullingReconciler, CullerConfig  # noqa: F401
+from kubeflow_tpu.controller.preemption import SliceHealthReconciler  # noqa: F401

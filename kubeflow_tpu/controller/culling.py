@@ -1,0 +1,314 @@
+"""Idle-culling controller: slice-aware Jupyter activity tracking.
+
+Rebuild of the reference culling loop (reference
+components/notebook-controller/controllers/culling_controller.go:87-218
+Reconcile, notebookIsIdle :221, kernel/terminal probing :244-322, monotonic
+annotation merge :360-437, setStopAnnotation :484) with the two TPU changes
+from SURVEY.md §7 step 5:
+
+1. **Multi-host activity merge** — Jupyter runs on worker 0, but any host of
+   the slice may be active (profile servers, distributed jobs). The prober
+   fans out to every host and activity merges with a monotonic guard, so a
+   busy worker 3 keeps the slice alive and clock skew can never move
+   last-activity backwards (the reference's flapping hazard).
+2. **Atomic release** — culling sets the stop annotation once; the core
+   reconciler scales the whole indexed StatefulSet to 0. Chips are reclaimed
+   all-or-nothing; a cull can never leave a partial slice holding capacity.
+
+Probing is behind the ``ActivityProber`` seam: production uses an HTTP
+prober against each host's Jupyter API (and a C++ fan-out prober can slot in
+for large slices); tests inject a fake.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import Callable, Optional, Protocol
+
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.api.notebook import Notebook
+from kubeflow_tpu.controller.notebook import headless_service_name
+from kubeflow_tpu.k8s import objects as obj_util
+from kubeflow_tpu.k8s.client import Client, retry_on_conflict
+from kubeflow_tpu.k8s.errors import NotFoundError
+from kubeflow_tpu.k8s.events import EventRecorder
+from kubeflow_tpu.k8s.manager import Manager, Reconciler, Request, Result
+from kubeflow_tpu.metrics import Metrics
+
+log = logging.getLogger(__name__)
+
+TIME_FORMAT = "%Y-%m-%dT%H:%M:%SZ"
+
+
+def _fmt(ts: float) -> str:
+    return time.strftime(TIME_FORMAT, time.gmtime(ts))
+
+
+def _parse(ts: str) -> Optional[float]:
+    try:
+        import calendar
+
+        return float(calendar.timegm(time.strptime(ts, TIME_FORMAT)))
+    except (ValueError, TypeError):
+        return None
+
+
+@dataclass
+class CullerConfig:
+    """Env knobs, names and defaults per the reference initGlobalVars
+    (culling_controller.go:534-568)."""
+
+    enable_culling: bool = False
+    cull_idle_time_min: int = 1440  # 1 day, reference default
+    idleness_check_period_min: int = 1
+    cluster_domain: str = "cluster.local"
+    dev_mode: bool = False
+
+    @classmethod
+    def from_env(cls, env: dict) -> "CullerConfig":
+        return cls(
+            enable_culling=env.get("ENABLE_CULLING", "false").lower() == "true",
+            cull_idle_time_min=int(env.get("CULL_IDLE_TIME", "1440")),
+            idleness_check_period_min=int(env.get("IDLENESS_CHECK_PERIOD", "1")),
+            cluster_domain=env.get("CLUSTER_DOMAIN", "cluster.local"),
+            dev_mode=env.get("DEV", "false").lower() == "true",
+        )
+
+
+@dataclass
+class HostActivity:
+    """Observed activity on one slice host."""
+
+    host: str
+    busy: bool = False
+    last_activity: Optional[float] = None  # unix seconds
+    reachable: bool = True
+
+
+class ActivityProber(Protocol):
+    def probe(self, nb: Notebook, hosts: list[str]) -> list[HostActivity]: ...
+
+
+class JupyterHTTPProber:
+    """Probes Jupyter's /api/kernels + /api/terminals on worker 0 and the
+    activity endpoint on every other host (reference getNotebookApiKernels
+    :277-322; DEV mode proxies via localhost as :253-257 does)."""
+
+    def __init__(self, timeout_s: float = 5.0, dev_proxy: Optional[str] = None):
+        self.timeout_s = timeout_s
+        self.dev_proxy = dev_proxy
+
+    def probe(self, nb: Notebook, hosts: list[str]) -> list[HostActivity]:
+        out = []
+        for i, host in enumerate(hosts):
+            base = (
+                f"{self.dev_proxy}/notebook/{nb.namespace}/{nb.name}"
+                if self.dev_proxy
+                else f"http://{host}:8888/notebook/{nb.namespace}/{nb.name}"
+            )
+            activity = HostActivity(host=host)
+            kernels = self._get_json(f"{base}/api/kernels")
+            if kernels is None:
+                activity.reachable = False
+                out.append(activity)
+                continue
+            for kernel in kernels:
+                if kernel.get("execution_state") == "busy":
+                    activity.busy = True
+                ts = _parse_jupyter_time(kernel.get("last_activity", ""))
+                if ts is not None:
+                    activity.last_activity = max(activity.last_activity or 0.0, ts)
+            terminals = self._get_json(f"{base}/api/terminals") or []
+            for term in terminals:
+                ts = _parse_jupyter_time(term.get("last_activity", ""))
+                if ts is not None:
+                    activity.last_activity = max(activity.last_activity or 0.0, ts)
+            out.append(activity)
+        return out
+
+    def _get_json(self, url: str):
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+                return json.loads(resp.read().decode())
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+
+def _parse_jupyter_time(value: str) -> Optional[float]:
+    """Jupyter emits e.g. 2026-07-29T12:00:00.123456Z."""
+    if not value:
+        return None
+    value = value.split(".")[0].rstrip("Z") + "Z"
+    return _parse(value)
+
+
+class CullingReconciler(Reconciler):
+    def __init__(
+        self,
+        client: Client,
+        config: Optional[CullerConfig] = None,
+        prober: Optional[ActivityProber] = None,
+        metrics: Optional[Metrics] = None,
+        recorder: Optional[EventRecorder] = None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.client = client
+        self.config = config or CullerConfig(enable_culling=True)
+        self.prober = prober or JupyterHTTPProber()
+        self.metrics = metrics or Metrics(client)
+        self.recorder = recorder or EventRecorder(client, component="culler")
+        self.clock = clock or time.time
+
+    def register(self, manager: Manager) -> None:
+        manager.register(self, for_kind="Notebook", name="Culler")
+
+    # ------------------------------------------------------------------
+    def reconcile(self, req: Request) -> Result:
+        if not self.config.enable_culling:
+            return Result()
+        try:
+            obj = self.client.get("Notebook", req.name, req.namespace)
+        except NotFoundError:
+            return Result()
+        if "deletionTimestamp" in obj["metadata"]:
+            return Result()
+        nb = Notebook(obj)
+        now = self.clock()
+
+        # Stopped → clear activity annotations, no requeue until resumed
+        # (reference :105-118).
+        if nb.stopped:
+            self._remove_activity_annotations(nb)
+            return Result()
+
+        # Pod 0 gone → nothing to probe (reference :121-139).
+        if not self.client.list(
+            "Pod", nb.namespace, {ann.NOTEBOOK_NAME_LABEL: nb.name}
+        ):
+            self._remove_activity_annotations(nb)
+            return Result(requeue_after=self._period_s())
+
+        anns = obj.get("metadata", {}).get("annotations", {})
+        if ann.LAST_ACTIVITY not in anns or ann.LAST_ACTIVITY_CHECK not in anns:
+            self._init_activity_annotations(nb, now)
+            return Result(requeue_after=self._period_s())
+
+        last_check = _parse(anns.get(ann.LAST_ACTIVITY_CHECK, "")) or 0.0
+        elapsed = now - last_check
+        if elapsed < self._period_s():
+            return Result(requeue_after=self._period_s() - elapsed)
+
+        activities = self.prober.probe(nb, self._host_dns(nb))
+        self._update_activity(nb, activities, now)
+
+        obj = self.client.get("Notebook", nb.name, nb.namespace)
+        nb = Notebook(obj)
+        last_activity = _parse(nb.annotations.get(ann.LAST_ACTIVITY, "")) or now
+        if now - last_activity > self.config.cull_idle_time_min * 60:
+            self._cull(nb, now, last_activity)
+            return Result()
+        return Result(requeue_after=self._period_s())
+
+    # ------------------------------------------------------------------
+    def _period_s(self) -> float:
+        return self.config.idleness_check_period_min * 60.0
+
+    def _host_dns(self, nb: Notebook) -> list[str]:
+        if nb.tpu is not None:
+            try:
+                topo = nb.tpu.slice_topology()
+            except Exception:
+                topo = None
+            if topo is not None and topo.hosts > 1:
+                return topo.worker_hostnames(
+                    nb.name,
+                    headless_service_name(nb.name),
+                    nb.namespace,
+                    self.config.cluster_domain,
+                )
+        # Single pod: route via the plain Service, as the reference does.
+        return [f"{nb.name}.{nb.namespace}.svc.{self.config.cluster_domain}"]
+
+    def _update_activity(
+        self, nb: Notebook, activities: list[HostActivity], now: float
+    ) -> None:
+        """Merge host activity with the monotonic guard (reference
+        updateTimestampFromKernelsActivity :380-437 generalized to N hosts)."""
+        busy = any(a.busy for a in activities)
+        observed: Optional[float] = None
+        for a in activities:
+            if a.last_activity is not None:
+                observed = max(observed or 0.0, a.last_activity)
+        if busy:
+            new_activity: Optional[float] = now
+        else:
+            new_activity = observed
+
+        def write():
+            fresh = self.client.get("Notebook", nb.name, nb.namespace)
+            anns = obj_util.annotations_of(fresh)
+            if new_activity is not None:
+                current = _parse(anns.get(ann.LAST_ACTIVITY, ""))
+                # Monotonic: never move last-activity backwards.
+                if current is None or new_activity > current:
+                    anns[ann.LAST_ACTIVITY] = _fmt(new_activity)
+            anns[ann.LAST_ACTIVITY_CHECK] = _fmt(now)
+            self.client.update(fresh)
+
+        retry_on_conflict(write)
+
+    def _init_activity_annotations(self, nb: Notebook, now: float) -> None:
+        def write():
+            fresh = self.client.get("Notebook", nb.name, nb.namespace)
+            anns = obj_util.annotations_of(fresh)
+            anns.setdefault(ann.LAST_ACTIVITY, _fmt(now))
+            anns.setdefault(ann.LAST_ACTIVITY_CHECK, _fmt(now))
+            self.client.update(fresh)
+
+        retry_on_conflict(write)
+
+    def _remove_activity_annotations(self, nb: Notebook) -> None:
+        def write():
+            fresh = self.client.get("Notebook", nb.name, nb.namespace)
+            removed = obj_util.remove_annotation(fresh, ann.LAST_ACTIVITY)
+            removed |= obj_util.remove_annotation(fresh, ann.LAST_ACTIVITY_CHECK)
+            if removed:
+                self.client.update(fresh)
+
+        retry_on_conflict(write)
+
+    def _cull(self, nb: Notebook, now: float, last_activity: float) -> None:
+        """Set the stop annotation → core reconciler scales slice to 0
+        atomically (reference setStopAnnotation :484-500)."""
+        chips = 0
+        if nb.tpu is not None:
+            try:
+                chips = nb.tpu.slice_topology().chips
+            except Exception:
+                chips = 0
+
+        def write():
+            fresh = self.client.get("Notebook", nb.name, nb.namespace)
+            anns = obj_util.annotations_of(fresh)
+            if ann.STOP in anns:
+                return
+            anns[ann.STOP] = _fmt(now)
+            self.client.update(fresh)
+
+        retry_on_conflict(write)
+        self.metrics.culling_total.inc()
+        self.metrics.last_culling_timestamp.set(now)
+        if chips:
+            self.metrics.chips_reclaimed_total.inc(chips)
+        idle_min = int((now - last_activity) / 60)
+        self.recorder.eventf(
+            nb.obj, "Normal", "NotebookCulled",
+            f"Notebook idle for {idle_min}m "
+            f"(> {self.config.cull_idle_time_min}m); "
+            + (f"released {chips} TPU chips" if chips else "stopped"),
+        )

@@ -1,0 +1,531 @@
+"""Core Notebook reconciler: Notebook CR → indexed StatefulSet + Services.
+
+TPU-native rebuild of the reference's core loop (reference
+components/notebook-controller/controllers/notebook_controller.go:94-294,
+generateStatefulSet :433-523, generateService :525-556, status mirroring
+:299-374, restart annotation :259-294, event re-emission :99-126), with the
+key generalization from SURVEY.md §7 step 2: a notebook is N pods, not 1.
+
+- CPU notebook (no ``spec.tpu``): 1-replica StatefulSet — reference parity.
+- TPU notebook: **indexed StatefulSet** with ``replicas == slice hosts``,
+  ``podManagementPolicy: Parallel`` (all hosts start together — a partial
+  slice is useless and jax.distributed.initialize would hang), a headless
+  Service for stable per-host DNS, ``google.com/tpu`` chip limits on the
+  primary container, and GKE TPU nodeSelectors + tolerations.
+- The stop annotation scales the *whole slice* to 0 atomically; a restart
+  annotation deletes *every* host pod (never partial — the slice restarts
+  as a unit).
+"""
+
+from __future__ import annotations
+
+import calendar
+import copy
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.api.notebook import MAX_NAME_LENGTH, Notebook
+from kubeflow_tpu.controller import reconcilehelper as helper
+from kubeflow_tpu.k8s import objects as obj_util
+from kubeflow_tpu.k8s.client import Client, retry_on_conflict
+from kubeflow_tpu.k8s.errors import NotFoundError
+from kubeflow_tpu.k8s.events import EventRecorder
+from kubeflow_tpu.k8s.manager import Manager, Reconciler, Request, Result
+from kubeflow_tpu.metrics import Metrics
+from kubeflow_tpu.tpu.topology import InvalidTopologyError, SliceTopology
+
+log = logging.getLogger(__name__)
+
+NOTEBOOK_PORT = 8888
+NOTEBOOK_PORT_NAME = "notebook-port"
+JAX_COORDINATOR_PORT = 8476  # jax.distributed default coordinator port
+
+# Annotations never copied onto pod templates (reference
+# notebook_controller.go:486-491 filters kubectl + lifecycle keys).
+_TEMPLATE_ANNOTATION_SKIP = {
+    "kubectl.kubernetes.io/last-applied-configuration",
+    ann.STOP,
+    ann.RESTART,
+    ann.LAST_ACTIVITY,
+    ann.LAST_ACTIVITY_CHECK,
+    ann.UPDATE_PENDING,
+    ann.TPU_SLICE_INTERRUPTED,
+}
+
+_REEMITTED_MARK = "notebooks.kubeflow.org/re-emitted"
+
+
+@dataclass
+class ControllerConfig:
+    """Env-sourced knobs (reference manager.yaml:28-58 ConfigMap wiring)."""
+
+    add_fsgroup: bool = True
+    cluster_domain: str = "cluster.local"
+    default_working_dir: str = "/home/jovyan"
+
+    @classmethod
+    def from_env(cls, env: dict) -> "ControllerConfig":
+        return cls(
+            add_fsgroup=env.get("ADD_FSGROUP", "true").lower() != "false",
+            cluster_domain=env.get("CLUSTER_DOMAIN", "cluster.local"),
+        )
+
+
+def headless_service_name(notebook_name: str) -> str:
+    return f"{notebook_name}-hosts"
+
+
+class NotebookReconciler(Reconciler):
+    def __init__(
+        self,
+        client: Client,
+        config: Optional[ControllerConfig] = None,
+        metrics: Optional[Metrics] = None,
+        recorder: Optional[EventRecorder] = None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.client = client
+        self.config = config or ControllerConfig()
+        self.metrics = metrics or Metrics(client)
+        self.recorder = recorder or EventRecorder(client)
+        self.clock = clock or time.time
+        # Notebooks whose slice-ready latency was already observed.
+        self._ready_observed: set[tuple[str, str]] = set()
+
+    def register(self, manager: Manager) -> None:
+        manager.register(
+            self,
+            for_kind="Notebook",
+            owns=("StatefulSet", "Service"),
+            watches=[
+                ("Pod", _pod_to_notebook),
+                ("Event", _event_to_notebook),
+            ],
+            name="NotebookReconciler",
+        )
+
+    # ------------------------------------------------------------------
+    def reconcile(self, req: Request) -> Result:
+        try:
+            obj = self.client.get("Notebook", req.name, req.namespace)
+        except NotFoundError:
+            return Result()
+        if "deletionTimestamp" in obj["metadata"]:
+            # Deletion cleanup is finalizer-driven (platform controller);
+            # child objects go via ownerReference GC.
+            return Result()
+        nb = Notebook(obj)
+
+        if len(nb.name) > MAX_NAME_LENGTH:
+            self.recorder.eventf(
+                obj, "Warning", "InvalidName",
+                f"Notebook name exceeds {MAX_NAME_LENGTH} characters; "
+                "StatefulSet pod hostnames would be invalid",
+            )
+            return Result()
+
+        # Resolve TPU topology up front; an invalid spec must never produce
+        # a half-scheduled slice.
+        slice_topo: Optional[SliceTopology] = None
+        if nb.tpu is not None:
+            try:
+                slice_topo = nb.tpu.slice_topology()
+            except InvalidTopologyError as err:
+                self.recorder.eventf(obj, "Warning", "InvalidTPUTopology", str(err))
+                self._set_condition(
+                    nb, "TPUTopologyValid", "False", "InvalidTopology", str(err)
+                )
+                return Result()
+            self._set_condition(
+                nb, "TPUTopologyValid", "True", "Resolved",
+                f"{slice_topo.accelerator_type} ({slice_topo.hosts} hosts)",
+            )
+
+        sts = generate_statefulset(nb, slice_topo, self.config)
+        created = self._reconcile_statefulset(obj, sts)
+        if created:
+            self.metrics.create_total.inc()
+
+        service = generate_service(nb)
+        helper.reconcile_child(self.client, obj, service, helper.copy_service_fields)
+        if slice_topo is not None:
+            headless = generate_headless_service(nb, slice_topo)
+            helper.reconcile_child(
+                self.client, obj, headless, helper.copy_service_fields
+            )
+
+        self._reemit_pod_events(nb, slice_topo)
+        self._update_status(nb, slice_topo)
+        self._handle_restart(nb, slice_topo)
+        return Result()
+
+    # ------------------------------------------------------------------
+    def _reconcile_statefulset(self, owner: dict, desired: dict) -> bool:
+        """Create-or-update; returns True when newly created."""
+        name = obj_util.name_of(desired)
+        namespace = obj_util.namespace_of(desired)
+        try:
+            existing = self.client.get("StatefulSet", name, namespace)
+        except NotFoundError:
+            obj_util.set_controller_reference(owner, desired)
+            try:
+                self.client.create(desired)
+            except Exception:
+                self.metrics.create_failed_total.inc()
+                raise
+            return True
+        if helper.copy_statefulset_fields(desired, existing):
+            self.client.update(existing)
+        return False
+
+    # ------------------------------------------------------------------
+    def _slice_pods(self, nb: Notebook) -> list[dict]:
+        out = []
+        for pod in self.client.list("Pod", nb.namespace):
+            labels = pod.get("metadata", {}).get("labels", {})
+            if labels.get(ann.NOTEBOOK_NAME_LABEL) == nb.name:
+                out.append(pod)
+        return sorted(out, key=obj_util.name_of)
+
+    def _update_status(self, nb: Notebook, slice_topo: Optional[SliceTopology]) -> None:
+        """Mirror pod state onto the Notebook (reference
+        createNotebookStatus :315-374), extended with slice-level TPU status."""
+        pods = self._slice_pods(nb)
+        pod0 = next((p for p in pods if obj_util.name_of(p).endswith("-0")), None)
+
+        status: dict = {}
+        ready_hosts = 0
+        for pod in pods:
+            if _pod_ready(pod):
+                ready_hosts += 1
+        status["readyReplicas"] = ready_hosts
+
+        pod_conditions: list = []
+        if pod0 is not None:
+            # Mirror pod-0 conditions (the reference mirrors its single pod).
+            pod_conditions = pod0.get("status", {}).get("conditions", [])
+            for cs in pod0.get("status", {}).get("containerStatuses", []):
+                if cs.get("name") == nb.name:
+                    status["containerState"] = cs.get("state", {})
+                    break
+
+        if slice_topo is not None:
+            hosts = slice_topo.hosts
+            interrupted = any(
+                p.get("status", {}).get("phase") == "Failed" for p in pods
+            ) or ann.TPU_SLICE_INTERRUPTED in nb.annotations
+            if nb.stopped:
+                health = "Stopped"
+            elif interrupted:
+                health = "Interrupted"
+            elif ready_hosts == hosts:
+                health = "Healthy"
+            else:
+                health = "Forming"
+            status["tpu"] = {
+                "hosts": hosts,
+                "readyHosts": ready_hosts,
+                "sliceHealth": health,
+                "acceleratorType": slice_topo.accelerator_type,
+            }
+            if hosts > 1:
+                status["tpu"]["jaxCoordinator"] = (
+                    f"{nb.name}-0.{headless_service_name(nb.name)}."
+                    f"{nb.namespace}.svc.{self.config.cluster_domain}"
+                    f":{JAX_COORDINATOR_PORT}"
+                )
+            if health == "Healthy":
+                self._observe_slice_ready(nb)
+
+        def write():
+            # Merge against the FRESH object's conditions: a condition set
+            # earlier in this reconcile (e.g. TPUTopologyValid) must survive
+            # the status rewrite, or the two writers oscillate forever.
+            fresh = self.client.get("Notebook", nb.name, nb.namespace)
+            merged = dict(status)
+            merged["conditions"] = _merge_pod_conditions(
+                fresh.get("status", {}).get("conditions", []), pod_conditions
+            )
+            if fresh.get("status", {}) == merged:
+                return
+            fresh["status"] = merged
+            self.client.update_status(fresh)
+
+        retry_on_conflict(write)
+
+    def _observe_slice_ready(self, nb: Notebook) -> None:
+        key = (nb.namespace, nb.name)
+        if key in self._ready_observed:
+            return
+        self._ready_observed.add(key)
+        created = nb.obj.get("metadata", {}).get("creationTimestamp", "")
+        try:
+            created_s = calendar.timegm(time.strptime(created, "%Y-%m-%dT%H:%M:%SZ"))
+        except (ValueError, OverflowError):
+            return
+        elapsed = max(0.0, self.clock() - created_s)
+        self.metrics.slice_ready_seconds.observe(elapsed)
+
+    # ------------------------------------------------------------------
+    def _handle_restart(self, nb: Notebook, slice_topo: Optional[SliceTopology]) -> None:
+        """Restart annotation → delete every slice pod, then clear it
+        (reference :259-294 deletes the single pod; a TPU slice restarts
+        as a unit — deleting only one host would wedge jax.distributed)."""
+        if nb.annotations.get(ann.RESTART) != "true":
+            return
+        for pod in self._slice_pods(nb):
+            try:
+                self.client.delete("Pod", obj_util.name_of(pod), nb.namespace)
+            except NotFoundError:
+                pass
+
+        def clear():
+            fresh = self.client.get("Notebook", nb.name, nb.namespace)
+            if obj_util.remove_annotation(fresh, ann.RESTART):
+                self.client.update(fresh)
+
+        retry_on_conflict(clear)
+        self.recorder.eventf(
+            nb.obj, "Normal", "NotebookRestarted",
+            f"All {max(1, slice_topo.hosts if slice_topo else 1)} slice pod(s) "
+            "deleted for restart",
+        )
+
+    # ------------------------------------------------------------------
+    def _reemit_pod_events(self, nb: Notebook, slice_topo: Optional[SliceTopology]) -> None:
+        """Surface Warning events from slice pods on the Notebook itself
+        (reference :99-126 re-emits via nbNameFromInvolvedObject)."""
+        prefixes = {f"{nb.name}-{i}" for i in range(slice_topo.hosts if slice_topo else 1)}
+        for event in self.client.list("Event", nb.namespace):
+            inv = event.get("involvedObject", {})
+            if inv.get("kind") != "Pod" or inv.get("name") not in prefixes:
+                continue
+            if event.get("type") != "Warning":
+                continue
+            marks = event.get("metadata", {}).get("annotations", {})
+            if _REEMITTED_MARK in marks:
+                continue
+            self.recorder.eventf(
+                nb.obj, "Warning", event.get("reason", "PodEvent"),
+                f"[{inv.get('name')}] {event.get('message', '')}",
+            )
+            obj_util.set_annotation(event, _REEMITTED_MARK, "true")
+            try:
+                self.client.update(event)
+            except NotFoundError:
+                pass
+
+    def _set_condition(
+        self, nb: Notebook, ctype: str, cstatus: str, reason: str, message: str
+    ) -> None:
+        def write():
+            fresh = self.client.get("Notebook", nb.name, nb.namespace)
+            obj_util.set_condition(
+                fresh,
+                {"type": ctype, "status": cstatus, "reason": reason, "message": message},
+            )
+            self.client.update_status(fresh)
+
+        retry_on_conflict(write)
+
+
+# ---------------------------------------------------------------------------
+# Spec generation (pure functions — the unit-test surface, SURVEY.md §4a)
+
+
+def generate_statefulset(
+    nb: Notebook, slice_topo: Optional[SliceTopology], config: ControllerConfig
+) -> dict:
+    """Notebook CR → StatefulSet spec (reference generateStatefulSet :433-523,
+    TPU-generalized)."""
+    hosts = slice_topo.hosts if slice_topo else 1
+    replicas = 0 if nb.stopped else hosts
+
+    template_labels = {
+        "statefulset": nb.name,
+        ann.NOTEBOOK_NAME_LABEL: nb.name,
+    }
+    for key, value in nb.labels.items():
+        template_labels.setdefault(key, value)
+    template_annotations = {
+        k: v
+        for k, v in nb.annotations.items()
+        if k not in _TEMPLATE_ANNOTATION_SKIP
+    }
+
+    pod_spec = copy.deepcopy(nb.pod_spec)
+    containers = pod_spec.setdefault("containers", [])
+    for container in containers:
+        if container.get("name") == nb.name:
+            _apply_container_defaults(container, nb, config)
+            if slice_topo is not None:
+                resources = container.setdefault("resources", {})
+                chips = str(slice_topo.chips_per_host)
+                resources.setdefault("limits", {})["google.com/tpu"] = chips
+                resources.setdefault("requests", {})["google.com/tpu"] = chips
+            break
+
+    if config.add_fsgroup:
+        pod_spec.setdefault("securityContext", {}).setdefault("fsGroup", 100)
+
+    if slice_topo is not None:
+        selector = pod_spec.setdefault("nodeSelector", {})
+        selector.update(slice_topo.node_selector())
+        if nb.tpu is not None and nb.tpu.spot:
+            selector["cloud.google.com/gke-spot"] = "true"
+        tolerations = pod_spec.setdefault("tolerations", [])
+        if not any(t.get("key") == "google.com/tpu" for t in tolerations):
+            tolerations.append(
+                {"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"}
+            )
+
+    sts = {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {
+            "name": nb.name,
+            "namespace": nb.namespace,
+            "labels": dict(template_labels),
+        },
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"statefulset": nb.name}},
+            "serviceName": headless_service_name(nb.name)
+            if slice_topo is not None
+            else nb.name,
+            "template": {
+                "metadata": {
+                    "labels": template_labels,
+                    "annotations": template_annotations,
+                },
+                "spec": pod_spec,
+            },
+        },
+    }
+    if slice_topo is not None:
+        # All hosts must come up together; OrderedReady would serialize the
+        # slice and blow the <90s spawn budget.
+        sts["spec"]["podManagementPolicy"] = "Parallel"
+    return sts
+
+
+def _apply_container_defaults(
+    container: dict, nb: Notebook, config: ControllerConfig
+) -> None:
+    """Reference defaults (notebook_controller.go:493-508)."""
+    container.setdefault("workingDir", config.default_working_dir)
+    ports = container.setdefault("ports", [])
+    if not any(p.get("containerPort") == NOTEBOOK_PORT for p in ports):
+        ports.append(
+            {"containerPort": NOTEBOOK_PORT, "name": NOTEBOOK_PORT_NAME, "protocol": "TCP"}
+        )
+    env = container.setdefault("env", [])
+    if not any(e.get("name") == "NB_PREFIX" for e in env):
+        env.append(
+            {"name": "NB_PREFIX", "value": f"/notebook/{nb.namespace}/{nb.name}"}
+        )
+
+
+def generate_service(nb: Notebook) -> dict:
+    """Routing Service: port 80 "http-notebook" → 8888 on pod 0 (reference
+    generateService :525-556; Jupyter runs on worker 0 of a slice)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": nb.name,
+            "namespace": nb.namespace,
+            "labels": {ann.NOTEBOOK_NAME_LABEL: nb.name},
+        },
+        "spec": {
+            "type": "ClusterIP",
+            "selector": {
+                "statefulset": nb.name,
+                "apps.kubernetes.io/pod-index": "0",
+            },
+            "ports": [
+                {
+                    "name": "http-" + nb.name,
+                    "port": 80,
+                    "targetPort": NOTEBOOK_PORT,
+                    "protocol": "TCP",
+                }
+            ],
+        },
+    }
+
+
+def generate_headless_service(nb: Notebook, slice_topo: SliceTopology) -> dict:
+    """Headless Service giving every slice host a stable DNS identity —
+    the backbone of TPU_WORKER_HOSTNAMES and jax.distributed bootstrap."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": headless_service_name(nb.name),
+            "namespace": nb.namespace,
+            "labels": {ann.NOTEBOOK_NAME_LABEL: nb.name},
+        },
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"statefulset": nb.name},
+            "publishNotReadyAddresses": True,  # hosts must resolve during formation
+            "ports": [
+                {"name": "jax-coordinator", "port": JAX_COORDINATOR_PORT, "protocol": "TCP"},
+                {"name": "notebook", "port": NOTEBOOK_PORT, "protocol": "TCP"},
+            ],
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Watch map functions
+
+
+def _pod_to_notebook(ev) -> list[Request]:
+    labels = ev.object.get("metadata", {}).get("labels", {})
+    name = labels.get(ann.NOTEBOOK_NAME_LABEL)
+    if name:
+        return [Request(name, ev.namespace)]
+    return []
+
+
+def _event_to_notebook(ev) -> list[Request]:
+    """Map pod Events to their Notebook: pod "{nb}-{ordinal}" → nb
+    (reference nbNameFromInvolvedObject :705)."""
+    inv = ev.object.get("involvedObject", {})
+    if inv.get("kind") != "Pod":
+        return []
+    name = inv.get("name", "")
+    base, _, ordinal = name.rpartition("-")
+    if base and ordinal.isdigit():
+        return [Request(base, ev.namespace)]
+    return []
+
+
+def _pod_ready(pod: dict) -> bool:
+    for cond in pod.get("status", {}).get("conditions", []):
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False
+
+
+def _merge_pod_conditions(existing: list, pod_conditions: list) -> list:
+    """Mirror pod conditions by type (reference PodCondToNotebookCond :376)."""
+    merged = {c.get("type"): c for c in existing}
+    for cond in pod_conditions:
+        merged[cond.get("type")] = {
+            "type": cond.get("type"),
+            "status": cond.get("status"),
+            **({"reason": cond["reason"]} if cond.get("reason") else {}),
+            **({"message": cond["message"]} if cond.get("message") else {}),
+            **(
+                {"lastTransitionTime": cond["lastTransitionTime"]}
+                if cond.get("lastTransitionTime")
+                else {}
+            ),
+        }
+    return list(merged.values())

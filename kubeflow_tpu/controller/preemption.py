@@ -1,0 +1,147 @@
+"""Slice health / preemption recovery controller.
+
+TPU slices on spot or maintenance-window capacity lose hosts without warning;
+the reference has no analogue (SURVEY.md §7 "Hard parts": "Preemption/
+maintenance events have no reference analogue; design from scratch against
+the event-re-emission + conditions machinery"). Design:
+
+- Watch slice pods. A pod that dies with a DisruptionTarget condition or a
+  Preempted/Evicted reason marks the whole Notebook ``SliceInterrupted``
+  (condition + annotation + Warning event) — a partial slice is useless, so
+  interruption is a slice-level state, not a pod-level one.
+- Recovery is level-triggered: the failed pod is deleted so the StatefulSet
+  controller (FakeKubelet in tests, kubelet in prod) recreates it; when every
+  host is Ready again the interruption clears and a SliceRecovered event is
+  emitted. In-notebook state is gone (jax.distributed must re-init) but the
+  *capacity* and the user's Jupyter session recover without dashboard action.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.api.notebook import Notebook
+from kubeflow_tpu.k8s import objects as obj_util
+from kubeflow_tpu.k8s.client import Client, retry_on_conflict
+from kubeflow_tpu.k8s.errors import NotFoundError
+from kubeflow_tpu.k8s.events import EventRecorder
+from kubeflow_tpu.k8s.manager import Manager, Reconciler, Request, Result
+from kubeflow_tpu.metrics import Metrics
+
+log = logging.getLogger(__name__)
+
+_PREEMPTION_REASONS = {"Preempted", "Evicted", "TerminationByKubernetes"}
+
+
+def _pod_preempted(pod: dict) -> Optional[str]:
+    status = pod.get("status", {})
+    if status.get("reason") in _PREEMPTION_REASONS:
+        return status.get("reason")
+    for cond in status.get("conditions", []):
+        if cond.get("type") == "DisruptionTarget" and cond.get("status") == "True":
+            return cond.get("reason", "DisruptionTarget")
+    if status.get("phase") == "Failed":
+        return status.get("reason", "PodFailed")
+    return None
+
+
+class SliceHealthReconciler(Reconciler):
+    def __init__(
+        self,
+        client: Client,
+        metrics: Optional[Metrics] = None,
+        recorder: Optional[EventRecorder] = None,
+    ):
+        self.client = client
+        self.metrics = metrics or Metrics(client)
+        self.recorder = recorder or EventRecorder(client, component="slice-health")
+
+    def register(self, manager: Manager) -> None:
+        manager.register(
+            self,
+            for_kind="Notebook",
+            watches=[("Pod", _pod_to_notebook)],
+            name="SliceHealth",
+        )
+
+    def reconcile(self, req: Request) -> Result:
+        try:
+            obj = self.client.get("Notebook", req.name, req.namespace)
+        except NotFoundError:
+            return Result()
+        nb = Notebook(obj)
+        if nb.tpu is None or "deletionTimestamp" in obj["metadata"]:
+            return Result()
+
+        pods = self.client.list(
+            "Pod", nb.namespace, {ann.NOTEBOOK_NAME_LABEL: nb.name}
+        )
+        failed = [(p, _pod_preempted(p)) for p in pods]
+        failed = [(p, reason) for p, reason in failed if reason]
+
+        if failed:
+            for pod, reason in failed:
+                self.metrics.slice_preemptions_total.inc()
+                self.recorder.eventf(
+                    obj, "Warning", "SliceInterrupted",
+                    f"Host pod {obj_util.name_of(pod)} lost ({reason}); "
+                    "recreating — in-notebook JAX state is gone",
+                )
+                # Delete so the STS/kubelet recreates the host pod.
+                try:
+                    self.client.delete("Pod", obj_util.name_of(pod), nb.namespace)
+                except NotFoundError:
+                    pass
+            self._mark_interrupted(nb, failed[0][1])
+            return Result()
+
+        # No failed pods: clear interruption once the slice is whole again.
+        if ann.TPU_SLICE_INTERRUPTED in nb.annotations:
+            try:
+                hosts = nb.tpu.slice_topology().hosts
+            except Exception:
+                return Result()
+            ready = sum(1 for p in pods if _pod_ready(p))
+            if ready == hosts:
+                self._clear_interrupted(nb)
+                self.recorder.eventf(
+                    obj, "Normal", "SliceRecovered",
+                    f"All {hosts} slice hosts Ready again",
+                )
+        return Result()
+
+    def _mark_interrupted(self, nb: Notebook, reason: str) -> None:
+        def write():
+            fresh = self.client.get("Notebook", nb.name, nb.namespace)
+            anns = obj_util.annotations_of(fresh)
+            if anns.get(ann.TPU_SLICE_INTERRUPTED) == reason:
+                return
+            anns[ann.TPU_SLICE_INTERRUPTED] = reason
+            self.client.update(fresh)
+
+        retry_on_conflict(write)
+
+    def _clear_interrupted(self, nb: Notebook) -> None:
+        def write():
+            fresh = self.client.get("Notebook", nb.name, nb.namespace)
+            if obj_util.remove_annotation(fresh, ann.TPU_SLICE_INTERRUPTED):
+                self.client.update(fresh)
+
+        retry_on_conflict(write)
+
+
+def _pod_to_notebook(ev) -> list[Request]:
+    labels = ev.object.get("metadata", {}).get("labels", {})
+    name = labels.get(ann.NOTEBOOK_NAME_LABEL)
+    if name:
+        return [Request(name, ev.namespace)]
+    return []
+
+
+def _pod_ready(pod: dict) -> bool:
+    for cond in pod.get("status", {}).get("conditions", []):
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False

@@ -156,6 +156,8 @@ class FakeCluster:
         namespace: str = "",
         label_selector: Optional[dict] = None,
     ) -> list[dict]:
+        if kind in CLUSTER_SCOPED_KINDS:
+            namespace = ""  # normalize like _key: a ns filter would hide all
         out = []
         for (k, ns, _), obj in sorted(self._objects.items()):
             if k != kind:
@@ -176,6 +178,10 @@ class FakeCluster:
         if key in self._objects:
             raise AlreadyExistsError(f"{kind} {key[1]}/{key[2]} already exists")
         obj = self._run_admission("CREATE", obj, None)
+        # Admission may rewrite name/namespace; store under the final key.
+        key = self._key(kind, obj_util.name_of(obj), obj_util.namespace_of(obj))
+        if key in self._objects:
+            raise AlreadyExistsError(f"{kind} {key[1]}/{key[2]} already exists")
         meta = obj.setdefault("metadata", {})
         self._uid += 1
         meta["uid"] = f"uid-{self._uid}"

@@ -108,12 +108,17 @@ class FakeKubelet(Reconciler):
         for i in range(replicas):
             self._ensure_pod(sts, i)
             self._retry_pending(sts, i)
-        # Scale-down: remove pods at ordinals >= replicas (whole-slice stop).
         for pod in self.cluster.list("Pod", req.namespace):
             if not obj_util.is_controlled_by(sts, pod):
                 continue
             idx = pod["metadata"].get("labels", {}).get(POD_INDEX_LABEL)
-            if idx is not None and int(idx) >= replicas:
+            # Scale-down: remove pods at ordinals >= replicas (whole-slice stop).
+            scale_down = idx is not None and int(idx) >= replicas
+            # The real StatefulSet controller deletes Failed pods so they are
+            # recreated — preemption recovery converges even without a
+            # slice-health controller.
+            failed = pod.get("status", {}).get("phase") == "Failed"
+            if scale_down or failed:
                 try:
                     self.cluster.delete("Pod", obj_util.name_of(pod), req.namespace)
                 except NotFoundError:

@@ -17,7 +17,6 @@ import logging
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from kubeflow_tpu.k8s import objects as obj_util
 from kubeflow_tpu.k8s.fake import FakeCluster, WatchEvent
 
 log = logging.getLogger(__name__)
@@ -85,9 +84,16 @@ class Manager:
         self.clock = clock or FakeClock()
         self._registrations: list[_Registration] = []
         self._cursor = 0
-        # (due_time, seq, registration_index, request) heap for requeues
+        # (due_time, seq, registration_index, request) heap for requeues.
+        # _pending coalesces per (reg, request) to the earliest due time,
+        # as controller-runtime's workqueue AddAfter does — stale heap
+        # entries are lazily skipped on pop.
         self._timers: list[tuple[float, int, int, Request]] = []
+        self._pending: dict[tuple[int, Request], float] = {}
         self._timer_seq = 0
+        # Reconcile exceptions seen since the last clear (error-masking
+        # guard: tests asserting convergence can check this is empty).
+        self.reconcile_errors: list[tuple[str, Request, Exception]] = []
 
     # -- registration ------------------------------------------------------
 
@@ -130,15 +136,30 @@ class Manager:
         calls = 0
         now = self.clock.now()
         while self._timers and self._timers[0][0] <= now:
-            _, _, reg_idx, req = heapq.heappop(self._timers)
+            due, _, reg_idx, req = heapq.heappop(self._timers)
+            # Skip stale entries superseded by a coalesced (earlier) timer.
+            if self._pending.get((reg_idx, req)) != due:
+                continue
+            del self._pending[(reg_idx, req)]
             calls += self._dispatch(reg_idx, req)
         calls += self.run_until_idle(max_cycles)
         return calls
 
     def next_requeue_in(self) -> Optional[float]:
-        if not self._timers:
+        live = [d for d in self._pending.values()]
+        if not live:
             return None
-        return max(0.0, self._timers[0][0] - self.clock.now())
+        return max(0.0, min(live) - self.clock.now())
+
+    def _schedule_requeue(self, reg_idx: int, req: Request, delay: float) -> None:
+        key = (reg_idx, req)
+        due = self.clock.now() + delay
+        existing = self._pending.get(key)
+        if existing is not None and existing <= due:
+            return  # already scheduled sooner (or same) — coalesce
+        self._pending[key] = due
+        self._timer_seq += 1
+        heapq.heappush(self._timers, (due, self._timer_seq, reg_idx, req))
 
     def _collect_requests(self) -> list[tuple[int, Request]]:
         events, self._cursor = self.cluster.drain_events(self._cursor)
@@ -160,21 +181,16 @@ class Manager:
         reg = self._registrations[reg_idx]
         try:
             result = reg.reconciler.reconcile(req)
-        except Exception:
+        except Exception as err:
             log.exception("%s: reconcile %s/%s failed", reg.name, req.namespace, req.name)
-            # controller-runtime would rate-limited-requeue; surface via timer.
-            self._timer_seq += 1
-            heapq.heappush(
-                self._timers,
-                (self.clock.now() + 1.0, self._timer_seq, reg_idx, req),
-            )
+            # controller-runtime would rate-limited-requeue; surface via timer
+            # AND record the error so run_until_idle() callers can notice
+            # (the retry only fires on tick(), not run_until_idle()).
+            self.reconcile_errors.append((reg.name, req, err))
+            self._schedule_requeue(reg_idx, req, 1.0)
             return 1
         if result and result.requeue_after > 0:
-            self._timer_seq += 1
-            heapq.heappush(
-                self._timers,
-                (self.clock.now() + result.requeue_after, self._timer_seq, reg_idx, req),
-            )
+            self._schedule_requeue(reg_idx, req, result.requeue_after)
         return 1
 
 

@@ -1,0 +1,1 @@
+from kubeflow_tpu.metrics.metrics import Metrics  # noqa: F401

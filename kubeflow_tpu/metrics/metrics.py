@@ -1,0 +1,106 @@
+"""Prometheus metrics: the reference metric set plus TPU slice metrics.
+
+Reference set (components/notebook-controller/pkg/metrics/metrics.go:22-60):
+``notebook_create_total``, ``notebook_create_failed_total``,
+``notebook_culling_total``, ``last_notebook_culling_timestamp_seconds``, and
+a ``notebook_running`` gauge computed by listing StatefulSets
+(metrics.go:82-99, a custom Collector).
+
+TPU-native additions (SURVEY.md §7 step 6): ``tpu_slice_ready_seconds`` (the
+p50 spawn north-star), ``tpu_slice_hosts`` / ``tpu_chips_total`` capacity
+gauges, and preemption/cull reclaim counters.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+from kubeflow_tpu.k8s.client import Client
+
+
+class Metrics:
+    """Per-manager metric bundle with an isolated registry (testable)."""
+
+    def __init__(self, client: Optional[Client] = None):
+        self.registry = CollectorRegistry()
+        self.client = client
+        self.create_total = Counter(
+            "notebook_create_total",
+            "Total times the controller created a notebook StatefulSet",
+            registry=self.registry,
+        )
+        self.create_failed_total = Counter(
+            "notebook_create_failed_total",
+            "Total notebook StatefulSet creation failures",
+            registry=self.registry,
+        )
+        self.culling_total = Counter(
+            "notebook_culling_total",
+            "Total notebooks culled for idleness",
+            registry=self.registry,
+        )
+        self.last_culling_timestamp = Gauge(
+            "last_notebook_culling_timestamp_seconds",
+            "Unix time of the most recent culling",
+            registry=self.registry,
+        )
+        # -- TPU-native additions ------------------------------------------
+        self.slice_ready_seconds = Histogram(
+            "tpu_slice_ready_seconds",
+            "Seconds from Notebook creation to all slice hosts Ready",
+            buckets=(5, 10, 20, 30, 45, 60, 90, 120, 180, 300, 600),
+            registry=self.registry,
+        )
+        self.slice_preemptions_total = Counter(
+            "tpu_slice_preemptions_total",
+            "Slice host preemptions/evictions observed",
+            registry=self.registry,
+        )
+        self.chips_reclaimed_total = Counter(
+            "tpu_chips_reclaimed_total",
+            "TPU chips released by culling or stop",
+            registry=self.registry,
+        )
+        self.running = Gauge(
+            "notebook_running",
+            "Currently running notebooks (replicas > 0)",
+            registry=self.registry,
+        )
+        self.tpu_chips_in_use = Gauge(
+            "tpu_chips_in_use",
+            "TPU chips currently held by running notebook slices",
+            registry=self.registry,
+        )
+
+    def collect_running(self) -> None:
+        """Recompute run-state gauges by listing StatefulSets, as the
+        reference's custom Collector does on scrape (metrics.go:82-99)."""
+        if self.client is None:
+            return
+        running = 0
+        chips = 0
+        for sts in self.client.list("StatefulSet"):
+            replicas = sts.get("spec", {}).get("replicas", 0)
+            if replicas > 0:
+                running += 1
+                template = sts.get("spec", {}).get("template", {}).get("spec", {})
+                for c in template.get("containers", []):
+                    per_host = int(
+                        c.get("resources", {}).get("limits", {}).get("google.com/tpu", 0) or 0
+                    )
+                    chips += per_host * replicas
+        self.running.set(running)
+        self.tpu_chips_in_use.set(chips)
+
+    def expose(self) -> bytes:
+        """Prometheus text exposition (the /metrics endpoint body)."""
+        self.collect_running()
+        return generate_latest(self.registry)

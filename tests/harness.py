@@ -1,0 +1,124 @@
+"""Shared envtest-style harness: fake cluster + manager + controllers.
+
+The analog of the reference's suite_test.go bootstrap (reference
+components/notebook-controller/controllers/suite_test.go:50-110): a live
+"API server" (FakeCluster), a manager with the controllers under test, and a
+fake kubelet + TPU node pools so StatefulSets become Ready pods.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from kubeflow_tpu import k8s
+from kubeflow_tpu.api.notebook import TPUSpec, new_notebook
+from kubeflow_tpu.controller.culling import CullerConfig, CullingReconciler, HostActivity
+from kubeflow_tpu.controller.notebook import ControllerConfig, NotebookReconciler
+from kubeflow_tpu.controller.preemption import SliceHealthReconciler
+from kubeflow_tpu.k8s.manager import FakeClock, Manager
+from kubeflow_tpu.metrics import Metrics
+
+
+class FakeProber:
+    """Scriptable ActivityProber."""
+
+    def __init__(self):
+        self.activities: list[HostActivity] = []
+        self.probe_count = 0
+
+    def set_idle(self, hosts: int = 1, last_activity: Optional[float] = None):
+        self.activities = [
+            HostActivity(host=f"h{i}", busy=False, last_activity=last_activity)
+            for i in range(hosts)
+        ]
+
+    def set_busy(self, hosts: int = 1, busy_host: int = 0):
+        self.activities = [
+            HostActivity(host=f"h{i}", busy=(i == busy_host)) for i in range(hosts)
+        ]
+
+    def probe(self, nb, hosts):
+        self.probe_count += 1
+        return list(self.activities)
+
+
+@dataclass
+class Env:
+    cluster: k8s.FakeCluster
+    manager: Manager
+    clock: FakeClock
+    kubelet: k8s.FakeKubelet
+    reconciler: NotebookReconciler
+    culler: Optional[CullingReconciler]
+    prober: Optional[FakeProber]
+    slice_health: Optional[SliceHealthReconciler]
+    metrics: Metrics
+
+
+def make_env(
+    culling: bool = False,
+    cull_idle_min: int = 30,
+    check_period_min: int = 1,
+    slice_health: bool = True,
+    node_pools: tuple = (("tpu-v5-lite-podslice", "4x4", 4, 4),),
+    cpu_nodes: int = 1,
+) -> Env:
+    clock = FakeClock()
+    cluster = k8s.FakeCluster(clock=clock)
+    manager = Manager(cluster, clock=clock)
+    metrics = Metrics(cluster)
+
+    kubelet = k8s.FakeKubelet(cluster)
+    for i in range(cpu_nodes):
+        k8s.add_cpu_node(cluster, f"cpu-node-{i}")
+    for accel_label, topo, hosts, chips in node_pools:
+        k8s.add_tpu_node_pool(cluster, accel_label, topo, hosts=hosts, chips_per_host=chips)
+
+    # Controllers register before the kubelet: within one event batch they
+    # dispatch first, so transient pod states (Failed → recreated) are
+    # observable by the slice-health controller before cleanup.
+    reconciler = NotebookReconciler(
+        cluster, ControllerConfig(), metrics=metrics, clock=clock
+    )
+    reconciler.register(manager)
+
+    culler_rec = None
+    prober = None
+    if culling:
+        prober = FakeProber()
+        prober.set_idle()
+        culler_rec = CullingReconciler(
+            cluster,
+            CullerConfig(
+                enable_culling=True,
+                cull_idle_time_min=cull_idle_min,
+                idleness_check_period_min=check_period_min,
+            ),
+            prober=prober,
+            metrics=metrics,
+            clock=clock,
+        )
+        culler_rec.register(manager)
+
+    health = None
+    if slice_health:
+        health = SliceHealthReconciler(cluster, metrics=metrics)
+        health.register(manager)
+
+    kubelet.register(manager)
+
+    return Env(
+        cluster, manager, clock, kubelet, reconciler, culler_rec, prober, health, metrics
+    )
+
+
+def tpu_notebook(name="nb", namespace="ns", accelerator="v5e", topology="4x4", **kw):
+    return new_notebook(
+        name, namespace, image="jax-notebook:latest",
+        tpu=TPUSpec(accelerator=accelerator, topology=topology), **kw,
+    )
+
+
+def cpu_notebook(name="nb", namespace="ns", **kw):
+    return new_notebook(name, namespace, image="jupyter-minimal:latest", **kw)

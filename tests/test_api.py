@@ -1,0 +1,69 @@
+"""Unit tests for the Notebook API types and conversion."""
+
+import pytest
+
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.api.notebook import (
+    HUB_VERSION,
+    Notebook,
+    TPUSpec,
+    convert,
+    new_notebook,
+)
+
+
+class TestNotebookAccessors:
+    def test_basic(self):
+        obj = new_notebook("my-nb", "team-a")
+        nb = Notebook(obj)
+        assert nb.name == "my-nb"
+        assert nb.namespace == "team-a"
+        assert nb.tpu is None
+        assert not nb.stopped
+        assert nb.primary_container()["name"] == "my-nb"
+
+    def test_tpu_spec_roundtrip(self):
+        spec = TPUSpec(accelerator="v5e", topology="4x4", spot=True)
+        obj = new_notebook("nb", "ns", tpu=spec)
+        nb = Notebook(obj)
+        assert nb.tpu == spec
+        topo = nb.tpu.slice_topology()
+        assert topo.hosts == 4
+
+    def test_primary_container_falls_back_to_first(self):
+        obj = new_notebook("nb", "ns")
+        obj["spec"]["template"]["spec"]["containers"][0]["name"] = "other"
+        nb = Notebook(obj)
+        assert nb.primary_container()["name"] == "other"
+
+    def test_stopped_and_lock(self):
+        obj = new_notebook(
+            "nb", "ns", annotations={ann.STOP: ann.RECONCILIATION_LOCK_VALUE}
+        )
+        nb = Notebook(obj)
+        assert nb.stopped
+        assert nb.lock_held
+        obj2 = new_notebook("nb2", "ns", annotations={ann.STOP: "2026-07-29T00:00:00Z"})
+        assert Notebook(obj2).stopped
+        assert not Notebook(obj2).lock_held
+
+
+class TestConversion:
+    def test_rewrites_api_version(self):
+        obj = new_notebook("nb", "ns", version="v1")
+        hub = convert(obj, HUB_VERSION)
+        assert hub["apiVersion"] == "kubeflow.org/v1beta1"
+        assert hub["spec"] == obj["spec"]
+
+    def test_roundtrip_preserves_tpu(self):
+        obj = new_notebook("nb", "ns", tpu=TPUSpec("v5e", "2x2"))
+        out = convert(convert(obj, "v1alpha1"), "v1")
+        assert out["spec"]["tpu"] == {"accelerator": "v5e", "topology": "2x2"}
+
+    def test_unknown_version(self):
+        with pytest.raises(ValueError):
+            convert(new_notebook("nb", "ns"), "v2")
+
+    def test_wrong_group(self):
+        with pytest.raises(ValueError):
+            convert({"apiVersion": "apps/v1", "kind": "Deployment"}, "v1")

@@ -1,0 +1,154 @@
+"""Tests for the slice-aware idle culler (reference culling tier,
+culling_controller_test.go:13-142, generalized to multi-host)."""
+
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.controller.culling import HostActivity, _fmt
+from kubeflow_tpu.k8s import objects as obj_util
+from kubeflow_tpu.k8s.events import events_for
+
+from tests.harness import cpu_notebook, make_env, tpu_notebook
+
+
+def anns_of(env, name="nb", ns="ns"):
+    return env.cluster.get("Notebook", name, ns)["metadata"].get("annotations", {})
+
+
+class TestActivityTracking:
+    def test_annotations_initialized(self):
+        env = make_env(culling=True)
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        a = anns_of(env)
+        assert ann.LAST_ACTIVITY in a
+        assert ann.LAST_ACTIVITY_CHECK in a
+
+    def test_no_probe_before_period(self):
+        env = make_env(culling=True, check_period_min=5)
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        assert env.prober.probe_count == 0
+        env.manager.tick(60.0)  # 1 min < 5 min period
+        assert env.prober.probe_count == 0
+        env.manager.tick(250.0)  # now past the period
+        assert env.prober.probe_count == 1
+
+    def test_busy_kernel_refreshes_activity(self):
+        env = make_env(culling=True, cull_idle_min=30, check_period_min=1)
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        env.prober.set_busy()
+        for _ in range(40):  # 40 minutes of busy kernel
+            env.manager.tick(60.0)
+        a = anns_of(env)
+        assert ann.STOP not in a  # never culled
+        last = a[ann.LAST_ACTIVITY]
+        assert last == _fmt(env.clock.now())  # pinned to "now" while busy
+
+    def test_monotonic_guard(self):
+        """Stale probe data must never move last-activity backwards
+        (reference compareAnnotationTimeToResource :360-378)."""
+        env = make_env(culling=True, check_period_min=1)
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        t0 = env.clock.now()
+        env.prober.set_idle(last_activity=t0 + 100)
+        env.manager.tick(120.0)
+        assert anns_of(env)[ann.LAST_ACTIVITY] == _fmt(t0 + 100)
+        # A later probe reports an OLDER activity (clock skew / restarted hub)
+        env.prober.set_idle(last_activity=t0 - 500)
+        env.manager.tick(120.0)
+        assert anns_of(env)[ann.LAST_ACTIVITY] == _fmt(t0 + 100)  # unchanged
+
+
+class TestCulling:
+    def test_idle_notebook_culled(self):
+        env = make_env(culling=True, cull_idle_min=30, check_period_min=1)
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        for _ in range(35):
+            env.manager.tick(60.0)
+        a = anns_of(env)
+        assert ann.STOP in a
+        sts = env.cluster.get("StatefulSet", "nb", "ns")
+        assert sts["spec"]["replicas"] == 0
+        evs = events_for(env.cluster, "Notebook", "nb", "ns")
+        assert any(e["reason"] == "NotebookCulled" for e in evs)
+
+    def test_tpu_slice_culled_atomically_with_chip_metric(self):
+        env = make_env(culling=True, cull_idle_min=30, check_period_min=1)
+        env.cluster.create(tpu_notebook())  # 16 chips
+        env.manager.run_until_idle()
+        env.prober.set_idle(hosts=4)
+        for _ in range(35):
+            env.manager.tick(60.0)
+        assert env.cluster.list("Pod", "ns") == []  # whole slice released
+        text = env.metrics.expose().decode()
+        assert "tpu_chips_reclaimed_total 16.0" in text
+        assert "notebook_culling_total 1.0" in text
+
+    def test_any_host_activity_keeps_slice_alive(self):
+        """Worker 3 busy (e.g. profiling server) while Jupyter on worker 0
+        is idle → slice must NOT be culled (SURVEY.md §7 step 5)."""
+        env = make_env(culling=True, cull_idle_min=30, check_period_min=1)
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        env.prober.set_busy(hosts=4, busy_host=3)
+        for _ in range(40):
+            env.manager.tick(60.0)
+        assert ann.STOP not in anns_of(env)
+
+    def test_stopped_notebook_annotations_cleared(self):
+        env = make_env(culling=True, cull_idle_min=30)
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        assert ann.LAST_ACTIVITY in anns_of(env)
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.annotations_of(nb)[ann.STOP] = "t"
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        a = anns_of(env)
+        assert ann.LAST_ACTIVITY not in a
+        assert ann.LAST_ACTIVITY_CHECK not in a
+
+    def test_culling_disabled_no_annotations(self):
+        env = make_env(culling=False)
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        assert ann.LAST_ACTIVITY not in anns_of(env)
+
+
+class TestPreemptionRecovery:
+    def test_preempted_host_marks_interrupted_and_recovers(self):
+        env = make_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["status"]["tpu"]["sliceHealth"] == "Healthy"
+
+        env.kubelet.preempt_pod("nb-2", "ns")
+        env.manager.run_until_idle()
+
+        # The failed host pod was deleted and recreated by the kubelet;
+        # recovery then cleared the interruption.
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["status"]["tpu"]["sliceHealth"] == "Healthy"
+        assert ann.TPU_SLICE_INTERRUPTED not in nb["metadata"].get("annotations", {})
+        evs = events_for(env.cluster, "Notebook", "nb", "ns")
+        reasons = {e["reason"] for e in evs}
+        assert "SliceInterrupted" in reasons
+        assert "SliceRecovered" in reasons
+        text = env.metrics.expose().decode()
+        assert "tpu_slice_preemptions_total 1.0" in text
+
+    def test_preemption_without_capacity_stays_interrupted(self):
+        env = make_env(node_pools=(("tpu-v5-lite-podslice", "4x4", 4, 4),))
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        # Remove a node so the preempted pod cannot reschedule.
+        env.kubelet.auto_ready = True
+        env.cluster.delete("Node", "tpu-node-4x4-3")
+        env.kubelet.preempt_pod("nb-3", "ns")
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["status"]["tpu"]["sliceHealth"] in ("Forming", "Interrupted")
+        assert nb["status"]["tpu"]["readyHosts"] == 3

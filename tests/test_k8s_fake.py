@@ -347,3 +347,77 @@ class TestFakeKubelet:
         c.update(sts)
         m.run_until_idle()
         assert c.list("Pod", "ns") == []
+
+
+class TestReviewRegressions:
+    def test_list_cluster_scoped_ignores_namespace_filter(self):
+        c = k8s.FakeCluster()
+        k8s.add_cpu_node(c, "n1")
+        assert len(c.list("Node", namespace="user-ns")) == 1
+
+    def test_kubelet_standalone_replaces_failed_pods(self):
+        """Preemption converges without any slice-health controller,
+        matching real StatefulSet-controller behavior."""
+        from tests.harness import make_env, tpu_notebook
+
+        env = make_env(slice_health=False)
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        env.kubelet.preempt_pod("nb-1", "ns")
+        env.manager.run_until_idle()
+        pods = env.cluster.list("Pod", "ns")
+        assert len(pods) == 4
+        assert all(p["status"]["phase"] == "Running" for p in pods)
+
+    def test_requeue_timers_coalesce_per_request(self):
+        c = k8s.FakeCluster()
+        m = Manager(c)
+
+        class Requeuer(Reconciler):
+            def __init__(self):
+                self.calls = 0
+
+            def reconcile(self, req):
+                self.calls += 1
+                return Result(requeue_after=60.0)
+
+        r = Requeuer()
+        m.register(r, for_kind="ConfigMap")
+        cm = c.create(make_cm())
+        m.run_until_idle()
+        # Hammer the object with updates: each triggers a reconcile, each
+        # returns requeue_after — timers must coalesce, not accumulate.
+        for i in range(5):
+            cm = c.get("ConfigMap", "cm", "default")
+            cm["data"] = {"i": str(i)}
+            c.update(cm)
+            m.run_until_idle()
+        calls_before = r.calls
+        m.tick(61.0)  # exactly one coalesced timer should fire
+        assert r.calls == calls_before + 1
+
+    def test_reconcile_errors_surfaced(self):
+        c = k8s.FakeCluster()
+        m = Manager(c)
+
+        class Failer(Reconciler):
+            def reconcile(self, req):
+                raise RuntimeError("boom")
+
+        m.register(Failer(), for_kind="ConfigMap")
+        c.create(make_cm())
+        m.run_until_idle()
+        assert len(m.reconcile_errors) == 1
+        assert m.reconcile_errors[0][0] == "Failer"
+
+    def test_admission_rewriting_namespace_stores_under_final_key(self):
+        c = k8s.FakeCluster()
+
+        def default_ns(req):
+            req.object["metadata"]["namespace"] = "defaulted"
+            return req.object
+
+        c.register_mutating_webhook("ConfigMap", default_ns)
+        c.create(make_cm(ns="original"))
+        assert c.exists("ConfigMap", "cm", "defaulted")
+        assert not c.exists("ConfigMap", "cm", "original")

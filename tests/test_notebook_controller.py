@@ -1,0 +1,272 @@
+"""Integration tests for the core Notebook reconciler (envtest tier).
+
+Mirrors the reference's BDD assertions (reference
+notebook_controller_bdd_test.go:32-96: STS replica behavior on stop/resume)
+and extends them to the TPU slice semantics from SURVEY.md §7 step 2.
+"""
+
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.k8s import objects as obj_util
+from kubeflow_tpu.k8s.events import events_for
+
+from tests.harness import cpu_notebook, make_env, tpu_notebook
+
+
+class TestCpuNotebook:
+    def test_single_replica_statefulset_and_service(self):
+        env = make_env()
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+
+        sts = env.cluster.get("StatefulSet", "nb", "ns")
+        assert sts["spec"]["replicas"] == 1
+        assert "podManagementPolicy" not in sts["spec"]
+        svc = env.cluster.get("Service", "nb", "ns")
+        assert svc["spec"]["ports"][0]["port"] == 80
+        assert svc["spec"]["ports"][0]["targetPort"] == 8888
+        # No TPU headless service for CPU notebooks.
+        assert not env.cluster.exists("Service", "nb-hosts", "ns")
+
+    def test_container_defaults(self):
+        env = make_env()
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        sts = env.cluster.get("StatefulSet", "nb", "ns")
+        container = sts["spec"]["template"]["spec"]["containers"][0]
+        assert container["workingDir"] == "/home/jovyan"
+        assert {"containerPort": 8888, "name": "notebook-port", "protocol": "TCP"} in container["ports"]
+        assert {"name": "NB_PREFIX", "value": "/notebook/ns/nb"} in container["env"]
+        assert sts["spec"]["template"]["spec"]["securityContext"]["fsGroup"] == 100
+
+    def test_pod_becomes_ready_and_status_mirrors(self):
+        env = make_env()
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["status"]["readyReplicas"] == 1
+        cond_types = {c["type"] for c in nb["status"]["conditions"]}
+        assert "Ready" in cond_types
+        assert nb["status"]["containerState"].get("running")
+
+    def test_name_too_long_rejected_with_event(self):
+        env = make_env()
+        long_name = "x" * 60
+        env.cluster.create(cpu_notebook(name=long_name))
+        env.manager.run_until_idle()
+        assert not env.cluster.exists("StatefulSet", long_name, "ns")
+        evs = events_for(env.cluster, "Notebook", long_name, "ns")
+        assert any(e["reason"] == "InvalidName" for e in evs)
+
+
+class TestTpuSlice:
+    def test_indexed_statefulset_shape(self):
+        env = make_env()
+        env.cluster.create(tpu_notebook())  # v5e 4x4 → 4 hosts
+        env.manager.run_until_idle()
+
+        sts = env.cluster.get("StatefulSet", "nb", "ns")
+        assert sts["spec"]["replicas"] == 4
+        assert sts["spec"]["podManagementPolicy"] == "Parallel"
+        assert sts["spec"]["serviceName"] == "nb-hosts"
+        pod_spec = sts["spec"]["template"]["spec"]
+        assert pod_spec["nodeSelector"] == {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "4x4",
+        }
+        assert any(t["key"] == "google.com/tpu" for t in pod_spec["tolerations"])
+        container = pod_spec["containers"][0]
+        assert container["resources"]["limits"]["google.com/tpu"] == "4"
+        assert container["resources"]["requests"]["google.com/tpu"] == "4"
+
+    def test_headless_service(self):
+        env = make_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        headless = env.cluster.get("Service", "nb-hosts", "ns")
+        assert headless["spec"]["clusterIP"] == "None"
+        assert headless["spec"]["publishNotReadyAddresses"] is True
+
+    def test_all_hosts_ready_status_healthy(self):
+        env = make_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["status"]["tpu"] == {
+            "hosts": 4,
+            "readyHosts": 4,
+            "sliceHealth": "Healthy",
+            "acceleratorType": "v5litepod-16",
+            "jaxCoordinator": "nb-0.nb-hosts.ns.svc.cluster.local:8476",
+        }
+        assert nb["status"]["readyReplicas"] == 4
+
+    def test_forming_when_pool_too_small(self):
+        env = make_env(node_pools=(("tpu-v5-lite-podslice", "4x4", 2, 4),))
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["status"]["tpu"]["sliceHealth"] == "Forming"
+        assert nb["status"]["tpu"]["readyHosts"] == 2
+
+    def test_invalid_topology_no_statefulset(self):
+        env = make_env()
+        env.cluster.create(tpu_notebook(topology="3x4"))
+        env.manager.run_until_idle()
+        assert not env.cluster.exists("StatefulSet", "nb", "ns")
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        conds = {c["type"]: c for c in nb["status"]["conditions"]}
+        assert conds["TPUTopologyValid"]["status"] == "False"
+        evs = events_for(env.cluster, "Notebook", "nb", "ns")
+        assert any(e["reason"] == "InvalidTPUTopology" for e in evs)
+
+    def test_single_host_v5e4(self):
+        env = make_env(node_pools=(("tpu-v5-lite-podslice", "2x2", 1, 4),))
+        env.cluster.create(tpu_notebook(topology="2x2"))
+        env.manager.run_until_idle()
+        sts = env.cluster.get("StatefulSet", "nb", "ns")
+        assert sts["spec"]["replicas"] == 1
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["status"]["tpu"]["sliceHealth"] == "Healthy"
+        # Single-host slices need no jax coordinator.
+        assert "jaxCoordinator" not in nb["status"]["tpu"]
+
+
+class TestStopResume:
+    def test_stop_annotation_scales_whole_slice_to_zero(self):
+        env = make_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        assert len(env.cluster.list("Pod", "ns")) == 4
+
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.annotations_of(nb)[ann.STOP] = "2026-07-29T00:00:00Z"
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+
+        sts = env.cluster.get("StatefulSet", "nb", "ns")
+        assert sts["spec"]["replicas"] == 0
+        assert env.cluster.list("Pod", "ns") == []  # atomic: no partial slice
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["status"]["tpu"]["sliceHealth"] == "Stopped"
+
+    def test_resume_restores_slice(self):
+        env = make_env()
+        env.cluster.create(tpu_notebook(annotations={ann.STOP: "t"}))
+        env.manager.run_until_idle()
+        assert env.cluster.get("StatefulSet", "nb", "ns")["spec"]["replicas"] == 0
+
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.remove_annotation(nb, ann.STOP)
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        assert env.cluster.get("StatefulSet", "nb", "ns")["spec"]["replicas"] == 4
+        assert len(env.cluster.list("Pod", "ns")) == 4
+
+
+class TestRestart:
+    def test_restart_annotation_deletes_all_pods_and_clears(self):
+        env = make_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        pods_before = {
+            p["metadata"]["uid"] for p in env.cluster.list("Pod", "ns")
+        }
+        assert len(pods_before) == 4
+
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.annotations_of(nb)[ann.RESTART] = "true"
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert ann.RESTART not in nb["metadata"].get("annotations", {})
+        pods_after = {p["metadata"]["uid"] for p in env.cluster.list("Pod", "ns")}
+        assert len(pods_after) == 4
+        assert pods_before.isdisjoint(pods_after)  # every host pod replaced
+
+
+class TestLevelTriggeredRecovery:
+    def test_deleted_statefulset_recreated(self):
+        env = make_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        env.cluster.delete("StatefulSet", "nb", "ns")
+        env.manager.run_until_idle()
+        assert env.cluster.exists("StatefulSet", "nb", "ns")
+
+    def test_deleted_service_recreated(self):
+        env = make_env()
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        env.cluster.delete("Service", "nb", "ns")
+        env.manager.run_until_idle()
+        assert env.cluster.exists("Service", "nb", "ns")
+
+    def test_spec_change_rolls_template(self):
+        """The reconcilehelper sharp-edge fix: template drift triggers Update."""
+        env = make_env()
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        nb["spec"]["template"]["spec"]["containers"][0]["image"] = "new-image:v2"
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        sts = env.cluster.get("StatefulSet", "nb", "ns")
+        assert sts["spec"]["template"]["spec"]["containers"][0]["image"] == "new-image:v2"
+
+    def test_notebook_deletion_cascades(self):
+        env = make_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        env.cluster.delete("Notebook", "nb", "ns")
+        env.manager.run_until_idle()
+        assert not env.cluster.exists("StatefulSet", "nb", "ns")
+        assert not env.cluster.exists("Service", "nb", "ns")
+        assert not env.cluster.exists("Service", "nb-hosts", "ns")
+
+
+class TestEventReemission:
+    def test_pod_warning_surfaces_on_notebook(self):
+        env = make_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        # A warning event lands on a slice pod (e.g. image pull failure).
+        env.cluster.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {"name": "nb-2.deadbeef", "namespace": "ns"},
+                "involvedObject": {"kind": "Pod", "name": "nb-2", "namespace": "ns"},
+                "type": "Warning",
+                "reason": "FailedMount",
+                "message": "volume timeout",
+            }
+        )
+        env.manager.run_until_idle()
+        evs = events_for(env.cluster, "Notebook", "nb", "ns")
+        assert any(
+            e["reason"] == "FailedMount" and "[nb-2]" in e["message"] for e in evs
+        )
+
+
+class TestMetrics:
+    def test_create_and_spawn_latency_observed(self):
+        env = make_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        text = env.metrics.expose().decode()
+        assert "notebook_create_total 1.0" in text
+        assert "tpu_slice_ready_seconds_count 1.0" in text
+        assert "notebook_running 1.0" in text
+        assert "tpu_chips_in_use 16.0" in text
+
+    def test_chips_released_on_stop(self):
+        env = make_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.annotations_of(nb)[ann.STOP] = "t"
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        text = env.metrics.expose().decode()
+        assert "tpu_chips_in_use 0.0" in text
