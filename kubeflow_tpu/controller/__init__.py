@@ -4,3 +4,4 @@ from kubeflow_tpu.controller.notebook import (  # noqa: F401
 )
 from kubeflow_tpu.controller.culling import CullingReconciler, CullerConfig  # noqa: F401
 from kubeflow_tpu.controller.preemption import SliceHealthReconciler  # noqa: F401
+from kubeflow_tpu.controller.platform import PlatformReconciler, PlatformConfig  # noqa: F401

@@ -1,0 +1,120 @@
+"""NetworkPolicies: notebook ingress lockdown + intra-slice data plane.
+
+Rebuild of reference components/odh-notebook-controller/controllers/
+notebook_network.go (:132-174 ``{name}-ctrl-np`` allowing 8888 only from the
+controller namespace; :177-211 ``{name}-kube-rbac-proxy-np`` allowing 8443
+from anywhere) plus the TPU-native addition from SURVEY.md §7 step 4: an
+intra-slice policy so slice host pods can reach each other over the JAX/DCN
+coordination ports — without it, a default-deny namespace would wedge
+``jax.distributed.initialize`` while 8888 still works, which is miserable to
+debug.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api.notebook import Notebook
+from kubeflow_tpu.controller import reconcilehelper as helper
+from kubeflow_tpu.k8s.client import Client
+
+NOTEBOOK_PORT = 8888
+RBAC_PROXY_PORT = 8443
+
+
+def ctrl_np_name(name: str) -> str:
+    return f"{name}-ctrl-np"
+
+
+def proxy_np_name(name: str) -> str:
+    return f"{name}-kube-rbac-proxy-np"
+
+
+def slice_np_name(name: str) -> str:
+    return f"{name}-slice-np"
+
+
+def new_ctrl_policy(nb: Notebook, controller_namespace: str) -> dict:
+    """Allow 8888 only from the controller namespace (culler probes, route
+    backend traffic ingresses via the gateway's proxied connection)."""
+    return {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "NetworkPolicy",
+        "metadata": {
+            "name": ctrl_np_name(nb.name),
+            "namespace": nb.namespace,
+            "labels": {"notebook-name": nb.name},
+        },
+        "spec": {
+            "podSelector": {"matchLabels": {"statefulset": nb.name}},
+            "policyTypes": ["Ingress"],
+            "ingress": [
+                {
+                    "from": [
+                        {
+                            "namespaceSelector": {
+                                "matchLabels": {
+                                    "kubernetes.io/metadata.name": controller_namespace
+                                }
+                            }
+                        }
+                    ],
+                    "ports": [{"protocol": "TCP", "port": NOTEBOOK_PORT}],
+                }
+            ],
+        },
+    }
+
+
+def new_proxy_policy(nb: Notebook) -> dict:
+    """8443 open to all (the rbac proxy IS the auth boundary)."""
+    return {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "NetworkPolicy",
+        "metadata": {
+            "name": proxy_np_name(nb.name),
+            "namespace": nb.namespace,
+            "labels": {"notebook-name": nb.name},
+        },
+        "spec": {
+            "podSelector": {"matchLabels": {"statefulset": nb.name}},
+            "policyTypes": ["Ingress"],
+            "ingress": [{"ports": [{"protocol": "TCP", "port": RBAC_PROXY_PORT}]}],
+        },
+    }
+
+
+def new_slice_policy(nb: Notebook) -> dict:
+    """TPU addition: slice pods talk to each other on every port — JAX
+    coordination (8476), per-host debug/profiling servers, and the gRPC
+    sidechannels libtpu opens between hosts use ephemeral ports, so the
+    peer-selector is the gate, not the port list."""
+    peer = {"podSelector": {"matchLabels": {"statefulset": nb.name}}}
+    return {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "NetworkPolicy",
+        "metadata": {
+            "name": slice_np_name(nb.name),
+            "namespace": nb.namespace,
+            "labels": {"notebook-name": nb.name},
+        },
+        "spec": {
+            "podSelector": {"matchLabels": {"statefulset": nb.name}},
+            "policyTypes": ["Ingress"],
+            "ingress": [{"from": [peer]}],
+        },
+    }
+
+
+def reconcile_network_policies(
+    client: Client, nb: Notebook, controller_namespace: str
+) -> None:
+    """Reference ReconcileAllNetworkPolicies (notebook_network.go:44)."""
+    helper.reconcile_child(client, nb.obj, new_ctrl_policy(nb, controller_namespace))
+    helper.reconcile_child(client, nb.obj, new_proxy_policy(nb))
+    multi_host = False
+    if nb.tpu is not None:
+        try:
+            multi_host = nb.tpu.slice_topology().hosts > 1
+        except Exception:
+            multi_host = False
+    if multi_host:
+        helper.reconcile_child(client, nb.obj, new_slice_policy(nb))

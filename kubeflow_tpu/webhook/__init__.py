@@ -1,0 +1,2 @@
+from kubeflow_tpu.webhook.mutating import NotebookMutatingWebhook, WebhookConfig  # noqa: F401
+from kubeflow_tpu.webhook.validating import NotebookValidatingWebhook  # noqa: F401

@@ -1,0 +1,94 @@
+"""TPU runtime environment injection.
+
+The TPU-native counterpart of the reference webhook's accelerator-adjacent
+env mutations (the reference injects nothing TPU-aware; its webhook mutates
+images/certs/sidecars only — SURVEY.md §2.2). Per the north star, this is
+where ``TPU_WORKER_HOSTNAMES`` / ``TPU_WORKER_ID`` / libtpu env get injected
+*instead of* CUDA env and GPU tolerations.
+
+Contract consumed by kubeflow_tpu.runtime.bootstrap inside the notebook:
+
+- ``TPU_WORKER_ID``       — this host's index, from the indexed-StatefulSet
+  pod-index label via the downward API (stable across pod restarts).
+- ``TPU_WORKER_HOSTNAMES``— comma-separated stable DNS of every slice host.
+- ``TPU_ACCELERATOR_TYPE``/``TPU_TOPOLOGY`` — slice shape for libtpu.
+- ``TPU_CHIPS_PER_HOST_BOUNDS``/``TPU_HOST_BOUNDS`` — libtpu grid bounds.
+- ``JAX_COORDINATOR_ADDRESS`` — worker 0's DNS:port for
+  jax.distributed.initialize over DCN.
+- ``JAX_NUM_PROCESSES``   — host count (jax.distributed num_processes).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api.notebook import Notebook
+from kubeflow_tpu.tpu.topology import SliceTopology
+
+POD_INDEX_LABEL = "apps.kubernetes.io/pod-index"
+JAX_COORDINATOR_PORT = 8476
+
+
+def inject_tpu_env(
+    nb: Notebook, topo: SliceTopology, cluster_domain: str = "cluster.local"
+) -> bool:
+    """Idempotently set the TPU env block on the primary container.
+
+    Returns True if the pod template changed. Values are recomputed from the
+    current spec, so topology edits (on stopped notebooks) roll forward.
+    """
+    container = nb.primary_container()
+    if container is None:
+        return False
+    headless = f"{nb.name}-hosts"
+    hostnames = topo.worker_hostnames(nb.name, headless, nb.namespace, cluster_domain)
+    desired: list[dict] = [
+        {
+            "name": "TPU_WORKER_ID",
+            "valueFrom": {
+                "fieldRef": {"fieldPath": f"metadata.labels['{POD_INDEX_LABEL}']"}
+            },
+        },
+        {"name": "TPU_WORKER_HOSTNAMES", "value": ",".join(hostnames)},
+        {"name": "TPU_ACCELERATOR_TYPE", "value": topo.accelerator_type},
+        {"name": "TPU_TOPOLOGY", "value": topo.topology_str},
+        {"name": "TPU_CHIPS_PER_HOST_BOUNDS", "value": topo.chip_bounds_str()},
+        {"name": "TPU_HOST_BOUNDS", "value": topo.host_bounds_str()},
+    ]
+    if topo.hosts > 1:
+        desired += [
+            {
+                "name": "JAX_COORDINATOR_ADDRESS",
+                "value": f"{hostnames[0]}:{JAX_COORDINATOR_PORT}",
+            },
+            {"name": "JAX_NUM_PROCESSES", "value": str(topo.hosts)},
+        ]
+    if nb.tpu is not None and nb.tpu.runtime_version:
+        desired.append(
+            {"name": "TPU_RUNTIME_VERSION", "value": nb.tpu.runtime_version}
+        )
+    return upsert_env(container, desired)
+
+
+def upsert_env(container: dict, desired: list[dict]) -> bool:
+    """Merge env entries by name; True if anything changed."""
+    env = container.setdefault("env", [])
+    changed = False
+    by_name = {e.get("name"): i for i, e in enumerate(env)}
+    for entry in desired:
+        idx = by_name.get(entry["name"])
+        if idx is None:
+            env.append(entry)
+            by_name[entry["name"]] = len(env) - 1
+            changed = True
+        elif env[idx] != entry:
+            env[idx] = entry
+            changed = True
+    return changed
+
+
+def remove_env(container: dict, names: set[str]) -> bool:
+    env = container.get("env", [])
+    kept = [e for e in env if e.get("name") not in names]
+    if len(kept) != len(env):
+        container["env"] = kept
+        return True
+    return False

@@ -1,0 +1,66 @@
+"""Validating admission webhook.
+
+Extends the reference's validator (reference
+components/odh-notebook-controller/controllers/notebook_validating_webhook.go:
+31-100 — denies MLflow-annotation removal on running notebooks) with the
+TPU-native invariants from SURVEY.md §7 step 3:
+
+- topology/accelerator changes on a RUNNING slice are denied (the slice
+  would have to be torn down; the user must stop the notebook first),
+- structurally invalid TPU specs are denied at admission, before any
+  object lands (better UX than an event after the fact).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.api.notebook import Notebook
+from kubeflow_tpu.k8s.client import Client
+from kubeflow_tpu.k8s.errors import WebhookDeniedError
+from kubeflow_tpu.k8s.fake import AdmissionRequest
+from kubeflow_tpu.tpu.topology import InvalidTopologyError
+
+
+class NotebookValidatingWebhook:
+    def __init__(self, client: Optional[Client] = None):
+        self.client = client
+
+    def register(self, cluster) -> None:
+        cluster.register_validating_webhook("Notebook", self.handle)
+
+    def handle(self, req: AdmissionRequest) -> None:
+        nb = Notebook(req.object)
+
+        if nb.tpu is not None:
+            try:
+                nb.tpu.slice_topology()
+            except InvalidTopologyError as err:
+                raise WebhookDeniedError(f"invalid spec.tpu: {err}") from None
+
+        if req.operation != "UPDATE" or req.old_object is None:
+            return
+        old = Notebook(req.old_object)
+        running = not old.stopped
+
+        if running and old.tpu != nb.tpu:
+            raise WebhookDeniedError(
+                "spec.tpu cannot change while the notebook is running: changing "
+                f"{old.tpu} -> {nb.tpu} would tear down the slice. "
+                f"Stop the notebook (annotation {ann.STOP!r}) first."
+            )
+
+        # Reference rule: MLflow integration cannot be silently detached
+        # from a running notebook (validateMLflowAnnotationRemoval :79-100).
+        old_mlflow = old.obj.get("metadata", {}).get("annotations", {}).get(
+            ann.MLFLOW_INSTANCE
+        )
+        new_mlflow = req.object.get("metadata", {}).get("annotations", {}).get(
+            ann.MLFLOW_INSTANCE
+        )
+        if running and old_mlflow and not new_mlflow:
+            raise WebhookDeniedError(
+                f"annotation {ann.MLFLOW_INSTANCE} cannot be removed while the "
+                "notebook is running; stop the notebook first"
+            )

@@ -15,9 +15,15 @@ from kubeflow_tpu import k8s
 from kubeflow_tpu.api.notebook import TPUSpec, new_notebook
 from kubeflow_tpu.controller.culling import CullerConfig, CullingReconciler, HostActivity
 from kubeflow_tpu.controller.notebook import ControllerConfig, NotebookReconciler
+from kubeflow_tpu.controller.platform import PlatformConfig, PlatformReconciler
 from kubeflow_tpu.controller.preemption import SliceHealthReconciler
 from kubeflow_tpu.k8s.manager import FakeClock, Manager
 from kubeflow_tpu.metrics import Metrics
+from kubeflow_tpu.webhook import (
+    NotebookMutatingWebhook,
+    NotebookValidatingWebhook,
+    WebhookConfig,
+)
 
 
 class FakeProber:
@@ -54,6 +60,7 @@ class Env:
     prober: Optional[FakeProber]
     slice_health: Optional[SliceHealthReconciler]
     metrics: Metrics
+    webhook: Optional[NotebookMutatingWebhook] = None
 
 
 def make_env(
@@ -63,6 +70,10 @@ def make_env(
     slice_health: bool = True,
     node_pools: tuple = (("tpu-v5-lite-podslice", "4x4", 4, 4),),
     cpu_nodes: int = 1,
+    webhooks: bool = False,
+    webhook_config: Optional[WebhookConfig] = None,
+    platform: bool = False,
+    platform_config: Optional[PlatformConfig] = None,
 ) -> Env:
     clock = FakeClock()
     cluster = k8s.FakeCluster(clock=clock)
@@ -106,10 +117,22 @@ def make_env(
         health = SliceHealthReconciler(cluster, metrics=metrics)
         health.register(manager)
 
+    if platform:
+        PlatformReconciler(cluster, platform_config or PlatformConfig()).register(
+            manager
+        )
+
     kubelet.register(manager)
 
+    webhook = None
+    if webhooks:
+        webhook = NotebookMutatingWebhook(cluster, webhook_config or WebhookConfig())
+        webhook.register(cluster)
+        NotebookValidatingWebhook(cluster).register(cluster)
+
     return Env(
-        cluster, manager, clock, kubelet, reconciler, culler_rec, prober, health, metrics
+        cluster, manager, clock, kubelet, reconciler, culler_rec, prober, health,
+        metrics, webhook,
     )
 
 

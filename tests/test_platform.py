@@ -1,0 +1,334 @@
+"""Platform-reconciler integration tests (ODH tier: reference
+odh notebook_controller_test.go ~7.1k LoC of Ginkgo specs, distilled)."""
+
+import base64
+import json
+
+import pytest
+
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.controller.platform import FINALIZER
+from kubeflow_tpu.k8s import objects as obj_util
+
+from tests.harness import cpu_notebook, make_env, tpu_notebook
+
+CENTRAL = "opendatahub"
+
+
+def make_platform_env(**kw):
+    return make_env(webhooks=True, platform=True, **kw)
+
+
+class TestLifecycle:
+    def test_finalizer_added_and_lock_released(self):
+        env = make_platform_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert FINALIZER in nb["metadata"]["finalizers"]
+        # Lock released once platform resources exist → slice started.
+        assert ann.STOP not in nb["metadata"].get("annotations", {})
+        assert env.cluster.get("StatefulSet", "nb", "ns")["spec"]["replicas"] == 4
+        assert nb["status"]["tpu"]["sliceHealth"] == "Healthy"
+
+    def test_user_stop_annotation_survives_platform_reconcile(self):
+        env = make_platform_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.annotations_of(nb)[ann.STOP] = "2026-07-29T10:00:00Z"
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        # A user stop (timestamp value) must NOT be treated as the lock.
+        assert nb["metadata"]["annotations"][ann.STOP] == "2026-07-29T10:00:00Z"
+        assert env.cluster.get("StatefulSet", "nb", "ns")["spec"]["replicas"] == 0
+
+
+class TestRouting:
+    def test_httproute_in_central_namespace(self):
+        env = make_platform_env()
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        route = env.cluster.get("HTTPRoute", "nb-ns-nb", CENTRAL)
+        rule = route["spec"]["rules"][0]
+        assert rule["matches"][0]["path"]["value"] == "/notebook/ns/nb"
+        assert rule["backendRefs"][0] == {"name": "nb", "namespace": "ns", "port": 80}
+        assert route["spec"]["parentRefs"][0]["name"] == "data-science-gateway"
+
+    def test_reference_grant_created_per_namespace(self):
+        env = make_platform_env()
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        grant = env.cluster.get("ReferenceGrant", "notebook-httproute-access", "ns")
+        assert grant["spec"]["from"][0]["namespace"] == CENTRAL
+        assert grant["spec"]["to"][0]["kind"] == "Service"
+
+    def test_route_recreated_if_deleted(self):
+        env = make_platform_env()
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        env.cluster.delete("HTTPRoute", "nb-ns-nb", CENTRAL)
+        env.manager.run_until_idle()
+        assert env.cluster.exists("HTTPRoute", "nb-ns-nb", CENTRAL)
+
+
+class TestAuthMode:
+    def test_auth_bundle_created(self):
+        env = make_platform_env()
+        env.cluster.create(cpu_notebook(annotations={ann.INJECT_AUTH: "true"}))
+        env.manager.run_until_idle()
+        assert env.cluster.exists("ServiceAccount", "nb-auth-proxy", "ns")
+        svc = env.cluster.get("Service", "nb-kube-rbac-proxy", "ns")
+        assert svc["metadata"]["annotations"][
+            "service.beta.openshift.io/serving-cert-secret-name"
+        ] == "nb-tls"
+        cm = env.cluster.get("ConfigMap", "nb-kube-rbac-proxy-config", "ns")
+        config = json.loads(cm["data"]["config-file.yaml"])
+        attrs = config["authorization"]["resourceAttributes"]
+        assert attrs["resource"] == "notebooks"
+        assert attrs["name"] == "nb"
+        crb = env.cluster.get("ClusterRoleBinding", "ns-nb-auth-delegator")
+        assert crb["roleRef"]["name"] == "system:auth-delegator"
+        route = env.cluster.get("HTTPRoute", "nb-ns-nb", CENTRAL)
+        assert route["spec"]["rules"][0]["backendRefs"][0]["port"] == 8443
+
+    def test_mode_switch_auth_to_plain_cleans_up(self):
+        env = make_platform_env()
+        env.cluster.create(cpu_notebook(annotations={ann.INJECT_AUTH: "true"}))
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        del nb["metadata"]["annotations"][ann.INJECT_AUTH]
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        assert not env.cluster.exists("ServiceAccount", "nb-auth-proxy", "ns")
+        assert not env.cluster.exists("Service", "nb-kube-rbac-proxy", "ns")
+        assert not env.cluster.exists("ClusterRoleBinding", "ns-nb-auth-delegator")
+        route = env.cluster.get("HTTPRoute", "nb-ns-nb", CENTRAL)
+        assert route["spec"]["rules"][0]["backendRefs"][0]["port"] == 80
+
+
+class TestNetworkPolicies:
+    def test_policies_for_multi_host_slice(self):
+        env = make_platform_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        ctrl = env.cluster.get("NetworkPolicy", "nb-ctrl-np", "ns")
+        ingress = ctrl["spec"]["ingress"][0]
+        assert ingress["ports"][0]["port"] == 8888
+        assert (
+            ingress["from"][0]["namespaceSelector"]["matchLabels"][
+                "kubernetes.io/metadata.name"
+            ]
+            == CENTRAL
+        )
+        assert env.cluster.exists("NetworkPolicy", "nb-kube-rbac-proxy-np", "ns")
+        slice_np = env.cluster.get("NetworkPolicy", "nb-slice-np", "ns")
+        peer = slice_np["spec"]["ingress"][0]["from"][0]
+        assert peer["podSelector"]["matchLabels"]["statefulset"] == "nb"
+
+    def test_no_slice_policy_for_cpu_notebook(self):
+        env = make_platform_env()
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        assert not env.cluster.exists("NetworkPolicy", "nb-slice-np", "ns")
+
+
+class TestDeletion:
+    def test_full_cleanup_on_delete(self):
+        env = make_platform_env()
+        env.cluster.create(cpu_notebook(annotations={ann.INJECT_AUTH: "true"}))
+        env.manager.run_until_idle()
+        # Legacy OAuthClient from a pre-3.0 install.
+        env.cluster.create(
+            {
+                "apiVersion": "oauth.openshift.io/v1",
+                "kind": "OAuthClient",
+                "metadata": {"name": "nb-ns-oauth-client"},
+            }
+        )
+        env.cluster.delete("Notebook", "nb", "ns")
+        env.manager.run_until_idle()
+        assert not env.cluster.exists("Notebook", "nb", "ns")
+        assert not env.cluster.exists("HTTPRoute", "nb-ns-nb", CENTRAL)
+        assert not env.cluster.exists("ReferenceGrant", "notebook-httproute-access", "ns")
+        assert not env.cluster.exists("ClusterRoleBinding", "ns-nb-auth-delegator")
+        assert not env.cluster.exists("OAuthClient", "nb-ns-oauth-client")
+
+    def test_reference_grant_kept_while_other_notebook_lives(self):
+        env = make_platform_env()
+        env.cluster.create(cpu_notebook(name="nb-a"))
+        env.cluster.create(cpu_notebook(name="nb-b"))
+        env.manager.run_until_idle()
+        env.cluster.delete("Notebook", "nb-a", "ns")
+        env.manager.run_until_idle()
+        assert env.cluster.exists("ReferenceGrant", "notebook-httproute-access", "ns")
+        env.cluster.delete("Notebook", "nb-b", "ns")
+        env.manager.run_until_idle()
+        assert not env.cluster.exists("ReferenceGrant", "notebook-httproute-access", "ns")
+
+
+class TestCaBundle:
+    def test_bundle_built_from_sources_with_pem_validation(self):
+        env = make_platform_env()
+        pem = (
+            "-----BEGIN CERTIFICATE-----\nMIIBBB==\n-----END CERTIFICATE-----"
+        )
+        env.cluster.create(
+            {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": {"name": "odh-trusted-ca-bundle", "namespace": CENTRAL},
+                "data": {"ca-bundle.crt": pem + "\ngarbage-not-pem"},
+            }
+        )
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        cm = env.cluster.get("ConfigMap", "workbench-trusted-ca-bundle", "ns")
+        assert pem in cm["data"]["ca-bundle.crt"]
+        assert "garbage" not in cm["data"]["ca-bundle.crt"]
+        # Webhook mounts it on the next notebook update (stopped or created).
+        env.cluster.create(cpu_notebook(name="nb2"))
+        env.manager.run_until_idle()
+        from kubeflow_tpu.api.notebook import Notebook
+
+        nb2 = Notebook(env.cluster.get("Notebook", "nb2", "ns"))
+        mounts = nb2.primary_container().get("volumeMounts", [])
+        assert any(m["name"] == "trusted-ca" for m in mounts)
+
+
+class TestRuntimeImagesAndPipelines:
+    def _runtime_imagestream(self, env):
+        env.cluster.create(
+            {
+                "apiVersion": "image.openshift.io/v1",
+                "kind": "ImageStream",
+                "metadata": {
+                    "name": "datascience-runtime",
+                    "namespace": CENTRAL,
+                    "labels": {"opendatahub.io/runtime-image": "true"},
+                    "annotations": {
+                        "opendatahub.io/runtime-image-name": "Data Science 2026a"
+                    },
+                },
+                "status": {
+                    "tags": [
+                        {"tag": "latest", "items": [{"dockerImageReference": "reg/rt@sha256:1"}]}
+                    ]
+                },
+            }
+        )
+
+    def test_runtime_images_synced_to_user_namespace(self):
+        env = make_platform_env()
+        self._runtime_imagestream(env)
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        cm = env.cluster.get("ConfigMap", "pipeline-runtime-images", "ns")
+        key = "data-science-2026a.json"
+        assert key in cm["data"]
+        assert json.loads(cm["data"][key])["metadata"]["image_name"] == "reg/rt@sha256:1"
+
+    def test_elyra_secret_from_dspa(self):
+        from kubeflow_tpu.controller.platform import PlatformConfig
+
+        env = make_platform_env(
+            platform_config=PlatformConfig(set_pipeline_secret=True)
+        )
+        env.cluster.create(
+            {
+                "apiVersion": "datasciencepipelinesapplications.opendatahub.io/v1",
+                "kind": "DataSciencePipelinesApplication",
+                "metadata": {"name": "dspa", "namespace": "ns"},
+                "spec": {
+                    "objectStorage": {
+                        "externalStorage": {
+                            "host": "s3.example",
+                            "bucket": "pipelines",
+                            "s3CredentialsSecret": {"secretName": "s3-creds"},
+                        }
+                    }
+                },
+            }
+        )
+        env.cluster.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Secret",
+                "metadata": {"name": "s3-creds", "namespace": "ns"},
+                "data": {
+                    "AWS_ACCESS_KEY_ID": base64.b64encode(b"ak").decode(),
+                    "AWS_SECRET_ACCESS_KEY": base64.b64encode(b"sk").decode(),
+                },
+            }
+        )
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        secret = env.cluster.get("Secret", "ds-pipeline-config", "ns")
+        config = json.loads(secret["stringData"]["odh_dsp.json"])
+        assert config["metadata"]["cos_bucket"] == "pipelines"
+        assert config["schema_name"] == "kfp"
+        # Owned by the DSPA, not the notebook (survives notebook deletion).
+        owner = secret["metadata"]["ownerReferences"][0]
+        assert owner["kind"] == "DataSciencePipelinesApplication"
+
+    def test_pipeline_rbac_when_role_exists(self):
+        from kubeflow_tpu.controller.platform import PlatformConfig
+
+        env = make_platform_env(platform_config=PlatformConfig(set_pipeline_rbac=True))
+        env.cluster.create(
+            {
+                "apiVersion": "rbac.authorization.k8s.io/v1",
+                "kind": "Role",
+                "metadata": {"name": "ds-pipeline-user-access-dspa", "namespace": "ns"},
+            }
+        )
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        rb = env.cluster.get("RoleBinding", "elyra-pipelines-nb", "ns")
+        assert rb["roleRef"]["name"] == "ds-pipeline-user-access-dspa"
+
+
+class TestMlflow:
+    def test_requeues_until_cluster_role_appears(self):
+        from kubeflow_tpu.controller.platform import PlatformConfig
+
+        env = make_platform_env(platform_config=PlatformConfig(mlflow_enabled=True))
+        env.cluster.create(
+            cpu_notebook(annotations={ann.MLFLOW_INSTANCE: "tracking"})
+        )
+        env.manager.run_until_idle()
+        assert not env.cluster.exists("RoleBinding", "mlflow-nb", "ns")
+        assert env.manager.next_requeue_in() is not None
+        env.cluster.create(
+            {
+                "apiVersion": "rbac.authorization.k8s.io/v1",
+                "kind": "ClusterRole",
+                "metadata": {"name": "mlflow-operator-mlflow-integration"},
+            }
+        )
+        env.manager.tick(31.0)
+        assert env.cluster.exists("RoleBinding", "mlflow-nb", "ns")
+
+
+class TestReviewRegressions:
+    def test_deleted_proxy_service_drift_repaired(self):
+        """Platform owns Service: deleting the rbac-proxy Service re-creates it."""
+        env = make_platform_env()
+        env.cluster.create(cpu_notebook(annotations={ann.INJECT_AUTH: "true"}))
+        env.manager.run_until_idle()
+        env.cluster.delete("Service", "nb-kube-rbac-proxy", "ns")
+        env.manager.run_until_idle()
+        assert env.cluster.exists("Service", "nb-kube-rbac-proxy", "ns")
+
+    def test_platform_config_namespace_propagates_to_routes(self):
+        from kubeflow_tpu.controller.platform import PlatformConfig
+
+        env = make_platform_env(
+            platform_config=PlatformConfig(controller_namespace="my-ctrl")
+        )
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        assert env.cluster.exists("HTTPRoute", "nb-ns-nb", "my-ctrl")
+        grant = env.cluster.get("ReferenceGrant", "notebook-httproute-access", "ns")
+        assert grant["spec"]["from"][0]["namespace"] == "my-ctrl"

@@ -1,0 +1,335 @@
+"""Webhook tests: envtest-with-webhooks tier (reference suite_test.go:122-126
+installs both webhooks; specs in notebook_mutating_webhook_test.go)."""
+
+import pytest
+
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.api.notebook import Notebook, TPUSpec
+from kubeflow_tpu.k8s import WebhookDeniedError
+from kubeflow_tpu.k8s import objects as obj_util
+
+from tests.harness import cpu_notebook, make_env, tpu_notebook
+
+
+def get_env_var(container, name):
+    for e in container.get("env", []):
+        if e.get("name") == name:
+            return e
+    return None
+
+
+def primary(env, name="nb", ns="ns"):
+    nb = Notebook(env.cluster.get("Notebook", name, ns))
+    return nb, nb.primary_container()
+
+
+class TestReconciliationLock:
+    def test_create_injects_lock(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(cpu_notebook())
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["metadata"]["annotations"][ann.STOP] == ann.RECONCILIATION_LOCK_VALUE
+
+    def test_lock_keeps_slice_down_until_released(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        assert env.cluster.get("StatefulSet", "nb", "ns")["spec"]["replicas"] == 0
+        # Platform reconciler releases the lock (simulated here).
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.remove_annotation(nb, ann.STOP)
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        assert env.cluster.get("StatefulSet", "nb", "ns")["spec"]["replicas"] == 4
+
+    def test_user_stop_annotation_not_overwritten(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(cpu_notebook(annotations={ann.STOP: "2026-01-01T00:00:00Z"}))
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["metadata"]["annotations"][ann.STOP] == "2026-01-01T00:00:00Z"
+
+
+class TestTpuEnvInjection:
+    def test_multi_host_env_block(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(tpu_notebook())
+        _, c = primary(env)
+        assert get_env_var(c, "TPU_WORKER_ID")["valueFrom"]["fieldRef"]["fieldPath"] == (
+            "metadata.labels['apps.kubernetes.io/pod-index']"
+        )
+        hostnames = get_env_var(c, "TPU_WORKER_HOSTNAMES")["value"].split(",")
+        assert len(hostnames) == 4
+        assert hostnames[0] == "nb-0.nb-hosts.ns.svc.cluster.local"
+        assert get_env_var(c, "TPU_ACCELERATOR_TYPE")["value"] == "v5litepod-16"
+        assert get_env_var(c, "TPU_TOPOLOGY")["value"] == "4x4"
+        assert get_env_var(c, "TPU_CHIPS_PER_HOST_BOUNDS")["value"] == "2,2,1"
+        assert get_env_var(c, "JAX_COORDINATOR_ADDRESS")["value"] == (
+            "nb-0.nb-hosts.ns.svc.cluster.local:8476"
+        )
+        assert get_env_var(c, "JAX_NUM_PROCESSES")["value"] == "4"
+
+    def test_single_host_no_coordinator(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(tpu_notebook(topology="2x2"))
+        _, c = primary(env)
+        assert get_env_var(c, "JAX_COORDINATOR_ADDRESS") is None
+        assert get_env_var(c, "TPU_WORKER_HOSTNAMES")["value"].count(",") == 0
+
+    def test_cpu_notebook_untouched(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(cpu_notebook())
+        _, c = primary(env)
+        assert get_env_var(c, "TPU_WORKER_ID") is None
+
+    def test_resolved_topology_annotation(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(tpu_notebook())
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["metadata"]["annotations"][ann.TPU_RESOLVED_TOPOLOGY] == (
+            "v5litepod-16/4x4"
+        )
+
+
+class TestImageResolution:
+    def _imagestream(self, env):
+        env.cluster.create(
+            {
+                "apiVersion": "image.openshift.io/v1",
+                "kind": "ImageStream",
+                "metadata": {"name": "jupyter-ds", "namespace": "opendatahub"},
+                "spec": {"tags": [{"name": "2026.1", "from": {"name": "spec-img"}}]},
+                "status": {
+                    "tags": [
+                        {
+                            "tag": "2026.1",
+                            "items": [{"dockerImageReference": "registry/ds@sha256:abc"}],
+                        }
+                    ]
+                },
+            }
+        )
+
+    def test_resolves_from_status_tag(self):
+        env = make_env(webhooks=True)
+        self._imagestream(env)
+        env.cluster.create(
+            cpu_notebook(annotations={ann.LAST_IMAGE_SELECTION: "jupyter-ds:2026.1"})
+        )
+        _, c = primary(env)
+        assert c["image"] == "registry/ds@sha256:abc"
+
+    def test_missing_stream_keeps_image(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(
+            cpu_notebook(annotations={ann.LAST_IMAGE_SELECTION: "nope:1"})
+        )
+        _, c = primary(env)
+        assert c["image"] == "jupyter-minimal:latest"
+
+
+class TestAuthSidecar:
+    def test_injected_with_defaults(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(cpu_notebook(annotations={ann.INJECT_AUTH: "true"}))
+        nb, _ = primary(env)
+        sidecar = next(
+            c for c in nb.containers if c["name"] == "kube-rbac-proxy"
+        )
+        assert sidecar["resources"]["requests"]["cpu"] == "100m"
+        assert nb.pod_spec["serviceAccountName"] == "nb-auth-proxy"
+        vol_names = {v["name"] for v in nb.pod_spec["volumes"]}
+        assert {"kube-rbac-proxy-config", "kube-rbac-proxy-tls"} <= vol_names
+
+    def test_resource_annotations_override(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(
+            cpu_notebook(
+                annotations={
+                    ann.INJECT_AUTH: "true",
+                    ann.AUTH_SIDECAR_CPU_REQUEST: "250m",
+                    ann.AUTH_SIDECAR_MEMORY_LIMIT: "128Mi",
+                }
+            )
+        )
+        nb, _ = primary(env)
+        sidecar = next(c for c in nb.containers if c["name"] == "kube-rbac-proxy")
+        assert sidecar["resources"]["requests"]["cpu"] == "250m"
+        assert sidecar["resources"]["limits"]["memory"] == "128Mi"
+
+    def test_invalid_resource_annotation_denied(self):
+        env = make_env(webhooks=True)
+        with pytest.raises(WebhookDeniedError):
+            env.cluster.create(
+                cpu_notebook(
+                    annotations={
+                        ann.INJECT_AUTH: "true",
+                        ann.AUTH_SIDECAR_CPU_REQUEST: "lots-please",
+                    }
+                )
+            )
+
+    def test_sidecar_removed_when_auth_disabled(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(cpu_notebook(annotations={ann.INJECT_AUTH: "true"}))
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        del nb["metadata"]["annotations"][ann.INJECT_AUTH]
+        env.cluster.update(nb)
+        nb, _ = primary(env)
+        assert all(c["name"] != "kube-rbac-proxy" for c in nb.containers)
+
+
+class TestUpdateBlocking:
+    def _running_notebook(self, env):
+        env.cluster.create(tpu_notebook())
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.remove_annotation(nb, ann.STOP)  # release the lock
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        return env.cluster.get("Notebook", "nb", "ns")
+
+    def test_webhook_drift_reverted_on_running_notebook(self):
+        env = make_env(webhooks=True)
+        nb = self._running_notebook(env)
+        image_before = Notebook(nb).primary_container()["image"]
+        # A CA bundle appears AFTER the notebook started: mounting it would
+        # change the template → must be blocked while running.
+        env.cluster.create(
+            {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": {"name": "workbench-trusted-ca-bundle", "namespace": "ns"},
+                "data": {"ca-bundle.crt": "PEMPEM"},
+            }
+        )
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.set_annotation(nb, "touch", "1")  # metadata-only user update
+        env.cluster.update(nb)
+        fresh = Notebook(env.cluster.get("Notebook", "nb", "ns"))
+        assert get_env_var(fresh.primary_container(), "SSL_CERT_FILE") is None
+        assert fresh.primary_container()["image"] == image_before
+        pending = fresh.annotations[ann.UPDATE_PENDING]
+        assert "trusted-ca" in pending or "volume" in pending or "env" in pending
+
+    def test_user_template_change_allowed_while_running(self):
+        env = make_env(webhooks=True)
+        nb = self._running_notebook(env)
+        nb["spec"]["template"]["spec"]["containers"][0]["image"] = "jax-notebook:v2"
+        env.cluster.update(nb)
+        fresh = Notebook(env.cluster.get("Notebook", "nb", "ns"))
+        assert fresh.primary_container()["image"] == "jax-notebook:v2"
+        assert ann.UPDATE_PENDING not in fresh.annotations
+
+    def test_mutations_land_on_stopped_notebook(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(
+            {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": {"name": "workbench-trusted-ca-bundle", "namespace": "ns"},
+                "data": {"ca-bundle.crt": "PEMPEM"},
+            }
+        )
+        env.cluster.create(tpu_notebook())  # created with lock → stopped
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.set_annotation(nb, "touch", "1")
+        env.cluster.update(nb)
+        fresh = Notebook(env.cluster.get("Notebook", "nb", "ns"))
+        assert get_env_var(fresh.primary_container(), "SSL_CERT_FILE") is not None
+        assert ann.UPDATE_PENDING not in fresh.annotations
+
+
+class TestValidatingWebhook:
+    def test_invalid_topology_denied_at_create(self):
+        env = make_env(webhooks=True)
+        with pytest.raises(WebhookDeniedError, match="invalid spec.tpu"):
+            env.cluster.create(tpu_notebook(topology="3x4"))
+        assert not env.cluster.exists("Notebook", "nb", "ns")
+
+    def test_tpu_change_denied_while_running(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(tpu_notebook())
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.remove_annotation(nb, ann.STOP)
+        env.cluster.update(nb)
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        nb["spec"]["tpu"]["topology"] = "4x8"
+        with pytest.raises(WebhookDeniedError, match="cannot change"):
+            env.cluster.update(nb)
+
+    def test_tpu_change_allowed_when_stopped(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(tpu_notebook())  # lock → stopped
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        nb["spec"]["tpu"]["topology"] = "4x8"
+        env.cluster.update(nb)
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["spec"]["tpu"]["topology"] == "4x8"
+
+    def test_mlflow_annotation_removal_denied_while_running(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(
+            cpu_notebook(annotations={ann.MLFLOW_INSTANCE: "tracking"})
+        )
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.remove_annotation(nb, ann.STOP)
+        env.cluster.update(nb)
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        del nb["metadata"]["annotations"][ann.MLFLOW_INSTANCE]
+        with pytest.raises(WebhookDeniedError, match="cannot be removed"):
+            env.cluster.update(nb)
+
+
+class TestMlflowAndProxyEnv:
+    def test_mlflow_env_injected(self):
+        from kubeflow_tpu.webhook import WebhookConfig
+
+        env = make_env(
+            webhooks=True,
+            webhook_config=WebhookConfig(
+                mlflow_enabled=True, gateway_url="https://gw.example"
+            ),
+        )
+        env.cluster.create(
+            cpu_notebook(annotations={ann.MLFLOW_INSTANCE: "team-tracking"})
+        )
+        _, c = primary(env)
+        assert get_env_var(c, "MLFLOW_TRACKING_URI")["value"] == (
+            "https://gw.example/mlflow/team-tracking"
+        )
+        assert get_env_var(c, "MLFLOW_K8S_INTEGRATION")["value"] == "true"
+
+    def test_cluster_proxy_env(self):
+        from kubeflow_tpu.webhook import WebhookConfig
+
+        env = make_env(
+            webhooks=True,
+            webhook_config=WebhookConfig(inject_cluster_proxy_env=True),
+        )
+        env.cluster.create(
+            {
+                "apiVersion": "config.openshift.io/v1",
+                "kind": "Proxy",
+                "metadata": {"name": "cluster"},
+                "spec": {"httpProxy": "http://proxy:3128", "noProxy": ".cluster.local"},
+            }
+        )
+        env.cluster.create(cpu_notebook())
+        _, c = primary(env)
+        assert get_env_var(c, "HTTP_PROXY")["value"] == "http://proxy:3128"
+        assert get_env_var(c, "NO_PROXY")["value"] == ".cluster.local"
+
+
+class TestFeastMount:
+    def test_label_gated_mount_and_unmount(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(
+            cpu_notebook(labels={ann.FEAST_INTEGRATION_LABEL: "true"})
+        )
+        nb, c = primary(env)
+        assert any(v["name"] == "feast-config" for v in nb.pod_spec["volumes"])
+        assert any(m["name"] == "feast-config" for m in c["volumeMounts"])
+        fresh = env.cluster.get("Notebook", "nb", "ns")
+        fresh["metadata"]["labels"][ann.FEAST_INTEGRATION_LABEL] = "false"
+        env.cluster.update(fresh)
+        nb, _ = primary(env)
+        assert all(v["name"] != "feast-config" for v in nb.pod_spec.get("volumes", []))
