@@ -1,0 +1,17 @@
+"""Shared resource names and ports: the cross-component wire contract.
+
+The controller-side sync (kubeflow_tpu.controller.integrations) writes
+objects the webhook-side mounts (kubeflow_tpu.webhook.mounts) look up by
+name, and the env the webhook injects must match the ports the Services and
+runtime bootstrap use. Each name/port is defined exactly once, here.
+"""
+
+NOTEBOOK_PORT = 8888
+RBAC_PROXY_PORT = 8443
+JAX_COORDINATOR_PORT = 8476  # jax.distributed default coordinator port
+
+CA_BUNDLE_CONFIGMAP = "workbench-trusted-ca-bundle"
+RUNTIME_IMAGES_CONFIGMAP = "pipeline-runtime-images"
+ELYRA_SECRET_NAME = "ds-pipeline-config"
+MANAGED_BY_LABEL = "opendatahub.io/managed-by"
+MANAGED_BY_VALUE = "workbenches"

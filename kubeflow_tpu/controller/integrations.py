@@ -14,7 +14,16 @@ import logging
 import re
 from typing import Optional
 
+import base64
+
 from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.api.names import (
+    CA_BUNDLE_CONFIGMAP,
+    ELYRA_SECRET_NAME,
+    MANAGED_BY_LABEL,
+    MANAGED_BY_VALUE,
+    RUNTIME_IMAGES_CONFIGMAP,
+)
 from kubeflow_tpu.api.notebook import Notebook
 from kubeflow_tpu.controller import reconcilehelper as helper
 from kubeflow_tpu.k8s.client import Client
@@ -32,7 +41,7 @@ CA_SOURCE_CONFIGMAPS = (
     ("odh-trusted-ca-bundle", "odh-ca-bundle.crt"),
     ("kube-root-ca.crt", "ca.crt"),
 )
-CA_TARGET_CONFIGMAP = "workbench-trusted-ca-bundle"
+CA_TARGET_CONFIGMAP = CA_BUNDLE_CONFIGMAP
 
 _PEM_BLOCK_RE = re.compile(
     r"-----BEGIN CERTIFICATE-----[A-Za-z0-9+/=\s]+-----END CERTIFICATE-----"
@@ -74,7 +83,7 @@ def reconcile_ca_bundle(
         "metadata": {
             "name": CA_TARGET_CONFIGMAP,
             "namespace": nb.namespace,
-            "labels": {"opendatahub.io/managed-by": "workbenches"},
+            "labels": {MANAGED_BY_LABEL: MANAGED_BY_VALUE},
         },
         "data": {"ca-bundle.crt": "\n".join(unique) + "\n"},
     }
@@ -88,7 +97,6 @@ def reconcile_ca_bundle(
 # controller ns → per-user-ns ConfigMap; key sanitization :174-182)
 
 RUNTIME_IMAGE_LABEL = "opendatahub.io/runtime-image"
-RUNTIME_IMAGES_CONFIGMAP = "pipeline-runtime-images"
 
 
 def format_key_name(display_name: str) -> str:
@@ -121,6 +129,17 @@ def sync_runtime_images_config_map(
             {"display_name": display, "metadata": {"image_name": image_ref}}
         )
     if not data:
+        # Sources gone → delete the synced CM (if it is ours) so the
+        # webhook unmounts stale runtime definitions.
+        try:
+            existing = client.get("ConfigMap", RUNTIME_IMAGES_CONFIGMAP, nb.namespace)
+            if (
+                existing.get("metadata", {}).get("labels", {}).get(MANAGED_BY_LABEL)
+                == MANAGED_BY_VALUE
+            ):
+                client.delete("ConfigMap", RUNTIME_IMAGES_CONFIGMAP, nb.namespace)
+        except NotFoundError:
+            pass
         return
     desired = {
         "apiVersion": "v1",
@@ -128,7 +147,7 @@ def sync_runtime_images_config_map(
         "metadata": {
             "name": RUNTIME_IMAGES_CONFIGMAP,
             "namespace": nb.namespace,
-            "labels": {"opendatahub.io/managed-by": "workbenches"},
+            "labels": {MANAGED_BY_LABEL: MANAGED_BY_VALUE},
         },
         "data": data,
     }
@@ -140,7 +159,16 @@ def sync_runtime_images_config_map(
 # SyncElyraRuntimeConfigSecret :305-399, extractElyraRuntimeConfigInfo
 # :189-298, getHostnameForPublicEndpoint :106-148)
 
-ELYRA_SECRET_NAME = "ds-pipeline-config"
+
+def _decode_secret_value(data: dict, key: str) -> str:
+    """Secret.data values are base64 on the wire; Elyra wants plaintext."""
+    raw = data.get(key, "")
+    if not raw:
+        return ""
+    try:
+        return base64.b64decode(raw).decode()
+    except (ValueError, UnicodeDecodeError):
+        return ""
 
 
 def sync_elyra_runtime_config(
@@ -161,8 +189,10 @@ def sync_elyra_runtime_config(
     if s3_secret_name:
         try:
             s3 = client.get("Secret", s3_secret_name, nb.namespace)
-            access_key = s3.get("data", {}).get("AWS_ACCESS_KEY_ID", "")
-            secret_key = s3.get("data", {}).get("AWS_SECRET_ACCESS_KEY", "")
+            access_key = _decode_secret_value(s3.get("data", {}), "AWS_ACCESS_KEY_ID")
+            secret_key = _decode_secret_value(
+                s3.get("data", {}), "AWS_SECRET_ACCESS_KEY"
+            )
         except NotFoundError:
             pass
     api_endpoint = (
@@ -190,7 +220,7 @@ def sync_elyra_runtime_config(
         "metadata": {
             "name": ELYRA_SECRET_NAME,
             "namespace": nb.namespace,
-            "labels": {"opendatahub.io/managed-by": "workbenches"},
+            "labels": {MANAGED_BY_LABEL: MANAGED_BY_VALUE},
         },
         "stringData": {"odh_dsp.json": json.dumps(runtime_config)},
     }

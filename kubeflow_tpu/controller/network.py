@@ -12,12 +12,10 @@ debug.
 
 from __future__ import annotations
 
+from kubeflow_tpu.api.names import NOTEBOOK_PORT, RBAC_PROXY_PORT
 from kubeflow_tpu.api.notebook import Notebook
 from kubeflow_tpu.controller import reconcilehelper as helper
 from kubeflow_tpu.k8s.client import Client
-
-NOTEBOOK_PORT = 8888
-RBAC_PROXY_PORT = 8443
 
 
 def ctrl_np_name(name: str) -> str:
@@ -32,9 +30,27 @@ def slice_np_name(name: str) -> str:
     return f"{name}-slice-np"
 
 
-def new_ctrl_policy(nb: Notebook, controller_namespace: str) -> dict:
-    """Allow 8888 only from the controller namespace (culler probes, route
-    backend traffic ingresses via the gateway's proxied connection)."""
+def new_ctrl_policy(
+    nb: Notebook, controller_namespace: str, gateway_namespace: str
+) -> dict:
+    """Allow 8888 from the controller namespace (culler probes) AND the
+    gateway namespace — plain-mode HTTPRoutes terminate at the gateway pods,
+    whose connections to 8888 must not be dropped by the lockdown."""
+    peers = [
+        {
+            "namespaceSelector": {
+                "matchLabels": {"kubernetes.io/metadata.name": controller_namespace}
+            }
+        }
+    ]
+    if gateway_namespace and gateway_namespace != controller_namespace:
+        peers.append(
+            {
+                "namespaceSelector": {
+                    "matchLabels": {"kubernetes.io/metadata.name": gateway_namespace}
+                }
+            }
+        )
     return {
         "apiVersion": "networking.k8s.io/v1",
         "kind": "NetworkPolicy",
@@ -48,15 +64,7 @@ def new_ctrl_policy(nb: Notebook, controller_namespace: str) -> dict:
             "policyTypes": ["Ingress"],
             "ingress": [
                 {
-                    "from": [
-                        {
-                            "namespaceSelector": {
-                                "matchLabels": {
-                                    "kubernetes.io/metadata.name": controller_namespace
-                                }
-                            }
-                        }
-                    ],
+                    "from": peers,
                     "ports": [{"protocol": "TCP", "port": NOTEBOOK_PORT}],
                 }
             ],
@@ -105,10 +113,14 @@ def new_slice_policy(nb: Notebook) -> dict:
 
 
 def reconcile_network_policies(
-    client: Client, nb: Notebook, controller_namespace: str
+    client: Client, nb: Notebook, controller_namespace: str,
+    gateway_namespace: str = "",
 ) -> None:
     """Reference ReconcileAllNetworkPolicies (notebook_network.go:44)."""
-    helper.reconcile_child(client, nb.obj, new_ctrl_policy(nb, controller_namespace))
+    helper.reconcile_child(
+        client, nb.obj,
+        new_ctrl_policy(nb, controller_namespace, gateway_namespace),
+    )
     helper.reconcile_child(client, nb.obj, new_proxy_policy(nb))
     multi_host = False
     if nb.tpu is not None:

@@ -39,9 +39,9 @@ from kubeflow_tpu.tpu.topology import InvalidTopologyError, SliceTopology
 
 log = logging.getLogger(__name__)
 
-NOTEBOOK_PORT = 8888
+from kubeflow_tpu.api.names import JAX_COORDINATOR_PORT, NOTEBOOK_PORT
+
 NOTEBOOK_PORT_NAME = "notebook-port"
-JAX_COORDINATOR_PORT = 8476  # jax.distributed default coordinator port
 
 # Annotations never copied onto pod templates (reference
 # notebook_controller.go:486-491 filters kubectl + lifecycle keys).

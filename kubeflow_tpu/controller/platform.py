@@ -120,7 +120,10 @@ class PlatformReconciler(Reconciler):
 
         cfg = self.config
         integrations.reconcile_ca_bundle(self.client, nb, cfg.controller_namespace)
-        network.reconcile_network_policies(self.client, nb, cfg.controller_namespace)
+        network.reconcile_network_policies(
+            self.client, nb, cfg.controller_namespace,
+            gateway_namespace=cfg.routes.gateway_namespace,
+        )
         integrations.sync_runtime_images_config_map(
             self.client, nb, cfg.controller_namespace
         )

@@ -1,0 +1,8 @@
+from kubeflow_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    LLAMA_CONFIGS,
+    init_params,
+    forward,
+    decode_step,
+    init_kv_cache,
+)

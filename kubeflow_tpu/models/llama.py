@@ -1,0 +1,362 @@
+"""Llama model family: functional JAX, TPU-first.
+
+The in-notebook flagship for the benchmark target (BASELINE.md: Llama-2-7B
+tokens/sec/chip on v5e). Design choices for the MXU/XLA (not a torch port):
+
+- pure functional: params are a pytree of bf16 arrays; every entry point is
+  jit-able and shard-able with the PartitionSpecs from
+  kubeflow_tpu.parallel.mesh.MeshPlan,
+- **stacked layers + lax.scan**: all transformer layers live in one pytree
+  with a leading (n_layers, ...) axis and the forward pass scans over it —
+  XLA compiles ONE layer body instead of unrolling 32, keeping compile
+  times interactive-notebook friendly,
+- static shapes everywhere: prefill takes a fixed block, decode is a single
+  fused step over a preallocated KV cache (lax.dynamic_update_slice), so
+  XLA compiles exactly two programs for generation,
+- attention goes through kubeflow_tpu.ops.flash_attention (pallas on TPU),
+- f32 for norms/softmax/rope accumulation, bf16 weights and activations —
+  the MXU-native mix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.attention import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_hidden: int = 11008
+    rope_theta: float = 10000.0
+    max_seq_len: int = 4096
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def param_count(self) -> int:
+        embed = self.vocab_size * self.dim
+        attn = self.dim * self.head_dim * (2 * self.n_heads + 2 * self.n_kv_heads)
+        mlp = 3 * self.dim * self.ffn_hidden
+        norms = 2 * self.dim
+        return 2 * embed + self.n_layers * (attn + mlp + norms) + self.dim
+
+
+LLAMA_CONFIGS: dict[str, LlamaConfig] = {
+    "llama-2-7b": LlamaConfig(),
+    "llama-2-13b": LlamaConfig(dim=5120, n_layers=40, n_heads=40, n_kv_heads=40,
+                               ffn_hidden=13824),
+    "llama-2-70b": LlamaConfig(dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+                               ffn_hidden=28672),
+    "llama-3-8b": LlamaConfig(vocab_size=128256, dim=4096, n_layers=32,
+                              n_heads=32, n_kv_heads=8, ffn_hidden=14336,
+                              rope_theta=500000.0, max_seq_len=8192),
+    # Tiny configs for tests / compile checks.
+    "tiny": LlamaConfig(vocab_size=256, dim=128, n_layers=2, n_heads=4,
+                        n_kv_heads=4, ffn_hidden=256, max_seq_len=256),
+    "tiny-gqa": LlamaConfig(vocab_size=256, dim=128, n_layers=2, n_heads=4,
+                            n_kv_heads=2, ffn_hidden=256, max_seq_len=256),
+}
+
+
+# ---------------------------------------------------------------------------
+# Init — layer params are STACKED along a leading n_layers axis.
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
+    """Random init, 1/sqrt(fan_in) scaling, stacked layers."""
+    k_embed, k_head, k_layers = jax.random.split(key, 3)
+
+    def dense(k, shape):
+        # Generate directly in the target dtype: a 7B init must never
+        # materialize f32 temporaries (2× HBM) on a 16 GB chip.
+        scale = jnp.asarray(1.0 / math.sqrt(shape[-2]), cfg.dtype)
+        return jax.random.normal(k, shape, cfg.dtype) * scale
+
+    hd = cfg.head_dim
+    L = cfg.n_layers
+    lk = iter(jax.random.split(k_layers, 7))
+    layers = {
+        "attn_norm": jnp.ones((L, cfg.dim), cfg.dtype),
+        "wq": dense(next(lk), (L, cfg.dim, cfg.n_heads * hd)),
+        "wk": dense(next(lk), (L, cfg.dim, cfg.n_kv_heads * hd)),
+        "wv": dense(next(lk), (L, cfg.dim, cfg.n_kv_heads * hd)),
+        "wo": dense(next(lk), (L, cfg.n_heads * hd, cfg.dim)),
+        "mlp_norm": jnp.ones((L, cfg.dim), cfg.dtype),
+        "w_gate": dense(next(lk), (L, cfg.dim, cfg.ffn_hidden)),
+        "w_up": dense(next(lk), (L, cfg.dim, cfg.ffn_hidden)),
+        "w_down": dense(next(lk), (L, cfg.ffn_hidden, cfg.dim)),
+    }
+    return {
+        "embed": dense(k_embed, (cfg.vocab_size, cfg.dim)),
+        "final_norm": jnp.ones((cfg.dim,), cfg.dtype),
+        "lm_head": dense(k_head, (cfg.vocab_size, cfg.dim)),
+        "layers": layers,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Building blocks (f32 internals, bf16 boundaries)
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * rms).astype(x.dtype) * weight
+
+
+def rope_frequencies(cfg: LlamaConfig, positions: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for the given positions: (S, head_dim/2) each, f32."""
+    half = cfg.head_dim // 2
+    freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (B, H, S, D). Rotate pairs (split-half convention)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[None, None, :, :]
+    s = sin[None, None, :, :]
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out1 = x1f * c - x2f * s
+    out2 = x2f * c + x1f * s
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+def _split_heads(x: jax.Array, n_heads: int) -> jax.Array:
+    b, s, _ = x.shape
+    return x.reshape(b, s, n_heads, -1).transpose(0, 2, 1, 3)  # (B, H, S, D)
+
+
+def _merge_heads(x: jax.Array) -> jax.Array:
+    b, h, s, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    if n_rep == 1:
+        return x
+    b, h, s, d = x.shape
+    return jnp.broadcast_to(x[:, :, None], (b, h, n_rep, s, d)).reshape(
+        b, h * n_rep, s, d
+    )
+
+
+def _layer_fwd(
+    layer: dict, cfg: LlamaConfig, x: jax.Array,
+    cos: jax.Array, sin: jax.Array, attn_impl: str,
+) -> jax.Array:
+    """One transformer layer, full-sequence (prefill/training)."""
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = apply_rope(_split_heads(h @ layer["wq"], cfg.n_heads), cos, sin)
+    k = apply_rope(_split_heads(h @ layer["wk"], cfg.n_kv_heads), cos, sin)
+    v = _split_heads(h @ layer["wv"], cfg.n_kv_heads)
+    rep = cfg.n_heads // cfg.n_kv_heads
+    attn = flash_attention(
+        q, _repeat_kv(k, rep), _repeat_kv(v, rep), causal=True, impl=attn_impl
+    )
+    x = x + _merge_heads(attn) @ layer["wo"]
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    return x + _mlp(layer, h)
+
+
+def _mlp(layer: dict, x: jax.Array) -> jax.Array:
+    gate = jax.nn.silu((x @ layer["w_gate"]).astype(jnp.float32))
+    up = (x @ layer["w_up"]).astype(jnp.float32)
+    return ((gate * up).astype(x.dtype)) @ layer["w_down"]
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+
+
+@partial(jax.jit, static_argnames=("cfg", "attn_impl"))
+def forward(
+    params: dict, cfg: LlamaConfig, tokens: jax.Array, attn_impl: str = "auto"
+) -> jax.Array:
+    """Full prefill / training forward: tokens (B, S) → logits (B, S, V)."""
+    x = params["embed"][tokens]
+    cos, sin = rope_frequencies(cfg, jnp.arange(tokens.shape[1]))
+
+    def body(x, layer):
+        return _layer_fwd(layer, cfg, x, cos, sin, attn_impl), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"].T).astype(jnp.float32)
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
+    """Stacked KV cache: (L, B, Hkv, max_len, head_dim)."""
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
+def decode_step(
+    params: dict,
+    cfg: LlamaConfig,
+    token: jax.Array,  # (B, 1)
+    kv_cache: dict,
+    position: jax.Array,  # scalar int32: write position
+) -> tuple[jax.Array, dict]:
+    """One autoregressive step: token at ``position`` → logits (B, V).
+
+    Cache buffers are donated so decode mutates HBM in place; the step is
+    KV-cache-bandwidth-bound, exactly as it should be. The per-layer scan
+    carries x and updates the stacked cache slice for its layer.
+    """
+    return _decode_impl(params, cfg, token, kv_cache, position)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
+def prefill(
+    params: dict, cfg: LlamaConfig, tokens: jax.Array, kv_cache: dict
+) -> tuple[jax.Array, dict]:
+    """Prompt pass: (last-position logits, primed cache) in ONE pass."""
+    return _prefill_impl(params, cfg, tokens, kv_cache)
+
+
+def greedy_generate(
+    params: dict,
+    cfg: LlamaConfig,
+    prompt: jax.Array,  # (B, S_prompt)
+    max_new_tokens: int,
+    kv_cache: Optional[dict] = None,
+) -> jax.Array:
+    """Greedy decoding driver: prefill once, then stepwise decode."""
+    b, s_prompt = prompt.shape
+    max_len = s_prompt + max_new_tokens
+    if kv_cache is None:
+        kv_cache = init_kv_cache(cfg, b, max_len)
+
+    last_logits, kv_cache = prefill(params, cfg, prompt, kv_cache)
+    next_token = jnp.argmax(last_logits, axis=-1)[:, None]
+
+    tokens = [next_token]
+    position = jnp.asarray(s_prompt, jnp.int32)
+    for _ in range(max_new_tokens - 1):
+        logits, kv_cache = decode_step(params, cfg, next_token, kv_cache, position)
+        next_token = jnp.argmax(logits, axis=-1)[:, None]
+        tokens.append(next_token)
+        position = position + 1
+    return jnp.concatenate(tokens, axis=1)
+
+
+def _prefill_impl(
+    params: dict, cfg: LlamaConfig, tokens: jax.Array, kv_cache: dict
+) -> tuple[jax.Array, dict]:
+    """Prefill: write prompt K/V into the cache AND return last-position
+    logits (B, V) — one pass, no duplicated compute."""
+    x = params["embed"][tokens]
+    s = tokens.shape[1]
+    cos, sin = rope_frequencies(cfg, jnp.arange(s))
+    rep = cfg.n_heads // cfg.n_kv_heads
+
+    def body(x, scanned):
+        layer, k_cache, v_cache = scanned
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = apply_rope(_split_heads(h @ layer["wq"], cfg.n_heads), cos, sin)
+        k = apply_rope(_split_heads(h @ layer["wk"], cfg.n_kv_heads), cos, sin)
+        v = _split_heads(h @ layer["wv"], cfg.n_kv_heads)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
+        attn = flash_attention(q, _repeat_kv(k, rep), _repeat_kv(v, rep),
+                               causal=True, impl="auto")
+        x = x + _merge_heads(attn) @ layer["wo"]
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(layer, h)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    x_last = rms_norm(x[:, -1], params["final_norm"], cfg.norm_eps)
+    logits = (x_last @ params["lm_head"].T).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
+def prime_kv_cache(
+    params: dict, cfg: LlamaConfig, tokens: jax.Array, kv_cache: dict
+) -> dict:
+    """Write the prompt's K/V into the cache (prefill side-product)."""
+    _, cache = _prefill_impl(params, cfg, tokens, kv_cache)
+    return cache
+
+
+def _decode_impl(params, cfg, token, kv_cache, position):
+    """Unjitted decode body (shared by decode_step and generate_tokens)."""
+    x = params["embed"][token]
+    cos, sin = rope_frequencies(cfg, position[None])
+    rep = cfg.n_heads // cfg.n_kv_heads
+
+    def body(x, scanned):
+        layer, k_cache, v_cache = scanned
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = apply_rope(_split_heads(h @ layer["wq"], cfg.n_heads), cos, sin)
+        k = apply_rope(_split_heads(h @ layer["wk"], cfg.n_kv_heads), cos, sin)
+        v = _split_heads(h @ layer["wv"], cfg.n_kv_heads)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, position, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, position, 0))
+        attn = flash_attention(
+            q, _repeat_kv(k_cache, rep), _repeat_kv(v_cache, rep),
+            causal=True, q_offset=position, impl="xla",
+        )
+        x = x + _merge_heads(attn) @ layer["wo"]
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(layer, h)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"].T).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps"), donate_argnums=(3,))
+def generate_tokens(
+    params: dict,
+    cfg: LlamaConfig,
+    prompt: jax.Array,  # (B, S_prompt)
+    kv_cache: dict,
+    steps: int,
+) -> jax.Array:
+    """Fused generation: prefill + ``steps`` greedy decode steps in ONE
+    compiled program — a single dispatch regardless of length, which is
+    what makes decode throughput measurable (and fast) behind any
+    host↔device latency."""
+    b, s_prompt = prompt.shape
+    logits, kv_cache = _prefill_impl(params, cfg, prompt, kv_cache)
+    first = jnp.argmax(logits, axis=-1)[:, None]
+
+    def step(carry, _):
+        tok, cache, pos = carry
+        logits, cache = _decode_impl(params, cfg, tok, cache, pos)
+        nxt = jnp.argmax(logits, axis=-1)[:, None]
+        return (nxt, cache, pos + 1), tok[:, 0]
+
+    (_, _, _), toks = jax.lax.scan(
+        step,
+        (first, kv_cache, jnp.asarray(s_prompt, jnp.int32)),
+        length=steps,
+    )
+    return toks.T  # (B, steps)

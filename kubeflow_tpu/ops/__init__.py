@@ -1,0 +1,1 @@
+from kubeflow_tpu.ops.attention import flash_attention  # noqa: F401

@@ -1,0 +1,177 @@
+"""Attention ops: pallas flash attention for TPU, XLA fallback elsewhere.
+
+The hot op of the model stack (SURVEY.md has no reference counterpart — the
+reference is a control plane; this exists for the in-notebook Llama
+benchmark parity target in BASELINE.md).
+
+Design per /opt/skills/guides/pallas_guide.md:
+- online-softmax flash attention, grid over (batch*heads, q blocks),
+  K/V resident in VMEM per program (S·D·2·2 bytes ≪ 16 MB for bench
+  shapes), fori_loop over K blocks with running (m, l, o) carries —
+  no materialized S×S scores, HBM traffic stays O(S·D),
+- MXU-shaped blocks (128 lanes), f32 accumulation via
+  preferred_element_type, bf16 in/out,
+- causal masking by block: fully-unmasked blocks skip the compare entirely.
+
+Decode (q_len == 1) is bandwidth-bound over the KV cache and gains nothing
+from pallas tiling here; it uses the XLA path which fuses into two GEVMs.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+# Import guard keeps CPU-only environments importable without TPU pallas.
+try:  # pragma: no cover
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+
+BLOCK_Q = 128
+BLOCK_K = 128
+NEG_INF = -1e30
+
+
+# Pluggable implementations: the parallel layer registers e.g. "ring"
+# (sequence-parallel ring attention bound to a concrete mesh) here, so the
+# model code stays mesh-agnostic.
+_IMPL_REGISTRY: dict = {}
+
+
+def register_attention_impl(name: str, fn) -> None:
+    _IMPL_REGISTRY[name] = fn
+
+
+def flash_attention(
+    q: jax.Array,  # (B, H, Sq, D)
+    k: jax.Array,  # (B, H, Sk, D)
+    v: jax.Array,  # (B, H, Sk, D)
+    causal: bool = True,
+    q_offset: int = 0,
+    impl: str = "auto",
+) -> jax.Array:
+    """Multi-head attention. ``q_offset`` is q's global position offset
+    relative to k (for cached prefill continuation)."""
+    if impl in _IMPL_REGISTRY:
+        return _IMPL_REGISTRY[impl](q, k, v, causal=causal, q_offset=q_offset)
+    if impl == "auto":
+        impl = "pallas" if _pallas_ok(q, k) else "xla"
+    if impl == "pallas":
+        return _flash_attention_pallas(q, k, v, causal=causal, q_offset=q_offset)
+    return _attention_xla(q, k, v, causal=causal, q_offset=q_offset)
+
+
+def _pallas_ok(q: jax.Array, k: jax.Array) -> bool:
+    if pl is None or jax.default_backend() not in ("tpu", "axon"):
+        return False
+    _, _, sq, d = q.shape
+    sk = k.shape[2]
+    return sq % BLOCK_Q == 0 and sk % BLOCK_K == 0 and d % 128 == 0 and sq > 1
+
+
+# ---------------------------------------------------------------------------
+# XLA reference path (CPU tests, decode, ragged shapes)
+
+
+def _attention_xla(q, k, v, causal: bool, q_offset: int) -> jax.Array:
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        q_pos = jnp.arange(sq)[:, None] + q_offset
+        k_pos = jnp.arange(sk)[None, :]
+        scores = jnp.where(k_pos <= q_pos, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas path
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, q_offset: int,
+                  sk: int, scale: float):
+    # Block shapes: q (1, BLOCK_Q, D); k/v (1, sk, D); o (1, BLOCK_Q, D).
+    qi = pl.program_id(1)
+    q_block = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
+    d = q_block.shape[-1]
+    num_k_blocks = sk // BLOCK_K
+
+    def body(kb, carry):
+        m, l, o = carry
+        k_block = k_ref[0, pl.ds(kb * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        v_block = v_ref[0, pl.ds(kb * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        s = jnp.dot(q_block, k_block.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = (
+                jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 0)
+                + qi * BLOCK_Q
+                + q_offset
+            )
+            k_pos = (
+                jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 1)
+                + kb * BLOCK_K
+            )
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[:, None] + jnp.dot(
+            p, v_block, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, o_new
+
+    m0 = jnp.full((BLOCK_Q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((BLOCK_Q,), jnp.float32)
+    o0 = jnp.zeros((BLOCK_Q, d), jnp.float32)
+
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing; bound the
+        # loop at the diagonal block (compile-time per q-block is not
+        # possible — qi is dynamic — so bound dynamically).
+        last = jnp.minimum(
+            num_k_blocks,
+            (qi * BLOCK_Q + q_offset + BLOCK_Q + BLOCK_K - 1) // BLOCK_K,
+        )
+    else:
+        last = num_k_blocks
+    m, l, o = jax.lax.fori_loop(0, last, body, (m0, l0, o0))
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_attention_pallas(q, k, v, causal: bool, q_offset: int) -> jax.Array:
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    grid = (b * h, sq // BLOCK_Q)
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, q_offset=q_offset, sk=sk, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d)

@@ -1,0 +1,1 @@
+from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh  # noqa: F401

@@ -1,0 +1,106 @@
+"""Device mesh + sharding plan for in-notebook model work.
+
+TPU-first design (the scaling-book recipe): pick a mesh, annotate shardings
+with NamedSharding/PartitionSpec, let XLA insert the collectives, which ride
+ICI inside a slice and DCN across slices. Axes:
+
+- ``dp``  — data parallel (batch dim; gradients all-reduced over dp)
+- ``fsdp``— fully-sharded data parallel (params/optimizer sharded over it,
+            all-gathered for use; batch also sharded over it)
+- ``tp``  — tensor parallel (attention heads / MLP hidden)
+- ``sp``  — sequence/context parallel (ring attention over long sequences)
+
+The reference control plane has no counterpart (SURVEY.md §2.5: parallelism
+is "absent in reference"); this module is the in-notebook half of the
+framework's distributed story.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    dp: int = 1, fsdp: int = 1, tp: int = 1, sp: int = 1,
+    devices: Optional[list] = None,
+) -> Mesh:
+    """Build a Mesh with the canonical axis order (dp, fsdp, sp, tp).
+
+    tp is innermost so tensor-parallel collectives ride the fastest ICI
+    hops; dp is outermost so gradient all-reduces cross the slow links
+    least often.
+    """
+    devices = devices if devices is not None else jax.devices()
+    want = dp * fsdp * sp * tp
+    if want != len(devices):
+        raise ValueError(
+            f"mesh dp={dp} fsdp={fsdp} sp={sp} tp={tp} needs {want} devices, "
+            f"have {len(devices)}"
+        )
+    arr = np.array(devices).reshape(dp, fsdp, sp, tp)
+    return Mesh(arr, axis_names=("dp", "fsdp", "sp", "tp"))
+
+
+@dataclass
+class MeshPlan:
+    """A mesh plus the PartitionSpecs the model stack agrees on."""
+
+    mesh: Mesh
+
+    # -- activations -------------------------------------------------------
+    @property
+    def batch_spec(self) -> P:
+        """Activations: batch over (dp, fsdp), sequence over sp."""
+        return P(("dp", "fsdp"), "sp", None)
+
+    @property
+    def logits_spec(self) -> P:
+        return P(("dp", "fsdp"), "sp", "tp")
+
+    # -- parameters --------------------------------------------------------
+    def param_spec(self, path: tuple[str, ...], value_ndim: int) -> P:
+        """Sharding rule for a llama-family parameter by its tree path.
+
+        tp shards the head/hidden output dimension; fsdp shards the input
+        dimension (FSDP-style weight sharding). Stacked layer params carry
+        a leading (n_layers,) axis that stays unsharded (the scan axis).
+        Note: tp must divide n_kv_heads for GQA configs (e.g. tp ≤ 8 on
+        llama-2-70b) or the wk/wv shard would split a head.
+        """
+        name = "/".join(path)
+        if "embed" in name or "lm_head" in name:
+            # (vocab, dim): vocab over tp, dim over fsdp
+            return P("tp", "fsdp")
+        if any(k in name for k in ("wq", "wk", "wv", "w_gate", "w_up")):
+            # (L, dim, out): shard out over tp, dim over fsdp
+            return P(None, "fsdp", "tp")
+        if any(k in name for k in ("wo", "w_down")):
+            # (L, in, dim): in over tp, dim over fsdp
+            return P(None, "tp", "fsdp")
+        return P()  # norms/scalars replicated
+
+    def shard_params(self, params):
+        """Apply NamedShardings to a param tree (device_put)."""
+        def place(path, value):
+            spec = self.param_spec(tuple(str(p.key) for p in path), value.ndim)
+            return jax.device_put(value, NamedSharding(self.mesh, spec))
+
+        return jax.tree_util.tree_map_with_path(place, params)
+
+    def param_shardings(self, params):
+        """NamedSharding tree (for jit in/out shardings)."""
+        def spec_of(path, value):
+            return NamedSharding(
+                self.mesh,
+                self.param_spec(tuple(str(p.key) for p in path), value.ndim),
+            )
+
+        return jax.tree_util.tree_map_with_path(spec_of, params)
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.batch_spec)

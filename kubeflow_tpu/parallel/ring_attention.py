@@ -1,0 +1,103 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Long-context strategy (first-class per the framework brief): the sequence is
+sharded over ``sp``; each device holds a Q/K/V shard, computes blockwise
+attention against the K/V chunk it currently holds, and rotates K/V around
+the ring with ``ppermute`` — overlapping compute with ICI transfers and
+merging partial softmaxes with the standard log-sum-exp (flash) recursion.
+Memory per device stays O(S/sp · D) while attending over the full sequence.
+
+This is the jnp/shard_map formulation (XLA schedules the collective-compute
+overlap); a pallas RDMA variant (pallas_guide.md "Ring Collectives") can
+slot in underneath without changing the call site.
+
+Composition with the rest of the mesh: ``make_sharded_ring_attention``
+wraps the ring body in shard_map with batch over (dp, fsdp), heads over tp,
+sequence over sp — so dp/tp/sp all compose in one jitted step.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jax.Array,  # local (B, H, S_local, D)
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Blockwise ring attention. MUST run inside shard_map over axis_name."""
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32) * scale
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, step_idx):
+        m, l, o, k_cur, v_cur = carry
+        # The chunk we currently hold originated on device (my_idx - step).
+        chunk_idx = (my_idx - step_idx) % n
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = my_idx * s_local + jnp.arange(s_local)[:, None]
+            k_pos = chunk_idx * s_local + jnp.arange(s_local)[None, :]
+            s = jnp.where((k_pos <= q_pos)[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        # Rotate K/V to the next device; XLA overlaps this with the next
+        # step's einsums.
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, o_new, k_next, v_next), None
+
+    m0 = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), jnp.float32)
+    o0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+    (m, l, o, _, _), _ = jax.lax.scan(
+        step, (m0, l0, o0, k, v), jnp.arange(n)
+    )
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def make_sharded_ring_attention(mesh: Mesh):
+    """Return attention(q, k, v, causal, q_offset) jit-composable over the
+    full mesh: batch=(dp,fsdp), heads=tp, sequence=sp."""
+    spec = P(("dp", "fsdp"), "tp", "sp", None)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    def _sharded(q, k, v):
+        return ring_attention(q, k, v, axis_name="sp", causal=True)
+
+    def attention(q, k, v, causal=True, q_offset=0, impl=None):
+        if not causal:
+            raise NotImplementedError("ring attention is causal-only here")
+        return _sharded(q, k, v)
+
+    return attention

@@ -1,0 +1,5 @@
+from kubeflow_tpu.runtime.bootstrap import (  # noqa: F401
+    SliceRuntime,
+    bootstrap,
+    runtime_from_env,
+)

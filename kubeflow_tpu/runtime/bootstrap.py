@@ -1,0 +1,140 @@
+"""In-notebook TPU slice bootstrap.
+
+The consumer of the control plane's environment contract (see
+kubeflow_tpu.webhook.tpu_env): a user opens a notebook on a TPU slice and
+runs
+
+    from kubeflow_tpu.runtime import bootstrap
+    rt = bootstrap()          # jax.distributed over the slice if multi-host
+    mesh = rt.mesh(dp=2, tp=8)
+
+and gets the whole slice visible (``jax.device_count() == slice chips``, the
+north-star check) plus a ready device mesh. The controller made DNS/env
+correct; libtpu/XLA own the ICI/DCN data plane (SURVEY.md §2.5) — this
+module only wires identities together and never moves tensor bytes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class SliceRuntime:
+    """Resolved view of this host's place in the slice."""
+
+    worker_id: int
+    num_workers: int
+    worker_hostnames: list[str]
+    coordinator_address: str  # "" on single-host slices
+    accelerator_type: str
+    topology: str
+    distributed_initialized: bool = False
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_workers > 1
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.worker_id == 0
+
+    # -- mesh helpers ------------------------------------------------------
+    def mesh(self, **axis_sizes: int):
+        """Build a jax.sharding.Mesh over the whole slice.
+
+        Axis sizes must multiply to the global device count; a single axis
+        of -1 is inferred. Example: ``rt.mesh(dp=2, tp=8)`` on 16 chips.
+        """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        total = len(devices)
+        names = list(axis_sizes.keys())
+        sizes = list(axis_sizes.values())
+        if sizes.count(-1) > 1:
+            raise ValueError("at most one axis size may be -1")
+        if -1 in sizes:
+            known = 1
+            for s in sizes:
+                if s != -1:
+                    known *= s
+            if total % known != 0:
+                raise ValueError(
+                    f"cannot infer axis: {total} devices not divisible by {known}"
+                )
+            sizes[sizes.index(-1)] = total // known
+        prod = 1
+        for s in sizes:
+            prod *= s
+        if prod != total:
+            raise ValueError(
+                f"mesh axes {dict(zip(names, sizes))} multiply to {prod}, "
+                f"but the slice has {total} devices"
+            )
+        mesh_devices = np.array(devices).reshape(sizes)
+        return Mesh(mesh_devices, axis_names=tuple(names))
+
+
+def runtime_from_env(env: Optional[dict] = None) -> SliceRuntime:
+    """Parse the webhook-injected environment into a SliceRuntime."""
+    env = dict(os.environ) if env is None else env
+    hostnames_raw = env.get("TPU_WORKER_HOSTNAMES", "")
+    hostnames = [h for h in hostnames_raw.split(",") if h]
+    num = int(env.get("JAX_NUM_PROCESSES", str(max(1, len(hostnames)))))
+    return SliceRuntime(
+        worker_id=int(env.get("TPU_WORKER_ID", "0") or 0),
+        num_workers=num,
+        worker_hostnames=hostnames,
+        coordinator_address=env.get("JAX_COORDINATOR_ADDRESS", ""),
+        accelerator_type=env.get("TPU_ACCELERATOR_TYPE", ""),
+        topology=env.get("TPU_TOPOLOGY", ""),
+    )
+
+
+def bootstrap(
+    env: Optional[dict] = None,
+    expected_devices: Optional[int] = None,
+    initialize_distributed: bool = True,
+) -> SliceRuntime:
+    """Bring the slice up: jax.distributed over DCN when multi-host, then
+    sanity-check the device count.
+
+    Idempotent per process; safe to re-run in a notebook cell.
+    """
+    rt = runtime_from_env(env)
+    if rt.is_multi_host and initialize_distributed:
+        import jax
+
+        try:
+            jax.distributed.initialize(
+                coordinator_address=rt.coordinator_address,
+                num_processes=rt.num_workers,
+                process_id=rt.worker_id,
+            )
+            rt.distributed_initialized = True
+        except RuntimeError as err:
+            # Already initialized (re-run cell) — fine.
+            if "already" in str(err).lower():
+                rt.distributed_initialized = True
+            else:
+                raise
+    if expected_devices is not None:
+        import jax
+
+        actual = jax.device_count()
+        if actual != expected_devices:
+            raise RuntimeError(
+                f"slice incomplete: expected {expected_devices} devices, "
+                f"jax.device_count() == {actual}. A host may be missing "
+                "(check Notebook status.tpu.readyHosts) or "
+                "jax.distributed did not reach every worker."
+            )
+    return rt

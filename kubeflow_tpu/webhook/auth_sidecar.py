@@ -19,9 +19,9 @@ import re
 from typing import Optional
 
 from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.api.names import RBAC_PROXY_PORT
 from kubeflow_tpu.api.notebook import Notebook
 
-RBAC_PROXY_PORT = 8443
 RBAC_PROXY_CONTAINER = "kube-rbac-proxy"
 
 _QUANTITY_RE = re.compile(r"^\d+(\.\d+)?(m|k|Ki|Mi|Gi|Ti|M|G|T)?$")

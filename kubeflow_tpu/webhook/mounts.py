@@ -17,19 +17,21 @@ skips gracefully when the source object does not exist yet (the reference's
 from __future__ import annotations
 
 from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.api.names import (
+    CA_BUNDLE_CONFIGMAP,
+    ELYRA_SECRET_NAME,
+    RUNTIME_IMAGES_CONFIGMAP,
+)
 from kubeflow_tpu.api.notebook import Notebook
 from kubeflow_tpu.k8s.client import Client
 from kubeflow_tpu.k8s.errors import NotFoundError
 from kubeflow_tpu.webhook.tpu_env import remove_env, upsert_env
 
-CA_BUNDLE_CONFIGMAP = "workbench-trusted-ca-bundle"
 CA_MOUNT_PATH = "/etc/pki/tls/custom-certs"
 CA_CERT_FILE = f"{CA_MOUNT_PATH}/ca-bundle.crt"
 
-RUNTIME_IMAGES_CONFIGMAP = "pipeline-runtime-images"
 RUNTIME_IMAGES_MOUNT_PATH = "/opt/app-root/pipeline-runtimes"
 
-ELYRA_SECRET_NAME = "ds-pipeline-config"
 ELYRA_MOUNT_PATH = "/opt/app-root/runtimes"
 
 FEAST_MOUNT_PATH = "/opt/app-root/src/feast-config"

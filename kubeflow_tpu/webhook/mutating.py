@@ -247,8 +247,13 @@ class NotebookMutatingWebhook:
             obj_util.remove_annotation(nb.obj, ann.UPDATE_PENDING)
             return
         user_changed = user_template != old_template
-        if user_changed:
-            # The user changed the template deliberately — allow the rollout.
+        # An inject-auth flip is user intent too: the sidecar add/remove it
+        # causes must roll out together with the platform reconciler's
+        # SA/Service/ConfigMap changes, or the pod template would reference
+        # deleted objects after the next restart.
+        old_auth = old.get("metadata", {}).get("annotations", {}).get(ann.INJECT_AUTH)
+        new_auth = nb.annotations.get(ann.INJECT_AUTH)
+        if user_changed or old_auth != new_auth:
             obj_util.remove_annotation(nb.obj, ann.UPDATE_PENDING)
             return
         diff = first_difference(old_template, mutated_template) or "template changed"

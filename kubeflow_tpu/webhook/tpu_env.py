@@ -20,11 +20,11 @@ Contract consumed by kubeflow_tpu.runtime.bootstrap inside the notebook:
 
 from __future__ import annotations
 
+from kubeflow_tpu.api.names import JAX_COORDINATOR_PORT
 from kubeflow_tpu.api.notebook import Notebook
 from kubeflow_tpu.tpu.topology import SliceTopology
 
 POD_INDEX_LABEL = "apps.kubernetes.io/pod-index"
-JAX_COORDINATOR_PORT = 8476
 
 
 def inject_tpu_env(
@@ -53,6 +53,7 @@ def inject_tpu_env(
         {"name": "TPU_CHIPS_PER_HOST_BOUNDS", "value": topo.chip_bounds_str()},
         {"name": "TPU_HOST_BOUNDS", "value": topo.host_bounds_str()},
     ]
+    stale: set[str] = set()
     if topo.hosts > 1:
         desired += [
             {
@@ -61,11 +62,20 @@ def inject_tpu_env(
             },
             {"name": "JAX_NUM_PROCESSES", "value": str(topo.hosts)},
         ]
+    else:
+        # A topology edit that shrank the slice to one host must drop the
+        # multi-host env, or bootstrap would wait for workers that no
+        # longer exist.
+        stale |= {"JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES"}
     if nb.tpu is not None and nb.tpu.runtime_version:
         desired.append(
             {"name": "TPU_RUNTIME_VERSION", "value": nb.tpu.runtime_version}
         )
-    return upsert_env(container, desired)
+    else:
+        stale.add("TPU_RUNTIME_VERSION")
+    changed = upsert_env(container, desired)
+    changed |= remove_env(container, stale)
+    return changed
 
 
 def upsert_env(container: dict, desired: list[dict]) -> bool:

@@ -4,15 +4,23 @@ Control-plane tests are pure Python. Model/parallel tests run JAX on a
 virtual 8-device CPU mesh so multi-chip sharding is exercised without TPU
 hardware (the driver separately dry-runs the multi-chip path).
 
-The env vars must be set before jax is first imported anywhere in the test
-process, hence they live at conftest import time.
+Note: on this machine the TPU is exposed through a platform plugin that
+ignores the JAX_PLATFORMS env var, so the CPU override must go through
+jax.config before the backend initializes — hence it lives at conftest
+import time, before any test imports jax.
 """
 
 import os
 
+# Belt and braces for environments where the env vars DO work.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

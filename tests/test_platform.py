@@ -332,3 +332,84 @@ class TestReviewRegressions:
         assert env.cluster.exists("HTTPRoute", "nb-ns-nb", "my-ctrl")
         grant = env.cluster.get("ReferenceGrant", "notebook-httproute-access", "ns")
         assert grant["spec"]["from"][0]["namespace"] == "my-ctrl"
+
+
+class TestIntegrationRegressions:
+    def test_elyra_secret_decodes_s3_credentials(self):
+        from kubeflow_tpu.controller.platform import PlatformConfig
+
+        env = make_platform_env(
+            platform_config=PlatformConfig(set_pipeline_secret=True)
+        )
+        env.cluster.create(
+            {
+                "apiVersion": "datasciencepipelinesapplications.opendatahub.io/v1",
+                "kind": "DataSciencePipelinesApplication",
+                "metadata": {"name": "dspa", "namespace": "ns"},
+                "spec": {
+                    "objectStorage": {
+                        "externalStorage": {
+                            "host": "s3.example",
+                            "bucket": "b",
+                            "s3CredentialsSecret": {"secretName": "s3-creds"},
+                        }
+                    }
+                },
+            }
+        )
+        env.cluster.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Secret",
+                "metadata": {"name": "s3-creds", "namespace": "ns"},
+                "data": {
+                    "AWS_ACCESS_KEY_ID": base64.b64encode(b"my-access-key").decode(),
+                    "AWS_SECRET_ACCESS_KEY": base64.b64encode(b"my-secret").decode(),
+                },
+            }
+        )
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        secret = env.cluster.get("Secret", "ds-pipeline-config", "ns")
+        config = json.loads(secret["stringData"]["odh_dsp.json"])
+        assert config["metadata"]["cos_username"] == "my-access-key"
+        assert config["metadata"]["cos_password"] == "my-secret"
+
+    def test_runtime_images_cm_deleted_when_sources_gone(self):
+        env = make_platform_env()
+        env.cluster.create(
+            {
+                "apiVersion": "image.openshift.io/v1",
+                "kind": "ImageStream",
+                "metadata": {
+                    "name": "rt",
+                    "namespace": CENTRAL,
+                    "labels": {"opendatahub.io/runtime-image": "true"},
+                },
+                "status": {
+                    "tags": [{"tag": "l", "items": [{"dockerImageReference": "r/i@sha"}]}]
+                },
+            }
+        )
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        assert env.cluster.exists("ConfigMap", "pipeline-runtime-images", "ns")
+        env.cluster.delete("ImageStream", "rt", CENTRAL)
+        # Touch the notebook so the platform re-reconciles.
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.annotations_of(nb)["touch"] = "1"
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        assert not env.cluster.exists("ConfigMap", "pipeline-runtime-images", "ns")
+
+    def test_ctrl_netpol_admits_gateway_namespace(self):
+        env = make_platform_env()
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        np_obj = env.cluster.get("NetworkPolicy", "nb-ctrl-np", "ns")
+        selectors = [
+            p["namespaceSelector"]["matchLabels"]["kubernetes.io/metadata.name"]
+            for p in np_obj["spec"]["ingress"][0]["from"]
+        ]
+        assert CENTRAL in selectors
+        assert "openshift-ingress" in selectors

@@ -333,3 +333,34 @@ class TestFeastMount:
         env.cluster.update(fresh)
         nb, _ = primary(env)
         assert all(v["name"] != "feast-config" for v in nb.pod_spec.get("volumes", []))
+
+
+class TestReviewRegressions:
+    def test_shrinking_topology_drops_multihost_env(self):
+        """4x4 → 2x2 while stopped must remove JAX coordinator env."""
+        env = make_env(webhooks=True)
+        env.cluster.create(tpu_notebook())  # 4x4, created with lock (stopped)
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        nb["spec"]["tpu"]["topology"] = "2x2"
+        env.cluster.update(nb)
+        _, c = primary(env)
+        assert get_env_var(c, "JAX_COORDINATOR_ADDRESS") is None
+        assert get_env_var(c, "JAX_NUM_PROCESSES") is None
+        assert get_env_var(c, "TPU_TOPOLOGY")["value"] == "2x2"
+
+    def test_auth_flip_on_running_notebook_rolls_out(self):
+        """Disabling auth on a running notebook must remove the sidecar —
+        NOT park it as update-pending while the platform deletes its SA."""
+        env = make_env(webhooks=True)
+        env.cluster.create(cpu_notebook(annotations={ann.INJECT_AUTH: "true"}))
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.remove_annotation(nb, ann.STOP)  # release lock → running
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        del nb["metadata"]["annotations"][ann.INJECT_AUTH]
+        env.cluster.update(nb)
+        fresh = Notebook(env.cluster.get("Notebook", "nb", "ns"))
+        assert all(c["name"] != "kube-rbac-proxy" for c in fresh.containers)
+        assert ann.UPDATE_PENDING not in fresh.annotations
+        assert "serviceAccountName" not in fresh.pod_spec
