@@ -1,0 +1,1 @@
+"""Manager process entrypoints (the reference's two ``main.go`` files)."""

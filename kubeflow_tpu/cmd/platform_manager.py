@@ -1,0 +1,183 @@
+"""Platform (ODH-equivalent) manager entrypoint.
+
+Reference parity — components/odh-notebook-controller/main.go (374 LoC):
+- required ``--kube-rbac-proxy-image`` flag, validated before anything else
+  (main.go:149-150,172-176),
+- TLS security-profile fetch from the cluster APIServer CR with hardened
+  fallback ciphers (main.go:71-78,183-234),
+- cache transforms stripping ConfigMap/Secret payloads (main.go:95-125),
+- controller-namespace detection (main.go:127-139),
+- MLflow env config (main.go:286-289),
+- platform reconciler + mutating + validating webhook registration
+  (main.go:291-331),
+- SecurityProfileWatcher restarting the process on TLS change
+  (main.go:344-367).
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Optional
+
+from kubeflow_tpu.controller.platform import PlatformConfig, PlatformReconciler
+from kubeflow_tpu.controller.tls import (
+    SecurityProfileWatcher,
+    TLSProfile,
+    fetch_tls_profile,
+)
+from kubeflow_tpu.k8s.cache import TransformingClient
+from kubeflow_tpu.k8s.fake import FakeCluster
+from kubeflow_tpu.k8s.health import HealthChecks, ping
+from kubeflow_tpu.k8s.leader import PLATFORM_LEASE, LeaderElector
+from kubeflow_tpu.k8s.manager import FakeClock, Manager
+from kubeflow_tpu.webhook.mutating import NotebookMutatingWebhook, WebhookConfig
+from kubeflow_tpu.webhook.validating import NotebookValidatingWebhook
+
+IN_CLUSTER_NAMESPACE_FILE = "/var/run/secrets/kubernetes.io/serviceaccount/namespace"
+
+
+class FlagError(ValueError):
+    """Invalid CLI flags (the reference exits 1 — main.go:172-176)."""
+
+
+@dataclass
+class Options:
+    kube_rbac_proxy_image: str = ""
+    metrics_addr: str = ":8080"
+    probe_addr: str = ":8081"
+    webhook_port: int = 8443
+    cert_dir: str = ""
+    enable_leader_election: bool = False
+
+
+def parse_args(argv: Optional[list[str]] = None) -> Options:
+    parser = argparse.ArgumentParser(prog="platform-notebook-controller")
+    parser.add_argument("--kube-rbac-proxy-image", default="")
+    parser.add_argument("--metrics-addr", default=":8080")
+    parser.add_argument("--probe-addr", default=":8081")
+    parser.add_argument("--webhook-port", type=int, default=8443)
+    parser.add_argument("--cert-dir", default="")
+    parser.add_argument("--enable-leader-election", action="store_true")
+    ns = parser.parse_args(argv or [])
+    opts = Options(
+        kube_rbac_proxy_image=ns.kube_rbac_proxy_image,
+        metrics_addr=ns.metrics_addr,
+        probe_addr=ns.probe_addr,
+        webhook_port=ns.webhook_port,
+        cert_dir=ns.cert_dir,
+        enable_leader_election=ns.enable_leader_election,
+    )
+    # Reference main.go:172-176: the image flag is mandatory — fail fast at
+    # boot rather than inject an empty sidecar image later.
+    if not opts.kube_rbac_proxy_image:
+        raise FlagError("--kube-rbac-proxy-image is required")
+    return opts
+
+
+def detect_namespace(env: dict, namespace_file: Optional[str] = None) -> str:
+    """Controller-namespace detection (reference main.go:127-139):
+    explicit env wins, then the in-cluster serviceaccount namespace file,
+    then the development default."""
+    if env.get("K8S_NAMESPACE"):
+        return env["K8S_NAMESPACE"]
+    path = Path(namespace_file or IN_CLUSTER_NAMESPACE_FILE)
+    try:
+        text = path.read_text().strip()
+        if text:
+            return text
+    except OSError:
+        pass
+    return "opendatahub"
+
+
+@dataclass
+class PlatformBundle:
+    manager: Manager
+    options: Options
+    health: HealthChecks
+    platform_reconciler: PlatformReconciler
+    mutating_webhook: NotebookMutatingWebhook
+    validating_webhook: NotebookValidatingWebhook
+    tls_profile: TLSProfile
+    tls_watcher: SecurityProfileWatcher
+    cache_client: TransformingClient
+    elector: Optional[LeaderElector] = None
+    restart_requested: list = field(default_factory=list)
+
+    def run_until_idle(self, max_cycles: int = 200) -> int:
+        if self.elector and not self.elector.try_acquire():
+            return 0
+        return self.manager.run_until_idle(max_cycles)
+
+
+def build(
+    cluster: FakeCluster,
+    env: Optional[dict] = None,
+    argv: Optional[list[str]] = None,
+    clock: Optional[FakeClock] = None,
+    namespace_file: Optional[str] = None,
+    identity: str = "platform-controller-0",
+    on_tls_change: Optional[Callable[[TLSProfile], None]] = None,
+) -> PlatformBundle:
+    env = env or {}
+    opts = parse_args(argv if argv is not None else ["--kube-rbac-proxy-image", "x"])
+
+    namespace = detect_namespace(env, namespace_file)
+    env = {**env, "K8S_NAMESPACE": namespace}
+
+    manager = Manager(cluster, clock)
+
+    # TLS profile at boot + restart-on-change watcher.
+    tls_profile = fetch_tls_profile(cluster)
+    restart_requested: list = []
+
+    def _restart(profile: TLSProfile) -> None:
+        restart_requested.append(profile)
+        if on_tls_change:
+            on_tls_change(profile)
+
+    tls_watcher = SecurityProfileWatcher(cluster, tls_profile, _restart)
+    tls_watcher.register(manager)
+
+    # Informer-cache transform client (used for bulk reads; the reconciler
+    # keeps the raw client for payload-bearing objects, as the reference's
+    # transform allowlist does).
+    cache_client = TransformingClient(cluster)
+
+    platform_cfg = PlatformConfig.from_env(env)
+    platform = PlatformReconciler(cluster, config=platform_cfg)
+    platform.register(manager)
+
+    webhook_cfg = WebhookConfig.from_env(
+        {**env, "KUBE_RBAC_PROXY_IMAGE": opts.kube_rbac_proxy_image}
+    )
+    mutating = NotebookMutatingWebhook(cluster, config=webhook_cfg)
+    mutating.register(cluster)
+    validating = NotebookValidatingWebhook(cluster)
+    validating.register(cluster)
+
+    health = HealthChecks()
+    health.add_healthz_check("healthz", ping)
+    health.add_readyz_check("readyz", ping)
+
+    elector = None
+    if opts.enable_leader_election:
+        elector = LeaderElector(
+            cluster, PLATFORM_LEASE, namespace, identity, clock=manager.clock
+        )
+
+    return PlatformBundle(
+        manager=manager,
+        options=opts,
+        health=health,
+        platform_reconciler=platform,
+        mutating_webhook=mutating,
+        validating_webhook=validating,
+        tls_profile=tls_profile,
+        tls_watcher=tls_watcher,
+        cache_client=cache_client,
+        elector=elector,
+        restart_requested=restart_requested,
+    )

@@ -28,7 +28,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from kubeflow_tpu.ops.attention import flash_attention
+from kubeflow_tpu.ops.attention import NEG_INF, flash_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -240,7 +240,13 @@ def greedy_generate(
     max_new_tokens: int,
     kv_cache: Optional[dict] = None,
 ) -> jax.Array:
-    """Greedy decoding driver: prefill once, then stepwise decode."""
+    """Greedy decoding driver: prefill once, then stepwise decode.
+
+    A caller-provided ``kv_cache`` is DONATED to the compiled prefill/decode
+    steps (its buffers are reused in place) — the passed-in arrays are
+    invalid afterwards. Pass a fresh ``init_kv_cache(...)`` or let this
+    function allocate its own; do not reuse the argument after the call.
+    """
     b, s_prompt = prompt.shape
     max_len = s_prompt + max_new_tokens
     if kv_cache is None:
@@ -301,11 +307,38 @@ def prime_kv_cache(
     return cache
 
 
+def _gqa_decode_attention(
+    q: jax.Array,  # (B, H, 1, D)
+    k: jax.Array,  # (B, Hkv, L, D)
+    v: jax.Array,  # (B, Hkv, L, D)
+    position: jax.Array,  # scalar: q's absolute position
+) -> jax.Array:
+    """Grouped-query decode attention against the UNREPEATED KV cache.
+
+    Decode is KV-bandwidth-bound; materializing a rep-times-repeated cache
+    per step would multiply HBM traffic (and working set) by H/Hkv, which
+    is exactly what GQA exists to avoid. Instead q is folded to
+    (B, Hkv, rep, 1, D) and attends the shared cache directly.
+    """
+    b, h, sq, d = q.shape
+    hkv = k.shape[1]
+    qg = q.reshape(b, hkv, h // hkv, sq, d)
+    scale = 1.0 / math.sqrt(d)
+    scores = (
+        jnp.einsum("bgrqd,bgkd->bgrqk", qg, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+    k_pos = jnp.arange(k.shape[2])
+    scores = jnp.where(k_pos[None, None, None, None, :] <= position, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrqk,bgkd->bgrqd", probs.astype(v.dtype), v)
+    return out.reshape(b, h, sq, d)
+
+
 def _decode_impl(params, cfg, token, kv_cache, position):
     """Unjitted decode body (shared by decode_step and generate_tokens)."""
     x = params["embed"][token]
     cos, sin = rope_frequencies(cfg, position[None])
-    rep = cfg.n_heads // cfg.n_kv_heads
 
     def body(x, scanned):
         layer, k_cache, v_cache = scanned
@@ -315,10 +348,7 @@ def _decode_impl(params, cfg, token, kv_cache, position):
         v = _split_heads(h @ layer["wv"], cfg.n_kv_heads)
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, position, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, position, 0))
-        attn = flash_attention(
-            q, _repeat_kv(k_cache, rep), _repeat_kv(v_cache, rep),
-            causal=True, q_offset=position, impl="xla",
-        )
+        attn = _gqa_decode_attention(q, k_cache, v_cache, position)
         x = x + _merge_heads(attn) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         x = x + _mlp(layer, h)

@@ -21,7 +21,6 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.models.llama import LlamaConfig, forward
-from kubeflow_tpu.ops.attention import register_attention_impl
 from kubeflow_tpu.parallel.mesh import MeshPlan
 from kubeflow_tpu.parallel.ring_attention import make_sharded_ring_attention
 
@@ -57,10 +56,10 @@ def make_train_step(
     mesh = plan.mesh
     if use_ring_sp is None:
         use_ring_sp = mesh.shape.get("sp", 1) > 1
-    attn_impl = "auto"
-    if use_ring_sp:
-        register_attention_impl("ring", make_sharded_ring_attention(mesh))
-        attn_impl = "ring"
+    # Pass the mesh-bound impl as a callable: a global registry entry named
+    # "ring" would be rebound by every make_train_step call, so a step built
+    # for mesh A could silently pick up mesh B's shard_map on retrace.
+    attn_impl = make_sharded_ring_attention(mesh) if use_ring_sp else "auto"
 
     def init_state(params):
         opt_state = optimizer.init(params)

@@ -57,7 +57,12 @@ def flash_attention(
     impl: str = "auto",
 ) -> jax.Array:
     """Multi-head attention. ``q_offset`` is q's global position offset
-    relative to k (for cached prefill continuation)."""
+    relative to k (for cached prefill continuation). ``impl`` may be a
+    registered name or a callable with this same signature (mesh-bound
+    impls like ring attention are passed directly so two meshes never
+    fight over one registry name)."""
+    if callable(impl):
+        return impl(q, k, v, causal=causal, q_offset=q_offset)
     if impl in _IMPL_REGISTRY:
         return _IMPL_REGISTRY[impl](q, k, v, causal=causal, q_offset=q_offset)
     if impl == "auto":

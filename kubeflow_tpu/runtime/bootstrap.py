@@ -121,8 +121,11 @@ def bootstrap(
             )
             rt.distributed_initialized = True
         except RuntimeError as err:
-            # Already initialized (re-run cell) — fine.
-            if "already" in str(err).lower():
+            # Already initialized (re-run cell) — fine. jax raises
+            # "distributed.initialize should only be called once"; older
+            # versions said "already initialized".
+            msg = str(err).lower()
+            if "already" in msg or "only be called once" in msg:
                 rt.distributed_initialized = True
             else:
                 raise

@@ -1,0 +1,323 @@
+"""Manager entrypoint layer: flags, leader election, health, TLS, cache.
+
+Reference analog: main() wiring tests — cache transforms are unit-tested in
+the reference's odh main_test.go:27+; flag validation mirrors
+odh main.go:172-176; leader election mirrors main.go:87-94.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu import k8s
+from kubeflow_tpu.cmd import notebook_manager, platform_manager
+from kubeflow_tpu.controller import tls
+from kubeflow_tpu.k8s.cache import STRIPPED_MARK, TransformingClient, strip_payload
+from kubeflow_tpu.k8s.health import HealthChecks, HealthServer, ping
+from kubeflow_tpu.k8s.leader import LeaderElector
+from kubeflow_tpu.k8s.manager import FakeClock
+
+from tests.harness import FakeProber, tpu_notebook
+
+
+# -- flags -----------------------------------------------------------------
+
+
+def test_notebook_manager_flag_defaults():
+    opts = notebook_manager.parse_args([])
+    assert opts.metrics_addr == ":8080"
+    assert opts.probe_addr == ":8081"
+    assert not opts.enable_leader_election
+
+
+def test_notebook_manager_flags_parse():
+    opts = notebook_manager.parse_args(
+        ["--metrics-addr", ":9090", "--enable-leader-election", "--burst", "100"]
+    )
+    assert opts.metrics_addr == ":9090"
+    assert opts.enable_leader_election
+    assert opts.burst == 100
+
+
+def test_platform_manager_requires_rbac_proxy_image():
+    with pytest.raises(platform_manager.FlagError):
+        platform_manager.parse_args([])
+
+
+def test_platform_manager_flags_parse():
+    opts = platform_manager.parse_args(
+        ["--kube-rbac-proxy-image", "proxy:v1", "--webhook-port", "9443"]
+    )
+    assert opts.kube_rbac_proxy_image == "proxy:v1"
+    assert opts.webhook_port == 9443
+
+
+def test_detect_namespace_env_wins(tmp_path):
+    ns_file = tmp_path / "namespace"
+    ns_file.write_text("from-file")
+    assert (
+        platform_manager.detect_namespace({"K8S_NAMESPACE": "from-env"}, str(ns_file))
+        == "from-env"
+    )
+    assert platform_manager.detect_namespace({}, str(ns_file)) == "from-file"
+    assert (
+        platform_manager.detect_namespace({}, str(tmp_path / "absent")) == "opendatahub"
+    )
+
+
+# -- leader election -------------------------------------------------------
+
+
+def test_leader_election_acquire_and_block():
+    clock = FakeClock()
+    cluster = k8s.FakeCluster(clock=clock)
+    a = LeaderElector(cluster, "lock", "ns", "a", lease_duration=15, clock=clock)
+    b = LeaderElector(cluster, "lock", "ns", "b", lease_duration=15, clock=clock)
+    assert a.try_acquire()
+    assert a.is_leader()
+    assert not b.try_acquire()
+    assert not b.is_leader()
+    # Renewal keeps it held past the original duration.
+    clock.advance(10)
+    assert a.try_acquire()
+    clock.advance(10)
+    assert not b.try_acquire()
+
+
+def test_leader_election_expiry_takeover():
+    clock = FakeClock()
+    cluster = k8s.FakeCluster(clock=clock)
+    a = LeaderElector(cluster, "lock", "ns", "a", lease_duration=15, clock=clock)
+    b = LeaderElector(cluster, "lock", "ns", "b", lease_duration=15, clock=clock)
+    assert a.try_acquire()
+    clock.advance(20)  # a's lease expired without renewal
+    assert b.try_acquire()
+    assert b.is_leader()
+    assert not a.is_leader()
+    assert b.transitions == 1
+
+
+def test_leader_election_release_hands_off_immediately():
+    clock = FakeClock()
+    cluster = k8s.FakeCluster(clock=clock)
+    a = LeaderElector(cluster, "lock", "ns", "a", clock=clock)
+    b = LeaderElector(cluster, "lock", "ns", "b", clock=clock)
+    assert a.try_acquire()
+    a.release()
+    assert b.try_acquire()  # no wait for expiry
+
+
+# -- health ----------------------------------------------------------------
+
+
+def test_health_checks_pass_and_fail():
+    checks = HealthChecks()
+    checks.add_healthz_check("healthz", ping)
+    checks.add_readyz_check("cache", lambda: (_ for _ in ()).throw(RuntimeError("not synced")))
+    code, _ = checks.handle("/healthz")
+    assert code == 200
+    code, body = checks.handle("/readyz")
+    assert code == 500
+    assert "not synced" in json.loads(body)["cache"]
+    assert checks.handle("/nope")[0] == 404
+
+
+def test_health_server_serves_http():
+    checks = HealthChecks()
+    checks.add_healthz_check("healthz", ping)
+    checks.add_readyz_check("readyz", ping)
+    server = HealthServer(checks)
+    server.start()
+    try:
+        for path in ("/healthz", "/readyz"):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}{path}"
+            ) as resp:
+                assert resp.status == 200
+    finally:
+        server.stop()
+
+
+# -- notebook manager wiring ----------------------------------------------
+
+
+def _cluster_with_nodes():
+    clock = FakeClock()
+    cluster = k8s.FakeCluster(clock=clock)
+    k8s.add_tpu_node_pool(cluster, "tpu-v5-lite-podslice", "4x4", hosts=4, chips_per_host=4)
+    return cluster, clock
+
+
+def test_build_without_culling_env():
+    cluster, clock = _cluster_with_nodes()
+    bundle = notebook_manager.build(cluster, env={}, clock=clock)
+    assert bundle.culling_reconciler is None
+
+
+def test_build_with_culling_env():
+    cluster, clock = _cluster_with_nodes()
+    bundle = notebook_manager.build(
+        cluster,
+        env={"ENABLE_CULLING": "true", "CULL_IDLE_TIME": "30"},
+        clock=clock,
+        prober=FakeProber(),
+    )
+    assert bundle.culling_reconciler is not None
+    assert bundle.culling_reconciler.config.cull_idle_time_min == 30
+
+
+def test_manager_bundle_reconciles_notebook():
+    cluster, clock = _cluster_with_nodes()
+    bundle = notebook_manager.build(cluster, env={}, clock=clock)
+    cluster.create(tpu_notebook(name="nb1"))
+    bundle.run_until_idle()
+    sts = cluster.get("StatefulSet", "nb1", "ns")
+    assert sts["spec"]["replicas"] == 4
+
+
+def test_leader_gating_blocks_non_leader():
+    cluster, clock = _cluster_with_nodes()
+    argv = ["--enable-leader-election"]
+    leader = notebook_manager.build(
+        cluster, env={}, argv=argv, clock=clock, identity="a"
+    )
+    follower = notebook_manager.build(
+        cluster, env={}, argv=argv, clock=clock, identity="b"
+    )
+    assert leader.elector.try_acquire()
+    cluster.create(tpu_notebook(name="nb1"))
+    assert follower.run_until_idle() == 0  # not leader: no reconciles
+    assert leader.run_until_idle() > 0
+    assert cluster.exists("StatefulSet", "nb1", "ns")
+
+
+# -- platform manager wiring ----------------------------------------------
+
+
+def test_platform_build_registers_webhooks_and_reconciler():
+    cluster, clock = _cluster_with_nodes()
+    bundle = platform_manager.build(
+        cluster,
+        env={"K8S_NAMESPACE": "opendatahub"},
+        argv=["--kube-rbac-proxy-image", "proxy:v1"],
+        clock=clock,
+    )
+    assert bundle.tls_profile == tls.INTERMEDIATE  # no APIServer CR → fallback
+    nb = tpu_notebook(name="nb1")
+    created = cluster.create(nb)
+    # Mutating webhook ran on create: reconciliation lock + TPU env present.
+    assert created["metadata"]["annotations"]["kubeflow-resource-stopped"]
+    bundle.run_until_idle()
+    assert cluster.exists("NetworkPolicy", "nb1-ctrl-np", "ns")
+
+
+def test_platform_webhook_uses_flag_image():
+    cluster, clock = _cluster_with_nodes()
+    bundle = platform_manager.build(
+        cluster,
+        env={},
+        argv=["--kube-rbac-proxy-image", "proxy:v42"],
+        clock=clock,
+    )
+    assert bundle.mutating_webhook.config.rbac_proxy_image == "proxy:v42"
+
+
+# -- TLS profile -----------------------------------------------------------
+
+
+def test_tls_profile_from_apiserver_cr():
+    cluster = k8s.FakeCluster()
+    cluster.create(
+        {
+            "apiVersion": "config.openshift.io/v1",
+            "kind": "APIServer",
+            "metadata": {"name": "cluster"},
+            "spec": {"tlsSecurityProfile": {"type": "Modern"}},
+        }
+    )
+    assert tls.fetch_tls_profile(cluster) == tls.MODERN
+
+
+def test_tls_custom_profile():
+    cluster = k8s.FakeCluster()
+    cluster.create(
+        {
+            "apiVersion": "config.openshift.io/v1",
+            "kind": "APIServer",
+            "metadata": {"name": "cluster"},
+            "spec": {
+                "tlsSecurityProfile": {
+                    "type": "Custom",
+                    "custom": {
+                        "minTLSVersion": "VersionTLS13",
+                        "ciphers": ["TLS_AES_128_GCM_SHA256"],
+                    },
+                }
+            },
+        }
+    )
+    profile = tls.fetch_tls_profile(cluster)
+    assert profile.profile_type == "Custom"
+    assert profile.min_version == "VersionTLS13"
+    assert profile.ciphers == ("TLS_AES_128_GCM_SHA256",)
+
+
+def test_tls_watcher_requests_restart_on_change():
+    cluster, clock = _cluster_with_nodes()
+    bundle = platform_manager.build(
+        cluster,
+        env={},
+        argv=["--kube-rbac-proxy-image", "p"],
+        clock=clock,
+    )
+    assert bundle.tls_profile == tls.INTERMEDIATE
+    bundle.run_until_idle()
+    assert bundle.restart_requested == []
+    cluster.create(
+        {
+            "apiVersion": "config.openshift.io/v1",
+            "kind": "APIServer",
+            "metadata": {"name": "cluster"},
+            "spec": {"tlsSecurityProfile": {"type": "Modern"}},
+        }
+    )
+    bundle.run_until_idle()
+    assert bundle.restart_requested == [tls.MODERN]
+
+
+# -- cache transforms ------------------------------------------------------
+
+
+def _cm(name, labels=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": "ns", "labels": labels or {}},
+        "data": {"k": "v" * 100},
+    }
+
+
+def test_cache_strips_unrelated_configmap():
+    stripped = strip_payload(_cm("random-cm"))
+    assert "data" not in stripped
+    assert stripped["metadata"]["annotations"][STRIPPED_MARK] == "true"
+
+
+def test_cache_keeps_allowlisted_payloads():
+    assert "data" in strip_payload(_cm("odh-trusted-ca-bundle"))
+    assert "data" in strip_payload(
+        _cm("img", labels={"opendatahub.io/runtime-image": "true"})
+    )
+
+
+def test_transforming_client_round_trip():
+    cluster = k8s.FakeCluster()
+    cluster.create(_cm("random-cm"))
+    client = TransformingClient(cluster)
+    assert "data" not in client.get("ConfigMap", "random-cm", "ns")
+    assert all("data" not in o for o in client.list("ConfigMap", "ns"))
+    # Underlying store untouched (transform models the cache, not etcd).
+    assert "data" in cluster.get("ConfigMap", "random-cm", "ns")
