@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""Regenerate config/ from kubeflow_tpu.deploy (reference ci/generate_code.sh
+keeps generated artifacts in sync; tests/test_manifests.py fails on drift)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kubeflow_tpu.deploy.render import write_all  # noqa: E402
+
+if __name__ == "__main__":
+    root = Path(__file__).resolve().parent.parent
+    for path in write_all(root):
+        print(f"wrote {path.relative_to(root)}")

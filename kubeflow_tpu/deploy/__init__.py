@@ -1,0 +1,4 @@
+"""Deploy manifests: CRD, RBAC, managers, webhooks, overlays, samples."""
+
+from kubeflow_tpu.deploy.manifests import notebook_crd  # noqa: F401
+from kubeflow_tpu.deploy.render import render_all  # noqa: F401

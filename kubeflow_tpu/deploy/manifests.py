@@ -1,0 +1,527 @@
+"""Kubernetes deploy manifests, built as Python dicts and rendered to YAML.
+
+Reference parity: the reference ships static kustomize trees —
+components/notebook-controller/config/{crd/bases,default,manager,rbac,
+overlays/{standalone,kubeflow,openshift},samples} and
+components/odh-notebook-controller/config/{base,crd/external,default,
+manager,rbac,webhook,samples}. Instead of hand-maintained YAML, this module
+is the single source of truth; ``ci/generate_manifests.py`` renders it into
+``config/`` and the drift test (tests/test_manifests.py) plays the role of
+the reference's generator-drift CI check (ci/generate_code.sh).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api.notebook import GROUP, KIND, MAX_NAME_LENGTH, VERSIONS
+from kubeflow_tpu.tpu.topology import ACCELERATORS, _ALIASES
+
+PLURAL = "notebooks"
+CRD_NAME = f"{PLURAL}.{GROUP}"
+CORE_MANAGER = "notebook-controller"
+PLATFORM_MANAGER = "platform-notebook-controller"
+
+
+# ---------------------------------------------------------------------------
+# CRD
+
+
+def _tpu_spec_schema() -> dict:
+    accelerators = sorted(ACCELERATORS) + sorted(_ALIASES)
+    return {
+        "type": "object",
+        "required": ["accelerator", "topology"],
+        "properties": {
+            "accelerator": {
+                "type": "string",
+                "enum": accelerators,
+                "description": "TPU generation (canonical name or GKE alias).",
+            },
+            "topology": {
+                "type": "string",
+                "pattern": r"^\d+x\d+(x\d+)?$",
+                "description": "Chip grid, e.g. 4x4 (v5e/v6e) or 2x2x2 (v4/v5p).",
+            },
+            "runtimeVersion": {"type": "string"},
+            "spot": {"type": "boolean"},
+        },
+    }
+
+
+def _tpu_status_schema() -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "hosts": {"type": "integer"},
+            "readyHosts": {"type": "integer"},
+            "sliceHealth": {
+                "type": "string",
+                "enum": ["Healthy", "Forming", "Interrupted", "Stopped"],
+            },
+            "jaxCoordinator": {"type": "string"},
+        },
+    }
+
+
+def _notebook_schema() -> dict:
+    """openAPIV3Schema for one served version.
+
+    The reference inlines the full generated PodSpec schema
+    (config/crd/bases/kubeflow.org_notebooks.yaml); a CRD generated from Go
+    types gets that for free. Here the template keeps PodSpec as a
+    preserve-unknown passthrough — same user contract (arbitrary PodSpec),
+    no 20k-line vendored schema to drift.
+    """
+    return {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "template": {
+                        "type": "object",
+                        "properties": {
+                            "spec": {
+                                "type": "object",
+                                "x-kubernetes-preserve-unknown-fields": True,
+                            }
+                        },
+                    },
+                    "tpu": _tpu_spec_schema(),
+                },
+            },
+            "status": {
+                "type": "object",
+                "properties": {
+                    "conditions": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        },
+                    },
+                    "readyReplicas": {"type": "integer"},
+                    "containerState": {
+                        "type": "object",
+                        "x-kubernetes-preserve-unknown-fields": True,
+                    },
+                    "tpu": _tpu_status_schema(),
+                },
+            },
+        },
+    }
+
+
+def notebook_crd() -> dict:
+    """The Notebook CRD: three served versions, v1beta1 storage (the
+    conversion hub — reference api/v1beta1/notebook_conversion.go:19)."""
+    versions = []
+    for v in VERSIONS:
+        versions.append(
+            {
+                "name": v,
+                "served": True,
+                "storage": v == "v1beta1",
+                "schema": {"openAPIV3Schema": _notebook_schema()},
+                "subresources": {"status": {}},
+                "additionalPrinterColumns": [
+                    {
+                        "name": "Ready",
+                        "type": "integer",
+                        "jsonPath": ".status.readyReplicas",
+                    },
+                    {
+                        "name": "TPU",
+                        "type": "string",
+                        "jsonPath": ".spec.tpu.accelerator",
+                    },
+                    {
+                        "name": "Topology",
+                        "type": "string",
+                        "jsonPath": ".spec.tpu.topology",
+                    },
+                ],
+            }
+        )
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": CRD_NAME},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": KIND,
+                "listKind": f"{KIND}List",
+                "plural": PLURAL,
+                "singular": "notebook",
+            },
+            "scope": "Namespaced",
+            "conversion": {"strategy": "None"},
+            "versions": versions,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# RBAC
+
+
+def _rule(api_groups, resources, verbs):
+    return {"apiGroups": api_groups, "resources": resources, "verbs": verbs}
+
+
+_ALL = ["create", "delete", "get", "list", "patch", "update", "watch"]
+_READ = ["get", "list", "watch"]
+
+
+def core_cluster_role() -> dict:
+    """Upstream controller RBAC (reference
+    components/notebook-controller/config/rbac/role.yaml)."""
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": f"{CORE_MANAGER}-role"},
+        "rules": [
+            _rule([GROUP], [PLURAL], _ALL),
+            _rule([GROUP], [f"{PLURAL}/status"], ["get", "patch", "update"]),
+            _rule([GROUP], [f"{PLURAL}/finalizers"], ["update"]),
+            _rule(["apps"], ["statefulsets"], _ALL),
+            _rule([""], ["services"], _ALL),
+            _rule([""], ["pods"], _READ + ["delete"]),
+            _rule([""], ["events"], _READ + ["create", "patch"]),
+            _rule([""], ["nodes"], _READ),
+            _rule(["coordination.k8s.io"], ["leases"], _ALL),
+        ],
+    }
+
+
+def platform_cluster_role() -> dict:
+    """Platform controller RBAC (reference
+    components/odh-notebook-controller/config/rbac/role.yaml)."""
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": f"{PLATFORM_MANAGER}-role"},
+        "rules": [
+            _rule([GROUP], [PLURAL], _READ + ["patch", "update"]),
+            _rule([GROUP], [f"{PLURAL}/finalizers"], ["update"]),
+            _rule([""], ["serviceaccounts", "services", "configmaps", "secrets"], _ALL),
+            _rule(["networking.k8s.io"], ["networkpolicies"], _ALL),
+            _rule(["gateway.networking.k8s.io"], ["httproutes", "referencegrants"], _ALL),
+            _rule(["gateway.networking.k8s.io"], ["gateways"], _READ),
+            _rule(
+                ["rbac.authorization.k8s.io"],
+                ["rolebindings", "clusterrolebindings"],
+                _ALL,
+            ),
+            _rule(["image.openshift.io"], ["imagestreams"], _READ),
+            _rule(["config.openshift.io"], ["apiservers", "proxies"], _READ),
+            _rule(["oauth.openshift.io"], ["oauthclients"], _READ + ["delete"]),
+            _rule(
+                ["datasciencepipelinesapplications.opendatahub.io"],
+                ["datasciencepipelinesapplications"],
+                _READ,
+            ),
+            _rule(["coordination.k8s.io"], ["leases"], _ALL),
+            _rule([""], ["events"], ["create", "patch"]),
+        ],
+    }
+
+
+def rbac_manifests(manager: str, cluster_role: dict) -> list[dict]:
+    sa = {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": {"name": manager, "namespace": "system"},
+    }
+    crb = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": {"name": f"{manager}-rolebinding"},
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "ClusterRole",
+            "name": cluster_role["metadata"]["name"],
+        },
+        "subjects": [
+            {"kind": "ServiceAccount", "name": manager, "namespace": "system"}
+        ],
+    }
+    return [sa, cluster_role, crb]
+
+
+# ---------------------------------------------------------------------------
+# Managers
+
+
+def culler_config_map() -> dict:
+    """Culler knobs as a ConfigMap (reference
+    config/manager/manager.yaml:44-58 sources these env vars)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": f"{CORE_MANAGER}-culler-config", "namespace": "system"},
+        "data": {
+            "ENABLE_CULLING": "false",
+            "CULL_IDLE_TIME": "1440",
+            "IDLENESS_CHECK_PERIOD": "1",
+            "CLUSTER_DOMAIN": "cluster.local",
+        },
+    }
+
+
+def core_manager_deployment() -> dict:
+    """Core controller Deployment (reference config/manager/manager.yaml)."""
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": CORE_MANAGER, "namespace": "system"},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": CORE_MANAGER}},
+            "template": {
+                "metadata": {"labels": {"app": CORE_MANAGER}},
+                "spec": {
+                    "serviceAccountName": CORE_MANAGER,
+                    "containers": [
+                        {
+                            "name": "manager",
+                            "image": "kubeflow-tpu/notebook-controller:latest",
+                            "command": ["python", "-m", "kubeflow_tpu.cmd.notebook_manager"],
+                            "args": [
+                                "--metrics-addr=:8080",
+                                "--probe-addr=:8081",
+                                "--enable-leader-election",
+                            ],
+                            "envFrom": [
+                                {
+                                    "configMapRef": {
+                                        "name": f"{CORE_MANAGER}-culler-config"
+                                    }
+                                }
+                            ],
+                            "env": [
+                                {
+                                    "name": "K8S_NAMESPACE",
+                                    "valueFrom": {
+                                        "fieldRef": {"fieldPath": "metadata.namespace"}
+                                    },
+                                }
+                            ],
+                            "ports": [
+                                {"containerPort": 8080, "name": "metrics"},
+                                {"containerPort": 8081, "name": "probes"},
+                            ],
+                            "livenessProbe": {
+                                "httpGet": {"path": "/healthz", "port": 8081}
+                            },
+                            "readinessProbe": {
+                                "httpGet": {"path": "/readyz", "port": 8081}
+                            },
+                            "resources": {
+                                "requests": {"cpu": "100m", "memory": "128Mi"},
+                                "limits": {"cpu": "1", "memory": "512Mi"},
+                            },
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def platform_manager_deployment() -> dict:
+    """Platform controller Deployment with webhook server (reference odh
+    config/manager + webhook serving-cert wiring)."""
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": PLATFORM_MANAGER, "namespace": "system"},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": PLATFORM_MANAGER}},
+            "template": {
+                "metadata": {"labels": {"app": PLATFORM_MANAGER}},
+                "spec": {
+                    "serviceAccountName": PLATFORM_MANAGER,
+                    "containers": [
+                        {
+                            "name": "manager",
+                            "image": "kubeflow-tpu/platform-notebook-controller:latest",
+                            "command": ["python", "-m", "kubeflow_tpu.cmd.platform_manager"],
+                            "args": [
+                                "--kube-rbac-proxy-image=$(KUBE_RBAC_PROXY_IMAGE)",
+                                "--webhook-port=8443",
+                                "--cert-dir=/tmp/k8s-webhook-server/serving-certs",
+                                "--enable-leader-election",
+                            ],
+                            "env": [
+                                {
+                                    "name": "KUBE_RBAC_PROXY_IMAGE",
+                                    "value": "gcr.io/kubebuilder/kube-rbac-proxy:v0.16.0",
+                                },
+                                {
+                                    "name": "K8S_NAMESPACE",
+                                    "valueFrom": {
+                                        "fieldRef": {"fieldPath": "metadata.namespace"}
+                                    },
+                                },
+                            ],
+                            "ports": [
+                                {"containerPort": 8443, "name": "webhook"},
+                                {"containerPort": 8080, "name": "metrics"},
+                                {"containerPort": 8081, "name": "probes"},
+                            ],
+                            "livenessProbe": {
+                                "httpGet": {"path": "/healthz", "port": 8081}
+                            },
+                            "readinessProbe": {
+                                "httpGet": {"path": "/readyz", "port": 8081}
+                            },
+                            "volumeMounts": [
+                                {
+                                    "name": "cert",
+                                    "mountPath": "/tmp/k8s-webhook-server/serving-certs",
+                                    "readOnly": True,
+                                }
+                            ],
+                            "resources": {
+                                "requests": {"cpu": "100m", "memory": "256Mi"},
+                                "limits": {"cpu": "1", "memory": "1Gi"},
+                            },
+                        }
+                    ],
+                    "volumes": [
+                        {
+                            "name": "cert",
+                            "secret": {"secretName": "webhook-server-cert"},
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def webhook_service() -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": f"{PLATFORM_MANAGER}-webhook", "namespace": "system"},
+        "spec": {
+            "selector": {"app": PLATFORM_MANAGER},
+            "ports": [{"port": 443, "targetPort": 8443}],
+        },
+    }
+
+
+def webhook_configurations() -> list[dict]:
+    """Mutating + validating webhook registrations (reference
+    config/webhook/manifests.yaml: /mutate-notebook-v1, /validate-notebook-v1)."""
+    rule = {
+        "apiGroups": [GROUP],
+        "apiVersions": list(VERSIONS),
+        "operations": ["CREATE", "UPDATE"],
+        "resources": [PLURAL],
+    }
+    client_config = lambda path: {  # noqa: E731
+        "service": {
+            "name": f"{PLATFORM_MANAGER}-webhook",
+            "namespace": "system",
+            "path": path,
+        }
+    }
+    mutating = {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "MutatingWebhookConfiguration",
+        "metadata": {"name": f"{PLATFORM_MANAGER}-mutating"},
+        "webhooks": [
+            {
+                "name": f"mutate.{CRD_NAME}",
+                "admissionReviewVersions": ["v1"],
+                "clientConfig": client_config("/mutate-notebook-v1"),
+                "rules": [rule],
+                "sideEffects": "None",
+                "failurePolicy": "Fail",
+            }
+        ],
+    }
+    validating = {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "ValidatingWebhookConfiguration",
+        "metadata": {"name": f"{PLATFORM_MANAGER}-validating"},
+        "webhooks": [
+            {
+                "name": f"validate.{CRD_NAME}",
+                "admissionReviewVersions": ["v1"],
+                "clientConfig": client_config("/validate-notebook-v1"),
+                "rules": [rule],
+                "sideEffects": "None",
+                "failurePolicy": "Fail",
+            }
+        ],
+    }
+    return [mutating, validating]
+
+
+# ---------------------------------------------------------------------------
+# Samples
+
+
+def sample_cpu_notebook() -> dict:
+    return {
+        "apiVersion": f"{GROUP}/v1",
+        "kind": KIND,
+        "metadata": {"name": "sample-cpu-notebook", "namespace": "default"},
+        "spec": {
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "sample-cpu-notebook",
+                            "image": "jupyter-minimal:latest",
+                            "resources": {
+                                "requests": {"cpu": "500m", "memory": "1Gi"}
+                            },
+                        }
+                    ]
+                }
+            }
+        },
+    }
+
+
+def sample_tpu_notebook() -> dict:
+    """The BASELINE.json north-star shape: 4-host v5e-16 slice."""
+    return {
+        "apiVersion": f"{GROUP}/v1",
+        "kind": KIND,
+        "metadata": {
+            "name": "sample-tpu-notebook",
+            "namespace": "default",
+            "annotations": {"notebooks.opendatahub.io/inject-auth": "true"},
+        },
+        "spec": {
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "sample-tpu-notebook",
+                            "image": "jax-notebook:latest",
+                            "resources": {
+                                "requests": {"cpu": "4", "memory": "16Gi"}
+                            },
+                        }
+                    ]
+                }
+            },
+            "tpu": {"accelerator": "v5e", "topology": "4x4"},
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Name-length guard shared with the controller
+
+
+def max_notebook_name_length() -> int:
+    return MAX_NAME_LENGTH

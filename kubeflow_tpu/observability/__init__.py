@@ -1,0 +1,10 @@
+"""Observability: tracing spans (metrics live in kubeflow_tpu.metrics)."""
+
+from kubeflow_tpu.observability.tracing import (  # noqa: F401
+    InMemoryExporter,
+    Span,
+    Tracer,
+    TracerProvider,
+    get_tracer,
+    set_tracer_provider,
+)

@@ -1,0 +1,136 @@
+"""OpenTelemetry-shaped tracing for the admission path.
+
+Reference parity: the ODH mutating webhook is the only traced component —
+a lazily acquired tracer (reference components/odh-notebook-controller/
+controllers/notebook_mutating_webhook.go:74-76 ``getWebhookTracer``), one
+root span per admission with notebook/namespace/operation attributes
+(:368-373), a child span inside maybeRestartRunningNotebook (:526), and
+span events for imagestream-not-found (:912,:961). Production default is
+the no-op global provider; tests install an in-memory exporter + real
+provider (opentelemetry_test.go:26-50, wired in suite_test.go:104-108).
+
+This module reproduces that shape without an OTel dependency: a global
+``TracerProvider`` defaulting to no-op, ``set_tracer_provider`` to install
+a recording one, and ``InMemoryExporter`` collecting finished spans.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+
+@dataclass
+class Span:
+    name: str
+    attributes: dict = field(default_factory=dict)
+    events: list = field(default_factory=list)
+    parent: Optional["Span"] = None
+    start_time: float = 0.0
+    end_time: float = 0.0
+    status: str = "OK"  # OK | ERROR
+    status_message: str = ""
+
+    def set_attribute(self, key: str, value) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, attributes: Optional[dict] = None) -> None:
+        self.events.append({"name": name, "attributes": attributes or {}})
+
+    def record_error(self, err: Exception) -> None:
+        self.status = "ERROR"
+        self.status_message = str(err)
+
+
+class _NoopSpan(Span):
+    """Recording methods are no-ops; attribute writes go nowhere."""
+
+    def set_attribute(self, key: str, value) -> None:
+        pass
+
+    def add_event(self, name: str, attributes: Optional[dict] = None) -> None:
+        pass
+
+    def record_error(self, err: Exception) -> None:
+        pass
+
+
+_NOOP_SPAN = _NoopSpan(name="noop")
+
+
+class InMemoryExporter:
+    """Collects ended spans (test analog of the reference's tracetest
+    in-memory exporter)."""
+
+    def __init__(self):
+        self.spans: list[Span] = []
+
+    def export(self, span: Span) -> None:
+        self.spans.append(span)
+
+    def by_name(self, name: str) -> list[Span]:
+        return [s for s in self.spans if s.name == name]
+
+    def reset(self) -> None:
+        self.spans.clear()
+
+
+# Active-span context, shared across Tracer instances (OTel context analog:
+# the reference's child span in maybeRestartRunningNotebook parents onto the
+# admission root span even though the tracer is re-acquired lazily).
+_active_spans: list[Span] = []
+
+
+class Tracer:
+    def __init__(self, name: str, exporter: Optional[InMemoryExporter]):
+        self.name = name
+        self.exporter = exporter
+
+    @contextlib.contextmanager
+    def start_span(self, name: str, **attributes) -> Iterator[Span]:
+        if self.exporter is None:
+            yield _NOOP_SPAN
+            return
+        span = Span(
+            name=name,
+            attributes=dict(attributes),
+            parent=_active_spans[-1] if _active_spans else None,
+            start_time=time.time(),
+        )
+        _active_spans.append(span)
+        try:
+            yield span
+        except Exception as err:
+            span.record_error(err)
+            raise
+        finally:
+            span.end_time = time.time()
+            _active_spans.pop()
+            self.exporter.export(span)
+
+
+class TracerProvider:
+    """Global provider; the default exports nowhere (OTel's no-op global)."""
+
+    def __init__(self, exporter: Optional[InMemoryExporter] = None):
+        self.exporter = exporter
+
+    def get_tracer(self, name: str) -> Tracer:
+        return Tracer(name, self.exporter)
+
+
+_provider = TracerProvider()
+
+
+def set_tracer_provider(provider: TracerProvider) -> None:
+    global _provider
+    _provider = provider
+
+
+def get_tracer(name: str) -> Tracer:
+    """Lazy tracer acquisition (reference getWebhookTracer :74-76): always
+    reads the *current* global provider, so a provider installed after
+    import is picked up."""
+    return _provider.get_tracer(name)

@@ -36,6 +36,7 @@ from kubeflow_tpu.k8s import objects as obj_util
 from kubeflow_tpu.k8s.client import Client
 from kubeflow_tpu.k8s.errors import NotFoundError, WebhookDeniedError
 from kubeflow_tpu.k8s.fake import AdmissionRequest
+from kubeflow_tpu.observability.tracing import get_tracer
 from kubeflow_tpu.tpu.topology import InvalidTopologyError
 from kubeflow_tpu.webhook import mounts
 from kubeflow_tpu.webhook.auth_sidecar import (
@@ -92,37 +93,49 @@ class NotebookMutatingWebhook:
     def handle(self, req: AdmissionRequest) -> dict:
         obj = req.object
         nb = Notebook(obj)
-        user_template = copy.deepcopy(
-            obj.get("spec", {}).get("template", {}).get("spec", {})
-        )
+        # Root admission span (reference Handle :368-373: span per admission
+        # with notebook/namespace/operation attributes; lazy tracer :74-76).
+        with get_tracer("notebook-webhook").start_span(
+            "mutate-notebook",
+            notebook=nb.name,
+            namespace=nb.namespace,
+            operation=req.operation,
+        ) as span:
+            user_template = copy.deepcopy(
+                obj.get("spec", {}).get("template", {}).get("spec", {})
+            )
 
-        if req.operation == "CREATE":
-            self._inject_reconciliation_lock(nb)
+            if req.operation == "CREATE":
+                self._inject_reconciliation_lock(nb)
 
-        self._resolve_image_from_registry(nb)
-        self._inject_tpu(nb)
-        mounts.check_and_mount_ca_bundle(nb, self.client)
-        mounts.mount_runtime_images(nb, self.client)
-        if self.config.set_pipeline_secret:
-            mounts.mount_elyra_secret(nb, self.client)
-        mounts.sync_feast_mount(nb)
-        if self.config.mlflow_enabled:
-            self._handle_mlflow_env(nb)
+            self._resolve_image_from_registry(nb, span)
+            self._inject_tpu(nb)
+            mounts.check_and_mount_ca_bundle(nb, self.client)
+            mounts.mount_runtime_images(nb, self.client)
+            if self.config.set_pipeline_secret:
+                mounts.mount_elyra_secret(nb, self.client)
+            mounts.sync_feast_mount(nb)
+            if self.config.mlflow_enabled:
+                self._handle_mlflow_env(nb)
 
-        if nb.annotations.get(ann.INJECT_AUTH) == "true":
-            try:
-                inject_kube_rbac_proxy(nb, self.config.rbac_proxy_image)
-            except InvalidSidecarResources as err:
-                raise WebhookDeniedError(str(err)) from None
-        else:
-            remove_kube_rbac_proxy(nb)
+            if nb.annotations.get(ann.INJECT_AUTH) == "true":
+                try:
+                    inject_kube_rbac_proxy(nb, self.config.rbac_proxy_image)
+                except InvalidSidecarResources as err:
+                    raise WebhookDeniedError(str(err)) from None
+            else:
+                remove_kube_rbac_proxy(nb)
 
-        if self.config.inject_cluster_proxy_env:
-            self._inject_cluster_proxy_env(nb)
+            if self.config.inject_cluster_proxy_env:
+                self._inject_cluster_proxy_env(nb)
 
-        if req.operation == "UPDATE" and req.old_object is not None:
-            self._maybe_block_running_update(nb, req.old_object, user_template)
-        return obj
+            if req.operation == "UPDATE" and req.old_object is not None:
+                # Child span (reference maybeRestartRunningNotebook :526).
+                with get_tracer("notebook-webhook").start_span(
+                    "maybe-restart-running-notebook", notebook=nb.name
+                ):
+                    self._maybe_block_running_update(nb, req.old_object, user_template)
+            return obj
 
     # ------------------------------------------------------------------
     def _inject_reconciliation_lock(self, nb: Notebook) -> None:
@@ -144,7 +157,7 @@ class NotebookMutatingWebhook:
             f"{topo.accelerator_type}/{topo.topology_str}",
         )
 
-    def _resolve_image_from_registry(self, nb: Notebook) -> None:
+    def _resolve_image_from_registry(self, nb: Notebook, span=None) -> None:
         """Resolve "imagestream:tag" annotations to a digested image ref
         (reference SetContainerImageFromRegistry :865-972)."""
         selection = nb.annotations.get(ann.LAST_IMAGE_SELECTION, "")
@@ -160,6 +173,12 @@ class NotebookMutatingWebhook:
             log.warning(
                 "imagestream %s/%s not found for %s", namespace, stream_name, nb.name
             )
+            # Span event (reference :912,:961 records imagestream-not-found).
+            if span is not None:
+                span.add_event(
+                    "imagestream-not-found",
+                    {"imagestream": f"{namespace}/{stream_name}"},
+                )
             return
         image = _image_for_tag(stream, tag)
         if not image:

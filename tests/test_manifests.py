@@ -1,0 +1,145 @@
+"""Deploy manifests: schema invariants + generator drift check.
+
+Reference analog: ci/generate_code.sh fails CI when generated CRDs drift
+from the Go types; ci/kustomize.sh validates every kustomization builds.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+import yaml
+
+from kubeflow_tpu.api.notebook import VERSIONS
+from kubeflow_tpu.deploy import manifests as m
+from kubeflow_tpu.deploy.render import render_all
+from kubeflow_tpu.tpu.topology import ACCELERATORS
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_crd_serves_all_versions_with_v1beta1_storage():
+    crd = m.notebook_crd()
+    versions = {v["name"]: v for v in crd["spec"]["versions"]}
+    assert set(versions) == set(VERSIONS)
+    assert [n for n, v in versions.items() if v["storage"]] == ["v1beta1"]
+    assert all(v["served"] for v in versions.values())
+    assert all("status" in v["subresources"] for v in versions.values())
+
+
+def test_crd_tpu_schema_matches_topology_catalog():
+    crd = m.notebook_crd()
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    tpu = schema["properties"]["spec"]["properties"]["tpu"]
+    enum = tpu["properties"]["accelerator"]["enum"]
+    for name in ACCELERATORS:
+        assert name in enum
+    pattern = re.compile(tpu["properties"]["topology"]["pattern"])
+    assert pattern.match("4x4")
+    assert pattern.match("2x2x2")
+    assert not pattern.match("4x")
+    assert tpu["required"] == ["accelerator", "topology"]
+
+
+def test_crd_podspec_is_passthrough():
+    schema = m.notebook_crd()["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    template = schema["properties"]["spec"]["properties"]["template"]
+    pod_spec = template["properties"]["spec"]
+    assert pod_spec["x-kubernetes-preserve-unknown-fields"] is True
+
+
+def test_samples_validate_against_schema_essentials():
+    for sample in (m.sample_cpu_notebook(), m.sample_tpu_notebook()):
+        assert sample["kind"] == "Notebook"
+        containers = sample["spec"]["template"]["spec"]["containers"]
+        assert containers[0]["name"] == sample["metadata"]["name"]
+    tpu = m.sample_tpu_notebook()["spec"]["tpu"]
+    assert tpu["accelerator"] in ACCELERATORS
+    assert re.match(r"^\d+x\d+(x\d+)?$", tpu["topology"])
+
+
+def test_core_rbac_covers_reconciled_kinds():
+    rules = m.core_cluster_role()["rules"]
+    covered = {(g, r) for rule in rules for g in rule["apiGroups"] for r in rule["resources"]}
+    for need in [
+        ("kubeflow.org", "notebooks"),
+        ("kubeflow.org", "notebooks/status"),
+        ("apps", "statefulsets"),
+        ("", "services"),
+        ("", "pods"),
+        ("", "events"),
+        ("coordination.k8s.io", "leases"),
+    ]:
+        assert need in covered, need
+
+
+def test_platform_rbac_covers_reconciled_kinds():
+    rules = m.platform_cluster_role()["rules"]
+    covered = {(g, r) for rule in rules for g in rule["apiGroups"] for r in rule["resources"]}
+    for need in [
+        ("gateway.networking.k8s.io", "httproutes"),
+        ("gateway.networking.k8s.io", "referencegrants"),
+        ("networking.k8s.io", "networkpolicies"),
+        ("", "serviceaccounts"),
+        ("rbac.authorization.k8s.io", "clusterrolebindings"),
+        ("image.openshift.io", "imagestreams"),
+        ("config.openshift.io", "apiservers"),
+    ]:
+        assert need in covered, need
+
+
+def test_webhook_configurations_register_both_paths():
+    mutating, validating = m.webhook_configurations()
+    assert (
+        mutating["webhooks"][0]["clientConfig"]["service"]["path"]
+        == "/mutate-notebook-v1"
+    )
+    assert (
+        validating["webhooks"][0]["clientConfig"]["service"]["path"]
+        == "/validate-notebook-v1"
+    )
+    for cfg in (mutating, validating):
+        rule = cfg["webhooks"][0]["rules"][0]
+        assert set(rule["apiVersions"]) == set(VERSIONS)
+        assert rule["operations"] == ["CREATE", "UPDATE"]
+
+
+def test_platform_manager_requires_rbac_proxy_image_arg():
+    dep = m.platform_manager_deployment()
+    args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert any("--kube-rbac-proxy-image" in a for a in args)
+
+
+def test_rendered_config_tree_has_no_drift():
+    """tests-as-CI: config/ on disk must match the generator exactly
+    (reference ci/generate_code.sh drift check)."""
+    for rel, expected in render_all().items():
+        path = REPO_ROOT / rel
+        assert path.exists(), f"{rel} missing — run ci/generate_manifests.py"
+        assert path.read_text() == expected, (
+            f"{rel} drifted — run ci/generate_manifests.py"
+        )
+
+
+def test_rendered_yaml_parses_and_kustomizations_resolve():
+    files = render_all()
+    parsed: dict[str, list] = {}
+    for rel, content in files.items():
+        docs = [d for d in yaml.safe_load_all(content) if d]
+        assert docs, rel
+        parsed[rel] = docs
+    # Every kustomization resource path must exist in the tree (or be a dir
+    # containing a kustomization).
+    dirs = {str(Path(rel).parent) for rel in files}
+    for rel, docs in parsed.items():
+        for doc in docs:
+            if doc.get("kind") != "Kustomization":
+                continue
+            base = Path(rel).parent
+            for res in doc.get("resources", []):
+                target = (base / res).resolve().relative_to(REPO_ROOT.resolve())
+                assert (
+                    str(target) in {str(Path(r)) for r in files}
+                    or str(target) in dirs
+                ), f"{rel} references missing {res}"

@@ -1,0 +1,93 @@
+"""Tracing: webhook spans with an in-memory exporter.
+
+Reference analog: opentelemetry_test.go:26-50 installs an in-memory
+exporter + real provider; specs assert root-span attributes and the
+maybeRestartRunningNotebook child span.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu.observability.tracing import (
+    InMemoryExporter,
+    TracerProvider,
+    get_tracer,
+    set_tracer_provider,
+)
+
+from tests.harness import make_env, tpu_notebook
+
+
+@pytest.fixture
+def exporter():
+    exp = InMemoryExporter()
+    set_tracer_provider(TracerProvider(exp))
+    yield exp
+    set_tracer_provider(TracerProvider())  # restore no-op global
+
+
+def test_noop_provider_records_nothing():
+    tracer = get_tracer("t")
+    with tracer.start_span("s", a=1) as span:
+        span.set_attribute("b", 2)
+        span.add_event("e")
+    # No exporter installed: nothing observable, and no error.
+
+
+def test_span_records_attributes_events_and_errors(exporter):
+    tracer = get_tracer("t")
+    with pytest.raises(ValueError):
+        with tracer.start_span("outer", kind="test") as span:
+            span.add_event("evt", {"k": "v"})
+            raise ValueError("boom")
+    (span,) = exporter.by_name("outer")
+    assert span.attributes == {"kind": "test"}
+    assert span.events == [{"name": "evt", "attributes": {"k": "v"}}]
+    assert span.status == "ERROR"
+    assert "boom" in span.status_message
+
+
+def test_nested_spans_have_parents(exporter):
+    tracer = get_tracer("t")
+    with tracer.start_span("root") as root:
+        with tracer.start_span("child"):
+            pass
+    child = exporter.by_name("child")[0]
+    assert child.parent is root
+    assert exporter.by_name("root")[0].parent is None
+
+
+def test_webhook_emits_root_span_per_admission(exporter):
+    env = make_env(webhooks=True)
+    env.cluster.create(tpu_notebook(name="nb1"))
+    spans = exporter.by_name("mutate-notebook")
+    assert len(spans) == 1
+    assert spans[0].attributes["notebook"] == "nb1"
+    assert spans[0].attributes["namespace"] == "ns"
+    assert spans[0].attributes["operation"] == "CREATE"
+
+
+def test_webhook_update_emits_child_span(exporter):
+    env = make_env(webhooks=True)
+    env.cluster.create(tpu_notebook(name="nb1"))
+    env.manager.run_until_idle()
+    exporter.reset()
+    nb = env.cluster.get("Notebook", "nb1", "ns")
+    nb["metadata"]["labels"] = {"touched": "true"}
+    env.cluster.update(nb)
+    root = exporter.by_name("mutate-notebook")
+    assert root and root[0].attributes["operation"] == "UPDATE"
+    child = exporter.by_name("maybe-restart-running-notebook")
+    assert child and child[0].parent is root[0]
+
+
+def test_webhook_records_imagestream_not_found_event(exporter):
+    env = make_env(webhooks=True)
+    nb = tpu_notebook(name="nb1")
+    nb["metadata"]["annotations"] = {
+        "notebooks.opendatahub.io/last-image-selection": "missing-stream:2026a"
+    }
+    env.cluster.create(nb)
+    (span,) = exporter.by_name("mutate-notebook")
+    assert any(e["name"] == "imagestream-not-found" for e in span.events)
