@@ -204,7 +204,13 @@ class CullingReconciler(Reconciler):
             return Result(requeue_after=self._period_s() - elapsed)
 
         activities = self.prober.probe(nb, self._host_dns(nb))
-        self._update_activity(nb, activities, now)
+        if activities and not any(a.reachable for a in activities):
+            # Whole slice unobservable (partition, NetPol misconfig): never
+            # cull blind — idle and unreachable are indistinguishable. The
+            # reference bails the same way when the kernels endpoint errors
+            # (getNotebookApiKernels :277-322 returns without updating).
+            return Result(requeue_after=self._period_s())
+        self._update_activity(nb, [a for a in activities if a.reachable], now)
 
         obj = self.client.get("Notebook", nb.name, nb.namespace)
         nb = Notebook(obj)

@@ -1,0 +1,299 @@
+"""Declarative chaos experiment catalog: schema validation + execution.
+
+The reference keeps a catalog of declarative ChaosExperiment CRs
+(reference chaos/experiments/*.yaml — pod-kill, network-partition,
+deployment-scale-zero, rbac-revoke, webhook-disrupt) that CI only
+schema-validates (.github/workflows/operator_chaos_validation.yaml:63-67);
+actually running them needs a live cluster + chaos operator. Because this
+project's API server is in-process, the same catalog is *executable*: the
+runner interprets each injection type against a FakeCluster + Manager
+environment and asserts the steady-state checks recover.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable
+
+import yaml
+
+from kubeflow_tpu.api import annotations as ann
+
+EXPERIMENT_KIND = "ChaosExperiment"
+KNOWLEDGE_KIND = "KnowledgeModel"
+API_VERSION = "chaos.kubeflow.org/v1alpha1"
+
+INJECTION_TYPES = (
+    "pod-kill",
+    "network-partition",
+    "controller-outage",
+    "client-fault",
+    "webhook-error",
+)
+STEADY_STATE_CHECKS = ("sliceReady", "notCulled", "notebookCreatable")
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def load_documents(path: Path) -> list[dict]:
+    return [d for d in yaml.safe_load_all(path.read_text()) if d]
+
+
+def load_experiments(directory: Path) -> list[dict]:
+    docs = []
+    for path in sorted(directory.glob("*.yaml")):
+        docs.extend(load_documents(path))
+    return docs
+
+
+def validate_experiment(doc: dict) -> None:
+    """Schema validation (the reference CI's validation step)."""
+
+    def need(cond: bool, msg: str) -> None:
+        if not cond:
+            raise ValidationError(f"{doc.get('metadata', {}).get('name', '?')}: {msg}")
+
+    need(doc.get("apiVersion") == API_VERSION, f"apiVersion must be {API_VERSION}")
+    need(doc.get("kind") == EXPERIMENT_KIND, f"kind must be {EXPERIMENT_KIND}")
+    need(bool(doc.get("metadata", {}).get("name")), "metadata.name required")
+    spec = doc.get("spec", {})
+    need(spec.get("target", {}).get("kind") == "Notebook", "target.kind must be Notebook")
+    states = spec.get("steadyState", [])
+    need(len(states) > 0, "at least one steadyState check")
+    for st in states:
+        need(st.get("check") in STEADY_STATE_CHECKS, f"unknown check {st.get('check')}")
+    injection = spec.get("injection", {})
+    need(injection.get("type") in INJECTION_TYPES, f"unknown injection {injection.get('type')}")
+    need(bool(spec.get("hypothesis")), "hypothesis required")
+    need(
+        isinstance(spec.get("recoveryTimeoutSeconds"), int)
+        and spec["recoveryTimeoutSeconds"] > 0,
+        "recoveryTimeoutSeconds must be a positive int",
+    )
+    need(
+        spec.get("blastRadius", {}).get("scope") in ("namespace", "cluster"),
+        "blastRadius.scope must be namespace|cluster",
+    )
+
+
+def validate_knowledge(doc: dict) -> None:
+    def need(cond: bool, msg: str) -> None:
+        if not cond:
+            raise ValidationError(msg)
+
+    need(doc.get("kind") == KNOWLEDGE_KIND, f"kind must be {KNOWLEDGE_KIND}")
+    spec = doc.get("spec", {})
+    controllers = {c.get("name") for c in spec.get("controllers", [])}
+    need(
+        controllers == {"notebook-controller", "platform-notebook-controller"},
+        f"controllers must list both managers, got {controllers}",
+    )
+    for c in spec.get("controllers", []):
+        need(bool(c.get("watches")), f"{c['name']}: watches required")
+        need(bool(c.get("managedResources")), f"{c['name']}: managedResources required")
+        for r in c["managedResources"]:
+            need(bool(r.get("kind")), f"{c['name']}: managedResource without kind")
+    hooks = {w.get("path") for w in spec.get("webhooks", [])}
+    need(
+        hooks == {"/mutate-notebook-v1", "/validate-notebook-v1"},
+        f"webhooks must cover both admission paths, got {hooks}",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Execution
+
+
+@dataclass
+class ExperimentResult:
+    name: str
+    passed: bool
+    detail: str = ""
+    observations: dict = field(default_factory=dict)
+
+
+class ExperimentRunner:
+    """Executes catalog experiments against a harness environment.
+
+    The runner owns no cluster itself: callers hand it an ``env_factory``
+    producing the envtest-style environment (tests/harness.make_env shape:
+    cluster, manager, clock, kubelet, culler/prober when culling is on) and
+    a fresh environment is built per experiment — blast radius never leaks
+    across runs.
+    """
+
+    def __init__(self, env_factory: Callable[..., object], notebook_factory: Callable[..., dict]):
+        self.env_factory = env_factory
+        self.notebook_factory = notebook_factory
+        self._handlers: dict[str, Callable[[dict], ExperimentResult]] = {
+            "pod-kill": self._run_pod_kill,
+            "network-partition": self._run_network_partition,
+            "controller-outage": self._run_controller_outage,
+            "client-fault": self._run_client_fault,
+            "webhook-error": self._run_webhook_error,
+        }
+
+    def run(self, doc: dict) -> ExperimentResult:
+        validate_experiment(doc)
+        handler = self._handlers[doc["spec"]["injection"]["type"]]
+        return handler(doc)
+
+    # -- shared helpers ----------------------------------------------------
+
+    def _ready_slice(self, env, name: str = "nb") -> dict:
+        nb = self.notebook_factory(name=name)
+        env.cluster.create(nb)
+        env.manager.run_until_idle()
+        return env.cluster.get("Notebook", name, "ns")
+
+    @staticmethod
+    def _slice_ready(env, name: str = "nb") -> bool:
+        obj = env.cluster.get("Notebook", name, "ns")
+        tpu = obj.get("status", {}).get("tpu", {})
+        return tpu.get("readyHosts", 0) == tpu.get("hosts", -1) and tpu.get(
+            "sliceHealth"
+        ) == "Healthy"
+
+    # -- handlers ----------------------------------------------------------
+
+    def _run_pod_kill(self, doc: dict) -> ExperimentResult:
+        params = doc["spec"]["injection"].get("params", {})
+        ordinal = int(params.get("podOrdinal", 0))
+        env = self.env_factory()
+        self._ready_slice(env)
+        assert self._slice_ready(env), "steady state never reached"
+
+        env.cluster.delete("Pod", f"nb-{ordinal}", "ns")
+        env.manager.run_until_idle()
+        recovered = self._slice_ready(env)
+        pods = env.cluster.list("Pod", "ns")
+        return ExperimentResult(
+            doc["metadata"]["name"],
+            passed=recovered and len(pods) == 4,
+            detail="" if recovered else "slice did not return to Ready",
+            observations={"pods_after": len(pods)},
+        )
+
+    def _run_network_partition(self, doc: dict) -> ExperimentResult:
+        params = doc["spec"]["injection"].get("params", {})
+        checks = int(params.get("durationChecks", 5))
+        env = self.env_factory(culling=True, cull_idle_min=30)
+        self._ready_slice(env)
+
+        # Partition: every probe reports unreachable.
+        from kubeflow_tpu.controller.culling import HostActivity
+
+        env.prober.activities = [
+            HostActivity(host=f"h{i}", reachable=False) for i in range(4)
+        ]
+        for _ in range(checks):
+            env.manager.tick(31 * 60)  # past the idle deadline each time
+        obj = env.cluster.get("Notebook", "nb", "ns")
+        culled = ann.STOP in obj["metadata"].get("annotations", {})
+        return ExperimentResult(
+            doc["metadata"]["name"],
+            passed=not culled,
+            detail="culled an unobservable slice" if culled else "",
+        )
+
+    def _run_controller_outage(self, doc: dict) -> ExperimentResult:
+        env = self.env_factory()
+        self._ready_slice(env)
+
+        # Outage: mutate without running the manager (events queue up).
+        obj = env.cluster.get("Notebook", "nb", "ns")
+        obj["metadata"].setdefault("annotations", {})[ann.STOP] = "user-stopped"
+        env.cluster.update(obj)
+        # Controller comes back: one convergence pass.
+        env.manager.run_until_idle()
+        sts = env.cluster.get("StatefulSet", "nb", "ns")
+        stopped_ok = sts["spec"]["replicas"] == 0
+
+        obj = env.cluster.get("Notebook", "nb", "ns")
+        del obj["metadata"]["annotations"][ann.STOP]
+        env.cluster.update(obj)
+        env.manager.run_until_idle()
+        resumed_ok = self._slice_ready(env)
+        return ExperimentResult(
+            doc["metadata"]["name"],
+            passed=stopped_ok and resumed_ok,
+            detail=f"stop={'ok' if stopped_ok else 'FAIL'} resume={'ok' if resumed_ok else 'FAIL'}",
+        )
+
+    def _run_client_fault(self, doc: dict) -> ExperimentResult:
+        from kubeflow_tpu.controller.notebook import NotebookReconciler
+        from kubeflow_tpu.k8s.chaos import ChaosClient, FaultConfig
+        from kubeflow_tpu.k8s.manager import Manager
+
+        params = doc["spec"]["injection"].get("params", {})
+        env = self.env_factory()
+        # Rebuild the notebook controller on a chaos-wrapped client, driving
+        # it via a dedicated manager (the reference drives Reconcile directly
+        # against the chaos client the same way — chaos_test.go:50-152).
+        chaos = ChaosClient(env.cluster)
+        fault = chaos.add_fault(
+            FaultConfig(
+                operations=tuple(params.get("operations", ())),
+                kinds=tuple(params.get("kinds", ())),
+                error_rate=float(params.get("errorRate", 1.0)),
+            )
+        )
+        chaos_mgr = Manager(env.cluster, clock=env.clock)
+        NotebookReconciler(chaos, clock=env.clock).register(chaos_mgr)
+        env.kubelet.register(chaos_mgr)
+
+        env.cluster.create(self.notebook_factory(name="nb"))
+        chaos_mgr.run_until_idle()
+        errored = len(chaos_mgr.reconcile_errors) > 0
+        no_children = not env.cluster.exists("StatefulSet", "nb", "ns")
+
+        fault.deactivate()
+        chaos_mgr.reconcile_errors.clear()
+        chaos_mgr.tick(2)  # fire the retry backoff
+        sts_ok = env.cluster.exists("StatefulSet", "nb", "ns")
+        svc_ok = env.cluster.exists("Service", "nb", "ns")
+        return ExperimentResult(
+            doc["metadata"]["name"],
+            passed=errored and no_children and sts_ok and svc_ok,
+            detail=(
+                f"errored={errored} no_children={no_children} "
+                f"sts={sts_ok} svc={svc_ok}"
+            ),
+            observations={"injected": fault.injected_count},
+        )
+
+    def _run_webhook_error(self, doc: dict) -> ExperimentResult:
+        params = doc["spec"]["injection"].get("params", {})
+        creates = int(params.get("durationCreates", 3))
+        env = self.env_factory(webhooks=True)
+
+        # Disrupt: webhook raises on every admission.
+        def broken(req):
+            raise RuntimeError("webhook unavailable")
+
+        original = env.cluster._mutating.get("Notebook", [])
+        env.cluster._mutating["Notebook"] = [
+            type(original[0])(fn=broken, operations=("CREATE", "UPDATE"))
+        ]
+        failed = 0
+        for i in range(creates):
+            try:
+                env.cluster.create(self.notebook_factory(name=f"nb{i}"))
+            except Exception:
+                failed += 1
+        persisted = sum(
+            1 for i in range(creates) if env.cluster.exists("Notebook", f"nb{i}", "ns")
+        )
+
+        # Recover and verify fail-closed left nothing half-mutated.
+        env.cluster._mutating["Notebook"] = original
+        created = env.cluster.create(self.notebook_factory(name="nb-after"))
+        lock = created["metadata"]["annotations"].get(ann.STOP)
+        return ExperimentResult(
+            doc["metadata"]["name"],
+            passed=failed == creates and persisted == 0 and lock is not None,
+            detail=f"failed={failed}/{creates} persisted={persisted} lock={lock}",
+        )

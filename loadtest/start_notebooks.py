@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Notebook churn load test.
+
+Reference parity: loadtest/start_notebooks.py spawns N Notebook CRs + PVCs
+via kubectl to load-test the controller (reference
+components/notebook-controller/loadtest/start_notebooks.py:1-12). This
+version has two modes:
+
+- default (no cluster needed): drives N TPU notebooks through the full
+  in-process control plane (webhooks + both reconcilers + fake kubelet) and
+  reports spawn metrics — reconcile calls per notebook and wall time, the
+  in-process analog of the BASELINE.json p50-spawn north star.
+- ``--emit-yaml DIR``: writes the N Notebook CRs as YAML for ``kubectl
+  apply`` against a real cluster, like the reference does.
+
+Usage: python loadtest/start_notebooks.py [-n 50] [--tpu | --cpu]
+       python loadtest/start_notebooks.py --emit-yaml /tmp/nbs -n 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def run_inprocess(n: int, tpu: bool) -> dict:
+    from tests.harness import cpu_notebook, make_env, tpu_notebook
+    from kubeflow_tpu.k8s import add_tpu_node_pool
+
+    env = make_env(webhooks=True, platform=True)
+    if tpu:
+        # One 4-host slice pool per notebook: churn tests the control plane,
+        # not scheduler backpressure (Pending-on-full-pool has its own test).
+        for i in range(1, n):
+            add_tpu_node_pool(
+                env.cluster, "tpu-v5-lite-podslice", "4x4",
+                hosts=4, chips_per_host=4, name_prefix=f"tpu-pool{i}",
+            )
+    spawn_calls = []
+    spawn_wall = []
+    t_total = time.perf_counter()
+    for i in range(n):
+        name = f"load-{i}"
+        nb = tpu_notebook(name=name) if tpu else cpu_notebook(name=name)
+        t0 = time.perf_counter()
+        env.cluster.create(nb)
+        calls = env.manager.run_until_idle(max_cycles=500)
+        spawn_wall.append(time.perf_counter() - t0)
+        spawn_calls.append(calls)
+        obj = env.cluster.get("Notebook", name, "ns")
+        ready = obj.get("status", {}).get("readyReplicas", 0)
+        if ready < 1:
+            raise SystemExit(f"{name} never became ready (readyReplicas={ready})")
+    total = time.perf_counter() - t_total
+    if env.manager.reconcile_errors:
+        raise SystemExit(f"reconcile errors: {env.manager.reconcile_errors[:3]}")
+    return {
+        "notebooks": n,
+        "mode": "tpu-4x4" if tpu else "cpu",
+        "total_wall_s": round(total, 3),
+        "p50_spawn_wall_ms": round(statistics.median(spawn_wall) * 1e3, 2),
+        "p95_spawn_wall_ms": round(
+            sorted(spawn_wall)[max(0, int(0.95 * n) - 1)] * 1e3, 2
+        ),
+        "p50_reconcile_calls": statistics.median(spawn_calls),
+        "notebooks_per_sec": round(n / total, 1),
+    }
+
+
+def emit_yaml(n: int, tpu: bool, out_dir: Path) -> None:
+    import yaml
+
+    from kubeflow_tpu.api.notebook import TPUSpec, new_notebook
+
+    out_dir.mkdir(parents=True, exist_ok=True)
+    for i in range(n):
+        nb = new_notebook(
+            f"load-{i}",
+            "loadtest",
+            image="jax-notebook:latest" if tpu else "jupyter-minimal:latest",
+            tpu=TPUSpec("v5e", "4x4") if tpu else None,
+        )
+        (out_dir / f"load-{i}.yaml").write_text(yaml.safe_dump(nb, sort_keys=False))
+    print(f"wrote {n} Notebook CRs to {out_dir}; kubectl apply -f {out_dir}/")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-n", type=int, default=50)
+    parser.add_argument("--cpu", action="store_true", help="single-pod CPU notebooks")
+    parser.add_argument("--emit-yaml", type=Path, default=None)
+    args = parser.parse_args()
+    tpu = not args.cpu
+    if args.emit_yaml:
+        emit_yaml(args.n, tpu, args.emit_yaml)
+        return 0
+    print(json.dumps(run_inprocess(args.n, tpu)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
