@@ -1,0 +1,254 @@
+"""Mixture-of-Experts transformer (Mixtral-style) with expert parallelism.
+
+TPU-first design: expert FFN weights carry a leading experts axis sharded
+over the ``ep`` mesh axis; token routing is expressed as dense one-hot
+dispatch/combine einsums, so XLA's SPMD partitioner inserts the
+all_to_all/psum collectives itself (the scaling-book recipe: annotate
+shardings, let XLA place communication on ICI). No manual collective calls
+in the model body — the same code runs single-chip.
+
+Attention reuses the Llama building blocks; only the FFN differs: a top-k
+softmax router with a load-balancing auxiliary loss (Switch/Mixtral
+formulation: aux = E * mean(fraction_routed * mean_router_prob)).
+
+The reference has no model stack (SURVEY.md §2.5 — not an ML framework);
+this is part of the framework's in-notebook compute story.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models.llama import (
+    _merge_heads,
+    _repeat_kv,
+    _split_heads,
+    apply_rope,
+    rms_norm,
+    rope_frequencies,
+)
+from kubeflow_tpu.ops.attention import flash_attention
+from kubeflow_tpu.parallel.mesh import MeshPlan
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_hidden: int = 14336
+    n_experts: int = 8
+    top_k: int = 2
+    aux_loss_coef: float = 0.01
+    norm_eps: float = 1e-5
+    rope_theta: float = 1e6
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+MOE_CONFIGS = {
+    "mixtral-8x7b": MoEConfig(),
+    "tiny-moe": MoEConfig(
+        vocab_size=512,
+        dim=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_hidden=256,
+        n_experts=4,
+        top_k=2,
+    ),
+}
+
+
+def init_params(cfg: MoEConfig, key: jax.Array) -> dict:
+    """Stacked-layer params; expert weights carry (L, E, ...) axes."""
+    k_embed, k_head, k_layers = jax.random.split(key, 3)
+    L, E = cfg.n_layers, cfg.n_experts
+    keys = iter(jax.random.split(k_layers, 8))
+
+    def dense(k, shape):
+        scale = 1.0 / jnp.sqrt(jnp.asarray(shape[-2], jnp.float32))
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    layers = {
+        "attn_norm": jnp.ones((L, cfg.dim), cfg.dtype),
+        "mlp_norm": jnp.ones((L, cfg.dim), cfg.dtype),
+        "wq": dense(next(keys), (L, cfg.dim, cfg.n_heads * cfg.head_dim)),
+        "wk": dense(next(keys), (L, cfg.dim, cfg.n_kv_heads * cfg.head_dim)),
+        "wv": dense(next(keys), (L, cfg.dim, cfg.n_kv_heads * cfg.head_dim)),
+        "wo": dense(next(keys), (L, cfg.n_heads * cfg.head_dim, cfg.dim)),
+        # Router in f32: tiny, and logit precision decides routing.
+        "router": jax.random.normal(next(keys), (L, cfg.dim, E), jnp.float32) * 0.02,
+        "w_gate": dense(next(keys), (L, E, cfg.dim, cfg.ffn_hidden)),
+        "w_up": dense(next(keys), (L, E, cfg.dim, cfg.ffn_hidden)),
+        "w_down": dense(next(keys), (L, E, cfg.ffn_hidden, cfg.dim)),
+    }
+    return {
+        "embed": dense(k_embed, (cfg.vocab_size, cfg.dim)),
+        "final_norm": jnp.ones((cfg.dim,), cfg.dtype),
+        "lm_head": dense(k_head, (cfg.vocab_size, cfg.dim)),
+        "layers": layers,
+    }
+
+
+def moe_ffn(layer: dict, cfg: MoEConfig, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Top-k routed expert FFN. x: (B, S, D) → (out, aux_loss).
+
+    Dense one-hot dispatch: gates (B,S,E) select/weight experts; the
+    dispatch einsum produces (E,B,S,D) sharded over ep, each expert runs its
+    SwiGLU, and the combine einsum reduces back — XLA turns the E-dim
+    movement into all_to_alls when ep > 1.
+    """
+    router_logits = (x.astype(jnp.float32) @ layer["router"])  # (B,S,E)
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, cfg.top_k)  # (B,S,K)
+    # Renormalized top-k gates, scattered back to (B,S,E).
+    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    one_hot = jax.nn.one_hot(top_idx, cfg.n_experts, dtype=jnp.float32)  # (B,S,K,E)
+    gates = jnp.einsum("bsk,bske->bse", top_vals, one_hot)
+    mask = jnp.sum(one_hot, axis=2)  # (B,S,E) in {0,1}
+
+    # Load-balancing aux loss (Switch eq. 4 / Mixtral): experts should see
+    # equal token fractions with equal router mass.
+    frac_routed = jnp.mean(mask, axis=(0, 1))  # (E,)
+    mean_prob = jnp.mean(probs, axis=(0, 1))  # (E,)
+    aux = cfg.n_experts * jnp.sum(frac_routed * mean_prob)
+
+    # Dispatch → per-expert SwiGLU → combine.
+    xin = jnp.einsum("bsd,bse->ebsd", x.astype(jnp.float32), mask).astype(x.dtype)
+
+    def expert(xin_e, wg, wu, wd):
+        h = jax.nn.silu(xin_e @ wg) * (xin_e @ wu)
+        return h @ wd
+
+    out_e = jax.vmap(expert)(xin, layer["w_gate"], layer["w_up"], layer["w_down"])
+    out = jnp.einsum(
+        "ebsd,bse->bsd", out_e.astype(jnp.float32), gates
+    ).astype(x.dtype)
+    return out, aux
+
+
+def _layer_fwd(layer: dict, cfg: MoEConfig, x, cos, sin):
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = apply_rope(_split_heads(h @ layer["wq"], cfg.n_heads), cos, sin)
+    k = apply_rope(_split_heads(h @ layer["wk"], cfg.n_kv_heads), cos, sin)
+    v = _split_heads(h @ layer["wv"], cfg.n_kv_heads)
+    rep = cfg.n_heads // cfg.n_kv_heads
+    attn = flash_attention(q, _repeat_kv(k, rep), _repeat_kv(v, rep), causal=True)
+    x = x + _merge_heads(attn) @ layer["wo"]
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    ffn_out, aux = moe_ffn(layer, cfg, h)
+    return x + ffn_out, aux
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def forward(params: dict, cfg: MoEConfig, tokens: jax.Array):
+    """(logits (B,S,V) f32, mean aux loss)."""
+    x = params["embed"][tokens]
+    positions = jnp.arange(tokens.shape[1])
+    cos, sin = rope_frequencies(cfg, positions)
+
+    def body(x, layer):
+        x, aux = _layer_fwd(layer, cfg, x, cos, sin)
+        return x, aux
+
+    x, aux_per_layer = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].T).astype(jnp.float32)
+    return logits, jnp.mean(aux_per_layer)
+
+
+# ---------------------------------------------------------------------------
+# Expert-parallel training
+
+
+def moe_param_spec(path: tuple[str, ...]) -> P:
+    """Sharding rules: experts over ep; within-expert dims over fsdp/tp;
+    attention follows the llama rules."""
+    name = "/".join(path)
+    if any(k in name for k in ("w_gate", "w_up")):
+        return P(None, "ep", "fsdp", "tp")  # (L, E, dim, hidden)
+    if "w_down" in name:
+        return P(None, "ep", "tp", "fsdp")  # (L, E, hidden, dim)
+    if "router" in name:
+        return P()  # tiny; replicated
+    if "embed" in name or "lm_head" in name:
+        return P("tp", "fsdp")
+    if any(k in name for k in ("wq", "wk", "wv")):
+        return P(None, "fsdp", "tp")
+    if "wo" in name:
+        return P(None, "tp", "fsdp")
+    return P()
+
+
+def shard_moe_params(plan: MeshPlan, params: dict) -> dict:
+    def place(path, value):
+        spec = moe_param_spec(tuple(str(p.key) for p in path))
+        return jax.device_put(value, NamedSharding(plan.mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def make_moe_train_step(cfg: MoEConfig, plan: MeshPlan, optimizer=None):
+    """(init_state, step) jitted over plan.mesh with ep expert sharding."""
+    optimizer = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    mesh = plan.mesh
+
+    def loss_fn(params, tokens):
+        logits, aux = forward(params, cfg, tokens)
+        targets = tokens[:, 1:]
+        logprobs = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll) + cfg.aux_loss_coef * aux
+
+    def init_state(params):
+        return {
+            "params": params,
+            "opt_state": optimizer.init(params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def train_step(state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], tokens)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return {
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }, loss
+
+    batch_sharding = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    jitted = jax.jit(
+        train_step, in_shardings=(None, batch_sharding), donate_argnums=(0,)
+    )
+
+    def shard_state(state):
+        def place(path, value):
+            keys = tuple(str(getattr(p, "key", p)) for p in path)
+            # Optimizer moments mirror params' tree paths.
+            param_keys = tuple(k for k in keys if k not in ("params", "opt_state")
+                               and not k.isdigit() and k not in ("mu", "nu", "count"))
+            if "step" in keys or "count" in keys:
+                return jax.device_put(value, NamedSharding(mesh, P()))
+            spec = moe_param_spec(param_keys) if value.ndim else P()
+            return jax.device_put(value, NamedSharding(mesh, spec))
+
+        return jax.tree_util.tree_map_with_path(place, state)
+
+    return init_state, jitted, shard_state

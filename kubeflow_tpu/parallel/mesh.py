@@ -7,6 +7,8 @@ ICI inside a slice and DCN across slices. Axes:
 - ``dp``  — data parallel (batch dim; gradients all-reduced over dp)
 - ``fsdp``— fully-sharded data parallel (params/optimizer sharded over it,
             all-gathered for use; batch also sharded over it)
+- ``ep``  — expert parallel (MoE expert dim; token routing all_to_alls)
+- ``pp``  — pipeline parallel (layer stages; activations ppermute between)
 - ``tp``  — tensor parallel (attention heads / MLP hidden)
 - ``sp``  — sequence/context parallel (ring attention over long sequences)
 
@@ -27,23 +29,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def make_mesh(
     dp: int = 1, fsdp: int = 1, tp: int = 1, sp: int = 1,
+    pp: int = 1, ep: int = 1,
     devices: Optional[list] = None,
 ) -> Mesh:
-    """Build a Mesh with the canonical axis order (dp, fsdp, sp, tp).
+    """Build a Mesh with the canonical axis order (dp, fsdp, ep, pp, sp, tp).
 
     tp is innermost so tensor-parallel collectives ride the fastest ICI
     hops; dp is outermost so gradient all-reduces cross the slow links
-    least often.
+    least often; pp sits between — its ppermute traffic is one activation
+    per microbatch boundary, far lighter than tp/sp collectives.
     """
     devices = devices if devices is not None else jax.devices()
-    want = dp * fsdp * sp * tp
+    want = dp * fsdp * ep * pp * sp * tp
     if want != len(devices):
         raise ValueError(
-            f"mesh dp={dp} fsdp={fsdp} sp={sp} tp={tp} needs {want} devices, "
-            f"have {len(devices)}"
+            f"mesh dp={dp} fsdp={fsdp} ep={ep} pp={pp} sp={sp} tp={tp} "
+            f"needs {want} devices, have {len(devices)}"
         )
-    arr = np.array(devices).reshape(dp, fsdp, sp, tp)
-    return Mesh(arr, axis_names=("dp", "fsdp", "sp", "tp"))
+    arr = np.array(devices).reshape(dp, fsdp, ep, pp, sp, tp)
+    return Mesh(arr, axis_names=("dp", "fsdp", "ep", "pp", "sp", "tp"))
 
 
 @dataclass

@@ -1,0 +1,187 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+TPU-first design: stage parameters are sharded over ``pp`` (leading stacked
+axis), and a GPipe microbatch schedule runs inside ``shard_map`` — each step
+every stage computes its layers on its current activation, then the
+activation rotates one stage forward via ``lax.ppermute`` (a single
+neighbor-hop that rides ICI). The whole schedule is one ``lax.scan``, so XLA
+sees a static loop with no data-dependent control flow.
+
+The reference control plane has no counterpart (SURVEY.md §2.5); this is
+part of the framework's in-notebook distributed story alongside ring
+attention (sp) and FSDP/TP.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models.llama import (
+    LlamaConfig,
+    _layer_fwd,
+    rms_norm,
+    rope_frequencies,
+)
+
+
+def split_layers_into_stages(layers: dict, pp: int) -> dict:
+    """Reshape stacked layer params (L, ...) → (pp, L/pp, ...)."""
+
+    def reshape(x):
+        L = x.shape[0]
+        if L % pp:
+            raise ValueError(f"n_layers={L} not divisible by pp={pp}")
+        return x.reshape(pp, L // pp, *x.shape[1:])
+
+    return jax.tree.map(reshape, layers)
+
+
+def merge_stages_into_layers(staged: dict) -> dict:
+    """Inverse of split_layers_into_stages."""
+    return jax.tree.map(lambda x: x.reshape(-1, *x.shape[2:]), staged)
+
+
+def _pipeline_spec(mesh: Mesh):
+    """shard_map specs: stage params over pp, activations replicated."""
+    stage_spec = P("pp")
+    repl = P()
+    return stage_spec, repl
+
+
+def make_pipelined_apply(cfg: LlamaConfig, mesh: Mesh, n_micro: int):
+    """Returns apply(staged_layers, x, cos, sin) -> x, running the layer
+    stack pipelined over pp with ``n_micro`` microbatches.
+
+    x: (B, S, D) with B % n_micro == 0. Embedding / final norm / lm_head
+    stay outside (replicated) — stage 0/-1 placement of those is a
+    memory optimization, not a correctness one.
+    """
+    pp = mesh.shape["pp"]
+    stage_spec, repl = _pipeline_spec(mesh)
+
+    def stage_fn(local_layers, x, cos, sin):
+        def body(x, layer):
+            return _layer_fwd(layer, cfg, x, cos, sin, "auto"), None
+
+        x, _ = jax.lax.scan(body, x, local_layers)
+        return x
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(stage_spec, repl, repl, repl),
+        out_specs=repl,
+        check_vma=False,
+    )
+    def pipelined(staged_layers, inputs, cos, sin):
+        # staged_layers arrive as the local (1, L/pp, ...) shard.
+        local = jax.tree.map(lambda t: t[0], staged_layers)
+        idx = jax.lax.axis_index("pp")
+        n_steps = n_micro + pp - 1
+        buf = jnp.zeros_like(inputs[0])
+        collected = jnp.zeros_like(inputs)
+
+        def step(carry, t):
+            buf, collected = carry
+            # Stage 0 ingests microbatch t (clamped feed is masked out at
+            # collection time for t >= n_micro).
+            feed = inputs[jnp.clip(t, 0, n_micro - 1)]
+            buf = jnp.where(idx == 0, feed, buf)
+            y = stage_fn(local, buf, cos, sin)
+            # Last stage has microbatch t-(pp-1)'s final activation.
+            out_t = t - (pp - 1)
+            slot = jnp.clip(out_t, 0, n_micro - 1)
+            valid = (out_t >= 0) & (idx == pp - 1)
+            collected = collected.at[slot].set(
+                jnp.where(valid, y, collected[slot])
+            )
+            # Rotate activations one stage forward (ICI neighbor hop).
+            buf = jax.lax.ppermute(
+                y, "pp", [(i, (i + 1) % pp) for i in range(pp)]
+            )
+            return (buf, collected), None
+
+        (buf, collected), _ = jax.lax.scan(
+            step, (buf, collected), jnp.arange(n_steps)
+        )
+        # Only the last stage holds real outputs; replicate via masked psum.
+        return jax.lax.psum(
+            jnp.where(idx == pp - 1, collected, jnp.zeros_like(collected)), "pp"
+        )
+
+    def apply(staged_layers, x, cos, sin):
+        b, s, d = x.shape
+        if b % n_micro:
+            raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
+        inputs = x.reshape(n_micro, b // n_micro, s, d)
+        out = pipelined(staged_layers, inputs, cos, sin)
+        return out.reshape(b, s, d)
+
+    return apply
+
+
+def pipeline_forward(
+    params: dict, cfg: LlamaConfig, tokens: jax.Array, mesh: Mesh, n_micro: int
+) -> jax.Array:
+    """Full forward with the layer stack pipelined; params['layers'] must be
+    stage-stacked (pp, L/pp, ...)."""
+    apply = make_pipelined_apply(cfg, mesh, n_micro)
+    x = params["embed"][tokens]
+    positions = jnp.arange(tokens.shape[1])
+    cos, sin = rope_frequencies(cfg, positions)
+    x = apply(params["layers"], x, cos, sin)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"].T).astype(jnp.float32)
+
+
+def shard_pipeline_params(params: dict, mesh: Mesh) -> dict:
+    """Place stage-stacked layers over pp; the rest replicated."""
+
+    def place(path, value):
+        keys = "/".join(str(getattr(p, "key", p)) for p in path)
+        spec = P("pp") if keys.startswith("layers") else P()
+        return jax.device_put(value, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def make_pipeline_train_step(
+    cfg: LlamaConfig, mesh: Mesh, n_micro: int, optimizer=None
+):
+    """(init_state, step): causal-LM training with pp-pipelined layers."""
+    optimizer = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+
+    def loss_fn(params, tokens):
+        logits = pipeline_forward(params, cfg, tokens, mesh, n_micro)
+        targets = tokens[:, 1:]
+        logprobs = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    def init_state(params):
+        return {
+            "params": params,
+            "opt_state": optimizer.init(params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def train_step(state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], tokens)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return {
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }, loss
+
+    return init_state, jax.jit(train_step, donate_argnums=(0,))
