@@ -1,0 +1,3 @@
+"""Input pipeline: prefetching token loaders (native C++ + Python fallback)."""
+
+from kubeflow_tpu.data.loader import TokenLoader, write_token_file  # noqa: F401

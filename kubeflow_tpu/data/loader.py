@@ -1,0 +1,164 @@
+"""Token batch loader over the native prefetcher, with a Python fallback.
+
+The C++ loader (native/dataloader.cpp) mmaps a uint32 token corpus and
+assembles random (batch, seq) windows on a producer thread — batch assembly
+overlaps device compute so the TPU never waits on the host. The Python
+fallback implements the identical sampling (same xorshift64* stream) on
+np.memmap; both are pure functions of (corpus, batch, seq, seed), which the
+tests use to cross-check them bit-for-bit.
+
+The shared library is built on demand with g++ and cached next to the
+source; environments without a toolchain silently use the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import Iterator, Optional
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_SRC = _NATIVE_DIR / "dataloader.cpp"
+_LIB = _NATIVE_DIR / "libkftpu_dataloader.so"
+
+_MASK = (1 << 64) - 1
+
+
+def _build_native() -> Optional[Path]:
+    if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _LIB
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+             str(_SRC), "-o", str(_LIB)],
+            check=True, capture_output=True, timeout=120,
+        )
+        return _LIB
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    lib_path = _build_native()
+    if lib_path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(lib_path))
+    except OSError:
+        return None
+    lib.dl_open.restype = ctypes.c_void_p
+    lib.dl_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_int,
+    ]
+    lib.dl_num_tokens.restype = ctypes.c_long
+    lib.dl_num_tokens.argtypes = [ctypes.c_void_p]
+    lib.dl_next.restype = ctypes.c_int
+    lib.dl_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+    lib.dl_close.restype = None
+    lib.dl_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def write_token_file(path: str | Path, tokens: np.ndarray) -> Path:
+    """Persist a token corpus in the loader's format (flat uint32 LE)."""
+    path = Path(path)
+    np.asarray(tokens, dtype=np.uint32).tofile(path)
+    return path
+
+
+class _PyState:
+    """Python mirror of the C++ sampler (same xorshift64* stream)."""
+
+    def __init__(self, path: Path, batch: int, seq: int, seed: int):
+        self.tokens = np.memmap(path, dtype=np.uint32, mode="r")
+        self.batch = batch
+        self.seq = seq
+        self.rng = seed if seed else 0x9E3779B97F4A7C15
+
+    def _next_rand(self) -> int:
+        x = self.rng
+        x ^= (x >> 12)
+        x = (x ^ (x << 25)) & _MASK
+        x ^= (x >> 27)
+        self.rng = x
+        return (x * 0x2545F4914F6CDD1D) & _MASK
+
+    def next(self) -> np.ndarray:
+        max_start = self.tokens.shape[0] - self.seq
+        out = np.empty((self.batch, self.seq), np.int32)
+        for b in range(self.batch):
+            start = self._next_rand() % (max_start + 1)
+            out[b] = self.tokens[start : start + self.seq].astype(np.int32)
+        return out
+
+
+class TokenLoader:
+    """Iterator of (batch, seq) int32 arrays sampled from a token file."""
+
+    def __init__(
+        self,
+        path: str | Path,
+        batch: int,
+        seq: int,
+        seed: int = 1,
+        prefetch: int = 4,
+        force_python: bool = False,
+    ):
+        self.path = Path(path)
+        if not self.path.exists():
+            raise FileNotFoundError(self.path)
+        self.batch = batch
+        self.seq = seq
+        n_tokens = self.path.stat().st_size // 4
+        if n_tokens < seq:
+            raise ValueError(f"corpus has {n_tokens} tokens < seq={seq}")
+        self.n_tokens = n_tokens
+
+        self._lib = None if force_python else _load_native()
+        self._handle = None
+        if self._lib is not None:
+            self._handle = self._lib.dl_open(
+                str(self.path).encode(), batch, seq, seed, prefetch
+            )
+            if not self._handle:
+                self._lib = None
+        if self._lib is None:
+            self._py = _PyState(self.path, batch, seq, seed)
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
+
+    def next(self) -> np.ndarray:
+        if self._lib is not None:
+            out = np.empty((self.batch, self.seq), np.int32)
+            rc = self._lib.dl_next(
+                self._handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            )
+            if rc != 0:
+                raise RuntimeError("native loader failed")
+            return out
+        return self._py.next()
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.next()
+
+    def batches(self, n: int) -> Iterator[np.ndarray]:
+        for _ in range(n):
+            yield self.next()
+
+    def close(self) -> None:
+        if self._lib is not None and self._handle:
+            self._lib.dl_close(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "TokenLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -98,7 +98,10 @@ def shard_state(plan: MeshPlan, state: dict) -> dict:
             ("params", "opt_state", "mu", "nu")
         )
         if getattr(value, "ndim", 0) == 0:
-            return value
+            # Replicate scalars explicitly: an uncommitted scalar restored
+            # from a checkpoint lands on one device and then conflicts with
+            # the mesh-wide arrays inside jit.
+            return jax.device_put(value, NamedSharding(plan.mesh, P()))
         spec = plan.param_spec(keys, value.ndim)
         return jax.device_put(value, NamedSharding(plan.mesh, spec))
 

@@ -1,0 +1,125 @@
+"""AdmissionReview HTTP server: protocol round-trips over real HTTP."""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu import k8s
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.webhook.mutating import NotebookMutatingWebhook, WebhookConfig
+from kubeflow_tpu.webhook.server import (
+    MUTATE_PATH,
+    VALIDATE_PATH,
+    WebhookServer,
+    handle_admission_review,
+)
+from kubeflow_tpu.webhook.validating import NotebookValidatingWebhook
+
+from tests.harness import tpu_notebook
+
+
+def _review(obj, operation="CREATE", old=None, uid="uid-1"):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": uid,
+            "operation": operation,
+            "object": obj,
+            "oldObject": old,
+        },
+    }
+
+
+@pytest.fixture
+def cluster():
+    c = k8s.FakeCluster()
+    k8s.add_tpu_node_pool(c, "tpu-v5-lite-podslice", "4x4", hosts=4, chips_per_host=4)
+    return c
+
+
+def test_mutate_review_returns_patch(cluster):
+    webhook = NotebookMutatingWebhook(cluster, WebhookConfig())
+    review = handle_admission_review(
+        _review(tpu_notebook(name="nb1")), webhook.handle, None
+    )
+    resp = review["response"]
+    assert resp["allowed"] and resp["uid"] == "uid-1"
+    patch = json.loads(base64.b64decode(resp["patch"]))
+    patched = patch[0]["value"]
+    assert patched["metadata"]["annotations"][ann.STOP] == ann.RECONCILIATION_LOCK_VALUE
+    env_names = {
+        e["name"]
+        for c in patched["spec"]["template"]["spec"]["containers"]
+        for e in c.get("env", [])
+    }
+    assert "TPU_WORKER_HOSTNAMES" in env_names
+
+
+def test_validate_review_denies_topology_change(cluster):
+    validating = NotebookValidatingWebhook(cluster)
+    old = tpu_notebook(name="nb1")
+    old["status"] = {"readyReplicas": 4}
+    new = tpu_notebook(name="nb1", topology="2x4")
+    new["status"] = {"readyReplicas": 4}
+    review = handle_admission_review(
+        _review(new, operation="UPDATE", old=old), None, validating.handle
+    )
+    assert not review["response"]["allowed"]
+    assert review["response"]["status"]["code"] == 403
+
+
+def test_handler_exception_fails_closed(cluster):
+    def broken(req):
+        raise RuntimeError("boom")
+
+    review = handle_admission_review(_review(tpu_notebook()), broken, None)
+    assert not review["response"]["allowed"]
+    assert review["response"]["status"]["code"] == 500
+
+
+def test_http_round_trip_both_paths(cluster):
+    mutating = NotebookMutatingWebhook(cluster, WebhookConfig())
+    validating = NotebookValidatingWebhook(cluster)
+    server = WebhookServer(mutating.handle, validating.handle)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+
+        body = json.dumps(_review(tpu_notebook(name="nb1"))).encode()
+        req = urllib.request.Request(
+            base + MUTATE_PATH, data=body, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req) as resp:
+            out = json.loads(resp.read())
+        assert out["response"]["allowed"]
+        assert out["response"].get("patch")
+
+        req = urllib.request.Request(
+            base + VALIDATE_PATH, data=body, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req) as resp:
+            out = json.loads(resp.read())
+        assert out["response"]["allowed"]
+
+        bad = urllib.request.Request(base + "/nope", data=body)
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(bad)
+    finally:
+        server.stop()
+
+
+def test_noop_mutation_returns_no_patch(cluster):
+    """An UPDATE that the webhook doesn't change must not emit a patch."""
+    webhook = NotebookMutatingWebhook(cluster, WebhookConfig())
+    obj = tpu_notebook(name="nb1")
+    first = handle_admission_review(_review(obj), webhook.handle, None)
+    mutated = json.loads(base64.b64decode(first["response"]["patch"]))[0]["value"]
+    second = handle_admission_review(
+        _review(mutated, operation="UPDATE", old=mutated), webhook.handle, None
+    )
+    assert "patch" not in second["response"], second["response"]
