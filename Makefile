@@ -1,0 +1,63 @@
+# Dev-workflow entrypoints, mirroring the reference's per-component Makefiles
+# (reference: components/notebook-controller/Makefile,
+#  components/odh-notebook-controller/Makefile — targets test/test-chaos/
+#  manifests/deploy/run/docker-build).
+#
+# The reference runs its envtest suite twice with SET_PIPELINE_RBAC=false/true
+# (odh Makefile:116-126); `make test` does the same here.
+
+PYTHON ?= python
+IMG_NOTEBOOK ?= kubeflow-tpu/notebook-manager:latest
+IMG_PLATFORM ?= kubeflow-tpu/platform-manager:latest
+
+export JAX_PLATFORMS ?= cpu
+export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
+
+.PHONY: all test test-chaos test-e2e manifests verify-manifests run-notebook \
+	run-platform loadtest bench native lint build-images deploy dryrun help
+
+all: test
+
+help:
+	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort -u
+
+test: ## Full suite, twice: SET_PIPELINE_RBAC=false then true (reference parity)
+	SET_PIPELINE_RBAC=false $(PYTHON) -m pytest tests/ -x -q
+	SET_PIPELINE_RBAC=true $(PYTHON) -m pytest tests/ -x -q
+
+test-chaos: ## Chaos tier only (reference: make test-chaos, odh Makefile:111-114)
+	$(PYTHON) -m pytest tests/test_chaos_catalog.py tests/test_k8s_fake.py -q
+
+test-e2e: ## In-process e2e lifecycle suite (reference: e2e/ on a live cluster)
+	$(PYTHON) -m pytest tests/test_e2e.py -q
+
+manifests: ## Regenerate config/ tree (reference: make manifests / ci/generate_code.sh)
+	$(PYTHON) ci/generate_manifests.py
+
+verify-manifests: ## Fail if config/ drifted from the generators (CI gate)
+	$(PYTHON) ci/generate_manifests.py --verify
+
+run-notebook: ## Run the core lifecycle manager locally (reference: make run)
+	$(PYTHON) -m kubeflow_tpu.cmd.notebook_manager
+
+run-platform:
+	$(PYTHON) -m kubeflow_tpu.cmd.platform_manager --kube-rbac-proxy-image=$(IMG_PLATFORM)
+
+loadtest: ## Notebook churn benchmark (reference: loadtest/start_notebooks.py)
+	$(PYTHON) loadtest/start_notebooks.py -n 50
+
+bench: ## Headline TPU benchmark — one JSON line
+	$(PYTHON) bench.py
+
+dryrun: ## Multi-chip sharding compile check on a virtual 8-device mesh
+	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+native: ## Build native C++ components (data loader, slice prober)
+	$(MAKE) -C native
+
+build-images: ## Container images for both managers (reference: make docker-build)
+	docker build -f Containerfile.notebook-manager -t $(IMG_NOTEBOOK) .
+	docker build -f Containerfile.platform-manager -t $(IMG_PLATFORM) .
+
+deploy: manifests ## Apply the kustomize default overlay (reference: make deploy)
+	kubectl apply -k config/default

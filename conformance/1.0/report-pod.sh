@@ -1,0 +1,12 @@
+#!/bin/sh
+# Copy the junit report out of a conformance runner pod
+# (reference analogue: conformance/1.7/report-pod.sh).
+set -eu
+APP=$1
+NAMESPACE=${2:-kftpu-conformance}
+REPORT_DIR=${3:-/tmp/kftpu-conformance}
+
+POD=$(kubectl get pods -n "$NAMESPACE" -l "app=$APP" \
+  -o jsonpath='{.items[0].metadata.name}')
+kubectl cp "$NAMESPACE/$POD:/report/$APP.xml" "$REPORT_DIR/$APP.xml"
+echo "report: $REPORT_DIR/$APP.xml"

@@ -112,6 +112,14 @@ def build(
     culler_cfg = CullerConfig.from_env(env)
     # Reference main.go:111-123: culling controller only exists when enabled.
     if culler_cfg.enable_culling:
+        if prober is None:
+            # Native concurrent fan-out when built, Python prober otherwise;
+            # DEV mode keeps the localhost-proxy path.
+            from kubeflow_tpu.controller.prober import make_prober
+
+            prober = make_prober(
+                dev_proxy="http://localhost:8001" if culler_cfg.dev_mode else None
+            )
         culler = CullingReconciler(
             cluster,
             config=culler_cfg,

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import ctypes
 import subprocess
+import weakref
 from pathlib import Path
 from typing import Iterator, Optional
 
@@ -28,8 +29,12 @@ _MASK = (1 << 64) - 1
 
 
 def _build_native() -> Optional[Path]:
-    if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+    if _LIB.exists() and (
+        not _SRC.exists() or _LIB.stat().st_mtime >= _SRC.stat().st_mtime
+    ):
         return _LIB
+    if not _SRC.exists():
+        return None
     try:
         subprocess.run(
             ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
@@ -128,6 +133,12 @@ class TokenLoader:
                 self._lib = None
         if self._lib is None:
             self._py = _PyState(self.path, batch, seq, seed)
+        else:
+            # Reclaim the producer thread + mmap even if the user never
+            # calls close() (abandoned loaders in re-run notebook cells).
+            self._finalizer = weakref.finalize(
+                self, self._lib.dl_close, self._handle
+            )
 
     @property
     def native(self) -> bool:
@@ -154,6 +165,7 @@ class TokenLoader:
 
     def close(self) -> None:
         if self._lib is not None and self._handle:
+            self._finalizer.detach()
             self._lib.dl_close(self._handle)
             self._handle = None
 

@@ -93,8 +93,9 @@ def train_with_checkpointing(
     """Drive ``state, loss = step_fn(state, batch)`` over ``batches``,
     checkpointing per the manager's policy. Returns (state, losses).
 
-    Resumable: pass ``start_step`` = restored step + 1 and the batch
-    iterator fast-forwarded accordingly.
+    Resumable: pass ``start_step`` = the restored step (saves are labeled
+    ``start_step + 1, start_step + 2, ...``) and the batch iterator
+    fast-forwarded past the ``start_step`` batches already consumed.
     """
     losses = []
     step = start_step

@@ -1,0 +1,192 @@
+// Concurrent slice-activity prober.
+//
+// The culler must observe Jupyter activity on EVERY host of a TPU slice
+// before each idleness verdict (reference culling_controller.go:244-322
+// probes one pod; this framework generalizes to N hosts — SURVEY.md §7
+// step 5). Sequential probing makes the reconcile latency O(hosts ×
+// timeout) — a v5p-512 slice with 64 hosts and a 5s timeout could wedge a
+// reconcile for minutes when hosts are partitioned. This prober issues all
+// HTTP GETs concurrently from a thread pool, so wall time is one timeout
+// regardless of slice size.
+//
+// Plain HTTP/1.0 over raw sockets: in-cluster pod traffic, same as the
+// reference culler's http.Get. No TLS by design (NetworkPolicies scope who
+// may reach 8888).
+//
+// C ABI (ctypes, kubeflow_tpu/controller/prober.py):
+//   pr_probe(urls, n, timeout_ms, bodies, body_cap, statuses) -> 0
+//     urls:      array of n C strings "http://host:port/path"
+//     bodies:    n * body_cap char buffer; body i at offset i*body_cap,
+//                NUL-terminated, truncated to body_cap-1
+//     statuses:  per-URL HTTP status, or -1 connect/timeout, -2 bad URL
+//
+// Determinism/safety: no globals, no signals; each probe owns its socket.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <netdb.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <unistd.h>
+#include <fcntl.h>
+
+namespace {
+
+struct Url {
+  std::string host;
+  std::string port;
+  std::string path;
+};
+
+bool parse_url(const char* raw, Url* out) {
+  std::string s(raw);
+  const std::string scheme = "http://";
+  if (s.rfind(scheme, 0) != 0) return false;
+  s = s.substr(scheme.size());
+  size_t slash = s.find('/');
+  std::string hostport = slash == std::string::npos ? s : s.substr(0, slash);
+  out->path = slash == std::string::npos ? "/" : s.substr(slash);
+  size_t colon = hostport.rfind(':');
+  if (colon == std::string::npos) {
+    out->host = hostport;
+    out->port = "80";
+  } else {
+    out->host = hostport.substr(0, colon);
+    out->port = hostport.substr(colon + 1);
+  }
+  return !out->host.empty();
+}
+
+// Connect with a deadline; returns fd or -1.
+int connect_deadline(const Url& u, int timeout_ms) {
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(u.host.c_str(), u.port.c_str(), &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    fcntl(fd, F_SETFL, fcntl(fd, F_GETFL) | O_NONBLOCK);
+    int rc = connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (rc == 0) break;
+    if (errno == EINPROGRESS) {
+      pollfd pfd{fd, POLLOUT, 0};
+      if (poll(&pfd, 1, timeout_ms) == 1 && (pfd.revents & POLLOUT)) {
+        int err = 0;
+        socklen_t len = sizeof(err);
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+        if (err == 0) break;
+      }
+    }
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+// Read until EOF or deadline; appends to buf.
+bool read_all(int fd, int timeout_ms, std::string* buf) {
+  char chunk[4096];
+  for (;;) {
+    pollfd pfd{fd, POLLIN, 0};
+    int pr = poll(&pfd, 1, timeout_ms);
+    if (pr <= 0) return false;  // timeout or error
+    ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      return false;
+    }
+    if (n == 0) return true;  // orderly EOF
+    buf->append(chunk, static_cast<size_t>(n));
+    if (buf->size() > (16u << 20)) return true;  // 16 MiB safety cap
+  }
+}
+
+// One probe: returns HTTP status (>0), -1 network failure, -2 bad URL.
+int probe_one(const char* raw_url, int timeout_ms, char* body_out,
+              int body_cap) {
+  if (body_cap > 0) body_out[0] = '\0';
+  Url u;
+  if (!parse_url(raw_url, &u)) return -2;
+  int fd = connect_deadline(u, timeout_ms);
+  if (fd < 0) return -1;
+
+  std::string req = "GET " + u.path + " HTTP/1.0\r\nHost: " + u.host +
+                    "\r\nConnection: close\r\n\r\n";
+  size_t sent = 0;
+  while (sent < req.size()) {
+    pollfd pfd{fd, POLLOUT, 0};
+    if (poll(&pfd, 1, timeout_ms) <= 0) { close(fd); return -1; }
+    ssize_t n = send(fd, req.data() + sent, req.size() - sent, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      close(fd);
+      return -1;
+    }
+    sent += static_cast<size_t>(n);
+  }
+
+  std::string resp;
+  bool ok = read_all(fd, timeout_ms, &resp);
+  close(fd);
+  if (!ok && resp.empty()) return -1;
+
+  // "HTTP/1.x NNN ..."
+  int status = -1;
+  size_t sp = resp.find(' ');
+  if (sp != std::string::npos && resp.size() >= sp + 4)
+    status = std::atoi(resp.c_str() + sp + 1);
+  if (status <= 0) return -1;
+
+  size_t body_at = resp.find("\r\n\r\n");
+  if (body_at != std::string::npos && body_cap > 0) {
+    size_t n = resp.size() - (body_at + 4);
+    if (n > static_cast<size_t>(body_cap - 1)) n = body_cap - 1;
+    std::memcpy(body_out, resp.data() + body_at + 4, n);
+    body_out[n] = '\0';
+  }
+  return status;
+}
+
+}  // namespace
+
+extern "C" {
+
+int pr_probe(const char** urls, int n, int timeout_ms, char* bodies,
+             int body_cap, int* statuses) {
+  if (n <= 0) return 0;
+  if (!urls || !bodies || !statuses || body_cap <= 0 || timeout_ms <= 0)
+    return -1;
+  // One thread per URL, capped: slice host counts are ≤ 64 for v5p-512 and
+  // probes are poll-bound, so a flat pool is simpler than an event loop.
+  const int max_threads = 64;
+  std::vector<std::thread> pool;
+  std::atomic<int> next{0};
+  int workers = n < max_threads ? n : max_threads;
+  for (int w = 0; w < workers; ++w) {
+    pool.emplace_back([&]() {
+      for (;;) {
+        int i = next.fetch_add(1);
+        if (i >= n) return;
+        statuses[i] = probe_one(urls[i], timeout_ms,
+                                bodies + static_cast<size_t>(i) * body_cap,
+                                body_cap);
+      }
+    });
+  }
+  for (auto& t : pool) t.join();
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Pin release image tags into the generated manifests.
+
+Reference analogue: releasing/update-manifests-images — the reference
+edits kustomize image overrides; here the config/ tree is generated, so
+this edits the single source of truth (the generator defaults in
+kubeflow_tpu/deploy/manifests.py) and re-renders.
+
+Usage: python releasing/update_manifests_images.py v0.2.0
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+GENERATOR = REPO / "kubeflow_tpu" / "deploy" / "manifests.py"
+MANAGED_IMAGES = (
+    "kubeflow-tpu/notebook-controller",
+    "kubeflow-tpu/platform-notebook-controller",
+)
+
+
+def main() -> int:
+    if len(sys.argv) != 2 or not re.fullmatch(r"v\d+\.\d+\.\d+", sys.argv[1]):
+        print(__doc__)
+        return 2
+    tag = sys.argv[1]
+    src = GENERATOR.read_text()
+    for image in MANAGED_IMAGES:
+        pattern = re.escape(image) + r":[A-Za-z0-9._-]+"
+        if not re.search(pattern, src):
+            print(f"ERROR: {image} not found in {GENERATOR}")
+            return 1
+        src = re.sub(pattern, f"{image}:{tag}", src)
+    GENERATOR.write_text(src)
+    subprocess.run([sys.executable, str(REPO / "ci" / "generate_manifests.py")], check=True)
+    version_file = REPO / "releasing" / "version" / "VERSION"
+    version_file.write_text(tag.lstrip("v") + "\n")
+    print(f"pinned {', '.join(MANAGED_IMAGES)} to {tag} and re-rendered config/")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
