@@ -56,15 +56,17 @@ def run_decode_bench(cfg_name: str, prompt_len: int, steps: int, cache_len: int)
     )
 
     def timed_generate(n_steps: int) -> float:
-        cache = L.init_kv_cache(cfg, 1, cache_len)
-        # Warm up / compile this (cfg, steps) program.
-        toks = L.generate_tokens(params, cfg, prompt, cache, steps=n_steps)
+        # Warm up / compile this (cfg, steps) program. The KV cache is
+        # allocated INSIDE the compiled program (models.llama.generate), so
+        # no donation is needed and XLA picks the cache layout freely.
+        toks = L.generate(params, cfg, prompt, steps=n_steps, cache_len=cache_len)
         int(toks[0, -1])  # host readback = real sync
         times = []
         for _ in range(3):
-            cache = L.init_kv_cache(cfg, 1, cache_len)
             t0 = time.perf_counter()
-            toks = L.generate_tokens(params, cfg, prompt, cache, steps=n_steps)
+            toks = L.generate(
+                params, cfg, prompt, steps=n_steps, cache_len=cache_len
+            )
             int(toks[0, -1])
             times.append(time.perf_counter() - t0)
         return min(times)

@@ -374,6 +374,27 @@ def generate_tokens(
     compiled program — a single dispatch regardless of length, which is
     what makes decode throughput measurable (and fast) behind any
     host↔device latency."""
+    return _generate_impl(params, cfg, prompt, kv_cache, steps)
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "cache_len"))
+def generate(
+    params: dict,
+    cfg: LlamaConfig,
+    prompt: jax.Array,  # (B, S_prompt)
+    steps: int,
+    cache_len: int,
+) -> jax.Array:
+    """Fused generation that allocates its KV cache INSIDE the compiled
+    program. Preferred over generate_tokens for fresh generations: the
+    cache never exists as a host-visible buffer, so there is nothing to
+    donate (and no donation-layout mismatch) — XLA places the zeros
+    directly in the layout the scan wants."""
+    cache = init_kv_cache(cfg, prompt.shape[0], cache_len)
+    return _generate_impl(params, cfg, prompt, cache, steps)
+
+
+def _generate_impl(params, cfg, prompt, kv_cache, steps):
     b, s_prompt = prompt.shape
     logits, kv_cache = _prefill_impl(params, cfg, prompt, kv_cache)
     first = jnp.argmax(logits, axis=-1)[:, None]
