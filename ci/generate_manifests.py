@@ -1,15 +1,44 @@
 #!/usr/bin/env python
 """Regenerate config/ from kubeflow_tpu.deploy (reference ci/generate_code.sh
-keeps generated artifacts in sync; tests/test_manifests.py fails on drift)."""
+keeps generated artifacts in sync; tests/test_manifests.py fails on drift).
+
+``--verify`` checks the committed tree against the generators WITHOUT
+writing anything, and exits 1 listing any stale/missing files — the drift
+gate used by CI and ``make verify-manifests``.
+"""
 
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from kubeflow_tpu.deploy.render import write_all  # noqa: E402
+from kubeflow_tpu.deploy.render import render_all, write_all  # noqa: E402
+
+
+def verify(root: Path) -> int:
+    stale = []
+    for rel, content in render_all().items():
+        path = root / rel
+        if not path.exists():
+            stale.append(f"missing: {rel}")
+        elif path.read_text() != content:
+            stale.append(f"drifted: {rel}")
+    if stale:
+        for line in stale:
+            print(line, file=sys.stderr)
+        print(
+            f"{len(stale)} generated file(s) out of sync; "
+            "run `python ci/generate_manifests.py` and commit the result",
+            file=sys.stderr,
+        )
+        return 1
+    print("config/ is in sync with kubeflow_tpu.deploy generators")
+    return 0
+
 
 if __name__ == "__main__":
     root = Path(__file__).resolve().parent.parent
+    if "--verify" in sys.argv[1:]:
+        sys.exit(verify(root))
     for path in write_all(root):
         print(f"wrote {path.relative_to(root)}")

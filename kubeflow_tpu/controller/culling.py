@@ -111,24 +111,9 @@ class JupyterHTTPProber:
                 if self.dev_proxy
                 else f"http://{host}:8888/notebook/{nb.namespace}/{nb.name}"
             )
-            activity = HostActivity(host=host)
             kernels = self._get_json(f"{base}/api/kernels")
-            if kernels is None:
-                activity.reachable = False
-                out.append(activity)
-                continue
-            for kernel in kernels:
-                if kernel.get("execution_state") == "busy":
-                    activity.busy = True
-                ts = _parse_jupyter_time(kernel.get("last_activity", ""))
-                if ts is not None:
-                    activity.last_activity = max(activity.last_activity or 0.0, ts)
-            terminals = self._get_json(f"{base}/api/terminals") or []
-            for term in terminals:
-                ts = _parse_jupyter_time(term.get("last_activity", ""))
-                if ts is not None:
-                    activity.last_activity = max(activity.last_activity or 0.0, ts)
-            out.append(activity)
+            terminals = self._get_json(f"{base}/api/terminals")
+            out.append(fold_host_activity(host, kernels, terminals))
         return out
 
     def _get_json(self, url: str):
@@ -137,6 +122,35 @@ class JupyterHTTPProber:
                 return json.loads(resp.read().decode())
         except (urllib.error.URLError, OSError, ValueError):
             return None
+
+
+def fold_host_activity(
+    host: str,
+    kernels: Optional[list],
+    terminals: Optional[list],
+) -> HostActivity:
+    """Fold Jupyter kernel/terminal listings into one HostActivity.
+
+    The single source of truth for the merge semantics (busy wins; last
+    activity is the max across kernels AND terminals; ``kernels is None``
+    means the host was unreachable) — shared by the Python and native
+    probers so they cannot diverge.
+    """
+    activity = HostActivity(host=host)
+    if kernels is None:
+        activity.reachable = False
+        return activity
+    for kernel in kernels:
+        if kernel.get("execution_state") == "busy":
+            activity.busy = True
+        ts = _parse_jupyter_time(kernel.get("last_activity", ""))
+        if ts is not None:
+            activity.last_activity = max(activity.last_activity or 0.0, ts)
+    for term in terminals or []:
+        ts = _parse_jupyter_time(term.get("last_activity", ""))
+        if ts is not None:
+            activity.last_activity = max(activity.last_activity or 0.0, ts)
+    return activity
 
 
 def _parse_jupyter_time(value: str) -> Optional[float]:

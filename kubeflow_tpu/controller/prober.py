@@ -23,12 +23,14 @@ from kubeflow_tpu.api.notebook import Notebook
 from kubeflow_tpu.controller.culling import (
     HostActivity,
     JupyterHTTPProber,
-    _parse_jupyter_time,
+    fold_host_activity,
 )
 
 _NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / "native"
 _LIB_PATH = _NATIVE_DIR / "libkftpu_prober.so"
-_BODY_CAP = 1 << 20  # 1 MiB per endpoint; kernel lists are tiny
+# Kernel/terminal lists are a few hundred bytes each; 64 KiB leaves two
+# orders of magnitude of headroom without allocating megabytes per cycle.
+_BODY_CAP = 64 << 10
 
 
 def _load_lib() -> Optional[ctypes.CDLL]:
@@ -58,8 +60,14 @@ class NativeFanoutProber:
     per-host ``HostActivity`` exactly like the Python prober does.
     """
 
-    def __init__(self, timeout_s: float = 5.0, lib: Optional[ctypes.CDLL] = None):
+    def __init__(
+        self,
+        timeout_s: float = 5.0,
+        lib: Optional[ctypes.CDLL] = None,
+        port: int = 8888,
+    ):
         self.timeout_s = timeout_s
+        self.port = port
         self._lib = lib if lib is not None else _load_lib()
         if self._lib is None:
             raise RuntimeError(f"native prober not available at {_LIB_PATH}")
@@ -67,31 +75,16 @@ class NativeFanoutProber:
     def probe(self, nb: Notebook, hosts: list[str]) -> list[HostActivity]:
         urls: list[str] = []
         for host in hosts:
-            base = f"http://{host}:8888/notebook/{nb.namespace}/{nb.name}"
+            base = f"http://{host}:{self.port}/notebook/{nb.namespace}/{nb.name}"
             urls.append(f"{base}/api/kernels")
             urls.append(f"{base}/api/terminals")
         statuses, bodies = self._raw_probe(urls)
 
         out: list[HostActivity] = []
         for i, host in enumerate(hosts):
-            activity = HostActivity(host=host)
             kernels = _decode(statuses[2 * i], bodies[2 * i])
-            if kernels is None:
-                activity.reachable = False
-                out.append(activity)
-                continue
-            for kernel in kernels:
-                if kernel.get("execution_state") == "busy":
-                    activity.busy = True
-                ts = _parse_jupyter_time(kernel.get("last_activity", ""))
-                if ts is not None:
-                    activity.last_activity = max(activity.last_activity or 0.0, ts)
-            terminals = _decode(statuses[2 * i + 1], bodies[2 * i + 1]) or []
-            for term in terminals:
-                ts = _parse_jupyter_time(term.get("last_activity", ""))
-                if ts is not None:
-                    activity.last_activity = max(activity.last_activity or 0.0, ts)
-            out.append(activity)
+            terminals = _decode(statuses[2 * i + 1], bodies[2 * i + 1])
+            out.append(fold_host_activity(host, kernels, terminals))
         return out
 
     def _raw_probe(self, urls: list[str]) -> tuple[list[int], list[bytes]]:
@@ -111,11 +104,12 @@ class NativeFanoutProber:
         )
         if rc != 0:
             raise RuntimeError(f"pr_probe returned {rc}")
-        raw = bodies.raw
-        out_bodies = []
-        for i in range(n):
-            chunk = raw[i * _BODY_CAP : (i + 1) * _BODY_CAP]
-            out_bodies.append(chunk.split(b"\x00", 1)[0])
+        # string_at with no length stops at the first NUL, so only the
+        # actual response bytes are copied out — not n × _BODY_CAP.
+        base = ctypes.addressof(bodies)
+        out_bodies = [
+            ctypes.string_at(base + i * _BODY_CAP) for i in range(n)
+        ]
         return list(statuses), out_bodies
 
 

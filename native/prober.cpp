@@ -24,6 +24,8 @@
 
 #include <atomic>
 #include <cerrno>
+#include <chrono>
+#include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -65,8 +67,24 @@ bool parse_url(const char* raw, Url* out) {
   return !out->host.empty();
 }
 
-// Connect with a deadline; returns fd or -1.
-int connect_deadline(const Url& u, int timeout_ms) {
+int64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Milliseconds left before the absolute deadline; <= 0 means expired.
+int remaining_ms(int64_t deadline) {
+  int64_t left = deadline - now_ms();
+  if (left <= 0) return 0;
+  if (left > INT32_MAX) left = INT32_MAX;
+  return static_cast<int>(left);
+}
+
+// Connect before the absolute deadline; returns fd or -1. The deadline is
+// shared across every resolved address — a probe never gets more than its
+// overall budget no matter how many A/AAAA records resolve.
+int connect_deadline(const Url& u, int64_t deadline) {
   addrinfo hints{};
   hints.ai_family = AF_UNSPEC;
   hints.ai_socktype = SOCK_STREAM;
@@ -74,6 +92,8 @@ int connect_deadline(const Url& u, int timeout_ms) {
   if (getaddrinfo(u.host.c_str(), u.port.c_str(), &hints, &res) != 0) return -1;
   int fd = -1;
   for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    int left = remaining_ms(deadline);
+    if (left <= 0) break;
     fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
     if (fd < 0) continue;
     fcntl(fd, F_SETFL, fcntl(fd, F_GETFL) | O_NONBLOCK);
@@ -81,7 +101,7 @@ int connect_deadline(const Url& u, int timeout_ms) {
     if (rc == 0) break;
     if (errno == EINPROGRESS) {
       pollfd pfd{fd, POLLOUT, 0};
-      if (poll(&pfd, 1, timeout_ms) == 1 && (pfd.revents & POLLOUT)) {
+      if (poll(&pfd, 1, left) == 1 && (pfd.revents & POLLOUT)) {
         int err = 0;
         socklen_t len = sizeof(err);
         getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
@@ -95,12 +115,16 @@ int connect_deadline(const Url& u, int timeout_ms) {
   return fd;
 }
 
-// Read until EOF or deadline; appends to buf.
-bool read_all(int fd, int timeout_ms, std::string* buf) {
+// Read until EOF or the absolute deadline; appends to buf. Every poll gets
+// only the REMAINING budget, so a host that trickles bytes cannot extend
+// the probe past timeout_ms (the per-poll-restart pathology).
+bool read_all(int fd, int64_t deadline, std::string* buf) {
   char chunk[4096];
   for (;;) {
+    int left = remaining_ms(deadline);
+    if (left <= 0) return false;
     pollfd pfd{fd, POLLIN, 0};
-    int pr = poll(&pfd, 1, timeout_ms);
+    int pr = poll(&pfd, 1, left);
     if (pr <= 0) return false;  // timeout or error
     ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
     if (n < 0) {
@@ -114,20 +138,24 @@ bool read_all(int fd, int timeout_ms, std::string* buf) {
 }
 
 // One probe: returns HTTP status (>0), -1 network failure, -2 bad URL.
+// timeout_ms is the OVERALL budget for resolve+connect+send+read.
 int probe_one(const char* raw_url, int timeout_ms, char* body_out,
               int body_cap) {
   if (body_cap > 0) body_out[0] = '\0';
   Url u;
   if (!parse_url(raw_url, &u)) return -2;
-  int fd = connect_deadline(u, timeout_ms);
+  const int64_t deadline = now_ms() + timeout_ms;
+  int fd = connect_deadline(u, deadline);
   if (fd < 0) return -1;
 
   std::string req = "GET " + u.path + " HTTP/1.0\r\nHost: " + u.host +
                     "\r\nConnection: close\r\n\r\n";
   size_t sent = 0;
   while (sent < req.size()) {
+    int left = remaining_ms(deadline);
+    if (left <= 0) { close(fd); return -1; }
     pollfd pfd{fd, POLLOUT, 0};
-    if (poll(&pfd, 1, timeout_ms) <= 0) { close(fd); return -1; }
+    if (poll(&pfd, 1, left) <= 0) { close(fd); return -1; }
     ssize_t n = send(fd, req.data() + sent, req.size() - sent, MSG_NOSIGNAL);
     if (n < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) continue;
@@ -138,7 +166,7 @@ int probe_one(const char* raw_url, int timeout_ms, char* body_out,
   }
 
   std::string resp;
-  bool ok = read_all(fd, timeout_ms, &resp);
+  bool ok = read_all(fd, deadline, &resp);
   close(fd);
   if (!ok && resp.empty()) return -1;
 
@@ -169,8 +197,11 @@ int pr_probe(const char** urls, int n, int timeout_ms, char* bodies,
   if (!urls || !bodies || !statuses || body_cap <= 0 || timeout_ms <= 0)
     return -1;
   // One thread per URL, capped: slice host counts are ≤ 64 for v5p-512 and
-  // probes are poll-bound, so a flat pool is simpler than an event loop.
-  const int max_threads = 64;
+  // each host contributes 2 URLs (kernels+terminals), so 128 covers the
+  // largest slice in ONE wave — the "one timeout regardless of slice size"
+  // guarantee. Probes are poll-bound, so a flat pool beats an event loop
+  // on simplicity.
+  const int max_threads = 128;
   std::vector<std::thread> pool;
   std::atomic<int> next{0};
   int workers = n < max_threads ? n : max_threads;

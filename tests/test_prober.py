@@ -120,28 +120,34 @@ def test_native_probe_matches_python_prober(native_lib):
         srv.shutdown()
 
 
-def test_native_full_probe_merges_activity(native_lib, monkeypatch):
-    srv = _serve(BUSY, [])
-    port = srv.server_address[1]
+def test_native_full_probe_merges_activity(native_lib):
+    """Exercises NativeFanoutProber.probe() itself: busy detection and the
+    kernel/terminal last_activity max-merge (terminal is newer here)."""
+    srv = _serve(BUSY, TERM)
     try:
-        native = prober_mod.NativeFanoutProber(timeout_s=2.0, lib=native_lib)
-        # Redirect the :8888 URL builder at the test port.
-        orig = native.probe.__func__
+        native = prober_mod.NativeFanoutProber(
+            timeout_s=2.0, lib=native_lib, port=srv.server_address[1]
+        )
+        acts = native.probe(_nb(), ["127.0.0.1"])
+        assert len(acts) == 1
+        assert acts[0].reachable and acts[0].busy
+        # TERM's 11:00Z beats BUSY's 10:00Z in the max-merge.
+        from kubeflow_tpu.controller.culling import _parse_jupyter_time
 
-        def probe_with_port(nb, hosts):
-            urls = []
-            for host in hosts:
-                base = f"http://{host}:{port}/notebook/{nb.namespace}/{nb.name}"
-                urls.append(f"{base}/api/kernels")
-                urls.append(f"{base}/api/terminals")
-            statuses, bodies = native._raw_probe(urls)
-            return statuses, bodies
+        assert acts[0].last_activity == _parse_jupyter_time(TERM[0]["last_activity"])
+    finally:
+        srv.shutdown()
 
-        statuses, bodies = probe_with_port(_nb(), ["127.0.0.1"])
-        assert statuses[0] == 200
-        kernels = json.loads(bodies[0].decode())
-        assert kernels[0]["execution_state"] == "busy"
-        assert orig is not None
+
+def test_native_full_probe_marks_unreachable_host(native_lib):
+    srv = _serve(IDLE, [])
+    try:
+        native = prober_mod.NativeFanoutProber(
+            timeout_s=1.0, lib=native_lib, port=srv.server_address[1]
+        )
+        acts = native.probe(_nb(), ["127.0.0.1", "10.255.255.1"])
+        assert acts[0].reachable and not acts[0].busy
+        assert not acts[1].reachable
     finally:
         srv.shutdown()
 
@@ -183,6 +189,40 @@ def test_probe_mixed_reachable_and_dead(native_lib):
         assert statuses[0] == 200 and statuses[2] == 200
         assert statuses[1] < 0
         assert json.loads(bodies[0].decode()) == IDLE
+    finally:
+        srv.shutdown()
+
+
+def test_trickling_host_cannot_exceed_overall_deadline(native_lib):
+    """A host that drips bytes forever (each gap under the timeout) must
+    still be cut off at the OVERALL deadline — per-poll timeout restarts
+    would let it hold a worker thread indefinitely."""
+
+    class Trickler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.end_headers()
+            try:
+                for _ in range(50):  # ~10s of dripping if never cut off
+                    self.wfile.write(b"x")
+                    self.wfile.flush()
+                    time.sleep(0.2)
+            except BrokenPipeError:
+                pass
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Trickler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        native = prober_mod.NativeFanoutProber(timeout_s=1.0, lib=native_lib)
+        url = f"http://127.0.0.1:{srv.server_address[1]}/api/kernels"
+        t0 = time.monotonic()
+        statuses, _ = native._raw_probe([url])
+        elapsed = time.monotonic() - t0
+        assert elapsed < 3.0  # 1s budget + slack, nowhere near 10s
+        assert statuses[0] == 200  # headers arrived before the cutoff
     finally:
         srv.shutdown()
 
