@@ -15,14 +15,28 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from kubeflow_tpu.deploy.render import render_all, write_all  # noqa: E402
 
 
+def _orphans(root: Path, rendered: dict) -> list[str]:
+    """Files under config/ that no generator produces anymore — a renamed
+    generator must not leave its old output behind where kustomize or
+    kubectl apply -f could still ship it."""
+    known = set(rendered)
+    return sorted(
+        str(p.relative_to(root))
+        for p in (root / "config").rglob("*")
+        if p.is_file() and str(p.relative_to(root)) not in known
+    )
+
+
 def verify(root: Path) -> int:
+    rendered = render_all()
     stale = []
-    for rel, content in render_all().items():
+    for rel, content in rendered.items():
         path = root / rel
         if not path.exists():
             stale.append(f"missing: {rel}")
         elif path.read_text() != content:
             stale.append(f"drifted: {rel}")
+    stale += [f"orphaned: {rel}" for rel in _orphans(root, rendered)]
     if stale:
         for line in stale:
             print(line, file=sys.stderr)
@@ -42,3 +56,6 @@ if __name__ == "__main__":
         sys.exit(verify(root))
     for path in write_all(root):
         print(f"wrote {path.relative_to(root)}")
+    for rel in _orphans(root, render_all()):
+        (root / rel).unlink()
+        print(f"pruned {rel}")

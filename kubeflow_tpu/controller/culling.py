@@ -112,7 +112,13 @@ class JupyterHTTPProber:
                 else f"http://{host}:8888/notebook/{nb.namespace}/{nb.name}"
             )
             kernels = self._get_json(f"{base}/api/kernels")
-            terminals = self._get_json(f"{base}/api/terminals")
+            # Dead host: don't burn a second timeout on terminals the fold
+            # would ignore anyway.
+            terminals = (
+                self._get_json(f"{base}/api/terminals")
+                if kernels is not None
+                else None
+            )
             out.append(fold_host_activity(host, kernels, terminals))
         return out
 

@@ -83,7 +83,17 @@ class NativeFanoutProber:
         out: list[HostActivity] = []
         for i, host in enumerate(hosts):
             kernels = _decode(statuses[2 * i], bodies[2 * i])
+            if kernels is _TRUNCATED:
+                # The kernel list overflowed _BODY_CAP — hundreds of
+                # kernels means the server is plainly in use. Mark busy
+                # (refreshes last-activity upstream) rather than
+                # unreachable: an "unobservable" verdict would trip the
+                # never-cull-blind rule and hold the slice forever.
+                out.append(HostActivity(host=host, busy=True))
+                continue
             terminals = _decode(statuses[2 * i + 1], bodies[2 * i + 1])
+            if terminals is _TRUNCATED:
+                terminals = None
             out.append(fold_host_activity(host, kernels, terminals))
         return out
 
@@ -113,12 +123,20 @@ class NativeFanoutProber:
         return list(statuses), out_bodies
 
 
+# Sentinel: HTTP 200 but the body filled _BODY_CAP and won't parse — the
+# response was cut mid-JSON, which is a "very long kernel list", not an
+# unreachable host.
+_TRUNCATED = object()
+
+
 def _decode(status: int, body: bytes):
     if status != 200:
         return None
     try:
         parsed = json.loads(body.decode())
     except (ValueError, UnicodeDecodeError):
+        if len(body) >= _BODY_CAP - 1:
+            return _TRUNCATED
         return None
     return parsed if isinstance(parsed, list) else None
 

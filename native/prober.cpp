@@ -25,7 +25,10 @@
 #include <atomic>
 #include <cerrno>
 #include <chrono>
+#include <condition_variable>
 #include <cstdint>
+#include <memory>
+#include <mutex>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -81,15 +84,61 @@ int remaining_ms(int64_t deadline) {
   return static_cast<int>(left);
 }
 
-// Connect before the absolute deadline; returns fd or -1. The deadline is
-// shared across every resolved address — a probe never gets more than its
-// overall budget no matter how many A/AAAA records resolve.
-int connect_deadline(const Url& u, int64_t deadline) {
-  addrinfo hints{};
-  hints.ai_family = AF_UNSPEC;
-  hints.ai_socktype = SOCK_STREAM;
+// getaddrinfo has no timeout parameter, and a hung resolver (kube-dns
+// partition — precisely when the culler probes a partitioned slice) would
+// otherwise wedge a worker thread past any deadline. Run it in a helper
+// thread and wait with a deadline; on timeout the helper is detached and
+// cleans up after itself whenever libc eventually returns.
+struct ResolveState {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  bool abandoned = false;
   addrinfo* res = nullptr;
-  if (getaddrinfo(u.host.c_str(), u.port.c_str(), &hints, &res) != 0) return -1;
+};
+
+addrinfo* resolve_with_deadline(const std::string& host,
+                                const std::string& port, int64_t deadline) {
+  auto st = std::make_shared<ResolveState>();
+  std::thread worker([st, host, port]() {
+    addrinfo hints{};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    int rc = getaddrinfo(host.c_str(), port.c_str(), &hints, &res);
+    std::lock_guard<std::mutex> lock(st->mu);
+    st->done = true;
+    if (st->abandoned) {
+      // Probe gave up; nobody will read res.
+      if (rc == 0 && res) freeaddrinfo(res);
+    } else {
+      st->res = rc == 0 ? res : nullptr;
+    }
+    st->cv.notify_one();
+  });
+  std::unique_lock<std::mutex> lock(st->mu);
+  // now_ms() is steady_clock-based, so the deadline converts directly.
+  auto abs_deadline = std::chrono::steady_clock::time_point(
+      std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+          std::chrono::milliseconds(deadline)));
+  bool finished =
+      st->cv.wait_until(lock, abs_deadline, [&] { return st->done; });
+  if (finished) {
+    worker.join();
+    return st->res;
+  }
+  st->abandoned = true;
+  lock.unlock();
+  worker.detach();  // bounded leak: one blocked resolver thread, self-freeing
+  return nullptr;
+}
+
+// Connect before the absolute deadline; returns fd or -1. The deadline is
+// shared across resolution AND every resolved address — a probe never gets
+// more than its overall budget.
+int connect_deadline(const Url& u, int64_t deadline) {
+  addrinfo* res = resolve_with_deadline(u.host, u.port, deadline);
+  if (!res) return -1;
   int fd = -1;
   for (addrinfo* ai = res; ai; ai = ai->ai_next) {
     int left = remaining_ms(deadline);

@@ -227,6 +227,41 @@ def test_trickling_host_cannot_exceed_overall_deadline(native_lib):
         srv.shutdown()
 
 
+def test_truncated_kernel_list_reads_as_busy_not_unreachable(
+    native_lib, monkeypatch
+):
+    """A kernels body that overflows _BODY_CAP must mark the host BUSY —
+    treating it as unreachable would trip the never-cull-blind rule and
+    hold the slice forever (a kernel-leaking notebook is exactly what the
+    culler exists to see)."""
+    monkeypatch.setattr(prober_mod, "_BODY_CAP", 512)
+    huge = [
+        {"execution_state": "idle", "last_activity": "2026-07-29T10:00:00.000000Z"}
+    ] * 50  # ~4 KB as JSON, far over the patched 512-byte cap
+    srv = _serve(huge, [])
+    try:
+        native = prober_mod.NativeFanoutProber(
+            timeout_s=2.0, lib=native_lib, port=srv.server_address[1]
+        )
+        acts = native.probe(_nb(), ["127.0.0.1"])
+        assert acts[0].reachable
+        assert acts[0].busy
+    finally:
+        srv.shutdown()
+
+
+def test_hung_dns_respects_deadline(native_lib):
+    """Name resolution shares the overall budget: an unresolvable name must
+    fail within ~timeout, never wedge the worker thread."""
+    native = prober_mod.NativeFanoutProber(timeout_s=1.0, lib=native_lib)
+    t0 = time.monotonic()
+    statuses, _ = native._raw_probe(
+        ["http://nonexistent-host.invalid:8888/api/kernels"]
+    )
+    assert statuses[0] == -1
+    assert time.monotonic() - t0 < 5.0
+
+
 def test_make_prober_falls_back_without_lib(monkeypatch):
     monkeypatch.setattr(prober_mod, "_LIB_PATH", pathlib.Path("/nonexistent.so"))
     p = prober_mod.make_prober()
