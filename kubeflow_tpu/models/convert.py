@@ -1,0 +1,181 @@
+"""HuggingFace checkpoint conversion for the Llama family.
+
+A notebook user's first real act on a fresh TPU slice is loading weights;
+this module turns a HuggingFace Llama checkpoint (``LlamaForCausalLM``
+state dict, or a directory of ``*.safetensors`` shards) into the stacked
+pytree kubeflow_tpu.models.llama consumes.
+
+Layout notes (why each transform exists):
+
+- torch ``nn.Linear.weight`` is (out, in); our matmuls are ``x @ w`` with
+  w (in, out) → every projection transposes once at load time so the hot
+  path never does.
+- Our transformer layers are STACKED along a leading (n_layers, ...) axis
+  for the lax.scan forward — per-layer HF tensors are stacked here, once.
+- transformers stores q/k projections already permuted for its rotate-half
+  RoPE convention, which is the same convention ``llama.apply_rope``
+  implements, so weights load with no head-dim permutation.
+- ``lm_head.weight`` is (vocab, dim) in both layouts (we compute
+  ``x @ lm_head.T``): no transpose. Tied-embedding checkpoints
+  (``tie_word_embeddings=true``) reuse the embedding matrix.
+
+There is no counterpart in the reference (it has no ML runtime —
+SURVEY.md §2.5); this is north-star tooling for the in-notebook Llama
+benchmark (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Any, Mapping, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.llama import LlamaConfig
+
+
+def config_from_hf(hf_config: Any) -> LlamaConfig:
+    """Map a transformers LlamaConfig (object or dict) to LlamaConfig."""
+    get = (
+        hf_config.get
+        if isinstance(hf_config, Mapping)
+        else lambda k, d=None: getattr(hf_config, k, d)
+    )
+    n_heads = get("num_attention_heads")
+    return LlamaConfig(
+        vocab_size=get("vocab_size"),
+        dim=get("hidden_size"),
+        n_layers=get("num_hidden_layers"),
+        n_heads=n_heads,
+        n_kv_heads=get("num_key_value_heads", n_heads) or n_heads,
+        ffn_hidden=get("intermediate_size"),
+        rope_theta=float(get("rope_theta", 10000.0)),
+        max_seq_len=get("max_position_embeddings", 4096),
+        norm_eps=float(get("rms_norm_eps", 1e-5)),
+    )
+
+
+def _to_np(t: Any) -> np.ndarray:
+    """torch tensor / numpy array → numpy, without a torch import here."""
+    if isinstance(t, np.ndarray):
+        return t
+    # torch.Tensor: bf16 has no numpy dtype; detach via float32.
+    if hasattr(t, "detach"):
+        t = t.detach()
+        if str(t.dtype) == "torch.bfloat16":
+            t = t.float()
+        return t.cpu().numpy()
+    return np.asarray(t)
+
+
+def params_from_hf_state_dict(
+    cfg: LlamaConfig,
+    state_dict: Mapping[str, Any],
+    dtype: Optional[Any] = None,
+) -> dict:
+    """HF LlamaForCausalLM state dict → stacked params pytree.
+
+    Accepts torch tensors or numpy arrays as values. ``dtype`` defaults to
+    ``cfg.dtype`` (bf16 — the MXU-native choice).
+    """
+    dtype = cfg.dtype if dtype is None else dtype
+    sd = dict(state_dict)
+    # Some exports prefix everything with "model." except lm_head.
+    prefix = "model." if any(k.startswith("model.") for k in sd) else ""
+
+    def take(name: str) -> jnp.ndarray:
+        key = prefix + name if not name.startswith("lm_head") else name
+        if key not in sd and name.startswith("lm_head"):
+            # tie_word_embeddings: reuse the input embedding.
+            key = prefix + "embed_tokens.weight"
+        try:
+            return jnp.asarray(_to_np(sd[key]), dtype)
+        except KeyError:
+            raise KeyError(
+                f"checkpoint is missing '{key}' "
+                f"(have {len(sd)} tensors; is this a Llama-family export?)"
+            ) from None
+
+    def stack_linear(fmt: str) -> jnp.ndarray:
+        # (out, in) per layer → stacked (L, in, out).
+        return jnp.stack(
+            [take(fmt.format(i)).T for i in range(cfg.n_layers)]
+        )
+
+    def stack_norm(fmt: str) -> jnp.ndarray:
+        return jnp.stack([take(fmt.format(i)) for i in range(cfg.n_layers)])
+
+    layers = {
+        "attn_norm": stack_norm("layers.{}.input_layernorm.weight"),
+        "wq": stack_linear("layers.{}.self_attn.q_proj.weight"),
+        "wk": stack_linear("layers.{}.self_attn.k_proj.weight"),
+        "wv": stack_linear("layers.{}.self_attn.v_proj.weight"),
+        "wo": stack_linear("layers.{}.self_attn.o_proj.weight"),
+        "mlp_norm": stack_norm("layers.{}.post_attention_layernorm.weight"),
+        "w_gate": stack_linear("layers.{}.mlp.gate_proj.weight"),
+        "w_up": stack_linear("layers.{}.mlp.up_proj.weight"),
+        "w_down": stack_linear("layers.{}.mlp.down_proj.weight"),
+    }
+    return {
+        "embed": take("embed_tokens.weight"),
+        "final_norm": take("norm.weight"),
+        "lm_head": take("lm_head.weight"),
+        "layers": layers,
+    }
+
+
+def params_to_hf_state_dict(cfg: LlamaConfig, params: dict) -> dict:
+    """Inverse of params_from_hf_state_dict (numpy f32 values) — lets a
+    notebook export back to the HF ecosystem after TPU fine-tuning."""
+    out = {
+        "model.embed_tokens.weight": _f32(params["embed"]),
+        "model.norm.weight": _f32(params["final_norm"]),
+        "lm_head.weight": _f32(params["lm_head"]),
+    }
+    names = {
+        "attn_norm": ("input_layernorm.weight", False),
+        "wq": ("self_attn.q_proj.weight", True),
+        "wk": ("self_attn.k_proj.weight", True),
+        "wv": ("self_attn.v_proj.weight", True),
+        "wo": ("self_attn.o_proj.weight", True),
+        "mlp_norm": ("post_attention_layernorm.weight", False),
+        "w_gate": ("mlp.gate_proj.weight", True),
+        "w_up": ("mlp.up_proj.weight", True),
+        "w_down": ("mlp.down_proj.weight", True),
+    }
+    for ours, (theirs, is_linear) in names.items():
+        stacked = params["layers"][ours]
+        for i in range(cfg.n_layers):
+            mat = _f32(stacked[i])
+            out[f"model.layers.{i}.{theirs}"] = mat.T if is_linear else mat
+    return out
+
+
+def _f32(x: jnp.ndarray) -> np.ndarray:
+    return np.asarray(x, np.float32)
+
+
+def load_hf_checkpoint(
+    path: str | pathlib.Path, dtype: Optional[Any] = None
+) -> tuple[LlamaConfig, dict]:
+    """Load (config, params) from an HF checkpoint directory.
+
+    Reads ``config.json`` plus every ``*.safetensors`` shard (memory-mapped
+    by safetensors, so a 7B load streams tensor-by-tensor instead of
+    materializing the whole checkpoint twice).
+    """
+    path = pathlib.Path(path)
+    cfg = config_from_hf(json.loads((path / "config.json").read_text()))
+    shards = sorted(path.glob("*.safetensors"))
+    if not shards:
+        raise FileNotFoundError(f"no *.safetensors under {path}")
+    from safetensors import safe_open  # transformers dependency
+
+    state: dict[str, np.ndarray] = {}
+    for shard in shards:
+        with safe_open(str(shard), framework="np") as f:
+            for key in f.keys():
+                state[key] = f.get_tensor(key)
+    return cfg, params_from_hf_state_dict(cfg, state, dtype)
