@@ -33,7 +33,33 @@ from typing import Any, Mapping, Optional
 import jax.numpy as jnp
 import numpy as np
 
-from kubeflow_tpu.models.llama import LlamaConfig
+from kubeflow_tpu.models.llama import LlamaConfig, RopeScaling
+
+
+def _rope_scaling_from_hf(raw: Any) -> Optional[RopeScaling]:
+    """Map HF's rope_scaling block; raise rather than silently drop it —
+    ignoring e.g. Llama-3.1's "llama3" schedule would load cleanly and
+    generate garbage past the scaling regime."""
+    if raw is None:
+        return None
+    if not isinstance(raw, Mapping):
+        raw = dict(raw)
+    kind = raw.get("rope_type", raw.get("type", "default"))
+    if kind == "default":
+        return None
+    if kind == "llama3":
+        return RopeScaling(
+            factor=float(raw["factor"]),
+            low_freq_factor=float(raw["low_freq_factor"]),
+            high_freq_factor=float(raw["high_freq_factor"]),
+            original_max_position_embeddings=int(
+                raw["original_max_position_embeddings"]
+            ),
+        )
+    raise NotImplementedError(
+        f"rope_scaling type {kind!r} is not supported (have: llama3); "
+        "loading would produce wrong positions silently"
+    )
 
 
 def config_from_hf(hf_config: Any) -> LlamaConfig:
@@ -52,6 +78,7 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
         n_kv_heads=get("num_key_value_heads", n_heads) or n_heads,
         ffn_hidden=get("intermediate_size"),
         rope_theta=float(get("rope_theta", 10000.0)),
+        rope_scaling=_rope_scaling_from_hf(get("rope_scaling")),
         max_seq_len=get("max_position_embeddings", 4096),
         norm_eps=float(get("rms_norm_eps", 1e-5)),
     )

@@ -32,6 +32,20 @@ from kubeflow_tpu.ops.attention import NEG_INF, flash_attention
 
 
 @dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.1 "llama3" rope scaling (frequency-dependent NTK stretch).
+
+    Frozen/hashable so it can live inside the jit-static LlamaConfig.
+    Field semantics follow the HF config.json rope_scaling block.
+    """
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+
+@dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 32000
     dim: int = 4096
@@ -40,6 +54,7 @@ class LlamaConfig:
     n_kv_heads: int = 32
     ffn_hidden: int = 11008
     rope_theta: float = 10000.0
+    rope_scaling: Optional[RopeScaling] = None
     max_seq_len: int = 4096
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
@@ -65,6 +80,10 @@ LLAMA_CONFIGS: dict[str, LlamaConfig] = {
     "llama-3-8b": LlamaConfig(vocab_size=128256, dim=4096, n_layers=32,
                               n_heads=32, n_kv_heads=8, ffn_hidden=14336,
                               rope_theta=500000.0, max_seq_len=8192),
+    "llama-3.1-8b": LlamaConfig(vocab_size=128256, dim=4096, n_layers=32,
+                                n_heads=32, n_kv_heads=8, ffn_hidden=14336,
+                                rope_theta=500000.0, max_seq_len=131072,
+                                rope_scaling=RopeScaling()),
     # Tiny configs for tests / compile checks.
     "tiny": LlamaConfig(vocab_size=256, dim=128, n_layers=2, n_heads=4,
                         n_kv_heads=4, ffn_hidden=256, max_seq_len=256),
@@ -123,8 +142,35 @@ def rope_frequencies(cfg: LlamaConfig, positions: jax.Array) -> tuple[jax.Array,
     """cos/sin tables for the given positions: (S, head_dim/2) each, f32."""
     half = cfg.head_dim // 2
     freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if cfg.rope_scaling is not None:
+        freqs = _llama3_scale_freqs(cfg.rope_scaling, freqs)
     angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
     return jnp.cos(angles), jnp.sin(angles)
+
+
+def _llama3_scale_freqs(rs: RopeScaling, freqs: jax.Array) -> jax.Array:
+    """Llama-3.1 frequency-dependent scaling: high-frequency components are
+    kept, low-frequency components are stretched by ``factor``, with a
+    smooth ramp between the two wavelength cutoffs (matches the HF
+    "llama3" rope_type implementation numerically)."""
+    low_wavelen = rs.original_max_position_embeddings / rs.low_freq_factor
+    high_wavelen = rs.original_max_position_embeddings / rs.high_freq_factor
+    wavelen = 2.0 * math.pi / freqs
+    # Ramp ∈ [0,1]: 0 at the low-frequency cutoff, 1 at the high-frequency.
+    smooth = (rs.original_max_position_embeddings / wavelen - rs.low_freq_factor) / (
+        rs.high_freq_factor - rs.low_freq_factor
+    )
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    scaled = jnp.where(
+        wavelen > low_wavelen,
+        freqs / rs.factor,
+        jnp.where(
+            wavelen < high_wavelen,
+            freqs,
+            (1.0 - smooth) * freqs / rs.factor + smooth * freqs,
+        ),
+    )
+    return scaled
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:

@@ -23,6 +23,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from kubeflow_tpu.models.llama import LlamaConfig, forward
 from kubeflow_tpu.parallel.mesh import MeshPlan
 from kubeflow_tpu.parallel.ring_attention import make_sharded_ring_attention
+from kubeflow_tpu.parallel.ulysses import make_sharded_ulysses_attention
 
 
 def causal_lm_loss(
@@ -46,12 +47,20 @@ def make_train_step(
     plan: MeshPlan,
     optimizer=None,
     use_ring_sp: Optional[bool] = None,
+    sp_impl: str = "ring",
 ):
     """Build (init_state, train_step) jitted over plan.mesh.
 
-    ``use_ring_sp`` defaults to True when the mesh has an sp axis > 1:
-    attention then runs as ring attention over sequence shards.
+    When the mesh has an sp axis > 1 (``use_ring_sp`` defaults to True
+    then), attention runs sequence-parallel using ``sp_impl``:
+    "ring" (K/V rotate via ppermute, overlapped with compute) or
+    "ulysses" (two all_to_alls trade sequence shards for head shards;
+    needs heads-per-tp-shard divisible by sp).
     """
+    if sp_impl not in ("ring", "ulysses"):
+        # Validate even when sp ends up inactive: a typo'd sp_impl on an
+        # sp=1 mesh must not silently run dense attention.
+        raise ValueError(f"unknown sp_impl {sp_impl!r} (want 'ring'|'ulysses')")
     optimizer = optimizer or make_optimizer()
     mesh = plan.mesh
     if use_ring_sp is None:
@@ -59,7 +68,12 @@ def make_train_step(
     # Pass the mesh-bound impl as a callable: a global registry entry named
     # "ring" would be rebound by every make_train_step call, so a step built
     # for mesh A could silently pick up mesh B's shard_map on retrace.
-    attn_impl = make_sharded_ring_attention(mesh) if use_ring_sp else "auto"
+    if not use_ring_sp:
+        attn_impl = "auto"
+    elif sp_impl == "ring":
+        attn_impl = make_sharded_ring_attention(mesh)
+    else:
+        attn_impl = make_sharded_ulysses_attention(mesh)
 
     def init_state(params):
         opt_state = optimizer.init(params)

@@ -1,1 +1,9 @@
 from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh  # noqa: F401
+from kubeflow_tpu.parallel.ring_attention import (  # noqa: F401
+    make_sharded_ring_attention,
+    ring_attention,
+)
+from kubeflow_tpu.parallel.ulysses import (  # noqa: F401
+    make_sharded_ulysses_attention,
+    ulysses_attention,
+)

@@ -1,0 +1,104 @@
+"""Ulysses sequence parallelism: all-to-all head↔sequence re-sharding.
+
+The second long-context strategy (alongside ring attention in
+kubeflow_tpu.parallel.ring_attention): instead of rotating K/V around a
+ring, two ``all_to_all`` collectives swap the sharded axis. Inbound, each
+device trades its sequence shard for a HEAD shard — it then holds the FULL
+sequence for H/sp heads and runs ordinary (pallas/XLA flash) attention
+locally; outbound, the output is traded back to sequence shards.
+
+Trade-off vs ring (the reason both exist):
+- Ulysses moves activations twice (2 all-to-alls of O(S·D·H/sp) per
+  device) regardless of sequence length; ring moves K/V sp-1 times but
+  overlaps the permutes with compute.
+- Ulysses runs one dense local attention — the pallas flash kernel applies
+  unchanged, and the causal mask needs no cross-device bookkeeping.
+- Ulysses caps sp at the head count (sp must divide H); ring has no such
+  limit. GQA: K/V heads are repeated up to H first when sp does not
+  divide n_kv_heads — correctness over bandwidth; prefer sp ≤ n_kv_heads
+  on GQA configs.
+
+Composition mirrors ring attention: batch over (dp, fsdp), heads over tp,
+sequence over sp, all inside one shard_map.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from kubeflow_tpu.ops.attention import flash_attention
+
+
+def ulysses_attention(
+    q: jax.Array,  # local (B, H_local, S_local, D) — H_local is post-tp
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+    local_impl: str = "auto",
+) -> jax.Array:
+    """All-to-all attention. MUST run inside shard_map over ``axis_name``.
+
+    Requires H_local % sp == 0 (after any GQA repeat done by the caller).
+    """
+    sp = jax.lax.psum(1, axis_name)
+    if sp == 1:
+        return flash_attention(q, k, v, causal=causal, impl=local_impl)
+    h_local = q.shape[1]
+    if h_local % sp != 0:
+        raise ValueError(
+            f"ulysses needs heads ({h_local}) divisible by sp ({sp}); "
+            "repeat GQA K/V heads or lower sp"
+        )
+    # Trade sequence shards for head shards: (B, H, S/sp, D) → (B, H/sp, S, D).
+    gather = partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=1,
+        concat_axis=2, tiled=True,
+    )
+    out = flash_attention(
+        gather(q), gather(k), gather(v), causal=causal, impl=local_impl
+    )
+    # Trade back: (B, H/sp, S, D) → (B, H, S/sp, D).
+    return jax.lax.all_to_all(
+        out, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+def make_sharded_ulysses_attention(mesh: Mesh, local_impl: str = "auto"):
+    """Return attention(q, k, v, causal, q_offset) jit-composable over the
+    full mesh — drop-in for make_sharded_ring_attention (same specs:
+    batch=(dp,fsdp), heads=tp, sequence=sp)."""
+    spec = P(("dp", "fsdp"), "tp", "sp", None)
+    sp = mesh.shape.get("sp", 1)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    def _sharded(q, k, v):
+        return ulysses_attention(
+            q, k, v, axis_name="sp", causal=True, local_impl=local_impl
+        )
+
+    def attention(q, k, v, causal=True, q_offset=0, impl=None):
+        if not causal:
+            raise NotImplementedError("ulysses attention is causal-only here")
+        h = q.shape[1]
+        tp = mesh.shape.get("tp", 1)
+        if (h // tp) % sp != 0:
+            raise ValueError(
+                f"heads-per-tp-shard {h // tp} not divisible by sp={sp}; "
+                "the model layer must repeat GQA K/V up to full heads "
+                "before sequence-parallel attention"
+            )
+        return _sharded(q, k, v)
+
+    return attention

@@ -66,10 +66,72 @@ def test_forward_matches_transformers_gqa():
 
 
 def test_tied_embeddings_checkpoint_loads():
-    cfg, params, model, _ = _parity_case(n_kv_heads=4, tie=True)
+    """Real tied checkpoints (safetensors save) STRIP lm_head.weight; the
+    loader must fall back to the embedding matrix."""
+    hf_cfg, model = _tiny_hf(n_kv_heads=4, tie=True)
+    cfg = config_from_hf(hf_cfg)
+    sd = dict(model.state_dict())
+    sd.pop("lm_head.weight", None)  # what save_pretrained does for tied
+    params = params_from_hf_state_dict(cfg, sd, np.float32)
     np.testing.assert_array_equal(
         np.asarray(params["lm_head"]), np.asarray(params["embed"])
     )
+    tokens = np.array([[3, 17, 250, 42]], np.int32)
+    f32_cfg = L.LlamaConfig(**{**cfg.__dict__, "dtype": np.float32})
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens).long()).logits.numpy()
+    ours = np.asarray(L.forward(params, f32_cfg, tokens))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_rope_scaling_llama3_matches_transformers():
+    """Llama-3.1-style rope_scaling must be applied, not dropped."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 4.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.rope_scaling is not None and cfg.rope_scaling.factor == 4.0
+    f32_cfg = L.LlamaConfig(**{**cfg.__dict__, "dtype": np.float32})
+    params = params_from_hf_state_dict(f32_cfg, model.state_dict(), np.float32)
+    # Positions past original_max_position_embeddings exercise the
+    # stretched low-frequency regime.
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 256, (1, 96)).astype(np.int32)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens).long()).logits.numpy()
+    ours = np.asarray(L.forward(params, f32_cfg, tokens))
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_unsupported_rope_scaling_raises():
+    with pytest.raises(NotImplementedError, match="rope_scaling"):
+        config_from_hf(
+            {
+                "vocab_size": 256,
+                "hidden_size": 64,
+                "num_hidden_layers": 2,
+                "num_attention_heads": 4,
+                "intermediate_size": 128,
+                "rope_scaling": {"rope_type": "yarn", "factor": 2.0},
+            }
+        )
 
 
 def test_greedy_generation_matches_transformers():
