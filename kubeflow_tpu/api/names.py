@@ -9,6 +9,7 @@ runtime bootstrap use. Each name/port is defined exactly once, here.
 NOTEBOOK_PORT = 8888
 RBAC_PROXY_PORT = 8443
 JAX_COORDINATOR_PORT = 8476  # jax.distributed default coordinator port
+MEGASCALE_PORT = 8081  # megascale (multislice DCN) coordinator port
 
 CA_BUNDLE_CONFIGMAP = "workbench-trusted-ca-bundle"
 RUNTIME_IMAGES_CONFIGMAP = "pipeline-runtime-images"

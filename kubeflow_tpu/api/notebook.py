@@ -39,7 +39,11 @@ from typing import Any, Optional
 
 from kubeflow_tpu.api import annotations as ann
 from kubeflow_tpu.k8s import objects as obj_util
-from kubeflow_tpu.tpu.topology import SliceTopology, slice_from_spec
+from kubeflow_tpu.tpu.topology import (
+    InvalidTopologyError,
+    SliceTopology,
+    slice_from_spec,
+)
 
 GROUP = "kubeflow.org"
 KIND = "Notebook"
@@ -57,9 +61,16 @@ class TPUSpec:
     topology: str
     runtime_version: str = ""
     spot: bool = False
+    # Multislice: N identical slices form one notebook (GKE Multislice /
+    # MEGASCALE — DCN between slices, ICI within). 1 = plain single slice.
+    slice_count: int = 1
 
     def slice_topology(self) -> SliceTopology:
         """Resolve and validate; raises InvalidTopologyError on bad input."""
+        if self.slice_count < 1:
+            raise InvalidTopologyError(
+                f"sliceCount must be >= 1, got {self.slice_count}"
+            )
         return slice_from_spec(self.accelerator, self.topology)
 
     @classmethod
@@ -69,6 +80,7 @@ class TPUSpec:
             topology=d.get("topology", ""),
             runtime_version=d.get("runtimeVersion", ""),
             spot=bool(d.get("spot", False)),
+            slice_count=int(d.get("sliceCount", 1)),
         )
 
     def to_dict(self) -> dict:
@@ -77,6 +89,8 @@ class TPUSpec:
             out["runtimeVersion"] = self.runtime_version
         if self.spot:
             out["spot"] = True
+        if self.slice_count != 1:
+            out["sliceCount"] = self.slice_count
         return out
 
 

@@ -32,7 +32,10 @@ from typing import Callable, Optional, Protocol
 
 from kubeflow_tpu.api import annotations as ann
 from kubeflow_tpu.api.notebook import Notebook
-from kubeflow_tpu.controller.notebook import headless_service_name
+from kubeflow_tpu.controller.notebook import (
+    headless_service_name,
+    slice_sts_names,
+)
 from kubeflow_tpu.k8s import objects as obj_util
 from kubeflow_tpu.k8s.client import Client, retry_on_conflict
 from kubeflow_tpu.k8s.errors import NotFoundError
@@ -250,13 +253,20 @@ class CullingReconciler(Reconciler):
                 topo = nb.tpu.slice_topology()
             except Exception:
                 topo = None
-            if topo is not None and topo.hosts > 1:
-                return topo.worker_hostnames(
-                    nb.name,
-                    headless_service_name(nb.name),
-                    nb.namespace,
-                    self.config.cluster_domain,
-                )
+            slices = nb.tpu.slice_count
+            if topo is not None and (topo.hosts > 1 or slices > 1):
+                # Every host of EVERY slice: activity anywhere (profiling
+                # server, distributed worker) must block the cull.
+                return [
+                    host
+                    for sts in slice_sts_names(nb.name, slices)
+                    for host in topo.worker_hostnames(
+                        sts,
+                        headless_service_name(nb.name),
+                        nb.namespace,
+                        self.config.cluster_domain,
+                    )
+                ]
         # Single pod: route via the plain Service, as the reference does.
         return [f"{nb.name}.{nb.namespace}.svc.{self.config.cluster_domain}"]
 

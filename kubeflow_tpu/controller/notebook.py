@@ -39,7 +39,12 @@ from kubeflow_tpu.tpu.topology import InvalidTopologyError, SliceTopology
 
 log = logging.getLogger(__name__)
 
-from kubeflow_tpu.api.names import JAX_COORDINATOR_PORT, NOTEBOOK_PORT
+from kubeflow_tpu.api.names import (
+    JAX_COORDINATOR_PORT,
+    MEGASCALE_PORT,
+    NOTEBOOK_PORT,
+)
+from kubeflow_tpu.webhook.tpu_env import upsert_env
 
 NOTEBOOK_PORT_NAME = "notebook-port"
 
@@ -76,6 +81,20 @@ class ControllerConfig:
 
 def headless_service_name(notebook_name: str) -> str:
     return f"{notebook_name}-hosts"
+
+
+def slice_sts_name(notebook_name: str, slice_id: int) -> str:
+    """StatefulSet name for one slice of a (possibly multislice) notebook.
+
+    Slice 0 keeps the bare notebook name — single-slice notebooks (the
+    overwhelmingly common case) are byte-identical to the pre-multislice
+    layout, and pod-0 DNS/routing ({name}-0) stays stable.
+    """
+    return notebook_name if slice_id == 0 else f"{notebook_name}-s{slice_id}"
+
+
+def slice_sts_names(notebook_name: str, slice_count: int) -> list[str]:
+    return [slice_sts_name(notebook_name, j) for j in range(slice_count)]
 
 
 class NotebookReconciler(Reconciler):
@@ -119,11 +138,19 @@ class NotebookReconciler(Reconciler):
             return Result()
         nb = Notebook(obj)
 
-        if len(nb.name) > MAX_NAME_LENGTH:
+        # The LONGEST generated STS name must fit: multislice appends
+        # "-s{j}", and slice 1+'s pods would silently fail to come up if
+        # only the bare name were checked.
+        slice_suffix = (
+            len(f"-s{nb.tpu.slice_count - 1}")
+            if nb.tpu is not None and nb.tpu.slice_count > 1
+            else 0
+        )
+        if len(nb.name) + slice_suffix > MAX_NAME_LENGTH:
             self.recorder.eventf(
                 obj, "Warning", "InvalidName",
-                f"Notebook name exceeds {MAX_NAME_LENGTH} characters; "
-                "StatefulSet pod hostnames would be invalid",
+                f"Notebook name plus slice suffix exceeds {MAX_NAME_LENGTH} "
+                "characters; StatefulSet pod hostnames would be invalid",
             )
             return Result()
 
@@ -144,10 +171,17 @@ class NotebookReconciler(Reconciler):
                 f"{slice_topo.accelerator_type} ({slice_topo.hosts} hosts)",
             )
 
-        sts = generate_statefulset(nb, slice_topo, self.config)
-        created = self._reconcile_statefulset(obj, sts)
-        if created:
+        slice_count = nb.tpu.slice_count if nb.tpu is not None else 1
+        created_any = False
+        for slice_id in range(slice_count):
+            sts = generate_statefulset(
+                nb, slice_topo, self.config,
+                slice_id=slice_id, slice_count=slice_count,
+            )
+            created_any |= self._reconcile_statefulset(obj, sts)
+        if created_any:
             self.metrics.create_total.inc()
+        self._prune_stale_slice_sts(nb, slice_count)
 
         service = generate_service(nb)
         helper.reconcile_child(self.client, obj, service, helper.copy_service_fields)
@@ -177,9 +211,33 @@ class NotebookReconciler(Reconciler):
                 self.metrics.create_failed_total.inc()
                 raise
             return True
+        if not obj_util.is_controlled_by(owner, existing):
+            # E.g. notebook "foo" (sliceCount 2) vs a sibling notebook
+            # literally named "foo-s1": both would claim STS "foo-s1".
+            # Never adopt — two reconcilers would fight over one object.
+            self.recorder.eventf(
+                owner, "Warning", "StatefulSetConflict",
+                f"StatefulSet {name} exists but is not controlled by this "
+                "Notebook; refusing to adopt it (name collision?)",
+            )
+            return False
         if helper.copy_statefulset_fields(desired, existing):
             self.client.update(existing)
         return False
+
+    def _prune_stale_slice_sts(self, nb: Notebook, slice_count: int) -> None:
+        """Delete per-slice StatefulSets beyond the current sliceCount (a
+        shrink while stopped; the validating webhook blocks live changes)."""
+        expected = set(slice_sts_names(nb.name, slice_count))
+        for sts in self.client.list(
+            "StatefulSet", nb.namespace, {ann.NOTEBOOK_NAME_LABEL: nb.name}
+        ):
+            name = obj_util.name_of(sts)
+            if name not in expected:
+                try:
+                    self.client.delete("StatefulSet", name, nb.namespace)
+                except NotFoundError:
+                    pass
 
     # ------------------------------------------------------------------
     def _slice_pods(self, nb: Notebook) -> list[dict]:
@@ -213,7 +271,8 @@ class NotebookReconciler(Reconciler):
                     break
 
         if slice_topo is not None:
-            hosts = slice_topo.hosts
+            slice_count = nb.tpu.slice_count if nb.tpu is not None else 1
+            hosts = slice_topo.hosts * slice_count  # total pods
             interrupted = any(
                 p.get("status", {}).get("phase") == "Failed" for p in pods
             ) or ann.TPU_SLICE_INTERRUPTED in nb.annotations
@@ -231,6 +290,9 @@ class NotebookReconciler(Reconciler):
                 "sliceHealth": health,
                 "acceleratorType": slice_topo.accelerator_type,
             }
+            if slice_count > 1:
+                status["tpu"]["slices"] = slice_count
+                status["tpu"]["hostsPerSlice"] = slice_topo.hosts
             if hosts > 1:
                 status["tpu"]["jaxCoordinator"] = (
                     f"{nb.name}-0.{headless_service_name(nb.name)}."
@@ -276,9 +338,11 @@ class NotebookReconciler(Reconciler):
         as a unit — deleting only one host would wedge jax.distributed)."""
         if nb.annotations.get(ann.RESTART) != "true":
             return
+        deleted = 0
         for pod in self._slice_pods(nb):
             try:
                 self.client.delete("Pod", obj_util.name_of(pod), nb.namespace)
+                deleted += 1
             except NotFoundError:
                 pass
 
@@ -290,15 +354,19 @@ class NotebookReconciler(Reconciler):
         retry_on_conflict(clear)
         self.recorder.eventf(
             nb.obj, "Normal", "NotebookRestarted",
-            f"All {max(1, slice_topo.hosts if slice_topo else 1)} slice pod(s) "
-            "deleted for restart",
+            f"All {max(1, deleted)} slice pod(s) deleted for restart",
         )
 
     # ------------------------------------------------------------------
     def _reemit_pod_events(self, nb: Notebook, slice_topo: Optional[SliceTopology]) -> None:
         """Surface Warning events from slice pods on the Notebook itself
         (reference :99-126 re-emits via nbNameFromInvolvedObject)."""
-        prefixes = {f"{nb.name}-{i}" for i in range(slice_topo.hosts if slice_topo else 1)}
+        slice_count = nb.tpu.slice_count if nb.tpu is not None else 1
+        prefixes = {
+            f"{sts}-{i}"
+            for sts in slice_sts_names(nb.name, slice_count)
+            for i in range(slice_topo.hosts if slice_topo else 1)
+        }
         for event in self.client.list("Event", nb.namespace):
             inv = event.get("involvedObject", {})
             if inv.get("kind") != "Pod" or inv.get("name") not in prefixes:
@@ -337,15 +405,28 @@ class NotebookReconciler(Reconciler):
 
 
 def generate_statefulset(
-    nb: Notebook, slice_topo: Optional[SliceTopology], config: ControllerConfig
+    nb: Notebook,
+    slice_topo: Optional[SliceTopology],
+    config: ControllerConfig,
+    slice_id: int = 0,
+    slice_count: int = 1,
 ) -> dict:
     """Notebook CR → StatefulSet spec (reference generateStatefulSet :433-523,
-    TPU-generalized)."""
+    TPU-generalized).
+
+    Multislice (slice_count > 1): ONE StatefulSet PER SLICE, so each pod's
+    index label is its slice-LOCAL ordinal — TPU_WORKER_ID stays a plain
+    downward-API projection and libtpu sees per-slice worker ids, exactly
+    as GKE Multislice structures its JobSets. Slice-varying env
+    (TPU_WORKER_HOSTNAMES, MEGASCALE_*) is injected here; slice-invariant
+    env comes from the webhook.
+    """
     hosts = slice_topo.hosts if slice_topo else 1
     replicas = 0 if nb.stopped else hosts
+    sts_name = slice_sts_name(nb.name, slice_id)
 
     template_labels = {
-        "statefulset": nb.name,
+        "statefulset": sts_name,
         ann.NOTEBOOK_NAME_LABEL: nb.name,
     }
     for key, value in nb.labels.items():
@@ -366,6 +447,10 @@ def generate_statefulset(
                 chips = str(slice_topo.chips_per_host)
                 resources.setdefault("limits", {})["google.com/tpu"] = chips
                 resources.setdefault("requests", {})["google.com/tpu"] = chips
+                if slice_count > 1:
+                    _apply_multislice_env(
+                        container, nb, slice_topo, config, slice_id, slice_count
+                    )
             break
 
     if config.add_fsgroup:
@@ -386,13 +471,15 @@ def generate_statefulset(
         "apiVersion": "apps/v1",
         "kind": "StatefulSet",
         "metadata": {
-            "name": nb.name,
+            "name": sts_name,
             "namespace": nb.namespace,
             "labels": dict(template_labels),
         },
         "spec": {
             "replicas": replicas,
-            "selector": {"matchLabels": {"statefulset": nb.name}},
+            # Selector keys on the PER-SLICE name: two slices' StatefulSets
+            # must never adopt each other's pods.
+            "selector": {"matchLabels": {"statefulset": sts_name}},
             "serviceName": headless_service_name(nb.name)
             if slice_topo is not None
             else nb.name,
@@ -410,6 +497,56 @@ def generate_statefulset(
         # slice and blow the <90s spawn budget.
         sts["spec"]["podManagementPolicy"] = "Parallel"
     return sts
+
+
+def _apply_multislice_env(
+    container: dict,
+    nb: Notebook,
+    slice_topo: SliceTopology,
+    config: ControllerConfig,
+    slice_id: int,
+    slice_count: int,
+) -> None:
+    """Slice-varying env for multislice notebooks.
+
+    Overrides the webhook's single-slice values where they differ:
+    TPU_WORKER_HOSTNAMES lists THIS slice's hosts (libtpu is per-slice);
+    JAX_* spans every host of every slice (jax.distributed runs one global
+    process group over DCN); MEGASCALE_* carries the slice topology
+    (SURVEY.md §5: "MEGASCALE_*/JAX_COORDINATOR style env when spanning
+    slices").
+    """
+    headless = headless_service_name(nb.name)
+    sts_name = slice_sts_name(nb.name, slice_id)
+    hostnames = slice_topo.worker_hostnames(
+        sts_name, headless, nb.namespace, config.cluster_domain
+    )
+    # Slice 0 / host 0 coordinates both planes (jax.distributed and
+    # megascale); its name is the bare notebook name, so this is stable.
+    head = (
+        f"{nb.name}-0.{headless}.{nb.namespace}.svc.{config.cluster_domain}"
+    )
+    upsert_env(
+        container,
+        [
+            {"name": "TPU_WORKER_HOSTNAMES", "value": ",".join(hostnames)},
+            {"name": "TPU_HOSTS_PER_SLICE", "value": str(slice_topo.hosts)},
+            {"name": "MEGASCALE_NUM_SLICES", "value": str(slice_count)},
+            {"name": "MEGASCALE_SLICE_ID", "value": str(slice_id)},
+            {
+                "name": "MEGASCALE_COORDINATOR_ADDRESS",
+                "value": f"{head}:{MEGASCALE_PORT}",
+            },
+            {
+                "name": "JAX_COORDINATOR_ADDRESS",
+                "value": f"{head}:{JAX_COORDINATOR_PORT}",
+            },
+            {
+                "name": "JAX_NUM_PROCESSES",
+                "value": str(slice_topo.hosts * slice_count),
+            },
+        ],
+    )
 
 
 def _apply_container_defaults(
@@ -471,7 +608,10 @@ def generate_headless_service(nb: Notebook, slice_topo: SliceTopology) -> dict:
         },
         "spec": {
             "clusterIP": "None",
-            "selector": {"statefulset": nb.name},
+            # Selects by NOTEBOOK label, not per-slice statefulset label:
+            # every slice's pods share this subdomain so cross-slice DCN
+            # (megascale, jax.distributed) resolves one flat DNS space.
+            "selector": {ann.NOTEBOOK_NAME_LABEL: nb.name},
             "publishNotReadyAddresses": True,  # hosts must resolve during formation
             "ports": [
                 {"name": "jax-coordinator", "port": JAX_COORDINATOR_PORT, "protocol": "TCP"},
@@ -502,7 +642,14 @@ def _event_to_notebook(ev) -> list[Request]:
     name = inv.get("name", "")
     base, _, ordinal = name.rpartition("-")
     if base and ordinal.isdigit():
-        return [Request(base, ev.namespace)]
+        requests = [Request(base, ev.namespace)]
+        # Multislice pods are "{nb}-s{j}-{i}"; a notebook literally named
+        # "{nb}-s{j}" is also possible, so requeue BOTH candidates (a
+        # nonexistent name reconciles to a no-op).
+        head, _, tail = base.rpartition("-")
+        if head and len(tail) > 1 and tail[0] == "s" and tail[1:].isdigit():
+            requests.append(Request(head, ev.namespace))
+        return requests
     return []
 
 

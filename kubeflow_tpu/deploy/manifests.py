@@ -43,6 +43,15 @@ def _tpu_spec_schema() -> dict:
             },
             "runtimeVersion": {"type": "string"},
             "spot": {"type": "boolean"},
+            "sliceCount": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 1,
+                "description": (
+                    "Number of identical slices forming one multislice "
+                    "notebook (DCN between slices, ICI within)."
+                ),
+            },
         },
     }
 
@@ -58,6 +67,8 @@ def _tpu_status_schema() -> dict:
                 "enum": ["Healthy", "Forming", "Interrupted", "Stopped"],
             },
             "jaxCoordinator": {"type": "string"},
+            "slices": {"type": "integer"},
+            "hostsPerSlice": {"type": "integer"},
         },
     }
 

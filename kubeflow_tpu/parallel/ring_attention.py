@@ -98,6 +98,11 @@ def make_sharded_ring_attention(mesh: Mesh):
     def attention(q, k, v, causal=True, q_offset=0, impl=None):
         if not causal:
             raise NotImplementedError("ring attention is causal-only here")
+        if q_offset:
+            raise NotImplementedError(
+                "ring attention does not support q_offset (cached "
+                "continuation); the mask is anchored at position 0"
+            )
         return _sharded(q, k, v)
 
     return attention

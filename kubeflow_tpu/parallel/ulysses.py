@@ -91,6 +91,11 @@ def make_sharded_ulysses_attention(mesh: Mesh, local_impl: str = "auto"):
     def attention(q, k, v, causal=True, q_offset=0, impl=None):
         if not causal:
             raise NotImplementedError("ulysses attention is causal-only here")
+        if q_offset:
+            raise NotImplementedError(
+                "ulysses attention does not support q_offset (cached "
+                "continuation); the mask is anchored at position 0"
+            )
         h = q.shape[1]
         tp = mesh.shape.get("tp", 1)
         if (h // tp) % sp != 0:

@@ -26,14 +26,18 @@ log = logging.getLogger(__name__)
 
 @dataclass
 class SliceRuntime:
-    """Resolved view of this host's place in the slice."""
+    """Resolved view of this host's place in the slice (or multislice)."""
 
-    worker_id: int
-    num_workers: int
+    worker_id: int  # slice-LOCAL worker id (libtpu's TPU_WORKER_ID)
+    num_workers: int  # total jax processes across ALL slices
     worker_hostnames: list[str]
     coordinator_address: str  # "" on single-host slices
     accelerator_type: str
     topology: str
+    # Multislice (MEGASCALE): which slice this host belongs to.
+    slice_id: int = 0
+    num_slices: int = 1
+    hosts_per_slice: int = 1
     distributed_initialized: bool = False
 
     @property
@@ -41,8 +45,14 @@ class SliceRuntime:
         return self.num_workers > 1
 
     @property
+    def process_id(self) -> int:
+        """Global jax.distributed process id: slices are laid out
+        contiguously, so slice j's workers are [j*hosts, (j+1)*hosts)."""
+        return self.slice_id * self.hosts_per_slice + self.worker_id
+
+    @property
     def is_coordinator(self) -> bool:
-        return self.worker_id == 0
+        return self.slice_id == 0 and self.worker_id == 0
 
     # -- mesh helpers ------------------------------------------------------
     def mesh(self, **axis_sizes: int):
@@ -84,11 +94,18 @@ class SliceRuntime:
 
 
 def runtime_from_env(env: Optional[dict] = None) -> SliceRuntime:
-    """Parse the webhook-injected environment into a SliceRuntime."""
+    """Parse the controller/webhook-injected environment into a
+    SliceRuntime (multislice-aware: MEGASCALE_* + TPU_HOSTS_PER_SLICE)."""
     env = dict(os.environ) if env is None else env
     hostnames_raw = env.get("TPU_WORKER_HOSTNAMES", "")
     hostnames = [h for h in hostnames_raw.split(",") if h]
-    num = int(env.get("JAX_NUM_PROCESSES", str(max(1, len(hostnames)))))
+    hosts_per_slice = int(
+        env.get("TPU_HOSTS_PER_SLICE") or str(max(1, len(hostnames)))
+    )
+    num_slices = int(env.get("MEGASCALE_NUM_SLICES", "1") or 1)
+    num = int(
+        env.get("JAX_NUM_PROCESSES") or str(hosts_per_slice * num_slices)
+    )
     return SliceRuntime(
         worker_id=int(env.get("TPU_WORKER_ID", "0") or 0),
         num_workers=num,
@@ -96,6 +113,9 @@ def runtime_from_env(env: Optional[dict] = None) -> SliceRuntime:
         coordinator_address=env.get("JAX_COORDINATOR_ADDRESS", ""),
         accelerator_type=env.get("TPU_ACCELERATOR_TYPE", ""),
         topology=env.get("TPU_TOPOLOGY", ""),
+        slice_id=int(env.get("MEGASCALE_SLICE_ID", "0") or 0),
+        num_slices=num_slices,
+        hosts_per_slice=hosts_per_slice,
     )
 
 
@@ -117,7 +137,7 @@ def bootstrap(
             jax.distributed.initialize(
                 coordinator_address=rt.coordinator_address,
                 num_processes=rt.num_workers,
-                process_id=rt.worker_id,
+                process_id=rt.process_id,
             )
             rt.distributed_initialized = True
         except RuntimeError as err:

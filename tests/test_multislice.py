@@ -1,0 +1,304 @@
+"""Multislice (MEGASCALE) notebooks: N slices, one notebook.
+
+Covers spec generation (per-slice StatefulSets + env), the end-to-end
+lifecycle on the fake control plane, runtime bootstrap id math, culler
+fan-out across slices, and the validating-webhook immutability rule.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.api.notebook import Notebook, TPUSpec, new_notebook
+from kubeflow_tpu.controller.culling import CullerConfig, CullingReconciler
+from kubeflow_tpu.controller.notebook import (
+    ControllerConfig,
+    generate_headless_service,
+    generate_statefulset,
+    slice_sts_names,
+)
+from kubeflow_tpu.k8s.errors import WebhookDeniedError
+from kubeflow_tpu.runtime.bootstrap import runtime_from_env
+from tests.harness import make_env
+
+
+def _ms_notebook(name="ms", namespace="ns", slices=2, accelerator="v5e",
+                 topology="4x4", **kw):
+    return new_notebook(
+        name, namespace, image="jax-notebook:latest",
+        tpu=TPUSpec(accelerator=accelerator, topology=topology,
+                    slice_count=slices),
+        **kw,
+    )
+
+
+def _env_of(sts: dict, name: str) -> dict:
+    for c in sts["spec"]["template"]["spec"]["containers"]:
+        if c.get("name") == name:
+            return {e["name"]: e.get("value") for e in c.get("env", [])}
+    raise AssertionError("primary container missing")
+
+
+class TestSpecGeneration:
+    def test_one_sts_per_slice_with_distinct_selectors(self):
+        nb = Notebook(_ms_notebook(slices=3))
+        topo = nb.tpu.slice_topology()
+        names, selectors = [], []
+        for j in range(3):
+            sts = generate_statefulset(
+                nb, topo, ControllerConfig(), slice_id=j, slice_count=3
+            )
+            names.append(sts["metadata"]["name"])
+            selectors.append(sts["spec"]["selector"]["matchLabels"]["statefulset"])
+            assert sts["spec"]["replicas"] == topo.hosts
+            assert sts["spec"]["podManagementPolicy"] == "Parallel"
+        assert names == ["ms", "ms-s1", "ms-s2"]
+        # Selectors must differ or the StatefulSets adopt each other's pods.
+        assert selectors == names
+
+    def test_megascale_env_varies_per_slice(self):
+        nb = Notebook(_ms_notebook(slices=2))
+        topo = nb.tpu.slice_topology()
+        envs = [
+            _env_of(
+                generate_statefulset(
+                    nb, topo, ControllerConfig(), slice_id=j, slice_count=2
+                ),
+                "ms",
+            )
+            for j in range(2)
+        ]
+        assert envs[0]["MEGASCALE_SLICE_ID"] == "0"
+        assert envs[1]["MEGASCALE_SLICE_ID"] == "1"
+        for env in envs:
+            assert env["MEGASCALE_NUM_SLICES"] == "2"
+            assert env["TPU_HOSTS_PER_SLICE"] == str(topo.hosts)
+            assert env["JAX_NUM_PROCESSES"] == str(2 * topo.hosts)
+            # One coordinator for both planes: slice 0, host 0.
+            assert env["MEGASCALE_COORDINATOR_ADDRESS"].startswith("ms-0.ms-hosts.")
+            assert env["JAX_COORDINATOR_ADDRESS"].startswith("ms-0.ms-hosts.")
+        # Hostnames are slice-local (libtpu's view is per-slice).
+        assert envs[0]["TPU_WORKER_HOSTNAMES"].split(",")[0].startswith("ms-0.")
+        assert envs[1]["TPU_WORKER_HOSTNAMES"].split(",")[0].startswith("ms-s1-0.")
+
+    def test_single_slice_has_no_megascale_env(self):
+        nb = Notebook(_ms_notebook(slices=1))
+        topo = nb.tpu.slice_topology()
+        sts = generate_statefulset(nb, topo, ControllerConfig())
+        env = _env_of(sts, "ms")
+        assert "MEGASCALE_SLICE_ID" not in env
+        assert sts["metadata"]["name"] == "ms"
+
+    def test_headless_service_spans_all_slices(self):
+        nb = Notebook(_ms_notebook(slices=2))
+        topo = nb.tpu.slice_topology()
+        svc = generate_headless_service(nb, topo)
+        # Notebook-name label selects every slice's pods into one subdomain.
+        assert svc["spec"]["selector"] == {"notebook-name": "ms"}
+
+    def test_slice_sts_names(self):
+        assert slice_sts_names("nb", 1) == ["nb"]
+        assert slice_sts_names("nb", 3) == ["nb", "nb-s1", "nb-s2"]
+
+
+class TestLifecycle:
+    def _make_env(self):
+        # One pool big enough for 2 slices x 4 hosts of v5e 4x4.
+        return make_env(
+            webhooks=True,
+            platform=True,
+            node_pools=(("tpu-v5-lite-podslice", "4x4", 8, 4),),
+        )
+
+    def test_multislice_comes_up_and_reports_status(self):
+        env = self._make_env()
+        env.cluster.create(_ms_notebook(name="ms", namespace="u", slices=2))
+        env.manager.run_until_idle()
+        pods = env.cluster.list("Pod", "u")
+        names = sorted(p["metadata"]["name"] for p in pods)
+        assert names == [
+            "ms-0", "ms-1", "ms-2", "ms-3",
+            "ms-s1-0", "ms-s1-1", "ms-s1-2", "ms-s1-3",
+        ]
+        nb = env.cluster.get("Notebook", "ms", "u")
+        tpu = nb["status"]["tpu"]
+        assert tpu["hosts"] == 8
+        assert tpu["readyHosts"] == 8
+        assert tpu["slices"] == 2
+        assert tpu["hostsPerSlice"] == 4
+        assert tpu["sliceHealth"] == "Healthy"
+
+    def test_stop_scales_every_slice_to_zero(self):
+        env = self._make_env()
+        env.cluster.create(_ms_notebook(name="ms", namespace="u", slices=2))
+        env.manager.run_until_idle()
+
+        nb = env.cluster.get("Notebook", "ms", "u")
+        nb["metadata"].setdefault("annotations", {})[ann.STOP] = (
+            "2026-07-29T00:00:00Z"
+        )
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+
+        for sts in env.cluster.list("StatefulSet", "u"):
+            assert sts["spec"]["replicas"] == 0
+        assert env.cluster.list("Pod", "u") == []
+
+    def test_slice_count_shrink_prunes_extra_sts(self):
+        env = self._make_env()
+        env.cluster.create(_ms_notebook(name="ms", namespace="u", slices=2))
+        env.manager.run_until_idle()
+        assert len(env.cluster.list("StatefulSet", "u")) == 2
+
+        nb = env.cluster.get("Notebook", "ms", "u")
+        nb["metadata"].setdefault("annotations", {})[ann.STOP] = (
+            "2026-07-29T00:00:00Z"
+        )
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "ms", "u")
+        nb["spec"]["tpu"]["sliceCount"] = 1
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+
+        stses = env.cluster.list("StatefulSet", "u")
+        assert [s["metadata"]["name"] for s in stses] == ["ms"]
+
+    def test_restart_deletes_pods_of_every_slice(self):
+        env = self._make_env()
+        env.cluster.create(_ms_notebook(name="ms", namespace="u", slices=2))
+        env.manager.run_until_idle()
+        before = {p["metadata"]["uid"] for p in env.cluster.list("Pod", "u")}
+
+        nb = env.cluster.get("Notebook", "ms", "u")
+        nb["metadata"].setdefault("annotations", {})[ann.RESTART] = "true"
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+
+        after = {p["metadata"]["uid"] for p in env.cluster.list("Pod", "u")}
+        assert len(after) == 8
+        assert before.isdisjoint(after)  # every pod replaced
+
+
+class TestNameCollisions:
+    def test_long_name_plus_slice_suffix_rejected(self):
+        env = make_env(node_pools=(("tpu-v5-lite-podslice", "4x4", 8, 4),))
+        # 52 chars passes bare, but "-s1" pushes slice 1 over the limit.
+        name = "n" * 52
+        env.cluster.create(_ms_notebook(name=name, namespace="u", slices=2))
+        env.manager.run_until_idle()
+        assert env.cluster.list("StatefulSet", "u") == []
+        events = [
+            e for e in env.cluster.list("Event", "u")
+            if e.get("reason") == "InvalidName"
+        ]
+        assert events
+
+    def test_single_slice_52_char_name_still_allowed(self):
+        env = make_env(node_pools=(("tpu-v5-lite-podslice", "4x4", 8, 4),))
+        name = "n" * 52
+        env.cluster.create(_ms_notebook(name=name, namespace="u", slices=1))
+        env.manager.run_until_idle()
+        assert len(env.cluster.list("StatefulSet", "u")) == 1
+
+    def test_never_adopts_sibling_notebooks_sts(self):
+        """Notebook 'foo' (sliceCount 2) must not seize the STS of a
+        notebook literally named 'foo-s1'."""
+        env = make_env(node_pools=(("tpu-v5-lite-podslice", "4x4", 16, 4),))
+        env.cluster.create(_ms_notebook(name="foo-s1", namespace="u", slices=1))
+        env.manager.run_until_idle()
+        sibling_sts = env.cluster.get("StatefulSet", "foo-s1", "u")
+        sibling_uid = sibling_sts["metadata"]["ownerReferences"][0]["uid"]
+
+        env.cluster.create(_ms_notebook(name="foo", namespace="u", slices=2))
+        env.manager.run_until_idle()
+
+        sts = env.cluster.get("StatefulSet", "foo-s1", "u")
+        assert sts["metadata"]["ownerReferences"][0]["uid"] == sibling_uid
+        env_vars = {
+            e["name"]
+            for c in sts["spec"]["template"]["spec"]["containers"]
+            for e in c.get("env", [])
+        }
+        assert "MEGASCALE_SLICE_ID" not in env_vars  # spec never overwritten
+        conflicts = [
+            e for e in env.cluster.list("Event", "u")
+            if e.get("reason") == "StatefulSetConflict"
+        ]
+        assert conflicts
+
+
+class TestValidation:
+    def test_slice_count_change_denied_while_running(self):
+        env = make_env(
+            webhooks=True, platform=True,
+            node_pools=(("tpu-v5-lite-podslice", "4x4", 8, 4),),
+        )
+        env.cluster.create(_ms_notebook(name="ms", namespace="u", slices=2))
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "ms", "u")
+        nb["spec"]["tpu"]["sliceCount"] = 4
+        with pytest.raises(WebhookDeniedError, match="cannot change"):
+            env.cluster.update(nb)
+
+    def test_zero_slice_count_denied_at_admission(self):
+        env = make_env(webhooks=True)
+        with pytest.raises(WebhookDeniedError, match="sliceCount"):
+            env.cluster.create(_ms_notebook(name="ms", namespace="u", slices=0))
+
+
+class TestRuntimeBootstrap:
+    def test_process_id_math(self):
+        rt = runtime_from_env(
+            {
+                "TPU_WORKER_ID": "2",
+                "TPU_HOSTS_PER_SLICE": "4",
+                "MEGASCALE_SLICE_ID": "1",
+                "MEGASCALE_NUM_SLICES": "2",
+                "JAX_NUM_PROCESSES": "8",
+                "TPU_WORKER_HOSTNAMES": "a,b,c,d",
+                "JAX_COORDINATOR_ADDRESS": "ms-0.ms-hosts.u.svc.cluster.local:8476",
+            }
+        )
+        assert rt.worker_id == 2  # slice-local, what libtpu sees
+        assert rt.process_id == 6  # global: 1*4 + 2
+        assert rt.num_workers == 8
+        assert rt.num_slices == 2
+        assert not rt.is_coordinator
+
+    def test_slice0_host0_is_coordinator(self):
+        rt = runtime_from_env(
+            {
+                "TPU_WORKER_ID": "0",
+                "TPU_HOSTS_PER_SLICE": "4",
+                "MEGASCALE_SLICE_ID": "0",
+                "MEGASCALE_NUM_SLICES": "2",
+            }
+        )
+        assert rt.is_coordinator and rt.process_id == 0
+
+    def test_single_slice_unchanged(self):
+        rt = runtime_from_env(
+            {
+                "TPU_WORKER_ID": "1",
+                "TPU_WORKER_HOSTNAMES": "a,b,c,d",
+                "JAX_NUM_PROCESSES": "4",
+            }
+        )
+        assert rt.process_id == rt.worker_id == 1
+        assert rt.num_slices == 1
+
+
+class TestCullerFanout:
+    def test_host_dns_covers_every_slice(self):
+        env = make_env(
+            culling=True,
+            node_pools=(("tpu-v5-lite-podslice", "4x4", 8, 4),),
+        )
+        culler = env.culler
+        nb = Notebook(_ms_notebook(name="ms", namespace="u", slices=2))
+        hosts = culler._host_dns(nb)
+        assert len(hosts) == 8
+        assert hosts[0].startswith("ms-0.ms-hosts.u.svc.")
+        assert hosts[4].startswith("ms-s1-0.ms-hosts.u.svc.")
