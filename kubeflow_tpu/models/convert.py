@@ -63,13 +63,27 @@ def _rope_scaling_from_hf(raw: Any) -> Optional[RopeScaling]:
 
 
 def config_from_hf(hf_config: Any) -> LlamaConfig:
-    """Map a transformers LlamaConfig (object or dict) to LlamaConfig."""
+    """Map a transformers config (object or dict) to LlamaConfig.
+
+    Handles the Llama-family variants that share the HF module layout:
+    llama (+3.1 rope scaling), mistral (sliding window), gemma (GeGLU,
+    1+w norms, scaled/tied embeddings, decoupled head_dim).
+    """
     get = (
         hf_config.get
         if isinstance(hf_config, Mapping)
         else lambda k, d=None: getattr(hf_config, k, d)
     )
+    model_type = get("model_type", "llama") or "llama"
+    if model_type not in ("llama", "mistral", "gemma"):
+        raise NotImplementedError(
+            f"model_type {model_type!r} is not in the supported Llama "
+            "family (llama, mistral, gemma)"
+        )
     n_heads = get("num_attention_heads")
+    default_head_dim = get("hidden_size") // n_heads
+    act = get("hidden_activation") or get("hidden_act") or "silu"
+    is_gemma = model_type == "gemma"
     return LlamaConfig(
         vocab_size=get("vocab_size"),
         dim=get("hidden_size"),
@@ -81,6 +95,16 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
         rope_scaling=_rope_scaling_from_hf(get("rope_scaling")),
         max_seq_len=get("max_position_embeddings", 4096),
         norm_eps=float(get("rms_norm_eps", 1e-5)),
+        sliding_window=int(get("sliding_window") or 0)
+        if model_type == "mistral"
+        else 0,
+        act="gelu" if act.startswith("gelu") else "silu",
+        norm_add_unit=is_gemma,
+        embed_scale=is_gemma,
+        head_dim_override=(
+            hd if (hd := get("head_dim", 0) or 0) != default_head_dim else 0
+        ),
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
     )
 
 

@@ -47,6 +47,18 @@ class RopeScaling:
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
+    """Llama-family transformer config.
+
+    The family flags cover the popular decoder-only variants without
+    separate model classes (they share the HF module layout, so
+    models/convert.py loads all of them):
+    - Mistral: ``sliding_window`` > 0 (local attention band)
+    - Gemma: ``act="gelu"`` (GeGLU, tanh approximation),
+      ``norm_add_unit`` (RMSNorm multiplies by 1+w), ``embed_scale``
+      (embeddings scaled by sqrt(dim)), ``head_dim_override`` (head_dim
+      decoupled from dim//n_heads), ``tie_embeddings``.
+    """
+
     vocab_size: int = 32000
     dim: int = 4096
     n_layers: int = 32
@@ -58,17 +70,24 @@ class LlamaConfig:
     max_seq_len: int = 4096
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    sliding_window: int = 0  # 0 = full causal attention
+    act: str = "silu"  # "silu" (llama/mistral) | "gelu" (gemma, tanh approx)
+    norm_add_unit: bool = False  # RMSNorm weight is (1 + w) (gemma)
+    embed_scale: bool = False  # scale embeddings by sqrt(dim) (gemma)
+    head_dim_override: int = 0  # 0 = dim // n_heads
+    tie_embeddings: bool = False  # lm_head shares the embedding matrix
 
     @property
     def head_dim(self) -> int:
-        return self.dim // self.n_heads
+        return self.head_dim_override or self.dim // self.n_heads
 
     def param_count(self) -> int:
         embed = self.vocab_size * self.dim
         attn = self.dim * self.head_dim * (2 * self.n_heads + 2 * self.n_kv_heads)
         mlp = 3 * self.dim * self.ffn_hidden
         norms = 2 * self.dim
-        return 2 * embed + self.n_layers * (attn + mlp + norms) + self.dim
+        n_embed = 1 if self.tie_embeddings else 2
+        return n_embed * embed + self.n_layers * (attn + mlp + norms) + self.dim
 
 
 LLAMA_CONFIGS: dict[str, LlamaConfig] = {
@@ -84,6 +103,19 @@ LLAMA_CONFIGS: dict[str, LlamaConfig] = {
                                 n_heads=32, n_kv_heads=8, ffn_hidden=14336,
                                 rope_theta=500000.0, max_seq_len=131072,
                                 rope_scaling=RopeScaling()),
+    "mistral-7b": LlamaConfig(vocab_size=32000, dim=4096, n_layers=32,
+                              n_heads=32, n_kv_heads=8, ffn_hidden=14336,
+                              max_seq_len=32768, sliding_window=4096),
+    "gemma-2b": LlamaConfig(vocab_size=256000, dim=2048, n_layers=18,
+                            n_heads=8, n_kv_heads=1, ffn_hidden=16384,
+                            max_seq_len=8192, act="gelu", norm_add_unit=True,
+                            embed_scale=True, head_dim_override=256,
+                            tie_embeddings=True),
+    "gemma-7b": LlamaConfig(vocab_size=256000, dim=3072, n_layers=28,
+                            n_heads=16, n_kv_heads=16, ffn_hidden=24576,
+                            max_seq_len=8192, act="gelu", norm_add_unit=True,
+                            embed_scale=True, head_dim_override=256,
+                            tie_embeddings=True),
     # Tiny configs for tests / compile checks.
     "tiny": LlamaConfig(vocab_size=256, dim=128, n_layers=2, n_heads=4,
                         n_kv_heads=4, ffn_hidden=256, max_seq_len=256),
@@ -132,18 +164,37 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
 # Building blocks (f32 internals, bf16 boundaries)
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, add_unit: bool = False
+) -> jax.Array:
     xf = x.astype(jnp.float32)
     rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    if add_unit:
+        # Gemma: multiply by (1 + w) in f32, THEN cast (matches HF).
+        return ((xf * rms) * (weight.astype(jnp.float32) + 1.0)).astype(x.dtype)
     return (xf * rms).astype(x.dtype) * weight
+
+
+def _norm(x: jax.Array, weight: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    return rms_norm(x, weight, cfg.norm_eps, add_unit=cfg.norm_add_unit)
+
+
+def _embed(params: dict, cfg: LlamaConfig, tokens: jax.Array) -> jax.Array:
+    x = params["embed"][tokens]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(math.sqrt(cfg.dim), x.dtype)
+    return x
 
 
 def rope_frequencies(cfg: LlamaConfig, positions: jax.Array) -> tuple[jax.Array, jax.Array]:
     """cos/sin tables for the given positions: (S, head_dim/2) each, f32."""
     half = cfg.head_dim // 2
     freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    if cfg.rope_scaling is not None:
-        freqs = _llama3_scale_freqs(cfg.rope_scaling, freqs)
+    # getattr: duck-typed configs (MoEConfig) reuse this without carrying
+    # every llama-family field.
+    scaling = getattr(cfg, "rope_scaling", None)
+    if scaling is not None:
+        freqs = _llama3_scale_freqs(scaling, freqs)
     angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
     return jnp.cos(angles), jnp.sin(angles)
 
@@ -209,21 +260,26 @@ def _layer_fwd(
     cos: jax.Array, sin: jax.Array, attn_impl: str,
 ) -> jax.Array:
     """One transformer layer, full-sequence (prefill/training)."""
-    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    h = _norm(x, layer["attn_norm"], cfg)
     q = apply_rope(_split_heads(h @ layer["wq"], cfg.n_heads), cos, sin)
     k = apply_rope(_split_heads(h @ layer["wk"], cfg.n_kv_heads), cos, sin)
     v = _split_heads(h @ layer["wv"], cfg.n_kv_heads)
     rep = cfg.n_heads // cfg.n_kv_heads
     attn = flash_attention(
-        q, _repeat_kv(k, rep), _repeat_kv(v, rep), causal=True, impl=attn_impl
+        q, _repeat_kv(k, rep), _repeat_kv(v, rep), causal=True,
+        impl=attn_impl, window=cfg.sliding_window,
     )
     x = x + _merge_heads(attn) @ layer["wo"]
-    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    return x + _mlp(layer, h)
+    h = _norm(x, layer["mlp_norm"], cfg)
+    return x + _mlp(layer, h, cfg)
 
 
-def _mlp(layer: dict, x: jax.Array) -> jax.Array:
-    gate = jax.nn.silu((x @ layer["w_gate"]).astype(jnp.float32))
+def _mlp(layer: dict, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    pre = (x @ layer["w_gate"]).astype(jnp.float32)
+    if cfg.act == "gelu":
+        gate = jax.nn.gelu(pre, approximate=True)  # pytorch-tanh gelu
+    else:
+        gate = jax.nn.silu(pre)
     up = (x @ layer["w_up"]).astype(jnp.float32)
     return ((gate * up).astype(x.dtype)) @ layer["w_down"]
 
@@ -237,14 +293,14 @@ def forward(
     params: dict, cfg: LlamaConfig, tokens: jax.Array, attn_impl: str = "auto"
 ) -> jax.Array:
     """Full prefill / training forward: tokens (B, S) → logits (B, S, V)."""
-    x = params["embed"][tokens]
+    x = _embed(params, cfg, tokens)
     cos, sin = rope_frequencies(cfg, jnp.arange(tokens.shape[1]))
 
     def body(x, layer):
         return _layer_fwd(layer, cfg, x, cos, sin, attn_impl), None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = _norm(x, params["final_norm"], cfg)
     return (x @ params["lm_head"].T).astype(jnp.float32)
 
 
@@ -316,30 +372,31 @@ def _prefill_impl(
 ) -> tuple[jax.Array, dict]:
     """Prefill: write prompt K/V into the cache AND return last-position
     logits (B, V) — one pass, no duplicated compute."""
-    x = params["embed"][tokens]
+    x = _embed(params, cfg, tokens)
     s = tokens.shape[1]
     cos, sin = rope_frequencies(cfg, jnp.arange(s))
     rep = cfg.n_heads // cfg.n_kv_heads
 
     def body(x, scanned):
         layer, k_cache, v_cache = scanned
-        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        h = _norm(x, layer["attn_norm"], cfg)
         q = apply_rope(_split_heads(h @ layer["wq"], cfg.n_heads), cos, sin)
         k = apply_rope(_split_heads(h @ layer["wk"], cfg.n_kv_heads), cos, sin)
         v = _split_heads(h @ layer["wv"], cfg.n_kv_heads)
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
         attn = flash_attention(q, _repeat_kv(k, rep), _repeat_kv(v, rep),
-                               causal=True, impl="auto")
+                               causal=True, impl="auto",
+                               window=cfg.sliding_window)
         x = x + _merge_heads(attn) @ layer["wo"]
-        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        x = x + _mlp(layer, h)
+        h = _norm(x, layer["mlp_norm"], cfg)
+        x = x + _mlp(layer, h, cfg)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
     )
-    x_last = rms_norm(x[:, -1], params["final_norm"], cfg.norm_eps)
+    x_last = _norm(x[:, -1], params["final_norm"], cfg)
     logits = (x_last @ params["lm_head"].T).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
 
@@ -358,6 +415,7 @@ def _gqa_decode_attention(
     k: jax.Array,  # (B, Hkv, L, D)
     v: jax.Array,  # (B, Hkv, L, D)
     position: jax.Array,  # scalar: q's absolute position
+    window: int = 0,
 ) -> jax.Array:
     """Grouped-query decode attention against the UNREPEATED KV cache.
 
@@ -374,8 +432,11 @@ def _gqa_decode_attention(
         jnp.einsum("bgrqd,bgkd->bgrqk", qg, k, preferred_element_type=jnp.float32)
         * scale
     )
-    k_pos = jnp.arange(k.shape[2])
-    scores = jnp.where(k_pos[None, None, None, None, :] <= position, scores, NEG_INF)
+    k_pos = jnp.arange(k.shape[2])[None, None, None, None, :]
+    mask = k_pos <= position
+    if window:
+        mask = mask & (k_pos > position - window)
+    scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bgrqk,bgkd->bgrqd", probs.astype(v.dtype), v)
     return out.reshape(b, h, sq, d)
@@ -383,27 +444,29 @@ def _gqa_decode_attention(
 
 def _decode_impl(params, cfg, token, kv_cache, position):
     """Unjitted decode body (shared by decode_step and generate_tokens)."""
-    x = params["embed"][token]
+    x = _embed(params, cfg, token)
     cos, sin = rope_frequencies(cfg, position[None])
 
     def body(x, scanned):
         layer, k_cache, v_cache = scanned
-        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        h = _norm(x, layer["attn_norm"], cfg)
         q = apply_rope(_split_heads(h @ layer["wq"], cfg.n_heads), cos, sin)
         k = apply_rope(_split_heads(h @ layer["wk"], cfg.n_kv_heads), cos, sin)
         v = _split_heads(h @ layer["wv"], cfg.n_kv_heads)
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, position, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, position, 0))
-        attn = _gqa_decode_attention(q, k_cache, v_cache, position)
+        attn = _gqa_decode_attention(
+            q, k_cache, v_cache, position, window=cfg.sliding_window
+        )
         x = x + _merge_heads(attn) @ layer["wo"]
-        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        x = x + _mlp(layer, h)
+        h = _norm(x, layer["mlp_norm"], cfg)
+        x = x + _mlp(layer, h, cfg)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
     )
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = _norm(x, params["final_norm"], cfg)
     logits = (x[:, 0] @ params["lm_head"].T).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
 

@@ -55,21 +55,30 @@ def flash_attention(
     causal: bool = True,
     q_offset: int = 0,
     impl: str = "auto",
+    window: int = 0,
 ) -> jax.Array:
     """Multi-head attention. ``q_offset`` is q's global position offset
-    relative to k (for cached prefill continuation). ``impl`` may be a
-    registered name or a callable with this same signature (mesh-bound
-    impls like ring attention are passed directly so two meshes never
-    fight over one registry name)."""
-    if callable(impl):
-        return impl(q, k, v, causal=causal, q_offset=q_offset)
-    if impl in _IMPL_REGISTRY:
-        return _IMPL_REGISTRY[impl](q, k, v, causal=causal, q_offset=q_offset)
+    relative to k (for cached prefill continuation). ``window`` > 0 adds
+    sliding-window masking (Mistral-style: query at position p attends
+    keys in (p-window, p]). ``impl`` may be a registered name or a
+    callable with this same signature (mesh-bound impls like ring
+    attention are passed directly so two meshes never fight over one
+    registry name)."""
+    if callable(impl) or impl in _IMPL_REGISTRY:
+        if window:
+            raise NotImplementedError(
+                "sequence-parallel attention impls do not support "
+                "sliding windows yet"
+            )
+        fn = impl if callable(impl) else _IMPL_REGISTRY[impl]
+        return fn(q, k, v, causal=causal, q_offset=q_offset)
     if impl == "auto":
         impl = "pallas" if _pallas_ok(q, k) else "xla"
     if impl == "pallas":
-        return _flash_attention_pallas(q, k, v, causal=causal, q_offset=q_offset)
-    return _attention_xla(q, k, v, causal=causal, q_offset=q_offset)
+        return _flash_attention_pallas(
+            q, k, v, causal=causal, q_offset=q_offset, window=window
+        )
+    return _attention_xla(q, k, v, causal=causal, q_offset=q_offset, window=window)
 
 
 def _pallas_ok(q: jax.Array, k: jax.Array) -> bool:
@@ -84,16 +93,19 @@ def _pallas_ok(q: jax.Array, k: jax.Array) -> bool:
 # XLA reference path (CPU tests, decode, ragged shapes)
 
 
-def _attention_xla(q, k, v, causal: bool, q_offset: int) -> jax.Array:
+def _attention_xla(q, k, v, causal: bool, q_offset: int, window: int = 0) -> jax.Array:
     scale = 1.0 / math.sqrt(q.shape[-1])
     scores = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
-    if causal:
+    if causal or window:
         sq, sk = q.shape[2], k.shape[2]
         q_pos = jnp.arange(sq)[:, None] + q_offset
         k_pos = jnp.arange(sk)[None, :]
-        scores = jnp.where(k_pos <= q_pos, scores, NEG_INF)
+        mask = k_pos <= q_pos if causal else jnp.ones((sq, sk), bool)
+        if window:
+            mask = mask & (k_pos > q_pos - window)
+        scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
@@ -103,7 +115,7 @@ def _attention_xla(q, k, v, causal: bool, q_offset: int) -> jax.Array:
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, q_offset: int,
-                  sk: int, scale: float):
+                  sk: int, scale: float, window: int = 0):
     # Block shapes: q (1, BLOCK_Q, D); k/v (1, sk, D); o (1, BLOCK_Q, D).
     qi = pl.program_id(1)
     q_block = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
@@ -115,7 +127,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, q_offset: int,
         k_block = k_ref[0, pl.ds(kb * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
         v_block = v_ref[0, pl.ds(kb * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
         s = jnp.dot(q_block, k_block.T, preferred_element_type=jnp.float32)
-        if causal:
+        if causal or window:
             q_pos = (
                 jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 0)
                 + qi * BLOCK_Q
@@ -125,7 +137,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, q_offset: int,
                 jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 1)
                 + kb * BLOCK_K
             )
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            mask = k_pos <= q_pos if causal else (k_pos == k_pos)
+            if window:
+                mask = mask & (k_pos > q_pos - window)
+            s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[:, None])
@@ -149,11 +164,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, q_offset: int,
         )
     else:
         last = num_k_blocks
-    m, l, o = jax.lax.fori_loop(0, last, body, (m0, l0, o0))
+    if window:
+        # Blocks entirely BELOW the window contribute nothing either: the
+        # earliest visible key for this q block is q_start - window + 1.
+        first = jnp.maximum(0, (qi * BLOCK_Q + q_offset - window + 1) // BLOCK_K)
+    else:
+        first = 0
+    m, l, o = jax.lax.fori_loop(first, last, body, (m0, l0, o0))
     o_ref[0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
 
 
-def _flash_attention_pallas(q, k, v, causal: bool, q_offset: int) -> jax.Array:
+def _flash_attention_pallas(
+    q, k, v, causal: bool, q_offset: int, window: int = 0
+) -> jax.Array:
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = 1.0 / math.sqrt(d)
@@ -162,7 +185,8 @@ def _flash_attention_pallas(q, k, v, causal: bool, q_offset: int) -> jax.Array:
     vf = v.reshape(b * h, sk, d)
     grid = (b * h, sq // BLOCK_Q)
     kernel = functools.partial(
-        _flash_kernel, causal=causal, q_offset=q_offset, sk=sk, scale=scale
+        _flash_kernel, causal=causal, q_offset=q_offset, sk=sk, scale=scale,
+        window=window,
     )
     out = pl.pallas_call(
         kernel,

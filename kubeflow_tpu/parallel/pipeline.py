@@ -25,8 +25,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.models.llama import (
     LlamaConfig,
+    _embed,
     _layer_fwd,
-    rms_norm,
+    _norm,
     rope_frequencies,
 )
 
@@ -133,11 +134,11 @@ def pipeline_forward(
     """Full forward with the layer stack pipelined; params['layers'] must be
     stage-stacked (pp, L/pp, ...)."""
     apply = make_pipelined_apply(cfg, mesh, n_micro)
-    x = params["embed"][tokens]
+    x = _embed(params, cfg, tokens)
     positions = jnp.arange(tokens.shape[1])
     cos, sin = rope_frequencies(cfg, positions)
     x = apply(params["layers"], x, cos, sin)
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = _norm(x, params["final_norm"], cfg)
     return (x @ params["lm_head"].T).astype(jnp.float32)
 
 

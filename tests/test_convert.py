@@ -151,6 +151,85 @@ def test_greedy_generation_matches_transformers():
     np.testing.assert_array_equal(ours, ref)
 
 
+def test_mistral_sliding_window_matches_transformers():
+    """Sequence LONGER than the window exercises the sliding mask."""
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        sliding_window=8,
+        rope_theta=10000.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.MistralForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.sliding_window == 8
+    f32_cfg = L.LlamaConfig(**{**cfg.__dict__, "dtype": np.float32})
+    params = params_from_hf_state_dict(f32_cfg, model.state_dict(), np.float32)
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, 256, (1, 32)).astype(np.int32)  # 32 >> window 8
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens).long()).logits.numpy()
+    ours = np.asarray(L.forward(params, f32_cfg, tokens))
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+    # Cached decode applies the same window.
+    steps = 6
+    with torch.no_grad():
+        ref_toks = model.generate(
+            torch.from_numpy(tokens).long(), max_new_tokens=steps,
+            do_sample=False, num_beams=1,
+        ).numpy()[:, tokens.shape[1]:]
+    ours_toks = np.asarray(
+        L.generate(params, f32_cfg, tokens, steps=steps,
+                   cache_len=tokens.shape[1] + steps)
+    )
+    np.testing.assert_array_equal(ours_toks, ref_toks)
+
+
+def test_gemma_matches_transformers():
+    """Gemma: GeGLU + (1+w) norms + scaled/tied embeddings + head_dim
+    decoupled from dim//n_heads."""
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=1,
+        head_dim=32,  # != 64/4
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        hidden_activation="gelu_pytorch_tanh",
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.GemmaForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.act == "gelu" and cfg.norm_add_unit and cfg.embed_scale
+    assert cfg.head_dim == 32 and cfg.tie_embeddings
+    f32_cfg = L.LlamaConfig(**{**cfg.__dict__, "dtype": np.float32})
+    sd = dict(model.state_dict())
+    sd.pop("lm_head.weight", None)  # tied
+    params = params_from_hf_state_dict(f32_cfg, sd, np.float32)
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(0, 256, (1, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens).long()).logits.numpy()
+    ours = np.asarray(L.forward(params, f32_cfg, tokens))
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_unsupported_model_type_raises():
+    with pytest.raises(NotImplementedError, match="model_type"):
+        config_from_hf({"model_type": "qwen2", "num_attention_heads": 4,
+                        "hidden_size": 64})
+
+
 def test_config_mapping_fields():
     hf_cfg, _ = _tiny_hf(n_kv_heads=2)
     cfg = config_from_hf(hf_cfg)

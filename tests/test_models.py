@@ -92,6 +92,29 @@ class TestAttentionOps:
         out_b = flash_attention(q, k_masked, v_masked, causal=True, q_offset=3, impl="xla")
         assert jnp.allclose(out_a, out_b, atol=1e-6)
 
+    def test_sliding_window_masks_old_keys(self):
+        """window=4: position p sees only keys (p-4, p] — poisoning keys
+        outside the band must not change the output."""
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 1, 16))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 16, 16))
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 16, 16))
+        # q at position 10 (offset), window 4 → sees keys 7..10 only.
+        out_a = flash_attention(q, k, v, causal=True, q_offset=10,
+                                impl="xla", window=4)
+        k_p = k.at[:, :, :7].set(99.0).at[:, :, 11:].set(99.0)
+        v_p = v.at[:, :, :7].set(99.0).at[:, :, 11:].set(99.0)
+        out_b = flash_attention(q, k_p, v_p, causal=True, q_offset=10,
+                                impl="xla", window=4)
+        assert jnp.allclose(out_a, out_b, atol=1e-6)
+
+    def test_window_wider_than_sequence_is_full_causal(self):
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 32, 16))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 32, 16))
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 32, 16))
+        full = flash_attention(q, k, v, causal=True, impl="xla")
+        windowed = flash_attention(q, k, v, causal=True, impl="xla", window=64)
+        assert jnp.allclose(full, windowed, atol=1e-6)
+
 
 class TestRingAttention:
     def test_matches_dense_sp8(self):
