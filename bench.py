@@ -41,7 +41,10 @@ ATTEMPTS = [
 ]
 
 
-def run_decode_bench(cfg_name: str, prompt_len: int, steps: int, cache_len: int):
+def run_decode_bench(
+    cfg_name: str, prompt_len: int, steps: int, cache_len: int,
+    int8: bool = False,
+):
     import jax
     import jax.numpy as jnp
 
@@ -51,6 +54,12 @@ def run_decode_bench(cfg_name: str, prompt_len: int, steps: int, cache_len: int)
     key = jax.random.PRNGKey(0)
     params = L.init_params(cfg, key)
     jax.block_until_ready(params)
+    if int8:
+        # Weight-only int8 (models/quant.py): halves HBM traffic per
+        # decoded token. free_source: bf16+int8 don't coexist in 16 GB.
+        from kubeflow_tpu.models.quant import quantize_params
+
+        params = quantize_params(params, free_source=True)
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (1, prompt_len), 0, cfg.vocab_size
     )
@@ -80,18 +89,22 @@ def run_decode_bench(cfg_name: str, prompt_len: int, steps: int, cache_len: int)
 def main() -> int:
     import jax
 
+    int8 = "--int8" in sys.argv[1:]
     device = jax.devices()[0]
     kind = getattr(device, "device_kind", str(device))
     last_err = None
     for cfg_name, prompt_len, steps, cache_len, baseline in ATTEMPTS:
         try:
-            tok_s = run_decode_bench(cfg_name, prompt_len, steps, cache_len)
+            tok_s = run_decode_bench(
+                cfg_name, prompt_len, steps, cache_len, int8=int8
+            )
             print(
                 json.dumps(
                     {
                         "metric": (
                             f"{cfg_name} greedy decode tokens/sec/chip "
-                            f"(bs=1, bf16, fused loop, {kind})"
+                            f"(bs=1, {'int8 weights' if int8 else 'bf16'}, "
+                            f"fused loop, {kind})"
                         ),
                         "value": round(tok_s, 2),
                         "unit": "tokens/sec/chip",

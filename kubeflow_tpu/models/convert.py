@@ -138,9 +138,6 @@ def params_from_hf_state_dict(
 
     def take(name: str) -> jnp.ndarray:
         key = prefix + name if not name.startswith("lm_head") else name
-        if key not in sd and name.startswith("lm_head"):
-            # tie_word_embeddings: reuse the input embedding.
-            key = prefix + "embed_tokens.weight"
         try:
             return jnp.asarray(_to_np(sd[key]), dtype)
         except KeyError:
@@ -169,12 +166,16 @@ def params_from_hf_state_dict(
         "w_up": stack_linear("layers.{}.mlp.up_proj.weight"),
         "w_down": stack_linear("layers.{}.mlp.down_proj.weight"),
     }
-    return {
+    out = {
         "embed": take("embed_tokens.weight"),
         "final_norm": take("norm.weight"),
-        "lm_head": take("lm_head.weight"),
         "layers": layers,
     }
+    # Tied configs carry no lm_head leaf (models/llama.py init_params:
+    # one storage keeps gradients tied); untied checkpoints must have it.
+    if not cfg.tie_embeddings:
+        out["lm_head"] = take("lm_head.weight")
+    return out
 
 
 def params_to_hf_state_dict(cfg: LlamaConfig, params: dict) -> dict:
@@ -183,8 +184,9 @@ def params_to_hf_state_dict(cfg: LlamaConfig, params: dict) -> dict:
     out = {
         "model.embed_tokens.weight": _f32(params["embed"]),
         "model.norm.weight": _f32(params["final_norm"]),
-        "lm_head.weight": _f32(params["lm_head"]),
     }
+    if "lm_head" in params:
+        out["lm_head.weight"] = _f32(params["lm_head"])
     names = {
         "attn_norm": ("input_layernorm.weight", False),
         "wq": ("self_attn.q_proj.weight", True),

@@ -152,16 +152,43 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
         "w_up": dense(next(lk), (L, cfg.dim, cfg.ffn_hidden)),
         "w_down": dense(next(lk), (L, cfg.ffn_hidden, cfg.dim)),
     }
-    return {
+    out = {
         "embed": dense(k_embed, (cfg.vocab_size, cfg.dim)),
         "final_norm": jnp.ones((cfg.dim,), cfg.dtype),
-        "lm_head": dense(k_head, (cfg.vocab_size, cfg.dim)),
         "layers": layers,
     }
+    # Tied configs (gemma) carry NO separate lm_head leaf: one storage,
+    # so allocation matches param_count() and — crucially — gradients
+    # from the embedding lookup and the head projection flow into the
+    # SAME leaf (two aliased leaves would silently untie during training).
+    if not cfg.tie_embeddings:
+        out["lm_head"] = dense(k_head, (cfg.vocab_size, cfg.dim))
+    return out
 
 
 # ---------------------------------------------------------------------------
 # Building blocks (f32 internals, bf16 boundaries)
+
+
+def _mm(x: jax.Array, w) -> jax.Array:
+    """x @ w where w is dense OR int8-quantized ({"q": int8, "s": scale},
+    models/quant.py). The int8 tensor is what crosses HBM; the cast and
+    per-output-channel scale fuse into the matmul epilogue under XLA —
+    this is the whole weight-only-quant decode win."""
+    if isinstance(w, dict):
+        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w
+
+
+def _lm_head_logits(x: jax.Array, params: dict) -> jax.Array:
+    """x @ lm_head.T → f32 logits. Tied trees (no "lm_head" leaf) project
+    through the embedding matrix; either may be int8-quantized with a
+    per-vocab-row scale."""
+    w = params["lm_head"] if "lm_head" in params else params["embed"]
+    if isinstance(w, dict):
+        logits = (x @ w["q"].T.astype(x.dtype)).astype(jnp.float32)
+        return logits * w["s"][:, 0]
+    return (x @ w.T).astype(jnp.float32)
 
 
 def rms_norm(
@@ -261,27 +288,27 @@ def _layer_fwd(
 ) -> jax.Array:
     """One transformer layer, full-sequence (prefill/training)."""
     h = _norm(x, layer["attn_norm"], cfg)
-    q = apply_rope(_split_heads(h @ layer["wq"], cfg.n_heads), cos, sin)
-    k = apply_rope(_split_heads(h @ layer["wk"], cfg.n_kv_heads), cos, sin)
-    v = _split_heads(h @ layer["wv"], cfg.n_kv_heads)
+    q = apply_rope(_split_heads(_mm(h, layer["wq"]), cfg.n_heads), cos, sin)
+    k = apply_rope(_split_heads(_mm(h, layer["wk"]), cfg.n_kv_heads), cos, sin)
+    v = _split_heads(_mm(h, layer["wv"]), cfg.n_kv_heads)
     rep = cfg.n_heads // cfg.n_kv_heads
     attn = flash_attention(
         q, _repeat_kv(k, rep), _repeat_kv(v, rep), causal=True,
         impl=attn_impl, window=cfg.sliding_window,
     )
-    x = x + _merge_heads(attn) @ layer["wo"]
+    x = x + _mm(_merge_heads(attn), layer["wo"])
     h = _norm(x, layer["mlp_norm"], cfg)
     return x + _mlp(layer, h, cfg)
 
 
 def _mlp(layer: dict, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
-    pre = (x @ layer["w_gate"]).astype(jnp.float32)
+    pre = _mm(x, layer["w_gate"]).astype(jnp.float32)
     if cfg.act == "gelu":
         gate = jax.nn.gelu(pre, approximate=True)  # pytorch-tanh gelu
     else:
         gate = jax.nn.silu(pre)
-    up = (x @ layer["w_up"]).astype(jnp.float32)
-    return ((gate * up).astype(x.dtype)) @ layer["w_down"]
+    up = _mm(x, layer["w_up"]).astype(jnp.float32)
+    return _mm((gate * up).astype(x.dtype), layer["w_down"])
 
 
 # ---------------------------------------------------------------------------
@@ -301,7 +328,7 @@ def forward(
 
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = _norm(x, params["final_norm"], cfg)
-    return (x @ params["lm_head"].T).astype(jnp.float32)
+    return _lm_head_logits(x, params)
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
@@ -380,15 +407,15 @@ def _prefill_impl(
     def body(x, scanned):
         layer, k_cache, v_cache = scanned
         h = _norm(x, layer["attn_norm"], cfg)
-        q = apply_rope(_split_heads(h @ layer["wq"], cfg.n_heads), cos, sin)
-        k = apply_rope(_split_heads(h @ layer["wk"], cfg.n_kv_heads), cos, sin)
-        v = _split_heads(h @ layer["wv"], cfg.n_kv_heads)
+        q = apply_rope(_split_heads(_mm(h, layer["wq"]), cfg.n_heads), cos, sin)
+        k = apply_rope(_split_heads(_mm(h, layer["wk"]), cfg.n_kv_heads), cos, sin)
+        v = _split_heads(_mm(h, layer["wv"]), cfg.n_kv_heads)
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
         attn = flash_attention(q, _repeat_kv(k, rep), _repeat_kv(v, rep),
                                causal=True, impl="auto",
                                window=cfg.sliding_window)
-        x = x + _merge_heads(attn) @ layer["wo"]
+        x = x + _mm(_merge_heads(attn), layer["wo"])
         h = _norm(x, layer["mlp_norm"], cfg)
         x = x + _mlp(layer, h, cfg)
         return x, (k_cache, v_cache)
@@ -397,7 +424,7 @@ def _prefill_impl(
         body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
     )
     x_last = _norm(x[:, -1], params["final_norm"], cfg)
-    logits = (x_last @ params["lm_head"].T).astype(jnp.float32)
+    logits = _lm_head_logits(x_last, params)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -450,15 +477,15 @@ def _decode_impl(params, cfg, token, kv_cache, position):
     def body(x, scanned):
         layer, k_cache, v_cache = scanned
         h = _norm(x, layer["attn_norm"], cfg)
-        q = apply_rope(_split_heads(h @ layer["wq"], cfg.n_heads), cos, sin)
-        k = apply_rope(_split_heads(h @ layer["wk"], cfg.n_kv_heads), cos, sin)
-        v = _split_heads(h @ layer["wv"], cfg.n_kv_heads)
+        q = apply_rope(_split_heads(_mm(h, layer["wq"]), cfg.n_heads), cos, sin)
+        k = apply_rope(_split_heads(_mm(h, layer["wk"]), cfg.n_kv_heads), cos, sin)
+        v = _split_heads(_mm(h, layer["wv"]), cfg.n_kv_heads)
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, position, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, position, 0))
         attn = _gqa_decode_attention(
             q, k_cache, v_cache, position, window=cfg.sliding_window
         )
-        x = x + _merge_heads(attn) @ layer["wo"]
+        x = x + _mm(_merge_heads(attn), layer["wo"])
         h = _norm(x, layer["mlp_norm"], cfg)
         x = x + _mlp(layer, h, cfg)
         return x, (k_cache, v_cache)
@@ -467,7 +494,7 @@ def _decode_impl(params, cfg, token, kv_cache, position):
         body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
     )
     x = _norm(x, params["final_norm"], cfg)
-    logits = (x[:, 0] @ params["lm_head"].T).astype(jnp.float32)
+    logits = _lm_head_logits(x[:, 0], params)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -484,6 +511,59 @@ def generate_tokens(
     what makes decode throughput measurable (and fast) behind any
     host↔device latency."""
     return _generate_impl(params, cfg, prompt, kv_cache, steps)
+
+
+def sample_logits(
+    logits: jax.Array,  # (B, V) f32
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Sample next tokens: temperature → top-k filter → top-p (nucleus)
+    filter → categorical. All shapes static; jit/scan-safe.
+
+    temperature == 0 is greedy (argmax), matching generate()."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]  # (B, 1)
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # Exclusive cumulative mass BEFORE each token; tokens whose prefix
+        # already covers top_p are cut. The best token always survives.
+        cum = jnp.cumsum(probs, axis=-1) - probs
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True) - 1
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, NEG_INF, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "steps", "cache_len", "temperature", "top_k", "top_p"),
+)
+def sample(
+    params: dict,
+    cfg: LlamaConfig,
+    prompt: jax.Array,  # (B, S_prompt)
+    key: jax.Array,
+    steps: int,
+    cache_len: int,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Fused sampling generation: prefill + ``steps`` sampled decode steps
+    in ONE compiled program (the sampling counterpart of generate())."""
+    kv_cache = init_kv_cache(cfg, prompt.shape[0], cache_len)
+    return _generate_impl(
+        params, cfg, prompt, kv_cache, steps,
+        key=key, temperature=temperature, top_k=top_k, top_p=top_p,
+    )
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "cache_len"))
@@ -503,20 +583,31 @@ def generate(
     return _generate_impl(params, cfg, prompt, cache, steps)
 
 
-def _generate_impl(params, cfg, prompt, kv_cache, steps):
+def _generate_impl(
+    params, cfg, prompt, kv_cache, steps,
+    key=None, temperature=0.0, top_k=0, top_p=1.0,
+):
+    """ONE fused prefill+decode loop for greedy AND sampled generation.
+
+    temperature == 0 is greedy: sample_logits short-circuits to argmax and
+    never consumes the key (a dummy key threads through the scan carry)."""
     b, s_prompt = prompt.shape
+    if key is None:
+        key = jax.random.PRNGKey(0)  # untouched when temperature == 0
     logits, kv_cache = _prefill_impl(params, cfg, prompt, kv_cache)
-    first = jnp.argmax(logits, axis=-1)[:, None]
+    key, sub = jax.random.split(key)
+    first = sample_logits(logits, sub, temperature, top_k, top_p)[:, None]
 
     def step(carry, _):
-        tok, cache, pos = carry
+        tok, cache, pos, key = carry
         logits, cache = _decode_impl(params, cfg, tok, cache, pos)
-        nxt = jnp.argmax(logits, axis=-1)[:, None]
-        return (nxt, cache, pos + 1), tok[:, 0]
+        key, sub = jax.random.split(key)
+        nxt = sample_logits(logits, sub, temperature, top_k, top_p)[:, None]
+        return (nxt, cache, pos + 1, key), tok[:, 0]
 
-    (_, _, _), toks = jax.lax.scan(
+    (_, _, _, _), toks = jax.lax.scan(
         step,
-        (first, kv_cache, jnp.asarray(s_prompt, jnp.int32)),
+        (first, kv_cache, jnp.asarray(s_prompt, jnp.int32), key),
         length=steps,
     )
     return toks.T  # (B, steps)

@@ -1,0 +1,85 @@
+"""Weight-only int8 quantization for the Llama family.
+
+Decode at batch 1 is HBM-bandwidth-bound: every generated token reads all
+~13.5 GB of bf16 weights on a 7B model. Storing the big projections as
+int8 with a per-output-channel bf16 scale halves the bytes read — XLA
+fuses the dequant (cast + scale multiply) into the matmul loop, so the
+int8 tensors are what actually crosses HBM. Expected decode speedup at
+bs=1 approaches 2× with <0.5% logit error (symmetric per-channel).
+
+The quantized tree mirrors the bf16 tree: each targeted weight becomes
+{"q": int8, "s": f32 scale broadcast over the input axis}. llama.py's
+matmul helper (_mm / _lm_head_logits) consumes either representation, so
+forward/prefill/decode/generate work unchanged.
+
+Embeddings stay bf16 (a gather, not a matmul: per-channel scales don't
+fold, and it is read once per token, not per layer).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# Stacked (L, in, out) projections plus the (V, D) lm_head.
+_LAYER_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+@partial(jax.jit, static_argnames=("axis",))
+def quantize_weight(w: jax.Array, axis: int) -> dict:
+    """Symmetric per-channel int8: scale = max|w| / 127 over ``axis``
+    (the contraction axis), so dequant is a broadcast multiply on the
+    OUTPUT side of the matmul.
+
+    Jitted so the f32 upcast stays fused into the reduce/round kernels —
+    eager mode would materialize a full f32 copy (2× the bf16 tensor),
+    which OOMs a 16 GB chip mid-way through quantizing a 7B model."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale.astype(jnp.float32)}
+
+
+def dequantize_weight(qw: dict, dtype=jnp.bfloat16) -> jax.Array:
+    return (qw["q"].astype(jnp.float32) * qw["s"]).astype(dtype)
+
+
+def quantize_params(params: dict, targets=_LAYER_TARGETS,
+                    quantize_lm_head: bool = True,
+                    free_source: bool = False) -> dict:
+    """bf16 param tree → mixed tree with int8 projections.
+
+    Stacked layer weights (L, in, out) contract over axis 1; lm_head
+    (V, D) contracts over axis 1 (used as x @ lm_head.T).
+
+    ``free_source=True`` DELETES each bf16 source buffer as soon as its
+    int8 copy exists — required to quantize a 7B model in place on a
+    16 GB chip (13.5 GB bf16 + 7 GB int8 would not coexist). The input
+    tree's projection leaves are invalid afterwards."""
+    layers = dict(params["layers"])
+    for t in targets:
+        src = layers[t]
+        layers[t] = jax.block_until_ready(quantize_weight(src, axis=1))
+        if free_source:
+            src.delete()
+    out = {**params, "layers": layers}
+    # Tied trees have no lm_head leaf; the projection then goes through
+    # the (unquantized) embedding, which is also the gather table.
+    if quantize_lm_head and "lm_head" in params:
+        out["lm_head"] = jax.block_until_ready(
+            quantize_weight(params["lm_head"], axis=1)
+        )
+        if free_source:
+            params["lm_head"].delete()
+    return out
+
+
+def quantized_bytes(params: dict) -> int:
+    """HBM bytes of a (possibly mixed) param tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        total += leaf.size * leaf.dtype.itemsize
+    return total

@@ -27,6 +27,7 @@ from kubeflow_tpu.models.llama import (
     LlamaConfig,
     _embed,
     _layer_fwd,
+    _lm_head_logits,
     _norm,
     rope_frequencies,
 )
@@ -139,7 +140,7 @@ def pipeline_forward(
     cos, sin = rope_frequencies(cfg, positions)
     x = apply(params["layers"], x, cos, sin)
     x = _norm(x, params["final_norm"], cfg)
-    return (x @ params["lm_head"].T).astype(jnp.float32)
+    return _lm_head_logits(x, params)
 
 
 def shard_pipeline_params(params: dict, mesh: Mesh) -> dict:
