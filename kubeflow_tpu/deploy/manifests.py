@@ -478,6 +478,96 @@ def webhook_configurations() -> list[dict]:
 # Samples
 
 
+DEFAULT_PREPULL_IMAGES = ("jax-notebook:latest",)
+
+
+def image_prepuller_daemonset(images=DEFAULT_PREPULL_IMAGES) -> dict:
+    """DaemonSet that pre-pulls notebook images onto every TPU node.
+
+    Image pull is the dominant variable cost in the <90s p50 spawn budget
+    (BASELINE.md north star): multi-GB notebook images pulled at spawn
+    time blow it on cold nodes. Each image runs as an initContainer that
+    exits immediately; the pause main container keeps the pod (and the
+    cached image layers) resident. Targets any node carrying the GKE TPU
+    accelerator label via an Exists affinity."""
+    # A prepull container must exit 0 no matter what the target image
+    # contains — distroless/scratch images ship NO binaries at all. The
+    # standard warm-puller recipe: copy a static no-op binary out of
+    # busybox into an emptyDir first, then run THAT from every target
+    # image's filesystem.
+    tools_mount = {"name": "prepull-tools", "mountPath": "/prepull-tools"}
+    init = [
+        {
+            "name": "copy-noop",
+            "image": "busybox:1.36",
+            "command": ["cp", "/bin/sleep", "/prepull-tools/noop"],
+            "volumeMounts": [tools_mount],
+            "resources": {"limits": {"cpu": "100m", "memory": "64Mi"}},
+        }
+    ] + [
+        {
+            "name": f"prepull-{i}",
+            "image": image,
+            "command": ["/prepull-tools/noop", "0"],
+            "volumeMounts": [tools_mount],
+            "resources": {"limits": {"cpu": "100m", "memory": "64Mi"}},
+        }
+        for i, image in enumerate(images)
+    ]
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {
+            "name": "notebook-image-prepuller",
+            "namespace": "system",
+            "labels": {"app": "notebook-image-prepuller"},
+        },
+        "spec": {
+            "selector": {"matchLabels": {"app": "notebook-image-prepuller"}},
+            "updateStrategy": {"type": "RollingUpdate"},
+            "template": {
+                "metadata": {"labels": {"app": "notebook-image-prepuller"}},
+                "spec": {
+                    "affinity": {
+                        "nodeAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": {
+                                "nodeSelectorTerms": [
+                                    {
+                                        "matchExpressions": [
+                                            {
+                                                "key": "cloud.google.com/gke-tpu-accelerator",
+                                                "operator": "Exists",
+                                            }
+                                        ]
+                                    }
+                                ]
+                            }
+                        }
+                    },
+                    "tolerations": [
+                        {
+                            "key": "google.com/tpu",
+                            "operator": "Exists",
+                            "effect": "NoSchedule",
+                        }
+                    ],
+                    "volumes": [{"name": "prepull-tools", "emptyDir": {}}],
+                    "initContainers": init,
+                    "containers": [
+                        {
+                            "name": "pause",
+                            "image": "registry.k8s.io/pause:3.9",
+                            "resources": {
+                                "limits": {"cpu": "10m", "memory": "16Mi"}
+                            },
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
 def sample_cpu_notebook() -> dict:
     return {
         "apiVersion": f"{GROUP}/v1",

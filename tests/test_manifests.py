@@ -143,3 +143,39 @@ def test_rendered_yaml_parses_and_kustomizations_resolve():
                     str(target) in {str(Path(r)) for r in files}
                     or str(target) in dirs
                 ), f"{rel} references missing {res}"
+
+
+def test_image_prepuller_targets_tpu_nodes_only():
+    """The GKE overlay's pre-puller (spawn-latency lever, BASELINE <90s
+    north star) must land on TPU nodes and tolerate the TPU taint."""
+    from kubeflow_tpu.deploy.manifests import image_prepuller_daemonset
+
+    ds = image_prepuller_daemonset(("img-a:1", "img-b:2"))
+    spec = ds["spec"]["template"]["spec"]
+    expr = spec["affinity"]["nodeAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ]["nodeSelectorTerms"][0]["matchExpressions"][0]
+    assert expr == {
+        "key": "cloud.google.com/gke-tpu-accelerator",
+        "operator": "Exists",
+    }
+    assert any(t["key"] == "google.com/tpu" for t in spec["tolerations"])
+    # First init copies a static no-op out of busybox; the prepull inits
+    # run THAT, so distroless/scratch target images (no binaries at all)
+    # still exit 0 instead of crash-looping the DaemonSet.
+    inits = spec["initContainers"]
+    assert inits[0]["image"].startswith("busybox")
+    assert [c["image"] for c in inits[1:]] == ["img-a:1", "img-b:2"]
+    for c in inits[1:]:
+        assert c["command"][0].startswith("/prepull-tools/")
+    # Main container only keeps the pod resident; init containers did the pull.
+    assert len(spec["containers"]) == 1
+
+
+def test_gke_overlay_namespaces_the_prepuller():
+    """Overlay-level resources bypass the base's namespace transformer;
+    the overlay must set the namespace itself or the DaemonSet lands in
+    the nonexistent 'system' namespace."""
+    files = render_all()
+    overlay = yaml.safe_load(files["config/overlays/gke/kustomization.yaml"])
+    assert overlay["namespace"] == "kubeflow-tpu-system"
