@@ -498,9 +498,12 @@ def image_prepuller_daemonset(images=DEFAULT_PREPULL_IMAGES) -> dict:
     tools_mount = {"name": "prepull-tools", "mountPath": "/prepull-tools"}
     init = [
         {
-            "name": "copy-noop",
+            "name": "copy-busybox",
             "image": "busybox:1.36",
-            "command": ["cp", "/bin/sleep", "/prepull-tools/noop"],
+            # busybox is a MULTICALL binary dispatching on argv[0]: it must
+            # be copied under its own name and invoked as "busybox sleep",
+            # not renamed (argv[0]="noop" would exit 127 applet-not-found).
+            "command": ["cp", "/bin/busybox", "/prepull-tools/busybox"],
             "volumeMounts": [tools_mount],
             "resources": {"limits": {"cpu": "100m", "memory": "64Mi"}},
         }
@@ -508,7 +511,7 @@ def image_prepuller_daemonset(images=DEFAULT_PREPULL_IMAGES) -> dict:
         {
             "name": f"prepull-{i}",
             "image": image,
-            "command": ["/prepull-tools/noop", "0"],
+            "command": ["/prepull-tools/busybox", "sleep", "0"],
             "volumeMounts": [tools_mount],
             "resources": {"limits": {"cpu": "100m", "memory": "64Mi"}},
         }

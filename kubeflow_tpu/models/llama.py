@@ -395,10 +395,16 @@ def greedy_generate(
 
 
 def _prefill_impl(
-    params: dict, cfg: LlamaConfig, tokens: jax.Array, kv_cache: dict
+    params: dict, cfg: LlamaConfig, tokens: jax.Array, kv_cache: dict,
+    kv_mask: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, dict]:
     """Prefill: write prompt K/V into the cache AND return last-position
-    logits (B, V) — one pass, no duplicated compute."""
+    logits (B, V) — one pass, no duplicated compute.
+
+    ``kv_mask`` (B, S) bool marks real (non-pad) prompt tokens for
+    LEFT-padded batches. RoPE positions stay absolute cache indices: rope
+    is shift-equivariant, so a per-sequence pad offset cancels in q·k and
+    the result matches HF's pad-adjusted position_ids exactly."""
     x = _embed(params, cfg, tokens)
     s = tokens.shape[1]
     cos, sin = rope_frequencies(cfg, jnp.arange(s))
@@ -414,7 +420,7 @@ def _prefill_impl(
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
         attn = flash_attention(q, _repeat_kv(k, rep), _repeat_kv(v, rep),
                                causal=True, impl="auto",
-                               window=cfg.sliding_window)
+                               window=cfg.sliding_window, kv_mask=kv_mask)
         x = x + _mm(_merge_heads(attn), layer["wo"])
         h = _norm(x, layer["mlp_norm"], cfg)
         x = x + _mlp(layer, h, cfg)
@@ -443,6 +449,7 @@ def _gqa_decode_attention(
     v: jax.Array,  # (B, Hkv, L, D)
     position: jax.Array,  # scalar: q's absolute position
     window: int = 0,
+    kv_mask: Optional[jax.Array] = None,  # (B, L) valid-key mask
 ) -> jax.Array:
     """Grouped-query decode attention against the UNREPEATED KV cache.
 
@@ -463,14 +470,18 @@ def _gqa_decode_attention(
     mask = k_pos <= position
     if window:
         mask = mask & (k_pos > position - window)
+    if kv_mask is not None:
+        mask = mask & kv_mask[:, None, None, None, :]
     scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bgrqk,bgkd->bgrqd", probs.astype(v.dtype), v)
     return out.reshape(b, h, sq, d)
 
 
-def _decode_impl(params, cfg, token, kv_cache, position):
-    """Unjitted decode body (shared by decode_step and generate_tokens)."""
+def _decode_impl(params, cfg, token, kv_cache, position, kv_mask=None):
+    """Unjitted decode body (shared by decode_step and generate_tokens).
+    ``kv_mask`` (B, cache_len) marks valid cache slots (serving: False on
+    left-pad slots; slots past ``position`` are causally excluded anyway)."""
     x = _embed(params, cfg, token)
     cos, sin = rope_frequencies(cfg, position[None])
 
@@ -483,7 +494,8 @@ def _decode_impl(params, cfg, token, kv_cache, position):
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, position, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, position, 0))
         attn = _gqa_decode_attention(
-            q, k_cache, v_cache, position, window=cfg.sliding_window
+            q, k_cache, v_cache, position, window=cfg.sliding_window,
+            kv_mask=kv_mask,
         )
         x = x + _mm(_merge_heads(attn), layer["wo"])
         h = _norm(x, layer["mlp_norm"], cfg)

@@ -1,0 +1,166 @@
+"""Batched in-notebook serving for the Llama family.
+
+Variable-length prompts are LEFT-padded to one static shape, generation
+runs as ONE fused prefill+decode program per (batch, prompt_len, steps)
+bucket, and per-sequence EOS is handled inside the scan (finished rows
+emit pad and stop influencing anything). Static shapes are the TPU
+constraint this design serves: XLA compiles a handful of bucketed
+programs instead of one per request shape.
+
+Why left-padding works unmodified:
+- every sequence ENDS at the same index, so the decode write position
+  stays one scalar;
+- pad slots are excluded via a STATIC kv_mask (True for all generated
+  slots — causality already hides the future);
+- RoPE uses absolute cache indices: rope is shift-equivariant, so the
+  per-sequence pad offset cancels in q·k, matching HF's pad-adjusted
+  position_ids numerically.
+
+No reference counterpart (control plane only); this is the in-notebook
+inference surface next to train/LoRA/quant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.llama import (
+    LlamaConfig,
+    _decode_impl,
+    _prefill_impl,
+    init_kv_cache,
+    sample_logits,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    max_new_tokens: int = 128
+    temperature: float = 0.0  # 0 = greedy
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: int = 2  # llama tokenizer </s>
+    pad_id: int = 0
+
+
+def left_pad(
+    prompts: Sequence[Sequence[int]], pad_id: int, length: Optional[int] = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Ragged token lists → (tokens (B, L) int32, mask (B, L) bool)."""
+    if not prompts:
+        raise ValueError("empty prompt batch")
+    longest = max(len(p) for p in prompts)
+    length = longest if length is None else length
+    if length < longest:
+        raise ValueError(f"length {length} < longest prompt {longest}")
+    batch = len(prompts)
+    tokens = np.full((batch, length), pad_id, np.int32)
+    mask = np.zeros((batch, length), bool)
+    for i, prompt in enumerate(prompts):
+        if len(prompt) == 0:
+            raise ValueError(f"prompt {i} is empty")
+        tokens[i, length - len(prompt):] = np.asarray(prompt, np.int32)
+        mask[i, length - len(prompt):] = True
+    return tokens, mask
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "steps", "cache_len", "temperature", "top_k", "top_p",
+                     "eos_id", "pad_id"),
+)
+def _batch_generate_fused(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # (B, L) left-padded
+    prompt_mask: Optional[jax.Array],  # (B, L) bool; None = no padding
+    key: jax.Array,
+    steps: int,
+    cache_len: int,
+    temperature: float,
+    top_k: int,
+    top_p: float,
+    eos_id: int,
+    pad_id: int,
+) -> tuple[jax.Array, jax.Array]:
+    """(generated (B, steps), lengths (B,)) in one compiled program."""
+    b, s_prompt = tokens.shape
+    kv_cache = init_kv_cache(cfg, b, cache_len)
+    # Static full-cache mask: pad slots False forever, every slot from the
+    # prompt end onward True (causality hides not-yet-written slots).
+    kv_mask = (
+        None
+        if prompt_mask is None
+        else jnp.concatenate(
+            [prompt_mask, jnp.ones((b, cache_len - s_prompt), bool)], axis=1
+        )
+    )
+    logits, kv_cache = _prefill_impl(
+        params, cfg, tokens, kv_cache, kv_mask=prompt_mask
+    )
+    key, sub = jax.random.split(key)
+    first = sample_logits(logits, sub, temperature, top_k, top_p)
+    done0 = first == eos_id
+    first = jnp.where(done0, pad_id, first)[:, None]
+
+    def step(carry, _):
+        tok, cache, pos, key, done = carry
+        logits, cache = _decode_impl(
+            params, cfg, tok, cache, pos, kv_mask=kv_mask
+        )
+        key, sub = jax.random.split(key)
+        nxt = sample_logits(logits, sub, temperature, top_k, top_p)
+        now_done = done | (nxt == eos_id)
+        nxt = jnp.where(now_done, pad_id, nxt)[:, None]
+        # Emit the carry token WITH its done-before flag: valid-length
+        # counting must not key on pad_id (a model may legitimately emit
+        # token 0).
+        return (nxt, cache, pos + 1, key, now_done), (tok[:, 0], done)
+
+    (_, _, _, _, _), (toks, dones) = jax.lax.scan(
+        step,
+        (first, kv_cache, jnp.asarray(s_prompt, jnp.int32), key, done0),
+        length=steps,
+    )
+    out = toks.T  # (B, steps)
+    lengths = jnp.sum(~dones.T, axis=1)
+    return out, lengths
+
+
+def batch_generate(
+    params: dict,
+    cfg: LlamaConfig,
+    prompts: Sequence[Sequence[int]],
+    gen: Optional[GenerationConfig] = None,
+    key: Optional[jax.Array] = None,
+    pad_to: Optional[int] = None,
+) -> list[list[int]]:
+    """Generate completions for a ragged batch of prompts.
+
+    Returns one token list per prompt, truncated at (and excluding) EOS.
+    ``pad_to`` buckets the prompt length so repeated calls reuse one
+    compiled program.
+    """
+    gen = gen or GenerationConfig()
+    key = jax.random.PRNGKey(0) if key is None else key
+    tokens, np_mask = left_pad(prompts, gen.pad_id, pad_to)
+    # Uniform-length bucket: drop the all-True mask (host-side check,
+    # before jit) so prefill keeps the pallas flash kernel — auto falls
+    # back to the XLA path whenever any kv_mask is present.
+    mask = None if np_mask.all() else jnp.asarray(np_mask)
+    cache_len = tokens.shape[1] + gen.max_new_tokens
+    out, lengths = _batch_generate_fused(
+        params, cfg, jnp.asarray(tokens), mask, key,
+        steps=gen.max_new_tokens, cache_len=cache_len,
+        temperature=gen.temperature, top_k=gen.top_k, top_p=gen.top_p,
+        eos_id=gen.eos_id, pad_id=gen.pad_id,
+    )
+    out = np.asarray(out)
+    lengths = np.asarray(lengths)
+    return [list(row[:n]) for row, n in zip(out, lengths)]

@@ -56,29 +56,41 @@ def flash_attention(
     q_offset: int = 0,
     impl: str = "auto",
     window: int = 0,
+    kv_mask: "jax.Array | None" = None,
 ) -> jax.Array:
     """Multi-head attention. ``q_offset`` is q's global position offset
     relative to k (for cached prefill continuation). ``window`` > 0 adds
     sliding-window masking (Mistral-style: query at position p attends
-    keys in (p-window, p]). ``impl`` may be a registered name or a
-    callable with this same signature (mesh-bound impls like ring
-    attention are passed directly so two meshes never fight over one
-    registry name)."""
+    keys in (p-window, p]). ``kv_mask`` (B, Sk) bool marks VALID key
+    positions — False keys (left-padding in batched serving) are masked
+    for every query. ``impl`` may be a registered name or a callable with
+    this same signature (mesh-bound impls like ring attention are passed
+    directly so two meshes never fight over one registry name)."""
     if callable(impl) or impl in _IMPL_REGISTRY:
-        if window:
+        if window or kv_mask is not None:
             raise NotImplementedError(
                 "sequence-parallel attention impls do not support "
-                "sliding windows yet"
+                "sliding windows / padding masks yet"
             )
         fn = impl if callable(impl) else _IMPL_REGISTRY[impl]
         return fn(q, k, v, causal=causal, q_offset=q_offset)
     if impl == "auto":
-        impl = "pallas" if _pallas_ok(q, k) else "xla"
+        impl = "pallas" if (kv_mask is None and _pallas_ok(q, k)) else "xla"
     if impl == "pallas":
+        if kv_mask is not None:
+            # Fail loudly: a silent XLA fallback would make explicit
+            # pallas benchmarks/tests measure the wrong code path.
+            raise NotImplementedError(
+                "the pallas kernel does not support kv_mask; use "
+                "impl='auto'/'xla' for padded batches"
+            )
         return _flash_attention_pallas(
             q, k, v, causal=causal, q_offset=q_offset, window=window
         )
-    return _attention_xla(q, k, v, causal=causal, q_offset=q_offset, window=window)
+    return _attention_xla(
+        q, k, v, causal=causal, q_offset=q_offset, window=window,
+        kv_mask=kv_mask,
+    )
 
 
 def _pallas_ok(q: jax.Array, k: jax.Array) -> bool:
@@ -93,7 +105,9 @@ def _pallas_ok(q: jax.Array, k: jax.Array) -> bool:
 # XLA reference path (CPU tests, decode, ragged shapes)
 
 
-def _attention_xla(q, k, v, causal: bool, q_offset: int, window: int = 0) -> jax.Array:
+def _attention_xla(
+    q, k, v, causal: bool, q_offset: int, window: int = 0, kv_mask=None
+) -> jax.Array:
     scale = 1.0 / math.sqrt(q.shape[-1])
     scores = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
@@ -106,6 +120,8 @@ def _attention_xla(q, k, v, causal: bool, q_offset: int, window: int = 0) -> jax
         if window:
             mask = mask & (k_pos > q_pos - window)
         scores = jnp.where(mask, scores, NEG_INF)
+    if kv_mask is not None:
+        scores = jnp.where(kv_mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 

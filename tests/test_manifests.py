@@ -165,9 +165,12 @@ def test_image_prepuller_targets_tpu_nodes_only():
     # still exit 0 instead of crash-looping the DaemonSet.
     inits = spec["initContainers"]
     assert inits[0]["image"].startswith("busybox")
+    # busybox dispatches applets by argv[0]: the binary must keep its own
+    # name and be invoked as "busybox sleep", never renamed (exit 127).
+    assert inits[0]["command"][-2:] == ["/bin/busybox", "/prepull-tools/busybox"]
     assert [c["image"] for c in inits[1:]] == ["img-a:1", "img-b:2"]
     for c in inits[1:]:
-        assert c["command"][0].startswith("/prepull-tools/")
+        assert c["command"][:2] == ["/prepull-tools/busybox", "sleep"]
     # Main container only keeps the pod resident; init containers did the pull.
     assert len(spec["containers"]) == 1
 

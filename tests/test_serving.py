@@ -1,0 +1,146 @@
+"""Batched left-padded serving: HF parity and EOS semantics."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama as L
+from kubeflow_tpu.models.convert import config_from_hf, params_from_hf_state_dict
+from kubeflow_tpu.models.serving import (
+    GenerationConfig,
+    batch_generate,
+    left_pad,
+)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def hf_pair():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-5,
+        attn_implementation="eager",
+        pad_token_id=0,
+        eos_token_id=2,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg)
+    cfg = L.LlamaConfig(**{**cfg.__dict__, "dtype": np.float32})
+    params = params_from_hf_state_dict(cfg, model.state_dict(), np.float32)
+    return model, cfg, params
+
+
+class TestLeftPad:
+    def test_pads_on_the_left(self):
+        tokens, mask = left_pad([[5, 6], [7, 8, 9, 10]], pad_id=0)
+        np.testing.assert_array_equal(
+            tokens, [[0, 0, 5, 6], [7, 8, 9, 10]]
+        )
+        np.testing.assert_array_equal(
+            mask, [[False, False, True, True], [True] * 4]
+        )
+
+    def test_explicit_bucket_length(self):
+        tokens, _ = left_pad([[1]], pad_id=9, length=8)
+        assert tokens.shape == (1, 8) and tokens[0, -1] == 1
+
+    def test_rejects_too_small_bucket_and_empties(self):
+        with pytest.raises(ValueError, match="longest"):
+            left_pad([[1, 2, 3]], 0, length=2)
+        with pytest.raises(ValueError, match="empty prompt batch"):
+            left_pad([], 0)
+        with pytest.raises(ValueError, match="prompt 1 is empty"):
+            left_pad([[1], []], 0)
+
+
+class TestHFParity:
+    def test_ragged_batch_matches_transformers(self, hf_pair):
+        """The core claim: left-padding + static kv_mask + absolute rope
+        positions == HF's pad-adjusted position_ids, token for token."""
+        model, cfg, params = hf_pair
+        rng = np.random.default_rng(0)
+        prompts = [
+            list(rng.integers(3, 256, size=n)) for n in (5, 11, 8)
+        ]
+        steps = 10
+        tokens, mask = left_pad(prompts, pad_id=0)
+        with torch.no_grad():
+            ref = model.generate(
+                torch.from_numpy(tokens).long(),
+                attention_mask=torch.from_numpy(mask).long(),
+                max_new_tokens=steps,
+                do_sample=False,
+                num_beams=1,
+                eos_token_id=None,  # force full length for the comparison
+                pad_token_id=0,
+            ).numpy()[:, tokens.shape[1]:]
+        ours = batch_generate(
+            params, cfg, prompts,
+            GenerationConfig(max_new_tokens=steps, eos_id=-1),
+        )
+        for row, expected in zip(ours, ref):
+            np.testing.assert_array_equal(np.asarray(row), expected)
+
+    def test_batched_matches_single(self, hf_pair):
+        """A sequence's output must not depend on its batch neighbors."""
+        _, cfg, params = hf_pair
+        rng = np.random.default_rng(1)
+        prompts = [list(rng.integers(3, 256, size=n)) for n in (4, 9)]
+        gen = GenerationConfig(max_new_tokens=8, eos_id=-1)
+        batched = batch_generate(params, cfg, prompts, gen)
+        singles = [batch_generate(params, cfg, [p], gen)[0] for p in prompts]
+        assert batched == singles
+
+
+class TestEos:
+    def test_eos_truncates_per_sequence(self, hf_pair):
+        _, cfg, params = hf_pair
+        rng = np.random.default_rng(2)
+        prompts = [list(rng.integers(3, 256, size=6)) for _ in range(3)]
+        # Find what each row greedily generates, then declare one row's
+        # second token as "EOS" and check truncation.
+        free = batch_generate(
+            params, cfg, prompts, GenerationConfig(max_new_tokens=6, eos_id=-1)
+        )
+        eos = free[1][1]
+        out = batch_generate(
+            params, cfg, prompts,
+            GenerationConfig(max_new_tokens=6, eos_id=int(eos)),
+        )
+        assert len(out[1]) <= 1  # truncated at its EOS (excluded)
+        for i in (0, 2):
+            # Other rows unaffected up to their own first eos occurrence.
+            expected = free[i]
+            cut = expected.index(eos) if eos in expected else len(expected)
+            assert out[i] == expected[:cut]
+
+    def test_uniform_batch_skips_mask_and_matches_ragged_path(self, hf_pair):
+        """Equal-length prompts drop the kv_mask (keeping the pallas
+        prefill on TPU); results must equal the masked path's."""
+        _, cfg, params = hf_pair
+        rng = np.random.default_rng(3)
+        prompts = [list(rng.integers(3, 256, size=7)) for _ in range(2)]
+        gen = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        uniform = batch_generate(params, cfg, prompts, gen)
+        # Same prompts forced through the masked path via a wider bucket
+        # (mask has False slots even though content is identical).
+        ragged = batch_generate(params, cfg, prompts, gen, pad_to=12)
+        assert uniform == ragged
+
+    def test_bucketing_reuses_compiled_program(self, hf_pair):
+        _, cfg, params = hf_pair
+        gen = GenerationConfig(max_new_tokens=4, eos_id=-1)
+        a = batch_generate(params, cfg, [[5, 6, 7]], gen, pad_to=16)
+        b = batch_generate(params, cfg, [[9] * 10], gen, pad_to=16)
+        assert len(a[0]) == 4 and len(b[0]) == 4
