@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""LoRA fine-tune a Llama-family model, then export merged weights.
+
+With --checkpoint, loads real HF weights (safetensors dir); otherwise
+random-init tiny for a smoke run. Only the adapters carry gradients and
+optimizer state (~0.1% of the model at rank 8), so a 7B fine-tune fits
+next to its frozen bf16 base on one v5e chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+# Runnable straight from a checkout (pip install not required in-notebook).
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="tiny")
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--rank", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--export", default=None, help="write merged HF state dict (.npz)")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.models import llama as L
+    from kubeflow_tpu.models.convert import load_hf_checkpoint, params_to_hf_state_dict
+    from kubeflow_tpu.models.lora import (
+        LoraConfig,
+        init_lora_params,
+        lora_param_count,
+        make_lora_train_step,
+        merge_lora,
+    )
+
+    if args.checkpoint:
+        cfg, params = load_hf_checkpoint(args.checkpoint)
+    else:
+        cfg = L.LLAMA_CONFIGS[args.config]
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+
+    lcfg = LoraConfig(rank=args.rank)
+    lora = init_lora_params(cfg, lcfg, jax.random.PRNGKey(1))
+    print(
+        f"base {cfg.param_count()/1e6:.1f}M params frozen; "
+        f"training {lora_param_count(cfg, lcfg)/1e3:.1f}K adapter params"
+    )
+
+    init_state, step = make_lora_train_step(cfg, lcfg, learning_rate=args.lr)
+    state = init_state(lora)
+    key = jax.random.PRNGKey(2)
+    for i in range(args.steps):
+        key, sub = jax.random.split(key)
+        tokens = jax.random.randint(sub, (4, 64), 0, cfg.vocab_size)
+        state, loss = step(state, params, tokens)
+        print(f"step {i + 1}: loss {float(loss):.4f}")
+
+    merged = merge_lora(params, state["lora"], lcfg)
+    if args.export:
+        sd = params_to_hf_state_dict(cfg, merged)
+        np.savez(args.export, **sd)
+        print(f"merged HF state dict → {args.export}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Batched generation service loop: quantize, bucket, generate.
+
+With --checkpoint, loads real HF weights and (optionally) the matching
+tokenizer for text I/O; otherwise random-init tiny and raw token IDs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+# Runnable straight from a checkout (pip install not required in-notebook).
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="tiny")
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--int8", action="store_true")
+    ap.add_argument("--max-new-tokens", type=int, default=16)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--prompt", action="append", default=None,
+                    help="text prompt (needs --checkpoint tokenizer); repeatable")
+    args = ap.parse_args()
+
+    import jax
+
+    from kubeflow_tpu.models import llama as L
+    from kubeflow_tpu.models.convert import load_hf_checkpoint
+    from kubeflow_tpu.models.quant import quantize_params
+    from kubeflow_tpu.models.serving import GenerationConfig, batch_generate
+
+    tokenizer = None
+    if args.checkpoint:
+        cfg, params = load_hf_checkpoint(args.checkpoint)
+        try:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(args.checkpoint)
+        except Exception:
+            pass
+    else:
+        cfg = L.LLAMA_CONFIGS[args.config]
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+
+    if args.int8:
+        params = quantize_params(params, free_source=True)
+        print("int8 weight-only quantization applied (~2x decode)")
+
+    if tokenizer is not None and args.prompt:
+        prompts = [tokenizer(p)["input_ids"] for p in args.prompt]
+        eos = tokenizer.eos_token_id
+    else:
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        prompts = [list(rng.integers(3, cfg.vocab_size, size=n))
+                   for n in (5, 11, 8)]
+        eos = 2
+    gen = GenerationConfig(
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature,
+        top_p=0.95 if args.temperature else 1.0,
+        eos_id=eos,
+    )
+    outs = batch_generate(params, cfg, prompts, gen, key=jax.random.PRNGKey(0))
+    for i, out in enumerate(outs):
+        if tokenizer is not None and args.prompt:
+            print(f"[{i}] {tokenizer.decode(out)}")
+        else:
+            print(f"[{i}] {len(out)} tokens: {out[:16]}{'...' if len(out) > 16 else ''}")
+
+
+if __name__ == "__main__":
+    main()

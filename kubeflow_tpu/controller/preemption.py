@@ -100,7 +100,10 @@ class SliceHealthReconciler(Reconciler):
         # No failed pods: clear interruption once the slice is whole again.
         if ann.TPU_SLICE_INTERRUPTED in nb.annotations:
             try:
-                hosts = nb.tpu.slice_topology().hosts
+                # ALL hosts of ALL slices must be Ready again (a 2-slice
+                # notebook has hosts×2 pods; comparing against one slice's
+                # host count would leave the interruption set forever).
+                hosts = nb.tpu.slice_topology().hosts * nb.tpu.slice_count
             except Exception:
                 return Result()
             ready = sum(1 for p in pods if _pod_ready(p))

@@ -62,6 +62,29 @@ def _rope_scaling_from_hf(raw: Any) -> Optional[RopeScaling]:
     )
 
 
+def _sliding_window_from_hf(get, model_type: str) -> int:
+    """Window semantics differ per family. Mistral windows every layer.
+    Qwen2 windows only layers >= max_window_layers when use_sliding_window
+    is set — with the HF default max_window_layers == n_layers, NO layer
+    is windowed. A partial (per-layer) window split is unsupported: raise
+    rather than silently windowing all layers (wrong long-context logits)."""
+    if model_type == "mistral":
+        return int(get("sliding_window") or 0)
+    if model_type == "qwen2" and get("use_sliding_window", False):
+        n_layers = get("num_hidden_layers")
+        cutoff = get("max_window_layers", n_layers)
+        if cutoff >= n_layers:
+            return 0  # HF applies the window to no layer
+        if cutoff == 0:
+            return int(get("sliding_window") or 0)  # every layer windowed
+        raise NotImplementedError(
+            f"qwen2 max_window_layers={cutoff} < num_hidden_layers="
+            f"{n_layers}: per-layer sliding-window splits are not "
+            "supported (all-or-nothing only)"
+        )
+    return 0
+
+
 def config_from_hf(hf_config: Any) -> LlamaConfig:
     """Map a transformers config (object or dict) to LlamaConfig.
 
@@ -75,10 +98,10 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
         else lambda k, d=None: getattr(hf_config, k, d)
     )
     model_type = get("model_type", "llama") or "llama"
-    if model_type not in ("llama", "mistral", "gemma"):
+    if model_type not in ("llama", "mistral", "gemma", "qwen2"):
         raise NotImplementedError(
             f"model_type {model_type!r} is not in the supported Llama "
-            "family (llama, mistral, gemma)"
+            "family (llama, mistral, gemma, qwen2)"
         )
     n_heads = get("num_attention_heads")
     default_head_dim = get("hidden_size") // n_heads
@@ -95,9 +118,7 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
         rope_scaling=_rope_scaling_from_hf(get("rope_scaling")),
         max_seq_len=get("max_position_embeddings", 4096),
         norm_eps=float(get("rms_norm_eps", 1e-5)),
-        sliding_window=int(get("sliding_window") or 0)
-        if model_type == "mistral"
-        else 0,
+        sliding_window=_sliding_window_from_hf(get, model_type),
         act="gelu" if act.startswith("gelu") else "silu",
         norm_add_unit=is_gemma,
         embed_scale=is_gemma,
@@ -105,6 +126,7 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
             hd if (hd := get("head_dim", 0) or 0) != default_head_dim else 0
         ),
         tie_embeddings=bool(get("tie_word_embeddings", False)),
+        attn_bias=model_type == "qwen2",
     )
 
 
@@ -166,6 +188,10 @@ def params_from_hf_state_dict(
         "w_up": stack_linear("layers.{}.mlp.up_proj.weight"),
         "w_down": stack_linear("layers.{}.mlp.down_proj.weight"),
     }
+    if cfg.attn_bias:
+        layers["bq"] = stack_norm("layers.{}.self_attn.q_proj.bias")
+        layers["bk"] = stack_norm("layers.{}.self_attn.k_proj.bias")
+        layers["bv"] = stack_norm("layers.{}.self_attn.v_proj.bias")
     out = {
         "embed": take("embed_tokens.weight"),
         "final_norm": take("norm.weight"),
@@ -189,6 +215,15 @@ def params_to_hf_state_dict(cfg: LlamaConfig, params: dict) -> dict:
         out["lm_head.weight"] = _f32(params["lm_head"])
     names = {
         "attn_norm": ("input_layernorm.weight", False),
+        **(
+            {
+                "bq": ("self_attn.q_proj.bias", False),
+                "bk": ("self_attn.k_proj.bias", False),
+                "bv": ("self_attn.v_proj.bias", False),
+            }
+            if "bq" in params["layers"]
+            else {}
+        ),
         "wq": ("self_attn.q_proj.weight", True),
         "wk": ("self_attn.k_proj.weight", True),
         "wv": ("self_attn.v_proj.weight", True),

@@ -56,7 +56,8 @@ class LlamaConfig:
     - Gemma: ``act="gelu"`` (GeGLU, tanh approximation),
       ``norm_add_unit`` (RMSNorm multiplies by 1+w), ``embed_scale``
       (embeddings scaled by sqrt(dim)), ``head_dim_override`` (head_dim
-      decoupled from dim//n_heads), ``tie_embeddings``.
+      decoupled from dim//n_heads), ``tie_embeddings``
+    - Qwen2: ``attn_bias`` (biases on the q/k/v projections).
     """
 
     vocab_size: int = 32000
@@ -76,6 +77,7 @@ class LlamaConfig:
     embed_scale: bool = False  # scale embeddings by sqrt(dim) (gemma)
     head_dim_override: int = 0  # 0 = dim // n_heads
     tie_embeddings: bool = False  # lm_head shares the embedding matrix
+    attn_bias: bool = False  # q/k/v projections carry biases (qwen2)
 
     @property
     def head_dim(self) -> int:
@@ -116,6 +118,10 @@ LLAMA_CONFIGS: dict[str, LlamaConfig] = {
                             max_seq_len=8192, act="gelu", norm_add_unit=True,
                             embed_scale=True, head_dim_override=256,
                             tie_embeddings=True),
+    "qwen2.5-7b": LlamaConfig(vocab_size=152064, dim=3584, n_layers=28,
+                              n_heads=28, n_kv_heads=4, ffn_hidden=18944,
+                              rope_theta=1000000.0, max_seq_len=32768,
+                              norm_eps=1e-6, attn_bias=True),
     # Tiny configs for tests / compile checks.
     "tiny": LlamaConfig(vocab_size=256, dim=128, n_layers=2, n_heads=4,
                         n_kv_heads=4, ffn_hidden=256, max_seq_len=256),
@@ -152,6 +158,10 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
         "w_up": dense(next(lk), (L, cfg.dim, cfg.ffn_hidden)),
         "w_down": dense(next(lk), (L, cfg.ffn_hidden, cfg.dim)),
     }
+    if cfg.attn_bias:
+        layers["bq"] = jnp.zeros((L, cfg.n_heads * hd), cfg.dtype)
+        layers["bk"] = jnp.zeros((L, cfg.n_kv_heads * hd), cfg.dtype)
+        layers["bv"] = jnp.zeros((L, cfg.n_kv_heads * hd), cfg.dtype)
     out = {
         "embed": dense(k_embed, (cfg.vocab_size, cfg.dim)),
         "final_norm": jnp.ones((cfg.dim,), cfg.dtype),
@@ -168,6 +178,14 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
 
 # ---------------------------------------------------------------------------
 # Building blocks (f32 internals, bf16 boundaries)
+
+
+def _qkv(h: jax.Array, layer: dict) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """q/k/v projections with optional qwen2-style biases."""
+    q, k, v = _mm(h, layer["wq"]), _mm(h, layer["wk"]), _mm(h, layer["wv"])
+    if "bq" in layer:
+        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    return q, k, v
 
 
 def _mm(x: jax.Array, w) -> jax.Array:
@@ -288,9 +306,10 @@ def _layer_fwd(
 ) -> jax.Array:
     """One transformer layer, full-sequence (prefill/training)."""
     h = _norm(x, layer["attn_norm"], cfg)
-    q = apply_rope(_split_heads(_mm(h, layer["wq"]), cfg.n_heads), cos, sin)
-    k = apply_rope(_split_heads(_mm(h, layer["wk"]), cfg.n_kv_heads), cos, sin)
-    v = _split_heads(_mm(h, layer["wv"]), cfg.n_kv_heads)
+    hq, hk, hv = _qkv(h, layer)
+    q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin)
+    k = apply_rope(_split_heads(hk, cfg.n_kv_heads), cos, sin)
+    v = _split_heads(hv, cfg.n_kv_heads)
     rep = cfg.n_heads // cfg.n_kv_heads
     attn = flash_attention(
         q, _repeat_kv(k, rep), _repeat_kv(v, rep), causal=True,
@@ -413,9 +432,10 @@ def _prefill_impl(
     def body(x, scanned):
         layer, k_cache, v_cache = scanned
         h = _norm(x, layer["attn_norm"], cfg)
-        q = apply_rope(_split_heads(_mm(h, layer["wq"]), cfg.n_heads), cos, sin)
-        k = apply_rope(_split_heads(_mm(h, layer["wk"]), cfg.n_kv_heads), cos, sin)
-        v = _split_heads(_mm(h, layer["wv"]), cfg.n_kv_heads)
+        hq, hk, hv = _qkv(h, layer)
+        q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin)
+        k = apply_rope(_split_heads(hk, cfg.n_kv_heads), cos, sin)
+        v = _split_heads(hv, cfg.n_kv_heads)
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
         attn = flash_attention(q, _repeat_kv(k, rep), _repeat_kv(v, rep),
@@ -466,10 +486,17 @@ def _gqa_decode_attention(
         jnp.einsum("bgrqd,bgkd->bgrqk", qg, k, preferred_element_type=jnp.float32)
         * scale
     )
+    # ``position`` may be a scalar (single-token decode) or a (sq,) vector
+    # (chunked decode, e.g. speculative verification): query i attends
+    # cache slots <= position[i].
+    pos = jnp.asarray(position)
+    if pos.ndim == 0:
+        pos = jnp.broadcast_to(pos, (sq,))
+    pos_q = pos[None, None, None, :, None]  # (.., sq, 1)
     k_pos = jnp.arange(k.shape[2])[None, None, None, None, :]
-    mask = k_pos <= position
+    mask = k_pos <= pos_q
     if window:
-        mask = mask & (k_pos > position - window)
+        mask = mask & (k_pos > pos_q - window)
     if kv_mask is not None:
         mask = mask & kv_mask[:, None, None, None, :]
     scores = jnp.where(mask, scores, NEG_INF)
@@ -479,22 +506,40 @@ def _gqa_decode_attention(
 
 
 def _decode_impl(params, cfg, token, kv_cache, position, kv_mask=None):
-    """Unjitted decode body (shared by decode_step and generate_tokens).
-    ``kv_mask`` (B, cache_len) marks valid cache slots (serving: False on
-    left-pad slots; slots past ``position`` are causally excluded anyway)."""
-    x = _embed(params, cfg, token)
-    cos, sin = rope_frequencies(cfg, position[None])
+    """Unjitted single-token decode (shared by decode_step and the fused
+    generation loops): (B, 1) token → (B, V) logits."""
+    logits, cache = _decode_chunk_impl(
+        params, cfg, token, kv_cache, position, kv_mask=kv_mask
+    )
+    return logits[:, 0], cache
+
+
+def _decode_chunk_impl(params, cfg, tokens, kv_cache, position, kv_mask=None):
+    """Cached decode of a CHUNK: (B, K) tokens written at cache slots
+    ``position .. position+K-1`` → logits (B, K, V) + updated cache.
+
+    K == 1 is ordinary autoregressive decode; K > 1 is the speculative
+    verification forward — the target reads its weights ONCE for K tokens.
+    Chunk-causality: query i attends cache slots <= position+i (vector
+    positions in _gqa_decode_attention). ``kv_mask`` (B, cache_len) marks
+    valid cache slots (serving: False on left-pad slots; slots past the
+    write pointer are causally excluded anyway)."""
+    k_len = tokens.shape[1]
+    x = _embed(params, cfg, tokens)
+    positions = position + jnp.arange(k_len)
+    cos, sin = rope_frequencies(cfg, positions)
 
     def body(x, scanned):
         layer, k_cache, v_cache = scanned
         h = _norm(x, layer["attn_norm"], cfg)
-        q = apply_rope(_split_heads(_mm(h, layer["wq"]), cfg.n_heads), cos, sin)
-        k = apply_rope(_split_heads(_mm(h, layer["wk"]), cfg.n_kv_heads), cos, sin)
-        v = _split_heads(_mm(h, layer["wv"]), cfg.n_kv_heads)
+        hq, hk, hv = _qkv(h, layer)
+        q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin)
+        k = apply_rope(_split_heads(hk, cfg.n_kv_heads), cos, sin)
+        v = _split_heads(hv, cfg.n_kv_heads)
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, position, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, position, 0))
         attn = _gqa_decode_attention(
-            q, k_cache, v_cache, position, window=cfg.sliding_window,
+            q, k_cache, v_cache, positions, window=cfg.sliding_window,
             kv_mask=kv_mask,
         )
         x = x + _mm(_merge_heads(attn), layer["wo"])
@@ -506,7 +551,7 @@ def _decode_impl(params, cfg, token, kv_cache, position, kv_mask=None):
         body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
     )
     x = _norm(x, params["final_norm"], cfg)
-    logits = _lm_head_logits(x[:, 0], params)
+    logits = _lm_head_logits(x, params)  # (B, K, V)
     return logits, {"k": new_k, "v": new_v}
 
 

@@ -1,0 +1,136 @@
+"""Greedy speculative decoding: a small draft model proposes, the target
+verifies k tokens per step in ONE forward.
+
+Decode at bs=1 is HBM-bound on the TARGET's weights; verification reads
+them once per k proposed tokens instead of once per token, so wall-clock
+approaches (accepted+1)/k_spec × the plain decode cost when the draft
+agrees often (same-family small model). Greedy acceptance makes the
+output EXACTLY the target's greedy decoding — tested token-for-token —
+so speculation is a pure latency optimization, never a quality trade.
+
+Mechanics per round (cache-pointer discipline is the subtle part):
+- draft autoregressively proposes d_1..d_k from its own cache,
+- target runs one chunked forward over [prev_token, d_1..d_k] (k+1 wide,
+  so every proposal is acceptable) at the current cache offset via
+  llama._decode_chunk_impl — the same body ordinary decode uses, with
+  vector positions; stale slots beyond the pointer are overwritten next
+  round and causally masked meanwhile,
+- accept the longest prefix where target argmax matches the proposal,
+  emit the target's own next token as the correction, and REWIND both
+  caches' write pointers to the accepted length.
+
+No reference counterpart (control plane only — SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.llama import (
+    LlamaConfig,
+    _decode_chunk_impl,
+    _decode_impl,
+    _prefill_impl,
+    init_kv_cache,
+)
+
+
+@partial(jax.jit, static_argnames=("cfg", "k_spec"))
+def _draft_propose(params, cfg, token, kv_cache, position, k_spec):
+    """Draft k_spec greedy tokens autoregressively from ``token``.
+
+    Runs k_spec+1 decode steps: each step WRITES its input token's K/V,
+    so the extra step is what lands d_k in the draft cache — on a fully
+    accepted round the next round continues from position+k_spec+1 and a
+    missing d_k entry would silently degrade later proposals (a hole the
+    target's verification can't see)."""
+
+    def step(carry, _):
+        tok, cache, pos = carry
+        logits, cache = _decode_impl(params, cfg, tok, cache, pos)
+        nxt = jnp.argmax(logits, axis=-1)[:, None]
+        return (nxt, cache, pos + 1), nxt[:, 0]
+
+    (_, cache, _), sampled = jax.lax.scan(
+        step, (token, kv_cache, position), length=k_spec + 1
+    )
+    return sampled.T[:, :k_spec], cache  # (B, k_spec); last sample unused
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _target_verify(params, cfg, chunk, kv_cache, start_pos):
+    logits, cache = _decode_chunk_impl(params, cfg, chunk, kv_cache, start_pos)
+    return jnp.argmax(logits, axis=-1), cache  # (B, K)
+
+
+def speculative_generate(
+    target_params: dict,
+    target_cfg: LlamaConfig,
+    draft_params: dict,
+    draft_cfg: LlamaConfig,
+    prompt: jax.Array,  # (1, S) — bs=1, the latency-bound case
+    steps: int,
+    cache_len: int,
+    k_spec: int = 4,
+) -> tuple[jax.Array, dict]:
+    """Greedy speculative decoding. Returns (tokens (1, steps), stats).
+
+    Output is IDENTICAL to target-only greedy decoding; stats reports the
+    acceptance rate that determines the speedup.
+    """
+    if prompt.shape[0] != 1:
+        raise NotImplementedError("speculative decoding is bs=1 here")
+    b, s_prompt = prompt.shape
+    t_cache = init_kv_cache(target_cfg, b, cache_len)
+    d_cache = init_kv_cache(draft_cfg, b, cache_len)
+
+    t_logits, t_cache = _prefill_impl(target_params, target_cfg, prompt, t_cache)
+    _, d_cache = _prefill_impl(draft_params, draft_cfg, prompt, d_cache)
+    last = jnp.argmax(t_logits, axis=-1)[:, None]  # first generated token
+
+    out: list[int] = [int(last[0, 0])]
+    pos = s_prompt  # both caches hold [0, pos) real entries
+    proposed_total = accepted_total = 0
+
+    while len(out) < steps:
+        # Verification chunk [last, d_1..d_k] writes pos..pos+k, so k is
+        # bounded by the remaining cache (pos + k <= cache_len - 1).
+        k = min(k_spec, steps - len(out), cache_len - pos - 1)
+        if k <= 0:
+            break
+        proposals, d_cache = _draft_propose(
+            draft_params, draft_cfg, last, d_cache, jnp.asarray(pos, jnp.int32), k
+        )
+        # Chunk is (k+1) wide so EVERY proposal is acceptable: pred i is
+        # the target's next token after ...[last, d_1..d_i].
+        chunk = jnp.concatenate([last, proposals], axis=1)
+        preds, t_cache = _target_verify(
+            target_params, target_cfg, chunk, t_cache, jnp.asarray(pos, jnp.int32)
+        )
+        preds_np = np.asarray(preds[0])
+        props_np = np.asarray(proposals[0])
+        n_accept = 0
+        while n_accept < k and preds_np[n_accept] == props_np[n_accept]:
+            n_accept += 1
+        # Emit accepted proposals + the target's own correction. When all
+        # k were accepted the "correction" is the target's free token for
+        # position pos+k (preds[k]).
+        emitted = list(props_np[:n_accept]) + [int(preds_np[n_accept])]
+        out.extend(int(t) for t in emitted)
+        proposed_total += k
+        accepted_total += n_accept
+        pos += n_accept + 1  # rewound past any rejected slots
+        last = jnp.asarray([[out[-1]]], jnp.int32)
+
+    stats = {
+        "proposed": proposed_total,
+        "accepted": accepted_total,
+        "acceptance_rate": (
+            accepted_total / proposed_total if proposed_total else 0.0
+        ),
+    }
+    return jnp.asarray([out[:steps]], jnp.int32), stats

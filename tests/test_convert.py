@@ -223,9 +223,72 @@ def test_gemma_matches_transformers():
     np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
 
 
+def test_qwen2_attention_bias_matches_transformers():
+    """Qwen2: llama layout + biases on the q/k/v projections."""
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        attn_implementation="eager",
+        use_sliding_window=False,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    # HF inits biases to zero; randomize them so the parity check actually
+    # exercises the bias math (real checkpoints have nonzero biases).
+    with torch.no_grad():
+        for name, p in model.named_parameters():
+            if name.endswith("_proj.bias"):
+                p.copy_(torch.randn_like(p) * 0.5)
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.attn_bias and cfg.sliding_window == 0
+    f32_cfg = L.LlamaConfig(**{**cfg.__dict__, "dtype": np.float32})
+    params = params_from_hf_state_dict(f32_cfg, model.state_dict(), np.float32)
+    assert "bq" in params["layers"]
+    assert float(np.abs(np.asarray(params["layers"]["bq"])).max()) > 0
+    rng = np.random.default_rng(4)
+    tokens = rng.integers(0, 256, (1, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens).long()).logits.numpy()
+    ours = np.asarray(L.forward(params, f32_cfg, tokens))
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+    # Round-trip export includes the biases.
+    exported = params_to_hf_state_dict(f32_cfg, params)
+    np.testing.assert_allclose(
+        exported["model.layers.0.self_attn.q_proj.bias"],
+        model.state_dict()["model.layers.0.self_attn.q_proj.bias"].numpy(),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_qwen2_sliding_window_semantics():
+    """HF qwen2 windows only layers >= max_window_layers: the default
+    (cutoff == n_layers) means NO window even with use_sliding_window."""
+    base = {
+        "model_type": "qwen2", "vocab_size": 64, "hidden_size": 64,
+        "num_hidden_layers": 4, "num_attention_heads": 4,
+        "intermediate_size": 128, "sliding_window": 512,
+        "use_sliding_window": True,
+    }
+    assert config_from_hf({**base, "max_window_layers": 4}).sliding_window == 0
+    assert config_from_hf({**base, "max_window_layers": 0}).sliding_window == 512
+    with pytest.raises(NotImplementedError, match="max_window_layers"):
+        config_from_hf({**base, "max_window_layers": 2})
+    # use_sliding_window absent → no window regardless.
+    off = dict(base)
+    del off["use_sliding_window"]
+    assert config_from_hf(off).sliding_window == 0
+
+
 def test_unsupported_model_type_raises():
     with pytest.raises(NotImplementedError, match="model_type"):
-        config_from_hf({"model_type": "qwen2", "num_attention_heads": 4,
+        config_from_hf({"model_type": "phi3", "num_attention_heads": 4,
                         "hidden_size": 64})
 
 

@@ -1,0 +1,52 @@
+"""Examples are executable documentation — run them as smoke tests."""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = pathlib.Path(__file__).resolve().parent.parent / "examples"
+
+
+def _run(script: str, *extra: str) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, str(EXAMPLES / script), "--config", "tiny", *extra],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+        cwd=str(EXAMPLES.parent),
+    )
+
+
+def test_train_sharded_runs_and_resumes(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    first = _run("train_sharded.py", "--steps", "4", "--ckpt-dir", ckpt)
+    assert first.returncode == 0, first.stderr
+    assert "step 4" in first.stdout
+    # Second run resumes instead of restarting (preemption recovery).
+    second = _run("train_sharded.py", "--steps", "6", "--ckpt-dir", ckpt)
+    assert second.returncode == 0, second.stderr
+    assert "resumed from step 4" in second.stdout
+    assert "step 5" in second.stdout
+
+
+def test_finetune_lora_runs_and_exports(tmp_path):
+    out = str(tmp_path / "merged.npz")
+    res = _run("finetune_lora.py", "--steps", "3", "--export", out)
+    assert res.returncode == 0, res.stderr
+    assert "adapter params" in res.stdout
+    assert pathlib.Path(out).exists()
+
+
+@pytest.mark.parametrize("extra", [(), ("--int8",)])
+def test_serve_batched_runs(extra):
+    res = _run("serve_batched.py", "--max-new-tokens", "4", *extra)
+    assert res.returncode == 0, res.stderr
+    assert "[2]" in res.stdout  # three prompts served

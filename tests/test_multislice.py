@@ -229,6 +229,36 @@ class TestNameCollisions:
         assert conflicts
 
 
+class TestPreemptionRecovery:
+    def test_slice1_host_preemption_recovers_whole_notebook(self):
+        env = make_env(
+            webhooks=True, platform=True,
+            node_pools=(("tpu-v5-lite-podslice", "4x4", 8, 4),),
+        )
+        env.cluster.create(_ms_notebook(name="ms", namespace="u", slices=2))
+        env.manager.run_until_idle()
+
+        # Preempt a host in slice 1.
+        victim = env.cluster.get("Pod", "ms-s1-2", "u")
+        victim["status"]["phase"] = "Failed"
+        victim["status"]["reason"] = "Preempted"
+        env.cluster.update_status(victim)
+        env.manager.run_until_idle()
+
+        # Recovered: 8 Running pods again, interruption cleared, both
+        # events emitted.
+        pods = env.cluster.list("Pod", "u")
+        assert len(pods) == 8
+        assert all(p["status"]["phase"] == "Running" for p in pods)
+        nb = env.cluster.get("Notebook", "ms", "u")
+        assert "tpu-slice-interrupted" not in str(
+            nb["metadata"].get("annotations", {})
+        )
+        assert nb["status"]["tpu"]["sliceHealth"] == "Healthy"
+        reasons = {e.get("reason") for e in env.cluster.list("Event", "u")}
+        assert {"SliceInterrupted", "SliceRecovered"} <= reasons
+
+
 class TestValidation:
     def test_slice_count_change_denied_while_running(self):
         env = make_env(

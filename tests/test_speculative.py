@@ -1,0 +1,80 @@
+"""Speculative decoding: the exactness guarantee and acceptance stats."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama as L
+from kubeflow_tpu.models.speculative import speculative_generate
+
+
+@pytest.fixture(scope="module")
+def target():
+    cfg = L.LLAMA_CONFIGS["tiny"]
+    return cfg, L.init_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def draft():
+    # Different weights, same vocab — a realistic (if untrained) draft.
+    cfg = L.LlamaConfig(vocab_size=256, dim=64, n_layers=1, n_heads=2,
+                        n_kv_heads=2, ffn_hidden=128, max_seq_len=256)
+    return cfg, L.init_params(cfg, jax.random.PRNGKey(7))
+
+
+def _prompt(n=8):
+    return jax.random.randint(jax.random.PRNGKey(1), (1, n), 0, 256)
+
+
+class TestExactness:
+    def test_output_equals_target_greedy_with_foreign_draft(self, target, draft):
+        """THE speculative-decoding invariant: any draft, same output."""
+        tcfg, tparams = target
+        dcfg, dparams = draft
+        prompt = _prompt()
+        steps = 24
+        ref = np.asarray(
+            L.generate(tparams, tcfg, prompt, steps=steps, cache_len=64)
+        )
+        out, stats = speculative_generate(
+            tparams, tcfg, dparams, dcfg, prompt,
+            steps=steps, cache_len=64, k_spec=4,
+        )
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        assert 0.0 <= stats["acceptance_rate"] <= 1.0
+
+    def test_self_draft_accepts_everything(self, target):
+        """Draft == target: every proposal must be accepted."""
+        tcfg, tparams = target
+        prompt = _prompt()
+        out, stats = speculative_generate(
+            tparams, tcfg, tparams, tcfg, prompt,
+            steps=16, cache_len=64, k_spec=4,
+        )
+        assert stats["acceptance_rate"] == 1.0
+        ref = np.asarray(L.generate(tparams, tcfg, prompt, steps=16, cache_len=64))
+        np.testing.assert_array_equal(np.asarray(out), ref)
+
+    @pytest.mark.parametrize("k_spec", [1, 2, 6])
+    def test_exact_for_any_speculation_depth(self, target, draft, k_spec):
+        tcfg, tparams = target
+        dcfg, dparams = draft
+        prompt = _prompt(5)
+        ref = np.asarray(L.generate(tparams, tcfg, prompt, steps=12, cache_len=48))
+        out, _ = speculative_generate(
+            tparams, tcfg, dparams, dcfg, prompt,
+            steps=12, cache_len=48, k_spec=k_spec,
+        )
+        np.testing.assert_array_equal(np.asarray(out), ref)
+
+    def test_rejects_batched_prompts(self, target, draft):
+        tcfg, tparams = target
+        dcfg, dparams = draft
+        with pytest.raises(NotImplementedError, match="bs=1"):
+            speculative_generate(
+                tparams, tcfg, dparams, dcfg,
+                jax.numpy.zeros((2, 4), jax.numpy.int32),
+                steps=4, cache_len=16,
+            )
