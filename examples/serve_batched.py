@@ -40,8 +40,14 @@ def main() -> None:
             from transformers import AutoTokenizer
 
             tokenizer = AutoTokenizer.from_pretrained(args.checkpoint)
-        except Exception:
-            pass
+        except Exception as err:
+            if args.prompt:
+                # Text prompts are unusable without the tokenizer — fail
+                # loudly rather than silently serving random token IDs.
+                raise SystemExit(
+                    f"--prompt given but tokenizer load failed: {err}"
+                )
+            print(f"# tokenizer unavailable ({err}); serving token IDs")
     else:
         cfg = L.LLAMA_CONFIGS[args.config]
         params = L.init_params(cfg, jax.random.PRNGKey(0))

@@ -174,3 +174,54 @@ class TokenLoader:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+# ---------------------------------------------------------------------------
+# Multi-host input pipeline
+
+
+def sharded_loader(
+    path: str | Path,
+    global_batch: int,
+    seq: int,
+    seed: int = 1,
+    process_id: Optional[int] = None,
+    num_processes: Optional[int] = None,
+    **kwargs,
+) -> TokenLoader:
+    """Per-host loader for multi-host training: each process loads ONLY
+    its global_batch/num_processes rows, from a process-disjoint random
+    stream (seed is splitmix-style mixed with the process id so streams
+    never collide even for adjacent seeds).
+
+    Pair with :func:`device_put_global` to assemble the per-host batches
+    into one global jax.Array laid out over the mesh — the host never
+    materializes (and DCN never moves) the full global batch.
+    """
+    import jax
+
+    pid = jax.process_index() if process_id is None else process_id
+    num = jax.process_count() if num_processes is None else num_processes
+    if global_batch % num != 0:
+        raise ValueError(
+            f"global_batch {global_batch} not divisible by "
+            f"{num} processes"
+        )
+    mixed = (seed * 0x9E3779B97F4A7C15 + pid * 0xBF58476D1CE4E5B9) & _MASK
+    # Keep the mixed seed nonzero (xorshift fixed point) and in int range.
+    mixed = (mixed % ((1 << 63) - 1)) or 1
+    return TokenLoader(
+        path, global_batch // num, seq, seed=mixed, **kwargs
+    )
+
+
+def device_put_global(local_batch: "np.ndarray", mesh, spec):
+    """Per-host (local_batch, seq) numpy → GLOBAL jax.Array over ``mesh``
+    with PartitionSpec ``spec`` (e.g. the MeshPlan batch spec). Each host
+    contributes only its own rows; jax assembles the global view."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), local_batch
+    )

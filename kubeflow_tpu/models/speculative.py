@@ -85,6 +85,16 @@ def speculative_generate(
     if prompt.shape[0] != 1:
         raise NotImplementedError("speculative decoding is bs=1 here")
     b, s_prompt = prompt.shape
+    # Fixed-shape rounds need headroom for a full k_spec chunk even on
+    # the last round; enforcing it up front keeps the (1, steps) output
+    # contract AND pins every round to ONE compiled shape (a shrinking
+    # tail k would retrace mid-decode).
+    needed = s_prompt + steps + k_spec
+    if cache_len < needed:
+        raise ValueError(
+            f"cache_len {cache_len} < prompt ({s_prompt}) + steps "
+            f"({steps}) + k_spec ({k_spec}) = {needed}"
+        )
     t_cache = init_kv_cache(target_cfg, b, cache_len)
     d_cache = init_kv_cache(draft_cfg, b, cache_len)
 
@@ -97,11 +107,9 @@ def speculative_generate(
     proposed_total = accepted_total = 0
 
     while len(out) < steps:
-        # Verification chunk [last, d_1..d_k] writes pos..pos+k, so k is
-        # bounded by the remaining cache (pos + k <= cache_len - 1).
-        k = min(k_spec, steps - len(out), cache_len - pos - 1)
-        if k <= 0:
-            break
+        # Always a FULL k_spec round (one compiled shape); surplus
+        # acceptances past ``steps`` are trimmed host-side below.
+        k = k_spec
         proposals, d_cache = _draft_propose(
             draft_params, draft_cfg, last, d_cache, jnp.asarray(pos, jnp.int32), k
         )

@@ -1,0 +1,76 @@
+"""In-notebook TPU profiling helpers.
+
+Thin policy wrapper over jax.profiler for the notebook workflow: capture
+a trace around N training steps, write it where the notebook's PVC (or
+/tmp) can serve it to TensorBoard/XProf, and annotate steps so the trace
+viewer shows model steps instead of anonymous XLA modules.
+
+    from kubeflow_tpu.observability.profiling import trace
+    with trace("/home/jovyan/profiles", "train"):
+        for _ in range(3):
+            state, loss = step(state, tokens)
+    # → tensorboard --logdir /home/jovyan/profiles
+
+The reference's only tracing is OTel on the admission webhook
+(SURVEY.md §5 — "No continuous profiling"); device-side profiling is a
+TPU-native addition for the in-notebook half of the framework.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pathlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(
+    log_dir: str | pathlib.Path,
+    name: str = "trace",
+    host_tracer_level: int = 2,
+) -> Iterator[pathlib.Path]:
+    """Capture a device+host profiler trace for the enclosed block."""
+    path = pathlib.Path(log_dir) / name
+    path.mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(
+        str(path),
+        create_perfetto_link=False,
+    )
+    try:
+        yield path
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def step_annotation(name: str, step: Optional[int] = None) -> Iterator[None]:
+    """Label the enclosed work in the trace viewer (StepTraceAnnotation)."""
+    if step is not None:
+        ctx = jax.profiler.StepTraceAnnotation(name, step_num=step)
+    else:
+        ctx = jax.profiler.TraceAnnotation(name)
+    with ctx:
+        yield
+
+
+def timed_steps(step_fn, state, batches, sync_every: int = 1):
+    """Drive ``state, loss = step_fn(state, batch)`` and return
+    (state, per-step wall seconds). Forces a device sync every
+    ``sync_every`` steps so the timings measure device work, not
+    dispatch — the first entry includes compile time by design (report
+    it separately or discard it)."""
+    times = []
+    loss = None
+    for i, batch in enumerate(batches):
+        t0 = time.perf_counter()
+        with step_annotation("train_step", step=i):
+            state, loss = step_fn(state, batch)
+        if (i + 1) % sync_every == 0:
+            jax.block_until_ready(loss)
+        times.append(time.perf_counter() - t0)
+    if loss is not None:
+        jax.block_until_ready(loss)
+    return state, times

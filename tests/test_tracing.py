@@ -91,3 +91,33 @@ def test_webhook_records_imagestream_not_found_event(exporter):
     env.cluster.create(nb)
     (span,) = exporter.by_name("mutate-notebook")
     assert any(e["name"] == "imagestream-not-found" for e in span.events)
+
+
+class TestProfiling:
+    def test_trace_produces_artifacts(self, tmp_path):
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.observability.profiling import trace
+
+        with trace(tmp_path, "t1") as path:
+            (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+        produced = list(path.rglob("*"))
+        assert any(p.is_file() for p in produced), produced
+
+    def test_timed_steps_counts_and_progresses(self):
+        import jax
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.observability.profiling import timed_steps
+
+        @jax.jit
+        def step(state, batch):
+            new = state + batch.sum()
+            return new, new
+
+        state, times = timed_steps(
+            step, jnp.zeros(()), [jnp.ones((4,))] * 5
+        )
+        assert len(times) == 5
+        assert float(state) == 20.0
+        assert all(t >= 0 for t in times)
