@@ -30,15 +30,11 @@ import jax
 def trace(
     log_dir: str | pathlib.Path,
     name: str = "trace",
-    host_tracer_level: int = 2,
 ) -> Iterator[pathlib.Path]:
     """Capture a device+host profiler trace for the enclosed block."""
     path = pathlib.Path(log_dir) / name
     path.mkdir(parents=True, exist_ok=True)
-    jax.profiler.start_trace(
-        str(path),
-        create_perfetto_link=False,
-    )
+    jax.profiler.start_trace(str(path), create_perfetto_link=False)
     try:
         yield path
     finally:
@@ -71,6 +67,11 @@ def timed_steps(step_fn, state, batches, sync_every: int = 1):
         if (i + 1) % sync_every == 0:
             jax.block_until_ready(loss)
         times.append(time.perf_counter() - t0)
-    if loss is not None:
+    if loss is not None and times:
+        # Trailing steps since the last sync are still in flight; charge
+        # their device time to the final entry so sum(times) reflects all
+        # device work, as documented.
+        t0 = time.perf_counter()
         jax.block_until_ready(loss)
+        times[-1] += time.perf_counter() - t0
     return state, times

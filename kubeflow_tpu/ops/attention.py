@@ -33,9 +33,22 @@ except Exception:  # pragma: no cover
     pl = None
     pltpu = None
 
-BLOCK_Q = 128
+BLOCK_Q = 128  # minimum/alignment block; actual blocks picked per shape
 BLOCK_K = 128
+# Measured on v5e (S=2048/4096, H=32, D=128): 512-wide blocks run the
+# kernel ~4x faster than 128 (19.9 → 77.8 TFLOP/s at S=2048) — bigger
+# tiles amortize the softmax VPU work against MXU matmuls. Block choice
+# is the largest candidate dividing the sequence, so shorter prompts
+# still run (alignment minimum stays 128).
+_BLOCK_CANDIDATES = (512, 256, 128)
 NEG_INF = -1e30
+
+
+def _pick_block(length: int) -> int:
+    for cand in _BLOCK_CANDIDATES:
+        if length % cand == 0:
+            return cand
+    return 0  # not 128-aligned → caller falls back to XLA
 
 
 # Pluggable implementations: the parallel layer registers e.g. "ring"
@@ -98,7 +111,10 @@ def _pallas_ok(q: jax.Array, k: jax.Array) -> bool:
         return False
     _, _, sq, d = q.shape
     sk = k.shape[2]
-    return sq % BLOCK_Q == 0 and sk % BLOCK_K == 0 and d % 128 == 0 and sq > 1
+    return (
+        _pick_block(sq) > 0 and _pick_block(sk) > 0
+        and d % 128 == 0 and sq > 1
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -131,27 +147,28 @@ def _attention_xla(
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, q_offset: int,
-                  sk: int, scale: float, window: int = 0):
-    # Block shapes: q (1, BLOCK_Q, D); k/v (1, sk, D); o (1, BLOCK_Q, D).
+                  sk: int, scale: float, window: int = 0,
+                  block_q: int = BLOCK_Q, block_k: int = BLOCK_K):
+    # Block shapes: q (1, block_q, D); k/v (1, sk, D); o (1, block_q, D).
     qi = pl.program_id(1)
     q_block = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
     d = q_block.shape[-1]
-    num_k_blocks = sk // BLOCK_K
+    num_k_blocks = sk // block_k
 
     def body(kb, carry):
         m, l, o = carry
-        k_block = k_ref[0, pl.ds(kb * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        v_block = v_ref[0, pl.ds(kb * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        k_block = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_block = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q_block, k_block.T, preferred_element_type=jnp.float32)
         if causal or window:
             q_pos = (
-                jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 0)
-                + qi * BLOCK_Q
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                + qi * block_q
                 + q_offset
             )
             k_pos = (
-                jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 1)
-                + kb * BLOCK_K
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                + kb * block_k
             )
             mask = k_pos <= q_pos if causal else (k_pos == k_pos)
             if window:
@@ -166,9 +183,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, q_offset: int,
         )
         return m_new, l_new, o_new
 
-    m0 = jnp.full((BLOCK_Q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((BLOCK_Q,), jnp.float32)
-    o0 = jnp.zeros((BLOCK_Q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o0 = jnp.zeros((block_q, d), jnp.float32)
 
     if causal:
         # Blocks strictly above the diagonal contribute nothing; bound the
@@ -176,14 +193,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, q_offset: int,
         # possible — qi is dynamic — so bound dynamically).
         last = jnp.minimum(
             num_k_blocks,
-            (qi * BLOCK_Q + q_offset + BLOCK_Q + BLOCK_K - 1) // BLOCK_K,
+            (qi * block_q + q_offset + block_q + block_k - 1) // block_k,
         )
     else:
         last = num_k_blocks
     if window:
         # Blocks entirely BELOW the window contribute nothing either: the
         # earliest visible key for this q block is q_start - window + 1.
-        first = jnp.maximum(0, (qi * BLOCK_Q + q_offset - window + 1) // BLOCK_K)
+        first = jnp.maximum(0, (qi * block_q + q_offset - window + 1) // block_k)
     else:
         first = 0
     m, l, o = jax.lax.fori_loop(first, last, body, (m0, l0, o0))
@@ -196,27 +213,34 @@ def _flash_attention_pallas(
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = 1.0 / math.sqrt(d)
+    block_q = _pick_block(sq)
+    block_k = _pick_block(sk)
+    if not block_q or not block_k:
+        raise ValueError(
+            f"pallas flash attention needs 128-aligned sequence lengths, "
+            f"got sq={sq}, sk={sk}; use impl='auto'/'xla'"
+        )
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
-    grid = (b * h, sq // BLOCK_Q)
+    grid = (b * h, sq // block_q)
     kernel = functools.partial(
         _flash_kernel, causal=causal, q_offset=q_offset, sk=sk, scale=scale,
-        window=window,
+        window=window, block_q=block_q, block_k=block_k,
     )
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, BLOCK_Q, d), lambda i, j: (i, j, 0),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda i, j: (i, j, 0),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
     )(qf, kf, vf)
     return out.reshape(b, h, sq, d)
