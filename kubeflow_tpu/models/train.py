@@ -26,20 +26,63 @@ from kubeflow_tpu.parallel.ring_attention import make_sharded_ring_attention
 from kubeflow_tpu.parallel.ulysses import make_sharded_ulysses_attention
 
 
+def per_token_nll(
+    params: dict, cfg: LlamaConfig, tokens: jax.Array, attn_impl: str = "auto"
+) -> jax.Array:
+    """(B, S-1) next-token negative log likelihoods — the one place the
+    NLL math lives (training mean-loss and perplexity eval both fold it)."""
+    logits = forward(params, cfg, tokens, attn_impl=attn_impl)[:, :-1]
+    targets = tokens[:, 1:]
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+
+
 def causal_lm_loss(
     params: dict, cfg: LlamaConfig, tokens: jax.Array, attn_impl: str = "auto"
 ) -> jax.Array:
     """Next-token cross entropy over (B, S) token batches."""
-    logits = forward(params, cfg, tokens, attn_impl=attn_impl)
-    targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return jnp.mean(per_token_nll(params, cfg, tokens, attn_impl))
 
 
-def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1):
-    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
+def make_optimizer(
+    lr: float = 3e-4,
+    weight_decay: float = 0.1,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
+    end_lr_ratio: float = 0.1,
+    clip_norm: float = 0.0,
+):
+    """AdamW with the standard LLM training schedule knobs.
+
+    - warmup_steps > 0: linear warmup from 0 to ``lr``;
+    - decay_steps > 0: cosine decay from ``lr`` to ``lr*end_lr_ratio``
+      over exactly ``decay_steps`` steps AFTER warmup; with
+      decay_steps=0 the lr stays at peak forever after warmup;
+    - clip_norm > 0: global-norm gradient clipping before the update.
+    """
+    if warmup_steps or decay_steps:
+        # Composed explicitly so the documented semantics hold exactly:
+        # linear 0→lr over warmup_steps, then EITHER constant lr forever
+        # (decay_steps=0) or cosine over decay_steps AFTER warmup down to
+        # lr*end_lr_ratio. (optax.warmup_cosine_decay_schedule's
+        # decay_steps is the TOTAL length including warmup — a warmup-only
+        # request through it would cliff to the end value immediately.)
+        pieces = [optax.linear_schedule(0.0, lr, max(warmup_steps, 1))]
+        if decay_steps:
+            pieces.append(
+                optax.cosine_decay_schedule(
+                    lr, decay_steps, alpha=end_lr_ratio
+                )
+            )
+        else:
+            pieces.append(optax.constant_schedule(lr))
+        schedule = optax.join_schedules(pieces, boundaries=[warmup_steps])
+    else:
+        schedule = lr
+    opt = optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay)
+    if clip_norm > 0:
+        opt = optax.chain(optax.clip_by_global_norm(clip_norm), opt)
+    return opt
 
 
 def make_train_step(
@@ -48,6 +91,7 @@ def make_train_step(
     optimizer=None,
     use_ring_sp: Optional[bool] = None,
     sp_impl: str = "ring",
+    grad_accum: int = 1,
 ):
     """Build (init_state, train_step) jitted over plan.mesh.
 
@@ -56,6 +100,12 @@ def make_train_step(
     "ring" (K/V rotate via ppermute, overlapped with compute) or
     "ulysses" (two all_to_alls trade sequence shards for head shards;
     needs heads-per-tp-shard divisible by sp).
+
+    ``grad_accum`` > 1 splits the batch into that many microbatches and
+    accumulates gradients in a lax.scan before ONE optimizer update —
+    the HBM lever for effective batch sizes past what activations allow
+    (composes with jax.checkpoint inside the loss). The batch's leading
+    dim must be divisible by grad_accum.
     """
     if sp_impl not in ("ring", "ulysses"):
         # Validate even when sp ends up inactive: a typo'd sp_impl on an
@@ -79,10 +129,45 @@ def make_train_step(
         opt_state = optimizer.init(params)
         return {"params": params, "opt_state": opt_state, "step": jnp.zeros((), jnp.int32)}
 
+    def _grads(params, tokens):
+        return jax.value_and_grad(causal_lm_loss)(params, cfg, tokens, attn_impl)
+
     def train_step(state, tokens):
-        loss, grads = jax.value_and_grad(causal_lm_loss)(
-            state["params"], cfg, tokens, attn_impl
-        )
+        if grad_accum == 1:
+            loss, grads = _grads(state["params"], tokens)
+        else:
+            b = tokens.shape[0]
+            if b % grad_accum:
+                raise ValueError(
+                    f"batch {b} not divisible by grad_accum {grad_accum}"
+                )
+            # STRIDED split (micro[i] = tokens[i::ga]): each microbatch
+            # keeps rows from every dp shard, so no resharding collective
+            # per scan iteration — a contiguous reshape would put each
+            # microbatch on a fraction of the dp devices.
+            micro = tokens.reshape(
+                b // grad_accum, grad_accum, -1
+            ).transpose(1, 0, 2)
+
+            def accum(carry, mb):
+                loss_sum, grads_sum = carry
+                loss, grads = _grads(state["params"], mb)
+                return (
+                    loss_sum + loss,
+                    jax.tree_util.tree_map(jnp.add, grads_sum, grads),
+                ), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
+            )
+            (loss_sum, grads_sum), _ = jax.lax.scan(
+                accum, (jnp.zeros((), jnp.float32), zeros), micro
+            )
+            loss = loss_sum / grad_accum
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / grad_accum).astype(p.dtype),
+                grads_sum, state["params"],
+            )
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
         )
@@ -100,6 +185,32 @@ def make_train_step(
         donate_argnums=(0,),
     )
     return init_state, jitted
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def token_nll(params: dict, cfg: LlamaConfig, tokens: jax.Array) -> jax.Array:
+    """Per-batch (sum NLL, token count) for perplexity evaluation."""
+    nll = per_token_nll(params, cfg, tokens)
+    return jnp.sum(nll), nll.size
+
+
+def evaluate_perplexity(params: dict, cfg: LlamaConfig, batches) -> dict:
+    """Corpus perplexity over an iterable of (B, S) token batches.
+
+    Returns {"nll": mean per-token NLL, "perplexity": exp(nll),
+    "tokens": count}. Standard next-token evaluation: positions 1..S-1
+    are scored against the model's prediction from the prefix.
+    """
+    total = 0.0
+    count = 0
+    for tokens in batches:
+        s, n = token_nll(params, cfg, tokens)
+        total += float(s)
+        count += int(n)
+    if count == 0:
+        raise ValueError("no evaluation tokens")
+    nll = total / count
+    return {"nll": nll, "perplexity": float(jnp.exp(nll)), "tokens": count}
 
 
 def shard_state(plan: MeshPlan, state: dict) -> dict:

@@ -207,3 +207,130 @@ class TestRuntimeBootstrap:
 
         with _pytest.raises(RuntimeError, match="slice incomplete"):
             bootstrap(env={}, expected_devices=16)
+
+
+class TestTrainingExtras:
+    def test_grad_accum_matches_full_batch(self):
+        """4 microbatches must produce the same update as the full batch
+        (same data, same order — the accumulation is exact in f32)."""
+        import numpy as np
+
+        from kubeflow_tpu.models.train import make_train_step, shard_state
+        from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+
+        cfg = L.LlamaConfig(vocab_size=64, dim=32, n_layers=1, n_heads=2,
+                            n_kv_heads=2, ffn_hidden=64, dtype=jnp.float32)
+        plan = MeshPlan(make_mesh(dp=8))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+        results = {}
+        for accum in (1, 4):
+            params = L.init_params(cfg, jax.random.PRNGKey(0))
+            init_state, step = make_train_step(cfg, plan, grad_accum=accum)
+            state = shard_state(plan, init_state(params))
+            state, loss = step(state, tokens)
+            results[accum] = (
+                float(loss),
+                np.asarray(state["params"]["layers"]["wq"]),
+            )
+        assert abs(results[1][0] - results[4][0]) < 1e-5
+        np.testing.assert_allclose(results[1][1], results[4][1],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_indivisible_grad_accum_rejected(self):
+        from kubeflow_tpu.models.train import make_train_step, shard_state
+        from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+        import pytest
+
+        cfg = L.LLAMA_CONFIGS["tiny"]
+        plan = MeshPlan(make_mesh(dp=8))
+        init_state, step = make_train_step(cfg, plan, grad_accum=3)
+        state = shard_state(
+            plan, init_state(L.init_params(cfg, jax.random.PRNGKey(0)))
+        )
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+        with pytest.raises(ValueError, match="not divisible"):
+            step(state, tokens)
+
+    def test_warmup_then_constant_lr(self):
+        """warmup_steps without decay_steps: lr holds at PEAK after
+        warmup (never cliffs to an end value)."""
+        import optax
+
+        from kubeflow_tpu.models.train import make_optimizer
+
+        # Reconstruct the schedule the optimizer embeds by probing updates
+        # with sgd-like normalization: easier to probe the schedule fn via
+        # a fresh make and inspecting update magnitudes over steps.
+        opt = make_optimizer(lr=1e-2, warmup_steps=5)
+        params = {"w": jnp.zeros((1,))}
+        state = opt.init(params)
+        mags = []
+        for _ in range(12):
+            updates, state = opt.update({"w": jnp.ones((1,))}, state, params)
+            mags.append(float(jnp.abs(updates["w"])[0]))
+        assert mags[0] < mags[3] < mags[6]  # ramping through warmup
+        # Post-warmup the lr is constant: updates settle at peak scale,
+        # NOT at a decayed fraction of it.
+        assert abs(mags[-1] - mags[6]) / mags[6] < 0.2
+
+    def test_cosine_decays_after_warmup(self):
+        from kubeflow_tpu.models.train import make_optimizer
+
+        opt = make_optimizer(lr=1e-2, warmup_steps=2, decay_steps=10,
+                             end_lr_ratio=0.1)
+        params = {"w": jnp.zeros((1,))}
+        state = opt.init(params)
+        mags = []
+        for _ in range(14):
+            updates, state = opt.update({"w": jnp.ones((1,))}, state, params)
+            mags.append(float(jnp.abs(updates["w"])[0]))
+        peak = max(mags)
+        # Decay over the 10 steps AFTER warmup: the tail is ~end_lr_ratio
+        # of peak, not a 1-step cliff right after warmup.
+        assert mags[3] > 0.5 * peak  # still high early in the decay
+        assert mags[-1] < 0.25 * peak  # decayed by the end
+
+    def test_gradient_clipping_bounds_the_update(self):
+        """The SAME huge gradient must produce a bounded update with
+        clip_norm and an adam-normalized one without — compare at a
+        constant-lr step so the schedule can't mask a broken clip."""
+        from kubeflow_tpu.models.train import make_optimizer
+
+        params = {"w": jnp.zeros((4,))}
+        grads_huge = {"w": jnp.full((4,), 1e6)}
+        grads_unit = {"w": jnp.full((4,), 1e-8)}
+
+        def first_update(clip):
+            opt = make_optimizer(lr=1e-2, clip_norm=clip)
+            state = opt.init(params)
+            u_huge, state = opt.update(grads_huge, state, params)
+            return float(jnp.abs(u_huge["w"]).max())
+
+        # Adam normalizes magnitude, so compare the EFFECT of clipping on
+        # the second moment: with clipping, a tiny follow-up gradient
+        # still moves (nu small); without, nu is poisoned by 1e6² and the
+        # follow-up step is ~zero.
+        def second_update(clip):
+            opt = make_optimizer(lr=1e-2, clip_norm=clip)
+            state = opt.init(params)
+            _, state = opt.update(grads_huge, state, params)
+            u2, _ = opt.update(grads_unit, state, params)
+            return float(jnp.abs(u2["w"]).max())
+
+        assert second_update(1.0) > 100 * second_update(0.0)
+
+    def test_perplexity_of_uniform_model(self):
+        from kubeflow_tpu.models.train import evaluate_perplexity
+
+        cfg = L.LlamaConfig(vocab_size=64, dim=32, n_layers=1, n_heads=2,
+                            n_kv_heads=2, ffn_hidden=64, dtype=jnp.float32)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        # Zeroed lm_head → uniform logits → ppl == vocab_size exactly.
+        params["lm_head"] = jnp.zeros_like(params["lm_head"])
+        batches = [
+            jax.random.randint(jax.random.PRNGKey(i), (2, 16), 0, 64)
+            for i in range(3)
+        ]
+        result = evaluate_perplexity(params, cfg, batches)
+        assert abs(result["perplexity"] - 64.0) < 0.5
+        assert result["tokens"] == 3 * 2 * 15
