@@ -233,11 +233,23 @@ class NotebookReconciler(Reconciler):
             "StatefulSet", nb.namespace, {ann.NOTEBOOK_NAME_LABEL: nb.name}
         ):
             name = obj_util.name_of(sts)
-            if name not in expected:
-                try:
-                    self.client.delete("StatefulSet", name, nb.namespace)
-                except NotFoundError:
-                    pass
+            if name in expected:
+                continue
+            if not obj_util.is_controlled_by(nb.obj, sts):
+                # Mirror _reconcile_statefulset's adoption guard: a
+                # user-created STS that merely carries our name label must
+                # not be deleted out from under its owner.
+                self.recorder.eventf(
+                    nb.obj, "Warning", "StatefulSetConflict",
+                    f"StatefulSet {name} carries label "
+                    f"{ann.NOTEBOOK_NAME_LABEL}={nb.name} but is not "
+                    "controlled by this Notebook; refusing to prune it",
+                )
+                continue
+            try:
+                self.client.delete("StatefulSet", name, nb.namespace)
+            except NotFoundError:
+                pass
 
     # ------------------------------------------------------------------
     def _slice_pods(self, nb: Notebook) -> list[dict]:

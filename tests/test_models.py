@@ -2,6 +2,7 @@
 
 import jax
 import jax.numpy as jnp
+import optax
 import pytest
 
 from kubeflow_tpu.models import llama as L
@@ -291,33 +292,53 @@ class TestTrainingExtras:
         assert mags[-1] < 0.25 * peak  # decayed by the end
 
     def test_gradient_clipping_bounds_the_update(self):
-        """The SAME huge gradient must produce a bounded update with
-        clip_norm and an adam-normalized one without — compare at a
-        constant-lr step so the schedule can't mask a broken clip."""
+        """clip_norm>0 must actually bound what reaches the optimizer.
+
+        Adam normalizes update magnitude (m̂/√ν̂ is scale-invariant for a
+        constant-direction gradient), so asserting on adamw's output can't
+        distinguish clipped from unclipped. Instead assert on the
+        transform the flag installs: the gradient that flows past
+        clip_by_global_norm has global norm ≤ clip_norm, and a
+        non-normalizing optimizer (SGD) downstream of make_optimizer's
+        clip stage produces a bounded step.
+        """
         from kubeflow_tpu.models.train import make_optimizer
 
         params = {"w": jnp.zeros((4,))}
         grads_huge = {"w": jnp.full((4,), 1e6)}
-        grads_unit = {"w": jnp.full((4,), 1e-8)}
 
-        def first_update(clip):
-            opt = make_optimizer(lr=1e-2, clip_norm=clip)
-            state = opt.init(params)
-            u_huge, state = opt.update(grads_huge, state, params)
-            return float(jnp.abs(u_huge["w"]).max())
+        # 1) The transform itself bounds the global norm.
+        clip = optax.clip_by_global_norm(1.0)
+        clipped, _ = clip.update(grads_huge, clip.init(params), params)
+        assert float(optax.global_norm(clipped)) <= 1.0 + 1e-6
+        assert float(optax.global_norm(grads_huge)) > 1e6
 
-        # Adam normalizes magnitude, so compare the EFFECT of clipping on
-        # the second moment: with clipping, a tiny follow-up gradient
-        # still moves (nu small); without, nu is poisoned by 1e6² and the
-        # follow-up step is ~zero.
-        def second_update(clip):
-            opt = make_optimizer(lr=1e-2, clip_norm=clip)
-            state = opt.init(params)
-            _, state = opt.update(grads_huge, state, params)
-            u2, _ = opt.update(grads_unit, state, params)
-            return float(jnp.abs(u2["w"]).max())
+        # 2) Sanity on the mechanism (raw optax, not repo code): a
+        #    non-normalizing optimizer behind the same clip stage steps at
+        #    most lr * clip_norm, while unclipped SGD steps hugely.
+        opt_c = optax.chain(optax.clip_by_global_norm(1.0), optax.sgd(1e-2))
+        u_c, _ = opt_c.update(grads_huge, opt_c.init(params), params)
+        assert float(jnp.abs(u_c["w"]).max()) <= 1e-2 + 1e-8
 
-        assert second_update(1.0) > 100 * second_update(0.0)
+        opt_u = optax.sgd(1e-2)
+        u_u, _ = opt_u.update(grads_huge, opt_u.init(params), params)
+        assert float(jnp.abs(u_u["w"]).max()) > 1e3
+
+        # 3) And make_optimizer wires the clip stage in at all: after one
+        #    huge-gradient step, adam's second moment ν sees the CLIPPED
+        #    gradient (ν ≤ (1-b2)·clip² per element) rather than 1e6².
+        def max_nu(clip_norm):
+            opt = make_optimizer(lr=1e-2, clip_norm=clip_norm)
+            _, state = opt.update(grads_huge, opt.init(params), params)
+            nus = [float(jnp.max(s.nu["w"]))
+                   for s in jax.tree_util.tree_leaves(
+                       state, is_leaf=lambda x: hasattr(x, "nu"))
+                   if hasattr(s, "nu")]
+            assert nus, "no adam state found in optimizer chain"
+            return max(nus)
+
+        assert max_nu(1.0) <= 0.05 * 1.0**2 + 1e-9
+        assert max_nu(0.0) > 1e9
 
     def test_perplexity_of_uniform_model(self):
         from kubeflow_tpu.models.train import evaluate_perplexity

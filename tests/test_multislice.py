@@ -165,6 +165,31 @@ class TestLifecycle:
         stses = env.cluster.list("StatefulSet", "u")
         assert [s["metadata"]["name"] for s in stses] == ["ms"]
 
+    def test_prune_refuses_uncontrolled_statefulset(self):
+        """A user-created STS that merely carries the notebook-name label
+        must survive pruning (same no-adopt posture as reconcile)."""
+        env = self._make_env()
+        env.cluster.create(_ms_notebook(name="ms", namespace="u", slices=1))
+        # Foreign STS labeled like slice 1 of "ms" but owned by nobody.
+        env.cluster.create({
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {
+                "name": "ms-s9",
+                "namespace": "u",
+                "labels": {ann.NOTEBOOK_NAME_LABEL: "ms"},
+            },
+            "spec": {"replicas": 1, "template": {"spec": {"containers": []}}},
+        })
+        env.manager.run_until_idle()
+
+        names = {s["metadata"]["name"]
+                 for s in env.cluster.list("StatefulSet", "u")}
+        assert "ms-s9" in names  # not pruned
+        events = [e for e in env.cluster.list("Event", "u")
+                  if e.get("reason") == "StatefulSetConflict"]
+        assert events
+
     def test_restart_deletes_pods_of_every_slice(self):
         env = self._make_env()
         env.cluster.create(_ms_notebook(name="ms", namespace="u", slices=2))
