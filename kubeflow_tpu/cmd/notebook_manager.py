@@ -15,16 +15,20 @@ Reference parity — components/notebook-controller/main.go (148 LoC):
 from __future__ import annotations
 
 import argparse
+import logging
+import os
+import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
 from kubeflow_tpu.controller.culling import CullerConfig, CullingReconciler
 from kubeflow_tpu.controller.notebook import ControllerConfig, NotebookReconciler
 from kubeflow_tpu.controller.preemption import SliceHealthReconciler
-from kubeflow_tpu.k8s.fake import FakeCluster
-from kubeflow_tpu.k8s.health import HealthChecks, ping
+from kubeflow_tpu.k8s.client import Client
+from kubeflow_tpu.k8s.health import HealthChecks, HealthServer, ping
 from kubeflow_tpu.k8s.leader import UPSTREAM_LEASE, LeaderElector
-from kubeflow_tpu.k8s.manager import FakeClock, Manager
+from kubeflow_tpu.k8s.manager import FakeClock, Manager, RealClock
+from kubeflow_tpu.k8s.serve import install_signal_handlers, serve, split_addr
 from kubeflow_tpu.metrics.metrics import Metrics
 
 
@@ -84,7 +88,7 @@ class ManagerBundle:
 
 
 def build(
-    cluster: FakeCluster,
+    cluster: Client,
     env: Optional[dict] = None,
     argv: Optional[list[str]] = None,
     clock: Optional[FakeClock] = None,
@@ -153,3 +157,58 @@ def build(
         preemption_reconciler=preemption,
         elector=elector,
     )
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """Process entrypoint (reference main.go:58-148): connect to the real
+    apiserver, assemble the manager, serve probes, run until SIGTERM."""
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    from kubeflow_tpu.k8s.real import ClusterConfig, RealClient
+
+    import sys
+
+    if argv is None:
+        argv = sys.argv[1:]
+    env = dict(os.environ)
+    opts = parse_args(argv)
+    client = RealClient(ClusterConfig.from_env(env))
+    bundle = build(
+        client,
+        env=env,
+        argv=argv,
+        clock=RealClock(),
+        identity=env.get("HOSTNAME", "notebook-controller-0"),
+    )
+
+    host, port = split_addr(opts.probe_addr)
+    health_server = HealthServer(bundle.health, host=host, port=port)
+    health_server.start()
+    logging.getLogger(__name__).info(
+        "notebook-controller up: probes on %s:%d", host, health_server.port
+    )
+
+    metrics_server = None
+    if opts.metrics_addr and opts.metrics_addr != "0":
+        from kubeflow_tpu.metrics.server import MetricsServer
+
+        mhost, mport = split_addr(opts.metrics_addr)
+        metrics_server = MetricsServer(bundle.metrics, host=mhost, port=mport)
+        metrics_server.start()
+
+    stop = threading.Event()
+    install_signal_handlers(stop)
+    try:
+        serve(bundle, client, stop)
+    finally:
+        health_server.stop()
+        if metrics_server is not None:
+            metrics_server.stop()
+        client.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess e2e
+    raise SystemExit(main())

@@ -17,6 +17,9 @@ Reference parity — components/odh-notebook-controller/main.go (374 LoC):
 from __future__ import annotations
 
 import argparse
+import logging
+import os
+import threading
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Optional
@@ -28,10 +31,11 @@ from kubeflow_tpu.controller.tls import (
     fetch_tls_profile,
 )
 from kubeflow_tpu.k8s.cache import TransformingClient
-from kubeflow_tpu.k8s.fake import FakeCluster
-from kubeflow_tpu.k8s.health import HealthChecks, ping
+from kubeflow_tpu.k8s.client import Client
+from kubeflow_tpu.k8s.health import HealthChecks, HealthServer, ping
 from kubeflow_tpu.k8s.leader import PLATFORM_LEASE, LeaderElector
-from kubeflow_tpu.k8s.manager import FakeClock, Manager
+from kubeflow_tpu.k8s.manager import FakeClock, Manager, RealClock
+from kubeflow_tpu.k8s.serve import install_signal_handlers, serve, split_addr
 from kubeflow_tpu.webhook.mutating import NotebookMutatingWebhook, WebhookConfig
 from kubeflow_tpu.webhook.validating import NotebookValidatingWebhook
 
@@ -111,9 +115,15 @@ class PlatformBundle:
             return 0
         return self.manager.run_until_idle(max_cycles)
 
+    def tick(self, seconds: float) -> int:
+        if self.elector and not self.elector.try_acquire():
+            self.manager.clock.advance(seconds)
+            return 0
+        return self.manager.tick(seconds)
+
 
 def build(
-    cluster: FakeCluster,
+    cluster: Client,
     env: Optional[dict] = None,
     argv: Optional[list[str]] = None,
     clock: Optional[FakeClock] = None,
@@ -154,9 +164,13 @@ def build(
         {**env, "KUBE_RBAC_PROXY_IMAGE": opts.kube_rbac_proxy_image}
     )
     mutating = NotebookMutatingWebhook(cluster, config=webhook_cfg)
-    mutating.register(cluster)
     validating = NotebookValidatingWebhook(cluster)
-    validating.register(cluster)
+    if hasattr(cluster, "register_mutating_webhook"):
+        # In-process admission chain (FakeCluster / envtest tier). Against
+        # a real apiserver, admission arrives over HTTPS instead — main()
+        # serves the same handler objects via WebhookServer.
+        mutating.register(cluster)
+        validating.register(cluster)
 
     health = HealthChecks()
     health.add_healthz_check("healthz", ping)
@@ -181,3 +195,77 @@ def build(
         elector=elector,
         restart_requested=restart_requested,
     )
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """Process entrypoint (reference odh main.go:141-374): real apiserver
+    client, TLS profile at boot, manager + HTTPS admission server, probes,
+    run until SIGTERM — or until the cluster TLS profile changes, which
+    exits 0 so the pod restarts with the new profile (main.go:344-367)."""
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    from kubeflow_tpu.k8s.real import ClusterConfig, RealClient
+    from kubeflow_tpu.webhook.server import WebhookServer
+
+    import sys
+
+    if argv is None:
+        argv = sys.argv[1:]
+    env = dict(os.environ)
+    opts = parse_args(argv)
+    client = RealClient(ClusterConfig.from_env(env))
+
+    stop = threading.Event()
+    bundle = build(
+        client,
+        env=env,
+        argv=argv,
+        clock=RealClock(),
+        identity=env.get("HOSTNAME", "platform-controller-0"),
+        on_tls_change=lambda profile: stop.set(),
+    )
+
+    host, port = split_addr(opts.probe_addr)
+    health_server = HealthServer(bundle.health, host=host, port=port)
+    health_server.start()
+
+    webhook_server = WebhookServer(
+        mutating_handler=bundle.mutating_webhook.handle,
+        validating_handler=bundle.validating_webhook.handle,
+        host="0.0.0.0",
+        port=opts.webhook_port,
+        cert_dir=opts.cert_dir or None,
+        tls_profile=bundle.tls_profile,
+    )
+    webhook_server.start()
+    logging.getLogger(__name__).info(
+        "platform-controller up: probes on %s:%d, webhooks on :%d (%s)",
+        host, health_server.port, webhook_server.port,
+        "https" if webhook_server.tls_enabled else "http",
+    )
+
+    metrics_server = None
+    if opts.metrics_addr and opts.metrics_addr != "0":
+        from kubeflow_tpu.metrics.metrics import Metrics
+        from kubeflow_tpu.metrics.server import MetricsServer
+
+        mhost, mport = split_addr(opts.metrics_addr)
+        metrics_server = MetricsServer(Metrics(client), host=mhost, port=mport)
+        metrics_server.start()
+
+    install_signal_handlers(stop)
+    try:
+        serve(bundle, client, stop)
+    finally:
+        health_server.stop()
+        webhook_server.stop()
+        if metrics_server is not None:
+            metrics_server.stop()
+        client.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess e2e
+    raise SystemExit(main())

@@ -21,7 +21,15 @@ from kubeflow_tpu.k8s.objects import (  # noqa: F401
 )
 from kubeflow_tpu.k8s.client import Client, retry_on_conflict  # noqa: F401
 from kubeflow_tpu.k8s.fake import FakeCluster, AdmissionRequest  # noqa: F401
-from kubeflow_tpu.k8s.manager import Manager, Reconciler, Result, FakeClock  # noqa: F401
+from kubeflow_tpu.k8s.manager import (  # noqa: F401
+    Manager,
+    Reconciler,
+    Result,
+    FakeClock,
+    RealClock,
+)
+from kubeflow_tpu.k8s.real import ClusterConfig, RealClient  # noqa: F401
+from kubeflow_tpu.k8s.envtest import EnvtestServer  # noqa: F401
 from kubeflow_tpu.k8s.chaos import ChaosClient, FaultConfig  # noqa: F401
 from kubeflow_tpu.k8s.fixtures import (  # noqa: F401
     FakeKubelet,

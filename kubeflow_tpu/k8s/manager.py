@@ -15,9 +15,19 @@ from __future__ import annotations
 import heapq
 import logging
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Callable, Optional, Protocol
 
-from kubeflow_tpu.k8s.fake import FakeCluster, WatchEvent
+from kubeflow_tpu.k8s.fake import WatchEvent
+
+
+class WatchSource(Protocol):
+    """What the Manager needs from a cluster: an ordered event stream.
+
+    FakeCluster (tests) and RealClient (production watch threads) both
+    provide it — the reconcile loop is identical against either.
+    """
+
+    def drain_events(self, cursor: int) -> tuple[list[WatchEvent], int]: ...
 
 log = logging.getLogger(__name__)
 
@@ -62,6 +72,23 @@ class FakeClock:
         self._t += seconds
 
 
+class RealClock:
+    """Wall clock for production serving: ``advance`` is a no-op (time
+    advances itself), so ``Manager.tick(0)`` fires exactly the requeues
+    that have actually come due."""
+
+    def __call__(self) -> float:
+        import time
+
+        return time.time()
+
+    def now(self) -> float:
+        return self()
+
+    def advance(self, seconds: float) -> None:
+        pass
+
+
 @dataclass
 class _Registration:
     reconciler: Reconciler
@@ -79,7 +106,7 @@ class Manager:
     are driven by ``tick``.
     """
 
-    def __init__(self, cluster: FakeCluster, clock: Optional[FakeClock] = None):
+    def __init__(self, cluster: WatchSource, clock: Optional[FakeClock] = None):
         self.cluster = cluster
         self.clock = clock or FakeClock()
         self._registrations: list[_Registration] = []
@@ -113,6 +140,16 @@ class Manager:
         self._registrations.append(
             _Registration(reconciler, watch_list, name or type(reconciler).__name__)
         )
+
+    def watched_kinds(self) -> list[str]:
+        """Union of kinds any registered reconciler watches (the set of
+        watch streams a production serve loop must open)."""
+        kinds: list[str] = []
+        for reg in self._registrations:
+            for watch in reg.watches:
+                if watch.kind not in kinds:
+                    kinds.append(watch.kind)
+        return kinds
 
     # -- loop --------------------------------------------------------------
 
@@ -187,6 +224,9 @@ class Manager:
             # AND record the error so run_until_idle() callers can notice
             # (the retry only fires on tick(), not run_until_idle()).
             self.reconcile_errors.append((reg.name, req, err))
+            # Bound the error log for long-running serve loops; tests read
+            # it between run_until_idle calls, long before 1000 entries.
+            del self.reconcile_errors[:-1000]
             self._schedule_requeue(reg_idx, req, 1.0)
             return 1
         if result and result.requeue_after > 0:

@@ -1,0 +1,379 @@
+"""Production-wiring e2e: managers over RealClient + HTTP apiserver.
+
+The round-1 verdict's acceptance test: both managers assembled by the SAME
+``build()`` that ``main()`` uses, talking to an apiserver over HTTP (the
+envtest façade), admission delivered over HTTPS with self-signed serving
+certs, a kubelet fixture also living on the far side of HTTP, and a
+Notebook CR becoming running pods end-to-end — the reference's KinD
+integration flow (reference .github/workflows/
+odh_notebook_controller_integration_test.yaml:120-220) without cluster
+binaries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu import k8s
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.cmd import notebook_manager, platform_manager
+from kubeflow_tpu.k8s.envtest import EnvtestServer
+from kubeflow_tpu.k8s.manager import Manager, RealClock
+from kubeflow_tpu.k8s.real import RealClient
+from kubeflow_tpu.k8s.serve import serve, split_addr
+from kubeflow_tpu.metrics.server import MetricsServer
+from kubeflow_tpu.webhook.server import MUTATE_PATH, VALIDATE_PATH, WebhookServer
+
+from tests.harness import tpu_notebook
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_certs(cert_dir, cn="webhook.opendatahub.svc") -> str:
+    """Self-signed serving cert via the openssl CLI (the KinD workflow's
+    cert-generation step). Returns the CA path (== the cert, self-signed)."""
+    cert = os.path.join(cert_dir, "tls.crt")
+    key = os.path.join(cert_dir, "tls.key")
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048",
+            "-keyout", key, "-out", cert, "-days", "1", "-nodes",
+            "-subj", f"/CN={cn}",
+            "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost",
+        ],
+        check=True, capture_output=True,
+    )
+    return cert
+
+
+class _Shim:
+    """Minimal bundle for serve(): the kubelet fixture's manager."""
+
+    def __init__(self, manager):
+        self.manager = manager
+
+    def run_until_idle(self, max_cycles: int = 200) -> int:
+        return self.manager.run_until_idle(max_cycles)
+
+    def tick(self, seconds: float) -> int:
+        return self.manager.tick(seconds)
+
+
+@pytest.fixture
+def stack(tmp_path):
+    """apiserver + both managers + kubelet, all over the wire."""
+    cluster = k8s.FakeCluster()
+    k8s.add_tpu_node_pool(
+        cluster, "tpu-v5-lite-podslice", "4x4", hosts=4, chips_per_host=4
+    )
+    server = EnvtestServer(cluster).start()
+
+    clients: list[RealClient] = []
+
+    def new_client() -> RealClient:
+        c = RealClient(server.client_config())
+        clients.append(c)
+        return c
+
+    # Platform manager + HTTPS admission.
+    ca_file = make_certs(str(tmp_path))
+    platform = platform_manager.build(
+        new_client(),
+        env={"K8S_NAMESPACE": "opendatahub"},
+        argv=["--kube-rbac-proxy-image", "proxy:v1"],
+        clock=RealClock(),
+    )
+    webhook_server = WebhookServer(
+        mutating_handler=platform.mutating_webhook.handle,
+        validating_handler=platform.validating_webhook.handle,
+        cert_dir=str(tmp_path),
+        tls_profile=platform.tls_profile,
+    )
+    webhook_server.start()
+    assert webhook_server.tls_enabled
+    base = f"https://127.0.0.1:{webhook_server.port}"
+    server.add_remote_webhook(
+        "Notebook",
+        mutate_url=base + MUTATE_PATH,
+        validate_url=base + VALIDATE_PATH,
+        ca_file=ca_file,
+    )
+
+    core = notebook_manager.build(new_client(), env={}, clock=RealClock())
+
+    kubelet_client = new_client()
+    kubelet_manager = Manager(kubelet_client, clock=RealClock())
+    k8s.FakeKubelet(kubelet_client).register(kubelet_manager)
+
+    stop = threading.Event()
+    threads = [
+        threading.Thread(target=serve, args=(b, c, stop), daemon=True)
+        for b, c in (
+            (platform, clients[0]),
+            (core, clients[1]),
+            (_Shim(kubelet_manager), kubelet_client),
+        )
+    ]
+    for t in threads:
+        t.start()
+
+    class Stack:
+        pass
+
+    s = Stack()
+    s.server, s.cluster, s.core, s.platform = server, cluster, core, platform
+    s.webhook_server, s.user = webhook_server, new_client()
+    s.tmp_path = tmp_path
+    yield s
+
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    webhook_server.stop()
+    for c in clients:
+        c.stop()
+    server.stop()
+
+
+def _wait_for(fn, timeout=30.0, interval=0.1, desc="condition"):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            last = fn()
+            if last:
+                return last
+        except Exception as err:  # noqa: PERF203 - poll loop
+            last = err
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc} (last: {last!r})")
+
+
+@pytest.mark.slow
+def test_notebook_becomes_running_pods_over_the_wire(stack):
+    nb = tpu_notebook(name="wb")
+    created = stack.user.create(nb)
+    # HTTPS admission ran: reconciliation lock + TPU env injected.
+    assert created["metadata"]["annotations"][ann.STOP] == ann.RECONCILIATION_LOCK_VALUE
+    env_names = {
+        e["name"]
+        for c in created["spec"]["template"]["spec"]["containers"]
+        for e in c.get("env", [])
+    }
+    assert "TPU_WORKER_HOSTNAMES" in env_names
+
+    def slice_ready():
+        obj = stack.user.get("Notebook", "wb", "ns")
+        return obj if obj.get("status", {}).get("readyReplicas") == 4 else None
+
+    obj = _wait_for(slice_ready, desc="4 ready hosts")
+    assert obj["status"]["tpu"]["sliceHealth"] == "Healthy"
+
+    pods = stack.user.list("Pod", "ns", {"notebook-name": "wb"})
+    assert len(pods) == 4
+    # Platform side converged too (HTTPRoute lives in the central ns).
+    _wait_for(
+        lambda: stack.user.exists("HTTPRoute", "nb-ns-wb", "opendatahub"),
+        desc="HTTPRoute",
+    )
+    _wait_for(
+        lambda: stack.user.exists("NetworkPolicy", "wb-ctrl-np", "ns"),
+        desc="NetworkPolicy",
+    )
+
+    # Validating webhook over HTTPS: topology change on a running slice denied.
+    from kubeflow_tpu.k8s.errors import WebhookDeniedError
+
+    fresh = stack.user.get("Notebook", "wb", "ns")
+    fresh["spec"]["tpu"]["topology"] = "2x4"
+    with pytest.raises(WebhookDeniedError):
+        stack.user.update(fresh)
+
+    # Delete: finalizer-driven cleanup drains everything.
+    stack.user.delete("Notebook", "wb", "ns")
+    _wait_for(
+        lambda: not stack.user.exists("Notebook", "wb", "ns"),
+        desc="notebook deletion",
+    )
+    _wait_for(lambda: stack.user.list("Pod", "ns") == [], desc="pods gone")
+
+
+@pytest.mark.slow
+def test_metrics_and_cert_rotation(stack):
+    # /metrics serves the reference metric set off a live scrape.
+    metrics_server = MetricsServer(stack.core.metrics)
+    metrics_server.start()
+    try:
+        stack.user.create(tpu_notebook(name="wb2"))
+        _wait_for(
+            lambda: stack.user.get("Notebook", "wb2", "ns")
+            .get("status", {}).get("readyReplicas") == 4,
+            desc="slice ready",
+        )
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics_server.port}/metrics", timeout=5
+        ).read().decode()
+        assert "notebook_running 1.0" in body
+        assert "notebook_create_total 1.0" in body
+        assert "tpu_chips_in_use 16.0" in body
+        assert "tpu_slice_ready_seconds" in body
+    finally:
+        metrics_server.stop()
+
+    # Cert rotation: regenerate serving certs in place; the reloader picks
+    # them up and admission keeps working over HTTPS.
+    old_reloads = stack.webhook_server.cert_reloads
+    time.sleep(0.05)  # ensure distinct mtime_ns at fs-timestamp granularity
+    new_ca = make_certs(str(stack.tmp_path))
+    assert stack.webhook_server.poll_certs()
+    assert stack.webhook_server.cert_reloads == old_reloads + 1
+    # Re-point the apiserver's caBundle at the rotated CA (real clusters
+    # rotate both sides the same way) and prove admission still round-trips.
+    stack.server.add_remote_webhook(
+        "Notebook",
+        mutate_url=f"https://127.0.0.1:{stack.webhook_server.port}{MUTATE_PATH}",
+        validate_url=f"https://127.0.0.1:{stack.webhook_server.port}{VALIDATE_PATH}",
+        ca_file=new_ca,
+    )
+    created = stack.user.create(tpu_notebook(name="wb3"))
+    assert created["metadata"]["annotations"][ann.STOP] == ann.RECONCILIATION_LOCK_VALUE
+
+
+def test_webhook_server_fails_closed_without_certs(tmp_path):
+    from kubeflow_tpu.webhook.server import CertError
+
+    with pytest.raises(CertError):
+        WebhookServer(cert_dir=str(tmp_path))  # empty dir: no tls.crt/key
+
+
+def test_tls_profile_applied_to_listener(tmp_path):
+    import ssl
+
+    from kubeflow_tpu.controller.tls import MODERN
+
+    make_certs(str(tmp_path))
+    server = WebhookServer(
+        mutating_handler=lambda req: req.object,
+        cert_dir=str(tmp_path),
+        tls_profile=MODERN,
+    )
+    server.start()
+    try:
+        # Modern profile = TLS 1.3 minimum: a 1.2-capped client must fail.
+        capped = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        capped.check_hostname = False
+        capped.verify_mode = ssl.CERT_NONE
+        capped.maximum_version = ssl.TLSVersion.TLSv1_2
+        with pytest.raises(ssl.SSLError):
+            with socket.create_connection(("127.0.0.1", server.port), 5) as sock:
+                with capped.wrap_socket(sock):
+                    pass
+        # And a 1.3 client succeeds.
+        ok = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ok.check_hostname = False
+        ok.verify_mode = ssl.CERT_NONE
+        with socket.create_connection(("127.0.0.1", server.port), 5) as sock:
+            with ok.wrap_socket(sock) as tls:
+                assert tls.version() == "TLSv1.3"
+    finally:
+        server.stop()
+
+
+@pytest.mark.slow
+def test_manager_entrypoint_subprocess(tmp_path):
+    """`python -m kubeflow_tpu.cmd.notebook_manager` — the container
+    ENTRYPOINT — must serve probes, reconcile, and exit 0 on SIGTERM."""
+    cluster = k8s.FakeCluster()
+    k8s.add_tpu_node_pool(
+        cluster, "tpu-v5-lite-podslice", "4x4", hosts=4, chips_per_host=4
+    )
+    server = EnvtestServer(cluster).start()
+
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(
+        f"""
+apiVersion: v1
+kind: Config
+current-context: envtest
+contexts:
+- name: envtest
+  context: {{cluster: envtest, user: dev}}
+clusters:
+- name: envtest
+  cluster: {{server: "http://127.0.0.1:{server.port}"}}
+users:
+- name: dev
+  user: {{}}
+"""
+    )
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    probe_port, metrics_port = free_port(), free_port()
+    env = {
+        **os.environ,
+        "KUBECONFIG": str(kubeconfig),
+        "KUBERNETES_SERVICE_HOST": "",  # force the kubeconfig path
+        "PYTHONPATH": REPO_ROOT,
+    }
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "kubeflow_tpu.cmd.notebook_manager",
+            "--probe-addr", f"127.0.0.1:{probe_port}",
+            "--metrics-addr", f"127.0.0.1:{metrics_port}",
+        ],
+        env=env, cwd=REPO_ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        def probe_ok():
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{probe_port}/healthz", timeout=1
+                ) as resp:
+                    return resp.status == 200
+            except OSError:
+                return False
+
+        _wait_for(probe_ok, timeout=20, desc="healthz")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{probe_port}/readyz", timeout=2
+        ) as resp:
+            assert json.loads(resp.read())["readyz"] == "ok"
+
+        # The subprocess manager reconciles a Notebook created via the API.
+        user = RealClient(server.client_config())
+        user.create(tpu_notebook(name="subp"))
+        _wait_for(
+            lambda: user.exists("StatefulSet", "subp", "ns"),
+            timeout=20, desc="subprocess reconcile",
+        )
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics_port}/metrics", timeout=2
+        ) as resp:
+            assert b"notebook_create_total" in resp.read()
+        user.stop()
+
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=15) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        out = proc.stdout.read().decode(errors="replace")
+        server.stop()
+        if proc.returncode not in (0, -signal.SIGKILL):
+            raise AssertionError(f"manager exited {proc.returncode}:\n{out}")

@@ -15,6 +15,7 @@ from kubeflow_tpu.webhook.server import (
     MUTATE_PATH,
     VALIDATE_PATH,
     WebhookServer,
+    apply_json_patch,
     handle_admission_review,
 )
 from kubeflow_tpu.webhook.validating import NotebookValidatingWebhook
@@ -44,13 +45,17 @@ def cluster():
 
 def test_mutate_review_returns_patch(cluster):
     webhook = NotebookMutatingWebhook(cluster, WebhookConfig())
+    original = tpu_notebook(name="nb1")
     review = handle_admission_review(
-        _review(tpu_notebook(name="nb1")), webhook.handle, None
+        _review(original), webhook.handle, None
     )
     resp = review["response"]
     assert resp["allowed"] and resp["uid"] == "uid-1"
     patch = json.loads(base64.b64decode(resp["patch"]))
-    patched = patch[0]["value"]
+    # Granular RFC 6902 ops, never a whole-root replace (which would
+    # clobber concurrent webhook mutations in the admission chain).
+    assert all(op["path"] != "" for op in patch)
+    patched = apply_json_patch(original, patch)
     assert patched["metadata"]["annotations"][ann.STOP] == ann.RECONCILIATION_LOCK_VALUE
     env_names = {
         e["name"]
@@ -118,7 +123,8 @@ def test_noop_mutation_returns_no_patch(cluster):
     webhook = NotebookMutatingWebhook(cluster, WebhookConfig())
     obj = tpu_notebook(name="nb1")
     first = handle_admission_review(_review(obj), webhook.handle, None)
-    mutated = json.loads(base64.b64decode(first["response"]["patch"]))[0]["value"]
+    ops = json.loads(base64.b64decode(first["response"]["patch"]))
+    mutated = apply_json_patch(obj, ops)
     second = handle_admission_review(
         _review(mutated, operation="UPDATE", old=mutated), webhook.handle, None
     )
