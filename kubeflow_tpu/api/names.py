@@ -6,10 +6,43 @@ name, and the env the webhook injects must match the ports the Services and
 runtime bootstrap use. Each name/port is defined exactly once, here.
 """
 
+import hashlib
+
 NOTEBOOK_PORT = 8888
 RBAC_PROXY_PORT = 8443
 JAX_COORDINATOR_PORT = 8476  # jax.distributed default coordinator port
 MEGASCALE_PORT = 8081  # megascale (multislice DCN) coordinator port
+
+def derived_name(base: str, suffix: str = "", limit: int = 63) -> str:
+    """``{base}{suffix}`` when it fits ``limit``, else a deterministic
+    hashed fallback: truncated base + 8-hex sha1(base) + suffix.
+
+    Every child-object name derived from a Notebook name goes through
+    this, so a long Notebook name degrades consistently everywhere
+    (StatefulSets at 52 chars, Services/DNS labels at 63) instead of
+    being rejected by the apiserver on whichever object overflows first.
+    The reference's answer is apiserver GenerateName + controller-ref
+    lookup (reference notebook_controller.go:145-149,444-447); a content
+    hash keeps long names working without giving up get-by-name, which
+    slice DNS, the culler, and cross-component lookups rely on.
+    """
+    candidate = f"{base}{suffix}"
+    if len(candidate) <= limit:
+        return candidate
+    digest = hashlib.sha1(base.encode()).hexdigest()[:8]
+    keep = limit - len(suffix) - len(digest) - 1
+    return f"{base[:keep]}-{digest}{suffix}"
+
+
+def routing_service_name(notebook_name: str) -> str:
+    """The per-notebook routing Service (reference generateService :525)."""
+    return derived_name(notebook_name, "", 63)
+
+
+def proxy_service_name(notebook_name: str) -> str:
+    """kube-rbac-proxy Service (reference notebook_kube_rbac_auth.go:95)."""
+    return derived_name(notebook_name, "-kube-rbac-proxy", 63)
+
 
 CA_BUNDLE_CONFIGMAP = "workbench-trusted-ca-bundle"
 RUNTIME_IMAGES_CONFIGMAP = "pipeline-runtime-images"

@@ -24,8 +24,9 @@ from kubeflow_tpu.webhook.auth_sidecar import (
 )
 
 
-def proxy_service_name(notebook_name: str) -> str:
-    return f"{notebook_name}-kube-rbac-proxy"
+from kubeflow_tpu.api.names import proxy_service_name  # noqa: F401  (shared
+# with routes.py so the HTTPRoute backendRef always matches the Service,
+# including the long-name hashed fallback)
 
 
 def crb_name(nb: Notebook) -> str:

@@ -268,7 +268,12 @@ class CullingReconciler(Reconciler):
                     )
                 ]
         # Single pod: route via the plain Service, as the reference does.
-        return [f"{nb.name}.{nb.namespace}.svc.{self.config.cluster_domain}"]
+        from kubeflow_tpu.api.names import routing_service_name
+
+        return [
+            f"{routing_service_name(nb.name)}.{nb.namespace}"
+            f".svc.{self.config.cluster_domain}"
+        ]
 
     def _update_activity(
         self, nb: Notebook, activities: list[HostActivity], now: float

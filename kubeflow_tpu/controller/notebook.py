@@ -43,6 +43,8 @@ from kubeflow_tpu.api.names import (
     JAX_COORDINATOR_PORT,
     MEGASCALE_PORT,
     NOTEBOOK_PORT,
+    derived_name,
+    routing_service_name,
 )
 from kubeflow_tpu.webhook.tpu_env import upsert_env
 
@@ -80,17 +82,23 @@ class ControllerConfig:
 
 
 def headless_service_name(notebook_name: str) -> str:
-    return f"{notebook_name}-hosts"
+    # Service names get the full 63-char DNS label budget.
+    return derived_name(notebook_name, "-hosts", 63)
 
 
 def slice_sts_name(notebook_name: str, slice_id: int) -> str:
     """StatefulSet name for one slice of a (possibly multislice) notebook.
 
-    Slice 0 keeps the bare notebook name — single-slice notebooks (the
-    overwhelmingly common case) are byte-identical to the pre-multislice
-    layout, and pod-0 DNS/routing ({name}-0) stays stable.
+    Slice 0 keeps the bare notebook name whenever it fits — single-slice
+    notebooks (the overwhelmingly common case) are byte-identical to the
+    pre-multislice layout, and pod-0 DNS/routing ({name}-0) stays stable.
+    Names that would overflow the 52-char StatefulSet budget fall back to
+    the deterministic hashed form from ``api.names.derived_name`` instead
+    of being rejected (reference GenerateName fallback,
+    notebook_controller.go:145-149).
     """
-    return notebook_name if slice_id == 0 else f"{notebook_name}-s{slice_id}"
+    suffix = "" if slice_id == 0 else f"-s{slice_id}"
+    return derived_name(notebook_name, suffix, MAX_NAME_LENGTH)
 
 
 def slice_sts_names(notebook_name: str, slice_count: int) -> list[str]:
@@ -138,21 +146,6 @@ class NotebookReconciler(Reconciler):
             return Result()
         nb = Notebook(obj)
 
-        # The LONGEST generated STS name must fit: multislice appends
-        # "-s{j}", and slice 1+'s pods would silently fail to come up if
-        # only the bare name were checked.
-        slice_suffix = (
-            len(f"-s{nb.tpu.slice_count - 1}")
-            if nb.tpu is not None and nb.tpu.slice_count > 1
-            else 0
-        )
-        if len(nb.name) + slice_suffix > MAX_NAME_LENGTH:
-            self.recorder.eventf(
-                obj, "Warning", "InvalidName",
-                f"Notebook name plus slice suffix exceeds {MAX_NAME_LENGTH} "
-                "characters; StatefulSet pod hostnames would be invalid",
-            )
-            return Result()
 
         # Resolve TPU topology up front; an invalid spec must never produce
         # a half-scheduled slice.
@@ -181,6 +174,24 @@ class NotebookReconciler(Reconciler):
             created_any |= self._reconcile_statefulset(obj, sts)
         if created_any:
             self.metrics.create_total.inc()
+            # Long names fall back to deterministic hashed StatefulSet
+            # names (reference GenerateName fallback,
+            # notebook_controller.go:145-149) instead of a silently-never-
+            # scheduled notebook; surface the substitution on creation so
+            # the user can find their pods (not every reconcile — eventf
+            # costs API round-trips).
+            fallback_names = [
+                n for j in range(slice_count)
+                if (n := slice_sts_name(nb.name, j))
+                != (nb.name if j == 0 else f"{nb.name}-s{j}")
+            ]
+            if fallback_names:
+                self.recorder.eventf(
+                    obj, "Normal", "LongNameFallback",
+                    f"Notebook name exceeds {MAX_NAME_LENGTH} characters "
+                    f"for its slice layout; using generated StatefulSet "
+                    f"name(s) {', '.join(fallback_names)}",
+                )
         self._prune_stale_slice_sts(nb, slice_count)
 
         service = generate_service(nb)
@@ -253,12 +264,12 @@ class NotebookReconciler(Reconciler):
 
     # ------------------------------------------------------------------
     def _slice_pods(self, nb: Notebook) -> list[dict]:
-        out = []
-        for pod in self.client.list("Pod", nb.namespace):
-            labels = pod.get("metadata", {}).get("labels", {})
-            if labels.get(ann.NOTEBOOK_NAME_LABEL) == nb.name:
-                out.append(pod)
-        return sorted(out, key=obj_util.name_of)
+        # Server-side label selection: this runs in every reconcile, and a
+        # full-namespace pod list would be O(namespace) on a real apiserver.
+        pods = self.client.list(
+            "Pod", nb.namespace, {ann.NOTEBOOK_NAME_LABEL: nb.name}
+        )
+        return sorted(pods, key=obj_util.name_of)
 
     def _update_status(self, nb: Notebook, slice_topo: Optional[SliceTopology]) -> None:
         """Mirror pod state onto the Notebook (reference
@@ -307,7 +318,8 @@ class NotebookReconciler(Reconciler):
                 status["tpu"]["hostsPerSlice"] = slice_topo.hosts
             if hosts > 1:
                 status["tpu"]["jaxCoordinator"] = (
-                    f"{nb.name}-0.{headless_service_name(nb.name)}."
+                    f"{slice_sts_name(nb.name, 0)}-0."
+                    f"{headless_service_name(nb.name)}."
                     f"{nb.namespace}.svc.{self.config.cluster_domain}"
                     f":{JAX_COORDINATOR_PORT}"
                 )
@@ -374,15 +386,25 @@ class NotebookReconciler(Reconciler):
         """Surface Warning events from slice pods on the Notebook itself
         (reference :99-126 re-emits via nbNameFromInvolvedObject)."""
         slice_count = nb.tpu.slice_count if nb.tpu is not None else 1
-        prefixes = {
+        pod_names = [
             f"{sts}-{i}"
             for sts in slice_sts_names(nb.name, slice_count)
             for i in range(slice_topo.hosts if slice_topo else 1)
-        }
-        for event in self.client.list("Event", nb.namespace):
+        ]
+        # One indexed query per slice pod (involvedObject fields are an
+        # apiserver field index) instead of scanning every Event in the
+        # namespace on each reconcile.
+        events: list[dict] = []
+        for pod_name in pod_names:
+            events.extend(self.client.list(
+                "Event", nb.namespace,
+                field_selector={
+                    "involvedObject.kind": "Pod",
+                    "involvedObject.name": pod_name,
+                },
+            ))
+        for event in events:
             inv = event.get("involvedObject", {})
-            if inv.get("kind") != "Pod" or inv.get("name") not in prefixes:
-                continue
             if event.get("type") != "Warning":
                 continue
             marks = event.get("metadata", {}).get("annotations", {})
@@ -494,7 +516,7 @@ def generate_statefulset(
             "selector": {"matchLabels": {"statefulset": sts_name}},
             "serviceName": headless_service_name(nb.name)
             if slice_topo is not None
-            else nb.name,
+            else routing_service_name(nb.name),
             "template": {
                 "metadata": {
                     "labels": template_labels,
@@ -534,9 +556,11 @@ def _apply_multislice_env(
         sts_name, headless, nb.namespace, config.cluster_domain
     )
     # Slice 0 / host 0 coordinates both planes (jax.distributed and
-    # megascale); its name is the bare notebook name, so this is stable.
+    # megascale); slice_sts_name(…, 0) keeps the long-name fallback
+    # consistent with the actual pod hostname.
     head = (
-        f"{nb.name}-0.{headless}.{nb.namespace}.svc.{config.cluster_domain}"
+        f"{slice_sts_name(nb.name, 0)}-0.{headless}."
+        f"{nb.namespace}.svc.{config.cluster_domain}"
     )
     upsert_env(
         container,
@@ -579,25 +603,27 @@ def _apply_container_defaults(
 
 
 def generate_service(nb: Notebook) -> dict:
-    """Routing Service: port 80 "http-notebook" → 8888 on pod 0 (reference
-    generateService :525-556; Jupyter runs on worker 0 of a slice)."""
+    """Routing Service: port 80 → 8888 on pod 0 (reference generateService
+    :525-556; Jupyter runs on worker 0 of a slice). Selector and port name
+    go through the same long-name derivation as the StatefulSet — a
+    mismatch would leave a running slice unreachable."""
     return {
         "apiVersion": "v1",
         "kind": "Service",
         "metadata": {
-            "name": nb.name,
+            "name": routing_service_name(nb.name),
             "namespace": nb.namespace,
             "labels": {ann.NOTEBOOK_NAME_LABEL: nb.name},
         },
         "spec": {
             "type": "ClusterIP",
             "selector": {
-                "statefulset": nb.name,
+                "statefulset": slice_sts_name(nb.name, 0),
                 "apps.kubernetes.io/pod-index": "0",
             },
             "ports": [
                 {
-                    "name": "http-" + nb.name,
+                    "name": derived_name("http-" + nb.name, "", 63),
                     "port": 80,
                     "targetPort": NOTEBOOK_PORT,
                     "protocol": "TCP",

@@ -56,14 +56,20 @@ def route_name(nb: Notebook) -> str:
 
 def new_httproute(nb: Notebook, cfg: RouteConfig, auth: bool) -> dict:
     """Build the HTTPRoute (reference NewNotebookHTTPRoute :51-132)."""
+    from kubeflow_tpu.api.names import proxy_service_name, routing_service_name
+
     if auth:
         backend = {
-            "name": f"{nb.name}-kube-rbac-proxy",
+            "name": proxy_service_name(nb.name),
             "namespace": nb.namespace,
             "port": 8443,
         }
     else:
-        backend = {"name": nb.name, "namespace": nb.namespace, "port": 80}
+        backend = {
+            "name": routing_service_name(nb.name),
+            "namespace": nb.namespace,
+            "port": 80,
+        }
     return {
         "apiVersion": HTTPROUTE_API,
         "kind": "HTTPRoute",

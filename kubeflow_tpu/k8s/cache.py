@@ -78,10 +78,11 @@ class TransformingClient:
         kind: str,
         namespace: Optional[str] = None,
         labels: Optional[dict] = None,
+        field_selector: Optional[dict] = None,
     ) -> Iterable[dict]:
         return [
             strip_payload(o, self.keep)
-            for o in self.inner.list(kind, namespace, labels)
+            for o in self.inner.list(kind, namespace, labels, field_selector)
         ]
 
     def create(self, obj: dict) -> dict:

@@ -70,9 +70,12 @@ class ChaosClient:
         self._maybe_fail("get", kind)
         return self._inner.get(kind, name, namespace)
 
-    def list(self, kind: str, namespace: str = "", label_selector=None) -> list[dict]:
+    def list(
+        self, kind: str, namespace: str = "", label_selector=None,
+        field_selector=None,
+    ) -> list[dict]:
         self._maybe_fail("list", kind)
-        return self._inner.list(kind, namespace, label_selector)
+        return self._inner.list(kind, namespace, label_selector, field_selector)
 
     def create(self, obj: dict) -> dict:
         self._maybe_fail("create", obj.get("kind", ""))

@@ -22,6 +22,7 @@ class Client(Protocol):
         kind: str,
         namespace: str = "",
         label_selector: Optional[dict] = None,
+        field_selector: Optional[dict] = None,
     ) -> list[dict]: ...
 
     def create(self, obj: dict) -> dict: ...

@@ -68,8 +68,8 @@ def _parse_path(path: str) -> Optional[_Route]:
     )
 
 
-def _selector_from_query(qs: dict) -> Optional[dict]:
-    raw = (qs.get("labelSelector") or [""])[0]
+def _selector_from_query(qs: dict, key: str = "labelSelector") -> Optional[dict]:
+    raw = (qs.get(key) or [""])[0]
     if not raw:
         return None
     out = {}
@@ -167,8 +167,11 @@ class EnvtestServer:
                     if (qs.get("watch") or ["false"])[0] == "true":
                         return self._stream_watch(route, qs)
                     selector = _selector_from_query(qs)
+                    fields = _selector_from_query(qs, "fieldSelector")
                     with outer.lock:
-                        items = outer.cluster.list(route.kind, route.namespace, selector)
+                        items = outer.cluster.list(
+                            route.kind, route.namespace, selector, fields
+                        )
                         cursor = len(outer.cluster.events)
                     info = rest.info_for(route.kind)
                     return self._reply(200, {

@@ -155,6 +155,7 @@ class FakeCluster:
         kind: str,
         namespace: str = "",
         label_selector: Optional[dict] = None,
+        field_selector: Optional[dict] = None,
     ) -> list[dict]:
         if kind in CLUSTER_SCOPED_KINDS:
             namespace = ""  # normalize like _key: a ns filter would hide all
@@ -165,6 +166,8 @@ class FakeCluster:
             if namespace and ns != namespace:
                 continue
             if not obj_util.matches_labels(obj, label_selector):
+                continue
+            if not obj_util.matches_fields(obj, field_selector):
                 continue
             out.append(copy.deepcopy(obj))
         return out

@@ -130,6 +130,23 @@ def matches_labels(obj: dict, selector: Optional[dict]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def matches_fields(obj: dict, selector: Optional[dict]) -> bool:
+    """fieldSelector equality over dotted paths (the apiserver's indexed
+    subset, e.g. ``involvedObject.name=wb-0`` on Events)."""
+    if not selector:
+        return True
+    for path, want in selector.items():
+        cur = obj
+        for part in path.split("."):
+            if not isinstance(cur, dict):
+                cur = None
+                break
+            cur = cur.get(part)
+        if cur != want:
+            return False
+    return True
+
+
 def merge_patch(obj: dict, patch: dict) -> dict:
     """Apply an RFC 7386 JSON merge patch, returning a new object."""
     result = copy.deepcopy(obj)

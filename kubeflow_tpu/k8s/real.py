@@ -307,8 +307,11 @@ class RealClient:
         kind: str,
         namespace: str = "",
         label_selector: Optional[dict] = None,
+        field_selector: Optional[dict] = None,
     ) -> list[dict]:
-        path = rest.collection_path(kind, namespace) + rest.list_query(label_selector)
+        path = rest.collection_path(kind, namespace) + rest.list_query(
+            label_selector, field_selector=field_selector
+        )
         doc = self._request("GET", path)
         return [_ensure_tkg(item, kind) for item in doc.get("items", [])]
 

@@ -129,12 +129,18 @@ def list_query(
     resource_version: str = "",
     allow_bookmarks: bool = False,
     timeout_seconds: int = 0,
+    field_selector: Optional[dict] = None,
 ) -> str:
     """Query string for a list or watch request (empty or "?...")."""
     params: list[tuple[str, str]] = []
     sel = label_selector_str(label_selector)
     if sel:
         params.append(("labelSelector", sel))
+    if field_selector:
+        params.append((
+            "fieldSelector",
+            ",".join(f"{k}={v}" for k, v in sorted(field_selector.items())),
+        ))
     if watch:
         params.append(("watch", "true"))
         if allow_bookmarks:

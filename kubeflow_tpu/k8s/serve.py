@@ -54,11 +54,22 @@ def serve(
     """
     stop = stop or threading.Event()
     manager = bundle.manager
-    if hasattr(client, "start_watches"):
-        client.start_watches(manager.watched_kinds())
+    elector = getattr(bundle, "elector", None)
+    watches_started = False
 
     iterations = 0
     while not stop.is_set():
+        # A standby replica never drains the event stream (tick() bails
+        # before the manager runs), so its watches would accumulate events
+        # unboundedly and waiting on the stream would return immediately
+        # forever. Standbys therefore keep their watches unopened and just
+        # sleep between lease-acquisition attempts.
+        is_standby = elector is not None and not elector.try_acquire()
+        if not is_standby and not watches_started:
+            if hasattr(client, "start_watches"):
+                client.start_watches(manager.watched_kinds())
+            watches_started = True
+
         try:
             if hasattr(bundle, "tick"):
                 bundle.tick(0)
@@ -74,13 +85,6 @@ def serve(
         iterations += 1
         if max_iterations and iterations >= max_iterations:
             return
-
-        # A standby replica never drains events (tick() bails before the
-        # manager runs), so waiting on the event stream would return
-        # immediately forever — a busy loop hammering the Lease. Standbys
-        # just sleep between acquisition attempts.
-        elector = getattr(bundle, "elector", None)
-        is_standby = elector is not None and not elector.try_acquire()
 
         delay = manager.next_requeue_in()
         timeout = max_idle_wait if delay is None else max(0.0, min(delay, max_idle_wait))

@@ -295,6 +295,18 @@ class WebhookServer:
         validating = validating_handler
 
         class Handler(BaseHTTPRequestHandler):
+            # Bounds both the deferred TLS handshake and request reads: a
+            # half-open client costs one handler thread for 30s, never the
+            # accept loop.
+            timeout = 30
+
+            def setup(self):
+                super().setup()
+                if isinstance(self.connection, ssl.SSLSocket):
+                    # Deferred handshake (see wrap_socket below) under this
+                    # handler's timeout; failures close just this thread.
+                    self.connection.do_handshake()
+
             def do_POST(self):  # noqa: N802 (http.server API)
                 length = int(self.headers.get("Content-Length", 0))
                 try:
@@ -321,14 +333,30 @@ class WebhookServer:
             def log_message(self, *args):
                 pass
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self._server.daemon_threads = True
+        class _QuietServer(ThreadingHTTPServer):
+            daemon_threads = True
+
+            def handle_error(self, request, client_address):
+                # Handshake failures from probes/scans are expected noise;
+                # a traceback per bad connection would flood the log.
+                log.debug("webhook connection error from %s", client_address)
+
+        self._server = _QuietServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
         self._reloader: Optional[_CertReloader] = None
         self.tls_enabled = False
         if cert_dir:
             ctx = make_ssl_context(cert_dir, tls_profile)  # raises CertError: fail closed
-            self._server.socket = ctx.wrap_socket(self._server.socket, server_side=True)
+            # do_handshake_on_connect=False: accept() returns immediately
+            # and the handshake happens on the handler THREAD's first read.
+            # Otherwise one client that connects and never speaks TLS
+            # (port scan, half-open probe) wedges the accept loop and all
+            # admission stops — failurePolicy: Fail would then block every
+            # Notebook write cluster-wide.
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
             self._reloader = _CertReloader(ctx, cert_dir, reload_interval)
             self.tls_enabled = True
 

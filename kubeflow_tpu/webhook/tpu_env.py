@@ -38,8 +38,18 @@ def inject_tpu_env(
     container = nb.primary_container()
     if container is None:
         return False
-    headless = f"{nb.name}-hosts"
-    hostnames = topo.worker_hostnames(nb.name, headless, nb.namespace, cluster_domain)
+    # Name derivation must match the controller exactly, including the
+    # long-name hashed fallback — TPU_WORKER_HOSTNAMES with the wrong STS
+    # base would leave jax.distributed.initialize resolving nothing.
+    from kubeflow_tpu.controller.notebook import (
+        headless_service_name,
+        slice_sts_name,
+    )
+
+    headless = headless_service_name(nb.name)
+    hostnames = topo.worker_hostnames(
+        slice_sts_name(nb.name, 0), headless, nb.namespace, cluster_domain
+    )
     desired: list[dict] = [
         {
             "name": "TPU_WORKER_ID",

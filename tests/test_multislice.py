@@ -207,18 +207,27 @@ class TestLifecycle:
 
 
 class TestNameCollisions:
-    def test_long_name_plus_slice_suffix_rejected(self):
+    def test_long_name_plus_slice_suffix_falls_back(self):
+        from kubeflow_tpu.controller.notebook import slice_sts_name
+
         env = make_env(node_pools=(("tpu-v5-lite-podslice", "4x4", 8, 4),))
-        # 52 chars passes bare, but "-s1" pushes slice 1 over the limit.
+        # 52 chars fits bare, but "-s1" pushes slice 1 over the limit:
+        # slice 0 keeps the bare name, slice 1 gets the hashed fallback.
         name = "n" * 52
         env.cluster.create(_ms_notebook(name=name, namespace="u", slices=2))
         env.manager.run_until_idle()
-        assert env.cluster.list("StatefulSet", "u") == []
+
+        names = {s["metadata"]["name"] for s in env.cluster.list("StatefulSet", "u")}
+        s1 = slice_sts_name(name, 1)
+        assert names == {name, s1}
+        assert s1.endswith("-s1") and len(s1) <= 52 and s1 != f"{name}-s1"
         events = [
             e for e in env.cluster.list("Event", "u")
-            if e.get("reason") == "InvalidName"
+            if e.get("reason") == "LongNameFallback"
         ]
         assert events
+        # Both slices actually scheduled (8 pods).
+        assert len(env.cluster.list("Pod", "u")) == 8
 
     def test_single_slice_52_char_name_still_allowed(self):
         env = make_env(node_pools=(("tpu-v5-lite-podslice", "4x4", 8, 4),))

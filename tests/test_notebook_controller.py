@@ -48,14 +48,42 @@ class TestCpuNotebook:
         assert "Ready" in cond_types
         assert nb["status"]["containerState"].get("running")
 
-    def test_name_too_long_rejected_with_event(self):
+    def test_name_too_long_falls_back_to_hashed_sts_name(self):
+        """Reference GenerateName fallback (notebook_controller.go:145-149):
+        a >52-char name must still produce a working StatefulSet, via a
+        deterministic short name, with an event naming the substitution."""
+        from kubeflow_tpu.controller.notebook import slice_sts_name
+
         env = make_env()
         long_name = "x" * 60
         env.cluster.create(cpu_notebook(name=long_name))
         env.manager.run_until_idle()
+
+        sts_name = slice_sts_name(long_name, 0)
+        assert sts_name != long_name and len(sts_name) <= 52
         assert not env.cluster.exists("StatefulSet", long_name, "ns")
+        sts = env.cluster.get("StatefulSet", sts_name, "ns")
+        assert sts["spec"]["replicas"] == 1
         evs = events_for(env.cluster, "Notebook", long_name, "ns")
-        assert any(e["reason"] == "InvalidName" for e in evs)
+        assert any(e["reason"] == "LongNameFallback" for e in evs)
+        # Deterministic: a second reconcile computes the same name.
+        assert slice_sts_name(long_name, 0) == sts_name
+
+        # Routing must still reach the pods: the Service selector targets
+        # the FALLBACK statefulset label and all names fit their limits.
+        svc = env.cluster.list("Service", "ns")[0]
+        assert svc["spec"]["selector"]["statefulset"] == sts_name
+        assert len(svc["metadata"]["name"]) <= 63
+        assert len(svc["spec"]["ports"][0]["name"]) <= 63
+        pod = env.cluster.get("Pod", f"{sts_name}-0", "ns")
+        assert (
+            pod["metadata"]["labels"]["statefulset"]
+            == svc["spec"]["selector"]["statefulset"]
+        )
+        # The auth-proxy Service name derivation fits too.
+        from kubeflow_tpu.api.names import proxy_service_name
+
+        assert len(proxy_service_name(long_name)) <= 63
 
 
 class TestTpuSlice:
