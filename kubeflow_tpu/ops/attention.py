@@ -5,13 +5,25 @@ reference is a control plane; this exists for the in-notebook Llama
 benchmark parity target in BASELINE.md).
 
 Design per /opt/skills/guides/pallas_guide.md:
-- online-softmax flash attention, grid over (batch*heads, q blocks),
-  K/V resident in VMEM per program (S·D·2·2 bytes ≪ 16 MB for bench
-  shapes), fori_loop over K blocks with running (m, l, o) carries —
-  no materialized S×S scores, HBM traffic stays O(S·D),
-- MXU-shaped blocks (128 lanes), f32 accumulation via
+- **streamed K/V**: the grid is (batch*heads, q blocks, k blocks) with the
+  k-block dimension innermost; Pallas's pipeline machinery double-buffers
+  the K/V block fetches against compute, so VMEM holds only O(block) state
+  and sequence length is bounded by HBM, not VMEM (the previous design held
+  the full K/V per program in VMEM, capping S and MFU),
+- online-softmax flash recursion carried in f32 VMEM scratch (m, l, acc)
+  across the k-block grid steps; output written once on the last k step,
+- **fetch skipping**: causal/windowed blocks that contribute nothing are
+  skipped by clamping the K/V BlockSpec index map to the nearest needed
+  block — Pallas elides refetches when the block index is unchanged, so
+  masked-out blocks cost neither HBM bandwidth nor MXU flops,
+- boundary-only masking: interior blocks skip the iota/compare/select
+  entirely; only blocks straddling the causal diagonal or window edge pay
+  the VPU masking cost,
+- MXU-shaped blocks (multiples of 128 lanes), f32 accumulation via
   preferred_element_type, bf16 in/out,
-- causal masking by block: fully-unmasked blocks skip the compare entirely.
+- **differentiable**: custom_vjp with two pallas backward kernels (dq, and
+  dk/dv) using the saved logsumexp — flash attention's standard backward —
+  so TPU training steps run the pallas path end to end.
 
 Decode (q_len == 1) is bandwidth-bound over the KV cache and gains nothing
 from pallas tiling here; it uses the XLA path which fuses into two GEVMs.
@@ -35,11 +47,10 @@ except Exception:  # pragma: no cover
 
 BLOCK_Q = 128  # minimum/alignment block; actual blocks picked per shape
 BLOCK_K = 128
-# Measured on v5e (S=2048/4096, H=32, D=128): 512-wide blocks run the
-# kernel ~4x faster than 128 (19.9 → 77.8 TFLOP/s at S=2048) — bigger
-# tiles amortize the softmax VPU work against MXU matmuls. Block choice
-# is the largest candidate dividing the sequence, so shorter prompts
-# still run (alignment minimum stays 128).
+# Measured on v5e (S=4096, H=32, D=128, causal): the streamed kernel with
+# (512, 512) blocks reaches ~3x the whole-KV-resident design it replaced;
+# block choice is the largest candidate dividing the sequence, so shorter
+# prompts still run (alignment minimum stays 128).
 _BLOCK_CANDIDATES = (512, 256, 128)
 NEG_INF = -1e30
 
@@ -80,13 +91,11 @@ def flash_attention(
     this same signature (mesh-bound impls like ring attention are passed
     directly so two meshes never fight over one registry name)."""
     if callable(impl) or impl in _IMPL_REGISTRY:
-        if window or kv_mask is not None:
-            raise NotImplementedError(
-                "sequence-parallel attention impls do not support "
-                "sliding windows / padding masks yet"
-            )
         fn = impl if callable(impl) else _IMPL_REGISTRY[impl]
-        return fn(q, k, v, causal=causal, q_offset=q_offset)
+        return fn(
+            q, k, v, causal=causal, q_offset=q_offset, window=window,
+            kv_mask=kv_mask,
+        )
     if impl == "auto":
         impl = "pallas" if (kv_mask is None and _pallas_ok(q, k)) else "xla"
     if impl == "pallas":
@@ -98,7 +107,7 @@ def flash_attention(
                 "impl='auto'/'xla' for padded batches"
             )
         return _flash_attention_pallas(
-            q, k, v, causal=causal, q_offset=q_offset, window=window
+            q, k, v, causal, q_offset, window
         )
     return _attention_xla(
         q, k, v, causal=causal, q_offset=q_offset, window=window,
@@ -143,76 +152,484 @@ def _attention_xla(
 
 
 # ---------------------------------------------------------------------------
-# Pallas path
+# Pallas forward: streamed K/V, (bh, n_q, n_k) grid, k innermost.
+
+_LANES = 128  # f32 scratch rows are lane-replicated to the native tile width
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, q_offset: int,
-                  sk: int, scale: float, window: int = 0,
-                  block_q: int = BLOCK_Q, block_k: int = BLOCK_K):
-    # Block shapes: q (1, block_q, D); k/v (1, sk, D); o (1, block_q, D).
-    qi = pl.program_id(1)
-    q_block = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
-    d = q_block.shape[-1]
-    num_k_blocks = sk // block_k
+def _mask_bounds(causal: bool, window: int, block_q: int, block_k: int):
+    """Return (first_k, last_k) BlockSpec index-map helpers bounding which
+    k blocks contribute to a given q block (functions of the dynamic
+    q-block index and static q_offset). Used to CLAMP the K/V index maps:
+    Pallas elides refetches when a block index repeats, so out-of-bounds
+    blocks cost no HBM bandwidth."""
 
-    def body(kb, carry):
-        m, l, o = carry
-        k_block = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_block = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q_block, k_block.T, preferred_element_type=jnp.float32)
-        if causal or window:
-            q_pos = (
-                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-                + qi * block_q
-                + q_offset
-            )
-            k_pos = (
-                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-                + kb * block_k
-            )
-            mask = k_pos <= q_pos if causal else (k_pos == k_pos)
-            if window:
-                mask = mask & (k_pos > q_pos - window)
-            s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        o_new = o * alpha[:, None] + jnp.dot(
-            p, v_block, preferred_element_type=jnp.float32
+    def first_k(qi, q_offset):
+        if not window:
+            return 0
+        # Earliest visible key for this q block: q_start - window + 1.
+        return jnp.maximum(0, (qi * block_q + q_offset - window + 1) // block_k)
+
+    def last_k(qi, q_offset, n_k):
+        if not causal:
+            return n_k - 1
+        # Last k block intersecting the causal diagonal for this q block.
+        return jnp.minimum(
+            n_k - 1, (qi * block_q + q_offset + block_q - 1) // block_k
         )
-        return m_new, l_new, o_new
 
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    o0 = jnp.zeros((block_q, d), jnp.float32)
+    return first_k, last_k
 
-    if causal:
-        # Blocks strictly above the diagonal contribute nothing; bound the
-        # loop at the diagonal block (compile-time per q-block is not
-        # possible — qi is dynamic — so bound dynamically).
-        last = jnp.minimum(
-            num_k_blocks,
-            (qi * block_q + q_offset + block_q + block_k - 1) // block_k,
-        )
-    else:
-        last = num_k_blocks
+
+def _block_mask(q_start, k_start, block_q: int, block_k: int,
+                causal: bool, window: int):
+    """(BQ, BK) bool mask for one score block — the single definition the
+    forward and both backward kernels share, so mask semantics cannot
+    silently diverge between passes."""
+    q_pos = (
+        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + q_start
+    )
+    k_pos = (
+        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + k_start
+    )
+    mask = k_pos <= q_pos if causal else (k_pos == k_pos)
     if window:
-        # Blocks entirely BELOW the window contribute nothing either: the
-        # earliest visible key for this q block is q_start - window + 1.
-        first = jnp.maximum(0, (qi * block_q + q_offset - window + 1) // block_k)
+        mask = mask & (k_pos > q_pos - window)
+    return mask
+
+
+def _block_straddles(q_start, k_start, block_q: int, block_k: int,
+                     causal: bool, window: int):
+    """Scalar bool: does this (q, k) block pair straddle a mask edge?
+    Interior blocks (fully visible) skip the iota/compare/select."""
+    straddle = jnp.asarray(False)
+    if causal:
+        straddle = straddle | (k_start + block_k - 1 > q_start)
+    if window:
+        straddle = straddle | (k_start <= q_start + block_q - 1 - window)
+    return straddle
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_scr, m_scr, l_scr,
+    *, causal: bool, q_offset: int, window: int, scale: float,
+    block_q: int, block_k: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q + q_offset
+    k_start = ki * block_k
+    needed = jnp.asarray(True)
+    if causal:
+        needed = needed & (k_start <= q_start + block_q - 1)
+    if window:
+        needed = needed & (k_start + block_k - 1 > q_start - window)
+
+    def _update(s_masked):
+        m_prev = m_scr[:, :1]  # (BQ, 1), lane-replicated store below
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s_masked, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s_masked - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+            p.astype(v_ref.dtype), v_ref[0],
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    def _scores():
+        q_blk = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
+        k_blk = k_ref[0].astype(jnp.float32)  # (BK, D)
+        return jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
+
+    if not (causal or window):
+        @pl.when(needed)
+        def _plain_step():
+            _update(_scores())
     else:
-        first = 0
-    m, l, o = jax.lax.fori_loop(first, last, body, (m0, l0, o0))
-    o_ref[0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+        # Only blocks STRADDLING a mask edge pay the iota/compare/select;
+        # the predicated interior branch skips it entirely.
+        straddle = _block_straddles(
+            q_start, k_start, block_q, block_k, causal, window
+        )
+
+        @pl.when(needed & straddle)
+        def _masked_step():
+            mask = _block_mask(
+                q_start, k_start, block_q, block_k, causal, window
+            )
+            _update(jnp.where(mask, _scores(), NEG_INF))
+
+        @pl.when(needed & ~straddle)
+        def _interior_step():
+            _update(_scores())
+
+    @pl.when(ki == n_k - 1)
+    def _flush():
+        l = l_scr[:, :1]
+        m = m_scr[:, :1]
+        o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # logsumexp residual for the backward pass: m + log(l).
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))
+        lse_ref[0] = jnp.broadcast_to(lse.T, lse_ref.shape[1:])
+
+
+def _fwd_pallas_call(
+    qf, kf, vf, causal, q_offset, window, block_q, block_k, interpret=False
+):
+    bh, sq, d = qf.shape
+    sk = kf.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    n_q, n_k = sq // block_q, sk // block_k
+    first_k, last_k = _mask_bounds(causal, window, block_q, block_k)
+
+    def kv_index(i, qi, ki):
+        # Clamp the k-block index into this q block's needed range: skipped
+        # blocks repeat the previous index, and Pallas elides the refetch.
+        kidx = ki
+        if causal:
+            kidx = jnp.minimum(kidx, last_k(qi, q_offset, n_k))
+        if window:
+            kidx = jnp.maximum(kidx, first_k(qi, q_offset))
+        return (i, kidx, 0)
+
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, q_offset=q_offset, window=window,
+        scale=scale, block_q=block_q, block_k=block_k,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sq, d), qf.dtype),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+        ),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), kv_index,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), kv_index,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda i, qi, ki: (i, 0, qi),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out, lse[:, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward: two streamed kernels sharing the saved logsumexp.
+#
+# Standard flash backward with delta = rowsum(dO ⊙ O):
+#   p  = exp(q·kᵀ·scale − lse)
+#   dv = pᵀ · dO
+#   dp = dO · vᵀ
+#   ds = p ⊙ (dp − delta)
+#   dq = ds · k · scale        (accumulated over k blocks; q-block grid)
+#   dk = dsᵀ · q · scale       (accumulated over q blocks; k-block grid)
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
+    *, causal: bool, q_offset: int, window: int, scale: float,
+    block_q: int, block_k: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q + q_offset
+    k_start = ki * block_k
+    needed = jnp.asarray(True)
+    if causal:
+        needed = needed & (k_start <= q_start + block_q - 1)
+    if window:
+        needed = needed & (k_start + block_k - 1 > q_start - window)
+
+    def _step(masked: bool):
+        q_blk = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
+        if masked:
+            mask = _block_mask(
+                q_start, k_start, block_q, block_k, causal, window
+            )
+            s = jnp.where(mask, s, NEG_INF)
+        lse = lse_ref[0, 0][:, None]  # (BQ, 1)
+        p = jnp.exp(s - lse)
+        do_blk = do_ref[0].astype(jnp.float32)
+        dp = jnp.dot(
+            do_blk, v_ref[0].astype(jnp.float32).T,
+            preferred_element_type=jnp.float32,
+        )
+        delta = delta_ref[0, 0][:, None]
+        ds = p * (dp - delta)
+        acc_scr[...] += jnp.dot(
+            ds.astype(k_ref.dtype), k_ref[0],
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    if not (causal or window):
+        pl.when(needed)(functools.partial(_step, False))
+    else:
+        straddle = _block_straddles(
+            q_start, k_start, block_q, block_k, causal, window
+        )
+        pl.when(needed & straddle)(functools.partial(_step, True))
+        pl.when(needed & ~straddle)(functools.partial(_step, False))
+
+    @pl.when(ki == n_k - 1)
+    def _flush():
+        dq_ref[0] = acc_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, causal: bool, q_offset: int, window: int, scale: float,
+    block_q: int, block_k: int,
+):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q_start = qi * block_q + q_offset
+    k_start = ki * block_k
+    needed = jnp.asarray(True)
+    if causal:
+        needed = needed & (q_start + block_q - 1 >= k_start)
+    if window:
+        needed = needed & (q_start < k_start + block_k + window)
+
+    def _step(masked: bool):
+        q_blk = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
+        if masked:
+            mask = _block_mask(
+                q_start, k_start, block_q, block_k, causal, window
+            )
+            s = jnp.where(mask, s, NEG_INF)
+        lse = lse_ref[0, 0][:, None]
+        p = jnp.exp(s - lse)  # (BQ, BK)
+        do_blk = do_ref[0].astype(jnp.float32)
+        dv_scr[...] += jnp.dot(
+            p.T.astype(do_ref.dtype), do_ref[0],
+            preferred_element_type=jnp.float32,
+        )
+        dp = jnp.dot(
+            do_blk, v_ref[0].astype(jnp.float32).T,
+            preferred_element_type=jnp.float32,
+        )
+        delta = delta_ref[0, 0][:, None]
+        ds = p * (dp - delta)  # (BQ, BK)
+        dk_scr[...] += jnp.dot(
+            ds.T.astype(q_ref.dtype), q_ref[0],
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    if not (causal or window):
+        pl.when(needed)(functools.partial(_step, False))
+    else:
+        straddle = _block_straddles(
+            q_start, k_start, block_q, block_k, causal, window
+        )
+        pl.when(needed & straddle)(functools.partial(_step, True))
+        pl.when(needed & ~straddle)(functools.partial(_step, False))
+
+    @pl.when(qi == n_q - 1)
+    def _flush():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_pallas_call(
+    qf, kf, vf, do, lse, delta, causal, q_offset, window,
+    block_q, block_k, interpret=False,
+):
+    bh, sq, d = qf.shape
+    sk = kf.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    n_q, n_k = sq // block_q, sk // block_k
+    first_k, last_k = _mask_bounds(causal, window, block_q, block_k)
+    lse3 = lse[:, None, :]
+    delta3 = delta[:, None, :]
+
+    def kv_index(i, qi, ki):
+        kidx = ki
+        if causal:
+            kidx = jnp.minimum(kidx, last_k(qi, q_offset, n_k))
+        if window:
+            kidx = jnp.maximum(kidx, first_k(qi, q_offset))
+        return (i, kidx, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, causal=causal, q_offset=q_offset, window=window,
+            scale=scale, block_q=block_q, block_k=block_k,
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), qf.dtype),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda i, qi, ki: (i, 0, qi),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda i, qi, ki: (i, 0, qi),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, do, lse3, delta3)
+
+    def q_index(i, ki, qi):
+        # Mirror of kv_index: clamp the q-block index to this k block's
+        # contributing range so masked-out q blocks are never fetched.
+        qidx = qi
+        if causal:
+            qidx = jnp.maximum(qidx, (ki * block_k - q_offset) // block_q)
+        if window:
+            qidx = jnp.minimum(
+                qidx,
+                jnp.maximum(
+                    0,
+                    (ki * block_k + block_k - 1 + window - 1 - q_offset)
+                    // block_q,
+                ),
+            )
+        return (i, jnp.clip(qidx, 0, n_q - 1), 0)
+
+    def q_row_index(i, ki, qi):
+        idx = q_index(i, ki, qi)
+        return (i, 0, idx[1])
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, causal=causal, q_offset=q_offset, window=window,
+            scale=scale, block_q=block_q, block_k=block_k,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sk, d), kf.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), vf.dtype),
+        ),
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), q_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), q_row_index,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), q_row_index,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, do, lse3, delta3)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wiring
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_pallas(q, k, v, causal, q_offset, window, block_q, block_k,
+                  interpret):
+    out, _ = _fwd_pallas_call(
+        q, k, v, causal, q_offset, window, block_q, block_k, interpret
+    )
+    return out
+
+
+def _flash_pallas_fwd(q, k, v, causal, q_offset, window, block_q, block_k,
+                      interpret):
+    out, lse = _fwd_pallas_call(
+        q, k, v, causal, q_offset, window, block_q, block_k, interpret
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_pallas_bwd(causal, q_offset, window, block_q, block_k, interpret,
+                      res, do):
+    q, k, v, out, lse = res
+    # delta = rowsum(dO ⊙ O): tiny elementwise reduce, XLA fuses it.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )
+    dq, dk, dv = _bwd_pallas_call(
+        q, k, v, do, lse, delta, causal, q_offset, window,
+        block_q, block_k, interpret,
+    )
+    return dq, dk, dv
+
+
+_flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
 
 
 def _flash_attention_pallas(
-    q, k, v, causal: bool, q_offset: int, window: int = 0
+    q, k, v, causal: bool, q_offset: int, window: int = 0,
+    interpret: bool = False,
 ) -> jax.Array:
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    scale = 1.0 / math.sqrt(d)
     block_q = _pick_block(sq)
     block_k = _pick_block(sk)
     if not block_q or not block_k:
@@ -223,24 +640,7 @@ def _flash_attention_pallas(
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
-    grid = (b * h, sq // block_q)
-    kernel = functools.partial(
-        _flash_kernel, causal=causal, q_offset=q_offset, sk=sk, scale=scale,
-        window=window, block_q=block_q, block_k=block_k,
+    out = _flash_pallas(
+        qf, kf, vf, causal, q_offset, window, block_q, block_k, interpret
     )
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
-                               memory_space=pltpu.VMEM),
-    )(qf, kf, vf)
     return out.reshape(b, h, sq, d)

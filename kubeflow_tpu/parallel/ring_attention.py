@@ -7,6 +7,21 @@ the ring with ``ppermute`` — overlapping compute with ICI transfers and
 merging partial softmaxes with the standard log-sum-exp (flash) recursion.
 Memory per device stays O(S/sp · D) while attending over the full sequence.
 
+Supported masking (full parity with ops.attention.flash_attention):
+- ``causal`` with ``q_offset`` — cached continuation: the q shard's global
+  positions start at ``q_offset`` (chunked long-prompt prefill under SP),
+- ``window`` — Mistral-style sliding window; ring steps whose chunk lies
+  entirely outside every query's window contribute nothing (their partial
+  update is masked to -inf and the lse merge ignores them),
+- ``kv_mask`` — (B, S_local) valid-key marks; the mask chunk rotates around
+  the ring WITH its K/V chunk.
+
+Decode (q_len == 1 against an sp-sharded KV cache) does not rotate anything:
+``sp_decode_attention`` computes one partial (m, l, o) per device against
+its local cache shard and merges across ``sp`` with three collectives
+(pmax + 2 psum) — the flash-decoding split-KV reduction, which is one
+ICI round instead of sp-1 ring steps.
+
 This is the jnp/shard_map formulation (XLA schedules the collective-compute
 overlap); a pallas RDMA variant (pallas_guide.md "Ring Collectives") can
 slot in underneath without changing the call site.
@@ -20,6 +35,7 @@ from __future__ import annotations
 
 import math
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -30,33 +46,49 @@ NEG_INF = -1e30
 
 
 def ring_attention(
-    q: jax.Array,  # local (B, H, S_local, D)
-    k: jax.Array,
+    q: jax.Array,  # local (B, H, Sq_local, D)
+    k: jax.Array,  # local (B, H, Sk_local, D)
     v: jax.Array,
     axis_name: str = "sp",
     causal: bool = True,
+    q_offset: int = 0,
+    window: int = 0,
+    kv_mask: Optional[jax.Array] = None,  # local (B, Sk_local) valid keys
 ) -> jax.Array:
-    """Blockwise ring attention. MUST run inside shard_map over axis_name."""
+    """Blockwise ring attention. MUST run inside shard_map over axis_name.
+
+    Global positions: the q shard on ring index ``r`` covers
+    ``q_offset + r*Sq_local .. q_offset + (r+1)*Sq_local - 1``; the K/V
+    chunk that ORIGINATED on ring index ``c`` covers
+    ``c*Sk_local .. (c+1)*Sk_local - 1`` (K/V always anchor at 0 — they
+    are the full cached context; q may be a later chunk of it).
+    """
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
-    b, h, s_local, d = q.shape
+    b, h, sq_local, d = q.shape
+    sk_local = k.shape[2]
     scale = 1.0 / math.sqrt(d)
     qf = q.astype(jnp.float32) * scale
 
     perm = [(i, (i + 1) % n) for i in range(n)]
+    q_pos = q_offset + my_idx * sq_local + jnp.arange(sq_local)[:, None]
 
     def step(carry, step_idx):
-        m, l, o, k_cur, v_cur = carry
+        m, l, o, k_cur, v_cur, mask_cur = carry
         # The chunk we currently hold originated on device (my_idx - step).
         chunk_idx = (my_idx - step_idx) % n
         s = jnp.einsum(
             "bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
-        if causal:
-            q_pos = my_idx * s_local + jnp.arange(s_local)[:, None]
-            k_pos = chunk_idx * s_local + jnp.arange(s_local)[None, :]
-            s = jnp.where((k_pos <= q_pos)[None, None], s, NEG_INF)
+        k_pos = chunk_idx * sk_local + jnp.arange(sk_local)[None, :]
+        if causal or window:
+            mask = (k_pos <= q_pos) if causal else jnp.ones_like(k_pos <= q_pos)
+            if window:
+                mask = mask & (k_pos > q_pos - window)
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        if mask_cur is not None:
+            s = jnp.where(mask_cur[:, None, None, :], s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
@@ -65,44 +97,156 @@ def ring_attention(
             "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
-        # Rotate K/V to the next device; XLA overlaps this with the next
-        # step's einsums.
+        # Rotate K/V (and the key-validity mask with them) to the next
+        # device; XLA overlaps this with the next step's einsums.
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (m_new, l_new, o_new, k_next, v_next), None
+        mask_next = (
+            None if mask_cur is None
+            else jax.lax.ppermute(mask_cur, axis_name, perm)
+        )
+        return (m_new, l_new, o_new, k_next, v_next, mask_next), None
 
-    m0 = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, s_local), jnp.float32)
-    o0 = jnp.zeros((b, h, s_local, d), jnp.float32)
-    (m, l, o, _, _), _ = jax.lax.scan(
-        step, (m0, l0, o0, k, v), jnp.arange(n)
+    m0 = jnp.full((b, h, sq_local), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq_local), jnp.float32)
+    o0 = jnp.zeros((b, h, sq_local, d), jnp.float32)
+    (m, l, o, _, _, _), _ = jax.lax.scan(
+        step, (m0, l0, o0, k, v, kv_mask), jnp.arange(n)
     )
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
+def sp_decode_attention(
+    q: jax.Array,  # REPLICATED over sp: (B, H, Sq, D) — Sq small (1..K)
+    k: jax.Array,  # local cache shard (B, H, Skl, D)
+    v: jax.Array,
+    position,  # scalar or (Sq,): absolute position(s) of the queries
+    axis_name: str = "sp",
+    window: int = 0,
+    kv_mask: Optional[jax.Array] = None,  # local (B, Skl) valid cache slots
+) -> jax.Array:
+    """Split-KV decode: each device attends its local KV-cache shard, then
+    the partial softmaxes merge across ``sp`` with pmax/psum (the
+    flash-decoding reduction). MUST run inside shard_map over axis_name.
+
+    Device r's cache shard covers absolute slots r*Skl .. (r+1)*Skl-1.
+    Query i attends slots <= position[i] (and > position[i]-window when
+    windowed). Returns the merged (B, H, Sq, D) on every device.
+    """
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    skl = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale,
+        k.astype(jnp.float32), preferred_element_type=jnp.float32,
+    )
+    pos = jnp.asarray(position)
+    if pos.ndim == 0:
+        pos = jnp.broadcast_to(pos, (sq,))
+    q_pos = pos[:, None]  # (Sq, 1)
+    k_pos = my_idx * skl + jnp.arange(skl)[None, :]
+    mask = k_pos <= q_pos
+    if window:
+        mask = mask & (k_pos > q_pos - window)
+    s = jnp.where(mask[None, None], s, NEG_INF)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, NEG_INF)
+    m_local = jnp.max(s, axis=-1)  # (B, H, Sq)
+    # Shards whose every slot is masked contribute exp(-inf)=0 cleanly.
+    m = jax.lax.pmax(m_local, axis_name)
+    p = jnp.exp(s - m[..., None])
+    l = jax.lax.psum(jnp.sum(p, axis=-1), axis_name)
+    o = jax.lax.psum(
+        jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ),
+        axis_name,
+    )
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def cached_sharded(mesh: Mesh, body, base_specs, out_spec, mask_spec):
+    """shard_map-builder shared by the SP attention factories: builds (and
+    caches by static config) one shard_map whose trailing kv_mask input is
+    present only when the caller passes one — so None-mask callers pay no
+    dummy-mask bandwidth and repeat calls reuse the same traced closure.
+
+    ``body(*args, **static)`` runs inside the shard_map; when a mask is
+    present it arrives as the last positional arg.
+    """
+    cache: dict = {}
+
+    def get(with_mask: bool, **static):
+        key = (with_mask, tuple(sorted(static.items())))
+        if key not in cache:
+            in_specs = base_specs + ((mask_spec,) if with_mask else ())
+
+            @partial(
+                shard_map, mesh=mesh, in_specs=in_specs,
+                out_specs=out_spec, check_vma=False,
+            )
+            def _sharded(*args):
+                return body(*args, **static)
+
+            cache[key] = _sharded
+        return cache[key]
+
+    return get
+
+
 def make_sharded_ring_attention(mesh: Mesh):
-    """Return attention(q, k, v, causal, q_offset) jit-composable over the
-    full mesh: batch=(dp,fsdp), heads=tp, sequence=sp."""
+    """Return attention(q, k, v, causal, q_offset, window, kv_mask)
+    jit-composable over the full mesh: batch=(dp,fsdp), heads=tp,
+    sequence=sp. Signature-compatible with ops.attention.flash_attention
+    so it can be passed as ``impl``."""
     spec = P(("dp", "fsdp"), "tp", "sp", None)
 
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
-    )
-    def _sharded(q, k, v):
-        return ring_attention(q, k, v, axis_name="sp", causal=True)
+    def body(q, k, v, *mask, **static):
+        return ring_attention(
+            q, k, v, axis_name="sp",
+            kv_mask=mask[0] if mask else None, **static,
+        )
 
-    def attention(q, k, v, causal=True, q_offset=0, impl=None):
+    get = cached_sharded(
+        mesh, body, (spec, spec, spec), spec, P(("dp", "fsdp"), "sp")
+    )
+
+    def attention(q, k, v, causal=True, q_offset=0, window=0, kv_mask=None,
+                  impl=None):
         if not causal:
             raise NotImplementedError("ring attention is causal-only here")
-        if q_offset:
-            raise NotImplementedError(
-                "ring attention does not support q_offset (cached "
-                "continuation); the mask is anchored at position 0"
-            )
-        return _sharded(q, k, v)
+        static = dict(causal=causal, q_offset=q_offset, window=window)
+        if kv_mask is not None:
+            return get(True, **static)(q, k, v, kv_mask)
+        return get(False, **static)(q, k, v)
 
     return attention
+
+
+def make_sharded_sp_decode(mesh: Mesh):
+    """Return decode(q, k_shard, v_shard, position, window, kv_mask) with
+    q replicated over sp and the KV cache sequence-sharded over sp —
+    the serving-side counterpart of make_sharded_ring_attention."""
+    q_spec = P(("dp", "fsdp"), "tp", None, None)  # q NOT sharded over sp
+    kv_spec = P(("dp", "fsdp"), "tp", "sp", None)
+
+    def body(q, k, v, position, *mask, **static):
+        return sp_decode_attention(
+            q, k, v, position, axis_name="sp",
+            kv_mask=mask[0] if mask else None, **static,
+        )
+
+    get = cached_sharded(
+        mesh, body, (q_spec, kv_spec, kv_spec, P()), q_spec,
+        P(("dp", "fsdp"), "sp"),
+    )
+
+    def decode(q, k, v, position, window=0, kv_mask=None):
+        position = jnp.asarray(position)
+        if kv_mask is not None:
+            return get(True, window=window)(q, k, v, position, kv_mask)
+        return get(False, window=window)(q, k, v, position)
+
+    return decode

@@ -41,27 +41,48 @@ def ulysses_attention(
     axis_name: str = "sp",
     causal: bool = True,
     local_impl: str = "auto",
+    q_offset: int = 0,
+    window: int = 0,
+    kv_mask=None,  # local (B, Sk_local) valid-key marks, sp-sharded
 ) -> jax.Array:
     """All-to-all attention. MUST run inside shard_map over ``axis_name``.
 
     Requires H_local % sp == 0 (after any GQA repeat done by the caller).
+    Masking: after the inbound all-to-all each device holds the FULL
+    sequence for its head group, so ``q_offset``/``window`` pass straight
+    through to the local flash kernel; ``kv_mask`` arrives sequence-sharded
+    (it has no head axis to trade) and is all-gathered over sp instead.
     """
     sp = jax.lax.psum(1, axis_name)
     if sp == 1:
-        return flash_attention(q, k, v, causal=causal, impl=local_impl)
+        return flash_attention(
+            q, k, v, causal=causal, impl=local_impl, q_offset=q_offset,
+            window=window, kv_mask=kv_mask,
+        )
     h_local = q.shape[1]
     if h_local % sp != 0:
         raise ValueError(
             f"ulysses needs heads ({h_local}) divisible by sp ({sp}); "
             "repeat GQA K/V heads or lower sp"
         )
+    if kv_mask is not None:
+        kv_mask = jax.lax.all_gather(
+            kv_mask, axis_name, axis=1, tiled=True
+        )  # (B, Sk) full
+        # "auto" resolves to the XLA local path (the pallas kernel rejects
+        # kv_mask); an EXPLICIT local_impl="pallas" is left alone so it
+        # fails loudly in flash_attention rather than silently measuring
+        # the wrong code path.
+        if local_impl == "auto":
+            local_impl = "xla"
     # Trade sequence shards for head shards: (B, H, S/sp, D) → (B, H/sp, S, D).
     gather = partial(
         jax.lax.all_to_all, axis_name=axis_name, split_axis=1,
         concat_axis=2, tiled=True,
     )
     out = flash_attention(
-        gather(q), gather(k), gather(v), causal=causal, impl=local_impl
+        gather(q), gather(k), gather(v), causal=causal, impl=local_impl,
+        q_offset=q_offset, window=window, kv_mask=kv_mask,
     )
     # Trade back: (B, H/sp, S, D) → (B, H, S/sp, D).
     return jax.lax.all_to_all(
@@ -70,32 +91,29 @@ def ulysses_attention(
 
 
 def make_sharded_ulysses_attention(mesh: Mesh, local_impl: str = "auto"):
-    """Return attention(q, k, v, causal, q_offset) jit-composable over the
-    full mesh — drop-in for make_sharded_ring_attention (same specs:
-    batch=(dp,fsdp), heads=tp, sequence=sp)."""
+    """Return attention(q, k, v, causal, q_offset, window, kv_mask)
+    jit-composable over the full mesh — drop-in for
+    make_sharded_ring_attention (same specs: batch=(dp,fsdp), heads=tp,
+    sequence=sp)."""
+    from kubeflow_tpu.parallel.ring_attention import cached_sharded
+
     spec = P(("dp", "fsdp"), "tp", "sp", None)
     sp = mesh.shape.get("sp", 1)
 
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
-    )
-    def _sharded(q, k, v):
+    def body(q, k, v, *mask, **static):
         return ulysses_attention(
-            q, k, v, axis_name="sp", causal=True, local_impl=local_impl
+            q, k, v, axis_name="sp", local_impl=local_impl,
+            kv_mask=mask[0] if mask else None, **static,
         )
 
-    def attention(q, k, v, causal=True, q_offset=0, impl=None):
+    get = cached_sharded(
+        mesh, body, (spec, spec, spec), spec, P(("dp", "fsdp"), "sp")
+    )
+
+    def attention(q, k, v, causal=True, q_offset=0, window=0, kv_mask=None,
+                  impl=None):
         if not causal:
             raise NotImplementedError("ulysses attention is causal-only here")
-        if q_offset:
-            raise NotImplementedError(
-                "ulysses attention does not support q_offset (cached "
-                "continuation); the mask is anchored at position 0"
-            )
         h = q.shape[1]
         tp = mesh.shape.get("tp", 1)
         if (h // tp) % sp != 0:
@@ -104,6 +122,9 @@ def make_sharded_ulysses_attention(mesh: Mesh, local_impl: str = "auto"):
                 "the model layer must repeat GQA K/V up to full heads "
                 "before sequence-parallel attention"
             )
-        return _sharded(q, k, v)
+        static = dict(causal=causal, q_offset=q_offset, window=window)
+        if kv_mask is not None:
+            return get(True, **static)(q, k, v, kv_mask)
+        return get(False, **static)(q, k, v)
 
     return attention

@@ -1,0 +1,183 @@
+"""Sequence-parallel attention completeness: q_offset / sliding-window /
+kv_mask parity with the dense XLA reference for BOTH SP strategies (ring,
+Ulysses), plus the split-KV SP decode path.
+
+These close the round-2 gap where SP impls rejected window/kv_mask/q_offset
+outright (old ops/attention.py:83-88, parallel/ring_attention.py:99-106).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.models import llama as L
+from kubeflow_tpu.models.train import make_train_step, shard_state
+from kubeflow_tpu.ops.attention import flash_attention
+from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+from kubeflow_tpu.parallel.ring_attention import (
+    make_sharded_ring_attention,
+    make_sharded_sp_decode,
+)
+from kubeflow_tpu.parallel.ulysses import make_sharded_ulysses_attention
+
+
+def _qkv(heads=4, sq=128, sk=None, d=32, batch=2, seed=0):
+    sk = sq if sk is None else sk
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (
+        jax.random.normal(ks[0], (batch, heads, sq, d)),
+        jax.random.normal(ks[1], (batch, heads, sk, d)),
+        jax.random.normal(ks[2], (batch, heads, sk, d)),
+    )
+
+
+def _close(a, b, tol=1e-4):
+    assert float(jnp.max(jnp.abs(a - b))) < tol
+
+
+MAKERS = {
+    "ring": make_sharded_ring_attention,
+    "ulysses": make_sharded_ulysses_attention,
+}
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+class TestSPMaskingParity:
+    def test_sliding_window(self, impl):
+        mesh = make_mesh(dp=2, sp=4)
+        q, k, v = _qkv(heads=4, sq=128)
+        ref = flash_attention(q, k, v, causal=True, window=40, impl="xla")
+        out = MAKERS[impl](mesh)(q, k, v, window=40)
+        _close(out, ref)
+
+    def test_q_offset_cached_continuation(self, impl):
+        """q is a later chunk of a longer cached K/V sequence."""
+        mesh = make_mesh(dp=2, sp=4)
+        q, k, v = _qkv(heads=4, sq=64, sk=128)
+        ref = flash_attention(q, k, v, causal=True, q_offset=64, impl="xla")
+        out = MAKERS[impl](mesh)(q, k, v, q_offset=64)
+        _close(out, ref)
+
+    def test_kv_mask(self, impl):
+        mesh = make_mesh(dp=2, sp=4)
+        q, k, v = _qkv(heads=4, sq=128)
+        # Left-padding style: first 24 keys of batch row 0 invalid.
+        kv_mask = jnp.ones((2, 128), bool).at[0, :24].set(False)
+        ref = flash_attention(
+            q, k, v, causal=True, kv_mask=kv_mask, impl="xla"
+        )
+        out = MAKERS[impl](mesh)(q, k, v, kv_mask=kv_mask)
+        _close(out, ref)
+
+    def test_window_offset_mask_combined(self, impl):
+        mesh = make_mesh(dp=2, sp=4)
+        q, k, v = _qkv(heads=4, sq=64, sk=128)
+        kv_mask = jnp.ones((2, 128), bool).at[1, :16].set(False)
+        ref = flash_attention(
+            q, k, v, causal=True, q_offset=64, window=50, kv_mask=kv_mask,
+            impl="xla",
+        )
+        out = MAKERS[impl](mesh)(
+            q, k, v, q_offset=64, window=50, kv_mask=kv_mask
+        )
+        _close(out, ref)
+
+
+class TestSPDecode:
+    def test_matches_dense_single_token(self):
+        mesh = make_mesh(dp=2, sp=4)
+        q, k, v = _qkv(heads=4, sq=1, sk=128)
+        pos = 77
+        ref = flash_attention(q, k, v, causal=True, q_offset=pos, impl="xla")
+        out = make_sharded_sp_decode(mesh)(q, k, v, pos)
+        _close(out, ref)
+
+    def test_windowed_decode(self):
+        mesh = make_mesh(sp=8)
+        q, k, v = _qkv(heads=8, sq=1, sk=128)
+        pos = 100
+        ref = flash_attention(
+            q, k, v, causal=True, q_offset=pos, window=30, impl="xla"
+        )
+        out = make_sharded_sp_decode(mesh)(q, k, v, pos, window=30)
+        _close(out, ref)
+
+    def test_chunked_decode_vector_positions(self):
+        """K>1 queries at consecutive positions (speculative verification)."""
+        mesh = make_mesh(dp=2, sp=4)
+        q, k, v = _qkv(heads=4, sq=4, sk=128)
+        positions = jnp.asarray([60, 61, 62, 63])
+        ref = flash_attention(q, k, v, causal=True, q_offset=60, impl="xla")
+        out = make_sharded_sp_decode(mesh)(q, k, v, positions)
+        _close(out, ref)
+
+    def test_decode_kv_mask(self):
+        mesh = make_mesh(dp=2, sp=4)
+        q, k, v = _qkv(heads=4, sq=1, sk=128)
+        kv_mask = jnp.ones((2, 128), bool).at[0, :32].set(False)
+        pos = 90
+        ref = flash_attention(
+            q, k, v, causal=True, q_offset=pos, kv_mask=kv_mask, impl="xla"
+        )
+        out = make_sharded_sp_decode(mesh)(q, k, v, pos, kv_mask=kv_mask)
+        _close(out, ref)
+
+    def test_jits_inside_one_program(self):
+        mesh = make_mesh(dp=2, sp=4)
+        q, k, v = _qkv(heads=4, sq=1, sk=128)
+        decode = make_sharded_sp_decode(mesh)
+
+        @jax.jit
+        def step(q, k, v):
+            return decode(q, k, v, 50)
+
+        out = step(q, k, v)
+        ref = flash_attention(q, k, v, causal=True, q_offset=50, impl="xla")
+        _close(out, ref)
+
+
+class TestWindowedSPTraining:
+    def test_mistral_style_window_trains_under_sp(self):
+        """Sliding-window config (the Mistral family gate that round 2
+        could not train under sp) — loss matches the dense mesh."""
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            L.LLAMA_CONFIGS["tiny"], sliding_window=48
+        )
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 128), 0, cfg.vocab_size
+        )
+        losses = {}
+        for name, mesh in (
+            ("sp", make_mesh(dp=2, sp=4)),
+            ("dense", make_mesh(dp=4, tp=2)),
+        ):
+            plan = MeshPlan(mesh)
+            params = L.init_params(cfg, jax.random.PRNGKey(0))
+            init_state, step = make_train_step(cfg, plan)
+            state = shard_state(plan, init_state(params))
+            _, loss = step(state, tokens)
+            losses[name] = float(loss)
+        assert abs(losses["sp"] - losses["dense"]) < 1e-3
+
+    def test_ulysses_windowed_matches_ring(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            L.LLAMA_CONFIGS["tiny"], sliding_window=32
+        )
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(2), (4, 128), 0, cfg.vocab_size
+        )
+        losses = {}
+        for impl in ("ring", "ulysses"):
+            plan = MeshPlan(make_mesh(dp=2, sp=4))
+            params = L.init_params(cfg, jax.random.PRNGKey(0))
+            init_state, step = make_train_step(cfg, plan, sp_impl=impl)
+            state = shard_state(plan, init_state(params))
+            _, loss = step(state, tokens)
+            losses[impl] = float(loss)
+        assert abs(losses["ring"] - losses["ulysses"]) < 1e-3
