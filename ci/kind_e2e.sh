@@ -1,0 +1,85 @@
+#!/usr/bin/env bash
+# KinD integration e2e — the reference flow (reference .github/workflows/
+# odh_notebook_controller_integration_test.yaml:120-220) for this repo:
+#   KinD cluster → Gateway-API CRDs → manager images built+loaded →
+#   self-signed webhook serving certs → `make deploy` → create a Notebook
+#   CR → assert the webhook mutated it and the StatefulSet exists.
+#
+# Skips (exit 0 with a notice) when docker/kind/kubectl are unavailable so
+# the same script is safe on laptops and in restricted runners.
+set -euo pipefail
+
+NS=kubeflow-tpu-system
+CLUSTER=kubeflow-tpu-e2e
+GATEWAY_API_VERSION=${GATEWAY_API_VERSION:-v1.1.0}
+IMG_NOTEBOOK=kubeflow-tpu/notebook-controller:latest
+IMG_PLATFORM=kubeflow-tpu/platform-notebook-controller:latest
+
+for tool in docker kind kubectl; do
+  if ! command -v "$tool" >/dev/null 2>&1; then
+    echo "SKIP: $tool not available; KinD e2e requires docker+kind+kubectl"
+    exit 0
+  fi
+done
+
+cleanup() { kind delete cluster --name "$CLUSTER" >/dev/null 2>&1 || true; }
+trap cleanup EXIT
+
+echo "--- kind cluster"
+kind create cluster --name "$CLUSTER" --wait 120s
+
+echo "--- Gateway API CRDs (HTTPRoute / ReferenceGrant)"
+kubectl apply -f "https://github.com/kubernetes-sigs/gateway-api/releases/download/${GATEWAY_API_VERSION}/standard-install.yaml"
+
+echo "--- build + load manager images"
+docker build -q -f Containerfile.notebook-manager -t "$IMG_NOTEBOOK" .
+docker build -q -f Containerfile.platform-manager -t "$IMG_PLATFORM" .
+kind load docker-image --name "$CLUSTER" "$IMG_NOTEBOOK" "$IMG_PLATFORM"
+
+echo "--- self-signed webhook serving certs"
+CERT_DIR=$(mktemp -d)
+SVC=platform-notebook-controller-webhook
+openssl req -x509 -newkey rsa:2048 -nodes -days 1 \
+  -keyout "$CERT_DIR/tls.key" -out "$CERT_DIR/tls.crt" \
+  -subj "/CN=${SVC}.${NS}.svc" \
+  -addext "subjectAltName=DNS:${SVC}.${NS}.svc,DNS:${SVC}.${NS}.svc.cluster.local"
+kubectl create namespace "$NS"
+kubectl -n "$NS" create secret tls webhook-server-cert \
+  --cert="$CERT_DIR/tls.crt" --key="$CERT_DIR/tls.key"
+
+echo "--- deploy (kustomize default overlay)"
+make deploy
+
+echo "--- patch webhook caBundle with the self-signed CA"
+CA_BUNDLE=$(base64 -w0 <"$CERT_DIR/tls.crt")
+kubectl patch mutatingwebhookconfiguration platform-notebook-controller-mutating \
+  --type=json -p "[{\"op\":\"add\",\"path\":\"/webhooks/0/clientConfig/caBundle\",\"value\":\"${CA_BUNDLE}\"}]"
+kubectl patch validatingwebhookconfiguration platform-notebook-controller-validating \
+  --type=json -p "[{\"op\":\"add\",\"path\":\"/webhooks/0/clientConfig/caBundle\",\"value\":\"${CA_BUNDLE}\"}]"
+
+echo "--- wait for managers (reference bound: Ready within 100s)"
+kubectl -n "$NS" rollout status deployment/notebook-controller --timeout=100s
+kubectl -n "$NS" rollout status deployment/platform-notebook-controller --timeout=100s
+
+echo "--- create a Notebook CR, assert admission + reconcile"
+kubectl create namespace e2e-user
+kubectl -n e2e-user apply -f config/samples/cpu_notebook.yaml
+NB=$(kubectl -n e2e-user get notebooks -o jsonpath='{.items[0].metadata.name}')
+
+# The mutating webhook ran: TPU/env mutation stamps the reconciliation
+# lock annotation on CREATE (removed by the platform reconciler later).
+kubectl -n e2e-user get notebook "$NB" -o jsonpath='{.metadata.annotations}' | grep -q kubeflow-resource-stopped \
+  || { echo "FAIL: mutating webhook did not stamp the reconciliation lock"; exit 1; }
+
+echo "--- wait for the controller to emit the StatefulSet"
+for i in $(seq 1 60); do
+  if kubectl -n e2e-user get statefulset "$NB" >/dev/null 2>&1; then
+    echo "OK: StatefulSet $NB exists"
+    kubectl -n e2e-user get statefulset "$NB" -o wide
+    exit 0
+  fi
+  sleep 3
+done
+echo "FAIL: StatefulSet $NB never appeared"
+kubectl -n "$NS" logs deployment/notebook-controller --tail=50 || true
+exit 1

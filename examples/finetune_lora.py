@@ -28,6 +28,10 @@ def main() -> None:
     args = ap.parse_args()
 
     import jax
+
+    from kubeflow_tpu.runtime.bootstrap import honor_jax_platforms_env
+
+    honor_jax_platforms_env()  # JAX_PLATFORMS=cpu must win over TPU plugins
     import numpy as np
 
     from kubeflow_tpu.models import llama as L

@@ -28,6 +28,10 @@ def main() -> None:
 
     import jax
 
+    from kubeflow_tpu.runtime.bootstrap import honor_jax_platforms_env
+
+    honor_jax_platforms_env()  # JAX_PLATFORMS=cpu must win over TPU plugins
+
     from kubeflow_tpu.models import llama as L
     from kubeflow_tpu.models.convert import load_hf_checkpoint
     from kubeflow_tpu.models.quant import quantize_params

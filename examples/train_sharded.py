@@ -31,6 +31,10 @@ def main() -> None:
 
     import jax
 
+    from kubeflow_tpu.runtime.bootstrap import honor_jax_platforms_env
+
+    honor_jax_platforms_env()  # JAX_PLATFORMS=cpu must win over TPU plugins
+
     from kubeflow_tpu.models import llama as L
     from kubeflow_tpu.models.train import make_train_step, shard_state
     from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
@@ -41,8 +45,13 @@ def main() -> None:
     n = jax.device_count()
     print(f"slice up: {n} devices, worker {rt.worker_id}/{rt.num_workers}")
 
-    # Simple axis split: fsdp gets the devices; add tp/sp to taste.
+    # Simple axis split: fsdp gets the devices; add tp/sp to taste. The
+    # batch is padded up to a multiple of the mesh's batch axis (fsdp
+    # shards the batch dim too).
     plan = MeshPlan(make_mesh(fsdp=n))
+    if args.batch % n:
+        args.batch = ((args.batch + n - 1) // n) * n
+        print(f"batch rounded up to {args.batch} (multiple of {n} devices)")
     cfg = L.LLAMA_CONFIGS[args.config]
     init_state, step = make_train_step(cfg, plan, sp_impl=args.sp_impl)
     state = shard_state(plan, init_state(L.init_params(cfg, jax.random.PRNGKey(0))))

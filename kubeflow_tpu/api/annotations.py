@@ -39,6 +39,10 @@ FEAST_INTEGRATION_LABEL = "opendatahub.io/feast-integration"
 # -- TPU-native extensions ---------------------------------------------------
 # Set by the culler when a slice host is preempted/evicted; cleared on recovery.
 TPU_SLICE_INTERRUPTED = "notebooks.kubeflow.org/tpu-slice-interrupted"
+# Event re-emission cursor: resourceVersion of the newest namespace Event
+# already surfaced onto this Notebook (one read per reconcile, zero writes
+# to Event objects, restart-safe because it lives on the Notebook).
+LAST_SEEN_EVENT_RV = "notebooks.kubeflow.org/last-seen-event-rv"
 # Webhook records the resolved slice shape so updates can be diffed cheaply.
 TPU_RESOLVED_TOPOLOGY = "notebooks.kubeflow.org/tpu-resolved-topology"
 

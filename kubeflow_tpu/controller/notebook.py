@@ -62,7 +62,18 @@ _TEMPLATE_ANNOTATION_SKIP = {
     ann.TPU_SLICE_INTERRUPTED,
 }
 
-_REEMITTED_MARK = "notebooks.kubeflow.org/re-emitted"
+def _rv_int(rv: str) -> int:
+    """resourceVersion as an orderable int (0 when unset/opaque). The API
+    contract calls rvs opaque, but etcd revisions are monotonic integers in
+    practice — the same pragmatic ordering informer resume relies on."""
+    try:
+        return int(rv)
+    except (TypeError, ValueError):
+        return 0
+
+
+def _event_rv(event: dict) -> int:
+    return _rv_int(event.get("metadata", {}).get("resourceVersion", ""))
 
 
 @dataclass
@@ -384,41 +395,61 @@ class NotebookReconciler(Reconciler):
     # ------------------------------------------------------------------
     def _reemit_pod_events(self, nb: Notebook, slice_topo: Optional[SliceTopology]) -> None:
         """Surface Warning events from slice pods on the Notebook itself
-        (reference :99-126 re-emits via nbNameFromInvolvedObject)."""
+        (reference :99-126 re-emits via nbNameFromInvolvedObject).
+
+        Dedup is a lastSeen CURSOR on the Notebook (the newest Event
+        resourceVersion already processed): ONE field-indexed Event read
+        per reconcile and zero writes to Event objects — writing dedup
+        marks onto Events (the previous design) raced apiserver Event
+        TTL/series aggregation and cost one update per surfaced event.
+        The cursor lives on the Notebook, so a restarted controller
+        resumes where it left off instead of re-emitting history.
+        """
         slice_count = nb.tpu.slice_count if nb.tpu is not None else 1
-        pod_names = [
+        pod_names = {
             f"{sts}-{i}"
             for sts in slice_sts_names(nb.name, slice_count)
             for i in range(slice_topo.hosts if slice_topo else 1)
-        ]
-        # One indexed query per slice pod (involvedObject fields are an
-        # apiserver field index) instead of scanning every Event in the
-        # namespace on each reconcile.
-        events: list[dict] = []
-        for pod_name in pod_names:
-            events.extend(self.client.list(
-                "Event", nb.namespace,
-                field_selector={
-                    "involvedObject.kind": "Pod",
-                    "involvedObject.name": pod_name,
-                },
-            ))
-        for event in events:
-            inv = event.get("involvedObject", {})
-            if event.get("type") != "Warning":
+        }
+        cursor = _rv_int(nb.annotations.get(ann.LAST_SEEN_EVENT_RV, ""))
+        events = self.client.list(
+            "Event", nb.namespace,
+            field_selector={"involvedObject.kind": "Pod"},
+        )
+        max_seen = cursor
+        emitted = False
+        for event in sorted(events, key=_event_rv):
+            rv = _event_rv(event)
+            if rv <= cursor:
                 continue
-            marks = event.get("metadata", {}).get("annotations", {})
-            if _REEMITTED_MARK in marks:
+            max_seen = max(max_seen, rv)
+            inv = event.get("involvedObject", {})
+            if event.get("type") != "Warning" or inv.get("name") not in pod_names:
                 continue
             self.recorder.eventf(
                 nb.obj, "Warning", event.get("reason", "PodEvent"),
                 f"[{inv.get('name')}] {event.get('message', '')}",
             )
-            obj_util.set_annotation(event, _REEMITTED_MARK, "true")
-            try:
-                self.client.update(event)
-            except NotFoundError:
-                pass
+            emitted = True
+        # Persist the cursor only when something was surfaced: unrelated
+        # namespace events are cheap to re-filter next reconcile, and
+        # skipping the write avoids N notebooks each writing themselves
+        # whenever ANY pod in the namespace logs an event.
+        if emitted and max_seen > cursor:
+            def advance():
+                fresh = self.client.get("Notebook", nb.name, nb.namespace)
+                # Monotonic merge: another worker may have advanced further.
+                current = _rv_int(
+                    obj_util.annotations_of(fresh).get(ann.LAST_SEEN_EVENT_RV, "")
+                )
+                if current >= max_seen:
+                    return
+                obj_util.set_annotation(
+                    fresh, ann.LAST_SEEN_EVENT_RV, str(max_seen)
+                )
+                self.client.update(fresh)
+
+            retry_on_conflict(advance)
 
     def _set_condition(
         self, nb: Notebook, ctype: str, cstatus: str, reason: str, message: str

@@ -66,6 +66,7 @@ def _tpu_status_schema() -> dict:
                 "type": "string",
                 "enum": ["Healthy", "Forming", "Interrupted", "Stopped"],
             },
+            "acceleratorType": {"type": "string"},
             "jaxCoordinator": {"type": "string"},
             "slices": {"type": "integer"},
             "hostsPerSlice": {"type": "integer"},

@@ -87,16 +87,40 @@ class EnvtestServer:
     cluster directly (FakeKubelet steps, fixtures) must hold it too.
     """
 
+    # Event-log compaction: when the log exceeds 2x this, the oldest half
+    # is dropped — watchers resuming from before the horizon get 410 Gone
+    # and relist, exactly the etcd-compaction behavior a real apiserver
+    # shows. 0 disables (unbounded log).
+    MAX_EVENT_LOG = 8192
+
     def __init__(
         self,
         cluster: Optional[FakeCluster] = None,
         host: str = "127.0.0.1",
         port: int = 0,
         token: str = "",
+        crd_dir: Optional[str] = None,
+        max_event_log: Optional[int] = None,
     ):
         self.cluster = cluster or FakeCluster()
         self.lock = threading.RLock()
         self.token = token
+        self.max_event_log = (
+            self.MAX_EVENT_LOG if max_event_log is None else max_event_log
+        )
+        # CRD structural-schema enforcement (422 on violations), from the
+        # SAME generated YAMLs the deploy manifests ship. crd_dir="" turns
+        # it off explicitly.
+        if crd_dir is None:
+            import os as _os
+
+            default_dir = _os.path.join(
+                _os.path.dirname(__file__), "..", "..", "config", "crd", "bases"
+            )
+            crd_dir = default_dir if _os.path.isdir(default_dir) else ""
+        from kubeflow_tpu.k8s.schema import CRDSchemas
+
+        self.schemas = CRDSchemas.from_dir(crd_dir) if crd_dir else CRDSchemas()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -172,7 +196,7 @@ class EnvtestServer:
                         items = outer.cluster.list(
                             route.kind, route.namespace, selector, fields
                         )
-                        cursor = len(outer.cluster.events)
+                        cursor = outer.cluster.event_cursor()
                     info = rest.info_for(route.kind)
                     return self._reply(200, {
                         "kind": f"{route.kind}List",
@@ -184,12 +208,22 @@ class EnvtestServer:
                     return self._reply_error(err)
 
             def _stream_watch(self, route: _Route, qs: dict) -> None:
+                from kubeflow_tpu.k8s.errors import ExpiredError
+
                 try:
                     cursor = int((qs.get("resourceVersion") or ["0"])[0] or 0)
                 except ValueError:
                     cursor = 0
                 selector = _selector_from_query(qs)
                 timeout_s = int((qs.get("timeoutSeconds") or ["0"])[0] or 0)
+                # A resourceVersion behind the compaction horizon is 410
+                # Gone BEFORE the stream opens (apiserver behavior): the
+                # client must relist, not hang on an unresumable watch.
+                try:
+                    with outer.lock:
+                        events, cursor = outer.cluster.drain_events(cursor)
+                except ExpiredError as err:
+                    return self._reply_error(err)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Connection", "close")
@@ -198,8 +232,6 @@ class EnvtestServer:
                 deadline = _time.monotonic() + timeout_s if timeout_s else None
                 try:
                     while not outer._shutdown.is_set():
-                        with outer.lock:
-                            events, cursor = outer.cluster.drain_events(cursor)
                         for ev in events:
                             if ev.kind != route.kind:
                                 continue
@@ -217,6 +249,22 @@ class EnvtestServer:
                         if deadline and _time.monotonic() >= deadline:
                             return
                         outer._shutdown.wait(0.02)
+                        try:
+                            with outer.lock:
+                                events, cursor = outer.cluster.drain_events(cursor)
+                        except ExpiredError:
+                            # Compacted PAST an open stream (log overran the
+                            # watcher): the in-band 410 ERROR frame, after
+                            # which the client relists.
+                            frame = json.dumps({
+                                "type": "ERROR",
+                                "object": {"kind": "Status", "code": 410,
+                                           "reason": "Expired",
+                                           "message": "too old resource version"},
+                            }).encode() + b"\n"
+                            self.wfile.write(frame)
+                            self.wfile.flush()
+                            return
                 except (BrokenPipeError, ConnectionResetError):
                     return  # client went away
 
@@ -230,13 +278,16 @@ class EnvtestServer:
                 try:
                     obj = self._body()
                     obj.setdefault("kind", route.kind)
+                    obj.setdefault("apiVersion", rest.info_for(route.kind).api_version)
                     if route.namespace:
                         obj.setdefault("metadata", {}).setdefault("namespace", route.namespace)
                     # Remote admission runs WITHOUT the cluster lock held:
                     # webhook handlers call back into this apiserver.
                     obj = outer._run_remote_admission(route.kind, "CREATE", obj, None)
+                    outer.schemas.check(obj)  # CRD validation AFTER mutation
                     with outer.lock:
                         created = outer.cluster.create(obj)
+                        outer._maybe_compact()
                     return self._reply(201, created)
                 except ApiError as err:
                     return self._reply_error(err)
@@ -251,15 +302,29 @@ class EnvtestServer:
                 try:
                     obj = self._body()
                     obj.setdefault("kind", route.kind)
+                    obj.setdefault("apiVersion", rest.info_for(route.kind).api_version)
                     if route.status:
                         with outer.lock:
+                            # Schema-check the RESULT of the status write
+                            # (stored spec + incoming status) — a real
+                            # apiserver validates the status subresource
+                            # against the same CRD schema.
+                            stored = outer.cluster.get(
+                                route.kind, route.name, route.namespace
+                            )
+                            candidate = dict(stored)
+                            candidate["status"] = obj.get("status", {})
+                            outer.schemas.check(candidate)
                             out = outer.cluster.update_status(obj)
+                            outer._maybe_compact()
                         return self._reply(200, out)
                     with outer.lock:
                         old = outer.cluster.get(route.kind, route.name, route.namespace)
                     obj = outer._run_remote_admission(route.kind, "UPDATE", obj, old)
+                    outer.schemas.check(obj)
                     with outer.lock:
                         out = outer.cluster.update(obj)
+                        outer._maybe_compact()
                     return self._reply(200, out)
                 except ApiError as err:
                     return self._reply_error(err)
@@ -287,13 +352,27 @@ class EnvtestServer:
                         merged = outer._run_remote_admission(
                             route.kind, "UPDATE", merged, stored
                         )
+                        outer.schemas.check(merged)
                         with outer.lock:
                             out = outer.cluster.update(merged)
+                            outer._maybe_compact()
                     else:
+                        from kubeflow_tpu.k8s import objects as obj_util
+
+                        # ONE lock window for merge + schema check + apply:
+                        # checking a merge computed in an earlier window
+                        # could validate a state that never gets stored.
                         with outer.lock:
+                            stored = outer.cluster.get(
+                                route.kind, route.name, route.namespace
+                            )
+                            outer.schemas.check(
+                                obj_util.merge_patch(stored, patch)
+                            )
                             out = outer.cluster.patch(
                                 route.kind, route.name, route.namespace, patch
                             )
+                            outer._maybe_compact()
                     return self._reply(200, out)
                 except ApiError as err:
                     return self._reply_error(err)
@@ -317,6 +396,12 @@ class EnvtestServer:
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
+
+    def _maybe_compact(self) -> None:
+        """Bound the event log (call with ``lock`` held): past 2x the cap,
+        drop the oldest half — stragglers see 410 and relist."""
+        if self.max_event_log and len(self.cluster.events) > 2 * self.max_event_log:
+            self.cluster.compact_events(self.max_event_log)
 
     # -- remote admission (WebhookConfiguration analog) --------------------
 

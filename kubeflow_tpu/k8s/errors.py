@@ -48,6 +48,14 @@ class WebhookDeniedError(ApiError):
     reason = "Forbidden"
 
 
+class ExpiredError(ApiError):
+    """Watch/list resourceVersion older than the server's retention window
+    (HTTP 410 Gone) — the client must relist."""
+
+    code = 410
+    reason = "Expired"
+
+
 def is_not_found(err: Exception) -> bool:
     return isinstance(err, NotFoundError)
 

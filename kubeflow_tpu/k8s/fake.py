@@ -26,6 +26,7 @@ from kubeflow_tpu.k8s import objects as obj_util
 from kubeflow_tpu.k8s.errors import (
     AlreadyExistsError,
     ConflictError,
+    ExpiredError,
     InvalidError,
     NotFoundError,
 )
@@ -81,12 +82,12 @@ class FakeCluster:
 
     def __init__(self, clock: Optional[Callable[[], float]] = None):
         self._objects: dict[tuple[str, str, str], dict] = {}
-        self._rv = 0
         self._uid = 0
         self._clock = clock or time.time
         self._mutating: dict[str, list[_Webhook]] = {}
         self._validating: dict[str, list[_Webhook]] = {}
         self.events: list[WatchEvent] = []
+        self.events_base = 0  # absolute index of events[0] (see compact_events)
 
     # -- internals ---------------------------------------------------------
 
@@ -99,8 +100,12 @@ class FakeCluster:
         return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(self._clock()))
 
     def _next_rv(self) -> str:
-        self._rv += 1
-        return str(self._rv)
+        """resourceVersions ARE event-log cursors: the object rv stamped
+        before an ``_emit`` equals the log cursor AFTER that event, so a
+        watch resuming from any object rv replays exactly the events that
+        came later — the apiserver contract RealClient's reflector relies
+        on when it resumes from the last-seen rv without relisting."""
+        return str(self.events_base + len(self.events) + 1)
 
     def _emit(self, event_type: str, obj: dict) -> None:
         self.events.append(
@@ -277,6 +282,9 @@ class FakeCluster:
 
     def _remove(self, key: tuple[str, str, str], obj: dict) -> None:
         self._objects.pop(key, None)
+        # Deletion is a write: stamp a fresh rv so the DELETED event slots
+        # into the log ordering (resuming past it must not replay it).
+        obj.setdefault("metadata", {})["resourceVersion"] = self._next_rv()
         self._emit("DELETED", obj)
         self._collect_garbage(obj["metadata"].get("uid"))
 
@@ -303,6 +311,30 @@ class FakeCluster:
         return self._key(kind, name, namespace) in self._objects
 
     def drain_events(self, cursor: int) -> tuple[list[WatchEvent], int]:
-        """Events appended since ``cursor``; returns (events, new_cursor)."""
-        new = self.events[cursor:]
-        return new, len(self.events)
+        """Events appended since absolute ``cursor``; returns
+        (events, new_cursor). Cursors are ABSOLUTE: compaction
+        (``compact_events``) advances ``events_base`` without renumbering,
+        and a cursor that falls below the compaction horizon raises
+        ExpiredError — the apiserver's 410 Gone contract."""
+        if cursor < self.events_base:
+            raise ExpiredError(
+                f"event cursor {cursor} predates compaction horizon "
+                f"{self.events_base}"
+            )
+        start = cursor - self.events_base
+        new = self.events[start:]
+        return new, self.events_base + len(self.events)
+
+    def event_cursor(self) -> int:
+        """Absolute cursor one past the newest event (list resourceVersion)."""
+        return self.events_base + len(self.events)
+
+    def compact_events(self, keep_last: int) -> None:
+        """Drop all but the newest ``keep_last`` log entries. Watchers
+        positioned before the new horizon get ExpiredError (→ 410 Gone)
+        on their next drain and must relist. Bounds the log's memory for
+        long-running servers (a real apiserver compacts etcd the same way)."""
+        drop = max(0, len(self.events) - keep_last)
+        if drop:
+            del self.events[:drop]
+            self.events_base += drop

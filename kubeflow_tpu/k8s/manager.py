@@ -122,6 +122,13 @@ class Manager:
         # guard: tests asserting convergence can check this is empty).
         self.reconcile_errors: list[tuple[str, Request, Exception]] = []
 
+    @property
+    def cursor(self) -> int:
+        """Position in the event stream this manager has consumed up to —
+        the value callers hand to ``client.wait_for_events`` to block for
+        work (the serve loop's one dependency on manager internals)."""
+        return self._cursor
+
     # -- registration ------------------------------------------------------
 
     def register(

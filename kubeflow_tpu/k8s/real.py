@@ -413,10 +413,34 @@ def _ensure_tkg(obj: dict, kind: str) -> dict:
     return obj
 
 
+class _RelistRequired(ApiError):
+    """Watch resourceVersion expired (410 Gone): list-then-watch again."""
+
+
 class _Watcher(threading.Thread):
-    """List-then-watch loop for one kind (an informer's reflector)."""
+    """List-then-watch loop for one kind (an informer's reflector).
+
+    client-go reflector semantics (consumed by the reference at
+    components/notebook-controller/main.go:58-148):
+    - every watch request carries ``timeoutSeconds`` so the server closes
+      the stream on a bounded cadence (clean EOF → resume from last rv),
+    - the watch socket carries a READ DEADLINE slightly past that server
+      timeout plus TCP keepalive, so a silently-dead peer (NAT drop,
+      node freeze) surfaces as a timeout instead of wedging the watcher
+      forever,
+    - transient connection errors RESUME the watch from the last-seen
+      resourceVersion — no relist, no duplicate-ADDED reseed storm; only
+      410 Gone (or repeated resume failures) falls back to a full relist.
+    """
 
     RELIST_BACKOFF = (0.2, 0.5, 1.0, 2.0, 5.0)
+    # Server-side stream cadence; client-go uses 5-10 min. The socket read
+    # deadline adds slack for the final frame to arrive.
+    WATCH_TIMEOUT_SECONDS = 240
+    SOCKET_DEADLINE_SLACK = 30.0
+    # After this many consecutive failed resume attempts, assume the rv is
+    # poisoned (e.g. apiserver restored from backup) and relist.
+    MAX_RESUME_FAILURES = 4
 
     def __init__(self, client: RealClient, kind: str, namespace: str):
         super().__init__(daemon=True, name=f"watch-{kind.lower()}")
@@ -425,6 +449,10 @@ class _Watcher(threading.Thread):
         self.namespace = namespace
         self._stop = threading.Event()
         self._conn = None
+        # Last rv DELIVERED to the stream — updated per event so a
+        # mid-stream exception does not lose progress (resuming from the
+        # pre-call rv would replay the whole delta window as duplicates).
+        self._resume_rv = ""
 
     def stop(self) -> None:
         self._stop.set()
@@ -436,17 +464,47 @@ class _Watcher(threading.Thread):
 
     def run(self) -> None:
         backoff_idx = 0
+        rv = ""
+        resume_failures = 0
         while not self._stop.is_set():
             try:
-                rv = self._list_and_seed()
+                if not rv:
+                    rv = self._list_and_seed()
+                    backoff_idx = 0
+                rv = self._watch_from(rv)
                 backoff_idx = 0
-                self._watch_from(rv)
+                resume_failures = 0
+            except _RelistRequired:
+                self._resume_rv = ""
+                if self._stop.is_set():
+                    return
+                log.info("watch %s: resourceVersion expired; relisting", self.kind)
+                rv = ""
+                resume_failures = 0
             except Exception as err:
                 if self._stop.is_set():
                     return
+                # Events already delivered before the failure advance the
+                # resume point — never replay them.
+                rv = self._resume_rv or rv
                 delay = self.RELIST_BACKOFF[min(backoff_idx, len(self.RELIST_BACKOFF) - 1)]
                 backoff_idx += 1
-                log.warning("watch %s: %s; relisting in %.1fs", self.kind, err, delay)
+                if rv:
+                    resume_failures += 1
+                    if resume_failures >= self.MAX_RESUME_FAILURES:
+                        log.warning(
+                            "watch %s: %s; %d failed resumes — relisting in %.1fs",
+                            self.kind, err, resume_failures, delay,
+                        )
+                        rv = ""
+                        resume_failures = 0
+                    else:
+                        log.warning(
+                            "watch %s: %s; resuming from rv=%s in %.1fs",
+                            self.kind, err, rv, delay,
+                        )
+                else:
+                    log.warning("watch %s: %s; relisting in %.1fs", self.kind, err, delay)
                 self._stop.wait(delay)
 
     def _list_and_seed(self) -> str:
@@ -460,27 +518,52 @@ class _Watcher(threading.Thread):
             )
         return doc.get("metadata", {}).get("resourceVersion", "")
 
-    def _watch_from(self, rv: str) -> None:
-        """Stream watch events until the connection drops or 410 Gone."""
+    def _open_watch_connection(self):
+        """Watch connection with a read deadline + TCP keepalive (a watch
+        with no deadline on a silently-dead peer blocks forever)."""
+        conn = self.client.config.make_connection(
+            timeout=self.WATCH_TIMEOUT_SECONDS + self.SOCKET_DEADLINE_SLACK
+        )
+        conn.connect()
+        sock = conn.sock
+        try:
+            import socket as socketmod
+
+            sock.setsockopt(socketmod.SOL_SOCKET, socketmod.SO_KEEPALIVE, 1)
+            # Linux knobs; absent on other platforms — keepalive still on.
+            for opt, val in (
+                ("TCP_KEEPIDLE", 30), ("TCP_KEEPINTVL", 10), ("TCP_KEEPCNT", 3),
+            ):
+                if hasattr(socketmod, opt):
+                    sock.setsockopt(
+                        socketmod.IPPROTO_TCP, getattr(socketmod, opt), val
+                    )
+        except OSError:  # pragma: no cover — keepalive is best-effort
+            pass
+        return conn
+
+    def _watch_from(self, rv: str) -> str:
+        """Stream watch events; returns the latest rv on clean EOF or a
+        retriable disconnect (caller resumes), raises _RelistRequired on
+        410 Gone."""
+        self._resume_rv = rv
         while not self._stop.is_set():
             path = rest.collection_path(self.kind, self.namespace) + rest.list_query(
-                watch=True, resource_version=rv, allow_bookmarks=True
+                watch=True, resource_version=rv, allow_bookmarks=True,
+                timeout_seconds=self.WATCH_TIMEOUT_SECONDS,
             )
-            # Dedicated connection: watches are long-lived streams. No read
-            # timeout — the server's timeoutSeconds / bookmark cadence plus
-            # stop() closing the socket bound the block.
-            self._conn = self.client.config.make_connection(timeout=None)
+            self._conn = self._open_watch_connection()
             try:
                 self._conn.request("GET", path, headers=self.client._headers())
                 resp = self._conn.getresponse()
                 if resp.status == 410:
                     resp.read()
-                    raise ApiError("410 Gone: relist required")
+                    raise _RelistRequired("410 Gone: relist required")
                 if resp.status >= 400:
                     raise _error_for(resp.status, resp.read())
                 for line in _iter_lines(resp):
                     if self._stop.is_set():
-                        return
+                        return rv
                     try:
                         ev = json.loads(line)
                     except json.JSONDecodeError:
@@ -489,28 +572,31 @@ class _Watcher(threading.Thread):
                     obj = ev.get("object", {}) or {}
                     if etype == "BOOKMARK":
                         rv = obj.get("metadata", {}).get("resourceVersion", rv)
+                        self._resume_rv = rv
                         continue
                     if etype == "ERROR":
                         code = obj.get("code", 0)
                         if code == 410:
-                            raise ApiError("410 Gone: relist required")
+                            raise _RelistRequired("410 Gone: relist required")
                         raise ApiError(f"watch error event: {obj.get('message', obj)}")
                     obj = _ensure_tkg(obj, self.kind)
                     meta = obj.get("metadata", {})
                     rv = meta.get("resourceVersion", rv)
+                    self._resume_rv = rv
                     self.client._push_event(
                         WatchEvent(
                             etype, self.kind,
                             meta.get("namespace", ""), meta.get("name", ""), obj,
                         )
                     )
-                # Clean EOF (server-side timeout): resume from last rv.
+                # Clean EOF (server-side timeout): loop re-watches from rv.
             finally:
                 try:
                     self._conn.close()
                 except Exception:
                     pass
                 self._conn = None
+        return rv
 
 
 def _iter_lines(resp: HTTPResponse) -> Iterator[bytes]:

@@ -89,6 +89,6 @@ def serve(
         delay = manager.next_requeue_in()
         timeout = max_idle_wait if delay is None else max(0.0, min(delay, max_idle_wait))
         if not is_standby and hasattr(client, "wait_for_events"):
-            client.wait_for_events(manager._cursor, timeout)
+            client.wait_for_events(manager.cursor, timeout)
         else:
             stop.wait(timeout)

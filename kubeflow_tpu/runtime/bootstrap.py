@@ -24,6 +24,27 @@ from typing import Optional
 log = logging.getLogger(__name__)
 
 
+def honor_jax_platforms_env(env: Optional[dict] = None) -> None:
+    """Route ``JAX_PLATFORMS`` through jax.config before backend init.
+
+    Some TPU platform plugins register themselves regardless of the
+    env var (the env-var path is advisory), so ``JAX_PLATFORMS=cpu`` alone
+    does not reliably keep a process off the TPU. Pushing the value into
+    jax.config before the first backend touch does. No-op once a backend
+    exists or when the var is unset.
+    """
+    env = dict(os.environ) if env is None else env
+    want = env.get("JAX_PLATFORMS", "")
+    if not want:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+    except Exception:  # backend already initialized — leave it be
+        log.debug("could not apply JAX_PLATFORMS=%s via jax.config", want)
+
+
 @dataclass
 class SliceRuntime:
     """Resolved view of this host's place in the slice (or multislice)."""
@@ -129,6 +150,7 @@ def bootstrap(
 
     Idempotent per process; safe to re-run in a notebook cell.
     """
+    honor_jax_platforms_env(env)
     rt = runtime_from_env(env)
     if rt.is_multi_host and initialize_distributed:
         import jax

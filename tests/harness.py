@@ -74,17 +74,26 @@ def make_env(
     webhook_config: Optional[WebhookConfig] = None,
     platform: bool = False,
     platform_config: Optional[PlatformConfig] = None,
+    cluster: Optional[k8s.FakeCluster] = None,
 ) -> Env:
-    clock = FakeClock()
-    cluster = k8s.FakeCluster(clock=clock)
+    """Build a controller environment. Passing an existing ``cluster``
+    simulates a controller-process restart: fresh manager/reconcilers/
+    metrics over the surviving cluster state."""
+    reuse = cluster is not None
+    clock = cluster._clock if reuse else FakeClock()  # type: ignore[union-attr]
+    cluster = cluster if reuse else k8s.FakeCluster(clock=clock)
     manager = Manager(cluster, clock=clock)
     metrics = Metrics(cluster)
 
     kubelet = k8s.FakeKubelet(cluster)
     for i in range(cpu_nodes):
-        k8s.add_cpu_node(cluster, f"cpu-node-{i}")
-    for accel_label, topo, hosts, chips in node_pools:
-        k8s.add_tpu_node_pool(cluster, accel_label, topo, hosts=hosts, chips_per_host=chips)
+        if not reuse:
+            k8s.add_cpu_node(cluster, f"cpu-node-{i}")
+    if not reuse:
+        for accel_label, topo, hosts, chips in node_pools:
+            k8s.add_tpu_node_pool(
+                cluster, accel_label, topo, hosts=hosts, chips_per_host=chips
+            )
 
     # Controllers register before the kubelet: within one event batch they
     # dispatch first, so transient pod states (Failed → recreated) are

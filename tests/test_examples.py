@@ -14,7 +14,14 @@ EXAMPLES = pathlib.Path(__file__).resolve().parent.parent / "examples"
 
 def _run(script: str, *extra: str) -> subprocess.CompletedProcess:
     env = dict(os.environ)
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    # Hard override, not setdefault: the machine may export a TPU platform
+    # (JAX_PLATFORMS=axon); example smoke tests must be hermetic on CPU.
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     return subprocess.run(
         [sys.executable, str(EXAMPLES / script), "--config", "tiny", *extra],
         capture_output=True,

@@ -276,6 +276,41 @@ class TestEventReemission:
             e["reason"] == "FailedMount" and "[nb-2]" in e["message"] for e in evs
         )
 
+    def test_no_duplicate_reemission_across_restarts(self):
+        """The lastSeen cursor lives on the Notebook, so a NEW controller
+        process (fresh informers, fresh memory) must not re-emit history."""
+        env = make_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        env.cluster.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {"name": "nb-1.cafe", "namespace": "ns"},
+                "involvedObject": {"kind": "Pod", "name": "nb-1", "namespace": "ns"},
+                "type": "Warning",
+                "reason": "BackOff",
+                "message": "restarting failed container",
+            }
+        )
+        env.manager.run_until_idle()
+
+        def surfaced():
+            return [
+                e for e in events_for(env.cluster, "Notebook", "nb", "ns")
+                if e["reason"] == "BackOff"
+            ]
+
+        assert len(surfaced()) == 1
+        # No dedup marks were written onto the Event object itself.
+        stored = env.cluster.get("Event", "nb-1.cafe", "ns")
+        assert "re-emitted" not in str(stored.get("metadata", {}).get("annotations", {}))
+
+        # "Restart": a brand-new manager + reconciler over the same cluster.
+        env2 = make_env(cluster=env.cluster)
+        env2.manager.run_until_idle()
+        assert len(surfaced()) == 1, "restarted controller re-emitted history"
+
 
 class TestMetrics:
     def test_create_and_spawn_latency_observed(self):

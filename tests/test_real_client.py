@@ -209,6 +209,165 @@ class TestWatch:
         writer.stop()
 
 
+class TestWatchResilience:
+    """client-go reflector semantics: bounded recovery from dead peers,
+    resume-without-reseed on reconnects, relist only on 410 Gone."""
+
+    def _drain_all(self, client, cursor, settle=0.3):
+        """Drain until no new events arrive for ``settle`` seconds."""
+        out = []
+        while client.wait_for_events(cursor, timeout=settle):
+            events, cursor = client.drain_events(cursor)
+            out.extend(events)
+        return out, cursor
+
+    def test_watch_requests_carry_timeout_seconds(self):
+        # Every watch request must ask the server for a bounded stream.
+        from kubeflow_tpu.k8s import rest as restmod
+        from kubeflow_tpu.k8s.real import _Watcher
+
+        assert _Watcher.WATCH_TIMEOUT_SECONDS > 0
+        q = restmod.list_query(
+            watch=True, resource_version="5", allow_bookmarks=True,
+            timeout_seconds=_Watcher.WATCH_TIMEOUT_SECONDS,
+        )
+        assert f"timeoutSeconds={_Watcher.WATCH_TIMEOUT_SECONDS}" in q
+
+    def test_reconnect_resumes_without_reseed(self, server, client):
+        with server.lock:
+            server.cluster.create(_cm("pre1"))
+            server.cluster.create(_cm("pre2"))
+        client.start_watches(["ConfigMap"])
+        events, cursor = self._drain_all(client, 0)
+        assert sorted(e.name for e in events) == ["pre1", "pre2"]
+
+        # Kill the live watch connection (NAT drop / server restart).
+        watcher = client._watchers[0]
+        assert watcher._conn is not None
+        watcher._conn.close()
+
+        writer = RealClient(server.client_config())
+        writer.create(_cm("post"))
+        assert client.wait_for_events(cursor, timeout=10)
+        events, cursor = self._drain_all(client, cursor)
+        # The rv was still valid: ONLY the new object arrives — no
+        # duplicate-ADDED reseed of pre1/pre2.
+        assert [(e.type, e.name) for e in events] == [("ADDED", "post")]
+        writer.stop()
+
+    def test_410_gone_triggers_relist(self, server, client):
+        with server.lock:
+            server.cluster.create(_cm("keeper"))
+        client.start_watches(["ConfigMap"])
+        events, cursor = self._drain_all(client, 0)
+        assert [e.name for e in events] == ["keeper"]
+
+        # Sever the watch, then advance + compact the log past its rv.
+        watcher = client._watchers[0]
+        watcher._conn.close()
+        with server.lock:
+            server.cluster.create(_cm("during-outage"))
+            server.cluster.compact_events(0)  # horizon beyond watcher's rv
+
+        assert client.wait_for_events(cursor, timeout=10)
+        events, cursor = self._drain_all(client, cursor)
+        # Relist reseeds the full current state (both objects) — proving
+        # the 410 path ran through the live HTTP serve loop.
+        names = sorted(e.name for e in events if e.type == "ADDED")
+        assert names == ["during-outage", "keeper"]
+
+    def test_half_open_socket_bounded_by_read_deadline(self, monkeypatch):
+        """A peer that accepts the watch then goes silent forever must not
+        wedge the watcher: the socket read deadline surfaces it."""
+        import socket as socketmod
+
+        from kubeflow_tpu.k8s.real import _Watcher
+
+        silent = socketmod.socket()
+        silent.bind(("127.0.0.1", 0))
+        silent.listen(1)
+        host, port = silent.getsockname()
+
+        monkeypatch.setattr(_Watcher, "WATCH_TIMEOUT_SECONDS", 1)
+        monkeypatch.setattr(_Watcher, "SOCKET_DEADLINE_SLACK", 0.5)
+        cfg = ClusterConfig(host=host, port=port, scheme="http")
+        client = RealClient(cfg)
+        watcher = _Watcher(client, "ConfigMap", "")
+        t0 = time.monotonic()
+        with pytest.raises(Exception):
+            watcher._watch_from("1")
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5, f"half-open socket wedged the watcher for {elapsed}s"
+        client.stop()
+        silent.close()
+
+    def test_apiserver_restart_recovers(self, tmp_path):
+        """Kill the apiserver mid-watch; a replacement on the same port is
+        picked up within the relist backoff."""
+        srv = EnvtestServer().start()
+        host, port = srv.host, srv.port
+        cluster = srv.cluster
+        with srv.lock:
+            cluster.create(_cm("existing"))
+        client = RealClient(srv.client_config())
+        client.start_watches(["ConfigMap"])
+        assert client.wait_for_events(0, timeout=5)
+        _, cursor = client.drain_events(0)
+
+        srv.stop()  # hard outage
+        time.sleep(0.3)
+        srv2 = EnvtestServer(cluster=cluster, host=host, port=port).start()
+        try:
+            with srv2.lock:
+                cluster.create(_cm("after-restart"))
+            assert client.wait_for_events(cursor, timeout=15)
+            events, _ = client.drain_events(cursor)
+            assert "after-restart" in [e.name for e in events]
+        finally:
+            client.stop()
+            srv2.stop()
+
+
+class TestSchemaEnforcement:
+    """The façade enforces the generated CRD schema the way a real
+    apiserver does (422 Invalid) — reference gets this from envtest."""
+
+    def test_bad_topology_pattern_422(self, client):
+        from kubeflow_tpu.k8s.errors import InvalidError
+
+        nb = new_notebook("nb", "u", image="img",
+                          tpu=TPUSpec(accelerator="v5e", topology="4x4"))
+        nb["spec"]["tpu"]["topology"] = "4by4"  # violates ^\d+x\d+(x\d+)?$
+        with pytest.raises(InvalidError, match="pattern"):
+            client.create(nb)
+
+    def test_unknown_accelerator_enum_422(self, client):
+        from kubeflow_tpu.k8s.errors import InvalidError
+
+        nb = new_notebook("nb", "u", image="img",
+                          tpu=TPUSpec(accelerator="v5e", topology="4x4"))
+        nb["spec"]["tpu"]["accelerator"] = "h100"
+        with pytest.raises(InvalidError, match="not one of"):
+            client.create(nb)
+
+    def test_update_validated_too(self, client):
+        from kubeflow_tpu.k8s.errors import InvalidError
+
+        nb = new_notebook("nb", "u", image="img",
+                          tpu=TPUSpec(accelerator="v5e", topology="4x4"))
+        client.create(nb)
+        stored = client.get("Notebook", "nb", "u")
+        stored["spec"]["tpu"]["topology"] = "not-a-grid"
+        with pytest.raises(InvalidError):
+            client.update(stored)
+
+    def test_valid_notebook_passes(self, client):
+        nb = new_notebook("nb", "u", image="img",
+                          tpu=TPUSpec(accelerator="v5e", topology="2x2x2"))
+        created = client.create(nb)
+        assert created["metadata"]["uid"]
+
+
 class TestKubeconfig:
     def test_from_kubeconfig_http(self, server, tmp_path):
         kubeconfig = tmp_path / "config"

@@ -134,6 +134,7 @@ def stack(tmp_path):
     s.server, s.cluster, s.core, s.platform = server, cluster, core, platform
     s.webhook_server, s.user = webhook_server, new_client()
     s.tmp_path = tmp_path
+    s.clients = clients
     yield s
 
     stop.set()
@@ -247,6 +248,48 @@ def test_metrics_and_cert_rotation(stack):
     )
     created = stack.user.create(tpu_notebook(name="wb3"))
     assert created["metadata"]["annotations"][ann.STOP] == ann.RECONCILIATION_LOCK_VALUE
+
+
+@pytest.mark.slow
+def test_relist_after_410_through_serve_loop(stack):
+    """Compact the apiserver's event log past every watcher's position
+    (etcd compaction): the production serve loops must hit 410 Gone over
+    the wire, relist, and keep reconciling new CRs."""
+    stack.user.create(tpu_notebook(name="wb410"))
+    _wait_for(
+        lambda: stack.user.get("Notebook", "wb410", "ns")
+        .get("status", {}).get("readyReplicas") == 4,
+        desc="first slice ready",
+    )
+
+    # Sever every live watch, then compact the log to zero retained events
+    # — every resume rv is now behind the horizon, forcing the 410 path.
+    for client in stack.clients:
+        for watcher in client._watchers:
+            conn = watcher._conn
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+    with stack.server.lock:
+        stack.server.cluster.compact_events(0)
+
+    # Deletion AND a fresh slice must both reconcile post-relist (the node
+    # pool only fits one slice, so wb410 must drain before wb411 fits).
+    stack.user.delete("Notebook", "wb410", "ns")
+    _wait_for(
+        lambda: not stack.user.exists("Notebook", "wb410", "ns"),
+        desc="post-compaction deletion (410 relist recovery)",
+        timeout=60,
+    )
+    stack.user.create(tpu_notebook(name="wb411"))
+    _wait_for(
+        lambda: stack.user.get("Notebook", "wb411", "ns")
+        .get("status", {}).get("readyReplicas") == 4,
+        desc="post-compaction slice ready (410 relist recovery)",
+        timeout=60,
+    )
 
 
 def test_webhook_server_fails_closed_without_certs(tmp_path):
