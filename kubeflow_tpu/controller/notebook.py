@@ -411,18 +411,28 @@ class NotebookReconciler(Reconciler):
             for sts in slice_sts_names(nb.name, slice_count)
             for i in range(slice_topo.hosts if slice_topo else 1)
         }
-        cursor = _rv_int(nb.annotations.get(ann.LAST_SEEN_EVENT_RV, ""))
+        raw_cursor = nb.annotations.get(ann.LAST_SEEN_EVENT_RV, "")
+        cursor = _rv_int(raw_cursor)
         events = self.client.list(
             "Event", nb.namespace,
             field_selector={"involvedObject.kind": "Pod"},
         )
         max_seen = cursor
         emitted = False
+        priming = not raw_cursor
         for event in sorted(events, key=_event_rv):
             rv = _event_rv(event)
             if rv <= cursor:
                 continue
             max_seen = max(max_seen, rv)
+            if priming:
+                # First sight of this notebook (fresh create OR controller
+                # upgraded from the old per-Event-mark dedup): prime the
+                # cursor past existing history instead of re-emitting it —
+                # the notebook's own pods cannot have pre-creation events
+                # worth surfacing, and upgrades must not replay the fleet's
+                # retained Warning history as a duplicate burst.
+                continue
             inv = event.get("involvedObject", {})
             if event.get("type") != "Warning" or inv.get("name") not in pod_names:
                 continue
@@ -431,18 +441,22 @@ class NotebookReconciler(Reconciler):
                 f"[{inv.get('name')}] {event.get('message', '')}",
             )
             emitted = True
-        # Persist the cursor only when something was surfaced: unrelated
-        # namespace events are cheap to re-filter next reconcile, and
-        # skipping the write avoids N notebooks each writing themselves
-        # whenever ANY pod in the namespace logs an event.
-        if emitted and max_seen > cursor:
+        # Persist the cursor when something was surfaced, or once to prime
+        # (even at 0 — the annotation's presence IS the primed marker).
+        # Otherwise skip the write: unrelated namespace events are cheap to
+        # re-filter next reconcile, and writing would make N notebooks each
+        # update themselves whenever ANY pod in the namespace logs an event.
+        if priming or (emitted and max_seen > cursor):
             def advance():
-                fresh = self.client.get("Notebook", nb.name, nb.namespace)
+                try:
+                    fresh = self.client.get("Notebook", nb.name, nb.namespace)
+                except NotFoundError:
+                    return  # deleted mid-reconcile — nothing to advance
                 # Monotonic merge: another worker may have advanced further.
-                current = _rv_int(
-                    obj_util.annotations_of(fresh).get(ann.LAST_SEEN_EVENT_RV, "")
+                fresh_raw = obj_util.annotations_of(fresh).get(
+                    ann.LAST_SEEN_EVENT_RV, ""
                 )
-                if current >= max_seen:
+                if fresh_raw and _rv_int(fresh_raw) >= max_seen:
                     return
                 obj_util.set_annotation(
                     fresh, ann.LAST_SEEN_EVENT_RV, str(max_seen)

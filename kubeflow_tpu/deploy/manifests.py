@@ -298,6 +298,9 @@ def core_manager_deployment() -> dict:
                         {
                             "name": "manager",
                             "image": "kubeflow-tpu/notebook-controller:latest",
+                            # :latest defaults pullPolicy to Always, which
+                            # would bypass locally-loaded images (KinD e2e).
+                            "imagePullPolicy": "IfNotPresent",
                             "command": ["python", "-m", "kubeflow_tpu.cmd.notebook_manager"],
                             "args": [
                                 "--metrics-addr=:8080",
@@ -359,6 +362,7 @@ def platform_manager_deployment() -> dict:
                         {
                             "name": "manager",
                             "image": "kubeflow-tpu/platform-notebook-controller:latest",
+                            "imagePullPolicy": "IfNotPresent",
                             "command": ["python", "-m", "kubeflow_tpu.cmd.platform_manager"],
                             "args": [
                                 "--kube-rbac-proxy-image=$(KUBE_RBAC_PROXY_IMAGE)",

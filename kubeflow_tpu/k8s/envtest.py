@@ -104,6 +104,10 @@ class EnvtestServer:
     ):
         self.cluster = cluster or FakeCluster()
         self.lock = threading.RLock()
+        # Watch streams block on this instead of polling: every write verb
+        # notifies under the lock, so a reconcile chain's per-hop latency
+        # is wakeup latency, not a poll interval.
+        self.event_cond = threading.Condition(self.lock)
         self.token = token
         self.max_event_log = (
             self.MAX_EVENT_LOG if max_event_log is None else max_event_log
@@ -125,6 +129,9 @@ class EnvtestServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Nagle + delayed-ACK costs ~40ms per hop on
+            # loopback; reconcile chains multiply it.
+            disable_nagle_algorithm = True
 
             # -- plumbing --------------------------------------------------
             def log_message(self, *args):
@@ -248,9 +255,15 @@ class EnvtestServer:
                             self.wfile.flush()
                         if deadline and _time.monotonic() >= deadline:
                             return
-                        outer._shutdown.wait(0.02)
                         try:
-                            with outer.lock:
+                            with outer.event_cond:
+                                if (
+                                    outer.cluster.event_cursor() <= cursor
+                                    and not outer._shutdown.is_set()
+                                ):
+                                    # Wakes immediately on any write; the
+                                    # cap bounds shutdown/deadline checks.
+                                    outer.event_cond.wait(0.05)
                                 events, cursor = outer.cluster.drain_events(cursor)
                         except ExpiredError:
                             # Compacted PAST an open stream (log overran the
@@ -387,6 +400,7 @@ class EnvtestServer:
                 try:
                     with outer.lock:
                         outer.cluster.delete(route.kind, route.name, route.namespace)
+                        outer._maybe_compact()
                     return self._reply(200, {"kind": "Status", "status": "Success"})
                 except ApiError as err:
                     return self._reply_error(err)
@@ -399,9 +413,11 @@ class EnvtestServer:
 
     def _maybe_compact(self) -> None:
         """Bound the event log (call with ``lock`` held): past 2x the cap,
-        drop the oldest half — stragglers see 410 and relist."""
+        drop the oldest half — stragglers see 410 and relist. Also the
+        per-write chokepoint, so it wakes blocked watch streams."""
         if self.max_event_log and len(self.cluster.events) > 2 * self.max_event_log:
             self.cluster.compact_events(self.max_event_log)
+        self.event_cond.notify_all()
 
     # -- remote admission (WebhookConfiguration analog) --------------------
 
@@ -489,6 +505,8 @@ class EnvtestServer:
 
     def stop(self) -> None:
         self._shutdown.set()
+        with self.event_cond:
+            self.event_cond.notify_all()  # release blocked watch streams
         self._server.shutdown()
         self._server.server_close()
         if self._thread:

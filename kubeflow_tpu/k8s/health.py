@@ -70,6 +70,8 @@ class HealthServer:
         registry = self.checks
 
         class Handler(BaseHTTPRequestHandler):
+            # Avoid Nagle+delayed-ACK ~40ms stalls per request.
+            disable_nagle_algorithm = True
             def do_GET(self):  # noqa: N802 (http.server API)
                 code, body = registry.handle(self.path)
                 payload = body.encode()

@@ -121,6 +121,13 @@ class Manager:
         # Reconcile exceptions seen since the last clear (error-masking
         # guard: tests asserting convergence can check this is empty).
         self.reconcile_errors: list[tuple[str, Request, Exception]] = []
+        # Per-(reconciler, request) consecutive-failure counts driving the
+        # retry backoff (controller-runtime workqueue: 5ms base doubling
+        # to a cap; reset on the first success).
+        self._failures: dict[tuple[int, Request], int] = {}
+
+    RETRY_BASE_S = 0.005
+    RETRY_CAP_S = 30.0
 
     @property
     def cursor(self) -> int:
@@ -223,19 +230,24 @@ class Manager:
 
     def _dispatch(self, reg_idx: int, req: Request) -> int:
         reg = self._registrations[reg_idx]
+        key = (reg_idx, req)
         try:
             result = reg.reconciler.reconcile(req)
         except Exception as err:
             log.exception("%s: reconcile %s/%s failed", reg.name, req.namespace, req.name)
-            # controller-runtime would rate-limited-requeue; surface via timer
-            # AND record the error so run_until_idle() callers can notice
-            # (the retry only fires on tick(), not run_until_idle()).
+            # controller-runtime rate-limited requeue: exponential backoff
+            # per item from a 5ms base — a transient write conflict retries
+            # almost immediately instead of stalling the spawn path.
+            fails = self._failures.get(key, 0) + 1
+            self._failures[key] = fails
             self.reconcile_errors.append((reg.name, req, err))
             # Bound the error log for long-running serve loops; tests read
             # it between run_until_idle calls, long before 1000 entries.
             del self.reconcile_errors[:-1000]
-            self._schedule_requeue(reg_idx, req, 1.0)
+            delay = min(self.RETRY_BASE_S * (2 ** (fails - 1)), self.RETRY_CAP_S)
+            self._schedule_requeue(reg_idx, req, delay)
             return 1
+        self._failures.pop(key, None)
         if result and result.requeue_after > 0:
             self._schedule_requeue(reg_idx, req, result.requeue_after)
         return 1

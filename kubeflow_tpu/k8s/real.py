@@ -179,6 +179,9 @@ class ClusterConfig:
         return ""
 
     def make_connection(self, timeout: Optional[float] = 30.0):
+        # (Client-side TCP_NODELAY is already set by http.client's
+        # connect(); the server handlers disable Nagle too — both sides
+        # matter for the ~40ms delayed-ACK stall per request.)
         if self.scheme == "http":
             return HTTPConnection(self.host, self.port, timeout=timeout)
         ctx = ssl.create_default_context()
@@ -453,6 +456,11 @@ class _Watcher(threading.Thread):
         # mid-stream exception does not lose progress (resuming from the
         # pre-call rv would replay the whole delta window as duplicates).
         self._resume_rv = ""
+        # Did the most recent watch attempt get a 2xx stream open? A
+        # success resets the failure counters so they count CONSECUTIVE
+        # failures, not lifetime disconnects (a healthy watcher must not
+        # drift toward forced relists over days of routine reconnects).
+        self._connected_ok = False
 
     def stop(self) -> None:
         self._stop.set()
@@ -487,6 +495,12 @@ class _Watcher(threading.Thread):
                 # Events already delivered before the failure advance the
                 # resume point — never replay them.
                 rv = self._resume_rv or rv
+                if self._connected_ok:
+                    # The failed cycle DID stream successfully first: this
+                    # is a fresh disconnect, not the next in a failure run.
+                    backoff_idx = 0
+                    resume_failures = 0
+                    self._connected_ok = False
                 delay = self.RELIST_BACKOFF[min(backoff_idx, len(self.RELIST_BACKOFF) - 1)]
                 backoff_idx += 1
                 if rv:
@@ -561,6 +575,7 @@ class _Watcher(threading.Thread):
                     raise _RelistRequired("410 Gone: relist required")
                 if resp.status >= 400:
                     raise _error_for(resp.status, resp.read())
+                self._connected_ok = True
                 for line in _iter_lines(resp):
                     if self._stop.is_set():
                         return rv

@@ -27,6 +27,8 @@ class MetricsServer:
         registry = self.metrics
 
         class Handler(BaseHTTPRequestHandler):
+            # Avoid Nagle+delayed-ACK ~40ms stalls per request.
+            disable_nagle_algorithm = True
             def do_GET(self):  # noqa: N802 (http.server API)
                 if self.path.rstrip("/") not in ("/metrics", ""):
                     self.send_response(404)

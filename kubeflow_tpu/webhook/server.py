@@ -295,6 +295,8 @@ class WebhookServer:
         validating = validating_handler
 
         class Handler(BaseHTTPRequestHandler):
+            # Avoid Nagle+delayed-ACK ~40ms stalls per request.
+            disable_nagle_algorithm = True
             # Bounds both the deferred TLS handshake and request reads: a
             # half-open client costs one handler thread for 30s, never the
             # accept loop.

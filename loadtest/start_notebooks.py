@@ -73,6 +73,134 @@ def run_inprocess(n: int, tpu: bool) -> dict:
     }
 
 
+def run_wire(n: int, tpu: bool = True) -> dict:
+    """Spawn latency through the PRODUCTION wiring: apiserver over HTTP,
+    both managers via their main() build paths on serve loops, admission
+    over HTTPS with self-signed serving certs, kubelet on the far side of
+    HTTP. Measures create → all hosts Ready per notebook — the wire-stack
+    analog of the BASELINE.json p50 spawn north star (fake kubelet timing
+    is synthetic, but regressions in reconcile round-trips show up)."""
+    import subprocess
+    import tempfile
+    import threading
+
+    from kubeflow_tpu import k8s
+    from kubeflow_tpu.cmd import notebook_manager, platform_manager
+    from kubeflow_tpu.k8s.envtest import EnvtestServer
+    from kubeflow_tpu.k8s.manager import Manager, RealClock
+    from kubeflow_tpu.k8s.real import RealClient
+    from kubeflow_tpu.k8s.serve import serve
+    from kubeflow_tpu.webhook.server import (
+        MUTATE_PATH,
+        VALIDATE_PATH,
+        WebhookServer,
+    )
+    from tests.harness import cpu_notebook, tpu_notebook
+
+    hosts = 4 if tpu else 1
+    cluster = k8s.FakeCluster()
+    if tpu:
+        for i in range(n):
+            k8s.add_tpu_node_pool(
+                cluster, "tpu-v5-lite-podslice", "4x4",
+                hosts=4, chips_per_host=4, name_prefix=f"tpu-pool{i}",
+            )
+    else:
+        k8s.add_cpu_node(cluster, "cpu-node-0")
+    server = EnvtestServer(cluster).start()
+    clients: list[RealClient] = []
+
+    def new_client() -> RealClient:
+        c = RealClient(server.client_config())
+        clients.append(c)
+        return c
+
+    cert_dir = tempfile.mkdtemp(prefix="kftpu-loadtest-")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+         "-keyout", f"{cert_dir}/tls.key", "-out", f"{cert_dir}/tls.crt",
+         "-days", "1", "-nodes", "-subj", "/CN=webhook",
+         "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost"],
+        check=True, capture_output=True,
+    )
+    platform = platform_manager.build(
+        new_client(), env={"K8S_NAMESPACE": "opendatahub"},
+        argv=["--kube-rbac-proxy-image", "proxy:v1"], clock=RealClock(),
+    )
+    webhook_server = WebhookServer(
+        mutating_handler=platform.mutating_webhook.handle,
+        validating_handler=platform.validating_webhook.handle,
+        cert_dir=cert_dir, tls_profile=platform.tls_profile,
+    )
+    webhook_server.start()
+    base = f"https://127.0.0.1:{webhook_server.port}"
+    server.add_remote_webhook(
+        "Notebook", mutate_url=base + MUTATE_PATH,
+        validate_url=base + VALIDATE_PATH, ca_file=f"{cert_dir}/tls.crt",
+    )
+    core = notebook_manager.build(new_client(), env={}, clock=RealClock())
+    kubelet_client = new_client()
+    kubelet_manager = Manager(kubelet_client, clock=RealClock())
+    k8s.FakeKubelet(kubelet_client).register(kubelet_manager)
+
+    class _Shim:
+        def __init__(self, m):
+            self.manager = m
+
+        def run_until_idle(self, max_cycles: int = 200):
+            return self.manager.run_until_idle(max_cycles)
+
+        def tick(self, seconds: float):
+            return self.manager.tick(seconds)
+
+    stop = threading.Event()
+    threads = [
+        threading.Thread(target=serve, args=(b, c, stop), daemon=True)
+        for b, c in ((platform, clients[0]), (core, clients[1]),
+                     (_Shim(kubelet_manager), kubelet_client))
+    ]
+    for t in threads:
+        t.start()
+    user = new_client()
+
+    spawn_wall = []
+    try:
+        t_total = time.perf_counter()
+        for i in range(n):
+            name = f"load-{i}"
+            nb = tpu_notebook(name=name) if tpu else cpu_notebook(name=name)
+            t0 = time.perf_counter()
+            user.create(nb)
+            deadline = t0 + 120
+            while time.perf_counter() < deadline:
+                obj = user.get("Notebook", name, "ns")
+                if obj.get("status", {}).get("readyReplicas", 0) >= hosts:
+                    break
+                time.sleep(0.01)
+            else:
+                raise SystemExit(f"{name} never became ready over the wire")
+            spawn_wall.append(time.perf_counter() - t0)
+        total = time.perf_counter() - t_total
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        webhook_server.stop()
+        for c in clients:
+            c.stop()
+        server.stop()
+    return {
+        "notebooks": n,
+        "mode": ("tpu-4x4" if tpu else "cpu") + "-wire",
+        "total_wall_s": round(total, 3),
+        "p50_spawn_wall_ms": round(statistics.median(spawn_wall) * 1e3, 2),
+        "p95_spawn_wall_ms": round(
+            sorted(spawn_wall)[max(0, int(0.95 * n) - 1)] * 1e3, 2
+        ),
+        "notebooks_per_sec": round(n / total, 1),
+    }
+
+
 def emit_yaml(n: int, tpu: bool, out_dir: Path) -> None:
     import yaml
 
@@ -95,12 +223,26 @@ def main() -> int:
     parser.add_argument("-n", type=int, default=50)
     parser.add_argument("--cpu", action="store_true", help="single-pod CPU notebooks")
     parser.add_argument("--emit-yaml", type=Path, default=None)
+    parser.add_argument(
+        "--wire", action="store_true",
+        help="run through the production wiring (HTTP apiserver + HTTPS "
+             "admission + serve loops) instead of in-process",
+    )
+    parser.add_argument(
+        "--artifact", type=Path, default=None,
+        help="also write the JSON result to this path (round-over-round "
+             "spawn-latency tracking, e.g. SPAWN_r03.json)",
+    )
     args = parser.parse_args()
     tpu = not args.cpu
     if args.emit_yaml:
         emit_yaml(args.n, tpu, args.emit_yaml)
         return 0
-    print(json.dumps(run_inprocess(args.n, tpu)))
+    result = run_wire(args.n, tpu) if args.wire else run_inprocess(args.n, tpu)
+    line = json.dumps(result)
+    print(line)
+    if args.artifact:
+        args.artifact.write_text(line + "\n")
     return 0
 
 
