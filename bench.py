@@ -3,6 +3,12 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
+``--full`` additionally measures prefill tokens/sec, the pallas flash
+kernel's forward and forward+backward TFLOP/s, and a training-step MFU on
+a ~1.1B-param config that fits one 16 GB chip with AdamW state — written
+as comment lines on stderr plus a JSON artifact (``--artifact PATH``,
+default BENCH_FULL.json) so the headline stdout stays one line.
+
 Method (single chip, the BASELINE.md "Llama-2-7B tokens/sec/chip" metric):
 - random-init Llama-2-7B in bf16 directly on device (13.5 GB on a 16 GB
   v5e), KV cache bs=1,
@@ -86,10 +92,175 @@ def run_decode_bench(
     return 1.0 / decode_s_per_tok
 
 
+V5E_PEAK_BF16 = 197e12  # FLOP/s per chip
+
+
+def _sync(x) -> float:
+    """Force completion with a host readback (block_until_ready does not
+    synchronize through the axon tunnel)."""
+    import jax.numpy as jnp
+
+    return float(jnp.asarray(x).reshape(-1)[0])
+
+
+def _bench_fn(fn, *args, n=3):
+    import time as _t
+
+    out = fn(*args)
+    _sync(out)
+    times = []
+    for _ in range(n):
+        t0 = _t.perf_counter()
+        _sync(fn(*args))
+        times.append(_t.perf_counter() - t0)
+    return min(times)
+
+
+def run_full_bench(results: list) -> None:
+    """Prefill / kernel / training measurements (stderr + artifact)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import llama as L
+    from kubeflow_tpu.models.train import make_train_step, shard_state
+    from kubeflow_tpu.ops.attention import flash_attention
+    from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+
+    def report(metric, value, unit, extra=""):
+        results.append({"metric": metric, "value": round(value, 2), "unit": unit})
+        print(f"# {metric}: {value:.2f} {unit} {extra}", file=sys.stderr)
+
+    def section(fn):
+        """Sections are independent measurements: one OOM (e.g. 7B prefill
+        on a small chip) must not abort the ones that still fit."""
+        try:
+            fn()
+        except Exception as err:
+            print(f"# bench section {fn.__name__} failed: {err}", file=sys.stderr)
+
+    def kernel_section():
+        R = 20
+        for S in (2048, 4096, 8192):
+            q = jax.random.normal(jax.random.PRNGKey(0), (1, 32, S, 128), jnp.bfloat16)
+            k = jax.random.normal(jax.random.PRNGKey(1), (1, 32, S, 128), jnp.bfloat16)
+            v = jax.random.normal(jax.random.PRNGKey(2), (1, 32, S, 128), jnp.bfloat16)
+
+            def rep_fwd(q, k, v):
+                def body(i, o):
+                    return flash_attention(q + 0.0 * o, k, v, causal=True, impl="pallas")
+                return jax.lax.fori_loop(0, R, body, q)
+
+            t = _bench_fn(jax.jit(rep_fwd), q, k, v) / R
+            flops = 4 * 32 * S * S * 128 * 0.5  # causal
+            report(f"flash fwd S={S} TFLOP/s", flops / t / 1e12, "TFLOP/s",
+                   f"({flops / t / V5E_PEAK_BF16 * 100:.0f}% MFU)")
+
+            def rep_bwd(q, k, v):
+                def one(q):
+                    o = flash_attention(q, k, v, causal=True, impl="pallas")
+                    return jnp.sum(o.astype(jnp.float32))
+                def body(i, g):
+                    return jax.grad(one)(q + 0.0 * g)
+                return jax.lax.fori_loop(0, R, body, q)
+
+            t = _bench_fn(jax.jit(rep_bwd), q, k, v) / R
+            flops = 4 * 32 * S * S * 128 * 0.5 * 3.5  # fwd-in-grad + 2.5x bwd
+            report(f"flash fwd+bwd S={S} TFLOP/s", flops / t / 1e12, "TFLOP/s",
+                   f"({flops / t / V5E_PEAK_BF16 * 100:.0f}% MFU)")
+
+    def masked_kernel_section():
+        # The padded-batch (serving) kernel variant: first-class hardware
+        # exercise of the int8-mask Mosaic lowering, not just interpret.
+        R, S = 20, 2048
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 32, S, 128), jnp.bfloat16)
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 32, S, 128), jnp.bfloat16)
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 32, S, 128), jnp.bfloat16)
+        kv_mask = jnp.ones((1, S), bool).at[0, : S // 4].set(False)
+
+        def rep(q, k, v):
+            def body(i, o):
+                return flash_attention(
+                    q + 0.0 * o, k, v, causal=True, impl="pallas",
+                    kv_mask=kv_mask,
+                )
+            return jax.lax.fori_loop(0, R, body, q)
+
+        t = _bench_fn(jax.jit(rep), q, k, v) / R
+        flops = 4 * 32 * S * S * 128 * 0.5
+        report(f"flash fwd kv_mask S={S} TFLOP/s", flops / t / 1e12, "TFLOP/s",
+               f"({flops / t / V5E_PEAK_BF16 * 100:.0f}% MFU)")
+
+    def train_section():
+        # ~1.1B config fits one 16 GB chip with AdamW state.
+        tcfg = L.LlamaConfig(dim=2048, n_layers=16, n_heads=16, n_kv_heads=16,
+                             ffn_hidden=5504, max_seq_len=2048)
+        plan = MeshPlan(make_mesh(devices=jax.devices()[:1]))
+        t_params = L.init_params(tcfg, jax.random.PRNGKey(0))
+        init_state, step = make_train_step(tcfg, plan)
+        state = shard_state(plan, init_state(t_params))
+        batch, seq = 4, 2048
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (batch, seq), 0,
+                                    tcfg.vocab_size)
+        state, loss = step(state, tokens)  # compile + first step
+        _sync(loss)
+        import time as _t
+
+        times = []
+        for _ in range(3):
+            t0 = _t.perf_counter()
+            state, loss = step(state, tokens)
+            _sync(loss)
+            times.append(_t.perf_counter() - t0)
+        t = min(times)
+        n_params = tcfg.param_count()
+        flops = 6 * n_params * batch * seq  # fwd 2N + bwd 4N per token
+        report(
+            f"train step MFU (1.1B, bs={batch}, S={seq})",
+            flops / t / V5E_PEAK_BF16 * 100, "% MFU",
+            f"({flops / t / 1e12:.1f} TFLOP/s, {batch * seq / t:.0f} tokens/sec)",
+        )
+
+    def prefill_section():
+        cfg = L.LLAMA_CONFIGS["llama-2-7b"]
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        S = 2048
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, S), 0, cfg.vocab_size)
+
+        def prefill_logits(params, prompt):
+            cache = L.init_kv_cache(cfg, 1, S)
+            logits, _ = L._prefill_impl(params, cfg, prompt, cache)
+            return logits
+
+        t = _bench_fn(jax.jit(prefill_logits), params, prompt)
+        n_params = cfg.param_count()
+        flops = 2 * n_params * S  # forward ~2·N per token
+        report("llama-2-7b prefill tokens/sec/chip (bs=1, S=2048)", S / t,
+               "tokens/sec",
+               f"({flops / t / 1e12:.1f} TFLOP/s, {flops / t / V5E_PEAK_BF16 * 100:.0f}% MFU)")
+
+    section(kernel_section)
+    section(masked_kernel_section)
+    section(train_section)
+    # 7B prefill LAST: it holds the most HBM, and its OOM on a small chip
+    # must not rob the sections above of their measurement.
+    section(prefill_section)
+
+
 def main() -> int:
     import jax
 
     int8 = "--int8" in sys.argv[1:]
+    full = "--full" in sys.argv[1:]
+    artifact = "BENCH_FULL.json"
+    args = sys.argv[1:]
+    for i, arg in enumerate(args):
+        if arg == "--artifact":
+            if i + 1 >= len(args):
+                print("error: --artifact requires a path", file=sys.stderr)
+                return 2
+            artifact = args[i + 1]
+        elif arg.startswith("--artifact="):
+            artifact = arg.split("=", 1)[1]
     device = jax.devices()[0]
     kind = getattr(device, "device_kind", str(device))
     last_err = None
@@ -98,22 +269,28 @@ def main() -> int:
             tok_s = run_decode_bench(
                 cfg_name, prompt_len, steps, cache_len, int8=int8
             )
-            print(
-                json.dumps(
-                    {
-                        "metric": (
-                            f"{cfg_name} greedy decode tokens/sec/chip "
-                            f"(bs=1, {'int8 weights' if int8 else 'bf16'}, "
-                            f"fused loop, {kind})"
-                        ),
-                        "value": round(tok_s, 2),
-                        "unit": "tokens/sec/chip",
-                        "vs_baseline": (
-                            round(tok_s / baseline, 3) if baseline else 0.0
-                        ),
-                    }
-                )
-            )
+            headline = {
+                "metric": (
+                    f"{cfg_name} greedy decode tokens/sec/chip "
+                    f"(bs=1, {'int8 weights' if int8 else 'bf16'}, "
+                    f"fused loop, {kind})"
+                ),
+                "value": round(tok_s, 2),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": (
+                    round(tok_s / baseline, 3) if baseline else 0.0
+                ),
+            }
+            print(json.dumps(headline))
+            if full:
+                results = [headline]
+                try:
+                    run_full_bench(results)
+                except Exception as err:
+                    print(f"# full bench failed partway: {err}", file=sys.stderr)
+                with open(artifact, "w") as f:
+                    json.dump(results, f, indent=1)
+                print(f"# wrote {artifact}", file=sys.stderr)
             return 0
         except Exception as err:  # OOM or compile failure → try smaller
             last_err = err

@@ -97,17 +97,10 @@ def flash_attention(
             kv_mask=kv_mask,
         )
     if impl == "auto":
-        impl = "pallas" if (kv_mask is None and _pallas_ok(q, k)) else "xla"
+        impl = "pallas" if _pallas_ok(q, k) else "xla"
     if impl == "pallas":
-        if kv_mask is not None:
-            # Fail loudly: a silent XLA fallback would make explicit
-            # pallas benchmarks/tests measure the wrong code path.
-            raise NotImplementedError(
-                "the pallas kernel does not support kv_mask; use "
-                "impl='auto'/'xla' for padded batches"
-            )
         return _flash_attention_pallas(
-            q, k, v, causal, q_offset, window
+            q, k, v, causal, q_offset, window, kv_mask=kv_mask
         )
     return _attention_xla(
         q, k, v, causal=causal, q_offset=q_offset, window=window,
@@ -137,6 +130,7 @@ def _attention_xla(
     scores = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
+    visible = None  # (B?, 1, Sq|1, Sk) combined visibility
     if causal or window:
         sq, sk = q.shape[2], k.shape[2]
         q_pos = jnp.arange(sq)[:, None] + q_offset
@@ -145,9 +139,19 @@ def _attention_xla(
         if window:
             mask = mask & (k_pos > q_pos - window)
         scores = jnp.where(mask, scores, NEG_INF)
+        visible = mask[None, None]
     if kv_mask is not None:
-        scores = jnp.where(kv_mask[:, None, None, :], scores, NEG_INF)
+        kvm = kv_mask[:, None, None, :]
+        scores = jnp.where(kvm, scores, NEG_INF)
+        visible = kvm if visible is None else (visible & kvm)
     probs = jax.nn.softmax(scores, axis=-1)
+    if visible is not None:
+        # Safe-softmax convention shared with the pallas kernel: a row with
+        # NO visible keys (left-padding ahead of the causal frontier)
+        # contributes zero output and zero gradient, instead of the
+        # uniform-softmax garbage plain softmax yields at -1e30.
+        row_has_keys = jnp.any(visible, axis=-1, keepdims=True)
+        probs = jnp.where(row_has_keys, probs, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
 
@@ -211,10 +215,15 @@ def _block_straddles(q_start, k_start, block_q: int, block_k: int,
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_scr, m_scr, l_scr,
-    *, causal: bool, q_offset: int, window: int, scale: float,
-    block_q: int, block_k: int,
+    q_ref, k_ref, v_ref, *rest,
+    causal: bool, q_offset: int, window: int, scale: float,
+    block_q: int, block_k: int, with_mask: bool = False,
 ):
+    if with_mask:
+        mask_ref, o_ref, lse_ref, acc_scr, m_scr, l_scr = rest
+    else:
+        mask_ref = None
+        o_ref, lse_ref, acc_scr, m_scr, l_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -251,7 +260,11 @@ def _fwd_kernel(
     def _scores():
         q_blk = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
         k_blk = k_ref[0].astype(jnp.float32)  # (BK, D)
-        return jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
+        s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
+        if mask_ref is not None:
+            # (1, BK) int8 validity row, broadcast over q rows.
+            s = jnp.where(mask_ref[0] != 0, s, NEG_INF)
+        return s
 
     if not (causal or window):
         @pl.when(needed)
@@ -279,14 +292,18 @@ def _fwd_kernel(
     def _flush():
         l = l_scr[:, :1]
         m = m_scr[:, :1]
-        o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        # logsumexp residual for the backward pass: m + log(l).
+        # Safe softmax: a row whose every key was masked (m still -inf)
+        # outputs ZERO, matching the XLA path; its lse stays ~NEG_INF,
+        # which is what the backward kernels key off to zero its grads.
+        out = acc_scr[...] / jnp.maximum(l, 1e-30)
+        o_ref[0] = jnp.where(m > NEG_INF * 0.5, out, 0.0).astype(o_ref.dtype)
         lse = m + jnp.log(jnp.maximum(l, 1e-30))
         lse_ref[0] = jnp.broadcast_to(lse.T, lse_ref.shape[1:])
 
 
 def _fwd_pallas_call(
-    qf, kf, vf, causal, q_offset, window, block_q, block_k, interpret=False
+    qf, kf, vf, causal, q_offset, window, block_q, block_k, interpret=False,
+    kv_mask8=None, heads=1,
 ):
     bh, sq, d = qf.shape
     sk = kf.shape[1]
@@ -304,9 +321,29 @@ def _fwd_pallas_call(
             kidx = jnp.maximum(kidx, first_k(qi, q_offset))
         return (i, kidx, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), kv_index, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), kv_index, memory_space=pltpu.VMEM),
+    ]
+    args = [qf, kf, vf]
+    if kv_mask8 is not None:
+        # (B, 1, Sk) int8 validity; one row per BATCH element (the bh grid
+        # index folds heads, so divide back out).
+        in_specs.append(
+            pl.BlockSpec(
+                (1, 1, block_k),
+                lambda i, qi, ki: (i // heads, 0, kv_index(i, qi, ki)[1]),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        args.append(kv_mask8)
+
     kernel = functools.partial(
         _fwd_kernel, causal=causal, q_offset=q_offset, window=window,
         scale=scale, block_q=block_q, block_k=block_k,
+        with_mask=kv_mask8 is not None,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -315,14 +352,7 @@ def _fwd_pallas_call(
             jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ),
         grid=(bh, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), kv_index,
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), kv_index,
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
                          memory_space=pltpu.VMEM),
@@ -338,7 +368,7 @@ def _fwd_pallas_call(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*args)
     return out, lse[:, 0, :]
 
 
@@ -355,10 +385,15 @@ def _fwd_pallas_call(
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
-    *, causal: bool, q_offset: int, window: int, scale: float,
-    block_q: int, block_k: int,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    causal: bool, q_offset: int, window: int, scale: float,
+    block_q: int, block_k: int, with_mask: bool = False,
 ):
+    if with_mask:
+        mask_ref, dq_ref, acc_scr = rest
+    else:
+        mask_ref = None
+        dq_ref, acc_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -379,13 +414,18 @@ def _bwd_dq_kernel(
         q_blk = q_ref[0].astype(jnp.float32) * scale
         k_blk = k_ref[0].astype(jnp.float32)
         s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
+        if mask_ref is not None:
+            s = jnp.where(mask_ref[0] != 0, s, NEG_INF)
         if masked:
             mask = _block_mask(
                 q_start, k_start, block_q, block_k, causal, window
             )
             s = jnp.where(mask, s, NEG_INF)
         lse = lse_ref[0, 0][:, None]  # (BQ, 1)
-        p = jnp.exp(s - lse)
+        # Degenerate rows (no visible keys → lse ~ NEG_INF) get zero
+        # gradients; at lse magnitudes of 1e30, exp(s - lse) can no longer
+        # tell masked entries (-1e30) from real ones, so guard explicitly.
+        p = jnp.where(lse > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)
         do_blk = do_ref[0].astype(jnp.float32)
         dp = jnp.dot(
             do_blk, v_ref[0].astype(jnp.float32).T,
@@ -413,11 +453,15 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr,
-    *, causal: bool, q_offset: int, window: int, scale: float,
-    block_q: int, block_k: int,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    causal: bool, q_offset: int, window: int, scale: float,
+    block_q: int, block_k: int, with_mask: bool = False,
 ):
+    if with_mask:
+        mask_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        mask_ref = None
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     n_q = pl.num_programs(2)
@@ -439,13 +483,16 @@ def _bwd_dkv_kernel(
         q_blk = q_ref[0].astype(jnp.float32) * scale
         k_blk = k_ref[0].astype(jnp.float32)
         s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
+        if mask_ref is not None:
+            s = jnp.where(mask_ref[0] != 0, s, NEG_INF)
         if masked:
             mask = _block_mask(
                 q_start, k_start, block_q, block_k, causal, window
             )
             s = jnp.where(mask, s, NEG_INF)
         lse = lse_ref[0, 0][:, None]
-        p = jnp.exp(s - lse)  # (BQ, BK)
+        # Same degenerate-row guard as the dq kernel.
+        p = jnp.where(lse > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)  # (BQ, BK)
         do_blk = do_ref[0].astype(jnp.float32)
         dv_scr[...] += jnp.dot(
             p.T.astype(do_ref.dtype), do_ref[0],
@@ -479,7 +526,7 @@ def _bwd_dkv_kernel(
 
 def _bwd_pallas_call(
     qf, kf, vf, do, lse, delta, causal, q_offset, window,
-    block_q, block_k, interpret=False,
+    block_q, block_k, interpret=False, kv_mask8=None, heads=1,
 ):
     bh, sq, d = qf.shape
     sk = kf.shape[1]
@@ -488,6 +535,7 @@ def _bwd_pallas_call(
     first_k, last_k = _mask_bounds(causal, window, block_q, block_k)
     lse3 = lse[:, None, :]
     delta3 = delta[:, None, :]
+    with_mask = kv_mask8 is not None
 
     def kv_index(i, qi, ki):
         kidx = ki
@@ -497,25 +545,38 @@ def _bwd_pallas_call(
             kidx = jnp.maximum(kidx, first_k(qi, q_offset))
         return (i, kidx, 0)
 
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), kv_index, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), kv_index, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_q), lambda i, qi, ki: (i, 0, qi),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_q), lambda i, qi, ki: (i, 0, qi),
+                     memory_space=pltpu.VMEM),
+    ]
+    dq_args = [qf, kf, vf, do, lse3, delta3]
+    if with_mask:
+        dq_in_specs.append(
+            pl.BlockSpec(
+                (1, 1, block_k),
+                lambda i, qi, ki: (i // heads, 0, kv_index(i, qi, ki)[1]),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        dq_args.append(kv_mask8)
+
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, causal=causal, q_offset=q_offset, window=window,
             scale=scale, block_q=block_q, block_k=block_k,
+            with_mask=with_mask,
         ),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), qf.dtype),
         grid=(bh, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), kv_index, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), kv_index, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q), lambda i, qi, ki: (i, 0, qi),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q), lambda i, qi, ki: (i, 0, qi),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
@@ -523,7 +584,7 @@ def _bwd_pallas_call(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qf, kf, vf, do, lse3, delta3)
+    )(*dq_args)
 
     def q_index(i, ki, qi):
         # Mirror of kv_index: clamp the q-block index to this k block's
@@ -546,28 +607,38 @@ def _bwd_pallas_call(
         idx = q_index(i, ki, qi)
         return (i, 0, idx[1])
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d), q_index, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, d), q_index, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_q), q_row_index, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_q), q_row_index, memory_space=pltpu.VMEM),
+    ]
+    dkv_args = [qf, kf, vf, do, lse3, delta3]
+    if with_mask:
+        dkv_in_specs.append(
+            pl.BlockSpec(
+                (1, 1, block_k), lambda i, ki, qi: (i // heads, 0, ki),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        dkv_args.append(kv_mask8)
+
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, causal=causal, q_offset=q_offset, window=window,
             scale=scale, block_q=block_q, block_k=block_k,
+            with_mask=with_mask,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, sk, d), kf.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), vf.dtype),
         ),
         grid=(bh, n_k, n_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), q_index, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, d), q_index, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q), q_row_index,
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q), q_row_index,
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=(
             pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
                          memory_space=pltpu.VMEM),
@@ -582,7 +653,7 @@ def _bwd_pallas_call(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qf, kf, vf, do, lse3, delta3)
+    )(*dkv_args)
     return dq, dk, dv
 
 
@@ -624,9 +695,48 @@ def _flash_pallas_bwd(causal, q_offset, window, block_q, block_k, interpret,
 _flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash_pallas_masked(q, k, v, mask8, causal, q_offset, window,
+                         block_q, block_k, interpret, heads):
+    out, _ = _fwd_pallas_call(
+        q, k, v, causal, q_offset, window, block_q, block_k, interpret,
+        kv_mask8=mask8, heads=heads,
+    )
+    return out
+
+
+def _flash_pallas_masked_fwd(q, k, v, mask8, causal, q_offset, window,
+                             block_q, block_k, interpret, heads):
+    out, lse = _fwd_pallas_call(
+        q, k, v, causal, q_offset, window, block_q, block_k, interpret,
+        kv_mask8=mask8, heads=heads,
+    )
+    return out, (q, k, v, mask8, out, lse)
+
+
+def _flash_pallas_masked_bwd(causal, q_offset, window, block_q, block_k,
+                             interpret, heads, res, do):
+    import numpy as np
+
+    q, k, v, mask8, out, lse = res
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )
+    dq, dk, dv = _bwd_pallas_call(
+        q, k, v, do, lse, delta, causal, q_offset, window,
+        block_q, block_k, interpret, kv_mask8=mask8, heads=heads,
+    )
+    # Integer operands take float0 cotangents (masks have no tangent space).
+    dmask = np.zeros(mask8.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dmask
+
+
+_flash_pallas_masked.defvjp(_flash_pallas_masked_fwd, _flash_pallas_masked_bwd)
+
+
 def _flash_attention_pallas(
     q, k, v, causal: bool, q_offset: int, window: int = 0,
-    interpret: bool = False,
+    interpret: bool = False, kv_mask=None,
 ) -> jax.Array:
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -640,7 +750,14 @@ def _flash_attention_pallas(
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
-    out = _flash_pallas(
-        qf, kf, vf, causal, q_offset, window, block_q, block_k, interpret
-    )
+    if kv_mask is not None:
+        mask8 = kv_mask.astype(jnp.int8).reshape(b, 1, sk)
+        out = _flash_pallas_masked(
+            qf, kf, vf, mask8, causal, q_offset, window, block_q, block_k,
+            interpret, h,
+        )
+    else:
+        out = _flash_pallas(
+            qf, kf, vf, causal, q_offset, window, block_q, block_k, interpret
+        )
     return out.reshape(b, h, sq, d)

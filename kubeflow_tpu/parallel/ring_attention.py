@@ -113,7 +113,11 @@ def ring_attention(
     (m, l, o, _, _, _), _ = jax.lax.scan(
         step, (m0, l0, o0, k, v, kv_mask), jnp.arange(n)
     )
-    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    # Safe softmax (shared convention with ops.attention): rows with no
+    # visible keys output zero instead of normalized garbage.
+    out = jnp.where((m > NEG_INF * 0.5)[..., None], out, 0.0)
+    return out.astype(q.dtype)
 
 
 def sp_decode_attention(
@@ -164,7 +168,9 @@ def sp_decode_attention(
         ),
         axis_name,
     )
-    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    out = jnp.where((m > NEG_INF * 0.5)[..., None], out, 0.0)  # safe softmax
+    return out.astype(q.dtype)
 
 
 def cached_sharded(mesh: Mesh, body, base_specs, out_spec, mask_spec):

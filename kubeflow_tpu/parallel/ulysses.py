@@ -66,15 +66,11 @@ def ulysses_attention(
             "repeat GQA K/V heads or lower sp"
         )
     if kv_mask is not None:
+        # The mask has no head axis to trade; all-gather the full row
+        # instead. The local flash kernel (pallas or XLA) applies it.
         kv_mask = jax.lax.all_gather(
             kv_mask, axis_name, axis=1, tiled=True
         )  # (B, Sk) full
-        # "auto" resolves to the XLA local path (the pallas kernel rejects
-        # kv_mask); an EXPLICIT local_impl="pallas" is left alone so it
-        # fails loudly in flash_attention rather than silently measuring
-        # the wrong code path.
-        if local_impl == "auto":
-            local_impl = "xla"
     # Trade sequence shards for head shards: (B, H, S/sp, D) → (B, H/sp, S, D).
     gather = partial(
         jax.lax.all_to_all, axis_name=axis_name, split_axis=1,
