@@ -291,15 +291,6 @@ def _merge_heads(x: jax.Array) -> jax.Array:
     return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
 
 
-def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
-    if n_rep == 1:
-        return x
-    b, h, s, d = x.shape
-    return jnp.broadcast_to(x[:, :, None], (b, h, n_rep, s, d)).reshape(
-        b, h * n_rep, s, d
-    )
-
-
 def _layer_fwd(
     layer: dict, cfg: LlamaConfig, x: jax.Array,
     cos: jax.Array, sin: jax.Array, attn_impl: str,
@@ -310,10 +301,11 @@ def _layer_fwd(
     q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin)
     k = apply_rope(_split_heads(hk, cfg.n_kv_heads), cos, sin)
     v = _split_heads(hv, cfg.n_kv_heads)
-    rep = cfg.n_heads // cfg.n_kv_heads
+    # K/V go in UNREPEATED: flash_attention folds the GQA group mapping
+    # into its kernel index maps (or broadcasts for XLA/SP impls), so no
+    # n_heads-sized K/V buffer is materialized here.
     attn = flash_attention(
-        q, _repeat_kv(k, rep), _repeat_kv(v, rep), causal=True,
-        impl=attn_impl, window=cfg.sliding_window,
+        q, k, v, causal=True, impl=attn_impl, window=cfg.sliding_window,
     )
     x = x + _mm(_merge_heads(attn), layer["wo"])
     h = _norm(x, layer["mlp_norm"], cfg)
@@ -427,7 +419,6 @@ def _prefill_impl(
     x = _embed(params, cfg, tokens)
     s = tokens.shape[1]
     cos, sin = rope_frequencies(cfg, jnp.arange(s))
-    rep = cfg.n_heads // cfg.n_kv_heads
 
     def body(x, scanned):
         layer, k_cache, v_cache = scanned
@@ -438,7 +429,7 @@ def _prefill_impl(
         v = _split_heads(hv, cfg.n_kv_heads)
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
-        attn = flash_attention(q, _repeat_kv(k, rep), _repeat_kv(v, rep),
+        attn = flash_attention(q, k, v,  # GQA handled inside (no repeat)
                                causal=True, impl="auto",
                                window=cfg.sliding_window, kv_mask=kv_mask)
         x = x + _mm(_merge_heads(attn), layer["wo"])

@@ -28,7 +28,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.models.llama import (
     _merge_heads,
-    _repeat_kv,
     _split_heads,
     apply_rope,
     rms_norm,
@@ -146,8 +145,7 @@ def _layer_fwd(layer: dict, cfg: MoEConfig, x, cos, sin):
     q = apply_rope(_split_heads(h @ layer["wq"], cfg.n_heads), cos, sin)
     k = apply_rope(_split_heads(h @ layer["wk"], cfg.n_kv_heads), cos, sin)
     v = _split_heads(h @ layer["wv"], cfg.n_kv_heads)
-    rep = cfg.n_heads // cfg.n_kv_heads
-    attn = flash_attention(q, _repeat_kv(k, rep), _repeat_kv(v, rep), causal=True)
+    attn = flash_attention(q, k, v, causal=True)  # GQA folded in the kernel
     x = x + _merge_heads(attn) @ layer["wo"]
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     ffn_out, aux = moe_ffn(layer, cfg, h)

@@ -89,9 +89,22 @@ def flash_attention(
     positions — False keys (left-padding in batched serving) are masked
     for every query. ``impl`` may be a registered name or a callable with
     this same signature (mesh-bound impls like ring attention are passed
-    directly so two meshes never fight over one registry name)."""
+    directly so two meshes never fight over one registry name).
+
+    GQA: k/v may carry FEWER heads than q (H % Hkv == 0). The pallas
+    kernel reads the unrepeated K/V directly (its index maps fold the
+    group factor), so no rep-times-larger K/V buffer is ever materialized
+    — the difference between fitting and OOMing a long-context GQA
+    prefill. The XLA path and SP impls receive broadcast K/V instead.
+    """
+    h, hkv = q.shape[1], k.shape[1]
+    if h != hkv and h % hkv != 0:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
     if callable(impl) or impl in _IMPL_REGISTRY:
         fn = impl if callable(impl) else _IMPL_REGISTRY[impl]
+        if h != hkv:  # SP impls shard the head axis; give them full heads
+            k = _broadcast_kv(k, h // hkv)
+            v = _broadcast_kv(v, h // hkv)
         return fn(
             q, k, v, causal=causal, q_offset=q_offset, window=window,
             kv_mask=kv_mask,
@@ -102,9 +115,19 @@ def flash_attention(
         return _flash_attention_pallas(
             q, k, v, causal, q_offset, window, kv_mask=kv_mask
         )
+    if h != hkv:
+        k = _broadcast_kv(k, h // hkv)
+        v = _broadcast_kv(v, h // hkv)
     return _attention_xla(
         q, k, v, causal=causal, q_offset=q_offset, window=window,
         kv_mask=kv_mask,
+    )
+
+
+def _broadcast_kv(x: jax.Array, rep: int) -> jax.Array:
+    b, hkv, s, d = x.shape
+    return jnp.broadcast_to(x[:, :, None], (b, hkv, rep, s, d)).reshape(
+        b, hkv * rep, s, d
     )
 
 
@@ -301,9 +324,18 @@ def _fwd_kernel(
         lse_ref[0] = jnp.broadcast_to(lse.T, lse_ref.shape[1:])
 
 
+def _kv_row(i, heads: int, kv_heads: int):
+    """Map a flattened (batch*q_heads) grid row to its (batch*kv_heads)
+    K/V row — the GQA group fold (identity when heads == kv_heads)."""
+    if heads == kv_heads:
+        return i
+    rep = heads // kv_heads
+    return (i // heads) * kv_heads + (i % heads) // rep
+
+
 def _fwd_pallas_call(
     qf, kf, vf, causal, q_offset, window, block_q, block_k, interpret=False,
-    kv_mask8=None, heads=1,
+    kv_mask8=None, heads=1, kv_heads=1,
 ):
     bh, sq, d = qf.shape
     sk = kf.shape[1]
@@ -319,7 +351,7 @@ def _fwd_pallas_call(
             kidx = jnp.minimum(kidx, last_k(qi, q_offset, n_k))
         if window:
             kidx = jnp.maximum(kidx, first_k(qi, q_offset))
-        return (i, kidx, 0)
+        return (_kv_row(i, heads, kv_heads), kidx, 0)
 
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
@@ -455,7 +487,7 @@ def _bwd_dq_kernel(
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     causal: bool, q_offset: int, window: int, scale: float,
-    block_q: int, block_k: int, with_mask: bool = False,
+    block_q: int, block_k: int, with_mask: bool = False, n_q: int = 0,
 ):
     if with_mask:
         mask_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
@@ -463,10 +495,13 @@ def _bwd_dkv_kernel(
         mask_ref = None
         dk_ref, dv_ref, dk_scr, dv_scr = rest
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
-    n_q = pl.num_programs(2)
+    # Innermost dim sweeps (GQA group member, q block); only the q-block
+    # part positions the mask — every group member shares positions.
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
+    qi = j % n_q
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -518,7 +553,7 @@ def _bwd_dkv_kernel(
         pl.when(needed & straddle)(functools.partial(_step, True))
         pl.when(needed & ~straddle)(functools.partial(_step, False))
 
-    @pl.when(qi == n_q - 1)
+    @pl.when(j == n_j - 1)
     def _flush():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
@@ -526,12 +561,13 @@ def _bwd_dkv_kernel(
 
 def _bwd_pallas_call(
     qf, kf, vf, do, lse, delta, causal, q_offset, window,
-    block_q, block_k, interpret=False, kv_mask8=None, heads=1,
+    block_q, block_k, interpret=False, kv_mask8=None, heads=1, kv_heads=1,
 ):
     bh, sq, d = qf.shape
     sk = kf.shape[1]
     scale = 1.0 / math.sqrt(d)
     n_q, n_k = sq // block_q, sk // block_k
+    rep = heads // kv_heads
     first_k, last_k = _mask_bounds(causal, window, block_q, block_k)
     lse3 = lse[:, None, :]
     delta3 = delta[:, None, :]
@@ -543,7 +579,7 @@ def _bwd_pallas_call(
             kidx = jnp.minimum(kidx, last_k(qi, q_offset, n_k))
         if window:
             kidx = jnp.maximum(kidx, first_k(qi, q_offset))
-        return (i, kidx, 0)
+        return (_kv_row(i, heads, kv_heads), kidx, 0)
 
     dq_in_specs = [
         pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
@@ -586,7 +622,15 @@ def _bwd_pallas_call(
         interpret=interpret,
     )(*dq_args)
 
-    def q_index(i, ki, qi):
+    # dk/dv grid runs over KV rows; the innermost dimension sweeps
+    # (rep × q-blocks) so each kv head accumulates its whole q-head GROUP
+    # into one scratch before the flush — the GQA reduction happens inside
+    # the kernel instead of over a rep-times-materialized K/V.
+    def _decode_j(j):
+        return j // n_q, j % n_q  # (which q head in the group, q block)
+
+    def q_index(i, ki, j):
+        r, qi = _decode_j(j)
         # Mirror of kv_index: clamp the q-block index to this k block's
         # contributing range so masked-out q blocks are never fetched.
         qidx = qi
@@ -601,17 +645,19 @@ def _bwd_pallas_call(
                     // block_q,
                 ),
             )
-        return (i, jnp.clip(qidx, 0, n_q - 1), 0)
+        q_row = (i // kv_heads) * heads + (i % kv_heads) * rep + r
+        return (q_row, jnp.clip(qidx, 0, n_q - 1), 0)
 
-    def q_row_index(i, ki, qi):
-        idx = q_index(i, ki, qi)
-        return (i, 0, idx[1])
+    def q_row_index(i, ki, j):
+        idx = q_index(i, ki, j)
+        return (idx[0], 0, idx[1])
 
+    bhkv = kf.shape[0]
     dkv_in_specs = [
         pl.BlockSpec((1, block_q, d), q_index, memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
+        pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
+        pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0),
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((1, block_q, d), q_index, memory_space=pltpu.VMEM),
         pl.BlockSpec((1, 1, block_q), q_row_index, memory_space=pltpu.VMEM),
@@ -621,7 +667,7 @@ def _bwd_pallas_call(
     if with_mask:
         dkv_in_specs.append(
             pl.BlockSpec(
-                (1, 1, block_k), lambda i, ki, qi: (i // heads, 0, ki),
+                (1, 1, block_k), lambda i, ki, j: (i // kv_heads, 0, ki),
                 memory_space=pltpu.VMEM,
             )
         )
@@ -631,18 +677,18 @@ def _bwd_pallas_call(
         functools.partial(
             _bwd_dkv_kernel, causal=causal, q_offset=q_offset, window=window,
             scale=scale, block_q=block_q, block_k=block_k,
-            with_mask=with_mask,
+            with_mask=with_mask, n_q=n_q,
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, sk, d), kf.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), vf.dtype),
+            jax.ShapeDtypeStruct((bhkv, sk, d), kf.dtype),
+            jax.ShapeDtypeStruct((bhkv, sk, d), vf.dtype),
         ),
-        grid=(bh, n_k, n_q),
+        grid=(bhkv, n_k, rep * n_q),
         in_specs=dkv_in_specs,
         out_specs=(
-            pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
+            pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
+            pl.BlockSpec((1, block_k, d), lambda i, ki, j: (i, ki, 0),
                          memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[
@@ -661,25 +707,27 @@ def _bwd_pallas_call(
 # custom_vjp wiring
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash_pallas(q, k, v, causal, q_offset, window, block_q, block_k,
-                  interpret):
+                  interpret, heads, kv_heads):
     out, _ = _fwd_pallas_call(
-        q, k, v, causal, q_offset, window, block_q, block_k, interpret
+        q, k, v, causal, q_offset, window, block_q, block_k, interpret,
+        heads=heads, kv_heads=kv_heads,
     )
     return out
 
 
 def _flash_pallas_fwd(q, k, v, causal, q_offset, window, block_q, block_k,
-                      interpret):
+                      interpret, heads, kv_heads):
     out, lse = _fwd_pallas_call(
-        q, k, v, causal, q_offset, window, block_q, block_k, interpret
+        q, k, v, causal, q_offset, window, block_q, block_k, interpret,
+        heads=heads, kv_heads=kv_heads,
     )
     return out, (q, k, v, out, lse)
 
 
 def _flash_pallas_bwd(causal, q_offset, window, block_q, block_k, interpret,
-                      res, do):
+                      heads, kv_heads, res, do):
     q, k, v, out, lse = res
     # delta = rowsum(dO ⊙ O): tiny elementwise reduce, XLA fuses it.
     delta = jnp.sum(
@@ -687,7 +735,7 @@ def _flash_pallas_bwd(causal, q_offset, window, block_q, block_k, interpret,
     )
     dq, dk, dv = _bwd_pallas_call(
         q, k, v, do, lse, delta, causal, q_offset, window,
-        block_q, block_k, interpret,
+        block_q, block_k, interpret, heads=heads, kv_heads=kv_heads,
     )
     return dq, dk, dv
 
@@ -695,27 +743,27 @@ def _flash_pallas_bwd(causal, q_offset, window, block_q, block_k, interpret,
 _flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
 def _flash_pallas_masked(q, k, v, mask8, causal, q_offset, window,
-                         block_q, block_k, interpret, heads):
+                         block_q, block_k, interpret, heads, kv_heads):
     out, _ = _fwd_pallas_call(
         q, k, v, causal, q_offset, window, block_q, block_k, interpret,
-        kv_mask8=mask8, heads=heads,
+        kv_mask8=mask8, heads=heads, kv_heads=kv_heads,
     )
     return out
 
 
 def _flash_pallas_masked_fwd(q, k, v, mask8, causal, q_offset, window,
-                             block_q, block_k, interpret, heads):
+                             block_q, block_k, interpret, heads, kv_heads):
     out, lse = _fwd_pallas_call(
         q, k, v, causal, q_offset, window, block_q, block_k, interpret,
-        kv_mask8=mask8, heads=heads,
+        kv_mask8=mask8, heads=heads, kv_heads=kv_heads,
     )
     return out, (q, k, v, mask8, out, lse)
 
 
 def _flash_pallas_masked_bwd(causal, q_offset, window, block_q, block_k,
-                             interpret, heads, res, do):
+                             interpret, heads, kv_heads, res, do):
     import numpy as np
 
     q, k, v, mask8, out, lse = res
@@ -725,6 +773,7 @@ def _flash_pallas_masked_bwd(causal, q_offset, window, block_q, block_k,
     dq, dk, dv = _bwd_pallas_call(
         q, k, v, do, lse, delta, causal, q_offset, window,
         block_q, block_k, interpret, kv_mask8=mask8, heads=heads,
+        kv_heads=kv_heads,
     )
     # Integer operands take float0 cotangents (masks have no tangent space).
     dmask = np.zeros(mask8.shape, dtype=jax.dtypes.float0)
@@ -739,6 +788,7 @@ def _flash_attention_pallas(
     interpret: bool = False, kv_mask=None,
 ) -> jax.Array:
     b, h, sq, d = q.shape
+    hkv = k.shape[1]
     sk = k.shape[2]
     block_q = _pick_block(sq)
     block_k = _pick_block(sk)
@@ -748,16 +798,19 @@ def _flash_attention_pallas(
             f"got sq={sq}, sk={sk}; use impl='auto'/'xla'"
         )
     qf = q.reshape(b * h, sq, d)
-    kf = k.reshape(b * h, sk, d)
-    vf = v.reshape(b * h, sk, d)
+    # GQA-native: K/V stay at their REAL head count; the kernels' index
+    # maps fold the q-head → kv-head group mapping.
+    kf = k.reshape(b * hkv, sk, d)
+    vf = v.reshape(b * hkv, sk, d)
     if kv_mask is not None:
         mask8 = kv_mask.astype(jnp.int8).reshape(b, 1, sk)
         out = _flash_pallas_masked(
             qf, kf, vf, mask8, causal, q_offset, window, block_q, block_k,
-            interpret, h,
+            interpret, h, hkv,
         )
     else:
         out = _flash_pallas(
-            qf, kf, vf, causal, q_offset, window, block_q, block_k, interpret
+            qf, kf, vf, causal, q_offset, window, block_q, block_k,
+            interpret, h, hkv,
         )
     return out.reshape(b, h, sq, d)

@@ -145,8 +145,71 @@ class TestKvMask:
             assert float(jnp.max(jnp.abs(ref - got))) / scale < 1e-4
 
 
+class TestGQANative:
+    """K/V enter the kernel at their REAL head count; the index maps fold
+    the q-head → kv-head group, so no repeated K/V is materialized."""
+
+    def _gqa_qkv(self, h=8, hkv=2, sq=256, sk=256, b=2, d=128):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        return (
+            jax.random.normal(ks[0], (b, h, sq, d)),
+            jax.random.normal(ks[1], (b, hkv, sk, d)),
+            jax.random.normal(ks[2], (b, hkv, sk, d)),
+        )
+
+    def test_fwd_matches_repeated_xla(self):
+        q, k, v = self._gqa_qkv()
+        ref = A.flash_attention(q, k, v, impl="xla")  # broadcasts internally
+        got = A._flash_attention_pallas(q, k, v, True, 0, 0, interpret=True)
+        assert float(jnp.max(jnp.abs(ref - got))) < 1e-4
+
+    def test_fwd_windowed(self):
+        q, k, v = self._gqa_qkv(h=4, hkv=2, sq=256, sk=384)
+        ref = A.flash_attention(q, k, v, impl="xla", q_offset=128, window=90)
+        got = A._flash_attention_pallas(
+            q, k, v, True, 128, 90, interpret=True
+        )
+        assert float(jnp.max(jnp.abs(ref - got))) < 1e-4
+
+    def test_grads_match_repeated_xla(self):
+        """dk/dv must sum over each kv head's whole q-head group."""
+        q, k, v = self._gqa_qkv(h=4, hkv=2)
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+        gx = jax.grad(
+            loss(lambda q, k, v: A.flash_attention(q, k, v, impl="xla")),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gp = jax.grad(
+            loss(lambda q, k, v: A._flash_attention_pallas(
+                q, k, v, True, 0, 0, interpret=True)),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for ref, got in zip(gx, gp):
+            assert ref.shape == got.shape
+            scale = float(jnp.max(jnp.abs(ref))) + 1e-9
+            assert float(jnp.max(jnp.abs(ref - got))) / scale < 1e-4
+
+    def test_gqa_with_kv_mask(self):
+        q, k, v = self._gqa_qkv(h=4, hkv=2)
+        kv_mask = jnp.ones((2, 256), bool).at[0, :48].set(False)
+        ref = A.flash_attention(q, k, v, impl="xla", kv_mask=kv_mask)
+        got = A._flash_attention_pallas(
+            q, k, v, True, 0, 0, interpret=True, kv_mask=kv_mask
+        )
+        assert float(jnp.max(jnp.abs(ref - got))) < 1e-4
+
+
 class TestDispatch:
     def test_unaligned_lengths_fall_back(self):
         q, k, v = _qkv(100, 100)
         with pytest.raises(ValueError, match="128-aligned"):
             A._flash_attention_pallas(q, k, v, True, 0, 0, interpret=True)
+
+    def test_mismatched_heads_rejected(self):
+        q, _, _ = _qkv(256, 256)
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 3, 256, 128))
+        with pytest.raises(ValueError, match="not a multiple"):
+            A.flash_attention(q, k, k)  # 2 q heads, 3 kv heads
