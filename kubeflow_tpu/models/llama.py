@@ -445,6 +445,39 @@ def _prefill_impl(
     return logits, {"k": new_k, "v": new_v}
 
 
+@partial(jax.jit, static_argnames=("cfg", "chunk"), donate_argnums=(3,))
+def prefill_chunked(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # (B, S_prompt), S_prompt % chunk == 0
+    kv_cache: dict,
+    chunk: int = 512,
+) -> tuple[jax.Array, dict]:
+    """Long-prompt prefill in fixed chunks: (last-position logits, cache).
+
+    One compiled program regardless of prompt length (a lax.scan over
+    chunks), with activation and logits memory bounded at O(chunk) rows
+    instead of O(S) — the path for prompts whose full-sequence logits
+    (B, S, vocab) would not fit HBM. Numerically identical to the
+    single-shot ``prefill``: each chunk attends the cache slots written so
+    far plus itself, with chunk-causal masking inside the chunk.
+    """
+    b, s = tokens.shape
+    if s % chunk:
+        raise ValueError(f"prompt length {s} not divisible by chunk {chunk}")
+    chunks = tokens.reshape(b, s // chunk, chunk).transpose(1, 0, 2)
+
+    def step(carry, tok_chunk):
+        cache, pos = carry
+        logits, cache = _decode_chunk_impl(params, cfg, tok_chunk, cache, pos)
+        return (cache, pos + chunk), logits[:, -1]
+
+    (cache, _), last = jax.lax.scan(
+        step, (kv_cache, jnp.asarray(0, jnp.int32)), chunks
+    )
+    return last[-1], cache
+
+
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
 def prime_kv_cache(
     params: dict, cfg: LlamaConfig, tokens: jax.Array, kv_cache: dict

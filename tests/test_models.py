@@ -69,6 +69,60 @@ class TestLlama:
     def test_7b_param_count(self):
         assert abs(L.LLAMA_CONFIGS["llama-2-7b"].param_count() / 1e9 - 6.74) < 0.05
 
+    def test_chunked_prefill_matches_single_shot(self, tiny):
+        """Long-prompt prefill in chunks: same final logits + cache as the
+        one-shot pass (the bounded-memory path for prompts whose full
+        (B, S, vocab) logits would not fit HBM)."""
+        cfg, params = tiny
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(5), (2, 64), 0, cfg.vocab_size
+        )
+        ref_logits, ref_cache = L.prefill(
+            params, cfg, prompt, L.init_kv_cache(cfg, 2, 80)
+        )
+        got_logits, got_cache = L.prefill_chunked(
+            params, cfg, prompt, L.init_kv_cache(cfg, 2, 80), chunk=16
+        )
+        assert float(jnp.max(jnp.abs(ref_logits - got_logits))) < 1e-2
+        for key in ("k", "v"):
+            assert float(jnp.max(jnp.abs(
+                ref_cache[key][..., :64, :] - got_cache[key][..., :64, :]
+            ))) < 1e-2
+
+    def test_chunked_prefill_windowed_gqa(self):
+        """Sliding-window + GQA config through the chunked path."""
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            L.LLAMA_CONFIGS["tiny-gqa"], sliding_window=24
+        )
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(6), (1, 48), 0, cfg.vocab_size
+        )
+        ref, _ = L.prefill(params, cfg, prompt, L.init_kv_cache(cfg, 1, 48))
+        got, _ = L.prefill_chunked(
+            params, cfg, prompt, L.init_kv_cache(cfg, 1, 48), chunk=12
+        )
+        assert float(jnp.max(jnp.abs(ref - got))) < 1e-2
+
+    def test_chunked_prefill_then_decode(self, tiny):
+        """Generation continues correctly off a chunk-primed cache."""
+        cfg, params = tiny
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(7), (1, 32), 0, cfg.vocab_size
+        )
+        logits, cache = L.prefill_chunked(
+            params, cfg, prompt, L.init_kv_cache(cfg, 1, 40), chunk=8
+        )
+        nxt = jnp.argmax(logits, axis=-1)[:, None]
+        step_logits, _ = L.decode_step(
+            params, cfg, nxt, cache, jnp.asarray(32, jnp.int32)
+        )
+        full = jnp.concatenate([prompt, nxt], axis=1)
+        ref = L.forward(params, cfg, full)[:, -1]
+        assert float(jnp.max(jnp.abs(step_logits - ref))) < 1e-2
+
 
 class TestAttentionOps:
     def test_xla_flash_equivalence_noncausal(self):
