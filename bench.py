@@ -49,7 +49,7 @@ ATTEMPTS = [
 
 def run_decode_bench(
     cfg_name: str, prompt_len: int, steps: int, cache_len: int,
-    int8: bool = False,
+    quant_bits: int = 0,
 ):
     import jax
     import jax.numpy as jnp
@@ -60,12 +60,13 @@ def run_decode_bench(
     key = jax.random.PRNGKey(0)
     params = L.init_params(cfg, key)
     jax.block_until_ready(params)
-    if int8:
-        # Weight-only int8 (models/quant.py): halves HBM traffic per
-        # decoded token. free_source: bf16+int8 don't coexist in 16 GB.
+    if quant_bits:
+        # Weight-only quantization (models/quant.py): int8 halves, int4
+        # quarters the HBM traffic per decoded token. free_source: the
+        # bf16 and quantized trees don't coexist in 16 GB.
         from kubeflow_tpu.models.quant import quantize_params
 
-        params = quantize_params(params, free_source=True)
+        params = quantize_params(params, free_source=True, bits=quant_bits)
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (1, prompt_len), 0, cfg.vocab_size
     )
@@ -249,7 +250,12 @@ def run_full_bench(results: list) -> None:
 def main() -> int:
     import jax
 
-    int8 = "--int8" in sys.argv[1:]
+    if "--int8" in sys.argv[1:] and "--int4" in sys.argv[1:]:
+        print("error: --int8 and --int4 are mutually exclusive", file=sys.stderr)
+        return 2
+    quant_bits = 8 if "--int8" in sys.argv[1:] else (
+        4 if "--int4" in sys.argv[1:] else 0
+    )
     full = "--full" in sys.argv[1:]
     artifact = "BENCH_FULL.json"
     args = sys.argv[1:]
@@ -267,12 +273,13 @@ def main() -> int:
     for cfg_name, prompt_len, steps, cache_len, baseline in ATTEMPTS:
         try:
             tok_s = run_decode_bench(
-                cfg_name, prompt_len, steps, cache_len, int8=int8
+                cfg_name, prompt_len, steps, cache_len, quant_bits=quant_bits
             )
             headline = {
                 "metric": (
                     f"{cfg_name} greedy decode tokens/sec/chip "
-                    f"(bs=1, {'int8 weights' if int8 else 'bf16'}, "
+                    f"(bs=1, "
+                    f"{f'int{quant_bits} weights' if quant_bits else 'bf16'}, "
                     f"fused loop, {kind})"
                 ),
                 "value": round(tok_s, 2),

@@ -189,21 +189,30 @@ def _qkv(h: jax.Array, layer: dict) -> tuple[jax.Array, jax.Array, jax.Array]:
 
 
 def _mm(x: jax.Array, w) -> jax.Array:
-    """x @ w where w is dense OR int8-quantized ({"q": int8, "s": scale},
-    models/quant.py). The int8 tensor is what crosses HBM; the cast and
-    per-output-channel scale fuse into the matmul epilogue under XLA —
-    this is the whole weight-only-quant decode win."""
+    """x @ w where w is dense OR quantized (models/quant.py): int8 with a
+    per-output-channel scale (dequant fuses into the matmul EPILOGUE) or
+    group-wise int4 (dequant fuses into the weight-operand read). Either
+    way the quantized tensor is what crosses HBM — the whole
+    weight-only-quant decode win."""
     if isinstance(w, dict):
+        if w["q"].dtype == jnp.int4:
+            from kubeflow_tpu.models.quant import dequantize_weight
+
+            return x @ dequantize_weight(w, x.dtype)
         return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
     return x @ w
 
 
 def _lm_head_logits(x: jax.Array, params: dict) -> jax.Array:
     """x @ lm_head.T → f32 logits. Tied trees (no "lm_head" leaf) project
-    through the embedding matrix; either may be int8-quantized with a
-    per-vocab-row scale."""
+    through the embedding matrix; either may be quantized (int8 per-row
+    scale folds into the output; int4 dequantizes on the operand)."""
     w = params["lm_head"] if "lm_head" in params else params["embed"]
     if isinstance(w, dict):
+        if w["q"].dtype == jnp.int4:
+            from kubeflow_tpu.models.quant import dequantize_weight
+
+            return (x @ dequantize_weight(w, x.dtype).T).astype(jnp.float32)
         logits = (x @ w["q"].T.astype(x.dtype)).astype(jnp.float32)
         return logits * w["s"][:, 0]
     return (x @ w.T).astype(jnp.float32)

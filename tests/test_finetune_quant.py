@@ -220,3 +220,72 @@ class TestQuantization:
         # Projections dominate tiny's embed less than 7B's, so just assert
         # a real reduction.
         assert quantized_bytes(q) < quantized_bytes(bf16) * 0.8
+
+
+class TestInt4Quantization:
+    def test_round_trip_error_bounded(self):
+        from kubeflow_tpu.models.quant import quantize_weight_int4
+
+        w = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 32), jnp.float32)
+        qw = quantize_weight_int4(w, axis=1, group=16)
+        assert qw["q"].dtype == jnp.int4
+        assert qw["s"].shape == (2, 4, 32)  # 64 // 16 groups
+        back = dequantize_weight(qw, jnp.float32)
+        # Group-wise symmetric int4: error ≤ group_scale/2, i.e. ≤ 1/14 of
+        # the group max — much tighter than a per-channel int4 would be.
+        err = jnp.abs(back - w)
+        grouped = jnp.abs(w).reshape(2, 4, 16, 32).max(axis=2, keepdims=True)
+        bound = jnp.broadcast_to(grouped / 14.0 * 1.01, (2, 4, 16, 32))
+        assert bool(jnp.all(err.reshape(2, 4, 16, 32) <= bound))
+
+    def test_forward_exactly_matches_dequantized_tree(self, tiny):
+        """The fused int4 matmul path must equal running the model on the
+        explicitly-dequantized weights — the strong correctness property
+        (a random-init tiny model's argmax is too noise-sensitive for
+        agreement bounds; real trained models tolerate int4 far better)."""
+        cfg, params = tiny
+        qparams = quantize_params(params, bits=4, group=32)
+        deq = dict(qparams)
+        deq["layers"] = {
+            k: (dequantize_weight(v) if isinstance(v, dict) else v)
+            for k, v in qparams["layers"].items()
+        }
+        if isinstance(deq.get("lm_head"), dict):
+            deq["lm_head"] = dequantize_weight(deq["lm_head"])
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, cfg.vocab_size)
+        quant = np.asarray(L.forward(qparams, cfg, tokens))
+        ref = np.asarray(L.forward(deq, cfg, tokens))
+        assert np.abs(quant - ref).max() < 1e-5
+        # Loose sanity vs the unquantized model.
+        dense = np.asarray(L.forward(params, cfg, tokens))
+        cos = (dense * quant).sum() / (
+            np.linalg.norm(dense) * np.linalg.norm(quant)
+        )
+        assert cos > 0.9
+
+    def test_generation_runs_fused(self, tiny):
+        cfg, params = tiny
+        qparams = quantize_params(params, bits=4, group=32)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 0, cfg.vocab_size)
+        toks = L.generate(qparams, cfg, prompt, steps=8, cache_len=16)
+        assert toks.shape == (1, 8)
+
+    def test_group_must_divide_and_fit(self):
+        from kubeflow_tpu.models.quant import quantize_weight_int4
+
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 32))
+        with pytest.raises(ValueError, match="divisible"):
+            quantize_weight_int4(w, axis=0, group=48)
+        with pytest.raises(ValueError, match="must be in"):
+            quantize_weight_int4(w, axis=0, group=64)  # == contraction dim
+        with pytest.raises(ValueError, match="must be in"):
+            quantize_weight_int4(w, axis=0, group=1)  # shape-ambiguous
+
+    def test_free_source_validates_before_deleting(self, tiny):
+        """A bad group must fail BEFORE any bf16 buffer is deleted."""
+        cfg, params = tiny
+        with pytest.raises(ValueError):
+            quantize_params(params, bits=4, group=48, free_source=True)
+        # The source tree survived intact and still runs.
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+        assert L.forward(params, cfg, tokens).shape == (1, 8, cfg.vocab_size)
