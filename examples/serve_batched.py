@@ -20,6 +20,8 @@ def main() -> None:
     ap.add_argument("--config", default="tiny")
     ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--int8", action="store_true")
+    ap.add_argument("--int4", action="store_true",
+                    help="group-wise int4 weights (~4x fewer HBM bytes)")
     ap.add_argument("--max-new-tokens", type=int, default=16)
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--prompt", action="append", default=None,
@@ -56,9 +58,12 @@ def main() -> None:
         cfg = L.LLAMA_CONFIGS[args.config]
         params = L.init_params(cfg, jax.random.PRNGKey(0))
 
-    if args.int8:
-        params = quantize_params(params, free_source=True)
-        print("int8 weight-only quantization applied (~2x decode)")
+    if args.int8 and args.int4:
+        raise SystemExit("--int8 and --int4 are mutually exclusive")
+    if args.int8 or args.int4:
+        bits = 4 if args.int4 else 8
+        params = quantize_params(params, free_source=True, bits=bits)
+        print(f"int{bits} weight-only quantization applied")
 
     if tokenizer is not None and args.prompt:
         prompts = [tokenizer(p)["input_ids"] for p in args.prompt]

@@ -101,3 +101,33 @@ def test_max_to_keep_prunes_old_steps(tmp_path):
     steps = sorted(int(p.name) for p in (tmp_path / "keep").iterdir() if p.name.isdigit())
     assert len(steps) <= 2 and 4 in steps
     ckpt.close()
+
+
+def test_quantized_tree_round_trip(tmp_path):
+    """Quantized serving weights (pure-array {"q","s"} trees, int8 AND
+    group-wise int4) checkpoint and restore — the serving-restart path."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import llama as L
+    from kubeflow_tpu.models.quant import quantize_params
+
+    cfg = L.LLAMA_CONFIGS["tiny"]
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    for bits, group in ((8, 128), (4, 32)):
+        q = quantize_params(params, bits=bits, group=group)
+        ckpt = CheckpointManager(tmp_path / f"ckpt{bits}")
+        assert ckpt.save(1, q, force=True)
+        ckpt.wait()
+        template = jax.tree_util.tree_map(jnp.zeros_like, q)
+        restored, at = ckpt.restore_latest(template)
+        assert at == 1
+        wq = restored["layers"]["wq"]
+        assert wq["q"].dtype == (jnp.int8 if bits == 8 else jnp.int4)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size
+        )
+        ref = L.forward(q, cfg, tokens)
+        got = L.forward(restored, cfg, tokens)
+        assert float(jnp.max(jnp.abs(ref - got))) == 0.0
+        ckpt.close()
