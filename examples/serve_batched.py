@@ -27,6 +27,8 @@ def main() -> None:
     ap.add_argument("--prompt", action="append", default=None,
                     help="text prompt (needs --checkpoint tokenizer); repeatable")
     args = ap.parse_args()
+    if args.int8 and args.int4:
+        raise SystemExit("--int8 and --int4 are mutually exclusive")
 
     import jax
 
@@ -58,8 +60,6 @@ def main() -> None:
         cfg = L.LLAMA_CONFIGS[args.config]
         params = L.init_params(cfg, jax.random.PRNGKey(0))
 
-    if args.int8 and args.int4:
-        raise SystemExit("--int8 and --int4 are mutually exclusive")
     if args.int8 or args.int4:
         bits = 4 if args.int4 else 8
         params = quantize_params(params, free_source=True, bits=bits)

@@ -1,0 +1,291 @@
+"""Continuous batching: slot-based serving with admit-on-free.
+
+``batch_generate`` (models/serving.py) runs one fused program per batch —
+every request waits for the slowest. Continuous batching instead keeps a
+fixed pool of B cache SLOTS stepping together; when a request finishes
+(EOS or budget), its slot is freed and the next queued prompt is admitted
+immediately, without disturbing in-flight neighbors. Throughput stops
+being gated by the longest request in a batch.
+
+TPU-first shape discipline:
+- ONE compiled decode step for the life of the server: (B, 1) tokens,
+  per-slot write positions, a (B, cache_len) validity mask — all static
+  shapes, no per-request recompilation;
+- ONE compiled admit program per prompt-length bucket: the prompt is
+  left-padded to the bucket, prefilled into a single-row cache, and the
+  rows are written into the slot with dynamic_update_slice;
+- per-slot correctness falls out of the same invariants batch_generate
+  proved: left-padding + static kv_mask + absolute-position RoPE means
+  each slot's tokens follow exactly the greedy path of its own prompt.
+
+Host/device traffic per step: ONE positions upload, ONE tokens upload,
+ONE (B,) next-token readback (the standard continuous-batching sync
+point — the host must see tokens to retire/admit). All other state
+mutation happens on host numpy.
+
+No reference counterpart (control plane only); this sits with serving/
+speculative as the in-notebook inference surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.llama import (
+    LlamaConfig,
+    _embed,
+    _gqa_decode_attention,
+    _lm_head_logits,
+    _merge_heads,
+    _mlp,
+    _mm,
+    _norm,
+    _prefill_impl,
+    _qkv,
+    _split_heads,
+    apply_rope,
+    init_kv_cache,
+    rope_frequencies,
+    sample_logits,
+)
+from kubeflow_tpu.models.serving import GenerationConfig, left_pad
+
+
+# ---------------------------------------------------------------------------
+# Jitted programs
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(4, 5))
+def _admit_slot(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # (1, Lb) left-padded prompt
+    prompt_mask: Optional[jax.Array],  # (1, Lb) bool, None = no padding
+    cache: dict,  # batch cache (Lyr, B, Hkv, C, D)
+    kv_mask: jax.Array,  # (B, C) bool slot-validity state
+    slot: jax.Array,  # scalar int32 — traced, so ONE compile per bucket
+) -> tuple[jax.Array, dict, jax.Array]:
+    """Prefill one prompt into ``slot``: returns (first logits (V,),
+    updated cache, updated kv_mask)."""
+    cache_len = cache["k"].shape[3]
+    lb = tokens.shape[1]
+    temp = init_kv_cache(cfg, 1, cache_len)
+    logits, temp = _prefill_impl(params, cfg, tokens, temp, kv_mask=prompt_mask)
+    new_cache = {
+        name: jax.lax.dynamic_update_slice(
+            cache[name], temp[name], (0, slot, 0, 0, 0)
+        )
+        for name in ("k", "v")
+    }
+    row = jnp.ones((1, cache_len), bool)
+    if prompt_mask is not None:
+        row = row.at[:, :lb].set(prompt_mask)
+    new_mask = jax.lax.dynamic_update_slice(kv_mask, row, (slot, 0))
+    return logits[0], new_cache, new_mask
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "top_k", "top_p"),
+    donate_argnums=(3,),
+)
+def _cb_step(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # (B, 1) current input token per slot
+    cache: dict,
+    positions: jax.Array,  # (B,) write position per slot
+    kv_mask: jax.Array,  # (B, C)
+    key: jax.Array,
+    temperature: float,
+    top_k: int,
+    top_p: float,
+) -> tuple[jax.Array, dict]:
+    """One decode step across every slot at its own position."""
+    x = _embed(params, cfg, tokens)  # (B, 1, D)
+    cos, sin = rope_frequencies(cfg, positions)  # (B, half)
+
+    def write(cache_l, new, pos):
+        # (Hkv, C, D) <- (Hkv, 1, D) at slot-local position.
+        return jax.lax.dynamic_update_slice(cache_l, new, (0, pos, 0))
+
+    vwrite = jax.vmap(write)  # over the batch axis
+
+    def body(x, scanned):
+        layer, k_cache, v_cache = scanned  # caches (B, Hkv, C, D)
+        h = _norm(x, layer["attn_norm"], cfg)
+        hq, hk, hv = _qkv(h, layer)
+        q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin, per_batch=True)
+        k = apply_rope(_split_heads(hk, cfg.n_kv_heads), cos, sin,
+                       per_batch=True)
+        v = _split_heads(hv, cfg.n_kv_heads)
+        k_cache = vwrite(k_cache, k, positions)
+        v_cache = vwrite(v_cache, v, positions)
+        attn = _gqa_decode_attention(
+            q, k_cache, v_cache, positions, window=cfg.sliding_window,
+            kv_mask=kv_mask, per_batch=True,
+        )
+        x = x + _mm(_merge_heads(attn), layer["wo"])
+        h = _norm(x, layer["mlp_norm"], cfg)
+        x = x + _mlp(layer, h, cfg)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    logits = _lm_head_logits(_norm(x[:, 0], params["final_norm"], cfg), params)
+    nxt = sample_logits(logits, key, temperature, top_k, top_p)
+    return nxt, {"k": new_k, "v": new_v}
+
+
+# ---------------------------------------------------------------------------
+# Host-side server
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    prompt: list[int]
+    tokens: list[int] = dataclasses.field(default_factory=list)
+    budget: int = 0
+
+
+class ContinuousBatcher:
+    """Fixed-slot continuous-batching server.
+
+    >>> cb = ContinuousBatcher(params, cfg, slots=4, cache_len=256)
+    >>> ids = [cb.submit(p) for p in prompts]
+    >>> results = cb.run()           # {rid: tokens}, EOS-truncated
+    """
+
+    def __init__(
+        self,
+        params: dict,
+        cfg: LlamaConfig,
+        gen: Optional[GenerationConfig] = None,
+        slots: int = 8,
+        cache_len: int = 1024,
+        prompt_bucket: int = 64,
+        key: Optional[jax.Array] = None,
+    ):
+        self.gen = gen or GenerationConfig()
+        if prompt_bucket + self.gen.max_new_tokens > cache_len:
+            raise ValueError(
+                f"cache_len {cache_len} too small for prompt_bucket "
+                f"{prompt_bucket} + max_new_tokens {self.gen.max_new_tokens}"
+            )
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.cache_len = cache_len
+        self.prompt_bucket = prompt_bucket
+        self.key = jax.random.PRNGKey(0) if key is None else key
+        self.cache = init_kv_cache(cfg, slots, cache_len)
+        self.kv_mask = jnp.zeros((slots, cache_len), bool)
+        # Host-side mutable state; uploaded once per step.
+        self.positions = np.zeros((slots,), np.int32)
+        self.tokens = np.full((slots, 1), self.gen.pad_id, np.int32)
+        self._queue: list[_Request] = []
+        self._by_slot: list[Optional[_Request]] = [None] * slots
+        self._results: dict[int, list[int]] = {}
+        self._next_rid = 0
+
+    # -- API ---------------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int]) -> int:
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.prompt_bucket:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds bucket "
+                f"{self.prompt_bucket} (raise prompt_bucket)"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_Request(rid, list(prompt)))
+        return rid
+
+    def run(self) -> dict[int, list[int]]:
+        """Drive until queue and slots drain; returns {rid: tokens}."""
+        while self._queue or any(r is not None for r in self._by_slot):
+            self._admit_free_slots()
+            self._step()
+        out, self._results = self._results, {}
+        return out
+
+    # -- internals ---------------------------------------------------------
+
+    def _admit_free_slots(self) -> None:
+        for slot in range(self.slots):
+            if self._by_slot[slot] is not None or not self._queue:
+                continue
+            req = self._queue.pop(0)
+            padded, mask = left_pad(
+                [req.prompt], self.gen.pad_id, self.prompt_bucket
+            )
+            prompt_mask = None if mask.all() else jnp.asarray(mask)
+            logits, self.cache, self.kv_mask = _admit_slot(
+                self.params, self.cfg, jnp.asarray(padded), prompt_mask,
+                self.cache, self.kv_mask, jnp.asarray(slot, jnp.int32),
+            )
+            self.key, sub = jax.random.split(self.key)
+            first = int(
+                sample_logits(
+                    logits[None], sub, self.gen.temperature, self.gen.top_k,
+                    self.gen.top_p,
+                )[0]
+            )
+            self.positions[slot] = self.prompt_bucket
+            self._by_slot[slot] = req
+            req.budget = self.gen.max_new_tokens
+            self._note_token(slot, first)
+
+    def _note_token(self, slot: int, token: int) -> None:
+        """Record a sampled token for the slot's request; retire on EOS or
+        exhausted budget; otherwise feed it back as the next input."""
+        req = self._by_slot[slot]
+        if req is None:
+            return
+        req.budget -= 1
+        if token == self.gen.eos_id:
+            self._retire(slot)
+            return
+        req.tokens.append(token)
+        if req.budget <= 0:
+            self._retire(slot)
+            return
+        self.tokens[slot, 0] = token
+
+    def _retire(self, slot: int) -> None:
+        req = self._by_slot[slot]
+        self._results[req.rid] = req.tokens
+        self._by_slot[slot] = None
+        # Invalidate the slot so stale cache rows can never be attended
+        # before the next admit overwrites them.
+        self.kv_mask = self.kv_mask.at[slot].set(False)
+
+    def _step(self) -> None:
+        active = [i for i, r in enumerate(self._by_slot) if r is not None]
+        if not active:
+            return
+        self.key, sub = jax.random.split(self.key)
+        # jnp.array (not asarray): the CPU backend can alias numpy memory
+        # zero-copy, and the host mutates tokens/positions below while the
+        # dispatched step may still be reading them — upload COPIES.
+        nxt, self.cache = _cb_step(
+            self.params, self.cfg, jnp.array(self.tokens), self.cache,
+            jnp.array(self.positions), self.kv_mask, sub,
+            self.gen.temperature, self.gen.top_k, self.gen.top_p,
+        )
+        # The emitted token will occupy the next cache index of its slot.
+        for slot in active:
+            self.positions[slot] += 1
+        host_next = np.asarray(nxt)  # the one per-step readback
+        for slot in active:
+            self._note_token(slot, int(host_next[slot]))

@@ -278,12 +278,23 @@ def _llama3_scale_freqs(rs: RopeScaling, freqs: jax.Array) -> jax.Array:
     return scaled
 
 
-def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """x: (B, H, S, D). Rotate pairs (split-half convention)."""
+def apply_rope(
+    x: jax.Array, cos: jax.Array, sin: jax.Array, per_batch: bool = False
+) -> jax.Array:
+    """x: (B, H, S, D). Rotate pairs (split-half convention).
+
+    ``per_batch=False``: cos/sin are (S, half), shared across the batch.
+    ``per_batch=True``: cos/sin are (B, half) with S == 1 — one position
+    per batch row (continuous-batching decode, where every slot sits at
+    its own offset)."""
     half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
-    c = cos[None, None, :, :]
-    s = sin[None, None, :, :]
+    if per_batch:
+        c = cos[:, None, None, :]
+        s = sin[:, None, None, :]
+    else:
+        c = cos[None, None, :, :]
+        s = sin[None, None, :, :]
     x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
     out1 = x1f * c - x2f * s
     out2 = x2f * c + x1f * s
@@ -500,9 +511,10 @@ def _gqa_decode_attention(
     q: jax.Array,  # (B, H, 1, D)
     k: jax.Array,  # (B, Hkv, L, D)
     v: jax.Array,  # (B, Hkv, L, D)
-    position: jax.Array,  # scalar: q's absolute position
+    position: jax.Array,  # scalar | (sq,) | (B,) with per_batch=True
     window: int = 0,
     kv_mask: Optional[jax.Array] = None,  # (B, L) valid-key mask
+    per_batch: bool = False,
 ) -> jax.Array:
     """Grouped-query decode attention against the UNREPEATED KV cache.
 
@@ -519,13 +531,17 @@ def _gqa_decode_attention(
         jnp.einsum("bgrqd,bgkd->bgrqk", qg, k, preferred_element_type=jnp.float32)
         * scale
     )
-    # ``position`` may be a scalar (single-token decode) or a (sq,) vector
-    # (chunked decode, e.g. speculative verification): query i attends
-    # cache slots <= position[i].
+    # ``position`` may be a scalar (single-token decode), a (sq,) vector
+    # (chunked decode, e.g. speculative verification — query i attends
+    # cache slots <= position[i]), or with per_batch=True a (B,) vector
+    # (continuous batching — every batch row at its own offset).
     pos = jnp.asarray(position)
-    if pos.ndim == 0:
-        pos = jnp.broadcast_to(pos, (sq,))
-    pos_q = pos[None, None, None, :, None]  # (.., sq, 1)
+    if per_batch:
+        pos_q = pos[:, None, None, None, None]  # (B, 1, 1, 1, 1)
+    else:
+        if pos.ndim == 0:
+            pos = jnp.broadcast_to(pos, (sq,))
+        pos_q = pos[None, None, None, :, None]  # (.., sq, 1)
     k_pos = jnp.arange(k.shape[2])[None, None, None, None, :]
     mask = k_pos <= pos_q
     if window:

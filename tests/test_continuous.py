@@ -1,0 +1,133 @@
+"""Continuous batching: slot reuse, admission mid-flight, and per-request
+token parity with the fused batch path."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.models import llama as L
+from kubeflow_tpu.models.continuous import ContinuousBatcher
+from kubeflow_tpu.models.serving import GenerationConfig, batch_generate
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = L.LLAMA_CONFIGS["tiny"]
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _prompts(cfg, n, key=7):
+    ks = jax.random.split(jax.random.PRNGKey(key), n)
+    out = []
+    for i, k in enumerate(ks):
+        length = 4 + int(jax.random.randint(k, (), 0, 12))
+        out.append([int(t) for t in
+                    jax.random.randint(k, (length,), 3, cfg.vocab_size)])
+    return out
+
+
+def _assert_greedy_consistent(params, cfg, prompt, tokens):
+    """Each emitted token must be a greedy argmax of the reference forward
+    (within bf16 tie tolerance — ties legitimately break differently
+    across batch shapes; an off-path token is a REAL cache bug and sits
+    far below the max)."""
+    full = jnp.asarray([list(prompt) + list(tokens)])
+    logits = L.forward(params, cfg, full)[0]
+    start = len(prompt) - 1
+    for i, tok in enumerate(tokens):
+        row = logits[start + i]
+        gap = float(row.max() - row[tok])
+        assert gap < 0.02, f"token {i} ({tok}) off the greedy path by {gap}"
+
+
+class TestContinuousBatcher:
+    def test_single_request_matches_fused_batch_path(self, tiny):
+        """slots=1 reproduces batch_generate token-for-token (identical
+        shapes → no bf16 tie ambiguity)."""
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=8, eos_id=-1)
+        prompt = [5, 9, 17, 33]
+        ref = batch_generate(params, cfg, [prompt], gen=gen, pad_to=16)[0]
+        cb = ContinuousBatcher(params, cfg, gen=gen, slots=1,
+                               cache_len=24, prompt_bucket=16)
+        rid = cb.submit(prompt)
+        assert cb.run()[rid] == [int(t) for t in ref]
+
+    def test_slot_reuse_stays_on_greedy_path(self, tiny):
+        """More requests than slots: every request's tokens must follow
+        the greedy path of ITS OWN prompt — admission into a recycled
+        slot must not contaminate neighbors."""
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=10, eos_id=-1)  # no early EOS
+        prompts = _prompts(cfg, 7)
+        cb = ContinuousBatcher(
+            params, cfg, gen=gen, slots=3, cache_len=16 + gen.max_new_tokens,
+            prompt_bucket=16,
+        )
+        rids = [cb.submit(p) for p in prompts]
+        results = cb.run()
+        assert sorted(results) == sorted(rids)
+        for rid, prompt in zip(rids, prompts):
+            assert len(results[rid]) == gen.max_new_tokens
+            _assert_greedy_consistent(params, cfg, prompt, results[rid])
+
+    def test_eos_frees_slot_early(self, tiny):
+        """A request hitting EOS retires early and its slot is reused;
+        everyone stays on their own greedy path."""
+        cfg, params = tiny
+        probe = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        prompts = _prompts(cfg, 4, key=11)
+        # Probe with the SAME slot/batch shapes (bf16 ties break by
+        # computation shape, so the probe must mirror the real run), then
+        # make prompt 0's step-2 token the eos: request 0 stops after 2.
+        probe_cb = ContinuousBatcher(
+            params, cfg, gen=probe, slots=2, cache_len=16 + 6,
+            prompt_bucket=16,
+        )
+        probe_rids = [probe_cb.submit(p) for p in prompts]
+        probe_out = probe_cb.run()[probe_rids[0]]
+        eos = int(probe_out[2])
+        gen = GenerationConfig(max_new_tokens=6, eos_id=eos)
+        cb = ContinuousBatcher(
+            params, cfg, gen=gen, slots=2, cache_len=16 + 6, prompt_bucket=16
+        )
+        rids = [cb.submit(p) for p in prompts]
+        results = cb.run()
+        for rid, prompt in zip(rids, prompts):
+            out = results[rid]
+            assert eos not in out
+            assert len(out) <= gen.max_new_tokens
+            _assert_greedy_consistent(params, cfg, prompt, out)
+            if len(out) < gen.max_new_tokens:
+                # Early stop must be warranted: eos is (near-)argmax right
+                # after the emitted prefix.
+                full = jnp.asarray([list(prompt) + out])
+                row = L.forward(params, cfg, full)[0, -1]
+                assert float(row.max() - row[eos]) < 0.02
+        assert len(results[rids[0]]) < gen.max_new_tokens, "no early retire"
+
+    def test_submit_validation(self, tiny):
+        cfg, params = tiny
+        cb = ContinuousBatcher(params, cfg, slots=2, cache_len=64,
+                               prompt_bucket=16,
+                               gen=GenerationConfig(max_new_tokens=8))
+        with pytest.raises(ValueError, match="empty"):
+            cb.submit([])
+        with pytest.raises(ValueError, match="exceeds bucket"):
+            cb.submit(list(range(20)))
+
+    def test_run_with_empty_queue_returns_empty(self, tiny):
+        cfg, params = tiny
+        cb = ContinuousBatcher(params, cfg, slots=2, cache_len=64,
+                               prompt_bucket=16,
+                               gen=GenerationConfig(max_new_tokens=8))
+        assert cb.run() == {}
+
+    def test_constructor_rejects_overflowing_cache(self, tiny):
+        cfg, params = tiny
+        with pytest.raises(ValueError, match="cache_len"):
+            ContinuousBatcher(params, cfg, slots=2, cache_len=64,
+                              prompt_bucket=16)  # default max_new=128
