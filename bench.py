@@ -270,8 +270,27 @@ def main() -> int:
     device = jax.devices()[0]
     kind = getattr(device, "device_kind", str(device))
     last_err = None
-    for cfg_name, prompt_len, steps, cache_len, baseline in ATTEMPTS:
+    attempts = [
+        (cfg_name, prompt_len, steps, cache_len, baseline, force_xla)
+        for cfg_name, prompt_len, steps, cache_len, baseline in ATTEMPTS
+        # Safety net for the headline metric: if a config fails with the
+        # pallas prefill kernel (e.g. a Mosaic lowering regression), retry
+        # it on the XLA path before shrinking the model. Decode tok/s is
+        # measured by a two-point difference that cancels prefill, so the
+        # fallback does not change what the number means.
+        for force_xla in (False, True)
+    ]
+    for cfg_name, prompt_len, steps, cache_len, baseline, force_xla in attempts:
         try:
+            if force_xla:
+                from kubeflow_tpu.ops.attention import force_xla_fallback
+
+                force_xla_fallback(True)
+                # Drop any cached executable from the failed attempt — the
+                # jit cache does not key on the fallback flag.
+                jax.clear_caches()
+                print(f"# retrying {cfg_name} with XLA attention fallback",
+                      file=sys.stderr)
             tok_s = run_decode_bench(
                 cfg_name, prompt_len, steps, cache_len, quant_bits=quant_bits
             )

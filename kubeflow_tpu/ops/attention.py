@@ -131,8 +131,25 @@ def _broadcast_kv(x: jax.Array, rep: int) -> jax.Array:
     )
 
 
+# Kill switch for the pallas path: set by force_xla_fallback() or the
+# KUBEFLOW_TPU_FORCE_XLA_ATTENTION env var. Exists so a kernel-lowering
+# regression can never take the whole model stack down — impl="auto"
+# callers degrade to the XLA path instead.
+import os as _os
+
+_FORCE_XLA = _os.environ.get("KUBEFLOW_TPU_FORCE_XLA_ATTENTION", "") == "1"
+
+
+def force_xla_fallback(enabled: bool = True) -> None:
+    """Make impl="auto" resolve to the XLA path process-wide. NOTE: jitted
+    programs already traced keep their compiled choice; call before the
+    first trace (bench.py uses this to retry a failed config)."""
+    global _FORCE_XLA
+    _FORCE_XLA = enabled
+
+
 def _pallas_ok(q: jax.Array, k: jax.Array) -> bool:
-    if pl is None or jax.default_backend() not in ("tpu", "axon"):
+    if _FORCE_XLA or pl is None or jax.default_backend() not in ("tpu", "axon"):
         return False
     _, _, sq, d = q.shape
     sk = k.shape[2]
