@@ -21,4 +21,4 @@ with TPUs as a first-class concept:
   benchmark parity (Llama-2-7B tokens/sec/chip).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"

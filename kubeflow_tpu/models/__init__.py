@@ -5,4 +5,8 @@ from kubeflow_tpu.models.llama import (  # noqa: F401
     forward,
     decode_step,
     init_kv_cache,
+    prefill,
+    prefill_chunked,
+    generate,
+    sample,
 )

@@ -192,6 +192,28 @@ class TestGQANative:
             scale = float(jnp.max(jnp.abs(ref))) + 1e-9
             assert float(jnp.max(jnp.abs(ref - got))) / scale < 1e-4
 
+    def test_grads_gqa_window_offset_combined(self):
+        """The dkv kernel's hardest path: GQA group sweep + sliding-window
+        clamps + cached-continuation offset, all at once."""
+        q, k, v = self._gqa_qkv(h=4, hkv=2, sq=256, sk=384)
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+        gx = jax.grad(
+            loss(lambda q, k, v: A.flash_attention(
+                q, k, v, impl="xla", q_offset=128, window=120)),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gp = jax.grad(
+            loss(lambda q, k, v: A._flash_attention_pallas(
+                q, k, v, True, 128, 120, interpret=True)),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for ref, got in zip(gx, gp):
+            scale = float(jnp.max(jnp.abs(ref))) + 1e-9
+            assert float(jnp.max(jnp.abs(ref - got))) / scale < 1e-4
+
     def test_gqa_with_kv_mask(self):
         q, k, v = self._gqa_qkv(h=4, hkv=2)
         kv_mask = jnp.ones((2, 256), bool).at[0, :48].set(False)
