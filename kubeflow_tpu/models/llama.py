@@ -354,6 +354,12 @@ def forward(
     x = _embed(params, cfg, tokens)
     cos, sin = rope_frequencies(cfg, jnp.arange(tokens.shape[1]))
 
+    # Rematerialize each layer in the backward pass: the scan stores only
+    # the (B, S, dim) carry per layer instead of every attention/MLP
+    # intermediate (the f32 gate/up buffers alone are ~dim·ffn_hidden·2
+    # per token) — the standard TPU FLOPs-for-HBM trade. Free at inference
+    # (no cotangent → no recompute).
+    @jax.checkpoint
     def body(x, layer):
         return _layer_fwd(layer, cfg, x, cos, sin, attn_impl), None
 
