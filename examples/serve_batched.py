@@ -60,8 +60,13 @@ def main() -> None:
         cfg = L.LLAMA_CONFIGS[args.config]
         params = L.init_params(cfg, jax.random.PRNGKey(0))
 
-    if args.int8 or args.int4:
-        bits = 4 if args.int4 else 8
+    from kubeflow_tpu.models.quant import quant_bits_from_env
+
+    # CLI flags win; otherwise the notebook runtime option applies (the
+    # webhook projects the tpu-quantization annotation into
+    # KUBEFLOW_TPU_QUANT — this is the consuming end of that contract).
+    bits = 4 if args.int4 else (8 if args.int8 else quant_bits_from_env())
+    if bits:
         params = quantize_params(params, free_source=True, bits=bits)
         print(f"int{bits} weight-only quantization applied")
 

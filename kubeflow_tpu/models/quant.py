@@ -166,3 +166,24 @@ def quantized_bytes(params: dict) -> int:
     for leaf in jax.tree_util.tree_leaves(params):
         total += leaf.size * leaf.dtype.itemsize
     return total
+
+
+def quant_bits_from_env() -> int:
+    """Serving-side half of the notebook runtime option: the webhook
+    projects the ``notebooks.kubeflow.org/tpu-quantization`` annotation
+    into KUBEFLOW_TPU_QUANT ("int8"|"int4"; absent/"bf16" = 0). Returns
+    the ``bits`` argument for quantize_params (0 = stay bf16). Raises on
+    values the validating webhook would have denied — a hand-set env var
+    must not silently serve full precision."""
+    import os
+
+    value = os.environ.get("KUBEFLOW_TPU_QUANT", "")
+    if value in ("", "bf16"):
+        return 0
+    if value == "int8":
+        return 8
+    if value == "int4":
+        return 4
+    raise ValueError(
+        f"KUBEFLOW_TPU_QUANT={value!r}: want 'int8', 'int4', or 'bf16'"
+    )

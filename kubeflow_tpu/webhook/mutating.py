@@ -110,6 +110,7 @@ class NotebookMutatingWebhook:
 
             self._resolve_image_from_registry(nb, span)
             self._inject_tpu(nb)
+            self._handle_quant_env(nb)
             mounts.check_and_mount_ca_bundle(nb, self.client)
             mounts.mount_runtime_images(nb, self.client)
             if self.config.set_pipeline_secret:
@@ -156,6 +157,22 @@ class NotebookMutatingWebhook:
             nb.obj, ann.TPU_RESOLVED_TOPOLOGY,
             f"{topo.accelerator_type}/{topo.topology_str}",
         )
+
+    def _handle_quant_env(self, nb: Notebook) -> None:
+        """Project the quantization annotation into the serving env
+        (TPU-native runtime option; no reference counterpart). "bf16" and
+        absence both mean full precision — the env var is removed so the
+        in-notebook default (models.quant.quant_bits_from_env) applies."""
+        container = nb.primary_container()
+        if container is None:
+            return
+        value = nb.annotations.get(ann.TPU_QUANTIZATION, "")
+        if value in ("", "bf16") or value not in ann.TPU_QUANTIZATION_VALUES:
+            # Unknown values are denied by the validating webhook; never
+            # propagate them into the pod regardless of webhook ordering.
+            remove_env(container, {ann.QUANT_ENV_NAME})
+            return
+        upsert_env(container, [{"name": ann.QUANT_ENV_NAME, "value": value}])
 
     def _resolve_image_from_registry(self, nb: Notebook, span=None) -> None:
         """Resolve "imagestream:tag" annotations to a digested image ref

@@ -39,6 +39,13 @@ class NotebookValidatingWebhook:
             except InvalidTopologyError as err:
                 raise WebhookDeniedError(f"invalid spec.tpu: {err}") from None
 
+        quant = nb.annotations.get(ann.TPU_QUANTIZATION)
+        if quant and quant not in ann.TPU_QUANTIZATION_VALUES:
+            raise WebhookDeniedError(
+                f"annotation {ann.TPU_QUANTIZATION}: unknown value {quant!r} "
+                f"(want one of {', '.join(ann.TPU_QUANTIZATION_VALUES)})"
+            )
+
         if req.operation != "UPDATE" or req.old_object is None:
             return
         old = Notebook(req.old_object)

@@ -90,6 +90,61 @@ class TestTpuEnvInjection:
         )
 
 
+class TestQuantizationOption:
+    def test_annotation_projects_env(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(
+            tpu_notebook(annotations={ann.TPU_QUANTIZATION: "int8"})
+        )
+        _, c = primary(env)
+        assert get_env_var(c, ann.QUANT_ENV_NAME)["value"] == "int8"
+
+    def test_bf16_and_absent_mean_no_env(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(
+            tpu_notebook(annotations={ann.TPU_QUANTIZATION: "bf16"})
+        )
+        _, c = primary(env)
+        assert get_env_var(c, ann.QUANT_ENV_NAME) is None
+        env2 = make_env(webhooks=True)
+        env2.cluster.create(cpu_notebook())
+        _, c2 = primary(env2)
+        assert get_env_var(c2, ann.QUANT_ENV_NAME) is None
+
+    def test_removal_drops_env(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(
+            tpu_notebook(annotations={ann.TPU_QUANTIZATION: "int4"})
+        )
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        del nb["metadata"]["annotations"][ann.TPU_QUANTIZATION]
+        env.cluster.update(nb)
+        _, c = primary(env)
+        assert get_env_var(c, ann.QUANT_ENV_NAME) is None
+
+    def test_unknown_value_denied(self):
+        env = make_env(webhooks=True)
+        with pytest.raises(WebhookDeniedError, match="unknown value"):
+            env.cluster.create(
+                tpu_notebook(annotations={ann.TPU_QUANTIZATION: "fp4"})
+            )
+
+    def test_env_consumed_by_runtime(self, monkeypatch):
+        from kubeflow_tpu.models.quant import quant_bits_from_env
+
+        monkeypatch.delenv(ann.QUANT_ENV_NAME, raising=False)
+        assert quant_bits_from_env() == 0
+        monkeypatch.setenv(ann.QUANT_ENV_NAME, "int8")
+        assert quant_bits_from_env() == 8
+        monkeypatch.setenv(ann.QUANT_ENV_NAME, "int4")
+        assert quant_bits_from_env() == 4
+        monkeypatch.setenv(ann.QUANT_ENV_NAME, "bf16")
+        assert quant_bits_from_env() == 0
+        monkeypatch.setenv(ann.QUANT_ENV_NAME, "fp4")
+        with pytest.raises(ValueError, match="fp4"):
+            quant_bits_from_env()
+
+
 class TestImageResolution:
     def _imagestream(self, env):
         env.cluster.create(
