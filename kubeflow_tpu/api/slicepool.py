@@ -1,0 +1,90 @@
+"""SlicePool API: warm TPU slice capacity checked out at notebook spawn.
+
+No reference counterpart — the reference treats pod spawn latency as the
+cluster's problem (its only budget artifacts are CI timeouts; SURVEY.md §6).
+On TPU the dominant spawn costs are node-pool provisioning and workbench
+image pulls, both O(minutes) — far outside the <90 s p50 north star
+(BASELINE.json) for a cold slice. A SlicePool holds ``warmReplicas``
+pre-provisioned placeholder slices: each is a real indexed StatefulSet with
+the same ``google.com/tpu`` resources and topology nodeSelectors a Notebook
+slice would use (so GKE keeps nodes provisioned) running the workbench
+image with an idle command (so kubelets keep the image pulled). When a
+Notebook with a matching topology is created, the controller *claims* a
+warm slice — deletes the placeholder, freeing its chips on already-warm
+nodes for the notebook's pods to bind immediately — and the pool refills in
+the background (level-triggered reconcile).
+
+Pools are namespaced; a pool serves Notebooks in its own namespace (TPU
+quota and RBAC are namespace-scoped in the multi-tenant layout).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from kubeflow_tpu.api.notebook import GROUP, TPUSpec
+from kubeflow_tpu.k8s import objects as obj_util
+
+KIND = "SlicePool"
+VERSION = "v1"
+
+# Labels stamped on placeholder StatefulSets; the claim path selects on them.
+POOL_LABEL = "slicepools.kubeflow.org/pool"
+STATE_LABEL = "slicepools.kubeflow.org/state"
+ACCELERATOR_LABEL = "slicepools.kubeflow.org/accelerator"
+TOPOLOGY_LABEL = "slicepools.kubeflow.org/topology"
+STATE_WARM = "warm"
+
+# Annotation recorded on the Notebook when its slice came from a pool.
+CLAIMED_FROM = "notebooks.kubeflow.org/claimed-from-pool"
+
+
+class SlicePool:
+    """Typed view over a dict-shaped SlicePool object."""
+
+    def __init__(self, obj: dict):
+        self.obj = obj
+
+    @property
+    def name(self) -> str:
+        return obj_util.name_of(self.obj)
+
+    @property
+    def namespace(self) -> str:
+        return obj_util.namespace_of(self.obj)
+
+    @property
+    def tpu(self) -> TPUSpec:
+        return TPUSpec.from_dict(self.obj.get("spec", {}).get("tpu", {}))
+
+    @property
+    def warm_replicas(self) -> int:
+        return int(self.obj.get("spec", {}).get("warmReplicas", 1))
+
+    @property
+    def image(self) -> str:
+        """Image the placeholders run (and therefore keep pulled on the
+        slice nodes). Default to the standard workbench image."""
+        return self.obj.get("spec", {}).get("image", "jax-notebook:latest")
+
+    @property
+    def status(self) -> dict:
+        return self.obj.setdefault("status", {})
+
+
+def new_slicepool(
+    name: str,
+    namespace: str,
+    tpu: TPUSpec,
+    warm_replicas: int = 1,
+    image: Optional[str] = None,
+) -> dict:
+    obj = obj_util.new_object(f"{GROUP}/{VERSION}", KIND, name, namespace)
+    spec: dict[str, Any] = {
+        "tpu": tpu.to_dict(),
+        "warmReplicas": warm_replicas,
+    }
+    if image:
+        spec["image"] = image
+    obj["spec"] = spec
+    return obj

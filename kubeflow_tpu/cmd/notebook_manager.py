@@ -24,6 +24,7 @@ from typing import Optional
 from kubeflow_tpu.controller.culling import CullerConfig, CullingReconciler
 from kubeflow_tpu.controller.notebook import ControllerConfig, NotebookReconciler
 from kubeflow_tpu.controller.preemption import SliceHealthReconciler
+from kubeflow_tpu.controller.slicepool import SlicePoolReconciler
 from kubeflow_tpu.k8s.client import Client
 from kubeflow_tpu.k8s.health import HealthChecks, HealthServer, ping
 from kubeflow_tpu.k8s.leader import UPSTREAM_LEASE, LeaderElector
@@ -71,6 +72,7 @@ class ManagerBundle:
     notebook_reconciler: NotebookReconciler
     culling_reconciler: Optional[CullingReconciler]
     preemption_reconciler: SliceHealthReconciler
+    slicepool_reconciler: Optional[SlicePoolReconciler] = None
     elector: Optional[LeaderElector] = None
     extra: dict = field(default_factory=dict)
 
@@ -111,6 +113,11 @@ def build(
 
     preemption = SliceHealthReconciler(cluster, metrics=metrics)
     preemption.register(manager)
+
+    # Warm slice pools: inert without SlicePool CRs, so always registered
+    # (mirrors how Owns-watches cost nothing until objects exist).
+    pools = SlicePoolReconciler(cluster, metrics=metrics)
+    pools.register(manager)
 
     culler: Optional[CullingReconciler] = None
     culler_cfg = CullerConfig.from_env(env)
@@ -155,6 +162,7 @@ def build(
         notebook_reconciler=nb,
         culling_reconciler=culler,
         preemption_reconciler=preemption,
+        slicepool_reconciler=pools,
         elector=elector,
     )
 

@@ -182,7 +182,15 @@ class NotebookReconciler(Reconciler):
                 nb, slice_topo, self.config,
                 slice_id=slice_id, slice_count=slice_count,
             )
-            created_any |= self._reconcile_statefulset(obj, sts)
+            try:
+                existing = self.client.get(
+                    "StatefulSet", obj_util.name_of(sts), nb.namespace
+                )
+            except NotFoundError:
+                existing = None
+            if existing is None and slice_topo is not None and not nb.stopped:
+                self._maybe_claim_warm_slice(obj, nb, slice_topo)
+            created_any |= self._reconcile_statefulset(obj, sts, existing)
         if created_any:
             self.metrics.create_total.inc()
             # Long names fall back to deterministic hashed StatefulSet
@@ -219,13 +227,42 @@ class NotebookReconciler(Reconciler):
         return Result()
 
     # ------------------------------------------------------------------
-    def _reconcile_statefulset(self, owner: dict, desired: dict) -> bool:
-        """Create-or-update; returns True when newly created."""
+    def _maybe_claim_warm_slice(self, obj: dict, nb: Notebook, topo) -> None:
+        """Claim a warm SlicePool placeholder BEFORE the cold STS exists,
+        so the freed chips/warm nodes are available when the slice pods
+        first schedule (kubeflow_tpu.controller.slicepool). The caller only
+        invokes this when the slice STS does not exist yet — claims are for
+        first creation, never the steady-state reconcile path."""
+        from kubeflow_tpu.api.slicepool import CLAIMED_FROM
+        from kubeflow_tpu.controller.slicepool import claim_warm_slice
+
+        if not self.client.list("SlicePool", nb.namespace):
+            return  # namespace doesn't use pools; keep metrics quiet
+        pool = claim_warm_slice(
+            self.client, nb.namespace, topo, recorder=self.recorder,
+            notebook=obj,
+        )
+        if not pool:
+            self.metrics.pool_claim_misses_total.inc()
+            return
+        self.metrics.pool_claims_total.inc()
+
+        def record():
+            fresh = self.client.get("Notebook", nb.name, nb.namespace)
+            if obj_util.annotations_of(fresh).get(CLAIMED_FROM) != pool:
+                obj_util.set_annotation(fresh, CLAIMED_FROM, pool)
+                self.client.update(fresh)
+
+        retry_on_conflict(record)
+
+    # ------------------------------------------------------------------
+    def _reconcile_statefulset(
+        self, owner: dict, desired: dict, existing: Optional[dict]
+    ) -> bool:
+        """Create-or-update (``existing`` prefetched by the caller — one
+        GET serves both the claim probe and this); True when created."""
         name = obj_util.name_of(desired)
-        namespace = obj_util.namespace_of(desired)
-        try:
-            existing = self.client.get("StatefulSet", name, namespace)
-        except NotFoundError:
+        if existing is None:
             obj_util.set_controller_reference(owner, desired)
             try:
                 self.client.create(desired)

@@ -1,0 +1,290 @@
+"""SlicePool reconciler: keep warm TPU slices provisioned; serve claims.
+
+TPU-native subsystem with no reference counterpart (the reference's spawn
+path is always cold — SURVEY.md §6 records only CI-timeout expectations).
+Mechanics per ``kubeflow_tpu.api.slicepool``:
+
+- level-triggered reconcile maintains ``spec.warmReplicas`` placeholder
+  StatefulSets per pool (same nodeSelectors/chip resources as a notebook
+  slice; workbench image with an idle command, so nodes stay provisioned
+  and images stay pulled),
+- ``claim_warm_slice`` (called by the Notebook reconciler just before it
+  creates a cold slice STS) deletes one all-Ready placeholder, freeing its
+  chips on warm nodes for the incoming notebook pods; the pool's next
+  reconcile re-creates the placeholder (refill),
+- claimed placeholders are named with a monotonic generation counter so a
+  refill never races the apiserver's async cascade-delete of the claimed
+  StatefulSet's pods.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from kubeflow_tpu.api import slicepool as sp
+from kubeflow_tpu.api.names import derived_name
+from kubeflow_tpu.api.notebook import MAX_NAME_LENGTH
+from kubeflow_tpu.k8s import objects as obj_util
+from kubeflow_tpu.k8s.client import Client
+from kubeflow_tpu.k8s.errors import AlreadyExistsError, NotFoundError
+from kubeflow_tpu.k8s.events import EventRecorder
+from kubeflow_tpu.k8s.manager import Manager, Reconciler, Request, Result
+from kubeflow_tpu.metrics import Metrics
+from kubeflow_tpu.tpu.topology import InvalidTopologyError, SliceTopology
+
+log = logging.getLogger(__name__)
+
+
+# Shipped by config/manager (deploy.manifests.placeholder_priority_class):
+# value < 0 so any default-priority notebook pod preempts placeholder pods.
+PLACEHOLDER_PRIORITY_CLASS = "tpu-slicepool-placeholder"
+
+
+def warm_sts_name(pool_name: str, generation: int) -> str:
+    return derived_name(pool_name, f"-warm-{generation}", MAX_NAME_LENGTH)
+
+
+def generate_warm_statefulset(
+    pool: sp.SlicePool, topo: SliceTopology, generation: int
+) -> dict:
+    """Placeholder slice: real chips + nodeSelectors, idle container.
+
+    The container requests the full per-host chip count so the scheduler
+    (and GKE autoscaler) treat it exactly like a notebook slice; the idle
+    command never opens the notebook port, so routing/culling ignore it.
+    """
+    name = warm_sts_name(pool.name, generation)
+    labels = {
+        sp.POOL_LABEL: pool.name,
+        sp.STATE_LABEL: sp.STATE_WARM,
+        sp.ACCELERATOR_LABEL: topo.accelerator_type,
+        sp.TOPOLOGY_LABEL: topo.topology_str,
+        "statefulset": name,
+    }
+    container = {
+        "name": "warm-placeholder",
+        "image": pool.image,
+        # The workbench image's shell idles; the image itself is the point
+        # (kubelet keeps it pulled on every slice node).
+        "command": ["/bin/sh", "-c", "sleep infinity"],
+        "resources": {
+            "limits": {"google.com/tpu": str(topo.chips_per_host)},
+            "requests": {"google.com/tpu": str(topo.chips_per_host)},
+        },
+    }
+    pod_spec = {
+        "containers": [container],
+        "nodeSelector": dict(topo.node_selector()),
+        "tolerations": [
+            {"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"}
+        ],
+        # Negative-priority pods (config/manager ships the PriorityClass):
+        # notebook pods (priority 0) PREEMPT placeholders, so a refill that
+        # races the claiming notebook's pods for the just-freed nodes can
+        # never win — the scheduler evicts it in the notebook's favor, and
+        # the warm handoff holds without any claim/refill ordering.
+        "priorityClassName": PLACEHOLDER_PRIORITY_CLASS,
+    }
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {
+            "name": name,
+            "namespace": pool.namespace,
+            "labels": dict(labels),
+        },
+        "spec": {
+            "replicas": topo.hosts,
+            "podManagementPolicy": "Parallel",
+            "selector": {"matchLabels": {"statefulset": name}},
+            # Placeholders need no DNS, but apiserver validation requires a
+            # non-empty governing service name (it need not exist) on
+            # k8s <= 1.31; the STS's own name keeps it unique and obvious.
+            "serviceName": name,
+            "template": {"metadata": {"labels": labels}, "spec": pod_spec},
+        },
+    }
+
+
+def _sts_ready(sts: dict) -> bool:
+    status = sts.get("status", {})
+    want = sts.get("spec", {}).get("replicas", 0)
+    return want > 0 and status.get("readyReplicas", 0) >= want
+
+
+def claim_warm_slice(
+    client: Client,
+    namespace: str,
+    topo: SliceTopology,
+    recorder: Optional[EventRecorder] = None,
+    notebook: Optional[dict] = None,
+) -> Optional[str]:
+    """Claim one warm placeholder matching (accelerator, topology).
+
+    Returns the pool name, or None when no matching warm slice exists.
+    Prefers an all-Ready placeholder (nodes provisioned AND image pulled);
+    falls back to a still-warming one — even a partially-provisioned
+    placeholder beats a cold node-pool scale-up. Deleting the StatefulSet
+    cascades to its pods, releasing chips for the notebook's pods.
+    """
+    candidates = client.list(
+        "StatefulSet",
+        namespace,
+        label_selector={
+            sp.STATE_LABEL: sp.STATE_WARM,
+            sp.ACCELERATOR_LABEL: topo.accelerator_type,
+            sp.TOPOLOGY_LABEL: topo.topology_str,
+        },
+    )
+    # Ready placeholders first, then still-warming ones; on a lost delete
+    # race (a concurrent claim got there first) fall through to the next
+    # candidate instead of going cold while warm capacity remains.
+    ordered = sorted(candidates, key=lambda s: not _sts_ready(s))
+    for chosen in ordered:
+        pool_name = obj_util.labels_of(chosen).get(sp.POOL_LABEL, "")
+        try:
+            client.delete(
+                "StatefulSet", obj_util.name_of(chosen),
+                obj_util.namespace_of(chosen),
+            )
+        except NotFoundError:
+            continue
+        if recorder is not None and notebook is not None:
+            recorder.eventf(
+                notebook, "Normal", "ClaimedWarmSlice",
+                f"Claimed warm slice {obj_util.name_of(chosen)} from pool "
+                f"{pool_name} ({topo.accelerator_type})",
+            )
+        return pool_name or None
+    return None
+
+
+class SlicePoolReconciler(Reconciler):
+    """Maintains each pool's placeholder StatefulSets and status."""
+
+    def __init__(
+        self,
+        client: Client,
+        metrics: Optional[Metrics] = None,
+        recorder: Optional[EventRecorder] = None,
+    ):
+        self.client = client
+        self.metrics = metrics
+        self.recorder = recorder or EventRecorder(client)
+
+    def register(self, manager: Manager) -> None:
+        manager.register(
+            self,
+            for_kind="SlicePool",
+            owns=("StatefulSet",),
+            name="SlicePoolReconciler",
+        )
+
+    def reconcile(self, req: Request) -> Result:
+        try:
+            obj = self.client.get("SlicePool", req.name, req.namespace)
+        except NotFoundError:
+            self._drop_gauge(req.name)
+            return Result()  # placeholders go via ownerReference GC
+        if "deletionTimestamp" in obj["metadata"]:
+            self._drop_gauge(req.name)
+            return Result()
+        pool = sp.SlicePool(obj)
+
+        try:
+            topo = pool.tpu.slice_topology()
+        except InvalidTopologyError as err:
+            self.recorder.eventf(obj, "Warning", "InvalidTPUTopology", str(err))
+            pool.status["conditions"] = [
+                {
+                    "type": "TopologyValid",
+                    "status": "False",
+                    "reason": "InvalidTopology",
+                    "message": str(err),
+                }
+            ]
+            self.client.update_status(obj)
+            return Result()
+
+        owned = [
+            s
+            for s in self.client.list(
+                "StatefulSet", pool.namespace,
+                label_selector={sp.POOL_LABEL: pool.name},
+            )
+            if obj_util.is_controlled_by(obj, s)
+        ]
+        # Refill names never reuse a generation — not even a deleted one
+        # (on a real apiserver the claimed StatefulSet lingers while its
+        # cascade-delete runs; recreating the same name would fail). The
+        # high-water mark persists in status.
+        next_gen = max(
+            int(pool.status.get("generation", 0)),
+            1 + max((_generation_of(s) for s in owned), default=-1),
+        )
+        changed = False
+        while len(owned) < pool.warm_replicas:
+            desired = generate_warm_statefulset(pool, topo, next_gen)
+            obj_util.set_controller_reference(obj, desired)
+            try:
+                created = self.client.create(desired)
+                owned.append(created)
+                changed = True
+            except AlreadyExistsError:
+                pass  # stale cache; the next event re-reconciles
+            next_gen += 1
+        # Scale-down: retire the newest (least likely to be fully warm).
+        overs = sorted(owned, key=_generation_of)[pool.warm_replicas:]
+        for extra in overs:
+            try:
+                self.client.delete(
+                    "StatefulSet", obj_util.name_of(extra), pool.namespace
+                )
+                changed = True
+            except NotFoundError:
+                pass
+        kept = sorted(owned, key=_generation_of)[: pool.warm_replicas]
+
+        ready = sum(1 for s in kept if _sts_ready(s))
+        pool.status.update(
+            {
+                "generation": next_gen,
+                "warmReplicas": len(kept),
+                "readyReplicas": ready,
+                "conditions": [
+                    {
+                        "type": "TopologyValid",
+                        "status": "True",
+                        "reason": "Resolved",
+                        "message": f"{topo.accelerator_type} ({topo.hosts} hosts)",
+                    }
+                ],
+            }
+        )
+        self.client.update_status(obj)
+        if self.metrics is not None:
+            self.metrics.pool_warm_ready.labels(pool.name).set(ready)
+        if changed:
+            log.info(
+                "slicepool %s/%s: %d warm (%d ready)",
+                pool.namespace, pool.name, len(kept), ready,
+            )
+        return Result()
+
+    def _drop_gauge(self, pool_name: str) -> None:
+        """A deleted pool must not keep exporting its last warm count."""
+        if self.metrics is None:
+            return
+        try:
+            self.metrics.pool_warm_ready.remove(pool_name)
+        except KeyError:
+            pass  # never set for this pool
+
+
+def _generation_of(sts: dict) -> int:
+    name = obj_util.name_of(sts)
+    try:
+        return int(name.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return 0

@@ -173,6 +173,123 @@ def notebook_crd() -> dict:
     }
 
 
+def slicepool_crd() -> dict:
+    """SlicePool CRD (warm slice capacity; kubeflow_tpu.api.slicepool —
+    TPU-native, no reference counterpart)."""
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "required": ["tpu"],
+                "properties": {
+                    "tpu": _tpu_spec_schema(),
+                    "warmReplicas": {
+                        "type": "integer",
+                        "minimum": 0,
+                        "default": 1,
+                        "description": "Warm placeholder slices to maintain.",
+                    },
+                    "image": {
+                        "type": "string",
+                        "description": (
+                            "Workbench image the placeholders keep pulled "
+                            "on slice nodes."
+                        ),
+                    },
+                },
+            },
+            "status": {
+                "type": "object",
+                "properties": {
+                    "generation": {"type": "integer"},
+                    "warmReplicas": {"type": "integer"},
+                    "readyReplicas": {"type": "integer"},
+                    "conditions": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        },
+                    },
+                },
+            },
+        },
+    }
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"slicepools.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": "SlicePool",
+                "listKind": "SlicePoolList",
+                "plural": "slicepools",
+                "singular": "slicepool",
+            },
+            "scope": "Namespaced",
+            "conversion": {"strategy": "None"},
+            "versions": [
+                {
+                    "name": "v1",
+                    "served": True,
+                    "storage": True,
+                    "schema": {"openAPIV3Schema": schema},
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": [
+                        {
+                            "name": "Warm",
+                            "type": "integer",
+                            "jsonPath": ".status.warmReplicas",
+                        },
+                        {
+                            "name": "Ready",
+                            "type": "integer",
+                            "jsonPath": ".status.readyReplicas",
+                        },
+                        {
+                            "name": "Topology",
+                            "type": "string",
+                            "jsonPath": ".spec.tpu.topology",
+                        },
+                    ],
+                }
+            ],
+        },
+    }
+
+
+def placeholder_priority_class() -> dict:
+    """Negative priority for SlicePool placeholder pods: any
+    default-priority notebook pod preempts them, so a pool refill racing a
+    claiming notebook for the just-freed slice nodes always loses
+    (kubeflow_tpu.controller.slicepool)."""
+    return {
+        "apiVersion": "scheduling.k8s.io/v1",
+        "kind": "PriorityClass",
+        "metadata": {"name": "tpu-slicepool-placeholder"},
+        "value": -100,
+        "globalDefault": False,
+        "description": (
+            "Warm TPU slice placeholders; preempted by notebook workloads."
+        ),
+    }
+
+
+def sample_slicepool() -> dict:
+    return {
+        "apiVersion": f"{GROUP}/v1",
+        "kind": "SlicePool",
+        "metadata": {"name": "v5e-16-warm", "namespace": "default"},
+        "spec": {
+            "tpu": {"accelerator": "v5e", "topology": "4x4"},
+            "warmReplicas": 1,
+            "image": "jax-notebook:latest",
+        },
+    }
+
+
 # ---------------------------------------------------------------------------
 # RBAC
 
@@ -196,6 +313,8 @@ def core_cluster_role() -> dict:
             _rule([GROUP], [PLURAL], _ALL),
             _rule([GROUP], [f"{PLURAL}/status"], ["get", "patch", "update"]),
             _rule([GROUP], [f"{PLURAL}/finalizers"], ["update"]),
+            _rule([GROUP], ["slicepools"], _READ),
+            _rule([GROUP], ["slicepools/status"], ["get", "patch", "update"]),
             _rule(["apps"], ["statefulsets"], _ALL),
             _rule([""], ["services"], _ALL),
             _rule([""], ["pods"], _READ + ["delete"]),

@@ -33,6 +33,7 @@ class KindInfo:
 KINDS: dict[str, KindInfo] = {
     # kubeflow.org
     "Notebook": KindInfo("kubeflow.org", "v1beta1", "notebooks"),
+    "SlicePool": KindInfo("kubeflow.org", "v1", "slicepools"),
     # core
     "Pod": KindInfo("", "v1", "pods"),
     "Service": KindInfo("", "v1", "services"),

@@ -69,6 +69,22 @@ class Metrics:
             "TPU chips released by culling or stop",
             registry=self.registry,
         )
+        self.pool_claims_total = Counter(
+            "tpu_slicepool_claims_total",
+            "Warm slices claimed by notebook spawns",
+            registry=self.registry,
+        )
+        self.pool_claim_misses_total = Counter(
+            "tpu_slicepool_claim_misses_total",
+            "TPU notebook spawns that found no matching warm slice",
+            registry=self.registry,
+        )
+        self.pool_warm_ready = Gauge(
+            "tpu_slicepool_warm_ready",
+            "All-Ready warm placeholder slices per pool",
+            ["pool"],
+            registry=self.registry,
+        )
         self.running = Gauge(
             "notebook_running",
             "Currently running notebooks (replicas > 0)",

@@ -17,6 +17,7 @@ from kubeflow_tpu.controller.culling import CullerConfig, CullingReconciler, Hos
 from kubeflow_tpu.controller.notebook import ControllerConfig, NotebookReconciler
 from kubeflow_tpu.controller.platform import PlatformConfig, PlatformReconciler
 from kubeflow_tpu.controller.preemption import SliceHealthReconciler
+from kubeflow_tpu.controller.slicepool import SlicePoolReconciler
 from kubeflow_tpu.k8s.manager import FakeClock, Manager
 from kubeflow_tpu.metrics import Metrics
 from kubeflow_tpu.webhook import (
@@ -61,6 +62,7 @@ class Env:
     slice_health: Optional[SliceHealthReconciler]
     metrics: Metrics
     webhook: Optional[NotebookMutatingWebhook] = None
+    slicepool: Optional[SlicePoolReconciler] = None
 
 
 def make_env(
@@ -103,6 +105,9 @@ def make_env(
     )
     reconciler.register(manager)
 
+    pool_rec = SlicePoolReconciler(cluster, metrics=metrics)
+    pool_rec.register(manager)
+
     culler_rec = None
     prober = None
     if culling:
@@ -141,7 +146,7 @@ def make_env(
 
     return Env(
         cluster, manager, clock, kubelet, reconciler, culler_rec, prober, health,
-        metrics, webhook,
+        metrics, webhook, pool_rec,
     )
 
 

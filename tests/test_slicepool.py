@@ -1,0 +1,169 @@
+"""SlicePool tests: warm placeholder lifecycle + notebook claim path.
+
+TPU-native subsystem with no reference counterpart (the reference spawn
+path is always cold); the claim flow is asserted end-to-end through the
+envtest tier — pool warms a slice, notebook claims it, pods land on the
+freed capacity, pool refills.
+"""
+
+from kubeflow_tpu.api import slicepool as sp
+from kubeflow_tpu.api.notebook import TPUSpec
+from kubeflow_tpu.api.slicepool import new_slicepool
+from kubeflow_tpu.k8s import objects as obj_util
+from kubeflow_tpu.k8s.events import events_for
+
+from tests.harness import make_env, tpu_notebook
+
+
+def _pool(warm=1, topology="4x4", namespace="ns", name="pool"):
+    return new_slicepool(
+        name, namespace, TPUSpec(accelerator="v5e", topology=topology),
+        warm_replicas=warm,
+    )
+
+
+def _warm_stses(env, namespace="ns"):
+    return env.cluster.list(
+        "StatefulSet", namespace, label_selector={sp.STATE_LABEL: sp.STATE_WARM}
+    )
+
+
+class TestWarmPlaceholders:
+    def test_pool_provisions_warm_slices(self):
+        env = make_env()
+        env.cluster.create(_pool(warm=1))
+        env.manager.run_until_idle()
+
+        warm = _warm_stses(env)
+        assert len(warm) == 1
+        sts = warm[0]
+        spec = sts["spec"]["template"]["spec"]
+        c = spec["containers"][0]
+        assert c["resources"]["limits"]["google.com/tpu"] == "4"
+        assert spec["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x4"
+        assert sts["spec"]["replicas"] == 4
+        assert sts["spec"]["podManagementPolicy"] == "Parallel"
+        # Fake kubelet provisions the placeholder pods to Ready; status
+        # reflects a fully warm pool.
+        pool = env.cluster.get("SlicePool", "pool", "ns")
+        assert pool["status"]["readyReplicas"] == 1
+
+    def test_scale_down_retires_extras(self):
+        # Each 4x4 warm slice needs its own 4-host node pool.
+        env = make_env(
+            node_pools=(
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+            )
+        )
+        env.cluster.create(_pool(warm=2))
+        env.manager.run_until_idle()
+        assert len(_warm_stses(env)) == 2
+
+        pool = env.cluster.get("SlicePool", "pool", "ns")
+        pool["spec"]["warmReplicas"] = 1
+        env.cluster.update(pool)
+        env.manager.run_until_idle()
+        assert len(_warm_stses(env)) == 1
+
+    def test_invalid_topology_surfaces_condition(self):
+        env = make_env()
+        env.cluster.create(_pool(topology="9x9"))
+        env.manager.run_until_idle()
+        pool = env.cluster.get("SlicePool", "pool", "ns")
+        conds = {c["type"]: c["status"] for c in pool["status"]["conditions"]}
+        assert conds["TopologyValid"] == "False"
+        assert not _warm_stses(env)
+
+    def test_pool_deletion_collects_placeholders(self):
+        env = make_env()
+        env.cluster.create(_pool(warm=1))
+        env.manager.run_until_idle()
+        assert _warm_stses(env)
+        env.cluster.delete("SlicePool", "pool", "ns")
+        env.manager.run_until_idle()
+        assert not _warm_stses(env)
+
+
+class TestClaimPath:
+    def test_notebook_claims_warm_slice_on_contended_capacity(self):
+        """The core value proof: ONE slice's worth of nodes, fully held by
+        the warm placeholder. The claim must free it, the notebook's pods
+        must bind to the (already-provisioned) nodes, and the pool's
+        refill placeholder must queue behind them as Pending."""
+        env = make_env()  # one 4-host 4x4 pool
+        env.cluster.create(_pool(warm=1))
+        env.manager.run_until_idle()
+        warm_before = _warm_stses(env)
+        assert len(warm_before) == 1
+
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+
+        # Claimed placeholder is gone; a refill (new generation) exists.
+        warm_after = _warm_stses(env)
+        assert len(warm_after) == 1
+        assert obj_util.name_of(warm_after[0]) != obj_util.name_of(warm_before[0])
+
+        # The notebook got the capacity: all 4 hosts Ready.
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["status"]["readyReplicas"] == 4
+        assert nb["metadata"]["annotations"][sp.CLAIMED_FROM] == "pool"
+        assert any(
+            e["reason"] == "ClaimedWarmSlice"
+            for e in events_for(env.cluster, "Notebook", "nb", "ns")
+        )
+        # The refill is Pending (capacity now belongs to the notebook).
+        refill = env.cluster.get("StatefulSet", obj_util.name_of(warm_after[0]), "ns")
+        assert refill.get("status", {}).get("readyReplicas", 0) == 0
+
+        text = env.metrics.expose().decode()
+        assert "tpu_slicepool_claims_total 1.0" in text
+
+    def test_topology_mismatch_is_a_miss(self):
+        env = make_env(
+            node_pools=(
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+                ("tpu-v5-lite-podslice", "2x2", 1, 4),
+            )
+        )
+        env.cluster.create(_pool(warm=1, topology="2x2"))
+        env.manager.run_until_idle()
+
+        env.cluster.create(tpu_notebook())  # wants 4x4; pool holds 2x2
+        env.manager.run_until_idle()
+
+        assert len(_warm_stses(env)) == 1  # untouched
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert sp.CLAIMED_FROM not in nb["metadata"].get("annotations", {})
+        text = env.metrics.expose().decode()
+        assert "tpu_slicepool_claim_misses_total 1.0" in text
+
+    def test_no_pools_no_metrics_noise(self):
+        env = make_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        text = env.metrics.expose().decode()
+        assert "tpu_slicepool_claim_misses_total 0.0" in text
+        assert "tpu_slicepool_claims_total 0.0" in text
+
+    def test_claim_happens_once_not_per_reconcile(self):
+        env = make_env(
+            node_pools=(
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+            )
+        )
+        env.cluster.create(_pool(warm=2))
+        env.manager.run_until_idle()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        # Touch the notebook to force more reconciles.
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.set_annotation(nb, "touch", "1")
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+
+        text = env.metrics.expose().decode()
+        assert "tpu_slicepool_claims_total 1.0" in text
+        assert len(_warm_stses(env)) == 2  # claimed one refilled, other kept
