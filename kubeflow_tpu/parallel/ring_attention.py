@@ -68,7 +68,6 @@ def ring_attention(
     b, h, sq_local, d = q.shape
     sk_local = k.shape[2]
     scale = 1.0 / math.sqrt(d)
-    qf = q.astype(jnp.float32) * scale
 
     perm = [(i, (i + 1) % n) for i in range(n)]
     q_pos = q_offset + my_idx * sq_local + jnp.arange(sq_local)[:, None]
@@ -77,10 +76,12 @@ def ring_attention(
         m, l, o, k_cur, v_cur, mask_cur = carry
         # The chunk we currently hold originated on device (my_idx - step).
         chunk_idx = (my_idx - step_idx) % n
+        # Native-dtype MXU operands (bf16 in training — f32 operands would
+        # quarter the matmul rate), f32 accumulation + scale.
         s = jnp.einsum(
-            "bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32),
+            "bhqd,bhkd->bhqk", q, k_cur,
             preferred_element_type=jnp.float32,
-        )
+        ) * scale
         k_pos = chunk_idx * sk_local + jnp.arange(sk_local)[None, :]
         if causal or window:
             mask = (k_pos <= q_pos) if causal else jnp.ones_like(k_pos <= q_pos)
@@ -94,7 +95,7 @@ def ring_attention(
         p = jnp.exp(s - m_new[..., None])
         l_new = l * alpha + jnp.sum(p, axis=-1)
         o_new = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+            "bhqk,bhkd->bhqd", p.astype(v_cur.dtype), v_cur,
             preferred_element_type=jnp.float32,
         )
         # Rotate K/V (and the key-validity mask with them) to the next
@@ -141,10 +142,10 @@ def sp_decode_attention(
     b, h, sq, d = q.shape
     skl = k.shape[2]
     scale = 1.0 / math.sqrt(d)
+    # Native-dtype MXU operands, f32 accumulation (see ring step).
     s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale,
-        k.astype(jnp.float32), preferred_element_type=jnp.float32,
-    )
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32,
+    ) * scale
     pos = jnp.asarray(position)
     if pos.ndim == 0:
         pos = jnp.broadcast_to(pos, (sq,))
@@ -163,7 +164,7 @@ def sp_decode_attention(
     l = jax.lax.psum(jnp.sum(p, axis=-1), axis_name)
     o = jax.lax.psum(
         jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+            "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
             preferred_element_type=jnp.float32,
         ),
         axis_name,
