@@ -221,6 +221,43 @@ def run_full_bench(results: list) -> None:
             f"({flops / t / 1e12:.1f} TFLOP/s, {batch * seq / t:.0f} tokens/sec)",
         )
 
+    def batched_section():
+        # Batched-serving throughput: the continuous-batching stack's
+        # steady-state decode rate at bs=8 on int8 weights (bf16 7B +
+        # an 8-slot cache does not fit 16 GB). Two-point measurement
+        # cancels prefill; eos_id=-1 disables retirement so all 8 slots
+        # decode every step.
+        from kubeflow_tpu.models.quant import quantize_params
+        from kubeflow_tpu.models.serving import GenerationConfig, batch_generate
+
+        cfg = L.LLAMA_CONFIGS["llama-2-7b"]
+        params = quantize_params(
+            L.init_params(cfg, jax.random.PRNGKey(0)), free_source=True
+        )
+        bs, plen = 8, 128
+        rng = jax.random.randint(
+            jax.random.PRNGKey(1), (bs, plen), 3, cfg.vocab_size
+        )
+        prompts = [list(map(int, row)) for row in rng]
+
+        def timed(steps: int) -> float:
+            g = GenerationConfig(max_new_tokens=steps, eos_id=-1)
+            batch_generate(params, cfg, prompts, g)  # compile + warm
+            times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                batch_generate(params, cfg, prompts, g)
+                times.append(time.perf_counter() - t0)
+            return min(times)
+
+        t1, t2 = timed(64), timed(128)
+        tok_s = bs * 64 / (t2 - t1)
+        report(
+            f"llama-2-7b int8 batched decode tokens/sec/chip (bs={bs})",
+            tok_s, "tokens/sec",
+            "(continuous-batching steady state, all slots active)",
+        )
+
     def prefill_section():
         cfg = L.LLAMA_CONFIGS["llama-2-7b"]
         params = L.init_params(cfg, jax.random.PRNGKey(0))
@@ -242,6 +279,7 @@ def run_full_bench(results: list) -> None:
     section(kernel_section)
     section(masked_kernel_section)
     section(train_section)
+    section(batched_section)
     # 7B prefill LAST: it holds the most HBM, and its OOM on a small chip
     # must not rob the sections above of their measurement.
     section(prefill_section)
