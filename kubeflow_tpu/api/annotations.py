@@ -52,6 +52,13 @@ TPU_RESOLVED_TOPOLOGY = "notebooks.kubeflow.org/tpu-resolved-topology"
 TPU_QUANTIZATION = "notebooks.kubeflow.org/tpu-quantization"
 TPU_QUANTIZATION_VALUES = ("int8", "int4", "bf16")
 QUANT_ENV_NAME = "KUBEFLOW_TPU_QUANT"
+# Profiling runtime option: a port number makes runtime.bootstrap start
+# jax.profiler.start_server on it; the controller surfaces the worker-0
+# address as status.tpu.profilingServer and the ctrl NetworkPolicy opens
+# the port to the controller/gateway namespaces (xprof/TensorBoard connect
+# through a port-forward or the gateway).
+TPU_PROFILING_PORT = "notebooks.kubeflow.org/tpu-profiling-port"
+PROFILING_ENV_NAME = "KUBEFLOW_TPU_PROFILING_PORT"
 
 # -- labels ------------------------------------------------------------------
 NOTEBOOK_NAME_LABEL = "notebook-name"

@@ -12,6 +12,7 @@ debug.
 
 from __future__ import annotations
 
+from kubeflow_tpu.api import annotations as ann
 from kubeflow_tpu.api.names import NOTEBOOK_PORT, RBAC_PROXY_PORT
 from kubeflow_tpu.api.notebook import Notebook
 from kubeflow_tpu.controller import reconcilehelper as helper
@@ -65,11 +66,24 @@ def new_ctrl_policy(
             "ingress": [
                 {
                     "from": peers,
-                    "ports": [{"protocol": "TCP", "port": NOTEBOOK_PORT}],
+                    "ports": [
+                        {"protocol": "TCP", "port": p}
+                        for p in _allowed_ports(nb)
+                    ],
                 }
             ],
         },
     }
+
+
+def _allowed_ports(nb: Notebook) -> list[int]:
+    """8888 always; the profiling-port annotation opens the jax.profiler
+    server to the same peers (xprof connects via port-forward/gateway)."""
+    ports = [NOTEBOOK_PORT]
+    prof = nb.annotations.get(ann.TPU_PROFILING_PORT, "")
+    if prof.isdigit() and 1024 <= int(prof) <= 65535:
+        ports.append(int(prof))
+    return ports
 
 
 def new_proxy_policy(nb: Notebook) -> dict:

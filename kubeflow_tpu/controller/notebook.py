@@ -371,6 +371,15 @@ class NotebookReconciler(Reconciler):
                     f"{nb.namespace}.svc.{self.config.cluster_domain}"
                     f":{JAX_COORDINATOR_PORT}"
                 )
+            prof = nb.annotations.get(ann.TPU_PROFILING_PORT, "")
+            if prof.isdigit():
+                # Worker 0 runs jax.profiler.start_server on this port
+                # (runtime.bootstrap consumes the webhook-injected env).
+                status["tpu"]["profilingServer"] = (
+                    f"{slice_sts_name(nb.name, 0)}-0."
+                    f"{headless_service_name(nb.name)}."
+                    f"{nb.namespace}.svc.{self.config.cluster_domain}:{prof}"
+                )
             if health == "Healthy":
                 self._observe_slice_ready(nb)
 

@@ -68,6 +68,7 @@ def _tpu_status_schema() -> dict:
             },
             "acceleratorType": {"type": "string"},
             "jaxCoordinator": {"type": "string"},
+            "profilingServer": {"type": "string"},
             "slices": {"type": "integer"},
             "hostsPerSlice": {"type": "integer"},
         },

@@ -182,4 +182,30 @@ def bootstrap(
                 "(check Notebook status.tpu.readyHosts) or "
                 "jax.distributed did not reach every worker."
             )
+    maybe_start_profiler_server(env)
     return rt
+
+
+_PROFILER_STARTED = False
+
+
+def maybe_start_profiler_server(env: Optional[dict] = None) -> Optional[int]:
+    """Start jax.profiler.start_server on KUBEFLOW_TPU_PROFILING_PORT (the
+    webhook projects the tpu-profiling-port annotation into it; the
+    controller surfaces worker-0's address as status.tpu.profilingServer).
+    Idempotent per process — start_server raises if called twice. Returns
+    the port, or None when profiling is not configured."""
+    global _PROFILER_STARTED
+    import os
+
+    env = env if env is not None else dict(os.environ)
+    value = env.get("KUBEFLOW_TPU_PROFILING_PORT", "")
+    if not value:
+        return None
+    port = int(value)
+    if not _PROFILER_STARTED:
+        import jax
+
+        jax.profiler.start_server(port)
+        _PROFILER_STARTED = True
+    return port

@@ -111,6 +111,7 @@ class NotebookMutatingWebhook:
             self._resolve_image_from_registry(nb, span)
             self._inject_tpu(nb)
             self._handle_quant_env(nb)
+            self._handle_profiling_env(nb)
             mounts.check_and_mount_ca_bundle(nb, self.client)
             mounts.mount_runtime_images(nb, self.client)
             if self.config.set_pipeline_secret:
@@ -173,6 +174,21 @@ class NotebookMutatingWebhook:
             remove_env(container, {ann.QUANT_ENV_NAME})
             return
         upsert_env(container, [{"name": ann.QUANT_ENV_NAME, "value": value}])
+
+    def _handle_profiling_env(self, nb: Notebook) -> None:
+        """Project the profiling-port annotation into the env consumed by
+        runtime.bootstrap (jax.profiler.start_server). Invalid values are
+        denied by the validating webhook; never propagate them here."""
+        container = nb.primary_container()
+        if container is None:
+            return
+        value = nb.annotations.get(ann.TPU_PROFILING_PORT, "")
+        if not value.isdigit() or not 1024 <= int(value) <= 65535:
+            remove_env(container, {ann.PROFILING_ENV_NAME})
+            return
+        upsert_env(
+            container, [{"name": ann.PROFILING_ENV_NAME, "value": value}]
+        )
 
     def _resolve_image_from_registry(self, nb: Notebook, span=None) -> None:
         """Resolve "imagestream:tag" annotations to a digested image ref

@@ -46,6 +46,18 @@ class NotebookValidatingWebhook:
                 f"(want one of {', '.join(ann.TPU_QUANTIZATION_VALUES)})"
             )
 
+        prof = nb.annotations.get(ann.TPU_PROFILING_PORT)
+        if prof is not None:
+            try:
+                port = int(prof)
+            except ValueError:
+                port = -1
+            if not 1024 <= port <= 65535:
+                raise WebhookDeniedError(
+                    f"annotation {ann.TPU_PROFILING_PORT}: {prof!r} is not "
+                    "a port in 1024..65535"
+                )
+
         if req.operation != "UPDATE" or req.old_object is None:
             return
         old = Notebook(req.old_object)

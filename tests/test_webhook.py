@@ -145,6 +145,63 @@ class TestQuantizationOption:
             quant_bits_from_env()
 
 
+class TestProfilingOption:
+    def test_annotation_projects_env_and_status_address(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(
+            tpu_notebook(annotations={ann.TPU_PROFILING_PORT: "9012"})
+        )
+        env.manager.run_until_idle()
+        _, c = primary(env)
+        assert get_env_var(c, ann.PROFILING_ENV_NAME)["value"] == "9012"
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["status"]["tpu"]["profilingServer"] == (
+            "nb-0.nb-hosts.ns.svc.cluster.local:9012"
+        )
+
+    def test_network_policy_opens_profiling_port(self):
+        env = make_env(webhooks=True, platform=True)
+        env.cluster.create(
+            tpu_notebook(annotations={ann.TPU_PROFILING_PORT: "9012"})
+        )
+        env.manager.run_until_idle()
+        np_obj = env.cluster.get("NetworkPolicy", "nb-ctrl-np", "ns")
+        ports = [
+            p["port"] for rule in np_obj["spec"]["ingress"]
+            for p in rule["ports"]
+        ]
+        assert 8888 in ports and 9012 in ports
+
+    def test_invalid_port_denied(self):
+        env = make_env(webhooks=True)
+        for bad in ("80", "notaport", "70000"):
+            with pytest.raises(WebhookDeniedError, match="not a port"):
+                env.cluster.create(
+                    tpu_notebook(annotations={ann.TPU_PROFILING_PORT: bad})
+                )
+
+    def test_bootstrap_starts_profiler_server(self, monkeypatch):
+        # runtime/__init__ re-exports the bootstrap FUNCTION under the same
+        # name, shadowing the submodule attribute; resolve the module.
+        import importlib
+
+        bs = importlib.import_module("kubeflow_tpu.runtime.bootstrap")
+
+        started = []
+        monkeypatch.setattr(bs, "_PROFILER_STARTED", False)
+        import jax
+
+        monkeypatch.setattr(jax.profiler, "start_server", started.append)
+        assert bs.maybe_start_profiler_server({}) is None
+        port = bs.maybe_start_profiler_server(
+            {ann.PROFILING_ENV_NAME: "9012"}
+        )
+        assert port == 9012 and started == [9012]
+        # Idempotent: a notebook cell re-run must not raise.
+        bs.maybe_start_profiler_server({ann.PROFILING_ENV_NAME: "9012"})
+        assert started == [9012]
+
+
 class TestImageResolution:
     def _imagestream(self, env):
         env.cluster.create(
