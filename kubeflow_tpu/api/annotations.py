@@ -60,6 +60,18 @@ QUANT_ENV_NAME = "KUBEFLOW_TPU_QUANT"
 TPU_PROFILING_PORT = "notebooks.kubeflow.org/tpu-profiling-port"
 PROFILING_ENV_NAME = "KUBEFLOW_TPU_PROFILING_PORT"
 
+
+def parse_profiling_port(value) -> "int | None":
+    """THE one parser for the profiling port (webhooks, NetworkPolicy,
+    status, bootstrap all share it): a port in 1024..65535, else None.
+    int() rather than isdigit() — Unicode digits like '²' pass isdigit()
+    but crash int(), and an admission path must deny cleanly, not 500."""
+    try:
+        port = int(str(value).strip())
+    except (TypeError, ValueError):
+        return None
+    return port if 1024 <= port <= 65535 else None
+
 # -- labels ------------------------------------------------------------------
 NOTEBOOK_NAME_LABEL = "notebook-name"
 ODH_DASHBOARD_LABEL = "opendatahub.io/dashboard"

@@ -38,6 +38,11 @@ STATE_WARM = "warm"
 # Annotation recorded on the Notebook when its slice came from a pool.
 CLAIMED_FROM = "notebooks.kubeflow.org/claimed-from-pool"
 
+# Demand signals stamped ON THE POOL (unix seconds, as strings) by the
+# notebook reconciler's claim path; the autoscaler keys off them.
+LAST_CLAIM = "slicepools.kubeflow.org/last-claim"
+LAST_MISS = "slicepools.kubeflow.org/last-miss"
+
 
 class SlicePool:
     """Typed view over a dict-shaped SlicePool object."""
@@ -60,6 +65,26 @@ class SlicePool:
     @property
     def warm_replicas(self) -> int:
         return int(self.obj.get("spec", {}).get("warmReplicas", 1))
+
+    @property
+    def autoscale(self) -> Optional[dict]:
+        """{"min", "max", "scaleDownAfterSeconds"} or None (fixed-size
+        pool). When set, it REPLACES warmReplicas: the warm target starts
+        at min, grows by one per claim-miss (up to max), and decays by one
+        per idle scaleDownAfterSeconds (down to min). min=0 makes the pool
+        purely demand-driven."""
+        spec = self.obj.get("spec", {}).get("autoscale")
+        if not spec:
+            return None
+        lo = int(spec.get("min", 0))
+        # min > max is normalized to max = min (a CRD schema cannot express
+        # the cross-field constraint; pinning the target above max forever
+        # would be worse than honoring the larger bound).
+        return {
+            "min": lo,
+            "max": max(lo, int(spec.get("max", 1))),
+            "scaleDownAfterSeconds": int(spec.get("scaleDownAfterSeconds", 600)),
+        }
 
     @property
     def image(self) -> str:

@@ -116,7 +116,7 @@ def build(
 
     # Warm slice pools: inert without SlicePool CRs, so always registered
     # (mirrors how Owns-watches cost nothing until objects exist).
-    pools = SlicePoolReconciler(cluster, metrics=metrics)
+    pools = SlicePoolReconciler(cluster, metrics=metrics, clock=manager.clock)
     pools.register(manager)
 
     culler: Optional[CullingReconciler] = None

@@ -80,9 +80,9 @@ def _allowed_ports(nb: Notebook) -> list[int]:
     """8888 always; the profiling-port annotation opens the jax.profiler
     server to the same peers (xprof connects via port-forward/gateway)."""
     ports = [NOTEBOOK_PORT]
-    prof = nb.annotations.get(ann.TPU_PROFILING_PORT, "")
-    if prof.isdigit() and 1024 <= int(prof) <= 65535:
-        ports.append(int(prof))
+    prof = ann.parse_profiling_port(nb.annotations.get(ann.TPU_PROFILING_PORT))
+    if prof is not None:
+        ports.append(prof)
     return ports
 
 

@@ -240,7 +240,7 @@ class NotebookReconciler(Reconciler):
             return  # namespace doesn't use pools; keep metrics quiet
         pool = claim_warm_slice(
             self.client, nb.namespace, topo, recorder=self.recorder,
-            notebook=obj,
+            notebook=obj, now=self.clock(),
         )
         if not pool:
             self.metrics.pool_claim_misses_total.inc()
@@ -371,8 +371,10 @@ class NotebookReconciler(Reconciler):
                     f"{nb.namespace}.svc.{self.config.cluster_domain}"
                     f":{JAX_COORDINATOR_PORT}"
                 )
-            prof = nb.annotations.get(ann.TPU_PROFILING_PORT, "")
-            if prof.isdigit():
+            prof = ann.parse_profiling_port(
+                nb.annotations.get(ann.TPU_PROFILING_PORT)
+            )
+            if prof is not None:
                 # Worker 0 runs jax.profiler.start_server on this port
                 # (runtime.bootstrap consumes the webhook-injected env).
                 status["tpu"]["profilingServer"] = (

@@ -20,13 +20,14 @@ Mechanics per ``kubeflow_tpu.api.slicepool``:
 from __future__ import annotations
 
 import logging
-from typing import Optional
+import time
+from typing import Callable, Optional
 
 from kubeflow_tpu.api import slicepool as sp
 from kubeflow_tpu.api.names import derived_name
 from kubeflow_tpu.api.notebook import MAX_NAME_LENGTH
 from kubeflow_tpu.k8s import objects as obj_util
-from kubeflow_tpu.k8s.client import Client
+from kubeflow_tpu.k8s.client import Client, retry_on_conflict
 from kubeflow_tpu.k8s.errors import AlreadyExistsError, NotFoundError
 from kubeflow_tpu.k8s.events import EventRecorder
 from kubeflow_tpu.k8s.manager import Manager, Reconciler, Request, Result
@@ -119,6 +120,7 @@ def claim_warm_slice(
     topo: SliceTopology,
     recorder: Optional[EventRecorder] = None,
     notebook: Optional[dict] = None,
+    now: Optional[float] = None,
 ) -> Optional[str]:
     """Claim one warm placeholder matching (accelerator, topology).
 
@@ -127,6 +129,10 @@ def claim_warm_slice(
     falls back to a still-warming one — even a partially-provisioned
     placeholder beats a cold node-pool scale-up. Deleting the StatefulSet
     cascades to its pods, releasing chips for the notebook's pods.
+
+    Demand signals for the autoscaler: a successful claim stamps
+    LAST_CLAIM on the owning pool; a miss stamps LAST_MISS on every
+    topology-matching pool in the namespace (callers pass ``now``).
     """
     candidates = client.list(
         "StatefulSet",
@@ -156,8 +162,49 @@ def claim_warm_slice(
                 f"Claimed warm slice {obj_util.name_of(chosen)} from pool "
                 f"{pool_name} ({topo.accelerator_type})",
             )
+        if now is not None and pool_name:
+            _stamp(client, namespace, [pool_name], sp.LAST_CLAIM, now)
         return pool_name or None
+    if now is not None:
+        matching = [
+            obj_util.name_of(p)
+            for p in client.list("SlicePool", namespace)
+            if _pool_matches(p, topo)
+        ]
+        _stamp(client, namespace, matching, sp.LAST_MISS, now)
     return None
+
+
+def _pool_matches(pool_obj: dict, topo: SliceTopology) -> bool:
+    try:
+        pt = sp.SlicePool(pool_obj).tpu.slice_topology()
+    except Exception:
+        return False
+    return (
+        pt.accelerator_type == topo.accelerator_type
+        and pt.topology_str == topo.topology_str
+    )
+
+
+def _stamp(
+    client: Client, namespace: str, pool_names: list, key: str, now: float
+) -> None:
+    """Demand-signal write. Conflicts are RETRIED (the usual conflicting
+    writer is the pool reconciler updating status — losing the race must
+    not lose the miss/claim signal); only a deleted pool is skipped.
+    Stamps keep full float precision so a signal in the same second as a
+    scale event still orders correctly against status.lastScaleTime."""
+    for name in pool_names:
+
+        def write(name=name):
+            try:
+                pool = client.get("SlicePool", name, namespace)
+            except NotFoundError:
+                return
+            obj_util.set_annotation(pool, key, str(now))
+            client.update(pool)
+
+        retry_on_conflict(write)
 
 
 class SlicePoolReconciler(Reconciler):
@@ -168,10 +215,12 @@ class SlicePoolReconciler(Reconciler):
         client: Client,
         metrics: Optional[Metrics] = None,
         recorder: Optional[EventRecorder] = None,
+        clock: Optional[Callable[[], float]] = None,
     ):
         self.client = client
         self.metrics = metrics
         self.recorder = recorder or EventRecorder(client)
+        self.clock = clock or time.time
 
     def register(self, manager: Manager) -> None:
         manager.register(
@@ -207,6 +256,8 @@ class SlicePoolReconciler(Reconciler):
             self.client.update_status(obj)
             return Result()
 
+        warm_target, requeue, scale_status = self._warm_target(pool)
+
         owned = [
             s
             for s in self.client.list(
@@ -224,7 +275,7 @@ class SlicePoolReconciler(Reconciler):
             1 + max((_generation_of(s) for s in owned), default=-1),
         )
         changed = False
-        while len(owned) < pool.warm_replicas:
+        while len(owned) < warm_target:
             desired = generate_warm_statefulset(pool, topo, next_gen)
             obj_util.set_controller_reference(obj, desired)
             try:
@@ -235,7 +286,7 @@ class SlicePoolReconciler(Reconciler):
                 pass  # stale cache; the next event re-reconciles
             next_gen += 1
         # Scale-down: retire the newest (least likely to be fully warm).
-        overs = sorted(owned, key=_generation_of)[pool.warm_replicas:]
+        overs = sorted(owned, key=_generation_of)[warm_target:]
         for extra in overs:
             try:
                 self.client.delete(
@@ -244,7 +295,7 @@ class SlicePoolReconciler(Reconciler):
                 changed = True
             except NotFoundError:
                 pass
-        kept = sorted(owned, key=_generation_of)[: pool.warm_replicas]
+        kept = sorted(owned, key=_generation_of)[:warm_target]
 
         ready = sum(1 for s in kept if _sts_ready(s))
         pool.status.update(
@@ -260,6 +311,7 @@ class SlicePoolReconciler(Reconciler):
                         "message": f"{topo.accelerator_type} ({topo.hosts} hosts)",
                     }
                 ],
+                **scale_status,
             }
         )
         self.client.update_status(obj)
@@ -270,7 +322,48 @@ class SlicePoolReconciler(Reconciler):
                 "slicepool %s/%s: %d warm (%d ready)",
                 pool.namespace, pool.name, len(kept), ready,
             )
-        return Result()
+        return Result(requeue_after=requeue)
+
+    def _warm_target(self, pool: sp.SlicePool) -> tuple[int, float, dict]:
+        """(warm target, requeue seconds, status fields).
+
+        Fixed pools: spec.warmReplicas, no requeue. Autoscaled pools: the
+        target persists in status and moves one step per reconcile — up
+        when a miss postdates the last scale event (demand outran the
+        pool), down after scaleDownAfterSeconds with no claim/miss (the
+        periodic requeue is what notices pure idleness).
+        """
+        auto = pool.autoscale
+        if auto is None:
+            return pool.warm_replicas, 0.0, {}
+        lo, hi = auto["min"], auto["max"]
+        cooldown = auto["scaleDownAfterSeconds"]
+        now = self.clock()
+        target = int(pool.status.get("autoscaleTarget", lo))
+        target = max(lo, min(hi, target))
+        last_scale = float(pool.status.get("lastScaleTime", 0))
+
+        def stamp(key):
+            value = pool.obj.get("metadata", {}).get("annotations", {}).get(key)
+            try:
+                return float(value)
+            except (TypeError, ValueError):
+                return 0.0
+
+        last_miss, last_claim = stamp(sp.LAST_MISS), stamp(sp.LAST_CLAIM)
+        if last_miss > last_scale and target < hi:
+            target += 1
+            last_scale = now
+        elif (
+            target > lo
+            and now - max(last_miss, last_claim, last_scale) >= cooldown
+        ):
+            target -= 1
+            last_scale = now
+        return target, float(cooldown), {
+            "autoscaleTarget": target,
+            "lastScaleTime": last_scale,
+        }
 
     def _drop_gauge(self, pool_name: str) -> None:
         """A deleted pool must not keep exporting its last warm count."""

@@ -198,6 +198,23 @@ def slicepool_crd() -> dict:
                             "on slice nodes."
                         ),
                     },
+                    "autoscale": {
+                        "type": "object",
+                        "description": (
+                            "Replaces warmReplicas with a demand-driven "
+                            "target: min..max, +1 per claim miss, -1 per "
+                            "idle scaleDownAfterSeconds."
+                        ),
+                        "properties": {
+                            "min": {"type": "integer", "minimum": 0},
+                            "max": {"type": "integer", "minimum": 0},
+                            "scaleDownAfterSeconds": {
+                                "type": "integer",
+                                "minimum": 1,
+                                "default": 600,
+                            },
+                        },
+                    },
                 },
             },
             "status": {
@@ -206,6 +223,8 @@ def slicepool_crd() -> dict:
                     "generation": {"type": "integer"},
                     "warmReplicas": {"type": "integer"},
                     "readyReplicas": {"type": "integer"},
+                    "autoscaleTarget": {"type": "integer"},
+                    "lastScaleTime": {"type": "number"},
                     "conditions": {
                         "type": "array",
                         "items": {

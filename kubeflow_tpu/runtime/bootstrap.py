@@ -186,26 +186,40 @@ def bootstrap(
     return rt
 
 
-_PROFILER_STARTED = False
+_PROFILER_PORT: Optional[int] = None
 
 
 def maybe_start_profiler_server(env: Optional[dict] = None) -> Optional[int]:
     """Start jax.profiler.start_server on KUBEFLOW_TPU_PROFILING_PORT (the
     webhook projects the tpu-profiling-port annotation into it; the
     controller surfaces worker-0's address as status.tpu.profilingServer).
-    Idempotent per process — start_server raises if called twice. Returns
-    the port, or None when profiling is not configured."""
-    global _PROFILER_STARTED
+    Idempotent per process — start_server raises if called twice, so the
+    STARTED port is remembered and returned; asking for a different port
+    after one is running raises instead of lying about where the server
+    listens. Returns the listening port, or None when not configured."""
+    global _PROFILER_PORT
     import os
+
+    from kubeflow_tpu.api.annotations import parse_profiling_port
 
     env = env if env is not None else dict(os.environ)
     value = env.get("KUBEFLOW_TPU_PROFILING_PORT", "")
     if not value:
         return None
-    port = int(value)
-    if not _PROFILER_STARTED:
-        import jax
+    port = parse_profiling_port(value)
+    if port is None:
+        raise ValueError(
+            f"KUBEFLOW_TPU_PROFILING_PORT={value!r}: not a port in 1024..65535"
+        )
+    if _PROFILER_PORT is not None:
+        if _PROFILER_PORT != port:
+            raise RuntimeError(
+                f"profiler server already listens on {_PROFILER_PORT}; "
+                f"cannot move it to {port} in this process"
+            )
+        return _PROFILER_PORT
+    import jax
 
-        jax.profiler.start_server(port)
-        _PROFILER_STARTED = True
+    jax.profiler.start_server(port)
+    _PROFILER_PORT = port
     return port

@@ -182,12 +182,14 @@ class NotebookMutatingWebhook:
         container = nb.primary_container()
         if container is None:
             return
-        value = nb.annotations.get(ann.TPU_PROFILING_PORT, "")
-        if not value.isdigit() or not 1024 <= int(value) <= 65535:
+        port = ann.parse_profiling_port(
+            nb.annotations.get(ann.TPU_PROFILING_PORT)
+        )
+        if port is None:
             remove_env(container, {ann.PROFILING_ENV_NAME})
             return
         upsert_env(
-            container, [{"name": ann.PROFILING_ENV_NAME, "value": value}]
+            container, [{"name": ann.PROFILING_ENV_NAME, "value": str(port)}]
         )
 
     def _resolve_image_from_registry(self, nb: Notebook, span=None) -> None:

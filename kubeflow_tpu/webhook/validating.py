@@ -47,16 +47,11 @@ class NotebookValidatingWebhook:
             )
 
         prof = nb.annotations.get(ann.TPU_PROFILING_PORT)
-        if prof is not None:
-            try:
-                port = int(prof)
-            except ValueError:
-                port = -1
-            if not 1024 <= port <= 65535:
-                raise WebhookDeniedError(
-                    f"annotation {ann.TPU_PROFILING_PORT}: {prof!r} is not "
-                    "a port in 1024..65535"
-                )
+        if prof is not None and ann.parse_profiling_port(prof) is None:
+            raise WebhookDeniedError(
+                f"annotation {ann.TPU_PROFILING_PORT}: {prof!r} is not "
+                "a port in 1024..65535"
+            )
 
         if req.operation != "UPDATE" or req.old_object is None:
             return

@@ -105,7 +105,7 @@ def make_env(
     )
     reconciler.register(manager)
 
-    pool_rec = SlicePoolReconciler(cluster, metrics=metrics)
+    pool_rec = SlicePoolReconciler(cluster, metrics=metrics, clock=clock)
     pool_rec.register(manager)
 
     culler_rec = None

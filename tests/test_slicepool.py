@@ -85,6 +85,71 @@ class TestWarmPlaceholders:
         assert not _warm_stses(env)
 
 
+class TestAutoscale:
+    def _auto_pool(self, lo=0, hi=2, cooldown=300):
+        obj = _pool(warm=1)
+        obj["spec"]["autoscale"] = {
+            "min": lo, "max": hi, "scaleDownAfterSeconds": cooldown,
+        }
+        return obj
+
+    def test_demand_driven_from_zero(self):
+        """min=0: no warm capacity until a miss proves demand; the next
+        notebook after the miss finds a warm slice."""
+        env = make_env(
+            node_pools=(
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+            )
+        )
+        env.cluster.create(self._auto_pool())
+        env.manager.run_until_idle()
+        assert not _warm_stses(env)  # min=0 → nothing warm yet
+
+        env.cluster.create(tpu_notebook(name="nb1"))  # miss → demand signal
+        env.manager.run_until_idle()
+        nb1 = env.cluster.get("Notebook", "nb1", "ns")
+        assert sp.CLAIMED_FROM not in nb1["metadata"].get("annotations", {})
+        pool = env.cluster.get("SlicePool", "pool", "ns")
+        assert pool["status"]["autoscaleTarget"] == 1
+        assert len(_warm_stses(env)) == 1
+
+        env.cluster.create(tpu_notebook(name="nb2"))  # hit
+        env.manager.run_until_idle()
+        nb2 = env.cluster.get("Notebook", "nb2", "ns")
+        assert nb2["metadata"]["annotations"][sp.CLAIMED_FROM] == "pool"
+
+    def test_idle_scale_down_after_cooldown(self):
+        env = make_env()
+        env.cluster.create(self._auto_pool(lo=0, hi=2, cooldown=300))
+        env.manager.run_until_idle()
+        # Force demand, then let it go idle.
+        env.cluster.create(tpu_notebook(name="nb1"))
+        env.manager.run_until_idle()
+        pool = env.cluster.get("SlicePool", "pool", "ns")
+        assert pool["status"]["autoscaleTarget"] == 1
+
+        env.manager.tick(301)  # periodic requeue notices idleness
+        env.manager.run_until_idle()
+        pool = env.cluster.get("SlicePool", "pool", "ns")
+        assert pool["status"]["autoscaleTarget"] == 0
+        assert not _warm_stses(env)
+
+    def test_capped_at_max(self):
+        env = make_env(
+            node_pools=tuple(
+                ("tpu-v5-lite-podslice", "4x4", 4, 4) for _ in range(3)
+            )
+        )
+        env.cluster.create(self._auto_pool(lo=0, hi=1))
+        env.manager.run_until_idle()
+        for i in range(3):  # repeated misses
+            env.cluster.create(tpu_notebook(name=f"nb{i}"))
+            env.manager.run_until_idle()
+        pool = env.cluster.get("SlicePool", "pool", "ns")
+        assert pool["status"]["autoscaleTarget"] == 1
+
+
 class TestClaimPath:
     def test_notebook_claims_warm_slice_on_contended_capacity(self):
         """The core value proof: ONE slice's worth of nodes, fully held by

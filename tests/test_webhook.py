@@ -188,7 +188,7 @@ class TestProfilingOption:
         bs = importlib.import_module("kubeflow_tpu.runtime.bootstrap")
 
         started = []
-        monkeypatch.setattr(bs, "_PROFILER_STARTED", False)
+        monkeypatch.setattr(bs, "_PROFILER_PORT", None)
         import jax
 
         monkeypatch.setattr(jax.profiler, "start_server", started.append)
@@ -198,8 +198,17 @@ class TestProfilingOption:
         )
         assert port == 9012 and started == [9012]
         # Idempotent: a notebook cell re-run must not raise.
-        bs.maybe_start_profiler_server({ann.PROFILING_ENV_NAME: "9012"})
+        assert bs.maybe_start_profiler_server(
+            {ann.PROFILING_ENV_NAME: "9012"}
+        ) == 9012
         assert started == [9012]
+        # Moving ports mid-process is a lie we refuse to tell.
+        with pytest.raises(RuntimeError, match="already listens"):
+            bs.maybe_start_profiler_server({ann.PROFILING_ENV_NAME: "9013"})
+        # A hand-set invalid env var fails loudly.
+        monkeypatch.setattr(bs, "_PROFILER_PORT", None)
+        with pytest.raises(ValueError, match="not a port"):
+            bs.maybe_start_profiler_server({ann.PROFILING_ENV_NAME: "80"})
 
 
 class TestImageResolution:
