@@ -154,9 +154,70 @@ class _Request:
     prompt: list[int]
     tokens: list[int] = dataclasses.field(default_factory=list)
     budget: int = 0
+    # Paged batcher only: physical block ids this request holds, in
+    # position order. Harmless (empty) for the fixed-slot batcher.
+    blocks: list[int] = dataclasses.field(default_factory=list)
 
 
-class ContinuousBatcher:
+class _BatcherBase:
+    """Host-side scaffolding shared by the fixed-slot and paged batchers:
+    request queue/ids, submit validation, the drive loop, and per-token
+    retirement. Subclasses provide ``_admit_free_slots``, ``_step``, and
+    ``_release_slot`` (what freeing a slot means for their storage)."""
+
+    def _init_base(self, gen: GenerationConfig, slots: int,
+                   prompt_bucket: int) -> None:
+        self.gen = gen
+        self.slots = slots
+        self.prompt_bucket = prompt_bucket
+        self._queue: list[_Request] = []
+        self._by_slot: list[Optional[_Request]] = [None] * slots
+        self._results: dict[int, list[int]] = {}
+        self._next_rid = 0
+
+    def submit(self, prompt: Sequence[int]) -> int:
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.prompt_bucket:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds bucket "
+                f"{self.prompt_bucket} (raise prompt_bucket)"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_Request(rid, list(prompt)))
+        return rid
+
+    def run(self) -> dict[int, list[int]]:
+        """Drive until queue and slots drain; returns {rid: tokens}."""
+        while self._queue or any(r is not None for r in self._by_slot):
+            self._admit_free_slots()
+            self._step()
+        out, self._results = self._results, {}
+        return out
+
+    def _note_token(self, slot: int, token: int) -> None:
+        """Record a sampled token for the slot's request; retire on EOS or
+        exhausted budget; otherwise feed it back as the next input."""
+        req = self._by_slot[slot]
+        if req is None:
+            return
+        req.budget -= 1
+        if token == self.gen.eos_id:
+            self._retire(slot)
+            return
+        req.tokens.append(token)
+        if req.budget <= 0:
+            self._retire(slot)
+            return
+        self.tokens[slot, 0] = token
+
+    def _retire(self, slot: int) -> None:
+        self._results[self._by_slot[slot].rid] = self._by_slot[slot].tokens
+        self._release_slot(slot)
+
+
+class ContinuousBatcher(_BatcherBase):
     """Fixed-slot continuous-batching server.
 
     >>> cb = ContinuousBatcher(params, cfg, slots=4, cache_len=256)
@@ -182,42 +243,14 @@ class ContinuousBatcher:
             )
         self.params = params
         self.cfg = cfg
-        self.slots = slots
         self.cache_len = cache_len
-        self.prompt_bucket = prompt_bucket
         self.key = jax.random.PRNGKey(0) if key is None else key
         self.cache = init_kv_cache(cfg, slots, cache_len)
         self.kv_mask = jnp.zeros((slots, cache_len), bool)
         # Host-side mutable state; uploaded once per step.
         self.positions = np.zeros((slots,), np.int32)
         self.tokens = np.full((slots, 1), self.gen.pad_id, np.int32)
-        self._queue: list[_Request] = []
-        self._by_slot: list[Optional[_Request]] = [None] * slots
-        self._results: dict[int, list[int]] = {}
-        self._next_rid = 0
-
-    # -- API ---------------------------------------------------------------
-
-    def submit(self, prompt: Sequence[int]) -> int:
-        if len(prompt) == 0:
-            raise ValueError("empty prompt")
-        if len(prompt) > self.prompt_bucket:
-            raise ValueError(
-                f"prompt length {len(prompt)} exceeds bucket "
-                f"{self.prompt_bucket} (raise prompt_bucket)"
-            )
-        rid = self._next_rid
-        self._next_rid += 1
-        self._queue.append(_Request(rid, list(prompt)))
-        return rid
-
-    def run(self) -> dict[int, list[int]]:
-        """Drive until queue and slots drain; returns {rid: tokens}."""
-        while self._queue or any(r is not None for r in self._by_slot):
-            self._admit_free_slots()
-            self._step()
-        out, self._results = self._results, {}
-        return out
+        self._init_base(self.gen, slots, prompt_bucket)
 
     # -- internals ---------------------------------------------------------
 
@@ -246,25 +279,7 @@ class ContinuousBatcher:
             req.budget = self.gen.max_new_tokens
             self._note_token(slot, first)
 
-    def _note_token(self, slot: int, token: int) -> None:
-        """Record a sampled token for the slot's request; retire on EOS or
-        exhausted budget; otherwise feed it back as the next input."""
-        req = self._by_slot[slot]
-        if req is None:
-            return
-        req.budget -= 1
-        if token == self.gen.eos_id:
-            self._retire(slot)
-            return
-        req.tokens.append(token)
-        if req.budget <= 0:
-            self._retire(slot)
-            return
-        self.tokens[slot, 0] = token
-
-    def _retire(self, slot: int) -> None:
-        req = self._by_slot[slot]
-        self._results[req.rid] = req.tokens
+    def _release_slot(self, slot: int) -> None:
         self._by_slot[slot] = None
         # Invalidate the slot so stale cache rows can never be attended
         # before the next admit overwrites them.

@@ -1,0 +1,377 @@
+"""Paged KV cache: block-pool serving memory with on-demand allocation.
+
+``ContinuousBatcher`` (models/continuous.py) reserves ``cache_len`` rows
+per slot for the slot's whole lifetime — a request that stops after 10
+tokens still held memory for 1024. Paged serving (the vLLM insight)
+carves the cache into fixed-size BLOCKS shared by all slots through
+per-slot block TABLES: a request holds exactly the blocks its tokens
+occupy, blocks return to the pool at retirement, and total memory is
+sized to the *expected* load, not slots × worst case.
+
+TPU-first shape discipline (all static shapes, one compiled step):
+- the pool is ``(L, num_blocks, Hkv, block_size, D)`` per k/v; the decode
+  step gathers each slot's table → ``(B, Hkv, MAXB·BS, D)`` logical cache
+  view and reuses the same GQA decode attention as the dense path, with
+  the same ``(B, C)`` validity mask — correctness is inherited, only the
+  storage changed;
+- per-token writes are an advanced-indexing scatter at
+  ``(block, :, offset)`` — requests own disjoint blocks, so rows never
+  conflict;
+- block tables/positions are host numpy, uploaded once per step; the
+  block ALLOCATOR is plain host Python between steps (a free list), the
+  exact split the reference architecture uses for its control planes:
+  device for math, host for bookkeeping.
+
+Allocation is on demand: a request takes ``prompt_bucket/BS`` blocks at
+admit and one more each time generation crosses a block boundary. When
+the pool runs dry the YOUNGEST active request is preempted — its blocks
+freed, its prompt+generated tokens re-queued as a continuation prompt —
+which is also vLLM's recovery mechanism. Greedy continuations are
+byte-identical after re-prefill; sampled ones resume with a fresh key
+stream (documented, matching vLLM's recompute semantics).
+
+No reference counterpart (control plane only); sits with serving/
+continuous/speculative as the in-notebook inference surface.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.llama import (
+    LlamaConfig,
+    _embed,
+    _gqa_decode_attention,
+    _lm_head_logits,
+    _merge_heads,
+    _mlp,
+    _mm,
+    _norm,
+    _prefill_impl,
+    _qkv,
+    _split_heads,
+    apply_rope,
+    init_kv_cache,
+    rope_frequencies,
+    sample_logits,
+)
+from kubeflow_tpu.models.continuous import _BatcherBase, _Request
+from kubeflow_tpu.models.serving import GenerationConfig, left_pad
+
+
+def init_block_pool(cfg: LlamaConfig, num_blocks: int, block_size: int) -> dict:
+    """k/v block pools, (L, NB, Hkv, BS, D)."""
+    shape = (cfg.n_layers, num_blocks, cfg.n_kv_heads, block_size, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+@partial(jax.jit, static_argnames=("cfg", "block_size"), donate_argnums=(3,))
+def _paged_admit(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # (1, Lb) left-padded prompt
+    pool: dict,
+    prompt_mask: Optional[jax.Array],  # (1, Lb) or None
+    blocks: jax.Array,  # (Lb // BS,) int32 — this slot's prompt blocks
+    block_size: int,
+) -> tuple[jax.Array, dict]:
+    """Prefill one prompt into its allocated blocks; first logits (V,)."""
+    lb = tokens.shape[1]
+    temp = init_kv_cache(cfg, 1, lb)
+    logits, temp = _prefill_impl(params, cfg, tokens, temp, kv_mask=prompt_mask)
+    new_pool = dict(pool)
+    for name in ("k", "v"):
+        buf = new_pool[name]
+        for j in range(lb // block_size):
+            chunk = jax.lax.dynamic_slice_in_dim(
+                temp[name][:, 0], j * block_size, block_size, axis=2
+            )  # (L, Hkv, BS, D)
+            buf = jax.lax.dynamic_update_slice(
+                buf, chunk[:, None], (0, blocks[j], 0, 0, 0)
+            )
+        new_pool[name] = buf
+    return logits[0], new_pool
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "block_size", "temperature", "top_k", "top_p"),
+    donate_argnums=(3,),
+)
+def _paged_step(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # (B, 1)
+    pool: dict,
+    tables: jax.Array,  # (B, MAXB) int32
+    positions: jax.Array,  # (B,)
+    kv_mask: jax.Array,  # (B, MAXB * BS)
+    key: jax.Array,
+    block_size: int,
+    temperature: float,
+    top_k: int,
+    top_p: float,
+) -> tuple[jax.Array, dict]:
+    """One decode step across every slot, reading/writing through tables."""
+    b, maxb = tables.shape
+    x = _embed(params, cfg, tokens)
+    cos, sin = rope_frequencies(cfg, positions)
+    blk = jnp.take_along_axis(
+        tables, (positions // block_size)[:, None], axis=1
+    )[:, 0]  # (B,) physical block for this step's token
+    off = positions % block_size
+
+    def gathered(pool_l):
+        # (NB, Hkv, BS, D)[tables] → (B, MAXB, Hkv, BS, D) → logical view.
+        g = pool_l[tables]
+        return g.transpose(0, 2, 1, 3, 4).reshape(
+            b, cfg.n_kv_heads, maxb * block_size, cfg.head_dim
+        )
+
+    def body(x, scanned):
+        layer, k_pool_l, v_pool_l = scanned
+        h = _norm(x, layer["attn_norm"], cfg)
+        hq, hk, hv = _qkv(h, layer)
+        q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin, per_batch=True)
+        k = apply_rope(_split_heads(hk, cfg.n_kv_heads), cos, sin,
+                       per_batch=True)
+        v = _split_heads(hv, cfg.n_kv_heads)
+        # Scatter this token's K/V row into (block, offset) — requests own
+        # disjoint blocks, so batch rows never collide.
+        k_pool_l = k_pool_l.at[blk, :, off].set(k[:, :, 0])
+        v_pool_l = v_pool_l.at[blk, :, off].set(v[:, :, 0])
+        attn = _gqa_decode_attention(
+            q, gathered(k_pool_l), gathered(v_pool_l), positions,
+            window=cfg.sliding_window, kv_mask=kv_mask, per_batch=True,
+        )
+        x = x + _mm(_merge_heads(attn), layer["wo"])
+        h = _norm(x, layer["mlp_norm"], cfg)
+        x = x + _mlp(layer, h, cfg)
+        return x, (k_pool_l, v_pool_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], pool["k"], pool["v"])
+    )
+    logits = _lm_head_logits(_norm(x[:, 0], params["final_norm"], cfg), params)
+    nxt = sample_logits(logits, key, temperature, top_k, top_p)
+    return nxt, {"k": new_k, "v": new_v}
+
+
+class PagedBatcher(_BatcherBase):
+    """Continuous batching over a shared block pool.
+
+    >>> pb = PagedBatcher(params, cfg, slots=4, num_blocks=32, block_size=16)
+    >>> ids = [pb.submit(p) for p in prompts]
+    >>> results = pb.run()          # {rid: tokens}, EOS-truncated
+
+    ``num_blocks`` sizes total KV memory independently of ``slots`` —
+    the paged advantage. When it is too small for the moment's live
+    tokens, the youngest active request is preempted and re-queued.
+    """
+
+    def __init__(
+        self,
+        params: dict,
+        cfg: LlamaConfig,
+        gen: Optional[GenerationConfig] = None,
+        slots: int = 4,
+        num_blocks: int = 64,
+        block_size: int = 16,
+        prompt_bucket: int = 64,
+        key: Optional[jax.Array] = None,
+    ):
+        self.gen = gen or GenerationConfig()
+        if prompt_bucket % block_size:
+            raise ValueError(
+                f"prompt_bucket {prompt_bucket} must be a multiple of "
+                f"block_size {block_size}"
+            )
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.prompt_bucket = prompt_bucket
+        # Capacity (in blocks) one request can ever hold; fixes MAXB so the
+        # step compiles once.
+        # +1: a preempted continuation re-admits at a block-aligned padded
+        # length, which can overhang the nominal span by up to one block.
+        self.max_blocks = (
+            prompt_bucket + self.gen.max_new_tokens + block_size - 1
+        ) // block_size + 1
+        self.key = jax.random.PRNGKey(0) if key is None else key
+        self.pool = init_block_pool(cfg, num_blocks, block_size)
+        self.kv_mask = jnp.zeros((slots, self.max_blocks * block_size), bool)
+        self.tables = np.zeros((slots, self.max_blocks), np.int32)
+        self.positions = np.zeros((slots,), np.int32)
+        self.tokens = np.full((slots, 1), self.gen.pad_id, np.int32)
+        # Block 0 is the NULL block, never allocated: inactive slots keep
+        # tables=0/positions=0, so their (ignored) per-step writes land in
+        # block 0 instead of corrupting a block someone else reallocated —
+        # the shared pool's analog of the dense batcher's harmless
+        # stale-slot writes.
+        self._free = list(range(1, num_blocks))
+        self._init_base(self.gen, slots, prompt_bucket)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    # -- allocator ---------------------------------------------------------
+
+    def _take_blocks(self, n: int) -> Optional[list[int]]:
+        """n blocks off the free list, preempting youngest-first if dry.
+        None when even preempting every other request cannot supply n."""
+        while len(self._free) < n:
+            victim = self._youngest_active()
+            if victim is None:
+                return None
+            self._preempt(victim)
+        taken, self._free = self._free[:n], self._free[n:]
+        return taken
+
+    def _youngest_active(self) -> Optional[int]:
+        slots = [
+            (req.rid, slot)
+            for slot, req in enumerate(self._by_slot)
+            if req is not None
+        ]
+        return max(slots)[1] if slots else None
+
+    def _preempt(self, slot: int) -> None:
+        """Free the slot and re-queue prompt+generated as a continuation
+        (greedy continuations are identical after re-prefill; it re-admits
+        at a block-aligned padded length, so it may exceed prompt_bucket)."""
+        req = self._by_slot[slot]
+        self._release_slot(slot)
+        # Front of the queue: a preempted request outranks new arrivals.
+        cont = _Request(req.rid, req.prompt, req.tokens)
+        self._queue.insert(0, cont)
+
+    def _release_slot(self, slot: int) -> None:
+        req = self._by_slot[slot]
+        self._free.extend(req.blocks)
+        req.blocks = []
+        self._by_slot[slot] = None
+        self.kv_mask = self.kv_mask.at[slot].set(False)
+        self.tables[slot] = 0  # dead writes go to the null block
+        self.positions[slot] = 0
+
+    # -- internals ---------------------------------------------------------
+
+    def _admit_free_slots(self) -> None:
+        for slot in range(self.slots):
+            if self._by_slot[slot] is not None:
+                continue
+            # _take_blocks may preempt, which pushes a continuation to the
+            # queue FRONT — recompute for the new head until the (head,
+            # blocks) pair is consistent.
+            while self._queue:
+                head = self._queue[0]
+                effective = head.prompt + head.tokens
+                # Block-aligned admit bucket: prompt_bucket normally;
+                # larger for a preempted continuation that outgrew it
+                # (bounded variants → bounded compiles of the admit
+                # program).
+                bucket = max(
+                    self.prompt_bucket,
+                    -(-len(effective) // self.block_size) * self.block_size,
+                )
+                blocks = self._take_blocks(bucket // self.block_size)
+                if blocks is None:
+                    if not any(r is not None for r in self._by_slot):
+                        # Nothing left to preempt and still short: the pool
+                        # cannot EVER host this prompt — fail, don't spin.
+                        raise RuntimeError(
+                            f"block pool too small: {bucket // self.block_size}"
+                            f" blocks needed for a {len(effective)}-token "
+                            f"prompt, pool has {self.num_blocks - 1} usable; "
+                            "raise num_blocks"
+                        )
+                    return  # pool busy; retry after in-flight slots retire
+                if self._queue and self._queue[0] is head:
+                    break
+                self._free.extend(blocks)  # head changed; recompute
+            else:
+                continue  # queue drained for this slot
+            req = self._queue.pop(0)
+            generated = list(req.tokens)
+            padded, mask = left_pad([effective], self.gen.pad_id, bucket)
+            prompt_mask = None if mask.all() else jnp.asarray(mask)
+            logits, self.pool = _paged_admit(
+                self.params, self.cfg, jnp.asarray(padded), self.pool,
+                prompt_mask, jnp.asarray(blocks, jnp.int32), self.block_size,
+            )
+            self.key, sub = jax.random.split(self.key)
+            first = int(
+                sample_logits(
+                    logits[None], sub, self.gen.temperature, self.gen.top_k,
+                    self.gen.top_p,
+                )[0]
+            )
+            self.tables[slot] = 0  # stale entries never alias freed blocks
+            self.tables[slot, :len(blocks)] = blocks
+            self.positions[slot] = bucket
+            # Same convention as the dense continuous batcher: the mask
+            # carries PADDING validity only; future positions stay True
+            # because causality (k_pos <= position) already hides them, and
+            # the current step's freshly-written row must be attendable by
+            # its own query.
+            row = np.ones((self.max_blocks * self.block_size,), bool)
+            row[:bucket] = np.asarray(mask)[0]
+            self.kv_mask = self.kv_mask.at[slot].set(jnp.asarray(row))
+            req = _Request(req.rid, req.prompt, generated, blocks=blocks)
+            req.budget = self.gen.max_new_tokens - len(generated)
+            self._by_slot[slot] = req
+            self._note_token(slot, first)
+
+    def _ensure_step_blocks(self) -> list[int]:
+        """Every active slot whose NEXT write lands in an unallocated block
+        gets one before the step dispatches. A slot's request holds its
+        blocks in position order, so position p needs a block exactly when
+        p // block_size == len(req.blocks). Preemption inside _take_blocks
+        may evict slots (including a needing one); loop until stable."""
+        while True:
+            active = [i for i, r in enumerate(self._by_slot) if r is not None]
+            needing = [
+                s for s in active
+                if self.positions[s] // self.block_size
+                >= len(self._by_slot[s].blocks)
+            ]
+            if not needing:
+                return active
+            blocks = self._take_blocks(len(needing))
+            if blocks is None:
+                raise RuntimeError(
+                    "block pool exhausted with a single active request; "
+                    "raise num_blocks"
+                )
+            for s, blk in zip(needing, blocks):
+                req = self._by_slot[s]
+                if req is None:  # evicted by the preemption above
+                    self._free.append(blk)
+                    continue
+                self.tables[s, len(req.blocks)] = blk
+                req.blocks.append(blk)
+
+    def _step(self) -> None:
+        active = self._ensure_step_blocks()
+        if not active:
+            return
+        self.key, sub = jax.random.split(self.key)
+        nxt, self.pool = _paged_step(
+            self.params, self.cfg, jnp.array(self.tokens), self.pool,
+            jnp.array(self.tables), jnp.array(self.positions), self.kv_mask,
+            sub, self.block_size, self.gen.temperature, self.gen.top_k,
+            self.gen.top_p,
+        )
+        for slot in active:
+            self.positions[slot] += 1
+        host_next = np.asarray(nxt)
+        for slot in active:
+            self._note_token(slot, int(host_next[slot]))
