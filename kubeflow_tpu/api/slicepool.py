@@ -38,10 +38,14 @@ STATE_WARM = "warm"
 # Annotation recorded on the Notebook when its slice came from a pool.
 CLAIMED_FROM = "notebooks.kubeflow.org/claimed-from-pool"
 
-# Demand signals stamped ON THE POOL (unix seconds, as strings) by the
-# notebook reconciler's claim path; the autoscaler keys off them.
+# Demand signals stamped ON THE POOL by the notebook reconciler's claim
+# path (autoscaled pools only); the autoscaler keys off them. LAST_* are
+# unix seconds (idle detection); MISS_COUNT is a monotonic counter so N
+# concurrent misses scale the target by N, not by 1 (a timestamp alone
+# collapses simultaneous demand).
 LAST_CLAIM = "slicepools.kubeflow.org/last-claim"
 LAST_MISS = "slicepools.kubeflow.org/last-miss"
+MISS_COUNT = "slicepools.kubeflow.org/miss-count"
 
 
 class SlicePool:

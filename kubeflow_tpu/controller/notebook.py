@@ -236,11 +236,12 @@ class NotebookReconciler(Reconciler):
         from kubeflow_tpu.api.slicepool import CLAIMED_FROM
         from kubeflow_tpu.controller.slicepool import claim_warm_slice
 
-        if not self.client.list("SlicePool", nb.namespace):
+        pools = self.client.list("SlicePool", nb.namespace)
+        if not pools:
             return  # namespace doesn't use pools; keep metrics quiet
         pool = claim_warm_slice(
             self.client, nb.namespace, topo, recorder=self.recorder,
-            notebook=obj, now=self.clock(),
+            notebook=obj, now=self.clock(), pools=pools,
         )
         if not pool:
             self.metrics.pool_claim_misses_total.inc()

@@ -121,6 +121,7 @@ def claim_warm_slice(
     recorder: Optional[EventRecorder] = None,
     notebook: Optional[dict] = None,
     now: Optional[float] = None,
+    pools: Optional[list] = None,
 ) -> Optional[str]:
     """Claim one warm placeholder matching (accelerator, topology).
 
@@ -131,8 +132,11 @@ def claim_warm_slice(
     cascades to its pods, releasing chips for the notebook's pods.
 
     Demand signals for the autoscaler: a successful claim stamps
-    LAST_CLAIM on the owning pool; a miss stamps LAST_MISS on every
-    topology-matching pool in the namespace (callers pass ``now``).
+    LAST_CLAIM on the owning pool; a miss stamps LAST_MISS and increments
+    MISS_COUNT on every topology-matching AUTOSCALED pool in the namespace
+    (callers pass ``now``, and may pass a prefetched ``pools`` list to
+    avoid a second SlicePool list on the spawn path). Fixed-size pools
+    never read the signals, so they are never written.
     """
     candidates = client.list(
         "StatefulSet",
@@ -166,18 +170,26 @@ def claim_warm_slice(
             _stamp(client, namespace, [pool_name], sp.LAST_CLAIM, now)
         return pool_name or None
     if now is not None:
+        if pools is None:
+            pools = client.list("SlicePool", namespace)
         matching = [
             obj_util.name_of(p)
-            for p in client.list("SlicePool", namespace)
+            for p in pools
             if _pool_matches(p, topo)
         ]
-        _stamp(client, namespace, matching, sp.LAST_MISS, now)
+        _stamp(
+            client, namespace, matching, sp.LAST_MISS, now,
+            count_key=sp.MISS_COUNT,
+        )
     return None
 
 
 def _pool_matches(pool_obj: dict, topo: SliceTopology) -> bool:
+    pool = sp.SlicePool(pool_obj)
+    if pool.autoscale is None:
+        return False  # fixed pools never read demand signals
     try:
-        pt = sp.SlicePool(pool_obj).tpu.slice_topology()
+        pt = pool.tpu.slice_topology()
     except Exception:
         return False
     return (
@@ -187,13 +199,15 @@ def _pool_matches(pool_obj: dict, topo: SliceTopology) -> bool:
 
 
 def _stamp(
-    client: Client, namespace: str, pool_names: list, key: str, now: float
+    client: Client, namespace: str, pool_names: list, key: str, now: float,
+    count_key: Optional[str] = None,
 ) -> None:
     """Demand-signal write. Conflicts are RETRIED (the usual conflicting
     writer is the pool reconciler updating status — losing the race must
-    not lose the miss/claim signal); only a deleted pool is skipped.
-    Stamps keep full float precision so a signal in the same second as a
-    scale event still orders correctly against status.lastScaleTime."""
+    not lose the miss/claim signal); only a deleted pool is skipped. The
+    claim-side autoscale gate lives in _pool_matches for misses; claims
+    stamp only autoscaled pools too. ``count_key`` additionally increments
+    a monotonic counter so N concurrent signals count as N."""
     for name in pool_names:
 
         def write(name=name):
@@ -201,7 +215,16 @@ def _stamp(
                 pool = client.get("SlicePool", name, namespace)
             except NotFoundError:
                 return
+            if sp.SlicePool(pool).autoscale is None:
+                return  # nothing reads signals on fixed pools
             obj_util.set_annotation(pool, key, str(now))
+            if count_key is not None:
+                anns = obj_util.annotations_of(pool)
+                try:
+                    seen = int(anns.get(count_key, "0"))
+                except ValueError:
+                    seen = 0
+                obj_util.set_annotation(pool, count_key, str(seen + 1))
             client.update(pool)
 
         retry_on_conflict(write)
@@ -335,6 +358,13 @@ class SlicePoolReconciler(Reconciler):
         """
         auto = pool.autoscale
         if auto is None:
+            # A pool switched back to fixed sizing must not keep exporting
+            # (or later resurrect) autoscaler state — including the demand
+            # ANNOTATIONS, or a re-enable would read a stale miss counter
+            # against a fresh missCountSeen and scale up on dead demand.
+            for key in ("autoscaleTarget", "lastScaleTime", "missCountSeen"):
+                pool.status.pop(key, None)
+            self._clear_demand_annotations(pool)
             return pool.warm_replicas, 0.0, {}
         lo, hi = auto["min"], auto["max"]
         cooldown = auto["scaleDownAfterSeconds"]
@@ -351,8 +381,13 @@ class SlicePoolReconciler(Reconciler):
                 return 0.0
 
         last_miss, last_claim = stamp(sp.LAST_MISS), stamp(sp.LAST_CLAIM)
-        if last_miss > last_scale and target < hi:
-            target += 1
+        # Misses are a COUNTER so N concurrent cold spawns grow the target
+        # by N in one reconcile; the timestamps only feed idle detection.
+        miss_count = int(stamp(sp.MISS_COUNT))
+        seen = int(pool.status.get("missCountSeen", 0))
+        fresh_misses = max(0, miss_count - seen)
+        if fresh_misses and target < hi:
+            target = min(hi, target + fresh_misses)
             last_scale = now
         elif (
             target > lo
@@ -363,7 +398,25 @@ class SlicePoolReconciler(Reconciler):
         return target, float(cooldown), {
             "autoscaleTarget": target,
             "lastScaleTime": last_scale,
+            "missCountSeen": miss_count,
         }
+
+    def _clear_demand_annotations(self, pool: sp.SlicePool) -> None:
+        keys = (sp.LAST_MISS, sp.LAST_CLAIM, sp.MISS_COUNT)
+        anns = pool.obj.get("metadata", {}).get("annotations", {})
+        if not any(k in anns for k in keys):
+            return
+
+        def write():
+            try:
+                fresh = self.client.get("SlicePool", pool.name, pool.namespace)
+            except NotFoundError:
+                return
+            removed = [obj_util.remove_annotation(fresh, k) for k in keys]
+            if any(removed):  # list, not genexpr: every key must be removed
+                self.client.update(fresh)
+
+        retry_on_conflict(write)
 
     def _drop_gauge(self, pool_name: str) -> None:
         """A deleted pool must not keep exporting its last warm count."""

@@ -225,6 +225,7 @@ def slicepool_crd() -> dict:
                     "readyReplicas": {"type": "integer"},
                     "autoscaleTarget": {"type": "integer"},
                     "lastScaleTime": {"type": "number"},
+                    "missCountSeen": {"type": "integer"},
                     "conditions": {
                         "type": "array",
                         "items": {

@@ -135,6 +135,72 @@ class TestAutoscale:
         assert pool["status"]["autoscaleTarget"] == 0
         assert not _warm_stses(env)
 
+    def test_concurrent_misses_scale_by_count(self):
+        """Three cold spawns before the pool reconciles once must grow the
+        target by three — the miss COUNTER, not a collapsed timestamp."""
+        env = make_env(
+            node_pools=tuple(
+                ("tpu-v5-lite-podslice", "4x4", 4, 4) for _ in range(5)
+            )
+        )
+        env.cluster.create(self._auto_pool(lo=0, hi=5))
+        env.manager.run_until_idle()
+        for i in range(3):
+            env.cluster.create(tpu_notebook(name=f"nb{i}"))
+        env.manager.run_until_idle()
+        pool = env.cluster.get("SlicePool", "pool", "ns")
+        assert pool["status"]["autoscaleTarget"] == 3
+
+    def test_fixed_pools_never_stamped(self):
+        env = make_env()
+        env.cluster.create(_pool(warm=0, name="fixed"))
+        env.manager.run_until_idle()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        fixed = env.cluster.get("SlicePool", "fixed", "ns")
+        anns = fixed["metadata"].get("annotations", {})
+        assert sp.LAST_MISS not in anns and sp.MISS_COUNT not in anns
+
+    def test_disabling_autoscale_clears_state(self):
+        env = make_env()
+        env.cluster.create(self._auto_pool(lo=1, hi=2))
+        env.manager.run_until_idle()
+        pool = env.cluster.get("SlicePool", "pool", "ns")
+        assert pool["status"]["autoscaleTarget"] == 1
+        del pool["spec"]["autoscale"]
+        pool["spec"]["warmReplicas"] = 1
+        env.cluster.update(pool)
+        env.manager.run_until_idle()
+        pool = env.cluster.get("SlicePool", "pool", "ns")
+        assert "autoscaleTarget" not in pool["status"]
+        assert "lastScaleTime" not in pool["status"]
+
+    def test_reenable_does_not_resurrect_stale_demand(self):
+        """Disable autoscale after misses accrued, then re-enable: the
+        target must restart from min, not replay the dead miss counter."""
+        env = make_env()
+        env.cluster.create(self._auto_pool(lo=0, hi=3))
+        env.manager.run_until_idle()
+        env.cluster.create(tpu_notebook())  # miss → counter=1, target 1
+        env.manager.run_until_idle()
+        pool = env.cluster.get("SlicePool", "pool", "ns")
+        assert pool["status"]["autoscaleTarget"] == 1
+
+        del pool["spec"]["autoscale"]
+        pool["spec"]["warmReplicas"] = 0
+        env.cluster.update(pool)
+        env.manager.run_until_idle()
+        pool = env.cluster.get("SlicePool", "pool", "ns")
+        assert sp.MISS_COUNT not in pool["metadata"].get("annotations", {})
+
+        pool["spec"]["autoscale"] = {
+            "min": 0, "max": 3, "scaleDownAfterSeconds": 300,
+        }
+        env.cluster.update(pool)
+        env.manager.run_until_idle()
+        pool = env.cluster.get("SlicePool", "pool", "ns")
+        assert pool["status"]["autoscaleTarget"] == 0
+
     def test_capped_at_max(self):
         env = make_env(
             node_pools=tuple(
