@@ -22,6 +22,10 @@ def main() -> None:
     ap.add_argument("--int8", action="store_true")
     ap.add_argument("--int4", action="store_true",
                     help="group-wise int4 weights (~4x fewer HBM bytes)")
+    ap.add_argument("--paged", action="store_true",
+                    help="serve through the paged block-pool KV cache")
+    ap.add_argument("--num-blocks", type=int, default=64,
+                    help="block-pool size for --paged (16-token blocks)")
     ap.add_argument("--max-new-tokens", type=int, default=16)
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--prompt", action="append", default=None,
@@ -86,7 +90,21 @@ def main() -> None:
         top_p=0.95 if args.temperature else 1.0,
         eos_id=eos,
     )
-    outs = batch_generate(params, cfg, prompts, gen, key=jax.random.PRNGKey(0))
+    if args.paged:
+        from kubeflow_tpu.models.paged import PagedBatcher
+
+        bucket = 16 * ((max(len(p) for p in prompts) + 15) // 16)
+        pb = PagedBatcher(
+            params, cfg, gen=gen, slots=min(4, len(prompts)),
+            num_blocks=args.num_blocks, block_size=16, prompt_bucket=bucket,
+            key=jax.random.PRNGKey(0),
+        )
+        rids = [pb.submit(p) for p in prompts]
+        results = pb.run()
+        outs = [results[r] for r in rids]
+        print(f"paged: {pb.free_blocks}/{args.num_blocks - 1} blocks free after run")
+    else:
+        outs = batch_generate(params, cfg, prompts, gen, key=jax.random.PRNGKey(0))
     for i, out in enumerate(outs):
         if tokenizer is not None and args.prompt:
             print(f"[{i}] {tokenizer.decode(out)}")

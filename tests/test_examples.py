@@ -52,7 +52,7 @@ def test_finetune_lora_runs_and_exports(tmp_path):
     assert pathlib.Path(out).exists()
 
 
-@pytest.mark.parametrize("extra", [(), ("--int8",)])
+@pytest.mark.parametrize("extra", [(), ("--int8",), ("--paged",)])
 def test_serve_batched_runs(extra):
     res = _run("serve_batched.py", "--max-new-tokens", "4", *extra)
     assert res.returncode == 0, res.stderr
