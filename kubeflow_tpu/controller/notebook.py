@@ -188,7 +188,22 @@ class NotebookReconciler(Reconciler):
                 )
             except NotFoundError:
                 existing = None
-            if existing is None and slice_topo is not None and not nb.stopped:
+            # Claim a warm slice on every 0→N replica transition, not just
+            # first creation: the webhook's reconciliation lock means the
+            # STS is born at replicas 0 and scales up only after the
+            # platform reconciler releases the lock (the production path),
+            # and a culled notebook's RESUME re-acquires capacity too. The
+            # ownership check mirrors _reconcile_statefulset's no-adopt
+            # guard — a name-collision STS stuck at 0 replicas must not
+            # drain the pool on every reconcile.
+            scaling_up = slice_topo is not None and not nb.stopped and (
+                existing is None
+                or (
+                    existing.get("spec", {}).get("replicas", 0) == 0
+                    and obj_util.is_controlled_by(obj, existing)
+                )
+            )
+            if scaling_up:
                 self._maybe_claim_warm_slice(obj, nb, slice_topo)
             created_any |= self._reconcile_statefulset(obj, sts, existing)
         if created_any:
@@ -228,11 +243,11 @@ class NotebookReconciler(Reconciler):
 
     # ------------------------------------------------------------------
     def _maybe_claim_warm_slice(self, obj: dict, nb: Notebook, topo) -> None:
-        """Claim a warm SlicePool placeholder BEFORE the cold STS exists,
+        """Claim a warm SlicePool placeholder BEFORE the slice scales up,
         so the freed chips/warm nodes are available when the slice pods
         first schedule (kubeflow_tpu.controller.slicepool). The caller only
-        invokes this when the slice STS does not exist yet — claims are for
-        first creation, never the steady-state reconcile path."""
+        invokes this on a 0→N replica transition (creation with no lock,
+        lock release, or resume) — never the steady-state reconcile path."""
         from kubeflow_tpu.api.slicepool import CLAIMED_FROM
         from kubeflow_tpu.controller.slicepool import claim_warm_slice
 
@@ -282,7 +297,17 @@ class NotebookReconciler(Reconciler):
             )
             return False
         if helper.copy_statefulset_fields(desired, existing):
-            self.client.update(existing)
+            # Conflict-retried: aborting here after a warm-slice claim
+            # would re-enter the 0→N transition next reconcile and consume
+            # a SECOND placeholder for the same scale-up.
+            def write():
+                fresh = self.client.get(
+                    "StatefulSet", name, obj_util.namespace_of(desired)
+                )
+                if helper.copy_statefulset_fields(desired, fresh):
+                    self.client.update(fresh)
+
+            retry_on_conflict(write)
         return False
 
     def _prune_stale_slice_sts(self, nb: Notebook, slice_count: int) -> None:

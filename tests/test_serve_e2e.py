@@ -210,6 +210,71 @@ def test_notebook_becomes_running_pods_over_the_wire(stack):
 
 
 @pytest.mark.slow
+def test_slicepool_claim_over_the_wire(stack):
+    """Warm pool → locked Notebook → lock release → claim, all through the
+    production wiring (HTTP apiserver, HTTPS admission, serve loops). Also
+    proves the SlicePool CRD schema is enforced over the wire."""
+    from kubeflow_tpu.api.notebook import TPUSpec
+    from kubeflow_tpu.api.slicepool import CLAIMED_FROM, new_slicepool
+    from kubeflow_tpu.k8s.errors import InvalidError
+
+    with pytest.raises(InvalidError):
+        stack.user.create(
+            new_slicepool("bad", "ns", TPUSpec("v5e", "not-a-topology"))
+        )
+
+    stack.user.create(
+        new_slicepool("pool", "ns", TPUSpec("v5e", "4x4"), warm_replicas=1)
+    )
+    _wait_for(
+        lambda: stack.user.get("SlicePool", "pool", "ns")
+        .get("status", {}).get("readyReplicas") == 1,
+        desc="warm placeholder ready",
+    )
+    from kubeflow_tpu.api.slicepool import STATE_LABEL, STATE_WARM
+
+    def warm_names():
+        return {
+            s["metadata"]["name"]
+            for s in stack.user.list(
+                "StatefulSet", "ns", {STATE_LABEL: STATE_WARM}
+            )
+        }
+
+    before = warm_names()
+
+    nb = tpu_notebook(name="wb3")
+    created = stack.user.create(nb)
+    # Admission held the slice down; the claim must still happen when the
+    # platform reconciler releases the lock (the 0→N transition).
+    assert created["metadata"]["annotations"][ann.STOP] == (
+        ann.RECONCILIATION_LOCK_VALUE
+    )
+    _wait_for(
+        lambda: stack.user.get("Notebook", "wb3", "ns")["metadata"]
+        .get("annotations", {}).get(CLAIMED_FROM) == "pool",
+        desc="warm slice claimed",
+    )
+    _wait_for(
+        lambda: stack.user.get("Notebook", "wb3", "ns")
+        .get("status", {}).get("readyReplicas") == 4,
+        desc="4 ready hosts on claimed capacity",
+    )
+    # The pool refilled with a NEW generation (warmReplicas alone could be
+    # a stale pre-claim status; a different placeholder name cannot).
+    _wait_for(
+        lambda: warm_names() and warm_names() != before,
+        desc="pool refill (regenerated placeholder)",
+    )
+    stack.user.delete("Notebook", "wb3", "ns")
+    _wait_for(
+        lambda: not stack.user.exists("Notebook", "wb3", "ns"),
+        desc="notebook deletion",
+    )
+    stack.user.delete("SlicePool", "pool", "ns")
+
+
+@pytest.mark.slow
 def test_metrics_and_cert_rotation(stack):
     # /metrics serves the reference metric set off a live scrape.
     metrics_server = MetricsServer(stack.core.metrics)
