@@ -270,6 +270,40 @@ class TestClaimPath:
         text = env.metrics.expose().decode()
         assert "tpu_slicepool_claim_misses_total 1.0" in text
 
+    def test_resume_after_stop_claims_again(self):
+        """A culled/stopped notebook released its capacity; resume is a
+        fresh 0→N transition and deserves a warm slice too."""
+        from kubeflow_tpu.api import annotations as ann
+        from kubeflow_tpu.k8s import objects as obj_util2
+
+        env = make_env(
+            node_pools=(
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+            )
+        )
+        env.cluster.create(_pool(warm=1))
+        env.manager.run_until_idle()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        nb["metadata"]["annotations"][ann.STOP] = "2026-07-30T00:00:00Z"
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        sts = env.cluster.get("StatefulSet", "nb", "ns")
+        assert sts["spec"]["replicas"] == 0
+
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util2.remove_annotation(nb, ann.STOP)
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+
+        text = env.metrics.expose().decode()
+        assert "tpu_slicepool_claims_total 2.0" in text
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["status"]["readyReplicas"] == 4
+
     def test_no_pools_no_metrics_noise(self):
         env = make_env()
         env.cluster.create(tpu_notebook())
