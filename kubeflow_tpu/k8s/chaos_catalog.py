@@ -30,8 +30,21 @@ INJECTION_TYPES = (
     "controller-outage",
     "client-fault",
     "webhook-error",
+    "placeholder-kill",
 )
-STEADY_STATE_CHECKS = ("sliceReady", "notCulled", "notebookCreatable")
+STEADY_STATE_CHECKS = (
+    "sliceReady", "notCulled", "notebookCreatable", "warmPoolReady",
+)
+# Injection ↔ target coherence: a doc must declare the kind its handler
+# actually exercises, or a "pass" certifies a hypothesis that never ran.
+TARGET_KIND_FOR_INJECTION = {
+    "pod-kill": "Notebook",
+    "network-partition": "Notebook",
+    "controller-outage": "Notebook",
+    "client-fault": "Notebook",
+    "webhook-error": "Notebook",
+    "placeholder-kill": "SlicePool",
+}
 
 
 class ValidationError(ValueError):
@@ -60,13 +73,18 @@ def validate_experiment(doc: dict) -> None:
     need(doc.get("kind") == EXPERIMENT_KIND, f"kind must be {EXPERIMENT_KIND}")
     need(bool(doc.get("metadata", {}).get("name")), "metadata.name required")
     spec = doc.get("spec", {})
-    need(spec.get("target", {}).get("kind") == "Notebook", "target.kind must be Notebook")
     states = spec.get("steadyState", [])
     need(len(states) > 0, "at least one steadyState check")
     for st in states:
         need(st.get("check") in STEADY_STATE_CHECKS, f"unknown check {st.get('check')}")
     injection = spec.get("injection", {})
     need(injection.get("type") in INJECTION_TYPES, f"unknown injection {injection.get('type')}")
+    want_kind = TARGET_KIND_FOR_INJECTION[injection["type"]]
+    need(
+        spec.get("target", {}).get("kind") == want_kind,
+        f"injection {injection['type']} targets {want_kind}, "
+        f"got target.kind {spec.get('target', {}).get('kind')}",
+    )
     need(bool(spec.get("hypothesis")), "hypothesis required")
     need(
         isinstance(spec.get("recoveryTimeoutSeconds"), int)
@@ -134,6 +152,7 @@ class ExperimentRunner:
             "controller-outage": self._run_controller_outage,
             "client-fault": self._run_client_fault,
             "webhook-error": self._run_webhook_error,
+            "placeholder-kill": self._run_placeholder_kill,
         }
 
     def run(self, doc: dict) -> ExperimentResult:
@@ -175,6 +194,61 @@ class ExperimentRunner:
             passed=recovered and len(pods) == 4,
             detail="" if recovered else "slice did not return to Ready",
             observations={"pods_after": len(pods)},
+        )
+
+    def _run_placeholder_kill(self, doc: dict) -> ExperimentResult:
+        """A warm SlicePool placeholder StatefulSet is deleted out from
+        under the pool (node wipe, operator mistake, over-eager GC). The
+        level-triggered pool reconcile must regenerate a placeholder — at
+        a NEW generation name — and return the pool to all-Ready."""
+        from kubeflow_tpu.api import slicepool as sp
+        from kubeflow_tpu.api.notebook import TPUSpec
+        from kubeflow_tpu.api.slicepool import new_slicepool
+        from kubeflow_tpu.k8s import objects as obj_util
+
+        env = self.env_factory()
+        env.cluster.create(
+            new_slicepool("pool", "ns", TPUSpec("v5e", "4x4"), warm_replicas=1)
+        )
+        env.manager.run_until_idle()
+
+        def warm():
+            return env.cluster.list(
+                "StatefulSet", "ns",
+                label_selector={sp.STATE_LABEL: sp.STATE_WARM},
+            )
+
+        before = warm()
+        steady = (
+            len(before) == 1
+            and env.cluster.get("SlicePool", "pool", "ns")
+            .get("status", {}).get("readyReplicas") == 1
+        )
+        if not steady:
+            return ExperimentResult(
+                doc["metadata"]["name"], passed=False,
+                detail="steady state never reached",
+            )
+
+        env.cluster.delete("StatefulSet", obj_util.name_of(before[0]), "ns")
+        env.manager.run_until_idle()
+
+        after = warm()
+        regenerated = (
+            len(after) == 1
+            and obj_util.name_of(after[0]) != obj_util.name_of(before[0])
+        )
+        ready = (
+            env.cluster.get("SlicePool", "pool", "ns")
+            .get("status", {}).get("readyReplicas") == 1
+        )
+        return ExperimentResult(
+            doc["metadata"]["name"],
+            passed=regenerated and ready,
+            detail="" if regenerated and ready else (
+                f"regenerated={regenerated} ready={ready}"
+            ),
+            observations={"placeholders_after": len(after)},
         )
 
     def _run_network_partition(self, doc: dict) -> ExperimentResult:

@@ -31,6 +31,8 @@ def test_catalog_has_reference_parity_experiments():
         "controller-scale-zero",
         "rbac-revoke",
         "webhook-disrupt",
+        # Beyond reference: the warm-capacity subsystem gets chaos coverage.
+        "slicepool-placeholder-kill",
     }
 
 
@@ -61,6 +63,21 @@ def test_knowledge_model_valid_and_matches_code():
     assert ann.STOP in core["annotationsOwned"]
     assert ann.LAST_ACTIVITY in core["annotationsOwned"]
     assert ann.TPU_SLICE_INTERRUPTED in core["annotationsOwned"]
+    # The warm-capacity subsystem is inventoried: SlicePool watched, and a
+    # managedResources entry names the placeholder StatefulSets with the
+    # naming scheme the code actually uses.
+    from kubeflow_tpu.controller.slicepool import warm_sts_name
+
+    assert "SlicePool" in core["watches"]
+    placeholder_notes = [
+        r.get("note", "")
+        for r in core["managedResources"]
+        if r["kind"] == "StatefulSet"
+    ]
+    pattern = warm_sts_name("{pool}", 0).replace("-0", "-{gen}")
+    assert any(pattern in n for n in placeholder_notes), (
+        f"no StatefulSet managedResource mentions {pattern!r}"
+    )
 
     platform_kinds = {
         r["kind"] for r in controllers["platform-notebook-controller"]["managedResources"]
