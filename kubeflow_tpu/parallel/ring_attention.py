@@ -43,6 +43,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 NEG_INF = -1e30
+# Key-width of the inner flash-style sub-block (see ring_attention): caps
+# the materialized score buffer at (B, H, SqL, _RING_BLOCK) f32.
+_RING_BLOCK = 1024
 
 
 def ring_attention(
@@ -72,32 +75,66 @@ def ring_attention(
     perm = [(i, (i + 1) % n) for i in range(n)]
     q_pos = q_offset + my_idx * sq_local + jnp.arange(sq_local)[:, None]
 
-    def step(carry, step_idx):
-        m, l, o, k_cur, v_cur, mask_cur = carry
-        # The chunk we currently hold originated on device (my_idx - step).
-        chunk_idx = (my_idx - step_idx) % n
-        # Native-dtype MXU operands (bf16 in training — f32 operands would
-        # quarter the matmul rate), f32 accumulation + scale.
+    # Long-context memory lever: process each held chunk in sub-blocks of
+    # at most _RING_BLOCK keys with the same online-softmax recursion, so
+    # the materialized score buffer is (B, H, SqL, block), not
+    # (B, H, SqL, SkL) — at 32k-context shards the full matrix is GBs. The
+    # rematerialized sub-body keeps backward memory at O(block) too.
+    blk = next(
+        (c for c in (_RING_BLOCK, 512, 256, 128) if sk_local % c == 0),
+        sk_local,  # no aligned divisor (tiny/odd shard) → single block
+    )
+    blk = min(blk, sk_local)
+    nblk = sk_local // blk
+
+    def update(m, l, o, k_blk, v_blk, mask_blk, k_start):
+        """One flash-style (m, l, o) update against a key sub-block.
+        Native-dtype MXU operands (bf16 in training — f32 operands would
+        quarter the matmul rate), f32 accumulation + scale."""
         s = jnp.einsum(
-            "bhqd,bhkd->bhqk", q, k_cur,
+            "bhqd,bhkd->bhqk", q, k_blk,
             preferred_element_type=jnp.float32,
         ) * scale
-        k_pos = chunk_idx * sk_local + jnp.arange(sk_local)[None, :]
+        k_pos = k_start + jnp.arange(blk)[None, :]
         if causal or window:
             mask = (k_pos <= q_pos) if causal else jnp.ones_like(k_pos <= q_pos)
             if window:
                 mask = mask & (k_pos > q_pos - window)
             s = jnp.where(mask[None, None], s, NEG_INF)
-        if mask_cur is not None:
-            s = jnp.where(mask_cur[:, None, None, :], s, NEG_INF)
+        if mask_blk is not None:
+            s = jnp.where(mask_blk[:, None, None, :], s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
         l_new = l * alpha + jnp.sum(p, axis=-1)
         o_new = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(v_cur.dtype), v_cur,
+            "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
             preferred_element_type=jnp.float32,
         )
+        return m_new, l_new, o_new
+
+    def step(carry, step_idx):
+        m, l, o, k_cur, v_cur, mask_cur = carry
+        # The chunk we currently hold originated on device (my_idx - step).
+        chunk_idx = (my_idx - step_idx) % n
+        k_start0 = chunk_idx * sk_local
+        if nblk == 1:
+            m, l, o = update(m, l, o, k_cur, v_cur, mask_cur, k_start0)
+        else:
+            @jax.checkpoint
+            def sub(carry2, j):
+                m, l, o = carry2
+                k_blk = jax.lax.dynamic_slice_in_dim(k_cur, j * blk, blk, 2)
+                v_blk = jax.lax.dynamic_slice_in_dim(v_cur, j * blk, blk, 2)
+                mask_blk = (
+                    None if mask_cur is None
+                    else jax.lax.dynamic_slice_in_dim(mask_cur, j * blk, blk, 1)
+                )
+                return update(
+                    m, l, o, k_blk, v_blk, mask_blk, k_start0 + j * blk
+                ), None
+
+            (m, l, o), _ = jax.lax.scan(sub, (m, l, o), jnp.arange(nblk))
         # Rotate K/V (and the key-validity mask with them) to the next
         # device; XLA overlaps this with the next step's einsums.
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
@@ -106,7 +143,7 @@ def ring_attention(
             None if mask_cur is None
             else jax.lax.ppermute(mask_cur, axis_name, perm)
         )
-        return (m_new, l_new, o_new, k_next, v_next, mask_next), None
+        return (m, l, o, k_next, v_next, mask_next), None
 
     m0 = jnp.full((b, h, sq_local), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, sq_local), jnp.float32)
