@@ -285,9 +285,30 @@ def run_full_bench(results: list) -> None:
     section(prefill_section)
 
 
-def main() -> int:
-    import jax
+def _device_watchdog(timeout_s: int = 300) -> str:
+    """Probe device enumeration in a SUBPROCESS with a timeout: a wedged
+    axon tunnel hangs jax.devices() inside C++ where no Python timeout can
+    reach, and the bench must emit its JSON line rather than hang the
+    driver. Healthy enumeration takes seconds; 300 s is generous. Returns
+    "" on success, else a reason ("hung" / the probe's stderr tail) so a
+    broken env is distinguishable from a wedged tunnel."""
+    import subprocess
 
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        return f"hung (> {timeout_s}s)"
+    if probe.returncode == 0:
+        return ""
+    lines = probe.stderr.decode(errors="replace").strip().splitlines()
+    return "failed: " + (lines[-1] if lines else f"exit {probe.returncode}")
+
+
+def main() -> int:
+    # Usage errors first: they must not pay (or be masked by) a device probe.
     if "--int8" in sys.argv[1:] and "--int4" in sys.argv[1:]:
         print("error: --int8 and --int4 are mutually exclusive", file=sys.stderr)
         return 2
@@ -305,6 +326,25 @@ def main() -> int:
             artifact = args[i + 1]
         elif arg.startswith("--artifact="):
             artifact = arg.split("=", 1)[1]
+
+    reason = _device_watchdog()
+    if reason:
+        print(
+            json.dumps(
+                {
+                    "metric": "llama decode tokens/sec/chip "
+                              f"(device enumeration {reason})",
+                    "value": 0.0,
+                    "unit": "tokens/sec/chip",
+                    "vs_baseline": 0.0,
+                }
+            )
+        )
+        print(f"# jax.devices() probe: {reason}; see BASELINE.md provenance "
+              "note for the last healthy measurements", file=sys.stderr)
+        return 1
+
+    import jax
     device = jax.devices()[0]
     kind = getattr(device, "device_kind", str(device))
     last_err = None
