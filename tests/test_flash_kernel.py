@@ -235,3 +235,44 @@ class TestDispatch:
         k = jax.random.normal(jax.random.PRNGKey(1), (1, 3, 256, 128))
         with pytest.raises(ValueError, match="not a multiple"):
             A.flash_attention(q, k, k)  # 2 q heads, 3 kv heads
+
+
+class TestWholeKVVariant:
+    """The forward dispatches to the whole-KV single-fetch kernel when K+V
+    fit VMEM (_whole_kv_ok) and to the streamed grid otherwise. Both
+    variants must agree with XLA — and with each other — since the
+    streamed path is no longer exercised at small S by the tests above."""
+
+    @pytest.mark.parametrize("name,kw,sq,sk", CASES, ids=[c[0] for c in CASES])
+    def test_streamed_matches_xla_when_forced(self, name, kw, sq, sk,
+                                              monkeypatch):
+        monkeypatch.setattr(A, "_WHOLE_KV_MAX_BYTES", 0)  # force streaming
+        q, k, v = _qkv(sq, sk)
+        ref = A.flash_attention(q, k, v, impl="xla", **kw)
+        got = _fwd(q, k, v, **{"causal": True, **kw})
+        assert float(jnp.max(jnp.abs(ref - got))) < 1e-4
+
+    def test_whole_and_streamed_agree(self, monkeypatch):
+        q, k, v = _qkv(384, 384)
+        whole = _fwd(q, k, v, causal=True)
+        monkeypatch.setattr(A, "_WHOLE_KV_MAX_BYTES", 0)
+        streamed = _fwd(q, k, v, causal=True)
+        assert float(jnp.max(jnp.abs(whole - streamed))) < 1e-5
+
+    def test_whole_kv_gqa_with_mask(self):
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = jax.random.normal(ks[0], (2, 4, 256, 128))
+        k = jax.random.normal(ks[1], (2, 2, 256, 128))
+        v = jax.random.normal(ks[2], (2, 2, 256, 128))
+        kv_mask = jnp.ones((2, 256), bool).at[0, :64].set(False)
+        ref = A.flash_attention(q, k, v, causal=True, impl="xla",
+                                kv_mask=kv_mask)
+        got = A._flash_attention_pallas(q, k, v, True, 0, interpret=True,
+                                        kv_mask=kv_mask)
+        assert float(jnp.max(jnp.abs(ref - got))) < 1e-4
+
+    def test_dispatch_threshold(self):
+        # bf16 K+V at S=8192, D=128 is exactly 4 MiB -> whole-KV eligible;
+        # one step past the threshold must stream.
+        assert A._whole_kv_ok(8192, 128, 2)
+        assert not A._whole_kv_ok(8192 + 512, 128, 2)
