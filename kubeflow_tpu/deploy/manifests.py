@@ -334,7 +334,12 @@ def core_cluster_role() -> dict:
             _rule([GROUP], [PLURAL], _ALL),
             _rule([GROUP], [f"{PLURAL}/status"], ["get", "patch", "update"]),
             _rule([GROUP], [f"{PLURAL}/finalizers"], ["update"]),
-            _rule([GROUP], ["slicepools"], _READ),
+            # update/patch beyond read: the spawn path writes demand-signal
+            # annotations on the SlicePool main resource (slicepool.py
+            # _stamp / _clear_demand_annotations) — read-only verbs would
+            # 403 every TPU notebook spawn in a namespace with an
+            # autoscaled pool.
+            _rule([GROUP], ["slicepools"], _READ + ["patch", "update"]),
             _rule([GROUP], ["slicepools/status"], ["get", "patch", "update"]),
             _rule(["apps"], ["statefulsets"], _ALL),
             _rule([""], ["services"], _ALL),

@@ -74,6 +74,21 @@ def test_core_rbac_covers_reconciled_kinds():
         assert need in covered, need
 
 
+def test_core_rbac_grants_slicepool_demand_signal_writes():
+    """The notebook spawn path writes demand-signal annotations onto the
+    SlicePool MAIN resource (controller/slicepool.py _stamp /
+    _clear_demand_annotations via client.update) — with read-only verbs
+    every TPU notebook spawn in a namespace with an autoscaled pool would
+    403 in a real cluster, which fake-client tests cannot catch."""
+    rules = m.core_cluster_role()["rules"]
+    for rule in rules:
+        if "slicepools" in rule["resources"]:
+            assert "update" in rule["verbs"] and "patch" in rule["verbs"]
+            break
+    else:
+        raise AssertionError("no slicepools rule in core ClusterRole")
+
+
 def test_platform_rbac_covers_reconciled_kinds():
     rules = m.platform_cluster_role()["rules"]
     covered = {(g, r) for rule in rules for g in rule["apiGroups"] for r in rule["resources"]}
