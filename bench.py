@@ -196,30 +196,78 @@ def run_full_bench(results: list) -> None:
         tcfg = L.LlamaConfig(dim=2048, n_layers=16, n_heads=16, n_kv_heads=16,
                              ffn_hidden=5504, max_seq_len=2048)
         plan = MeshPlan(make_mesh(devices=jax.devices()[:1]))
-        t_params = L.init_params(tcfg, jax.random.PRNGKey(0))
-        init_state, step = make_train_step(tcfg, plan)
-        state = shard_state(plan, init_state(t_params))
         batch, seq = 4, 2048
         tokens = jax.random.randint(jax.random.PRNGKey(2), (batch, seq), 0,
                                     tcfg.vocab_size)
-        state, loss = step(state, tokens)  # compile + first step
-        _sync(loss)
-        import time as _t
-
-        times = []
-        for _ in range(3):
-            t0 = _t.perf_counter()
-            state, loss = step(state, tokens)
-            _sync(loss)
-            times.append(_t.perf_counter() - t0)
-        t = min(times)
         n_params = tcfg.param_count()
         flops = 6 * n_params * batch * seq  # fwd 2N + bwd 4N per token
+        import gc
+        import time as _t
+
+        def measure_step(**kw) -> float:
+            """Train-step time for one make_train_step config. Fresh
+            params/state per variant, freed before returning, so four
+            11 GB optimizer states never coexist."""
+            t_params = L.init_params(tcfg, jax.random.PRNGKey(0))
+            init_state, step = make_train_step(tcfg, plan, **kw)
+            state = shard_state(plan, init_state(t_params))
+            del t_params
+            state, loss = step(state, tokens)  # compile + first step
+            _sync(loss)
+            times = []
+            for _ in range(3):
+                t0 = _t.perf_counter()
+                state, loss = step(state, tokens)
+                _sync(loss)
+                times.append(_t.perf_counter() - t0)
+            del state
+            gc.collect()
+            return min(times)
+
+        # Headline: the default config (chunked CE, full remat).
+        t = measure_step()
         report(
             f"train step MFU (1.1B, bs={batch}, S={seq})",
             flops / t / V5E_PEAK_BF16 * 100, "% MFU",
             f"({flops / t / 1e12:.1f} TFLOP/s, {batch * seq / t:.0f} tokens/sec)",
         )
+        # Variants: where does the remaining time go, and does a cheaper
+        # remat policy fit? Each OOM-guards independently.
+        for name, kw in (
+            ("dense-CE", dict(loss_chunk=0)),
+            ("remat=dots", dict(remat="dots")),
+            ("remat=none", dict(remat="none")),
+        ):
+            try:
+                tv = measure_step(**kw)
+                report(
+                    f"train step MFU [{name}] (1.1B, bs={batch}, S={seq})",
+                    flops / tv / V5E_PEAK_BF16 * 100, "% MFU",
+                    f"({batch * seq / tv:.0f} tokens/sec)",
+                )
+            except Exception as err:
+                print(f"# train variant {name} failed: {err}", file=sys.stderr)
+                gc.collect()
+
+        # Attribution: fwd-only layer stack, CE head, grad, optimizer.
+        from kubeflow_tpu.models.train import chunked_causal_lm_loss
+
+        t_params = L.init_params(tcfg, jax.random.PRNGKey(0))
+        hid_fn = jax.jit(lambda p, t: L.forward_hidden(p, tcfg, t))
+        t_hidden = _bench_fn(hid_fn, t_params, tokens)
+        loss_fn = jax.jit(
+            lambda p, t: chunked_causal_lm_loss(p, tcfg, t)
+        )
+        t_loss = _bench_fn(loss_fn, t_params, tokens)
+        grad_fn = jax.jit(
+            jax.value_and_grad(lambda p, t: chunked_causal_lm_loss(p, tcfg, t))
+        )
+        t_grad = _bench_fn(lambda p, t: grad_fn(p, t)[0], t_params, tokens)
+        report("train profile fwd(hidden) ms", t_hidden * 1e3, "ms",
+               f"({2 * n_params * batch * seq / t_hidden / 1e12:.1f} TFLOP/s fwd)")
+        report("train profile CE-head ms", (t_loss - t_hidden) * 1e3, "ms")
+        report("train profile bwd ms", (t_grad - t_loss) * 1e3, "ms")
+        report("train profile optimizer+update ms", (t - t_grad) * 1e3, "ms")
 
     def batched_section():
         # Batched-serving throughput: the continuous-batching stack's

@@ -346,26 +346,59 @@ def _mlp(layer: dict, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
 # Entry points
 
 
+# Remat policies for the layer scan, keyed by name so callers (train step,
+# bench) can trade HBM for recompute FLOPs per hardware budget:
+# - "full": rematerialize everything; the scan stores only the (B, S, dim)
+#   carry per layer. Cheapest memory, recomputes the whole layer forward
+#   (~2N extra FLOPs) in the backward — the default that always fits.
+# - "dots": save MXU outputs (dot_general results with no batch dims —
+#   the qkv/wo/mlp projections), recompute only VPU-cheap elementwise ops
+#   (norms, rope, activations). Removes most of the recompute FLOPs at
+#   ~B*S*(heads*d + 2*ffn + 2*dim) saved bytes per layer.
+# - "none": no checkpointing; XLA stores what it needs. Fastest when it
+#   fits (small models / short S).
+_REMAT_POLICIES = {
+    "full": lambda body: jax.checkpoint(body),
+    "dots": lambda body: jax.checkpoint(
+        body,
+        policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    ),
+    "none": lambda body: body,
+}
+
+
+@partial(jax.jit, static_argnames=("cfg", "attn_impl", "remat"))
+def forward_hidden(
+    params: dict, cfg: LlamaConfig, tokens: jax.Array,
+    attn_impl: str = "auto", remat: str = "full",
+) -> jax.Array:
+    """Forward through the layer stack + final norm: tokens (B, S) →
+    hidden (B, S, dim), WITHOUT the lm-head projection — the seam the
+    chunked cross-entropy needs (models/train.py) so full (B, S, vocab)
+    logits never materialize. ``remat`` picks the _REMAT_POLICIES entry.
+    Free at inference (no cotangent → no recompute)."""
+    if remat not in _REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat policy {remat!r} (want {sorted(_REMAT_POLICIES)})"
+        )
+    x = _embed(params, cfg, tokens)
+    cos, sin = rope_frequencies(cfg, jnp.arange(tokens.shape[1]))
+
+    def body(x, layer):
+        return _layer_fwd(layer, cfg, x, cos, sin, attn_impl), None
+
+    x, _ = jax.lax.scan(_REMAT_POLICIES[remat](body), x, params["layers"])
+    return _norm(x, params["final_norm"], cfg)
+
+
 @partial(jax.jit, static_argnames=("cfg", "attn_impl"))
 def forward(
     params: dict, cfg: LlamaConfig, tokens: jax.Array, attn_impl: str = "auto"
 ) -> jax.Array:
     """Full prefill / training forward: tokens (B, S) → logits (B, S, V)."""
-    x = _embed(params, cfg, tokens)
-    cos, sin = rope_frequencies(cfg, jnp.arange(tokens.shape[1]))
-
-    # Rematerialize each layer in the backward pass: the scan stores only
-    # the (B, S, dim) carry per layer instead of every attention/MLP
-    # intermediate (the f32 gate/up buffers alone are ~dim·ffn_hidden·2
-    # per token) — the standard TPU FLOPs-for-HBM trade. Free at inference
-    # (no cotangent → no recompute).
-    @jax.checkpoint
-    def body(x, layer):
-        return _layer_fwd(layer, cfg, x, cos, sin, attn_impl), None
-
-    x, _ = jax.lax.scan(body, x, params["layers"])
-    x = _norm(x, params["final_norm"], cfg)
-    return _lm_head_logits(x, params)
+    return _lm_head_logits(
+        forward_hidden(params, cfg, tokens, attn_impl), params
+    )
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:

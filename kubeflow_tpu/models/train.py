@@ -20,7 +20,9 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from kubeflow_tpu.models.llama import LlamaConfig, forward
+from kubeflow_tpu.models.llama import (
+    LlamaConfig, _lm_head_logits, forward, forward_hidden,
+)
 from kubeflow_tpu.parallel.mesh import MeshPlan
 from kubeflow_tpu.parallel.ring_attention import make_sharded_ring_attention
 from kubeflow_tpu.parallel.ulysses import make_sharded_ulysses_attention
@@ -42,6 +44,61 @@ def causal_lm_loss(
 ) -> jax.Array:
     """Next-token cross entropy over (B, S) token batches."""
     return jnp.mean(per_token_nll(params, cfg, tokens, attn_impl))
+
+
+def chunked_causal_lm_loss(
+    params: dict, cfg: LlamaConfig, tokens: jax.Array,
+    attn_impl: str = "auto", chunk: int = 512, remat: str = "full",
+) -> jax.Array:
+    """causal_lm_loss without ever materializing (B, S, vocab) logits.
+
+    The lm-head + cross entropy run per sequence CHUNK inside a
+    checkpointed lax.scan: each step projects (B, chunk, dim) → logits,
+    reduces them to (lse − target logit), and the remat recomputes the
+    chunk's logits in the backward — so peak HBM holds one chunk of f32
+    logits instead of the full batch (≈1 GB at B=4, S=2048, V=32k, plus
+    log_softmax temporaries). Numerically identical to causal_lm_loss
+    (same lse − target arithmetic in f32). ``remat`` threads through to
+    the layer stack (llama._REMAT_POLICIES)."""
+    b, s = tokens.shape
+    if s < 2:
+        raise ValueError(
+            f"causal LM loss needs sequences of >= 2 tokens, got S={s}"
+        )
+    if chunk <= 0:
+        raise ValueError(f"chunk must be positive, got {chunk}")
+    hidden = forward_hidden(params, cfg, tokens, attn_impl, remat=remat)
+    hidden = hidden[:, :-1]
+    targets = tokens[:, 1:]
+    n_pos = s - 1
+    chunk = min(chunk, n_pos)
+    n_chunks = n_pos // chunk
+    tail = n_pos - n_chunks * chunk  # S-1 is rarely chunk-aligned
+
+    def chunk_nll_sum(h_c, t_c):
+        logits = _lm_head_logits(h_c, params)  # (B, c, V) f32, one chunk
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+        return jnp.sum(lse - tgt)
+
+    def body(acc, xs):
+        h_c, t_c = xs
+        return acc + chunk_nll_sum(h_c, t_c), None
+
+    h_main = hidden[:, : n_chunks * chunk].reshape(
+        b, n_chunks, chunk, -1
+    ).transpose(1, 0, 2, 3)
+    t_main = targets[:, : n_chunks * chunk].reshape(
+        b, n_chunks, chunk
+    ).transpose(1, 0, 2)
+    total, _ = jax.lax.scan(
+        jax.checkpoint(body), jnp.zeros((), jnp.float32), (h_main, t_main)
+    )
+    if tail:
+        total = total + chunk_nll_sum(
+            hidden[:, n_chunks * chunk:], targets[:, n_chunks * chunk:]
+        )
+    return total / (b * n_pos)
 
 
 def make_optimizer(
@@ -92,6 +149,8 @@ def make_train_step(
     use_ring_sp: Optional[bool] = None,
     sp_impl: str = "ring",
     grad_accum: int = 1,
+    loss_chunk: int = 512,
+    remat: str = "full",
 ):
     """Build (init_state, train_step) jitted over plan.mesh.
 
@@ -106,6 +165,11 @@ def make_train_step(
     the HBM lever for effective batch sizes past what activations allow
     (composes with jax.checkpoint inside the loss). The batch's leading
     dim must be divisible by grad_accum.
+
+    ``loss_chunk`` > 0 uses chunked_causal_lm_loss (full (B, S, vocab)
+    logits never materialize); 0 falls back to the dense loss.
+    ``remat`` picks the layer-stack checkpoint policy
+    (llama._REMAT_POLICIES: "full" | "dots" | "none").
     """
     if sp_impl not in ("ring", "ulysses"):
         # Validate even when sp ends up inactive: a typo'd sp_impl on an
@@ -129,8 +193,17 @@ def make_train_step(
         opt_state = optimizer.init(params)
         return {"params": params, "opt_state": opt_state, "step": jnp.zeros((), jnp.int32)}
 
+    if loss_chunk:
+        def _loss(params, tokens):
+            return chunked_causal_lm_loss(
+                params, cfg, tokens, attn_impl, chunk=loss_chunk, remat=remat
+            )
+    else:
+        def _loss(params, tokens):
+            return causal_lm_loss(params, cfg, tokens, attn_impl)
+
     def _grads(params, tokens):
-        return jax.value_and_grad(causal_lm_loss)(params, cfg, tokens, attn_impl)
+        return jax.value_and_grad(_loss)(params, tokens)
 
     def train_step(state, tokens):
         if grad_accum == 1:
