@@ -263,14 +263,42 @@ class ExperimentRunner:
         env.prober.activities = [
             HostActivity(host=f"h{i}", reachable=False) for i in range(4)
         ]
+        before = (
+            env.cluster.get("Notebook", "nb", "ns")["metadata"]
+            .get("annotations", {}).get(ann.LAST_ACTIVITY)
+        )
         for _ in range(checks):
             env.manager.tick(31 * 60)  # past the idle deadline each time
         obj = env.cluster.get("Notebook", "nb", "ns")
-        culled = ann.STOP in obj["metadata"].get("annotations", {})
+        anns = obj["metadata"].get("annotations", {})
+        culled_blind = ann.STOP in anns
+        activity_flapped = anns.get(ann.LAST_ACTIVITY) != before
+
+        # Hypothesis clause 2: once the partition heals, culling resumes
+        # from real observations — the unreachable window must not have
+        # wedged the culler.
+        env.prober.activities = [
+            HostActivity(host=f"h{i}", reachable=True) for i in range(4)
+        ]
+        for _ in range(2):
+            env.manager.tick(31 * 60)
+        healed_anns = (
+            env.cluster.get("Notebook", "nb", "ns")["metadata"]
+            .get("annotations", {})
+        )
+        resumed = ann.STOP in healed_anns
+        failures = []
+        if culled_blind:
+            failures.append("culled an unobservable slice")
+        if activity_flapped:
+            failures.append("last-activity flapped during partition")
+        if not resumed:
+            failures.append("culling did not resume after heal")
         return ExperimentResult(
             doc["metadata"]["name"],
-            passed=not culled,
-            detail="culled an unobservable slice" if culled else "",
+            passed=not failures,
+            detail="; ".join(failures),
+            observations={"healed_culled": resumed},
         )
 
     def _run_controller_outage(self, doc: dict) -> ExperimentResult:

@@ -45,6 +45,12 @@ class FakeProber:
             HostActivity(host=f"h{i}", busy=(i == busy_host)) for i in range(hosts)
         ]
 
+    def set_unreachable(self, hosts: int = 1):
+        """Every probe errors (network partition / NetPol misconfig)."""
+        self.activities = [
+            HostActivity(host=f"h{i}", reachable=False) for i in range(hosts)
+        ]
+
     def probe(self, nb, hosts):
         self.probe_count += 1
         return list(self.activities)

@@ -97,6 +97,45 @@ class TestCulling:
             env.manager.tick(60.0)
         assert ann.STOP not in anns_of(env)
 
+    def test_unreachable_slice_never_culled(self):
+        """THE safety-critical culler rule: a slice whose every host probe
+        errors (network partition, NetPol misconfig) must never be culled,
+        no matter how long it stays unobservable — idle and unreachable
+        are indistinguishable, and releasing a v5p-512 on a probe failure
+        is the reference's probe-error posture generalized
+        (culling_controller.go:277-322 returns without judging).
+        Behavior under test: controller/culling.py:230-235."""
+        env = make_env(culling=True, cull_idle_min=30, check_period_min=1)
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        before = anns_of(env)[ann.LAST_ACTIVITY]
+        env.prober.set_unreachable(hosts=4)
+        # Far past cull_idle_min with zero successful probes.
+        for _ in range(120):
+            env.manager.tick(60.0)
+        a = anns_of(env)
+        assert ann.STOP not in a
+        assert env.cluster.list("Pod", "ns") != []  # slice still held
+        # Probes kept being attempted (the culler did not give up)...
+        assert env.prober.probe_count > 100
+        # ...and last-activity was never advanced by unreachable data.
+        assert a[ann.LAST_ACTIVITY] == before
+
+    def test_partition_heals_then_idle_cull_resumes(self):
+        """After the partition heals, the normal idle clock applies — the
+        unreachable window must not have poisoned the state."""
+        env = make_env(culling=True, cull_idle_min=30, check_period_min=1)
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        env.prober.set_unreachable(hosts=4)
+        for _ in range(50):
+            env.manager.tick(60.0)
+        assert ann.STOP not in anns_of(env)
+        env.prober.set_idle(hosts=4)  # partition heals, slice idle
+        for _ in range(35):
+            env.manager.tick(60.0)
+        assert ann.STOP in anns_of(env)  # now culled normally
+
     def test_stopped_notebook_annotations_cleared(self):
         env = make_env(culling=True, cull_idle_min=30)
         env.cluster.create(cpu_notebook())
