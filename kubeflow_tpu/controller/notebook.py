@@ -204,7 +204,7 @@ class NotebookReconciler(Reconciler):
                 )
             )
             if scaling_up:
-                self._maybe_claim_warm_slice(obj, nb, slice_topo)
+                self._maybe_claim_warm_slice(obj, nb, slice_topo, slice_id)
             created_any |= self._reconcile_statefulset(obj, sts, existing)
         if created_any:
             self.metrics.create_total.inc()
@@ -227,6 +227,8 @@ class NotebookReconciler(Reconciler):
                     f"name(s) {', '.join(fallback_names)}",
                 )
         self._prune_stale_slice_sts(nb, slice_count)
+        if nb.stopped:
+            self._clear_claim_annotations(obj, nb)
 
         service = generate_service(nb)
         helper.reconcile_child(self.client, obj, service, helper.copy_service_fields)
@@ -242,14 +244,40 @@ class NotebookReconciler(Reconciler):
         return Result()
 
     # ------------------------------------------------------------------
-    def _maybe_claim_warm_slice(self, obj: dict, nb: Notebook, topo) -> None:
+    @staticmethod
+    def _claim_marker_key(slice_id: int) -> str:
+        """Claim-intent marker, keyed PER SLICE: each slice of a
+        multislice notebook claims its own placeholder, so slice 0's
+        marker must not suppress slice 1's claim. Slice 0 keeps the bare
+        CLAIMED_FROM name (the single-slice contract tests/users see)."""
+        from kubeflow_tpu.api.slicepool import CLAIMED_FROM
+
+        return CLAIMED_FROM if slice_id == 0 else f"{CLAIMED_FROM}.{slice_id}"
+
+    def _maybe_claim_warm_slice(
+        self, obj: dict, nb: Notebook, topo, slice_id: int = 0
+    ) -> None:
         """Claim a warm SlicePool placeholder BEFORE the slice scales up,
         so the freed chips/warm nodes are available when the slice pods
         first schedule (kubeflow_tpu.controller.slicepool). The caller only
         invokes this on a 0→N replica transition (creation with no lock,
         lock release, or resume) — never the steady-state reconcile path."""
-        from kubeflow_tpu.api.slicepool import CLAIMED_FROM
         from kubeflow_tpu.controller.slicepool import claim_warm_slice
+
+        marker = self._claim_marker_key(slice_id)
+        # One transition, one claim per slice: a prior pass may have
+        # claimed but its replica update is not visible yet (stale cache
+        # read, or the STS write failed after the claim) — the claim
+        # marker on a FRESH read is the intent record that stops a second
+        # placeholder being drained for the same scale-up. Markers are
+        # cleared whenever the notebook is stopped
+        # (_clear_claim_annotations), so a resume claims again.
+        try:
+            fresh = self.client.get("Notebook", nb.name, nb.namespace)
+        except NotFoundError:
+            return
+        if marker in obj_util.annotations_of(fresh):
+            return
 
         pools = self.client.list("SlicePool", nb.namespace)
         if not pools:
@@ -265,11 +293,43 @@ class NotebookReconciler(Reconciler):
 
         def record():
             fresh = self.client.get("Notebook", nb.name, nb.namespace)
-            if obj_util.annotations_of(fresh).get(CLAIMED_FROM) != pool:
-                obj_util.set_annotation(fresh, CLAIMED_FROM, pool)
+            if obj_util.annotations_of(fresh).get(marker) != pool:
+                obj_util.set_annotation(fresh, marker, pool)
                 self.client.update(fresh)
 
         retry_on_conflict(record)
+
+    def _clear_claim_annotations(self, obj: dict, nb: Notebook) -> None:
+        """A stopped notebook holds no slice capacity: drop the
+        claimed-from-pool markers (every per-slice key) so the next 0→N
+        transition (resume) claims fresh warm slices, while repeated
+        reconciles of the SAME transition stay idempotent
+        (_maybe_claim_warm_slice skips on the marker)."""
+        from kubeflow_tpu.api.slicepool import CLAIMED_FROM
+
+        def markers(o) -> list[str]:
+            return [
+                k for k in obj_util.annotations_of(o)
+                if k == CLAIMED_FROM or k.startswith(f"{CLAIMED_FROM}.")
+            ]
+
+        # Steady-state cheapness: most stopped notebooks carry no marker;
+        # decide on the already-fetched object before paying a fresh GET.
+        if not markers(obj):
+            return
+
+        def clear():
+            try:
+                fresh = self.client.get("Notebook", nb.name, nb.namespace)
+            except NotFoundError:
+                return
+            found = markers(fresh)
+            if found:
+                for k in found:
+                    obj_util.remove_annotation(fresh, k)
+                self.client.update(fresh)
+
+        retry_on_conflict(clear)
 
     # ------------------------------------------------------------------
     def _reconcile_statefulset(

@@ -332,3 +332,91 @@ class TestClaimPath:
         text = env.metrics.expose().decode()
         assert "tpu_slicepool_claims_total 1.0" in text
         assert len(_warm_stses(env)) == 2  # claimed one refilled, other kept
+
+    def test_repeated_zero_replica_reconcile_claims_once(self):
+        """The claim is keyed on the CLAIMED_FROM intent marker, not on
+        observed replicas: a reconcile that runs while the replica update
+        is not yet visible (stale cache read, or the STS write failed
+        right after the claim) must NOT drain a second placeholder for
+        the same scale-up."""
+        env = make_env(
+            node_pools=(
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+            )
+        )
+        env.cluster.create(_pool(warm=2))
+        env.manager.run_until_idle()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+
+        # Simulate the not-yet-visible replica update: the STS reads back
+        # at replicas 0 while the claim annotation is already recorded.
+        sts = env.cluster.get("StatefulSet", "nb", "ns")
+        sts["spec"]["replicas"] = 0
+        env.cluster.update(sts)
+        env.manager.run_until_idle()
+
+        text = env.metrics.expose().decode()
+        assert "tpu_slicepool_claims_total 1.0" in text  # no double claim
+        # The reconciler restored the replica count (level-triggered).
+        sts = env.cluster.get("StatefulSet", "nb", "ns")
+        assert sts["spec"]["replicas"] == 4
+
+    def test_claim_marker_cleared_while_stopped(self):
+        from kubeflow_tpu.api import annotations as ann
+
+        env = make_env()
+        env.cluster.create(_pool(warm=1))
+        env.manager.run_until_idle()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert sp.CLAIMED_FROM in nb["metadata"]["annotations"]
+
+        nb["metadata"]["annotations"][ann.STOP] = "2026-07-30T00:00:00Z"
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert sp.CLAIMED_FROM not in nb["metadata"].get("annotations", {})
+
+
+    def test_multislice_notebook_claims_one_placeholder_per_slice(self):
+        """Each slice of a multislice notebook is its own warm-capacity
+        claim: the per-slice claim markers (CLAIMED_FROM, CLAIMED_FROM.1)
+        must not suppress one another."""
+        from kubeflow_tpu.api.notebook import TPUSpec, new_notebook
+
+        env = make_env(
+            node_pools=(
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+            )
+        )
+        env.cluster.create(_pool(warm=2))
+        env.manager.run_until_idle()
+        assert len(_warm_stses(env)) == 2
+
+        env.cluster.create(new_notebook(
+            "ms", "ns", image="jax:latest",
+            tpu=TPUSpec(accelerator="v5e", topology="4x4", slice_count=2),
+        ))
+        env.manager.run_until_idle()
+
+        text = env.metrics.expose().decode()
+        assert "tpu_slicepool_claims_total 2.0" in text
+        nb = env.cluster.get("Notebook", "ms", "ns")
+        anns = nb["metadata"]["annotations"]
+        assert anns[sp.CLAIMED_FROM] == "pool"
+        assert anns[f"{sp.CLAIMED_FROM}.1"] == "pool"
+
+        # Stop clears BOTH markers.
+        from kubeflow_tpu.api import annotations as ann
+        nb["metadata"]["annotations"][ann.STOP] = "2026-07-30T00:00:00Z"
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        anns = env.cluster.get("Notebook", "ms", "ns")["metadata"].get(
+            "annotations", {})
+        assert sp.CLAIMED_FROM not in anns
+        assert f"{sp.CLAIMED_FROM}.1" not in anns
