@@ -61,16 +61,48 @@ TPU_PROFILING_PORT = "notebooks.kubeflow.org/tpu-profiling-port"
 PROFILING_ENV_NAME = "KUBEFLOW_TPU_PROFILING_PORT"
 
 
-def parse_profiling_port(value) -> "int | None":
-    """THE one parser for the profiling port (webhooks, NetworkPolicy,
-    status, bootstrap all share it): a port in 1024..65535, else None.
+def _load_reserved_ports() -> dict:
+    from kubeflow_tpu.api import names
+
+    return {
+        names.NOTEBOOK_PORT: "the notebook server",
+        names.RBAC_PROXY_PORT: "the kube-rbac-proxy sidecar",
+        names.JAX_COORDINATOR_PORT: "the JAX distributed coordinator",
+        names.MEGASCALE_PORT: "the multislice (megascale) coordinator",
+    }
+
+
+# Ports already claimed inside a notebook pod: a profiling server on any
+# of these would collide at bootstrap (jax.profiler.start_server fails
+# AFTER admission passed — exactly the late failure admission exists to
+# prevent).
+RESERVED_POD_PORTS = _load_reserved_ports()
+
+
+def profiling_port_error(value) -> "str | None":
+    """Why ``value`` is not an acceptable profiling port, or None if it
+    is — the ONE place the rules live, so the webhook's denial message
+    can never diverge from what parse_profiling_port accepts.
     int() rather than isdigit() — Unicode digits like '²' pass isdigit()
     but crash int(), and an admission path must deny cleanly, not 500."""
     try:
         port = int(str(value).strip())
     except (TypeError, ValueError):
+        return f"{value!r} is not a port in 1024..65535"
+    if not 1024 <= port <= 65535:
+        return f"{value!r} is not a port in 1024..65535"
+    if port in RESERVED_POD_PORTS:
+        return f"port {port} is already used in-pod by {RESERVED_POD_PORTS[port]}"
+    return None
+
+
+def parse_profiling_port(value) -> "int | None":
+    """THE one parser for the profiling port (webhooks, NetworkPolicy,
+    status, bootstrap all share it): a port in 1024..65535 that is not
+    already claimed in-pod (RESERVED_POD_PORTS), else None."""
+    if profiling_port_error(value) is not None:
         return None
-    return port if 1024 <= port <= 65535 else None
+    return int(str(value).strip())
 
 # -- labels ------------------------------------------------------------------
 NOTEBOOK_NAME_LABEL = "notebook-name"

@@ -47,11 +47,12 @@ class NotebookValidatingWebhook:
             )
 
         prof = nb.annotations.get(ann.TPU_PROFILING_PORT)
-        if prof is not None and ann.parse_profiling_port(prof) is None:
-            raise WebhookDeniedError(
-                f"annotation {ann.TPU_PROFILING_PORT}: {prof!r} is not "
-                "a port in 1024..65535"
-            )
+        if prof is not None:
+            why = ann.profiling_port_error(prof)
+            if why is not None:
+                raise WebhookDeniedError(
+                    f"annotation {ann.TPU_PROFILING_PORT}: {why}"
+                )
 
         if req.operation != "UPDATE" or req.old_object is None:
             return

@@ -180,6 +180,19 @@ class TestProfilingOption:
                     tpu_notebook(annotations={ann.TPU_PROFILING_PORT: bad})
                 )
 
+    def test_reserved_in_pod_ports_denied(self):
+        """Ports already claimed in-pod (notebook server 8888, rbac proxy
+        8443, JAX coordinator 8476, megascale 8081) pass the 1024..65535
+        range check but would collide at bootstrap
+        (jax.profiler.start_server fails AFTER admission) — deny them at
+        admission where the conflict is explainable."""
+        env = make_env(webhooks=True)
+        for port in ("8888", "8443", "8476", "8081"):
+            with pytest.raises(WebhookDeniedError, match="already used in-pod"):
+                env.cluster.create(
+                    tpu_notebook(annotations={ann.TPU_PROFILING_PORT: port})
+                )
+
     def test_bootstrap_starts_profiler_server(self, monkeypatch):
         # runtime/__init__ re-exports the bootstrap FUNCTION under the same
         # name, shadowing the submodule attribute; resolve the module.
