@@ -224,10 +224,19 @@ class PagedBatcher(_BatcherBase):
 
     # -- allocator ---------------------------------------------------------
 
-    def _take_blocks(self, n: int) -> Optional[list[int]]:
-        """n blocks off the free list, preempting youngest-first if dry.
-        None when even preempting every other request cannot supply n."""
+    def _take_blocks(self, n: int, preempt: bool = True) -> Optional[list[int]]:
+        """n blocks off the free list. With ``preempt`` (the DECODE path:
+        a running request needs its next block), the youngest active
+        request is evicted until the pool can supply n. The ADMISSION path
+        passes preempt=False and waits for retirements instead: admitting
+        a queued request by evicting a running one degenerates into
+        preempt → full re-prefill → one decode step → preempt again,
+        O(max_new_tokens) prefills per request, exactly when the pool is
+        under pressure — vLLM's policy split. None when the pool cannot
+        supply n under the given policy."""
         while len(self._free) < n:
+            if not preempt:
+                return None
             victim = self._youngest_active()
             if victim is None:
                 return None
@@ -268,9 +277,9 @@ class PagedBatcher(_BatcherBase):
         for slot in range(self.slots):
             if self._by_slot[slot] is not None:
                 continue
-            # _take_blocks may preempt, which pushes a continuation to the
-            # queue FRONT — recompute for the new head until the (head,
-            # blocks) pair is consistent.
+            # Admission never preempts (decode-path eviction may still
+            # push a continuation to the queue FRONT between steps, so the
+            # head is re-read per attempt).
             while self._queue:
                 head = self._queue[0]
                 effective = head.prompt + head.tokens
@@ -282,11 +291,22 @@ class PagedBatcher(_BatcherBase):
                     self.prompt_bucket,
                     -(-len(effective) // self.block_size) * self.block_size,
                 )
-                blocks = self._take_blocks(bucket // self.block_size)
+                need = bucket // self.block_size
+                # Watermark (vLLM's admission reserve): keep one free block
+                # per RUNNING request on top of the admit cost — otherwise
+                # admission grabs exactly the blocks running slots need at
+                # their next boundary and the decode path immediately
+                # evicts the fresh admit (one-step-removed thrash).
+                reserve = sum(1 for r in self._by_slot if r is not None)
+                blocks = (
+                    self._take_blocks(need, preempt=False)
+                    if len(self._free) >= need + reserve else None
+                )
                 if blocks is None:
                     if not any(r is not None for r in self._by_slot):
-                        # Nothing left to preempt and still short: the pool
-                        # cannot EVER host this prompt — fail, don't spin.
+                        # Nothing running to wait on and still short: the
+                        # pool cannot EVER host this prompt — fail, don't
+                        # spin.
                         raise RuntimeError(
                             f"block pool too small: {bucket // self.block_size}"
                             f" blocks needed for a {len(effective)}-token "
@@ -294,9 +314,7 @@ class PagedBatcher(_BatcherBase):
                             "raise num_blocks"
                         )
                     return  # pool busy; retry after in-flight slots retire
-                if self._queue and self._queue[0] is head:
-                    break
-                self._free.extend(blocks)  # head changed; recompute
+                break
             else:
                 continue  # queue drained for this slot
             req = self._queue.pop(0)

@@ -111,6 +111,37 @@ class TestPagedBatcher:
         assert out[rid2] == []
         assert pb2.free_blocks == 7
 
+    def test_admission_never_thrashes_prefills(self, tiny, monkeypatch):
+        """Admission must WAIT for retirements, not preempt running
+        requests: evict-to-admit degenerates into preempt → full
+        re-prefill → one decode step → preempt again, O(max_new_tokens)
+        prefills per request under pressure. Bound: one initial prefill
+        per request plus at most one resume per decode-path preemption —
+        far below the thrash regime (~max_new_tokens × requests)."""
+        from kubeflow_tpu.models import paged as paged_mod
+
+        cfg, params = tiny
+        real_admit = paged_mod._paged_admit
+        calls = {"n": 0}
+
+        def counting_admit(*a, **k):
+            calls["n"] += 1
+            return real_admit(*a, **k)
+
+        monkeypatch.setattr(paged_mod, "_paged_admit", counting_admit)
+        gen = GenerationConfig(max_new_tokens=8, eos_id=-1)
+        # Tight pool: 5 usable blocks, 4 requests of 2-3 blocks each, so
+        # the queue is never empty while slots run.
+        pb = PagedBatcher(params, cfg, gen=gen, slots=3, num_blocks=6,
+                          block_size=8, prompt_bucket=16)
+        prompts = _prompts(cfg, 4, key=23)
+        rids = [pb.submit(p) for p in prompts]
+        results = pb.run()
+        assert set(results) == set(rids)
+        # 4 initial prefills + decode-path preemption resumes; the thrash
+        # regime would be ~4 × 8 = 32.
+        assert calls["n"] <= 8, f"{calls['n']} prefills for 4 requests"
+
     def test_pool_too_small_raises(self, tiny):
         cfg, params = tiny
         gen = GenerationConfig(max_new_tokens=8, eos_id=-1)
