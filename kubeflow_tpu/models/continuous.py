@@ -92,7 +92,7 @@ def _admit_slot(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "temperature", "top_k", "top_p"),
+    static_argnames=("cfg", "temperature", "top_k", "top_p", "decode_attn"),
     donate_argnums=(3,),
 )
 def _cb_step(
@@ -106,8 +106,13 @@ def _cb_step(
     temperature: float,
     top_k: int,
     top_p: float,
+    decode_attn=None,  # mesh-bound SP decode (make_sharded_sp_decode)
 ) -> tuple[jax.Array, dict]:
-    """One decode step across every slot at its own position."""
+    """One decode step across every slot at its own position.
+
+    ``decode_attn`` (static) swaps the attention for a mesh-bound
+    sequence-parallel split-KV decode when the cache's sequence axis is
+    sharded over sp; None is the dense/GSPMD path."""
     x = _embed(params, cfg, tokens)  # (B, 1, D)
     cos, sin = rope_frequencies(cfg, positions)  # (B, half)
 
@@ -127,10 +132,20 @@ def _cb_step(
         v = _split_heads(hv, cfg.n_kv_heads)
         k_cache = vwrite(k_cache, k, positions)
         v_cache = vwrite(v_cache, v, positions)
-        attn = _gqa_decode_attention(
-            q, k_cache, v_cache, positions, window=cfg.sliding_window,
-            kv_mask=kv_mask, per_batch=True,
-        )
+        if decode_attn is None:
+            attn = _gqa_decode_attention(
+                q, k_cache, v_cache, positions, window=cfg.sliding_window,
+                kv_mask=kv_mask, per_batch=True,
+            )
+        else:
+            # GQA-native split-KV decode: the unrepeated cache shard goes
+            # straight in (sp_decode_attention folds the group mapping) —
+            # decode is KV-bandwidth-bound, so a rep-times-broadcast here
+            # would multiply the step's HBM traffic.
+            attn = decode_attn(
+                q, k_cache, v_cache, positions, window=cfg.sliding_window,
+                kv_mask=kv_mask, per_batch=True,
+            )
         x = x + _mm(_merge_heads(attn), layer["wo"])
         h = _norm(x, layer["mlp_norm"], cfg)
         x = x + _mlp(layer, h, cfg)
@@ -234,6 +249,7 @@ class ContinuousBatcher(_BatcherBase):
         cache_len: int = 1024,
         prompt_bucket: int = 64,
         key: Optional[jax.Array] = None,
+        plan=None,  # parallel.mesh.MeshPlan → tp/sp-sharded serving
     ):
         self.gen = gen or GenerationConfig()
         if prompt_bucket + self.gen.max_new_tokens > cache_len:
@@ -250,6 +266,43 @@ class ContinuousBatcher(_BatcherBase):
         # Host-side mutable state; uploaded once per step.
         self.positions = np.zeros((slots,), np.int32)
         self.tokens = np.full((slots, 1), self.gen.pad_id, np.int32)
+        self._decode_attn = None
+        if plan is not None:
+            # Multi-host serving: params tp-sharded per the model-wide
+            # plan; the cache's kv-head axis over tp and its SEQUENCE axis
+            # over sp; kv_mask follows the cache columns. The jitted
+            # programs are unchanged — GSPMD propagates the shardings and
+            # inserts the collectives (psum for tp matmuls); when sp > 1
+            # the decode attention swaps to the explicit split-KV
+            # shard_map (flash-decoding pmax/psum merge) so the cache read
+            # stays local to each sp shard.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from kubeflow_tpu.parallel.ring_attention import (
+                make_sharded_sp_decode,
+            )
+
+            mesh = plan.mesh
+            if cfg.n_kv_heads % max(1, mesh.shape.get("tp", 1)):
+                raise ValueError(
+                    f"tp={mesh.shape.get('tp')} must divide n_kv_heads="
+                    f"{cfg.n_kv_heads} for sharded serving"
+                )
+            sp = mesh.shape.get("sp", 1)
+            if sp > 1 and cache_len % sp:
+                raise ValueError(
+                    f"cache_len {cache_len} not divisible by sp={sp}"
+                )
+            self.params = plan.shard_params(params)
+            self.cache = jax.device_put(
+                self.cache,
+                NamedSharding(mesh, P(None, None, "tp", "sp", None)),
+            )
+            self.kv_mask = jax.device_put(
+                self.kv_mask, NamedSharding(mesh, P(None, "sp"))
+            )
+            if sp > 1:
+                self._decode_attn = make_sharded_sp_decode(mesh)
         self._init_base(self.gen, slots, prompt_bucket)
 
     # -- internals ---------------------------------------------------------
@@ -297,6 +350,7 @@ class ContinuousBatcher(_BatcherBase):
             self.params, self.cfg, jnp.array(self.tokens), self.cache,
             jnp.array(self.positions), self.kv_mask, sub,
             self.gen.temperature, self.gen.top_k, self.gen.top_p,
+            decode_attn=self._decode_attn,
         )
         # The emitted token will occupy the next cache index of its slot.
         for slot in active:

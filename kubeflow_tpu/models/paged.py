@@ -184,6 +184,7 @@ class PagedBatcher(_BatcherBase):
         block_size: int = 16,
         prompt_bucket: int = 64,
         key: Optional[jax.Array] = None,
+        plan=None,  # parallel.mesh.MeshPlan → tp-sharded serving
     ):
         self.gen = gen or GenerationConfig()
         if prompt_bucket % block_size:
@@ -206,6 +207,33 @@ class PagedBatcher(_BatcherBase):
         ) // block_size + 1
         self.key = jax.random.PRNGKey(0) if key is None else key
         self.pool = init_block_pool(cfg, num_blocks, block_size)
+        if plan is not None:
+            # tp-sharded paged serving: params per the model-wide plan,
+            # the pool's kv-head axis over tp; GSPMD propagates through
+            # the unchanged jitted step (psum for tp matmuls). Sequence
+            # sharding (sp) is NOT supported here — a paged pool shards
+            # by BLOCK ownership, not by contiguous sequence ranges, so
+            # the split-KV sp merge does not apply; use ContinuousBatcher
+            # for sp-sharded caches.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = plan.mesh
+            if mesh.shape.get("sp", 1) > 1:
+                raise ValueError(
+                    "PagedBatcher does not support sp-sharded meshes; "
+                    "the block pool has no contiguous sequence axis to "
+                    "shard (use ContinuousBatcher for sp)"
+                )
+            if cfg.n_kv_heads % max(1, mesh.shape.get("tp", 1)):
+                raise ValueError(
+                    f"tp={mesh.shape.get('tp')} must divide n_kv_heads="
+                    f"{cfg.n_kv_heads} for sharded serving"
+                )
+            self.params = plan.shard_params(params)
+            self.pool = jax.device_put(
+                self.pool,
+                NamedSharding(mesh, P(None, None, "tp", None, None)),
+            )
         self.kv_mask = jnp.zeros((slots, self.max_blocks * block_size), bool)
         self.tables = np.zeros((slots, self.max_blocks), np.int32)
         self.positions = np.zeros((slots,), np.int32)

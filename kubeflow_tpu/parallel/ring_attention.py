@@ -34,7 +34,7 @@ sequence over sp — so dp/tp/sp all compose in one jitted step.
 from __future__ import annotations
 
 import math
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
@@ -160,55 +160,71 @@ def ring_attention(
 
 def sp_decode_attention(
     q: jax.Array,  # REPLICATED over sp: (B, H, Sq, D) — Sq small (1..K)
-    k: jax.Array,  # local cache shard (B, H, Skl, D)
+    k: jax.Array,  # local cache shard (B, Hkv, Skl, D), UNREPEATED (GQA)
     v: jax.Array,
-    position,  # scalar or (Sq,): absolute position(s) of the queries
+    position,  # scalar or (Sq,); (B,) with per_batch=True
     axis_name: str = "sp",
     window: int = 0,
     kv_mask: Optional[jax.Array] = None,  # local (B, Skl) valid cache slots
+    per_batch: bool = False,
 ) -> jax.Array:
     """Split-KV decode: each device attends its local KV-cache shard, then
     the partial softmaxes merge across ``sp`` with pmax/psum (the
     flash-decoding reduction). MUST run inside shard_map over axis_name.
 
+    GQA-native: k/v carry their REAL head count (H % Hkv == 0); q folds
+    to (B, Hkv, rep, Sq, D) against the unrepeated shard, so decode —
+    which is KV-bandwidth-bound — never reads a rep-times-repeated cache.
+
     Device r's cache shard covers absolute slots r*Skl .. (r+1)*Skl-1.
     Query i attends slots <= position[i] (and > position[i]-window when
-    windowed). Returns the merged (B, H, Sq, D) on every device.
+    windowed). ``per_batch`` positions are (B,) — continuous-batching
+    decode, where every slot sits at its own offset (Sq == 1). Returns
+    the merged (B, H, Sq, D) on every device.
     """
     my_idx = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
+    hkv = k.shape[1]
     skl = k.shape[2]
     scale = 1.0 / math.sqrt(d)
+    qg = q.reshape(b, hkv, h // hkv, sq, d)
     # Native-dtype MXU operands, f32 accumulation (see ring step).
     s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32,
-    ) * scale
+        "bgrqd,bgkd->bgrqk", qg, k, preferred_element_type=jnp.float32,
+    ) * scale  # (B, G, R, Sq, Skl)
     pos = jnp.asarray(position)
-    if pos.ndim == 0:
-        pos = jnp.broadcast_to(pos, (sq,))
-    q_pos = pos[:, None]  # (Sq, 1)
     k_pos = my_idx * skl + jnp.arange(skl)[None, :]
-    mask = k_pos <= q_pos
-    if window:
-        mask = mask & (k_pos > q_pos - window)
-    s = jnp.where(mask[None, None], s, NEG_INF)
+    if per_batch:
+        q_pos = pos[:, None]  # (B, 1)
+        mask = k_pos <= q_pos  # (B, Skl)
+        if window:
+            mask = mask & (k_pos > q_pos - window)
+        s = jnp.where(mask[:, None, None, None, :], s, NEG_INF)
+    else:
+        if pos.ndim == 0:
+            pos = jnp.broadcast_to(pos, (sq,))
+        q_pos = pos[:, None]  # (Sq, 1)
+        mask = k_pos <= q_pos
+        if window:
+            mask = mask & (k_pos > q_pos - window)
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
     if kv_mask is not None:
-        s = jnp.where(kv_mask[:, None, None, :], s, NEG_INF)
-    m_local = jnp.max(s, axis=-1)  # (B, H, Sq)
+        s = jnp.where(kv_mask[:, None, None, None, :], s, NEG_INF)
+    m_local = jnp.max(s, axis=-1)  # (B, G, R, Sq)
     # Shards whose every slot is masked contribute exp(-inf)=0 cleanly.
     m = jax.lax.pmax(m_local, axis_name)
     p = jnp.exp(s - m[..., None])
     l = jax.lax.psum(jnp.sum(p, axis=-1), axis_name)
     o = jax.lax.psum(
         jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+            "bgrqk,bgkd->bgrqd", p.astype(v.dtype), v,
             preferred_element_type=jnp.float32,
         ),
         axis_name,
     )
     out = o / jnp.maximum(l, 1e-30)[..., None]
     out = jnp.where((m > NEG_INF * 0.5)[..., None], out, 0.0)  # safe softmax
-    return out.astype(q.dtype)
+    return out.reshape(b, h, sq, d).astype(q.dtype)
 
 
 def cached_sharded(mesh: Mesh, body, base_specs, out_spec, mask_spec):
@@ -269,10 +285,14 @@ def make_sharded_ring_attention(mesh: Mesh):
     return attention
 
 
+@lru_cache(maxsize=None)
 def make_sharded_sp_decode(mesh: Mesh):
     """Return decode(q, k_shard, v_shard, position, window, kv_mask) with
     q replicated over sp and the KV cache sequence-sharded over sp —
-    the serving-side counterpart of make_sharded_ring_attention."""
+    the serving-side counterpart of make_sharded_ring_attention. K/V may
+    be GQA-unrepeated (head axis Hkv; tp must divide it). Memoized per
+    mesh: the closure is a jit STATIC arg downstream (_cb_step), so a
+    fresh closure per caller would recompile the whole serving step."""
     q_spec = P(("dp", "fsdp"), "tp", None, None)  # q NOT sharded over sp
     kv_spec = P(("dp", "fsdp"), "tp", "sp", None)
 
@@ -287,10 +307,14 @@ def make_sharded_sp_decode(mesh: Mesh):
         P(("dp", "fsdp"), "sp"),
     )
 
-    def decode(q, k, v, position, window=0, kv_mask=None):
+    def decode(q, k, v, position, window=0, kv_mask=None, per_batch=False):
         position = jnp.asarray(position)
         if kv_mask is not None:
-            return get(True, window=window)(q, k, v, position, kv_mask)
-        return get(False, window=window)(q, k, v, position)
+            return get(True, window=window, per_batch=per_batch)(
+                q, k, v, position, kv_mask
+            )
+        return get(False, window=window, per_batch=per_batch)(
+            q, k, v, position
+        )
 
     return decode

@@ -150,3 +150,31 @@ class TestPagedBatcher:
         pb.submit([1, 2, 3])
         with pytest.raises(RuntimeError, match="pool"):
             pb.run()
+
+
+class TestShardedPaged:
+    def test_tp_sharded_matches_single_device(self, tiny):
+        from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        prompts = _prompts(cfg, 3, key=41)
+
+        def run(plan=None):
+            pb = PagedBatcher(params, cfg, gen=gen, slots=2, num_blocks=16,
+                              block_size=8, prompt_bucket=16, plan=plan)
+            rids = [pb.submit(p) for p in prompts]
+            out = pb.run()
+            return [out[r] for r in rids]
+
+        want = run()
+        plan = MeshPlan(make_mesh(tp=2, devices=jax.devices()[:2]))
+        assert want == run(plan=plan)
+
+    def test_sp_mesh_rejected(self, tiny):
+        from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+
+        cfg, params = tiny
+        plan = MeshPlan(make_mesh(tp=1, sp=2, devices=jax.devices()[:2]))
+        with pytest.raises(ValueError, match="sp"):
+            PagedBatcher(params, cfg, plan=plan)
