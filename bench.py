@@ -306,6 +306,56 @@ def run_full_bench(results: list) -> None:
             "(continuous-batching steady state, all slots active)",
         )
 
+    def spec_section():
+        # Speculative decoding's recorded numbers: acceptance rate and
+        # tok/s on the 1.1B config with a SELF-draft (acceptance 1.0 →
+        # the upper-bound speedup of the verification pipeline itself;
+        # real drafts land between this and plain decode).
+        from kubeflow_tpu.models.speculative import speculative_generate
+
+        tcfg = L.LlamaConfig(dim=2048, n_layers=16, n_heads=16, n_kv_heads=16,
+                             ffn_hidden=5504, max_seq_len=2048)
+        params = L.init_params(tcfg, jax.random.PRNGKey(0))
+        bs, plen, steps = 4, 32, 64
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (bs, plen), 0, tcfg.vocab_size
+        )
+
+        def timed_plain():
+            toks = L.generate(params, tcfg, prompt, steps=steps, cache_len=256)
+            _sync(toks)
+            import time as _t
+
+            t0 = _t.perf_counter()
+            toks = L.generate(params, tcfg, prompt, steps=steps, cache_len=256)
+            _sync(toks)
+            return _t.perf_counter() - t0
+
+        def timed_spec():
+            speculative_generate(params, tcfg, params, tcfg, prompt,
+                                 steps=steps, cache_len=256, k_spec=4)
+            import time as _t
+
+            t0 = _t.perf_counter()
+            _, stats = speculative_generate(
+                params, tcfg, params, tcfg, prompt,
+                steps=steps, cache_len=256, k_spec=4,
+            )
+            return _t.perf_counter() - t0, stats
+
+        t_plain = timed_plain()
+        t_spec, stats = timed_spec()
+        report(
+            f"spec decode tokens/sec (1.1B self-draft, bs={bs}, k=4)",
+            bs * steps / t_spec, "tokens/sec",
+            f"(plain fused {bs * steps / t_plain:.1f} tok/s, acceptance "
+            f"{stats['acceptance_rate']:.2f})",
+        )
+        results.append({
+            "metric": "spec decode acceptance rate (self-draft)",
+            "value": round(stats["acceptance_rate"], 3), "unit": "ratio",
+        })
+
     def prefill_section():
         cfg = L.LLAMA_CONFIGS["llama-2-7b"]
         params = L.init_params(cfg, jax.random.PRNGKey(0))
@@ -328,6 +378,7 @@ def run_full_bench(results: list) -> None:
     section(masked_kernel_section)
     section(train_section)
     section(batched_section)
+    section(spec_section)
     # 7B prefill LAST: it holds the most HBM, and its OOM on a small chip
     # must not rob the sections above of their measurement.
     section(prefill_section)

@@ -286,10 +286,15 @@ def apply_rope(
     ``per_batch=False``: cos/sin are (S, half), shared across the batch.
     ``per_batch=True``: cos/sin are (B, half) with S == 1 — one position
     per batch row (continuous-batching decode, where every slot sits at
-    its own offset)."""
+    its own offset). 3-D cos/sin (B, S, half) are per-batch per-position
+    (batched speculative verification: every row's chunk starts at its
+    own offset)."""
     half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
-    if per_batch:
+    if cos.ndim == 3:
+        c = cos[:, None, :, :]
+        s = sin[:, None, :, :]
+    elif per_batch:
         c = cos[:, None, None, :]
         s = sin[:, None, None, :]
     else:
@@ -576,7 +581,10 @@ def _gqa_decode_attention(
     # (continuous batching — every batch row at its own offset).
     pos = jnp.asarray(position)
     if per_batch:
-        pos_q = pos[:, None, None, None, None]  # (B, 1, 1, 1, 1)
+        if pos.ndim == 2:  # (B, Sq): per-row chunk offsets (batched spec)
+            pos_q = pos[:, None, None, :, None]
+        else:
+            pos_q = pos[:, None, None, None, None]  # (B, 1, 1, 1, 1)
     else:
         if pos.ndim == 0:
             pos = jnp.broadcast_to(pos, (sq,))
@@ -602,20 +610,15 @@ def _decode_impl(params, cfg, token, kv_cache, position, kv_mask=None):
     return logits[:, 0], cache
 
 
-def _decode_chunk_impl(params, cfg, tokens, kv_cache, position, kv_mask=None):
-    """Cached decode of a CHUNK: (B, K) tokens written at cache slots
-    ``position .. position+K-1`` → logits (B, K, V) + updated cache.
-
-    K == 1 is ordinary autoregressive decode; K > 1 is the speculative
-    verification forward — the target reads its weights ONCE for K tokens.
-    Chunk-causality: query i attends cache slots <= position+i (vector
-    positions in _gqa_decode_attention). ``kv_mask`` (B, cache_len) marks
-    valid cache slots (serving: False on left-pad slots; slots past the
-    write pointer are causally excluded anyway)."""
-    k_len = tokens.shape[1]
+def _chunk_decode_scan(params, cfg, tokens, kv_cache, cos, sin, write,
+                       attn_positions, kv_mask, per_batch):
+    """The ONE cached-chunk decode body (scan over layers), parameterized
+    by the two things the scalar- and per-row-offset variants differ in:
+    the cache ``write(cache_l, new)`` strategy and the attention position
+    argument. Keeping a single body means a future change (norm
+    placement, bias, window semantics) cannot diverge the ordinary
+    decode and batched-speculative paths."""
     x = _embed(params, cfg, tokens)
-    positions = position + jnp.arange(k_len)
-    cos, sin = rope_frequencies(cfg, positions)
 
     def body(x, scanned):
         layer, k_cache, v_cache = scanned
@@ -624,11 +627,11 @@ def _decode_chunk_impl(params, cfg, tokens, kv_cache, position, kv_mask=None):
         q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin)
         k = apply_rope(_split_heads(hk, cfg.n_kv_heads), cos, sin)
         v = _split_heads(hv, cfg.n_kv_heads)
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, position, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, position, 0))
+        k_cache = write(k_cache, k)
+        v_cache = write(v_cache, v)
         attn = _gqa_decode_attention(
-            q, k_cache, v_cache, positions, window=cfg.sliding_window,
-            kv_mask=kv_mask,
+            q, k_cache, v_cache, attn_positions, window=cfg.sliding_window,
+            kv_mask=kv_mask, per_batch=per_batch,
         )
         x = x + _mm(_merge_heads(attn), layer["wo"])
         h = _norm(x, layer["mlp_norm"], cfg)
@@ -641,6 +644,59 @@ def _decode_chunk_impl(params, cfg, tokens, kv_cache, position, kv_mask=None):
     x = _norm(x, params["final_norm"], cfg)
     logits = _lm_head_logits(x, params)  # (B, K, V)
     return logits, {"k": new_k, "v": new_v}
+
+
+def _decode_chunk_impl(params, cfg, tokens, kv_cache, position, kv_mask=None):
+    """Cached decode of a CHUNK: (B, K) tokens written at cache slots
+    ``position .. position+K-1`` → logits (B, K, V) + updated cache.
+
+    K == 1 is ordinary autoregressive decode; K > 1 is the speculative
+    verification forward — the target reads its weights ONCE for K tokens.
+    Chunk-causality: query i attends cache slots <= position+i (vector
+    positions in _gqa_decode_attention). ``kv_mask`` (B, cache_len) marks
+    valid cache slots (serving: False on left-pad slots; slots past the
+    write pointer are causally excluded anyway)."""
+    k_len = tokens.shape[1]
+    positions = position + jnp.arange(k_len)
+    cos, sin = rope_frequencies(cfg, positions)
+
+    def write(cache_l, new):
+        # One whole-batch slice write at the shared scalar offset.
+        return jax.lax.dynamic_update_slice(cache_l, new, (0, 0, position, 0))
+
+    return _chunk_decode_scan(
+        params, cfg, tokens, kv_cache, cos, sin, write, positions, kv_mask,
+        per_batch=False,
+    )
+
+
+def _decode_chunk_batch_impl(params, cfg, tokens, kv_cache, positions,
+                             kv_mask=None):
+    """Cached decode of a chunk at PER-ROW offsets: (B, K) tokens, row b
+    written at cache slots ``positions[b] .. positions[b]+K-1`` → logits
+    (B, K, V) + updated cache. The batched-speculative verification
+    forward — after round one every row has accepted a different prefix,
+    so the write pointers diverge. Chunk-causality per row: query i of
+    row b attends cache slots <= positions[b]+i. Same decode body as
+    _decode_chunk_impl (_chunk_decode_scan); only the write strategy and
+    position shapes differ."""
+    k_len = tokens.shape[1]
+    posmat = positions[:, None] + jnp.arange(k_len)[None, :]  # (B, K)
+    cos, sin = rope_frequencies(cfg, posmat.reshape(-1))
+    cos = cos.reshape(*posmat.shape, -1)  # (B, K, half)
+    sin = sin.reshape(*posmat.shape, -1)
+
+    def row_write(cache_l, new, pos):
+        # (Hkv, C, D) <- (Hkv, K, D) at this row's offset.
+        return jax.lax.dynamic_update_slice(cache_l, new, (0, pos, 0))
+
+    def write(cache_l, new):
+        return jax.vmap(row_write)(cache_l, new, positions)
+
+    return _chunk_decode_scan(
+        params, cfg, tokens, kv_cache, cos, sin, write, posmat, kv_mask,
+        per_batch=True,
+    )
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps"), donate_argnums=(3,))

@@ -1,23 +1,28 @@
 """Greedy speculative decoding: a small draft model proposes, the target
-verifies k tokens per step in ONE forward.
+verifies k tokens per step in ONE forward — batched.
 
-Decode at bs=1 is HBM-bound on the TARGET's weights; verification reads
-them once per k proposed tokens instead of once per token, so wall-clock
-approaches (accepted+1)/k_spec × the plain decode cost when the draft
-agrees often (same-family small model). Greedy acceptance makes the
-output EXACTLY the target's greedy decoding — tested token-for-token —
-so speculation is a pure latency optimization, never a quality trade.
+Decode is HBM-bound on the TARGET's weights; verification reads them once
+per k proposed tokens instead of once per token, so wall-clock approaches
+(accepted+1)/k_spec × the plain decode cost when the draft agrees often
+(same-family small model). Greedy acceptance makes the output EXACTLY the
+target's greedy decoding — tested token-for-token — so speculation is a
+pure latency optimization, never a quality trade.
 
-Mechanics per round (cache-pointer discipline is the subtle part):
+Batched rounds (the cache-pointer discipline is the subtle part): after
+round one every row has accepted a DIFFERENT prefix, so write pointers
+diverge per row. Both models decode chunks at per-row offsets
+(llama._decode_chunk_batch_impl: vmapped cache writes, (B, K) position
+matrices through rope and the causal mask). Rows that reach ``steps``
+freeze their pointer and keep riding the fixed-shape batch program —
+their slots recompute harmlessly; one compiled shape for the whole run.
+
+Per round:
 - draft autoregressively proposes d_1..d_k from its own cache,
 - target runs one chunked forward over [prev_token, d_1..d_k] (k+1 wide,
-  so every proposal is acceptable) at the current cache offset via
-  llama._decode_chunk_impl — the same body ordinary decode uses, with
-  vector positions; stale slots beyond the pointer are overwritten next
-  round and causally masked meanwhile,
-- accept the longest prefix where target argmax matches the proposal,
-  emit the target's own next token as the correction, and REWIND both
-  caches' write pointers to the accepted length.
+  so every proposal is acceptable) at each row's offset,
+- per row: accept the longest prefix where target argmax matches the
+  proposal, emit the target's own next token as the correction, advance
+  that row's pointer by accepted+1 (rewinding past rejected slots).
 
 No reference counterpart (control plane only — SURVEY.md §2.5).
 """
@@ -32,16 +37,16 @@ import numpy as np
 
 from kubeflow_tpu.models.llama import (
     LlamaConfig,
-    _decode_chunk_impl,
-    _decode_impl,
+    _decode_chunk_batch_impl,
     _prefill_impl,
     init_kv_cache,
 )
 
 
 @partial(jax.jit, static_argnames=("cfg", "k_spec"))
-def _draft_propose(params, cfg, token, kv_cache, position, k_spec):
-    """Draft k_spec greedy tokens autoregressively from ``token``.
+def _draft_propose(params, cfg, token, kv_cache, positions, k_spec):
+    """Draft k_spec greedy tokens autoregressively from ``token`` at
+    per-row ``positions`` (B,).
 
     Runs k_spec+1 decode steps: each step WRITES its input token's K/V,
     so the extra step is what lands d_k in the draft cache — on a fully
@@ -51,19 +56,21 @@ def _draft_propose(params, cfg, token, kv_cache, position, k_spec):
 
     def step(carry, _):
         tok, cache, pos = carry
-        logits, cache = _decode_impl(params, cfg, tok, cache, pos)
-        nxt = jnp.argmax(logits, axis=-1)[:, None]
+        logits, cache = _decode_chunk_batch_impl(params, cfg, tok, cache, pos)
+        nxt = jnp.argmax(logits[:, 0], axis=-1)[:, None]
         return (nxt, cache, pos + 1), nxt[:, 0]
 
     (_, cache, _), sampled = jax.lax.scan(
-        step, (token, kv_cache, position), length=k_spec + 1
+        step, (token, kv_cache, positions), length=k_spec + 1
     )
     return sampled.T[:, :k_spec], cache  # (B, k_spec); last sample unused
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def _target_verify(params, cfg, chunk, kv_cache, start_pos):
-    logits, cache = _decode_chunk_impl(params, cfg, chunk, kv_cache, start_pos)
+def _target_verify(params, cfg, chunk, kv_cache, positions):
+    logits, cache = _decode_chunk_batch_impl(
+        params, cfg, chunk, kv_cache, positions
+    )
     return jnp.argmax(logits, axis=-1), cache  # (B, K)
 
 
@@ -72,21 +79,19 @@ def speculative_generate(
     target_cfg: LlamaConfig,
     draft_params: dict,
     draft_cfg: LlamaConfig,
-    prompt: jax.Array,  # (1, S) — bs=1, the latency-bound case
+    prompt: jax.Array,  # (B, S)
     steps: int,
     cache_len: int,
     k_spec: int = 4,
 ) -> tuple[jax.Array, dict]:
-    """Greedy speculative decoding. Returns (tokens (1, steps), stats).
+    """Greedy speculative decoding. Returns (tokens (B, steps), stats).
 
-    Output is IDENTICAL to target-only greedy decoding; stats reports the
-    acceptance rate that determines the speedup.
+    Output is IDENTICAL to target-only greedy decoding of each row; stats
+    reports the acceptance rate that determines the speedup.
     """
-    if prompt.shape[0] != 1:
-        raise NotImplementedError("speculative decoding is bs=1 here")
     b, s_prompt = prompt.shape
     # Fixed-shape rounds need headroom for a full k_spec chunk even on
-    # the last round; enforcing it up front keeps the (1, steps) output
+    # the last round; enforcing it up front keeps the (B, steps) output
     # contract AND pins every round to ONE compiled shape (a shrinking
     # tail k would retrace mid-decode).
     needed = s_prompt + steps + k_spec
@@ -100,39 +105,49 @@ def speculative_generate(
 
     t_logits, t_cache = _prefill_impl(target_params, target_cfg, prompt, t_cache)
     _, d_cache = _prefill_impl(draft_params, draft_cfg, prompt, d_cache)
-    last = jnp.argmax(t_logits, axis=-1)[:, None]  # first generated token
+    # np.array (not asarray): device arrays view as read-only numpy.
+    last_np = np.array(jnp.argmax(t_logits, axis=-1))  # (B,) first tokens
 
-    out: list[int] = [int(last[0, 0])]
-    pos = s_prompt  # both caches hold [0, pos) real entries
+    out: list[list[int]] = [[int(t)] for t in last_np]
+    pos = np.full((b,), s_prompt, np.int64)  # per-row cache pointer
     proposed_total = accepted_total = 0
 
-    while len(out) < steps:
-        # Always a FULL k_spec round (one compiled shape); surplus
-        # acceptances past ``steps`` are trimmed host-side below.
-        k = k_spec
+    while any(len(o) < steps for o in out):
+        positions = jnp.asarray(pos, jnp.int32)
+        last = jnp.asarray(last_np, jnp.int32)[:, None]
         proposals, d_cache = _draft_propose(
-            draft_params, draft_cfg, last, d_cache, jnp.asarray(pos, jnp.int32), k
+            draft_params, draft_cfg, last, d_cache, positions, k_spec
         )
         # Chunk is (k+1) wide so EVERY proposal is acceptable: pred i is
         # the target's next token after ...[last, d_1..d_i].
         chunk = jnp.concatenate([last, proposals], axis=1)
         preds, t_cache = _target_verify(
-            target_params, target_cfg, chunk, t_cache, jnp.asarray(pos, jnp.int32)
+            target_params, target_cfg, chunk, t_cache, positions
         )
-        preds_np = np.asarray(preds[0])
-        props_np = np.asarray(proposals[0])
-        n_accept = 0
-        while n_accept < k and preds_np[n_accept] == props_np[n_accept]:
-            n_accept += 1
-        # Emit accepted proposals + the target's own correction. When all
-        # k were accepted the "correction" is the target's free token for
-        # position pos+k (preds[k]).
-        emitted = list(props_np[:n_accept]) + [int(preds_np[n_accept])]
-        out.extend(int(t) for t in emitted)
-        proposed_total += k
-        accepted_total += n_accept
-        pos += n_accept + 1  # rewound past any rejected slots
-        last = jnp.asarray([[out[-1]]], jnp.int32)
+        preds_np = np.asarray(preds)
+        props_np = np.asarray(proposals)
+        for row in range(b):
+            if len(out[row]) >= steps:
+                continue  # frozen row: pointer parked, output complete
+            n_accept = 0
+            while (
+                n_accept < k_spec
+                and preds_np[row, n_accept] == props_np[row, n_accept]
+            ):
+                n_accept += 1
+            # Emit accepted proposals + the target's own correction. When
+            # all k were accepted the "correction" is the target's free
+            # token for position pos+k (preds[k]).
+            emitted = list(props_np[row, :n_accept]) + [
+                int(preds_np[row, n_accept])
+            ]
+            out[row].extend(int(t) for t in emitted)
+            proposed_total += k_spec
+            accepted_total += n_accept
+            pos[row] += n_accept + 1  # rewound past any rejected slots
+            last_np[row] = out[row][-1] if len(out[row]) < steps else (
+                out[row][steps - 1]
+            )
 
     stats = {
         "proposed": proposed_total,
@@ -141,4 +156,4 @@ def speculative_generate(
             accepted_total / proposed_total if proposed_total else 0.0
         ),
     }
-    return jnp.asarray([out[:steps]], jnp.int32), stats
+    return jnp.asarray([o[:steps] for o in out], jnp.int32), stats

@@ -69,12 +69,32 @@ class TestExactness:
         )
         np.testing.assert_array_equal(np.asarray(out), ref)
 
-    def test_rejects_batched_prompts(self, target, draft):
+    def test_batched_equals_target_greedy_per_row(self, target, draft):
+        """Batched rounds with divergent per-row cache pointers: every
+        row's output must equal its own target-only greedy decode —
+        acceptance lengths differ per row, so this exercises the
+        per-row pointer advance and the frozen-row discipline."""
         tcfg, tparams = target
         dcfg, dparams = draft
-        with pytest.raises(NotImplementedError, match="bs=1"):
-            speculative_generate(
-                tparams, tcfg, dparams, dcfg,
-                jax.numpy.zeros((2, 4), jax.numpy.int32),
-                steps=4, cache_len=16,
-            )
+        prompt = jax.random.randint(jax.random.PRNGKey(5), (4, 8), 0, 256)
+        steps = 20
+        ref = np.asarray(
+            L.generate(tparams, tcfg, prompt, steps=steps, cache_len=64)
+        )
+        out, stats = speculative_generate(
+            tparams, tcfg, dparams, dcfg, prompt,
+            steps=steps, cache_len=64, k_spec=4,
+        )
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        assert 0.0 <= stats["acceptance_rate"] <= 1.0
+
+    def test_batched_self_draft_accepts_everything(self, target):
+        tcfg, tparams = target
+        prompt = jax.random.randint(jax.random.PRNGKey(6), (3, 6), 0, 256)
+        out, stats = speculative_generate(
+            tparams, tcfg, tparams, tcfg, prompt,
+            steps=12, cache_len=48, k_spec=4,
+        )
+        assert stats["acceptance_rate"] == 1.0
+        ref = np.asarray(L.generate(tparams, tcfg, prompt, steps=12, cache_len=48))
+        np.testing.assert_array_equal(np.asarray(out), ref)
