@@ -34,6 +34,9 @@ INJECT_OAUTH_LEGACY = "notebooks.opendatahub.io/inject-oauth"
 
 # -- integrations ------------------------------------------------------------
 MLFLOW_INSTANCE = "opendatahub.io/mlflow-instance"
+# Istio routing overrides (reference notebook_controller.go:51-52).
+REWRITE_URI = "notebooks.kubeflow.org/http-rewrite-uri"
+HEADERS_REQUEST_SET = "notebooks.kubeflow.org/http-headers-request-set"
 FEAST_INTEGRATION_LABEL = "opendatahub.io/feast-integration"
 
 # -- TPU-native extensions ---------------------------------------------------

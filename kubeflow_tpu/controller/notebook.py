@@ -83,18 +83,93 @@ class ControllerConfig:
     add_fsgroup: bool = True
     cluster_domain: str = "cluster.local"
     default_working_dir: str = "/home/jovyan"
+    # Istio mode (reference notebook_controller.go:238, manager.yaml:28-43):
+    # the kubeflow overlay serves notebooks through an Istio
+    # VirtualService; standalone/GKE use Gateway-API HTTPRoutes (the
+    # platform controller's path).
+    use_istio: bool = False
+    istio_gateway: str = "kubeflow/kubeflow-gateway"
+    istio_host: str = "*"
 
     @classmethod
     def from_env(cls, env: dict) -> "ControllerConfig":
         return cls(
             add_fsgroup=env.get("ADD_FSGROUP", "true").lower() != "false",
             cluster_domain=env.get("CLUSTER_DOMAIN", "cluster.local"),
+            use_istio=env.get("USE_ISTIO", "").lower() == "true",
+            istio_gateway=env.get("ISTIO_GATEWAY") or "kubeflow/kubeflow-gateway",
+            istio_host=env.get("ISTIO_HOST") or "*",
         )
 
 
 def headless_service_name(notebook_name: str) -> str:
     # Service names get the full 63-char DNS label budget.
     return derived_name(notebook_name, "-hosts", 63)
+
+
+def virtual_service_name(notebook_name: str, namespace: str) -> str:
+    """Reference virtualServiceName (notebook_controller.go:554-556)."""
+    return f"notebook-{namespace}-{notebook_name}"
+
+
+def generate_virtual_service(nb: Notebook, config: ControllerConfig) -> dict:
+    """Istio VirtualService routing ``/notebook/{ns}/{name}/`` to the
+    notebook Service (reference generateVirtualService,
+    notebook_controller.go:558-658; apiVersion upgraded v1alpha3 →
+    v1beta1, same schema for these fields).
+
+    Annotation overrides, as the reference: ``http-rewrite-uri`` replaces
+    the rewrite target; ``http-headers-request-set`` is a JSON object of
+    request headers to set (malformed JSON degrades to no headers rather
+    than failing the reconcile)."""
+    import json
+
+    prefix = f"/notebook/{nb.namespace}/{nb.name}/"
+    rewrite = nb.annotations.get(ann.REWRITE_URI) or prefix
+    headers = {}
+    raw = nb.annotations.get(ann.HEADERS_REQUEST_SET)
+    if raw:
+        try:
+            parsed = json.loads(raw)
+            if isinstance(parsed, dict):
+                headers = {str(k): str(v) for k, v in parsed.items()}
+        except ValueError:
+            headers = {}
+    # The ROUTING SERVICE's name, not the raw notebook name: names over
+    # the 63-char Service budget get the deterministic hashed fallback
+    # (api.names.derived_name), and a mismatch here would 503 every
+    # long-named notebook through Istio while all children look healthy.
+    service = (
+        f"{routing_service_name(nb.name)}.{nb.namespace}"
+        f".svc.{config.cluster_domain}"
+    )
+    return {
+        "apiVersion": "networking.istio.io/v1beta1",
+        "kind": "VirtualService",
+        "metadata": {
+            "name": virtual_service_name(nb.name, nb.namespace),
+            "namespace": nb.namespace,
+        },
+        "spec": {
+            "hosts": [config.istio_host],
+            "gateways": [config.istio_gateway],
+            "http": [
+                {
+                    "headers": {"request": {"set": headers}},
+                    "match": [{"uri": {"prefix": prefix}}],
+                    "rewrite": {"uri": rewrite},
+                    "route": [
+                        {
+                            "destination": {
+                                "host": service,
+                                "port": {"number": 80},
+                            }
+                        }
+                    ],
+                }
+            ],
+        },
+    }
 
 
 def slice_sts_name(notebook_name: str, slice_id: int) -> str:
@@ -236,6 +311,12 @@ class NotebookReconciler(Reconciler):
             headless = generate_headless_service(nb, slice_topo)
             helper.reconcile_child(
                 self.client, obj, headless, helper.copy_service_fields
+            )
+        if self.config.use_istio:
+            helper.reconcile_child(
+                self.client, obj,
+                generate_virtual_service(nb, self.config),
+                helper.copy_virtual_service_fields,
             )
 
         self._reemit_pod_events(nb, slice_topo)

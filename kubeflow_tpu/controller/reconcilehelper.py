@@ -50,6 +50,18 @@ def copy_service_fields(desired: dict, existing: dict) -> bool:
     return changed
 
 
+def copy_virtual_service_fields(desired: dict, existing: dict) -> bool:
+    """Istio VirtualService: meta + whole-spec copy (reference
+    CopyVirtualService, util.go:199-219 — nested-map spec compare, update
+    when drifted)."""
+    changed = _copy_meta(desired, existing)
+    want = desired.get("spec")
+    if want is not None and existing.get("spec") != want:
+        existing["spec"] = copy.deepcopy(want)
+        changed = True
+    return changed
+
+
 def copy_generic_fields(desired: dict, existing: dict) -> bool:
     """Labels/annotations + every non-meta top-level field (ConfigMap data,
     NetworkPolicy/HTTPRoute/RoleBinding specs, ...)."""

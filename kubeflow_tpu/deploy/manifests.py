@@ -343,6 +343,10 @@ def core_cluster_role() -> dict:
             _rule([GROUP], ["slicepools/status"], ["get", "patch", "update"]),
             _rule(["apps"], ["statefulsets"], _ALL),
             _rule([""], ["services"], _ALL),
+            # Istio serving mode (kubeflow overlay): the reconciler owns a
+            # VirtualService per notebook (reference role.yaml
+            # networking.istio.io rule).
+            _rule(["networking.istio.io"], ["virtualservices"], _ALL),
             _rule([""], ["pods"], _READ + ["delete"]),
             _rule([""], ["events"], _READ + ["create", "patch"]),
             _rule([""], ["nodes"], _READ),

@@ -83,6 +83,7 @@ def make_env(
     platform: bool = False,
     platform_config: Optional[PlatformConfig] = None,
     cluster: Optional[k8s.FakeCluster] = None,
+    controller_config: Optional[ControllerConfig] = None,
 ) -> Env:
     """Build a controller environment. Passing an existing ``cluster``
     simulates a controller-process restart: fresh manager/reconcilers/
@@ -107,7 +108,8 @@ def make_env(
     # dispatch first, so transient pod states (Failed → recreated) are
     # observable by the slice-health controller before cleanup.
     reconciler = NotebookReconciler(
-        cluster, ControllerConfig(), metrics=metrics, clock=clock
+        cluster, controller_config or ControllerConfig(), metrics=metrics,
+        clock=clock
     )
     reconciler.register(manager)
 

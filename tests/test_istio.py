@@ -1,0 +1,144 @@
+"""Istio serving mode (USE_ISTIO): per-notebook VirtualService lifecycle.
+
+Reference behavior being reproduced: notebook_controller.go:238 (env
+gate), :554-658 (generateVirtualService — prefix match, rewrite with
+annotation override, header-set annotation, route to the Service), and
+reconcilehelper CopyVirtualService (util.go:199-219). The kubeflow
+overlay enables it; standalone/GKE serve through Gateway-API HTTPRoutes.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.controller.notebook import (
+    ControllerConfig,
+    generate_virtual_service,
+    virtual_service_name,
+)
+
+from tests.harness import cpu_notebook, make_env
+
+
+def _istio_env(**cfg_kw):
+    return make_env(
+        controller_config=ControllerConfig(use_istio=True, **cfg_kw)
+    )
+
+
+def _vs(env, name="nb", ns="ns"):
+    return env.cluster.get("VirtualService", virtual_service_name(name, ns), ns)
+
+
+class TestVirtualService:
+    def test_created_with_reference_shape(self):
+        env = _istio_env()
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        vs = _vs(env)
+        assert vs["metadata"]["name"] == "notebook-ns-nb"
+        spec = vs["spec"]
+        assert spec["hosts"] == ["*"]
+        assert spec["gateways"] == ["kubeflow/kubeflow-gateway"]
+        http = spec["http"][0]
+        assert http["match"][0]["uri"]["prefix"] == "/notebook/ns/nb/"
+        assert http["rewrite"]["uri"] == "/notebook/ns/nb/"
+        dest = http["route"][0]["destination"]
+        assert dest["host"] == "nb.ns.svc.cluster.local"
+        assert dest["port"]["number"] == 80
+        # Owned: deleted with the notebook.
+        assert vs["metadata"]["ownerReferences"][0]["kind"] == "Notebook"
+
+    def test_gateway_and_host_from_config(self):
+        env = _istio_env(istio_gateway="mesh/gw", istio_host="nb.example.com")
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        spec = _vs(env)["spec"]
+        assert spec["gateways"] == ["mesh/gw"]
+        assert spec["hosts"] == ["nb.example.com"]
+
+    def test_rewrite_annotation_override(self):
+        env = _istio_env()
+        env.cluster.create(
+            cpu_notebook(annotations={ann.REWRITE_URI: "/custom/"})
+        )
+        env.manager.run_until_idle()
+        assert _vs(env)["spec"]["http"][0]["rewrite"]["uri"] == "/custom/"
+
+    def test_headers_annotation_sets_request_headers(self):
+        env = _istio_env()
+        env.cluster.create(cpu_notebook(annotations={
+            ann.HEADERS_REQUEST_SET: '{"X-Forwarded-Prefix": "/notebook/ns/nb"}'
+        }))
+        env.manager.run_until_idle()
+        hdrs = _vs(env)["spec"]["http"][0]["headers"]["request"]["set"]
+        assert hdrs == {"X-Forwarded-Prefix": "/notebook/ns/nb"}
+
+    def test_malformed_headers_json_degrades_to_empty(self):
+        """Reference behavior: bad JSON → empty header set, reconcile
+        proceeds (notebook_controller.go:608-612)."""
+        env = _istio_env()
+        env.cluster.create(
+            cpu_notebook(annotations={ann.HEADERS_REQUEST_SET: "{not json"})
+        )
+        env.manager.run_until_idle()
+        assert _vs(env)["spec"]["http"][0]["headers"]["request"]["set"] == {}
+
+    def test_drifted_spec_restored(self):
+        """Level-triggered: an out-of-band spec edit is reverted
+        (CopyVirtualService semantics)."""
+        env = _istio_env()
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        vs = _vs(env)
+        vs["spec"]["gateways"] = ["rogue/gw"]
+        env.cluster.update(vs)
+        # Touch the notebook to trigger a reconcile.
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        nb["metadata"].setdefault("annotations", {})["touch"] = "1"
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        assert _vs(env)["spec"]["gateways"] == ["kubeflow/kubeflow-gateway"]
+
+    def test_disabled_by_default(self):
+        env = make_env()
+        env.cluster.create(cpu_notebook())
+        env.manager.run_until_idle()
+        assert env.cluster.list("VirtualService", "ns") == []
+
+    def test_config_from_env(self):
+        cfg = ControllerConfig.from_env({
+            "USE_ISTIO": "true", "ISTIO_GATEWAY": "g/w", "ISTIO_HOST": "h",
+        })
+        assert cfg.use_istio and cfg.istio_gateway == "g/w"
+        assert cfg.istio_host == "h"
+        assert not ControllerConfig.from_env({}).use_istio
+
+    def test_long_name_routes_to_derived_service(self):
+        """Names past the 63-char Service budget use the hashed fallback
+        Service; the VirtualService destination must follow it or Istio
+        503s while every child object looks healthy."""
+        from kubeflow_tpu.controller.notebook import routing_service_name
+
+        long = "n" * 70
+        env = _istio_env()
+        env.cluster.create(cpu_notebook(name=long))
+        env.manager.run_until_idle()
+        vs = env.cluster.get(
+            "VirtualService", virtual_service_name(long, "ns"), "ns"
+        )
+        dest = vs["spec"]["http"][0]["route"][0]["destination"]["host"]
+        derived = routing_service_name(long)
+        assert derived != long
+        assert dest == f"{derived}.ns.svc.cluster.local"
+        # And that Service actually exists.
+        assert env.cluster.get("Service", derived, "ns")
+
+    def test_generator_is_pure(self):
+        from kubeflow_tpu.api.notebook import Notebook
+
+        from tests.harness import cpu_notebook as mk
+
+        nb = Notebook(mk(name="n2", namespace="team"))
+        vs = generate_virtual_service(nb, ControllerConfig(use_istio=True))
+        assert vs["metadata"]["name"] == "notebook-team-n2"
+        assert vs["apiVersion"] == "networking.istio.io/v1beta1"

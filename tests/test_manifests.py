@@ -70,6 +70,7 @@ def test_core_rbac_covers_reconciled_kinds():
         ("", "pods"),
         ("", "events"),
         ("coordination.k8s.io", "leases"),
+        ("networking.istio.io", "virtualservices"),
     ]:
         assert need in covered, need
 
