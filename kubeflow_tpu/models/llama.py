@@ -406,10 +406,95 @@ def forward(
     )
 
 
-def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
-    """Stacked KV cache: (L, B, Hkv, max_len, head_dim)."""
+def init_kv_cache(
+    cfg: LlamaConfig, batch: int, max_len: int, kv_bits: int = 0
+) -> dict:
+    """Stacked KV cache: (L, B, Hkv, max_len, head_dim).
+
+    ``kv_bits=8`` stores K/V as int8 with a per-(head, position) scale —
+    long-context decode reads cache bytes that grow with context, and
+    int8 halves them. The cache's STRUCTURE carries the format (the
+    ``k_scale``/``v_scale`` leaves), so every consumer keys off the
+    pytree, not a flag: writes quantize, attention dequantizes in the
+    score/value einsum epilogues, prefill attention still runs on the
+    fresh full-precision K/V (only storage quantizes)."""
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+    if kv_bits == 8:
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1], jnp.bfloat16),
+            "v_scale": jnp.zeros(shape[:-1], jnp.bfloat16),
+        }
+    if kv_bits:
+        raise ValueError(f"kv_bits must be 0 or 8, got {kv_bits}")
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _kv_quantize(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(..., S, D) → (int8 values, (..., S) bf16 scales): symmetric
+    per-(position, head) amax quantization over the head dim."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.round(xf / scale[..., None]).astype(jnp.int8)
+    return q, scale.astype(jnp.bfloat16)
+
+
+def _cache_store(cache_l: dict, k: jax.Array, v: jax.Array, position) -> dict:
+    """Write (B, Hkv, S, D) K/V into one LAYER's cache slice at a shared
+    scalar ``position``. Quantizes on write when the cache carries scale
+    leaves (init_kv_cache kv_bits=8)."""
+    out = dict(cache_l)
+    if "k_scale" in cache_l:
+        kq, ks = _kv_quantize(k)
+        vq, vs = _kv_quantize(v)
+        out["k"] = jax.lax.dynamic_update_slice(
+            cache_l["k"], kq, (0, 0, position, 0))
+        out["v"] = jax.lax.dynamic_update_slice(
+            cache_l["v"], vq, (0, 0, position, 0))
+        out["k_scale"] = jax.lax.dynamic_update_slice(
+            cache_l["k_scale"], ks, (0, 0, position))
+        out["v_scale"] = jax.lax.dynamic_update_slice(
+            cache_l["v_scale"], vs, (0, 0, position))
+        return out
+    out["k"] = jax.lax.dynamic_update_slice(
+        cache_l["k"], k, (0, 0, position, 0))
+    out["v"] = jax.lax.dynamic_update_slice(
+        cache_l["v"], v, (0, 0, position, 0))
+    return out
+
+
+def _cache_store_rows(cache_l: dict, k: jax.Array, v: jax.Array,
+                      positions: jax.Array) -> dict:
+    """Per-ROW offsets variant of _cache_store (batched speculative:
+    row b writes at positions[b])."""
+    if "k_scale" in cache_l:
+        kq, ks = _kv_quantize(k)
+        vq, vs = _kv_quantize(v)
+
+        def row(ck, cv, cks, cvs, kk, vv, kks, vvs, pos):
+            return (
+                jax.lax.dynamic_update_slice(ck, kk, (0, pos, 0)),
+                jax.lax.dynamic_update_slice(cv, vv, (0, pos, 0)),
+                jax.lax.dynamic_update_slice(cks, kks, (0, pos)),
+                jax.lax.dynamic_update_slice(cvs, vvs, (0, pos)),
+            )
+
+        k_, v_, ks_, vs_ = jax.vmap(row)(
+            cache_l["k"], cache_l["v"], cache_l["k_scale"],
+            cache_l["v_scale"], kq, vq, ks, vs, positions,
+        )
+        return {"k": k_, "v": v_, "k_scale": ks_, "v_scale": vs_}
+
+    def row(ck, cv, kk, vv, pos):
+        return (
+            jax.lax.dynamic_update_slice(ck, kk, (0, pos, 0)),
+            jax.lax.dynamic_update_slice(cv, vv, (0, pos, 0)),
+        )
+
+    k_, v_ = jax.vmap(row)(cache_l["k"], cache_l["v"], k, v, positions)
+    return {"k": k_, "v": v_}
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
@@ -485,28 +570,29 @@ def _prefill_impl(
     cos, sin = rope_frequencies(cfg, jnp.arange(s))
 
     def body(x, scanned):
-        layer, k_cache, v_cache = scanned
+        layer, cache_l = scanned
         h = _norm(x, layer["attn_norm"], cfg)
         hq, hk, hv = _qkv(h, layer)
         q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin)
         k = apply_rope(_split_heads(hk, cfg.n_kv_heads), cos, sin)
         v = _split_heads(hv, cfg.n_kv_heads)
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
+        cache_l = _cache_store(cache_l, k, v, jnp.asarray(0, jnp.int32))
+        # Attention runs on the FRESH full-precision K/V; an int8 cache
+        # quantizes storage only (what later decode steps read back).
         attn = flash_attention(q, k, v,  # GQA handled inside (no repeat)
                                causal=True, impl="auto",
                                window=cfg.sliding_window, kv_mask=kv_mask)
         x = x + _mm(_merge_heads(attn), layer["wo"])
         h = _norm(x, layer["mlp_norm"], cfg)
         x = x + _mlp(layer, h, cfg)
-        return x, (k_cache, v_cache)
+        return x, cache_l
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    x, new_cache = jax.lax.scan(
+        body, x, (params["layers"], kv_cache)
     )
     x_last = _norm(x[:, -1], params["final_norm"], cfg)
     logits = _lm_head_logits(x_last, params)
-    return logits, {"k": new_k, "v": new_v}
+    return logits, new_cache
 
 
 @partial(jax.jit, static_argnames=("cfg", "chunk"), donate_argnums=(3,))
@@ -553,12 +639,14 @@ def prime_kv_cache(
 
 def _gqa_decode_attention(
     q: jax.Array,  # (B, H, 1, D)
-    k: jax.Array,  # (B, Hkv, L, D)
+    k: jax.Array,  # (B, Hkv, L, D) — int8 when k_scale given
     v: jax.Array,  # (B, Hkv, L, D)
     position: jax.Array,  # scalar | (sq,) | (B,) with per_batch=True
     window: int = 0,
     kv_mask: Optional[jax.Array] = None,  # (B, L) valid-key mask
     per_batch: bool = False,
+    k_scale: Optional[jax.Array] = None,  # (B, Hkv, L) int8-cache scales
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Grouped-query decode attention against the UNREPEATED KV cache.
 
@@ -571,10 +659,17 @@ def _gqa_decode_attention(
     hkv = k.shape[1]
     qg = q.reshape(b, hkv, h // hkv, sq, d)
     scale = 1.0 / math.sqrt(d)
+    if k_scale is not None:
+        # int8 cache: the MXU dot runs on the int8 values upcast to q's
+        # dtype; the per-(head, position) scale folds into the f32 score
+        # epilogue — only int8 bytes ever cross HBM.
+        k = k.astype(q.dtype)
     scores = (
         jnp.einsum("bgrqd,bgkd->bgrqk", qg, k, preferred_element_type=jnp.float32)
         * scale
     )
+    if k_scale is not None:
+        scores = scores * k_scale.astype(jnp.float32)[:, :, None, None, :]
     # ``position`` may be a scalar (single-token decode), a (sq,) vector
     # (chunked decode, e.g. speculative verification — query i attends
     # cache slots <= position[i]), or with per_batch=True a (B,) vector
@@ -597,6 +692,15 @@ def _gqa_decode_attention(
         mask = mask & kv_mask[:, None, None, None, :]
     scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
+    if v_scale is not None:
+        # Fold the value scales into the probabilities (cheap: (…, L) vs
+        # the (…, L, D) a dequantized V would cost), then dot int8 V
+        # upcast to q's dtype.
+        probs = probs * v_scale.astype(jnp.float32)[:, :, None, None, :]
+        v = v.astype(q.dtype)
+        return jnp.einsum(
+            "bgrqk,bgkd->bgrqd", probs.astype(q.dtype), v
+        ).reshape(b, h, sq, d)
     out = jnp.einsum("bgrqk,bgkd->bgrqd", probs.astype(v.dtype), v)
     return out.reshape(b, h, sq, d)
 
@@ -610,40 +714,39 @@ def _decode_impl(params, cfg, token, kv_cache, position, kv_mask=None):
     return logits[:, 0], cache
 
 
-def _chunk_decode_scan(params, cfg, tokens, kv_cache, cos, sin, write,
+def _chunk_decode_scan(params, cfg, tokens, kv_cache, cos, sin, store,
                        attn_positions, kv_mask, per_batch):
     """The ONE cached-chunk decode body (scan over layers), parameterized
     by the two things the scalar- and per-row-offset variants differ in:
-    the cache ``write(cache_l, new)`` strategy and the attention position
+    the cache ``store(cache_l, k, v)`` strategy and the attention position
     argument. Keeping a single body means a future change (norm
     placement, bias, window semantics) cannot diverge the ordinary
-    decode and batched-speculative paths."""
+    decode and batched-speculative paths. The cache pytree's structure
+    decides the storage format (int8 + scales, or native dtype)."""
     x = _embed(params, cfg, tokens)
 
     def body(x, scanned):
-        layer, k_cache, v_cache = scanned
+        layer, cache_l = scanned
         h = _norm(x, layer["attn_norm"], cfg)
         hq, hk, hv = _qkv(h, layer)
         q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin)
         k = apply_rope(_split_heads(hk, cfg.n_kv_heads), cos, sin)
         v = _split_heads(hv, cfg.n_kv_heads)
-        k_cache = write(k_cache, k)
-        v_cache = write(v_cache, v)
+        cache_l = store(cache_l, k, v)
         attn = _gqa_decode_attention(
-            q, k_cache, v_cache, attn_positions, window=cfg.sliding_window,
-            kv_mask=kv_mask, per_batch=per_batch,
+            q, cache_l["k"], cache_l["v"], attn_positions,
+            window=cfg.sliding_window, kv_mask=kv_mask, per_batch=per_batch,
+            k_scale=cache_l.get("k_scale"), v_scale=cache_l.get("v_scale"),
         )
         x = x + _mm(_merge_heads(attn), layer["wo"])
         h = _norm(x, layer["mlp_norm"], cfg)
         x = x + _mlp(layer, h, cfg)
-        return x, (k_cache, v_cache)
+        return x, cache_l
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
-    )
+    x, new_cache = jax.lax.scan(body, x, (params["layers"], kv_cache))
     x = _norm(x, params["final_norm"], cfg)
     logits = _lm_head_logits(x, params)  # (B, K, V)
-    return logits, {"k": new_k, "v": new_v}
+    return logits, new_cache
 
 
 def _decode_chunk_impl(params, cfg, tokens, kv_cache, position, kv_mask=None):
@@ -660,12 +763,12 @@ def _decode_chunk_impl(params, cfg, tokens, kv_cache, position, kv_mask=None):
     positions = position + jnp.arange(k_len)
     cos, sin = rope_frequencies(cfg, positions)
 
-    def write(cache_l, new):
+    def store(cache_l, k, v):
         # One whole-batch slice write at the shared scalar offset.
-        return jax.lax.dynamic_update_slice(cache_l, new, (0, 0, position, 0))
+        return _cache_store(cache_l, k, v, position)
 
     return _chunk_decode_scan(
-        params, cfg, tokens, kv_cache, cos, sin, write, positions, kv_mask,
+        params, cfg, tokens, kv_cache, cos, sin, store, positions, kv_mask,
         per_batch=False,
     )
 
@@ -686,15 +789,11 @@ def _decode_chunk_batch_impl(params, cfg, tokens, kv_cache, positions,
     cos = cos.reshape(*posmat.shape, -1)  # (B, K, half)
     sin = sin.reshape(*posmat.shape, -1)
 
-    def row_write(cache_l, new, pos):
-        # (Hkv, C, D) <- (Hkv, K, D) at this row's offset.
-        return jax.lax.dynamic_update_slice(cache_l, new, (0, pos, 0))
-
-    def write(cache_l, new):
-        return jax.vmap(row_write)(cache_l, new, positions)
+    def store(cache_l, k, v):
+        return _cache_store_rows(cache_l, k, v, positions)
 
     return _chunk_decode_scan(
-        params, cfg, tokens, kv_cache, cos, sin, write, posmat, kv_mask,
+        params, cfg, tokens, kv_cache, cos, sin, store, posmat, kv_mask,
         per_batch=True,
     )
 
@@ -767,20 +866,23 @@ def sample(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "cache_len"))
+@partial(jax.jit, static_argnames=("cfg", "steps", "cache_len", "kv_bits"))
 def generate(
     params: dict,
     cfg: LlamaConfig,
     prompt: jax.Array,  # (B, S_prompt)
     steps: int,
     cache_len: int,
+    kv_bits: int = 0,
 ) -> jax.Array:
     """Fused generation that allocates its KV cache INSIDE the compiled
     program. Preferred over generate_tokens for fresh generations: the
     cache never exists as a host-visible buffer, so there is nothing to
     donate (and no donation-layout mismatch) — XLA places the zeros
-    directly in the layout the scan wants."""
-    cache = init_kv_cache(cfg, prompt.shape[0], cache_len)
+    directly in the layout the scan wants. ``kv_bits=8`` decodes against
+    an int8-quantized KV cache (halves the cache bytes read per token —
+    the long-context decode bandwidth lever)."""
+    cache = init_kv_cache(cfg, prompt.shape[0], cache_len, kv_bits=kv_bits)
     return _generate_impl(params, cfg, prompt, cache, steps)
 
 

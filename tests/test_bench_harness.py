@@ -186,3 +186,20 @@ def test_repo_artifact_is_a_valid_cache_source(bench):
     assert entry is not None and src is not None
     assert entry["value"] > 0
     assert "tokens/sec" in entry["unit"]
+
+
+def test_cached_headline_matches_full_config_tokens(bench, tmp_path,
+                                                    monkeypatch):
+    """Weight dtype matches on its FULL token and the KV-cache format must
+    agree: a bf16-weights + int8-KV headline must serve neither an --int8
+    (weights) run nor a plain bf16 run, and 'int8' alone must not
+    false-match the ', int8 KV' label."""
+    art = [{"metric": "llama decode (bs=1, bf16, int8 KV, fused loop)",
+            "value": 60.0, "unit": "tokens/sec/chip", "vs_baseline": 2.0}]
+    (tmp_path / "BENCH_FULL_kv8.json").write_text(json.dumps(art))
+    bench.__file__ = str(tmp_path / "bench.py")
+    monkeypatch.chdir(tmp_path)
+    assert bench._cached_headline(quant_bits=8)[0] is None  # int8 weights
+    assert bench._cached_headline(quant_bits=0, kv_bits=0)[0] is None
+    entry, _ = bench._cached_headline(quant_bits=0, kv_bits=8)
+    assert entry is not None and entry["value"] == 60.0
