@@ -62,18 +62,52 @@ _TEMPLATE_ANNOTATION_SKIP = {
     ann.TPU_SLICE_INTERRUPTED,
 }
 
-def _rv_int(rv: str) -> int:
-    """resourceVersion as an orderable int (0 when unset/opaque). The API
-    contract calls rvs opaque, but etcd revisions are monotonic integers in
-    practice — the same pragmatic ordering informer resume relies on."""
+# Dedup-cursor token regimes (compared as STRINGS; '!' < '.' < '0'..'9'):
+# the priming floor sorts below everything, timestamp tokens below every
+# integer token — so on an integer-rv cluster (etcd) one anomalous
+# rv-less Event is merely dropped instead of poisoning the cursor into a
+# regime that would suppress all future integer events.
+_CURSOR_FLOOR = "!"
+_TS_PREFIX = "."
+
+
+def _event_token(event: dict) -> str:
+    """Orderable dedup token for an Event, compared as STRINGS.
+
+    Primary regime: integer resourceVersions (etcd's monotonic revisions —
+    the pragmatic ordering informer resume relies on), zero-padded so
+    lexicographic order equals numeric order. Fallback regime for
+    apiservers whose rvs are genuinely opaque (the API contract allows
+    it): the Event's RFC3339 lastTimestamp with the event NAME as a
+    tiebreaker — timestamps have 1-second granularity, and two Warnings
+    in the same second must not collide into one token (the collision
+    would drop the second forever). Residual, documented limitation of
+    the opaque regime: an event recorded AFTER the cursor advanced, with
+    the same second and a lexically smaller name, is missed — bounded to
+    one second of history, versus etcd's unique revisions which never
+    collide."""
+    meta = event.get("metadata", {})
+    rv = meta.get("resourceVersion", "")
     try:
-        return int(rv)
+        return f"{int(rv):020d}"
     except (TypeError, ValueError):
-        return 0
+        ts = (
+            event.get("lastTimestamp")
+            or meta.get("creationTimestamp")
+            or ""
+        )
+        return f"{_TS_PREFIX}{ts}/{meta.get('name', '')}"
 
 
-def _event_rv(event: dict) -> int:
-    return _rv_int(event.get("metadata", {}).get("resourceVersion", ""))
+def _cursor_token(raw: str) -> str:
+    """Normalize a stored cursor annotation into token form (upgrades
+    cursors written by the older raw-int scheme)."""
+    if not raw:
+        return ""
+    try:
+        return f"{int(raw):020d}"
+    except (TypeError, ValueError):
+        return raw
 
 
 @dataclass
@@ -627,16 +661,19 @@ class NotebookReconciler(Reconciler):
             for i in range(slice_topo.hosts if slice_topo else 1)
         }
         raw_cursor = nb.annotations.get(ann.LAST_SEEN_EVENT_RV, "")
-        cursor = _rv_int(raw_cursor)
+        cursor = _cursor_token(raw_cursor)
         events = self.client.list(
             "Event", nb.namespace,
             field_selector={"involvedObject.kind": "Pod"},
         )
-        max_seen = cursor
+        # Floor token: sorts below BOTH regimes, so priming with no events
+        # still writes a non-empty annotation (its presence IS the primed
+        # marker) without blocking either regime's first real event.
+        max_seen = cursor or _CURSOR_FLOOR
         emitted = False
         priming = not raw_cursor
-        for event in sorted(events, key=_event_rv):
-            rv = _event_rv(event)
+        for event in sorted(events, key=_event_token):
+            rv = _event_token(event)
             if rv <= cursor:
                 continue
             max_seen = max(max_seen, rv)
@@ -671,10 +708,10 @@ class NotebookReconciler(Reconciler):
                 fresh_raw = obj_util.annotations_of(fresh).get(
                     ann.LAST_SEEN_EVENT_RV, ""
                 )
-                if fresh_raw and _rv_int(fresh_raw) >= max_seen:
+                if fresh_raw and _cursor_token(fresh_raw) >= max_seen:
                     return
                 obj_util.set_annotation(
-                    fresh, ann.LAST_SEEN_EVENT_RV, str(max_seen)
+                    fresh, ann.LAST_SEEN_EVENT_RV, max_seen
                 )
                 self.client.update(fresh)
 

@@ -311,6 +311,126 @@ class TestEventReemission:
         env2.manager.run_until_idle()
         assert len(surfaced()) == 1, "restarted controller re-emitted history"
 
+    def test_opaque_resource_versions_still_surface_and_dedup(self):
+        """The API contract calls resourceVersions OPAQUE; only etcd makes
+        them integers. With non-integer rvs the dedup cursor falls back to
+        Event lastTimestamp ordering — warnings still surface exactly
+        once (controller/notebook.py _event_token)."""
+        from kubeflow_tpu.controller.notebook import _cursor_token
+
+        env = make_env()
+
+        class OpaqueRVClient:
+            """Simulates an apiserver with non-integer resourceVersions
+            on the Event list the re-emitter reads."""
+
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def list(self, kind, namespace, *a, **kw):
+                out = self._inner.list(kind, namespace, *a, **kw)
+                if kind == "Event":
+                    for e in out:
+                        rv = e["metadata"].get("resourceVersion")
+                        if rv is not None:
+                            e["metadata"]["resourceVersion"] = f"op-{rv}"
+                return out
+
+        env.reconciler.client = OpaqueRVClient(env.cluster)
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        env.cluster.create({
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"name": "nb-3.feed", "namespace": "ns"},
+            "involvedObject": {"kind": "Pod", "name": "nb-3", "namespace": "ns"},
+            "type": "Warning",
+            "reason": "Evicted",
+            "message": "node pressure",
+            "lastTimestamp": "2026-07-30T12:00:00Z",
+        })
+        env.manager.run_until_idle()
+
+        def surfaced():
+            return [
+                e for e in events_for(env.cluster, "Notebook", "nb", "ns")
+                if e["reason"] == "Evicted"
+            ]
+
+        assert len(surfaced()) == 1
+        # The cursor advanced in the timestamp regime (name tiebreak).
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        from kubeflow_tpu.api import annotations as ann2
+
+        assert nb["metadata"]["annotations"][ann2.LAST_SEEN_EVENT_RV].startswith(
+            ".2026-"
+        )
+        # A SECOND warning in the same second (timestamp collision) must
+        # still surface: the event-name tiebreaker keeps tokens distinct.
+        env.cluster.create({
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"name": "nb-3.fffe", "namespace": "ns"},
+            "involvedObject": {"kind": "Pod", "name": "nb-3", "namespace": "ns"},
+            "type": "Warning",
+            "reason": "SameSecond",
+            "message": "second warning, same timestamp",
+            "lastTimestamp": "2026-07-30T12:00:00Z",
+        })
+        env.manager.run_until_idle()
+        assert any(
+            e["reason"] == "SameSecond"
+            for e in events_for(env.cluster, "Notebook", "nb", "ns")
+        )
+        # Repeat reconciles do not duplicate.
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        nb["metadata"].setdefault("annotations", {})["touch"] = "1"
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        assert len(surfaced()) == 1
+        # Old raw-int cursors normalize into the padded token regime.
+        assert _cursor_token("123") == f"{123:020d}"
+        assert _cursor_token("") == ""
+
+    def test_anomalous_rvless_event_does_not_poison_integer_cursor(self):
+        """One Event with a missing/non-integer rv on an otherwise-etcd
+        cluster must not flip the cursor into a regime that suppresses all
+        future integer-rv events: timestamp tokens sort BELOW integers, so
+        the anomaly is (at worst) dropped, never poisonous."""
+        from kubeflow_tpu.controller.notebook import _event_token
+
+        # Regime ordering invariants.
+        assert _event_token(
+            {"metadata": {"name": "x"}, "lastTimestamp": "2099-01-01T00:00:00Z"}
+        ) < _event_token({"metadata": {"name": "y", "resourceVersion": "1"}})
+
+        env = make_env()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        # Integer-rv warning surfaces, cursor advances in the int regime.
+        env.cluster.create({
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": "nb-0.aaaa", "namespace": "ns"},
+            "involvedObject": {"kind": "Pod", "name": "nb-0", "namespace": "ns"},
+            "type": "Warning", "reason": "First", "message": "m",
+        })
+        env.manager.run_until_idle()
+        # Later integer-rv warnings must still surface.
+        env.cluster.create({
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": "nb-0.bbbb", "namespace": "ns"},
+            "involvedObject": {"kind": "Pod", "name": "nb-0", "namespace": "ns"},
+            "type": "Warning", "reason": "Second", "message": "m",
+        })
+        env.manager.run_until_idle()
+        reasons = {
+            e["reason"] for e in events_for(env.cluster, "Notebook", "nb", "ns")
+        }
+        assert {"First", "Second"} <= reasons
+
 
 class TestMetrics:
     def test_create_and_spawn_latency_observed(self):
