@@ -275,8 +275,6 @@ def make_sharded_ring_attention(mesh: Mesh):
 
     def attention(q, k, v, causal=True, q_offset=0, window=0, kv_mask=None,
                   impl=None):
-        if not causal:
-            raise NotImplementedError("ring attention is causal-only here")
         static = dict(causal=causal, q_offset=q_offset, window=window)
         if kv_mask is not None:
             return get(True, **static)(q, k, v, kv_mask)

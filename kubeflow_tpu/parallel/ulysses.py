@@ -108,8 +108,6 @@ def make_sharded_ulysses_attention(mesh: Mesh, local_impl: str = "auto"):
 
     def attention(q, k, v, causal=True, q_offset=0, window=0, kv_mask=None,
                   impl=None):
-        if not causal:
-            raise NotImplementedError("ulysses attention is causal-only here")
         h = q.shape[1]
         tp = mesh.shape.get("tp", 1)
         if (h // tp) % sp != 0:

@@ -60,11 +60,31 @@ class TestUlyssesAttention:
         with pytest.raises(ValueError, match="not divisible by sp"):
             make_sharded_ulysses_attention(mesh)(q, k, v)
 
-    def test_non_causal_not_claimed(self):
-        mesh = make_mesh(sp=8)
-        q, k, v = _qkv(heads=8, seq=64)
-        with pytest.raises(NotImplementedError):
-            make_sharded_ulysses_attention(mesh)(q, k, v, causal=False)
+    def test_non_causal_matches_dense(self):
+        """Bidirectional (encoder-style) attention under SP: parity with
+        the dense non-causal path — and with ring attention."""
+        mesh = make_mesh(sp=4, dp=2)
+        q, k, v = _qkv(heads=4, seq=128)
+        ref = flash_attention(q, k, v, causal=False, impl="xla")
+        uly = make_sharded_ulysses_attention(mesh)(q, k, v, causal=False)
+        assert float(jnp.max(jnp.abs(uly - ref))) < 1e-4
+        ring = make_sharded_ring_attention(mesh)(q, k, v, causal=False)
+        assert float(jnp.max(jnp.abs(ring - ref))) < 1e-4
+
+    def test_non_causal_with_kv_mask(self):
+        mesh = make_mesh(sp=4, dp=2)
+        q, k, v = _qkv(heads=4, seq=128)
+        kv_mask = jnp.ones((2, 128), bool).at[:, :32].set(False)
+        ref = flash_attention(q, k, v, causal=False, impl="xla",
+                              kv_mask=kv_mask)
+        uly = make_sharded_ulysses_attention(mesh)(
+            q, k, v, causal=False, kv_mask=kv_mask
+        )
+        assert float(jnp.max(jnp.abs(uly - ref))) < 1e-4
+        ring = make_sharded_ring_attention(mesh)(
+            q, k, v, causal=False, kv_mask=kv_mask
+        )
+        assert float(jnp.max(jnp.abs(ring - ref))) < 1e-4
 
 
 class TestUlyssesTraining:
