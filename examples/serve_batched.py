@@ -26,6 +26,10 @@ def main() -> None:
                     help="serve through the paged block-pool KV cache")
     ap.add_argument("--num-blocks", type=int, default=64,
                     help="block-pool size for --paged (16-token blocks)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel shards (continuous batching)")
+    ap.add_argument("--sp", type=int, default=1,
+                    help="sequence-parallel KV-cache shards (continuous)")
     ap.add_argument("--max-new-tokens", type=int, default=16)
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--prompt", action="append", default=None,
@@ -103,6 +107,27 @@ def main() -> None:
         results = pb.run()
         outs = [results[r] for r in rids]
         print(f"paged: {pb.free_blocks}/{args.num_blocks - 1} blocks free after run")
+    elif args.tp > 1 or args.sp > 1:
+        # Multi-host serving: params shard over tp, the KV cache's
+        # sequence axis over sp (split-KV shard_map decode). Token-exact
+        # with the single-device batcher.
+        from kubeflow_tpu.models.continuous import ContinuousBatcher
+        from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+
+        n = args.tp * args.sp
+        plan = MeshPlan(make_mesh(tp=args.tp, sp=args.sp,
+                                  devices=jax.devices()[:n]))
+        bucket = 16 * ((max(len(p) for p in prompts) + 15) // 16)
+        cache_len = args.sp * -(-(bucket + gen.max_new_tokens) // args.sp)
+        cb = ContinuousBatcher(
+            params, cfg, gen=gen, slots=min(4, len(prompts)),
+            cache_len=cache_len, prompt_bucket=bucket,
+            key=jax.random.PRNGKey(0), plan=plan,
+        )
+        rids = [cb.submit(p) for p in prompts]
+        results = cb.run()
+        outs = [results[r] for r in rids]
+        print(f"sharded serving: tp={args.tp} sp={args.sp} over {n} devices")
     else:
         outs = batch_generate(params, cfg, prompts, gen, key=jax.random.PRNGKey(0))
     for i, out in enumerate(outs):

@@ -380,6 +380,13 @@ class NotebookReconciler(Reconciler):
         from kubeflow_tpu.controller.slicepool import claim_warm_slice
 
         marker = self._claim_marker_key(slice_id)
+        pools = self.client.list("SlicePool", nb.namespace)
+        if not pools:
+            # Namespace doesn't use pools (the common case): return before
+            # the idempotence GET below — an extra read per scale-up
+            # reconcile on the no-pool spawn path is measurable wire
+            # latency for nothing. Keep metrics quiet too.
+            return
         # One transition, one claim per slice: a prior pass may have
         # claimed but its replica update is not visible yet (stale cache
         # read, or the STS write failed after the claim) — the claim
@@ -393,10 +400,6 @@ class NotebookReconciler(Reconciler):
             return
         if marker in obj_util.annotations_of(fresh):
             return
-
-        pools = self.client.list("SlicePool", nb.namespace)
-        if not pools:
-            return  # namespace doesn't use pools; keep metrics quiet
         pool = claim_warm_slice(
             self.client, nb.namespace, topo, recorder=self.recorder,
             notebook=obj, now=self.clock(), pools=pools,

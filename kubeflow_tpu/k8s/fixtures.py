@@ -243,6 +243,8 @@ class FakeKubelet(Reconciler):
         }
 
     def _update_sts_status(self, sts: dict) -> None:
+        from kubeflow_tpu.k8s.client import retry_on_conflict
+
         ready = 0
         for pod in self.cluster.list("Pod", obj_util.namespace_of(sts)):
             if not obj_util.is_controlled_by(sts, pod):
@@ -250,12 +252,21 @@ class FakeKubelet(Reconciler):
             for cond in pod.get("status", {}).get("conditions", []):
                 if cond.get("type") == "Ready" and cond.get("status") == "True":
                     ready += 1
-        sts = self.cluster.get("StatefulSet", obj_util.name_of(sts), obj_util.namespace_of(sts))
-        sts["status"] = {
-            "replicas": sts.get("spec", {}).get("replicas", 1),
-            "readyReplicas": ready,
-        }
-        self.cluster.update_status(sts)
+        name, ns = obj_util.name_of(sts), obj_util.namespace_of(sts)
+
+        def write():
+            # Fresh read inside the retry: over the WIRE tier the core
+            # controller updates the same StatefulSet concurrently (the
+            # replica copy), and a stale rv here crashed the kubelet
+            # thread mid-loadtest instead of retrying like a real kubelet.
+            fresh = self.cluster.get("StatefulSet", name, ns)
+            fresh["status"] = {
+                "replicas": fresh.get("spec", {}).get("replicas", 1),
+                "readyReplicas": ready,
+            }
+            self.cluster.update_status(fresh)
+
+        retry_on_conflict(write)
 
     # -- fault helpers for preemption tests --------------------------------
 

@@ -52,7 +52,9 @@ def test_finetune_lora_runs_and_exports(tmp_path):
     assert pathlib.Path(out).exists()
 
 
-@pytest.mark.parametrize("extra", [(), ("--int8",), ("--paged",)])
+@pytest.mark.parametrize(
+    "extra", [(), ("--int8",), ("--paged",), ("--tp", "2", "--sp", "2")]
+)
 def test_serve_batched_runs(extra):
     res = _run("serve_batched.py", "--max-new-tokens", "4", *extra)
     assert res.returncode == 0, res.stderr
