@@ -94,6 +94,18 @@ def main() -> None:
         top_p=0.95 if args.temperature else 1.0,
         eos_id=eos,
     )
+    plan = None
+    if args.tp > 1 or args.sp > 1:
+        from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+
+        if args.paged and args.sp > 1:
+            # The block pool has no contiguous sequence axis to shard.
+            raise SystemExit("--paged supports --tp but not --sp "
+                             "(use continuous batching for sp)")
+        n = args.tp * args.sp
+        plan = MeshPlan(make_mesh(tp=args.tp, sp=args.sp,
+                                  devices=jax.devices()[:n]))
+
     if args.paged:
         from kubeflow_tpu.models.paged import PagedBatcher
 
@@ -101,22 +113,18 @@ def main() -> None:
         pb = PagedBatcher(
             params, cfg, gen=gen, slots=min(4, len(prompts)),
             num_blocks=args.num_blocks, block_size=16, prompt_bucket=bucket,
-            key=jax.random.PRNGKey(0),
+            key=jax.random.PRNGKey(0), plan=plan,
         )
         rids = [pb.submit(p) for p in prompts]
         results = pb.run()
         outs = [results[r] for r in rids]
         print(f"paged: {pb.free_blocks}/{args.num_blocks - 1} blocks free after run")
-    elif args.tp > 1 or args.sp > 1:
+    elif plan is not None:
         # Multi-host serving: params shard over tp, the KV cache's
         # sequence axis over sp (split-KV shard_map decode). Token-exact
         # with the single-device batcher.
         from kubeflow_tpu.models.continuous import ContinuousBatcher
-        from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
 
-        n = args.tp * args.sp
-        plan = MeshPlan(make_mesh(tp=args.tp, sp=args.sp,
-                                  devices=jax.devices()[:n]))
         bucket = 16 * ((max(len(p) for p in prompts) + 15) // 16)
         cache_len = args.sp * -(-(bucket + gen.max_new_tokens) // args.sp)
         cb = ContinuousBatcher(
@@ -127,7 +135,8 @@ def main() -> None:
         rids = [cb.submit(p) for p in prompts]
         results = cb.run()
         outs = [results[r] for r in rids]
-        print(f"sharded serving: tp={args.tp} sp={args.sp} over {n} devices")
+        print(f"sharded serving: tp={args.tp} sp={args.sp} over "
+              f"{args.tp * args.sp} devices")
     else:
         outs = batch_generate(params, cfg, prompts, gen, key=jax.random.PRNGKey(0))
     for i, out in enumerate(outs):

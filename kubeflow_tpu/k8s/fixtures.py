@@ -245,21 +245,24 @@ class FakeKubelet(Reconciler):
     def _update_sts_status(self, sts: dict) -> None:
         from kubeflow_tpu.k8s.client import retry_on_conflict
 
-        ready = 0
-        for pod in self.cluster.list("Pod", obj_util.namespace_of(sts)):
-            if not obj_util.is_controlled_by(sts, pod):
-                continue
-            for cond in pod.get("status", {}).get("conditions", []):
-                if cond.get("type") == "Ready" and cond.get("status") == "True":
-                    ready += 1
         name, ns = obj_util.name_of(sts), obj_util.namespace_of(sts)
 
         def write():
-            # Fresh read inside the retry: over the WIRE tier the core
-            # controller updates the same StatefulSet concurrently (the
-            # replica copy), and a stale rv here crashed the kubelet
-            # thread mid-loadtest instead of retrying like a real kubelet.
+            # Whole read-compute-write inside the retry: over the WIRE
+            # tier the core controller updates the same StatefulSet
+            # concurrently (the replica copy) — a stale rv crashed the
+            # kubelet thread mid-loadtest instead of retrying like a real
+            # kubelet, and a pod can flip Ready between attempts, so the
+            # ready count must be recomputed per attempt too.
             fresh = self.cluster.get("StatefulSet", name, ns)
+            ready = 0
+            for pod in self.cluster.list("Pod", ns):
+                if not obj_util.is_controlled_by(fresh, pod):
+                    continue
+                for cond in pod.get("status", {}).get("conditions", []):
+                    if (cond.get("type") == "Ready"
+                            and cond.get("status") == "True"):
+                        ready += 1
             fresh["status"] = {
                 "replicas": fresh.get("spec", {}).get("replicas", 1),
                 "readyReplicas": ready,
