@@ -320,6 +320,7 @@ class ContinuousBatcher(_BatcherBase):
                 self.params, self.cfg, jnp.asarray(padded), prompt_mask,
                 self.cache, self.kv_mask, jnp.asarray(slot, jnp.int32),
             )
+            self._post_admit(slot, jnp.asarray(padded), prompt_mask)
             self.key, sub = jax.random.split(self.key)
             first = int(
                 sample_logits(
@@ -331,6 +332,10 @@ class ContinuousBatcher(_BatcherBase):
             self._by_slot[slot] = req
             req.budget = self.gen.max_new_tokens
             self._note_token(slot, first)
+
+    def _post_admit(self, slot: int, padded, prompt_mask) -> None:
+        """Hook for subclasses that keep a SECOND cache in lockstep (the
+        speculative batcher prefills its draft cache here)."""
 
     def _release_slot(self, slot: int) -> None:
         self._by_slot[slot] = None

@@ -44,9 +44,11 @@ from kubeflow_tpu.models.llama import (
 
 
 @partial(jax.jit, static_argnames=("cfg", "k_spec"))
-def _draft_propose(params, cfg, token, kv_cache, positions, k_spec):
+def _draft_propose(params, cfg, token, kv_cache, positions, k_spec,
+                   kv_mask=None):
     """Draft k_spec greedy tokens autoregressively from ``token`` at
-    per-row ``positions`` (B,).
+    per-row ``positions`` (B,). ``kv_mask`` (B, C) marks valid cache
+    slots (serving: left-pad slots are False).
 
     Runs k_spec+1 decode steps: each step WRITES its input token's K/V,
     so the extra step is what lands d_k in the draft cache — on a fully
@@ -56,7 +58,9 @@ def _draft_propose(params, cfg, token, kv_cache, positions, k_spec):
 
     def step(carry, _):
         tok, cache, pos = carry
-        logits, cache = _decode_chunk_batch_impl(params, cfg, tok, cache, pos)
+        logits, cache = _decode_chunk_batch_impl(
+            params, cfg, tok, cache, pos, kv_mask=kv_mask
+        )
         nxt = jnp.argmax(logits[:, 0], axis=-1)[:, None]
         return (nxt, cache, pos + 1), nxt[:, 0]
 
@@ -67,9 +71,9 @@ def _draft_propose(params, cfg, token, kv_cache, positions, k_spec):
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def _target_verify(params, cfg, chunk, kv_cache, positions):
+def _target_verify(params, cfg, chunk, kv_cache, positions, kv_mask=None):
     logits, cache = _decode_chunk_batch_impl(
-        params, cfg, chunk, kv_cache, positions
+        params, cfg, chunk, kv_cache, positions, kv_mask=kv_mask
     )
     return jnp.argmax(logits, axis=-1), cache  # (B, K)
 
@@ -157,3 +161,146 @@ def speculative_generate(
         ),
     }
     return jnp.asarray([o[:steps] for o in out], jnp.int32), stats
+
+
+class SpeculativeContinuousBatcher:
+    """Continuous batching with speculative decoding as the STEP engine:
+    every serving round, the draft proposes k tokens per slot and the
+    target verifies them in one (B, k+1) forward at per-slot offsets —
+    the per-row cache-pointer machinery above, applied to the fixed-slot
+    server's persistent caches. Every request's output follows the greedy
+    path of its own prompt (tie-tolerant: the verify chunk computes
+    logits in a different shape than single-token decode, so bf16
+    NEAR-TIES may break differently — same caveat as every cross-shape
+    greedy comparison in this stack); throughput multiplies by
+    ~(accepted+1) per target read when the draft agrees often.
+
+    Greedy-only: acceptance compares argmaxes, so a sampling temperature
+    would break the exactness guarantee — rejected at construction.
+
+    >>> sb = SpeculativeContinuousBatcher(params, cfg, dparams, dcfg,
+    ...                                   slots=4, cache_len=256)
+    >>> rids = [sb.submit(p) for p in prompts]
+    >>> results = sb.run()                  # {rid: tokens}
+    >>> sb.acceptance_rate                  # serving-level stat
+    """
+
+    def __init__(
+        self,
+        params: dict,
+        target_cfg: LlamaConfig,
+        draft_params: dict,
+        draft_cfg: LlamaConfig,
+        gen=None,
+        slots: int = 8,
+        cache_len: int = 1024,
+        prompt_bucket: int = 64,
+        key=None,
+        k_spec: int = 4,
+    ):
+        from kubeflow_tpu.models.continuous import ContinuousBatcher
+        from kubeflow_tpu.models.serving import GenerationConfig
+
+        gen = gen or GenerationConfig()
+        if gen.temperature != 0.0:
+            raise ValueError(
+                "speculative serving is greedy-only (temperature must be 0: "
+                "acceptance compares argmaxes, sampling would break the "
+                "exactness guarantee)"
+            )
+        # Spec rounds write up to k_spec+1 slots beyond the pointer before
+        # rewinding; the cache needs that headroom past the nominal span.
+        if prompt_bucket + gen.max_new_tokens + k_spec + 1 > cache_len:
+            raise ValueError(
+                f"cache_len {cache_len} too small for prompt_bucket "
+                f"{prompt_bucket} + max_new_tokens {gen.max_new_tokens} + "
+                f"k_spec {k_spec} + 1 speculative headroom"
+            )
+
+        outer = self
+
+        class _Inner(ContinuousBatcher):
+            def _post_admit(self, slot, padded, prompt_mask):
+                outer._admit_draft(slot, padded, prompt_mask)
+
+            def _release_slot(self, slot):
+                super()._release_slot(slot)
+                outer.draft_kv_mask = outer.draft_kv_mask.at[slot].set(False)
+
+            def _step(self):
+                outer._spec_step()
+
+        self._cb = _Inner(
+            params, target_cfg, gen=gen, slots=slots, cache_len=cache_len,
+            prompt_bucket=prompt_bucket, key=key,
+        )
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.k_spec = k_spec
+        self.draft_cache = init_kv_cache(draft_cfg, slots, cache_len)
+        self.draft_kv_mask = jnp.zeros((slots, cache_len), bool)
+        self.proposed = 0
+        self.accepted = 0
+
+    # -- public surface (delegated) ----------------------------------------
+
+    def submit(self, prompt) -> int:
+        return self._cb.submit(prompt)
+
+    def run(self) -> dict:
+        return self._cb.run()
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+    # -- internals ---------------------------------------------------------
+
+    def _admit_draft(self, slot, padded, prompt_mask) -> None:
+        from kubeflow_tpu.models.continuous import _admit_slot
+
+        _, self.draft_cache, self.draft_kv_mask = _admit_slot(
+            self.draft_params, self.draft_cfg, padded, prompt_mask,
+            self.draft_cache, self.draft_kv_mask,
+            jnp.asarray(slot, jnp.int32),
+        )
+
+    def _spec_step(self) -> None:
+        cb = self._cb
+        active = [i for i, r in enumerate(cb._by_slot) if r is not None]
+        if not active:
+            return
+        positions = jnp.asarray(cb.positions, jnp.int32)
+        last = jnp.asarray(cb.tokens, jnp.int32)  # (B, 1) per-slot input
+        proposals, self.draft_cache = _draft_propose(
+            self.draft_params, self.draft_cfg, last, self.draft_cache,
+            positions, self.k_spec, kv_mask=self.draft_kv_mask,
+        )
+        chunk = jnp.concatenate([last, proposals], axis=1)
+        preds, cb.cache = _target_verify(
+            cb.params, cb.cfg, chunk, cb.cache, positions,
+            kv_mask=cb.kv_mask,
+        )
+        preds_np = np.asarray(preds)
+        props_np = np.asarray(proposals)
+        for slot in active:
+            n_accept = 0
+            while (
+                n_accept < self.k_spec
+                and preds_np[slot, n_accept] == props_np[slot, n_accept]
+            ):
+                n_accept += 1
+            emitted = list(props_np[slot, :n_accept]) + [
+                int(preds_np[slot, n_accept])
+            ]
+            for tok in emitted:
+                if cb._by_slot[slot] is None:
+                    break  # retired mid-round (EOS/budget): drop the rest
+                cb._note_token(slot, int(tok))
+            # Rewind the shared pointer past any rejected slots; both
+            # caches' stale entries beyond it are causally invisible and
+            # overwritten next round. A retired slot's position resets at
+            # its next admit.
+            cb.positions[slot] += n_accept + 1
+            self.proposed += self.k_spec
+            self.accepted += n_accept

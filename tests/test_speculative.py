@@ -98,3 +98,101 @@ class TestExactness:
         assert stats["acceptance_rate"] == 1.0
         ref = np.asarray(L.generate(tparams, tcfg, prompt, steps=12, cache_len=48))
         np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+class TestSpeculativeServing:
+    def test_serving_stays_on_greedy_path(self, target, draft):
+        """The spec batcher is a throughput engine, not a semantics
+        change: with more requests than slots and mixed prompt lengths,
+        every request's tokens must follow the greedy path of ITS OWN
+        prompt. (Tie-tolerant, not token-equal vs the plain batcher: the
+        verify chunk computes logits in a different shape, and bf16
+        near-ties legitimately break differently across shapes — the
+        same standard the continuous/paged suites use for cross-shape
+        comparisons.)"""
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.models.speculative import (
+            SpeculativeContinuousBatcher,
+        )
+        from tests.test_continuous import _assert_greedy_consistent
+
+        tcfg, tparams = target
+        dcfg, dparams = draft
+        gen = GenerationConfig(max_new_tokens=8, eos_id=-1)
+        ks = jax.random.split(jax.random.PRNGKey(9), 5)
+        prompts = [
+            [int(t) for t in jax.random.randint(k, (4 + i,), 3, 250)]
+            for i, k in enumerate(ks)
+        ]
+        sb = SpeculativeContinuousBatcher(
+            tparams, tcfg, dparams, dcfg, gen=gen, slots=2,
+            cache_len=64, prompt_bucket=16, k_spec=4,
+        )
+        rids = [sb.submit(p) for p in prompts]
+        got = sb.run()
+        assert len(got) == len(prompts)
+        for rid, prompt in zip(rids, prompts):
+            assert len(got[rid]) == 8
+            _assert_greedy_consistent(tparams, tcfg, prompt, got[rid])
+        assert 0.0 <= sb.acceptance_rate <= 1.0
+
+    def test_serving_self_draft_accepts_everything(self, target):
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.models.speculative import (
+            SpeculativeContinuousBatcher,
+        )
+
+        tcfg, tparams = target
+        gen = GenerationConfig(max_new_tokens=8, eos_id=-1)
+        sb = SpeculativeContinuousBatcher(
+            tparams, tcfg, tparams, tcfg, gen=gen, slots=2,
+            cache_len=64, prompt_bucket=16,
+        )
+        rids = [sb.submit([3 + i, 41, 90]) for i in range(3)]
+        out = sb.run()
+        assert all(len(out[r]) == 8 for r in rids)
+        assert sb.acceptance_rate == 1.0
+
+    def test_serving_eos_retires_early(self, target, draft):
+        """EOS mid-round retires the slot and drops the round's surplus
+        tokens; the freed slot serves the next request."""
+        from kubeflow_tpu.models.continuous import ContinuousBatcher
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.models.speculative import (
+            SpeculativeContinuousBatcher,
+        )
+
+        tcfg, tparams = target
+        dcfg, dparams = draft
+        probe = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        prompt = [5, 9, 17]
+        cb = ContinuousBatcher(tparams, tcfg, gen=probe, slots=1,
+                               cache_len=64, prompt_bucket=16)
+        rid = cb.submit(prompt)
+        eos = cb.run()[rid][2]  # third emitted token becomes the EOS
+
+        gen = GenerationConfig(max_new_tokens=6, eos_id=eos)
+        sb = SpeculativeContinuousBatcher(
+            tparams, tcfg, dparams, dcfg, gen=gen, slots=1,
+            cache_len=64, prompt_bucket=16,
+        )
+        r1, r2 = sb.submit(prompt), sb.submit([8, 44, 91, 7])
+        out = sb.run()
+        assert eos not in out[r1]
+        assert len(out[r1]) == 2  # stopped at the EOS
+        assert len(out[r2]) <= 6  # second request served after the retire
+
+    def test_serving_rejects_sampling(self, target, draft):
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.models.speculative import (
+            SpeculativeContinuousBatcher,
+        )
+
+        tcfg, tparams = target
+        dcfg, dparams = draft
+        with pytest.raises(ValueError, match="greedy-only"):
+            SpeculativeContinuousBatcher(
+                tparams, tcfg, dparams, dcfg,
+                gen=GenerationConfig(max_new_tokens=4, temperature=0.8),
+                cache_len=256,
+            )
