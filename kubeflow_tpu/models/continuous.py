@@ -39,6 +39,7 @@ import numpy as np
 
 from kubeflow_tpu.models.llama import (
     LlamaConfig,
+    _cache_store_rows,
     _embed,
     _gqa_decode_attention,
     _lm_head_logits,
@@ -75,13 +76,18 @@ def _admit_slot(
     updated cache, updated kv_mask)."""
     cache_len = cache["k"].shape[3]
     lb = tokens.shape[1]
-    temp = init_kv_cache(cfg, 1, cache_len)
+    # The temp cache mirrors the batch cache's storage format (the pytree
+    # structure carries it — int8 + scale leaves when kv_bits=8), so the
+    # row copy below is format-agnostic: scale leaves are rank-4
+    # (L, B, Hkv, C), value leaves rank-5.
+    temp = init_kv_cache(cfg, 1, cache_len,
+                         kv_bits=8 if "k_scale" in cache else 0)
     logits, temp = _prefill_impl(params, cfg, tokens, temp, kv_mask=prompt_mask)
     new_cache = {
         name: jax.lax.dynamic_update_slice(
-            cache[name], temp[name], (0, slot, 0, 0, 0)
+            cache[name], temp[name], (0, slot) + (0,) * (cache[name].ndim - 2)
         )
-        for name in ("k", "v")
+        for name in cache
     }
     row = jnp.ones((1, cache_len), bool)
     if prompt_mask is not None:
@@ -116,47 +122,46 @@ def _cb_step(
     x = _embed(params, cfg, tokens)  # (B, 1, D)
     cos, sin = rope_frequencies(cfg, positions)  # (B, half)
 
-    def write(cache_l, new, pos):
-        # (Hkv, C, D) <- (Hkv, 1, D) at slot-local position.
-        return jax.lax.dynamic_update_slice(cache_l, new, (0, pos, 0))
-
-    vwrite = jax.vmap(write)  # over the batch axis
-
     def body(x, scanned):
-        layer, k_cache, v_cache = scanned  # caches (B, Hkv, C, D)
+        layer, cache_l = scanned  # per-layer cache dict, leaves (B, Hkv, …)
         h = _norm(x, layer["attn_norm"], cfg)
         hq, hk, hv = _qkv(h, layer)
         q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin, per_batch=True)
         k = apply_rope(_split_heads(hk, cfg.n_kv_heads), cos, sin,
                        per_batch=True)
         v = _split_heads(hv, cfg.n_kv_heads)
-        k_cache = vwrite(k_cache, k, positions)
-        v_cache = vwrite(v_cache, v, positions)
+        # Per-row write at each slot's own position; the cache pytree's
+        # structure decides the storage format (quantize-on-write when the
+        # scale leaves are present — models.llama init_kv_cache kv_bits=8).
+        cache_l = _cache_store_rows(cache_l, k, v, positions)
         if decode_attn is None:
             attn = _gqa_decode_attention(
-                q, k_cache, v_cache, positions, window=cfg.sliding_window,
-                kv_mask=kv_mask, per_batch=True,
+                q, cache_l["k"], cache_l["v"], positions,
+                window=cfg.sliding_window, kv_mask=kv_mask, per_batch=True,
+                k_scale=cache_l.get("k_scale"),
+                v_scale=cache_l.get("v_scale"),
             )
         else:
             # GQA-native split-KV decode: the unrepeated cache shard goes
             # straight in (sp_decode_attention folds the group mapping) —
             # decode is KV-bandwidth-bound, so a rep-times-broadcast here
-            # would multiply the step's HBM traffic.
+            # would multiply the step's HBM traffic. int8 scale shards ride
+            # along sp exactly like their values.
             attn = decode_attn(
-                q, k_cache, v_cache, positions, window=cfg.sliding_window,
-                kv_mask=kv_mask, per_batch=True,
+                q, cache_l["k"], cache_l["v"], positions,
+                window=cfg.sliding_window, kv_mask=kv_mask, per_batch=True,
+                k_scale=cache_l.get("k_scale"),
+                v_scale=cache_l.get("v_scale"),
             )
         x = x + _mm(_merge_heads(attn), layer["wo"])
         h = _norm(x, layer["mlp_norm"], cfg)
         x = x + _mlp(layer, h, cfg)
-        return x, (k_cache, v_cache)
+        return x, cache_l
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
-    )
+    x, new_cache = jax.lax.scan(body, x, (params["layers"], cache))
     logits = _lm_head_logits(_norm(x[:, 0], params["final_norm"], cfg), params)
     nxt = sample_logits(logits, key, temperature, top_k, top_p)
-    return nxt, {"k": new_k, "v": new_v}
+    return nxt, new_cache
 
 
 # ---------------------------------------------------------------------------
@@ -250,6 +255,7 @@ class ContinuousBatcher(_BatcherBase):
         prompt_bucket: int = 64,
         key: Optional[jax.Array] = None,
         plan=None,  # parallel.mesh.MeshPlan → tp/sp-sharded serving
+        kv_bits: int = 0,  # 8 → int8 KV storage (halved cache HBM)
     ):
         self.gen = gen or GenerationConfig()
         if prompt_bucket + self.gen.max_new_tokens > cache_len:
@@ -261,7 +267,7 @@ class ContinuousBatcher(_BatcherBase):
         self.cfg = cfg
         self.cache_len = cache_len
         self.key = jax.random.PRNGKey(0) if key is None else key
-        self.cache = init_kv_cache(cfg, slots, cache_len)
+        self.cache = init_kv_cache(cfg, slots, cache_len, kv_bits=kv_bits)
         self.kv_mask = jnp.zeros((slots, cache_len), bool)
         # Host-side mutable state; uploaded once per step.
         self.positions = np.zeros((slots,), np.int32)
@@ -283,21 +289,15 @@ class ContinuousBatcher(_BatcherBase):
             )
 
             mesh = plan.mesh
-            if cfg.n_kv_heads % max(1, mesh.shape.get("tp", 1)):
-                raise ValueError(
-                    f"tp={mesh.shape.get('tp')} must divide n_kv_heads="
-                    f"{cfg.n_kv_heads} for sharded serving"
-                )
             sp = mesh.shape.get("sp", 1)
             if sp > 1 and cache_len % sp:
                 raise ValueError(
                     f"cache_len {cache_len} not divisible by sp={sp}"
                 )
+            # Cache first: shard_kv_cache owns the tp-divides-kv-heads
+            # validation, and must fire before params are placed.
+            self.cache = plan.shard_kv_cache(self.cache, seq_over_sp=True)
             self.params = plan.shard_params(params)
-            self.cache = jax.device_put(
-                self.cache,
-                NamedSharding(mesh, P(None, None, "tp", "sp", None)),
-            )
             self.kv_mask = jax.device_put(
                 self.kv_mask, NamedSharding(mesh, P(None, "sp"))
             )

@@ -419,6 +419,15 @@ def init_kv_cache(
     score/value einsum epilogues, prefill attention still runs on the
     fresh full-precision K/V (only storage quantizes)."""
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+    return _kv_cache_leaves(shape, cfg.dtype, kv_bits)
+
+
+def _kv_cache_leaves(shape: tuple, dtype, kv_bits: int) -> dict:
+    """ONE constructor for the structure-keyed storage format, shared by
+    the stacked cache (above) and the paged block pool (models.paged):
+    ``shape`` is the (..., S, D) value-leaf shape; kv_bits=8 adds the
+    bf16 scale leaves one rank lower. Keeping it single-homed means a
+    format change (scale dtype, a new kv_bits) cannot diverge them."""
     if kv_bits == 8:
         return {
             "k": jnp.zeros(shape, jnp.int8),
@@ -428,7 +437,7 @@ def init_kv_cache(
         }
     if kv_bits:
         raise ValueError(f"kv_bits must be 0 or 8, got {kv_bits}")
-    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
 def _kv_quantize(x: jax.Array) -> tuple[jax.Array, jax.Array]:

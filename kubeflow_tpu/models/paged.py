@@ -47,6 +47,8 @@ from kubeflow_tpu.models.llama import (
     LlamaConfig,
     _embed,
     _gqa_decode_attention,
+    _kv_cache_leaves,
+    _kv_quantize,
     _lm_head_logits,
     _merge_heads,
     _mlp,
@@ -64,10 +66,17 @@ from kubeflow_tpu.models.continuous import _BatcherBase, _Request
 from kubeflow_tpu.models.serving import GenerationConfig, left_pad
 
 
-def init_block_pool(cfg: LlamaConfig, num_blocks: int, block_size: int) -> dict:
-    """k/v block pools, (L, NB, Hkv, BS, D)."""
+def init_block_pool(
+    cfg: LlamaConfig, num_blocks: int, block_size: int, kv_bits: int = 0
+) -> dict:
+    """k/v block pools, (L, NB, Hkv, BS, D).
+
+    ``kv_bits=8`` stores int8 values + per-(block-row, head, offset) bf16
+    scale leaves — same structure-keyed format as models.llama
+    init_kv_cache (shared leaf constructor), so the step/admit programs
+    dispatch off the pytree."""
     shape = (cfg.n_layers, num_blocks, cfg.n_kv_heads, block_size, cfg.head_dim)
-    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+    return _kv_cache_leaves(shape, cfg.dtype, kv_bits)
 
 
 @partial(jax.jit, static_argnames=("cfg", "block_size"), donate_argnums=(3,))
@@ -82,17 +91,21 @@ def _paged_admit(
 ) -> tuple[jax.Array, dict]:
     """Prefill one prompt into its allocated blocks; first logits (V,)."""
     lb = tokens.shape[1]
-    temp = init_kv_cache(cfg, 1, lb)
+    # Temp cache mirrors the pool's storage format (structure-keyed int8);
+    # scale leaves are one rank lower, with the sequence axis at -1.
+    temp = init_kv_cache(cfg, 1, lb, kv_bits=8 if "k_scale" in pool else 0)
     logits, temp = _prefill_impl(params, cfg, tokens, temp, kv_mask=prompt_mask)
     new_pool = dict(pool)
-    for name in ("k", "v"):
+    for name in pool:
         buf = new_pool[name]
+        # temp[name][:, 0] is (L, Hkv, S, D) for values, (L, Hkv, S) for
+        # scale leaves — the sequence axis is 2 in both.
         for j in range(lb // block_size):
             chunk = jax.lax.dynamic_slice_in_dim(
                 temp[name][:, 0], j * block_size, block_size, axis=2
-            )  # (L, Hkv, BS, D)
+            )  # (L, Hkv, BS[, D])
             buf = jax.lax.dynamic_update_slice(
-                buf, chunk[:, None], (0, blocks[j], 0, 0, 0)
+                buf, chunk[:, None], (0, blocks[j]) + (0,) * (buf.ndim - 2)
             )
         new_pool[name] = buf
     return logits[0], new_pool
@@ -127,14 +140,18 @@ def _paged_step(
     off = positions % block_size
 
     def gathered(pool_l):
-        # (NB, Hkv, BS, D)[tables] → (B, MAXB, Hkv, BS, D) → logical view.
+        # (NB, Hkv, BS[, D])[tables] → (B, MAXB, Hkv, BS[, D]) → logical
+        # per-slot view: (B, Hkv, MAXB·BS[, D]). Works for value leaves
+        # and (one rank lower) int8 scale leaves alike.
         g = pool_l[tables]
-        return g.transpose(0, 2, 1, 3, 4).reshape(
-            b, cfg.n_kv_heads, maxb * block_size, cfg.head_dim
-        )
+        perm = (0, 2, 1, 3) + ((4,) if g.ndim == 5 else ())
+        shape = (b, cfg.n_kv_heads, maxb * block_size)
+        if g.ndim == 5:
+            shape += (cfg.head_dim,)
+        return g.transpose(perm).reshape(shape)
 
     def body(x, scanned):
-        layer, k_pool_l, v_pool_l = scanned
+        layer, pool_l = scanned  # per-layer pool dict, leaves (NB, Hkv, …)
         h = _norm(x, layer["attn_norm"], cfg)
         hq, hk, hv = _qkv(h, layer)
         q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin, per_batch=True)
@@ -142,24 +159,44 @@ def _paged_step(
                        per_batch=True)
         v = _split_heads(hv, cfg.n_kv_heads)
         # Scatter this token's K/V row into (block, offset) — requests own
-        # disjoint blocks, so batch rows never collide.
-        k_pool_l = k_pool_l.at[blk, :, off].set(k[:, :, 0])
-        v_pool_l = v_pool_l.at[blk, :, off].set(v[:, :, 0])
+        # disjoint blocks, so batch rows never collide. The pool pytree's
+        # structure decides the storage format: scale leaves present →
+        # quantize on write (int8 KV, models.llama kv_bits=8).
+        pool_l = dict(pool_l)
+        if "k_scale" in pool_l:
+            kq, ks = _kv_quantize(k)
+            vq, vs = _kv_quantize(v)
+            pool_l["k"] = pool_l["k"].at[blk, :, off].set(kq[:, :, 0])
+            pool_l["v"] = pool_l["v"].at[blk, :, off].set(vq[:, :, 0])
+            pool_l["k_scale"] = (
+                pool_l["k_scale"].at[blk, :, off].set(ks[:, :, 0])
+            )
+            pool_l["v_scale"] = (
+                pool_l["v_scale"].at[blk, :, off].set(vs[:, :, 0])
+            )
+        else:
+            pool_l["k"] = pool_l["k"].at[blk, :, off].set(k[:, :, 0])
+            pool_l["v"] = pool_l["v"].at[blk, :, off].set(v[:, :, 0])
+        ks_g = (
+            gathered(pool_l["k_scale"]) if "k_scale" in pool_l else None
+        )
+        vs_g = (
+            gathered(pool_l["v_scale"]) if "v_scale" in pool_l else None
+        )
         attn = _gqa_decode_attention(
-            q, gathered(k_pool_l), gathered(v_pool_l), positions,
+            q, gathered(pool_l["k"]), gathered(pool_l["v"]), positions,
             window=cfg.sliding_window, kv_mask=kv_mask, per_batch=True,
+            k_scale=ks_g, v_scale=vs_g,
         )
         x = x + _mm(_merge_heads(attn), layer["wo"])
         h = _norm(x, layer["mlp_norm"], cfg)
         x = x + _mlp(layer, h, cfg)
-        return x, (k_pool_l, v_pool_l)
+        return x, pool_l
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], pool["k"], pool["v"])
-    )
+    x, new_pool = jax.lax.scan(body, x, (params["layers"], pool))
     logits = _lm_head_logits(_norm(x[:, 0], params["final_norm"], cfg), params)
     nxt = sample_logits(logits, key, temperature, top_k, top_p)
-    return nxt, {"k": new_k, "v": new_v}
+    return nxt, new_pool
 
 
 class PagedBatcher(_BatcherBase):
@@ -185,6 +222,7 @@ class PagedBatcher(_BatcherBase):
         prompt_bucket: int = 64,
         key: Optional[jax.Array] = None,
         plan=None,  # parallel.mesh.MeshPlan → tp-sharded serving
+        kv_bits: int = 0,  # 8 → int8 block pool (halved KV HBM)
     ):
         self.gen = gen or GenerationConfig()
         if prompt_bucket % block_size:
@@ -206,7 +244,8 @@ class PagedBatcher(_BatcherBase):
             prompt_bucket + self.gen.max_new_tokens + block_size - 1
         ) // block_size + 1
         self.key = jax.random.PRNGKey(0) if key is None else key
-        self.pool = init_block_pool(cfg, num_blocks, block_size)
+        self.pool = init_block_pool(cfg, num_blocks, block_size,
+                                    kv_bits=kv_bits)
         if plan is not None:
             # tp-sharded paged serving: params per the model-wide plan,
             # the pool's kv-head axis over tp; GSPMD propagates through
@@ -215,25 +254,16 @@ class PagedBatcher(_BatcherBase):
             # by BLOCK ownership, not by contiguous sequence ranges, so
             # the split-KV sp merge does not apply; use ContinuousBatcher
             # for sp-sharded caches.
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            mesh = plan.mesh
-            if mesh.shape.get("sp", 1) > 1:
+            if plan.mesh.shape.get("sp", 1) > 1:
                 raise ValueError(
                     "PagedBatcher does not support sp-sharded meshes; "
                     "the block pool has no contiguous sequence axis to "
                     "shard (use ContinuousBatcher for sp)"
                 )
-            if cfg.n_kv_heads % max(1, mesh.shape.get("tp", 1)):
-                raise ValueError(
-                    f"tp={mesh.shape.get('tp')} must divide n_kv_heads="
-                    f"{cfg.n_kv_heads} for sharded serving"
-                )
+            # Pool first: shard_kv_cache owns the tp-divides-kv-heads
+            # validation, and must fire before params are placed.
+            self.pool = plan.shard_kv_cache(self.pool)
             self.params = plan.shard_params(params)
-            self.pool = jax.device_put(
-                self.pool,
-                NamedSharding(mesh, P(None, None, "tp", None, None)),
-            )
         self.kv_mask = jnp.zeros((slots, self.max_blocks * block_size), bool)
         self.tables = np.zeros((slots, self.max_blocks), np.int32)
         self.positions = np.zeros((slots,), np.int32)

@@ -73,7 +73,7 @@ def left_pad(
 @partial(
     jax.jit,
     static_argnames=("cfg", "steps", "cache_len", "temperature", "top_k", "top_p",
-                     "eos_id", "pad_id"),
+                     "eos_id", "pad_id", "kv_bits"),
 )
 def _batch_generate_fused(
     params: dict,
@@ -88,10 +88,13 @@ def _batch_generate_fused(
     top_p: float,
     eos_id: int,
     pad_id: int,
+    kv_bits: int = 0,
 ) -> tuple[jax.Array, jax.Array]:
     """(generated (B, steps), lengths (B,)) in one compiled program."""
     b, s_prompt = tokens.shape
-    kv_cache = init_kv_cache(cfg, b, cache_len)
+    # kv_bits=8 → int8 cache storage; prefill/decode dispatch off the
+    # cache pytree's structure (models.llama init_kv_cache).
+    kv_cache = init_kv_cache(cfg, b, cache_len, kv_bits=kv_bits)
     # Static full-cache mask: pad slots False forever, every slot from the
     # prompt end onward True (causality hides not-yet-written slots).
     kv_mask = (
@@ -140,12 +143,14 @@ def batch_generate(
     gen: Optional[GenerationConfig] = None,
     key: Optional[jax.Array] = None,
     pad_to: Optional[int] = None,
+    kv_bits: int = 0,
 ) -> list[list[int]]:
     """Generate completions for a ragged batch of prompts.
 
     Returns one token list per prompt, truncated at (and excluding) EOS.
     ``pad_to`` buckets the prompt length so repeated calls reuse one
-    compiled program.
+    compiled program. ``kv_bits=8`` stores the KV cache as int8
+    (~half the cache HBM; logits drift within quantization error).
     """
     gen = gen or GenerationConfig()
     key = jax.random.PRNGKey(0) if key is None else key
@@ -159,7 +164,7 @@ def batch_generate(
         params, cfg, jnp.asarray(tokens), mask, key,
         steps=gen.max_new_tokens, cache_len=cache_len,
         temperature=gen.temperature, top_k=gen.top_k, top_p=gen.top_p,
-        eos_id=gen.eos_id, pad_id=gen.pad_id,
+        eos_id=gen.eos_id, pad_id=gen.pad_id, kv_bits=kv_bits,
     )
     out = np.asarray(out)
     lengths = np.asarray(lengths)

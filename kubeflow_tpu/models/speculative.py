@@ -197,6 +197,8 @@ class SpeculativeContinuousBatcher:
         prompt_bucket: int = 64,
         key=None,
         k_spec: int = 4,
+        plan=None,  # parallel.mesh.MeshPlan → tp-sharded spec serving
+        kv_bits: int = 0,  # 8 → int8 KV for BOTH target and draft caches
     ):
         from kubeflow_tpu.models.continuous import ContinuousBatcher
         from kubeflow_tpu.models.serving import GenerationConfig
@@ -207,6 +209,14 @@ class SpeculativeContinuousBatcher:
                 "speculative serving is greedy-only (temperature must be 0: "
                 "acceptance compares argmaxes, sampling would break the "
                 "exactness guarantee)"
+            )
+        if plan is not None and plan.mesh.shape.get("sp", 1) > 1:
+            raise ValueError(
+                "SpeculativeContinuousBatcher does not support sp-sharded "
+                "meshes: draft-propose and target-verify run the chunked "
+                "decode (K>1 tokens per step), which has no split-KV sp "
+                "merge; use tp (and dp/fsdp) axes, or ContinuousBatcher "
+                "for sp-sharded caches"
             )
         # Spec rounds write up to k_spec+1 slots beyond the pointer before
         # rewinding; the cache needs that headroom past the nominal span.
@@ -232,13 +242,24 @@ class SpeculativeContinuousBatcher:
 
         self._cb = _Inner(
             params, target_cfg, gen=gen, slots=slots, cache_len=cache_len,
-            prompt_bucket=prompt_bucket, key=key,
+            prompt_bucket=prompt_bucket, key=key, plan=plan, kv_bits=kv_bits,
         )
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.k_spec = k_spec
-        self.draft_cache = init_kv_cache(draft_cfg, slots, cache_len)
+        self.draft_cache = init_kv_cache(draft_cfg, slots, cache_len,
+                                         kv_bits=kv_bits)
         self.draft_kv_mask = jnp.zeros((slots, cache_len), bool)
+        if plan is not None:
+            # The draft rides the same mesh: its params shard by the same
+            # tp rules, its cache's kv-head axis over tp. GSPMD propagates
+            # through _draft_propose/_target_verify (chunked decode) —
+            # psum for tp matmuls, no code change.
+            # Cache first: shard_kv_cache owns the tp-divides-kv-heads
+            # validation (the draft's head count can differ from the
+            # target's), and must fire before params are placed.
+            self.draft_cache = plan.shard_kv_cache(self.draft_cache)
+            self.draft_params = plan.shard_params(draft_params)
         self.proposed = 0
         self.accepted = 0
 

@@ -96,6 +96,33 @@ class MeshPlan:
 
         return jax.tree_util.tree_map_with_path(place, params)
 
+    def shard_kv_cache(self, cache, seq_over_sp: bool = False):
+        """device_put a stacked KV cache or paged block pool: the kv-head
+        axis (2) over tp, the sequence/offset axis (3) over sp when
+        ``seq_over_sp`` (dense serving caches; block pools shard by block
+        ownership, so their offset axis stays unsharded). int8 scale
+        leaves — one rank lower, no trailing head dim (models.llama
+        init_kv_cache kv_bits=8) — follow their values. ONE home for the
+        rank-dispatch rule AND its tp-divisibility precondition, so the
+        serving engines cannot diverge. Raises when tp would split a kv
+        head (GQA: a finer-than-head split silently corrupts attention)."""
+        tp = self.mesh.shape.get("tp", 1)
+        hkv = jax.tree_util.tree_leaves(cache)[0].shape[2]
+        if hkv % max(1, tp):
+            raise ValueError(
+                f"tp={tp} must divide n_kv_heads={hkv} for sharded serving"
+            )
+        seq = "sp" if seq_over_sp else None
+
+        def place(leaf):
+            spec = (
+                P(None, None, "tp", seq, None) if leaf.ndim == 5
+                else P(None, None, "tp", seq)
+            )
+            return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+
+        return jax.tree.map(place, cache)
+
     def param_shardings(self, params):
         """NamedSharding tree (for jit in/out shardings)."""
         def spec_of(path, value):

@@ -167,6 +167,8 @@ def sp_decode_attention(
     window: int = 0,
     kv_mask: Optional[jax.Array] = None,  # local (B, Skl) valid cache slots
     per_batch: bool = False,
+    k_scale: Optional[jax.Array] = None,  # local (B, Hkv, Skl): int8 cache
+    v_scale: Optional[jax.Array] = None,  #   scales (models.llama kv_bits=8)
 ) -> jax.Array:
     """Split-KV decode: each device attends its local KV-cache shard, then
     the partial softmaxes merge across ``sp`` with pmax/psum (the
@@ -188,10 +190,17 @@ def sp_decode_attention(
     skl = k.shape[2]
     scale = 1.0 / math.sqrt(d)
     qg = q.reshape(b, hkv, h // hkv, sq, d)
-    # Native-dtype MXU operands, f32 accumulation (see ring step).
+    # Native-dtype MXU operands, f32 accumulation (see ring step). An int8
+    # cache shard (k_scale given) upcasts the VALUES to q's dtype for the
+    # dot and folds the per-(head, position) scale into the f32 score
+    # epilogue — same discipline as _gqa_decode_attention: only int8 bytes
+    # ever cross HBM.
     s = jnp.einsum(
-        "bgrqd,bgkd->bgrqk", qg, k, preferred_element_type=jnp.float32,
+        "bgrqd,bgkd->bgrqk", qg, k.astype(q.dtype) if k_scale is not None
+        else k, preferred_element_type=jnp.float32,
     ) * scale  # (B, G, R, Sq, Skl)
+    if k_scale is not None:
+        s = s * k_scale.astype(jnp.float32)[:, :, None, None, :]
     pos = jnp.asarray(position)
     k_pos = my_idx * skl + jnp.arange(skl)[None, :]
     if per_batch:
@@ -215,9 +224,15 @@ def sp_decode_attention(
     m = jax.lax.pmax(m_local, axis_name)
     p = jnp.exp(s - m[..., None])
     l = jax.lax.psum(jnp.sum(p, axis=-1), axis_name)
+    if v_scale is not None:
+        # Fold the value scales into the probabilities (cheap: (…, Skl) vs
+        # dequantizing the (…, Skl, D) values).
+        p = p * v_scale.astype(jnp.float32)[:, :, None, None, :]
     o = jax.lax.psum(
         jnp.einsum(
-            "bgrqk,bgkd->bgrqd", p.astype(v.dtype), v,
+            "bgrqk,bgkd->bgrqd",
+            p.astype(q.dtype if v_scale is not None else v.dtype),
+            v.astype(q.dtype) if v_scale is not None else v,
             preferred_element_type=jnp.float32,
         ),
         axis_name,
@@ -227,28 +242,48 @@ def sp_decode_attention(
     return out.reshape(b, h, sq, d).astype(q.dtype)
 
 
-def cached_sharded(mesh: Mesh, body, base_specs, out_spec, mask_spec):
+def cached_sharded(mesh: Mesh, body, base_specs, out_spec, opt_groups):
     """shard_map-builder shared by the SP attention factories: builds (and
-    caches by static config) one shard_map whose trailing kv_mask input is
-    present only when the caller passes one — so None-mask callers pay no
-    dummy-mask bandwidth and repeat calls reuse the same traced closure.
+    caches by static config) one shard_map whose OPTIONAL trailing inputs
+    are present only when the caller passes them — so e.g. None-mask
+    callers pay no dummy-mask bandwidth and repeat calls reuse the same
+    traced closure.
 
-    ``body(*args, **static)`` runs inside the shard_map; when a mask is
-    present it arrives as the last positional arg.
+    ``opt_groups`` is an ordered tuple of ``(name, specs)`` optional
+    operand groups appended after the base operands when present. The
+    returned ``get(present, **static)`` (``present``: tuple of bools
+    aligned with opt_groups) yields a shard_map callable taking the base
+    args plus each present group's operands in declaration order; inside,
+    ``body(*base_args, **static, <name>=operand(s) or None)`` — a group
+    with one spec arrives as a bare operand, a multi-spec group as a
+    tuple.
     """
     cache: dict = {}
+    n_base = len(base_specs)
 
-    def get(with_mask: bool, **static):
-        key = (with_mask, tuple(sorted(static.items())))
+    def get(present, **static):
+        present = tuple(present)
+        key = (present, tuple(sorted(static.items())))
         if key not in cache:
-            in_specs = base_specs + ((mask_spec,) if with_mask else ())
+            in_specs = tuple(base_specs)
+            for (_, specs), here in zip(opt_groups, present):
+                if here:
+                    in_specs += tuple(specs)
 
             @partial(
                 shard_map, mesh=mesh, in_specs=in_specs,
                 out_specs=out_spec, check_vma=False,
             )
             def _sharded(*args):
-                return body(*args, **static)
+                rest = args[n_base:]
+                opts = {}
+                for (name, specs), here in zip(opt_groups, present):
+                    if not here:
+                        opts[name] = None
+                        continue
+                    take, rest = rest[:len(specs)], rest[len(specs):]
+                    opts[name] = take[0] if len(specs) == 1 else take
+                return body(*args[:n_base], **opts, **static)
 
             cache[key] = _sharded
         return cache[key]
@@ -263,22 +298,21 @@ def make_sharded_ring_attention(mesh: Mesh):
     so it can be passed as ``impl``."""
     spec = P(("dp", "fsdp"), "tp", "sp", None)
 
-    def body(q, k, v, *mask, **static):
-        return ring_attention(
-            q, k, v, axis_name="sp",
-            kv_mask=mask[0] if mask else None, **static,
-        )
+    def body(q, k, v, kv_mask=None, **static):
+        return ring_attention(q, k, v, axis_name="sp", kv_mask=kv_mask,
+                              **static)
 
     get = cached_sharded(
-        mesh, body, (spec, spec, spec), spec, P(("dp", "fsdp"), "sp")
+        mesh, body, (spec, spec, spec), spec,
+        (("kv_mask", (P(("dp", "fsdp"), "sp"),)),),
     )
 
     def attention(q, k, v, causal=True, q_offset=0, window=0, kv_mask=None,
                   impl=None):
         static = dict(causal=causal, q_offset=q_offset, window=window)
         if kv_mask is not None:
-            return get(True, **static)(q, k, v, kv_mask)
-        return get(False, **static)(q, k, v)
+            return get((True,), **static)(q, k, v, kv_mask)
+        return get((False,), **static)(q, k, v)
 
     return attention
 
@@ -293,26 +327,37 @@ def make_sharded_sp_decode(mesh: Mesh):
     fresh closure per caller would recompile the whole serving step."""
     q_spec = P(("dp", "fsdp"), "tp", None, None)  # q NOT sharded over sp
     kv_spec = P(("dp", "fsdp"), "tp", "sp", None)
+    scale_spec = P(("dp", "fsdp"), "tp", "sp")  # int8-cache (B, Hkv, Skl)
 
-    def body(q, k, v, position, *mask, **static):
+    def body(q, k, v, position, scales=None, kv_mask=None, **static):
+        ks, vs = scales if scales is not None else (None, None)
         return sp_decode_attention(
-            q, k, v, position, axis_name="sp",
-            kv_mask=mask[0] if mask else None, **static,
+            q, k, v, position, axis_name="sp", kv_mask=kv_mask,
+            k_scale=ks, v_scale=vs, **static,
         )
 
     get = cached_sharded(
         mesh, body, (q_spec, kv_spec, kv_spec, P()), q_spec,
-        P(("dp", "fsdp"), "sp"),
+        (
+            ("scales", (scale_spec, scale_spec)),
+            ("kv_mask", (P(("dp", "fsdp"), "sp"),)),
+        ),
     )
 
-    def decode(q, k, v, position, window=0, kv_mask=None, per_batch=False):
-        position = jnp.asarray(position)
-        if kv_mask is not None:
-            return get(True, window=window, per_batch=per_batch)(
-                q, k, v, position, kv_mask
+    def decode(q, k, v, position, window=0, kv_mask=None, per_batch=False,
+               k_scale=None, v_scale=None):
+        if (k_scale is None) != (v_scale is None):
+            raise ValueError(
+                "k_scale and v_scale must be passed together (int8 cache "
+                "shards carry both, models.llama init_kv_cache kv_bits=8)"
             )
-        return get(False, window=window, per_batch=per_batch)(
-            q, k, v, position
-        )
+        position = jnp.asarray(position)
+        args = (q, k, v, position)
+        if k_scale is not None:
+            args += (k_scale, v_scale)
+        if kv_mask is not None:
+            args += (kv_mask,)
+        return get((k_scale is not None, kv_mask is not None),
+                   window=window, per_batch=per_batch)(*args)
 
     return decode

@@ -96,14 +96,15 @@ def make_sharded_ulysses_attention(mesh: Mesh, local_impl: str = "auto"):
     spec = P(("dp", "fsdp"), "tp", "sp", None)
     sp = mesh.shape.get("sp", 1)
 
-    def body(q, k, v, *mask, **static):
+    def body(q, k, v, kv_mask=None, **static):
         return ulysses_attention(
             q, k, v, axis_name="sp", local_impl=local_impl,
-            kv_mask=mask[0] if mask else None, **static,
+            kv_mask=kv_mask, **static,
         )
 
     get = cached_sharded(
-        mesh, body, (spec, spec, spec), spec, P(("dp", "fsdp"), "sp")
+        mesh, body, (spec, spec, spec), spec,
+        (("kv_mask", (P(("dp", "fsdp"), "sp"),)),),
     )
 
     def attention(q, k, v, causal=True, q_offset=0, window=0, kv_mask=None,
@@ -118,7 +119,7 @@ def make_sharded_ulysses_attention(mesh: Mesh, local_impl: str = "auto"):
             )
         static = dict(causal=causal, q_offset=q_offset, window=window)
         if kv_mask is not None:
-            return get(True, **static)(q, k, v, kv_mask)
-        return get(False, **static)(q, k, v)
+            return get((True,), **static)(q, k, v, kv_mask)
+        return get((False,), **static)(q, k, v)
 
     return attention

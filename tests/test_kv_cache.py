@@ -113,3 +113,135 @@ class TestInt8KVCache:
         ks = np.asarray(cache["k_scale"][0])  # layer 0: (B, Hkv, C)
         assert (ks[0, :, 0:3] > 0).all() and (ks[0, :, 3:] == 0).all()
         assert (ks[1, :, 5:8] > 0).all() and (ks[1, :, 0:5] == 0).all()
+
+
+class TestServingInt8KV:
+    """int8 KV through the SERVING stack — every consumer that builds a
+    cache accepts kv_bits and keeps (near-)greedy parity with its bf16
+    twin. The batched server's cache is exactly the HBM pressure int8 KV
+    exists to halve, so the format must reach it, not just bs=1
+    generate()."""
+
+    def _agreement(self, a: list, b: list) -> float:
+        """Positionwise token agreement over the common prefix length
+        (greedy paths may legitimately fork after a near-tie)."""
+        n = min(len(a), len(b))
+        if n == 0:
+            return 1.0
+        return sum(x == y for x, y in zip(a[:n], b[:n])) / n
+
+    def _prompts(self, cfg, n, key=61):
+        ks = jax.random.split(jax.random.PRNGKey(key), n)
+        return [
+            [int(t) for t in
+             jax.random.randint(k, (4 + 2 * i,), 3, cfg.vocab_size)]
+            for i, k in enumerate(ks)
+        ]
+
+    def test_batch_generate_kv8(self, tiny):
+        from kubeflow_tpu.models.serving import (
+            GenerationConfig, batch_generate,
+        )
+
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=12, eos_id=-1)
+        prompts = self._prompts(cfg, 3)
+        full = batch_generate(params, cfg, prompts, gen=gen, pad_to=16)
+        q8 = batch_generate(params, cfg, prompts, gen=gen, pad_to=16,
+                            kv_bits=8)
+        assert [len(r) for r in q8] == [len(r) for r in full]
+        agree = np.mean([self._agreement(a, b) for a, b in zip(full, q8)])
+        assert agree >= 0.5, f"only {agree:.0%} token agreement"
+
+    def test_continuous_batcher_kv8(self, tiny):
+        from kubeflow_tpu.models.continuous import ContinuousBatcher
+        from kubeflow_tpu.models.serving import GenerationConfig
+
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=8, eos_id=-1)
+        prompts = self._prompts(cfg, 4)
+
+        def run(kv_bits):
+            cb = ContinuousBatcher(params, cfg, gen=gen, slots=2,
+                                   cache_len=64, prompt_bucket=16,
+                                   kv_bits=kv_bits)
+            rids = [cb.submit(p) for p in prompts]
+            out = cb.run()
+            return cb, [out[r] for r in rids]
+
+        cb8, q8 = run(8)
+        assert cb8.cache["k"].dtype == jnp.int8
+        assert "k_scale" in cb8.cache
+        _, full = run(0)
+        agree = np.mean([self._agreement(a, b) for a, b in zip(full, q8)])
+        assert agree >= 0.5, f"only {agree:.0%} token agreement"
+
+    def test_paged_batcher_kv8(self, tiny):
+        from kubeflow_tpu.models.paged import PagedBatcher
+        from kubeflow_tpu.models.serving import GenerationConfig
+
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=8, eos_id=-1)
+        prompts = self._prompts(cfg, 4)
+
+        def run(kv_bits):
+            pb = PagedBatcher(params, cfg, gen=gen, slots=2,
+                              num_blocks=24, block_size=8,
+                              prompt_bucket=16, kv_bits=kv_bits)
+            rids = [pb.submit(p) for p in prompts]
+            out = pb.run()
+            return pb, [out[r] for r in rids]
+
+        pb8, q8 = run(8)
+        assert pb8.pool["k"].dtype == jnp.int8
+        assert pb8.free_blocks == 23  # all returned after the run
+        _, full = run(0)
+        agree = np.mean([self._agreement(a, b) for a, b in zip(full, q8)])
+        assert agree >= 0.5, f"only {agree:.0%} token agreement"
+
+    def test_paged_int8_preemption_continuation(self, tiny):
+        """Preempt/re-admit (the paged recovery path) works with the int8
+        pool too: a deliberately starved pool forces preemptions and
+        every request still completes its budget."""
+        from kubeflow_tpu.models.paged import PagedBatcher
+        from kubeflow_tpu.models.serving import GenerationConfig
+
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=10, eos_id=-1)
+        pb = PagedBatcher(params, cfg, gen=gen, slots=3, num_blocks=10,
+                          block_size=8, prompt_bucket=8, kv_bits=8)
+        prompts = self._prompts(cfg, 3, key=67)
+        rids = [pb.submit(p[:6]) for p in prompts]
+        out = pb.run()
+        assert all(len(out[r]) == 10 for r in rids)
+
+    def test_sharded_continuous_kv8_tracks_single_device(self, tiny):
+        """tp/sp-sharded int8 serving tracks single-device int8 serving.
+        Quantization itself is deterministic and the sp split-KV merge
+        carries the scale shards with their values, but tp changes the
+        psum reduction order of the activation matmuls FEEDING the cache,
+        so a bf16 near-tie may legitimately fork the greedy stream —
+        demand strong agreement, not byte-equality (the suite's standard
+        for cross-reduction-order comparisons)."""
+        from kubeflow_tpu.models.continuous import ContinuousBatcher
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        prompts = self._prompts(cfg, 3, key=71)
+
+        def run(plan):
+            cb = ContinuousBatcher(params, cfg, gen=gen, slots=2,
+                                   cache_len=64, prompt_bucket=16,
+                                   plan=plan, kv_bits=8)
+            rids = [cb.submit(p) for p in prompts]
+            out = cb.run()
+            return [out[r] for r in rids]
+
+        want = run(None)
+        plan = MeshPlan(make_mesh(tp=2, sp=2, devices=jax.devices()[:4]))
+        got = run(plan)
+        assert [len(r) for r in got] == [len(r) for r in want]
+        agree = np.mean([self._agreement(a, b) for a, b in zip(want, got)])
+        assert agree >= 0.5, f"only {agree:.0%} token agreement"

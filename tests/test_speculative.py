@@ -196,3 +196,87 @@ class TestSpeculativeServing:
                 gen=GenerationConfig(max_new_tokens=4, temperature=0.8),
                 cache_len=256,
             )
+
+
+class TestShardedSpeculativeServing:
+    """Speculative serving composed with a device mesh: tp shards the
+    target AND draft params/caches through the same MeshPlan; the spec
+    engine's token stream must be exactly the single-device stream (the
+    plan changes where tensors live, not what the server emits)."""
+
+    def _run(self, target, draft, plan=None, kv_bits=0):
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.models.speculative import (
+            SpeculativeContinuousBatcher,
+        )
+
+        tcfg, tparams = target
+        dcfg, dparams = draft
+        gen = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        ks = jax.random.split(jax.random.PRNGKey(13), 3)
+        prompts = [
+            [int(t) for t in jax.random.randint(k, (4 + i,), 3, 250)]
+            for i, k in enumerate(ks)
+        ]
+        sb = SpeculativeContinuousBatcher(
+            tparams, tcfg, dparams, dcfg, gen=gen, slots=2,
+            cache_len=64, prompt_bucket=16, k_spec=3, plan=plan,
+            kv_bits=kv_bits,
+        )
+        rids = [sb.submit(p) for p in prompts]
+        out = sb.run()
+        return [out[r] for r in rids], sb.acceptance_rate
+
+    def test_tp_sharded_stays_on_greedy_path(self, target, draft):
+        """tp changes the psum reduction order, so a bf16 near-tie may
+        legitimately fork vs single-device (same standard as the serving
+        suite's cross-shape comparisons): assert every emitted token
+        follows the greedy path of its own prompt, not byte-equality."""
+        from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+        from tests.test_continuous import _assert_greedy_consistent
+
+        tcfg, tparams = target
+        plan = MeshPlan(make_mesh(tp=2, devices=jax.devices()[:2]))
+        got, rate = self._run(target, draft, plan=plan)
+        ks = jax.random.split(jax.random.PRNGKey(13), 3)
+        prompts = [
+            [int(t) for t in jax.random.randint(k, (4 + i,), 3, 250)]
+            for i, k in enumerate(ks)
+        ]
+        for prompt, tokens in zip(prompts, got):
+            assert len(tokens) == 6
+            _assert_greedy_consistent(tparams, tcfg, prompt, tokens)
+        assert 0.0 <= rate <= 1.0
+
+    def test_sp_mesh_rejected_with_reason(self, target, draft):
+        from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+
+        plan = MeshPlan(make_mesh(tp=1, sp=2, devices=jax.devices()[:2]))
+        with pytest.raises(ValueError, match="sp-sharded"):
+            self._run(target, draft, plan=plan)
+
+    def test_int8_kv_spec_serving(self, target, draft):
+        """kv_bits=8 reaches BOTH the target and draft caches; the spec
+        invariant (output == target-alone greedy, for the same cache
+        format) holds because verify and plain decode read the same
+        quantized storage."""
+        import jax.numpy as jnp
+        from kubeflow_tpu.models.continuous import ContinuousBatcher
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.models.speculative import (
+            SpeculativeContinuousBatcher,
+        )
+
+        tcfg, tparams = target
+        dcfg, dparams = draft
+        gen = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        sb = SpeculativeContinuousBatcher(
+            tparams, tcfg, dparams, dcfg, gen=gen, slots=2,
+            cache_len=64, prompt_bucket=16, k_spec=3, kv_bits=8,
+        )
+        assert sb._cb.cache["k"].dtype == jnp.int8
+        assert sb.draft_cache["k"].dtype == jnp.int8
+        prompts = [[5, 9, 17, 33], [7, 3, 11]]
+        rids = [sb.submit(p) for p in prompts]
+        out = sb.run()
+        assert all(len(out[r]) == 6 for r in rids)
