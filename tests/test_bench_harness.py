@@ -203,3 +203,38 @@ def test_cached_headline_matches_full_config_tokens(bench, tmp_path,
     assert bench._cached_headline(quant_bits=0, kv_bits=0)[0] is None
     entry, _ = bench._cached_headline(quant_bits=0, kv_bits=8)
     assert entry is not None and entry["value"] == 60.0
+
+
+def test_smoke_mode_headline_runs_on_cpu(bench):
+    """BENCH_SMOKE=1 runs the headline on toy shapes/CPU (no watchdog, no
+    chip) and exits 0 with a parseable JSON line — the executability
+    guard that keeps 'section never ran anywhere' from recurring."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [_sys.executable, bench.__file__],
+        env={**os.environ, "BENCH_SMOKE": "1"},
+        capture_output=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    line = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert line["value"] > 0
+    assert line["metric"].startswith("tiny ")
+
+
+def test_smoke_mode_refuses_artifact(bench):
+    """Toy smoke numbers must never enter the cached-headline search
+    space: --artifact under BENCH_SMOKE is a usage error."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [_sys.executable, bench.__file__, "--full", "--artifact", "x.json"],
+        env={**os.environ, "BENCH_SMOKE": "1"},
+        capture_output=True, timeout=60,
+    )
+    assert proc.returncode == 2
+    assert b"BENCH_SMOKE" in proc.stderr
