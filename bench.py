@@ -768,6 +768,7 @@ def main() -> int:
     kv_bits = 8 if "--kv8" in sys.argv[1:] else 0
     full = "--full" in sys.argv[1:]
     artifact = "BENCH_FULL.json"
+    artifact_requested = False
     args = sys.argv[1:]
     for i, arg in enumerate(args):
         if arg == "--artifact":
@@ -775,13 +776,15 @@ def main() -> int:
                 print("error: --artifact requires a path", file=sys.stderr)
                 return 2
             artifact = args[i + 1]
+            artifact_requested = True
         elif arg.startswith("--artifact="):
             artifact = arg.split("=", 1)[1]
+            artifact_requested = True
 
     import os
 
     smoke = _smoke_enabled()
-    if smoke and "--artifact" in " ".join(args):
+    if smoke and artifact_requested:
         # Smoke numbers are toy-shape executability checks, never
         # measurements; refusing the artifact keeps them out of the
         # cached-headline search space.

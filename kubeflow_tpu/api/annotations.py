@@ -83,16 +83,15 @@ RESERVED_POD_PORTS = _load_reserved_ports()
 
 
 def profiling_port_error(value) -> "str | None":
-    """Why ``value`` is not an acceptable profiling port, or None if it
-    is — the ONE place the rules live, so the webhook's denial message
-    can never diverge from what parse_profiling_port accepts.
-    int() rather than isdigit() — Unicode digits like '²' pass isdigit()
-    but crash int(), and an admission path must deny cleanly, not 500."""
-    try:
-        port = int(str(value).strip())
-    except (TypeError, ValueError):
-        return f"{value!r} is not a port in 1024..65535"
-    if not 1024 <= port <= 65535:
+    """Why ``value`` would be DENIED at admission, or None if acceptable:
+    the range rule plus the reserved-port rule. Reserved-port rejection
+    is an ADMISSION concern only — it gates what new annotations may say,
+    while parse_profiling_port (below) keeps honoring annotations that
+    were admitted under older rules.
+    Layered on parse_profiling_port so the range rule stays single-homed:
+    the denial message can never diverge from what the consumers parse."""
+    port = parse_profiling_port(value)
+    if port is None:
         return f"{value!r} is not a port in 1024..65535"
     if port in RESERVED_POD_PORTS:
         return f"port {port} is already used in-pod by {RESERVED_POD_PORTS[port]}"
@@ -101,11 +100,21 @@ def profiling_port_error(value) -> "str | None":
 
 def parse_profiling_port(value) -> "int | None":
     """THE one parser for the profiling port (webhooks, NetworkPolicy,
-    status, bootstrap all share it): a port in 1024..65535 that is not
-    already claimed in-pod (RESERVED_POD_PORTS), else None."""
-    if profiling_port_error(value) is not None:
+    status, bootstrap all share it): a port in 1024..65535, else None.
+
+    Deliberately RANGE-ONLY: tightening this parser with the reserved-port
+    rule would retroactively invalidate notebooks admitted under older
+    webhooks (their NetworkPolicy/status/bootstrap would silently stop
+    seeing the port instead of surfacing a migration error). New objects
+    with reserved ports never get this far — profiling_port_error denies
+    them at admission.
+    int() rather than isdigit() — Unicode digits like '²' pass isdigit()
+    but crash int(), and an admission path must deny cleanly, not 500."""
+    try:
+        port = int(str(value).strip())
+    except (TypeError, ValueError):
         return None
-    return int(str(value).strip())
+    return port if 1024 <= port <= 65535 else None
 
 # -- labels ------------------------------------------------------------------
 NOTEBOOK_NAME_LABEL = "notebook-name"

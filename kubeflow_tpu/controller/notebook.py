@@ -110,6 +110,15 @@ def _cursor_token(raw: str) -> str:
         return raw
 
 
+def _token_regime(tok: str) -> str:
+    """Which dedup regime a token belongs to: "int" (zero-padded etcd
+    revision), "ts" (timestamp/name fallback), or "" (floor/empty —
+    regime not yet pinned)."""
+    if not tok or tok == _CURSOR_FLOOR:
+        return ""
+    return "ts" if tok.startswith(_TS_PREFIX) else "int"
+
+
 @dataclass
 class ControllerConfig:
     """Env-sourced knobs (reference manager.yaml:28-58 ConfigMap wiring)."""
@@ -675,8 +684,26 @@ class NotebookReconciler(Reconciler):
         max_seen = cursor or _CURSOR_FLOOR
         emitted = False
         priming = not raw_cursor
+        # Sticky regime: once the cursor holds an int (etcd) or ts
+        # (opaque-rv fallback) token, events from the OTHER regime are
+        # skipped symmetrically — string order must never promote the
+        # cursor across regimes. Without this, ONE opaque rv that happens
+        # to parse as an integer would lift the cursor into the int regime
+        # (ints sort above every '.'-prefixed ts token) and permanently
+        # suppress all subsequent timestamp-token events. An unpinned
+        # cursor (fresh/floor) pins to the MAJORITY regime of the visible
+        # events, so the same single anomaly cannot pin the wrong regime
+        # at priming either.
+        regime = _token_regime(cursor)
+        if not regime and events:
+            votes = {"int": 0, "ts": 0}
+            for e in events:
+                votes[_token_regime(_event_token(e))] += 1
+            regime = "int" if votes["int"] >= votes["ts"] else "ts"
         for event in sorted(events, key=_event_token):
             rv = _event_token(event)
+            if regime and _token_regime(rv) != regime:
+                continue
             if rv <= cursor:
                 continue
             max_seen = max(max_seen, rv)

@@ -92,6 +92,11 @@ def speculative_generate(
 
     Output is IDENTICAL to target-only greedy decoding of each row; stats
     reports the acceptance rate that determines the speedup.
+
+    The returned caches are valid only for rows still short of ``steps``
+    at return (i.e. none): rows that completed keep riding the fixed-shape
+    rounds with a clamped parked pointer, so their cache tails hold dead
+    chunk writes. Callers continuing generation must re-prefill.
     """
     b, s_prompt = prompt.shape
     # Fixed-shape rounds need headroom for a full k_spec chunk even on
@@ -117,7 +122,18 @@ def speculative_generate(
     proposed_total = accepted_total = 0
 
     while any(len(o) < steps for o in out):
-        positions = jnp.asarray(pos, jnp.int32)
+        # Frozen rows (output complete) still ride the fixed-shape device
+        # step with parked pointers; surplus acceptances can park one at
+        # cache_len - 1, where the round's k_spec+1 chunk write would run
+        # past the cache and dynamic_update_slice would silently CLAMP the
+        # start — shifting the write onto the row's valid tail. Clamp the
+        # pointer explicitly instead so the dead write stays in-bounds at
+        # the cache's end. Consequence (documented contract): the returned
+        # caches are NOT valid for rows that reached ``steps`` — their
+        # tail slots hold dead chunk writes.
+        positions = jnp.asarray(
+            np.minimum(pos, cache_len - (k_spec + 1)), jnp.int32
+        )
         last = jnp.asarray(last_np, jnp.int32)[:, None]
         proposals, d_cache = _draft_propose(
             draft_params, draft_cfg, last, d_cache, positions, k_spec
@@ -314,14 +330,24 @@ class SpeculativeContinuousBatcher:
             emitted = list(props_np[slot, :n_accept]) + [
                 int(preds_np[slot, n_accept])
             ]
+            consumed = 0
             for tok in emitted:
                 if cb._by_slot[slot] is None:
                     break  # retired mid-round (EOS/budget): drop the rest
                 cb._note_token(slot, int(tok))
+                consumed += 1
             # Rewind the shared pointer past any rejected slots; both
             # caches' stale entries beyond it are causally invisible and
             # overwritten next round. A retired slot's position resets at
             # its next admit.
             cb.positions[slot] += n_accept + 1
-            self.proposed += self.k_spec
-            self.accepted += n_accept
+            # Stats count only what the request actually consumed: a slot
+            # that retired mid-round discards its tail proposals, and
+            # counting them would skew acceptance_rate low near
+            # retirements (it is a REPORTED serving metric).
+            if consumed == len(emitted):
+                self.proposed += self.k_spec
+                self.accepted += n_accept
+            else:
+                self.proposed += consumed
+                self.accepted += min(consumed, n_accept)

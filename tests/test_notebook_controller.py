@@ -431,6 +431,68 @@ class TestEventReemission:
         }
         assert {"First", "Second"} <= reasons
 
+    def test_integer_parsing_opaque_rv_does_not_poison_ts_cursor(self):
+        """The symmetric poisoning direction: on an opaque-rv cluster one
+        rv that HAPPENS to parse as an integer must not promote the cursor
+        into the int regime (ints sort above every ts token) and suppress
+        all later timestamp-token events — the regime is sticky per
+        cursor, cross-regime events are skipped."""
+        env = make_env()
+
+        class OpaqueRVClient:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def list(self, kind, namespace, *a, **kw):
+                out = self._inner.list(kind, namespace, *a, **kw)
+                if kind == "Event":
+                    for e in out:
+                        rv = e["metadata"].get("resourceVersion")
+                        if rv is not None:
+                            e["metadata"]["resourceVersion"] = f"op-{rv}"
+                return out
+
+        env.reconciler.client = OpaqueRVClient(env.cluster)
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()  # primes the cursor in the ts regime
+
+        def warn(name, reason, ts="2026-07-30T12:00:00Z"):
+            env.cluster.create({
+                "apiVersion": "v1", "kind": "Event",
+                "metadata": {"name": name, "namespace": "ns"},
+                "involvedObject": {"kind": "Pod", "name": "nb-0",
+                                   "namespace": "ns"},
+                "type": "Warning", "reason": reason, "message": "m",
+                "lastTimestamp": ts,
+            })
+
+        warn("nb-0.warn1", "Before")
+        env.manager.run_until_idle()
+        # The anomaly: for one reconcile the events surface with BARE
+        # integer rvs (as if one opaque rv happened to parse as an int) —
+        # drop the wrapper so the reconciler sees the raw assigned ints.
+        env.reconciler.client = env.cluster
+        warn("nb-0.warn2", "Anomaly")
+        env.manager.run_until_idle()
+        env.reconciler.client = OpaqueRVClient(env.cluster)
+        warn("nb-0.warn3", "After", ts="2026-07-30T12:00:05Z")
+        env.manager.run_until_idle()
+        reasons = {
+            e["reason"] for e in events_for(env.cluster, "Notebook", "nb", "ns")
+        }
+        assert "Before" in reasons
+        assert "After" in reasons, (
+            "ts-regime event suppressed after an int-parsing anomaly"
+        )
+        # Cursor is still a ts-regime token.
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["metadata"]["annotations"][ann.LAST_SEEN_EVENT_RV].startswith(
+            "."
+        )
+
 
 class TestMetrics:
     def test_create_and_spawn_latency_observed(self):

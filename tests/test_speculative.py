@@ -280,3 +280,29 @@ class TestShardedSpeculativeServing:
         rids = [sb.submit(p) for p in prompts]
         out = sb.run()
         assert all(len(out[r]) == 6 for r in rids)
+
+
+class TestFrozenRowClamp:
+    def test_minimum_cache_len_with_staggered_rows_stays_exact(self):
+        """Rows that finish early keep riding rounds with a parked
+        pointer; at the MINIMUM legal cache_len surplus acceptances can
+        park a pointer at the clamp boundary. Output for every row must
+        still equal target-alone greedy (the clamp keeps dead writes
+        in-bounds without touching live rows)."""
+        tcfg = L.LLAMA_CONFIGS["tiny"]
+        tparams = L.init_params(tcfg, jax.random.PRNGKey(0))
+        dcfg = L.LlamaConfig(vocab_size=256, dim=64, n_layers=1, n_heads=2,
+                             n_kv_heads=2, ffn_hidden=128, max_seq_len=256)
+        dparams = L.init_params(dcfg, jax.random.PRNGKey(7))
+        s_prompt, steps, k_spec = 8, 12, 4
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (3, s_prompt),
+                                    0, tcfg.vocab_size)
+        cache_len = s_prompt + steps + k_spec  # the exact minimum
+        out, stats = speculative_generate(
+            tparams, tcfg, dparams, dcfg, prompt, steps=steps,
+            cache_len=cache_len, k_spec=k_spec,
+        )
+        ref = L.generate(tparams, tcfg, prompt, steps=steps,
+                         cache_len=cache_len)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        assert 0.0 <= stats["acceptance_rate"] <= 1.0

@@ -498,3 +498,24 @@ class TestReviewRegressions:
         assert all(c["name"] != "kube-rbac-proxy" for c in fresh.containers)
         assert ann.UPDATE_PENDING not in fresh.annotations
         assert "serviceAccountName" not in fresh.pod_spec
+
+
+class TestProfilingPortLayering:
+    def test_parser_is_range_only_admission_rejects_reserved(self):
+        """parse_profiling_port honors annotations admitted under OLDER
+        rules (range-only), while profiling_port_error — the admission
+        gate — additionally rejects reserved in-pod ports. A pre-existing
+        notebook with port 8888 must keep its NetworkPolicy/status/
+        bootstrap behavior; only NEW admissions are denied."""
+        from kubeflow_tpu.api import annotations as ann
+        from kubeflow_tpu.api import names
+
+        reserved = names.NOTEBOOK_PORT
+        assert ann.parse_profiling_port(str(reserved)) == reserved
+        assert ann.profiling_port_error(str(reserved)) is not None
+        # Range rules stay shared by both.
+        for bad in ("80", "0", "70000", "nope", "²"):
+            assert ann.parse_profiling_port(bad) is None
+            assert ann.profiling_port_error(bad) is not None
+        assert ann.parse_profiling_port("9999") == 9999
+        assert ann.profiling_port_error("9999") is None
