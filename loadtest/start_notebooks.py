@@ -29,6 +29,12 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
+def _p95_ms(values) -> float:
+    """Nearest-rank p95 in milliseconds — ONE formula for every artifact
+    field, so the in-process, wire, and per-phase numbers can never drift."""
+    return round(sorted(values)[max(0, int(0.95 * len(values)) - 1)] * 1e3, 2)
+
+
 def run_inprocess(n: int, tpu: bool) -> dict:
     from tests.harness import cpu_notebook, make_env, tpu_notebook
     from kubeflow_tpu.k8s import add_tpu_node_pool
@@ -65,15 +71,13 @@ def run_inprocess(n: int, tpu: bool) -> dict:
         "mode": "tpu-4x4" if tpu else "cpu",
         "total_wall_s": round(total, 3),
         "p50_spawn_wall_ms": round(statistics.median(spawn_wall) * 1e3, 2),
-        "p95_spawn_wall_ms": round(
-            sorted(spawn_wall)[max(0, int(0.95 * n) - 1)] * 1e3, 2
-        ),
+        "p95_spawn_wall_ms": _p95_ms(spawn_wall),
         "p50_reconcile_calls": statistics.median(spawn_calls),
         "notebooks_per_sec": round(n / total, 1),
     }
 
 
-def run_wire(n: int, tpu: bool = True) -> dict:
+def run_wire(n: int, tpu: bool = True, profile: bool = False) -> dict:
     """Spawn latency through the PRODUCTION wiring: apiserver over HTTP,
     both managers via their main() build paths on serve loops, admission
     over HTTPS with self-signed serving certs, kubelet on the far side of
@@ -163,7 +167,19 @@ def run_wire(n: int, tpu: bool = True) -> dict:
         t.start()
     user = new_client()
 
+    from kubeflow_tpu.k8s.errors import NotFoundError
+
     spawn_wall = []
+    # Per-phase medians (profile mode): where inside create→ready the
+    # wall time goes. Phases are cumulative offsets from create:
+    #   create_rt  — user.create() returning (admission webhooks inline),
+    #   sts        — StatefulSet visible (core manager reconcile #1),
+    #   pods       — all host pods exist (kubelet pod fan-out),
+    #   pods_ready — every pod reports Ready (kubelet status walk),
+    #   ready      — notebook.status.readyReplicas == hosts (kubelet STS
+    #                status + core manager status mirror).
+    phases: dict = {k: [] for k in
+                    ("create_rt", "sts", "pods", "pods_ready", "ready")}
     try:
         t_total = time.perf_counter()
         for i in range(n):
@@ -171,8 +187,42 @@ def run_wire(n: int, tpu: bool = True) -> dict:
             nb = tpu_notebook(name=name) if tpu else cpu_notebook(name=name)
             t0 = time.perf_counter()
             user.create(nb)
+            if profile:
+                phases["create_rt"].append(time.perf_counter() - t0)
+            t_sts = t_pods = t_pods_ready = None
             deadline = t0 + 120
             while time.perf_counter() < deadline:
+                if profile and t_sts is None:
+                    try:
+                        user.get("StatefulSet", name, "ns")
+                        t_sts = time.perf_counter() - t0
+                    except NotFoundError:
+                        time.sleep(0.002)
+                        continue
+                if profile and t_pods is None:
+                    have = 0
+                    for j in range(hosts):
+                        try:
+                            user.get("Pod", f"{name}-{j}", "ns")
+                            have += 1
+                        except NotFoundError:
+                            break
+                    if have < hosts:
+                        time.sleep(0.002)
+                        continue
+                    t_pods = time.perf_counter() - t0
+                if profile and t_pods_ready is None:
+                    ok = 0
+                    for j in range(hosts):
+                        pod = user.get("Pod", f"{name}-{j}", "ns")
+                        conds = pod.get("status", {}).get("conditions", [])
+                        if any(c.get("type") == "Ready"
+                               and c.get("status") == "True" for c in conds):
+                            ok += 1
+                    if ok < hosts:
+                        time.sleep(0.002)
+                        continue
+                    t_pods_ready = time.perf_counter() - t0
                 obj = user.get("Notebook", name, "ns")
                 if obj.get("status", {}).get("readyReplicas", 0) >= hosts:
                     break
@@ -180,6 +230,11 @@ def run_wire(n: int, tpu: bool = True) -> dict:
             else:
                 raise SystemExit(f"{name} never became ready over the wire")
             spawn_wall.append(time.perf_counter() - t0)
+            if profile:
+                phases["sts"].append(t_sts)
+                phases["pods"].append(t_pods)
+                phases["pods_ready"].append(t_pods_ready)
+                phases["ready"].append(spawn_wall[-1])
         total = time.perf_counter() - t_total
     finally:
         stop.set()
@@ -189,16 +244,23 @@ def run_wire(n: int, tpu: bool = True) -> dict:
         for c in clients:
             c.stop()
         server.stop()
-    return {
+    out = {
         "notebooks": n,
         "mode": ("tpu-4x4" if tpu else "cpu") + "-wire",
         "total_wall_s": round(total, 3),
         "p50_spawn_wall_ms": round(statistics.median(spawn_wall) * 1e3, 2),
-        "p95_spawn_wall_ms": round(
-            sorted(spawn_wall)[max(0, int(0.95 * n) - 1)] * 1e3, 2
-        ),
+        "p95_spawn_wall_ms": _p95_ms(spawn_wall),
         "notebooks_per_sec": round(n / total, 1),
     }
+    if profile:
+        out["phase_p50_ms"] = {
+            k: round(statistics.median(v) * 1e3, 2)
+            for k, v in phases.items() if v
+        }
+        out["phase_p95_ms"] = {
+            k: _p95_ms(v) for k, v in phases.items() if v
+        }
+    return out
 
 
 def emit_yaml(n: int, tpu: bool, out_dir: Path) -> None:
@@ -233,12 +295,21 @@ def main() -> int:
         help="also write the JSON result to this path (round-over-round "
              "spawn-latency tracking, e.g. SPAWN_r03.json)",
     )
+    parser.add_argument(
+        "--profile", action="store_true",
+        help="(wire mode) record per-phase p50/p95: create round-trip, "
+             "STS visible, pods created, pods Ready, status ready — "
+             "attributes regressions to the reconcile leg that moved",
+    )
     args = parser.parse_args()
     tpu = not args.cpu
     if args.emit_yaml:
         emit_yaml(args.n, tpu, args.emit_yaml)
         return 0
-    result = run_wire(args.n, tpu) if args.wire else run_inprocess(args.n, tpu)
+    result = (
+        run_wire(args.n, tpu, profile=args.profile)
+        if args.wire else run_inprocess(args.n, tpu)
+    )
     line = json.dumps(result)
     print(line)
     if args.artifact:
