@@ -22,6 +22,10 @@ def main() -> None:
     ap.add_argument("--int8", action="store_true")
     ap.add_argument("--int4", action="store_true",
                     help="group-wise int4 weights (~4x fewer HBM bytes)")
+    ap.add_argument("--kv8", action="store_true",
+                    help="int8 KV cache (halves per-token cache reads and "
+                         "cache HBM; composes with --int8/--int4 weights "
+                         "and with --paged/--tp/--sp)")
     ap.add_argument("--paged", action="store_true",
                     help="serve through the paged block-pool KV cache")
     ap.add_argument("--num-blocks", type=int, default=64,
@@ -77,6 +81,7 @@ def main() -> None:
     if bits:
         params = quantize_params(params, free_source=True, bits=bits)
         print(f"int{bits} weight-only quantization applied")
+    kv_bits = 8 if args.kv8 else 0
 
     if tokenizer is not None and args.prompt:
         prompts = [tokenizer(p)["input_ids"] for p in args.prompt]
@@ -114,6 +119,7 @@ def main() -> None:
             params, cfg, gen=gen, slots=min(4, len(prompts)),
             num_blocks=args.num_blocks, block_size=16, prompt_bucket=bucket,
             key=jax.random.PRNGKey(0), plan=plan,
+            kv_bits=kv_bits,
         )
         rids = [pb.submit(p) for p in prompts]
         results = pb.run()
@@ -131,6 +137,7 @@ def main() -> None:
             params, cfg, gen=gen, slots=min(4, len(prompts)),
             cache_len=cache_len, prompt_bucket=bucket,
             key=jax.random.PRNGKey(0), plan=plan,
+            kv_bits=kv_bits,
         )
         rids = [cb.submit(p) for p in prompts]
         results = cb.run()
@@ -138,7 +145,9 @@ def main() -> None:
         print(f"sharded serving: tp={args.tp} sp={args.sp} over "
               f"{args.tp * args.sp} devices")
     else:
-        outs = batch_generate(params, cfg, prompts, gen, key=jax.random.PRNGKey(0))
+        outs = batch_generate(params, cfg, prompts, gen,
+                              key=jax.random.PRNGKey(0),
+                              kv_bits=kv_bits)
     for i, out in enumerate(outs):
         if tokenizer is not None and args.prompt:
             print(f"[{i}] {tokenizer.decode(out)}")
