@@ -85,17 +85,34 @@ class FakeKubelet(Reconciler):
         self.auto_ready = auto_ready
 
     def register(self, manager: Manager) -> None:
-        def node_event_to_all_sts(ev):
+        def all_sts(ev):
             return [
                 Request(obj_util.name_of(s), obj_util.namespace_of(s))
                 for s in self.cluster.list("StatefulSet")
             ]
 
+        def pod_capacity_freed_to_all_sts(ev):
+            # Capacity-freed signal: a deleted pod — or one that turned
+            # Succeeded (terminal pods release their node's TPU
+            # allocatable, see _schedule) — lets OTHER StatefulSets'
+            # Unschedulable-Pending pods bind (the real scheduler's
+            # retry-on-capacity). Failed pods converge via the owner's
+            # own reconcile (it deletes them → a DELETED event lands
+            # here). Scoped to these rare transitions so the per-pod
+            # create/status chatter of a spawning slice cannot amplify
+            # into O(n²) reconciles.
+            freed = ev.type == "DELETED" or (
+                ev.type == "MODIFIED"
+                and ev.object.get("status", {}).get("phase") == "Succeeded"
+            )
+            return all_sts(ev) if freed else []
+
         manager.register(
             self,
             for_kind="StatefulSet",
             owns=("Pod",),
-            watches=[("Node", node_event_to_all_sts)],
+            watches=[("Node", all_sts),
+                     ("Pod", pod_capacity_freed_to_all_sts)],
             name="FakeKubelet",
         )
 

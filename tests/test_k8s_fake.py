@@ -335,6 +335,74 @@ class TestFakeKubelet:
         nodes_used = {p["spec"]["nodeName"] for p in pods}
         assert len(nodes_used) == 4  # one host-pod per TPU node
 
+    def test_scheduling_respects_other_namespace_usage(self):
+        """Node TPU capacity is CLUSTER-scoped: pods bound in one
+        namespace must count against the allocatable another namespace's
+        scheduling sees (guards the per-reconcile scheduling snapshot,
+        which lists pods cluster-wide while the hot path lists only the
+        reconcile's namespace)."""
+        c = k8s.FakeCluster()
+        m = Manager(c)
+        k8s.FakeKubelet(c).register(m)
+        k8s.add_tpu_node_pool(c, "tpu-v5-lite-podslice", "4x4",
+                              hosts=4, chips_per_host=4)
+        sel = {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "4x4",
+        }
+        first = self._mini_sts(replicas=4, tpu="4", selector=sel)
+        c.create(first)
+        m.run_until_idle()
+        ns_pods = c.list("Pod", "ns")
+        assert len(ns_pods) == 4
+        assert all(p["status"]["phase"] == "Running" for p in ns_pods)
+        # Same shape in ANOTHER namespace: the pool is fully claimed by
+        # ns, so ns2's pods must stay Pending, not double-bind.
+        second = self._mini_sts(replicas=4, tpu="4", selector=sel)
+        second["metadata"]["namespace"] = "ns2"
+        c.create(second)
+        m.run_until_idle()
+        ns2_pods = c.list("Pod", "ns2")
+        assert len(ns2_pods) == 4
+        assert all(p["status"]["phase"] == "Pending" for p in ns2_pods)
+        # Capacity freed in ns → ns2 schedules.
+        for p in list(c.list("Pod", "ns")):
+            c.delete("Pod", obj_util.name_of(p), "ns")
+        c.delete("StatefulSet", "nb", "ns")
+        m.run_until_idle()
+        ns2_pods = c.list("Pod", "ns2")
+        assert len(ns2_pods) == 4
+        assert all(p["status"]["phase"] == "Running" for p in ns2_pods)
+
+    def test_succeeded_pod_releases_capacity_for_other_namespace(self):
+        """A pod that turns Succeeded (terminal) releases its node's TPU
+        allocatable without being deleted; another StatefulSet's
+        Unschedulable pods must wake and bind."""
+        c = k8s.FakeCluster()
+        m = Manager(c)
+        k8s.FakeKubelet(c).register(m)
+        k8s.add_tpu_node_pool(c, "tpu-v5-lite-podslice", "4x4",
+                              hosts=1, chips_per_host=4)
+        sel = {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "4x4",
+        }
+        first = self._mini_sts(replicas=1, tpu="4", selector=sel)
+        c.create(first)
+        m.run_until_idle()
+        second = self._mini_sts(replicas=1, tpu="4", selector=sel)
+        second["metadata"]["namespace"] = "ns2"
+        c.create(second)
+        m.run_until_idle()
+        (pending,) = c.list("Pod", "ns2")
+        assert pending["status"]["phase"] == "Pending"
+        done = c.get("Pod", "nb-0", "ns")
+        done["status"]["phase"] = "Succeeded"
+        c.update_status(done)
+        m.run_until_idle()
+        (woken,) = c.list("Pod", "ns2")
+        assert woken["status"]["phase"] == "Running"
+
     def test_scale_to_zero_deletes_all_pods(self):
         c = k8s.FakeCluster()
         m = Manager(c)
