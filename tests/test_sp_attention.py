@@ -229,3 +229,50 @@ class TestWindowedSPTraining:
             _, loss = step(state, tokens)
             losses[impl] = float(loss)
         assert abs(losses["ring"] - losses["ulysses"]) < 1e-3
+
+
+class TestSPDecodeInt8Scales:
+    """int8-cache decode through the sp split-KV merge: the scale shards
+    ride with their values, folded into the f32 score/probability
+    epilogues exactly as the dense _gqa_decode_attention does."""
+
+    def _quantized(self, heads=4, sk=128, d=32, batch=2, seed=3):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(ks[0], (batch, heads, 1, d), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (batch, heads, sk, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (batch, heads, sk, d), jnp.bfloat16)
+        kq, kscale = L._kv_quantize(k)
+        vq, vscale = L._kv_quantize(v)
+        return q, kq, vq, kscale, vscale
+
+    def test_matches_dense_int8_decode(self):
+        mesh = make_mesh(dp=2, sp=4)
+        q, kq, vq, ks, vs = self._quantized()
+        pos = 77
+        ref = L._gqa_decode_attention(q, kq, vq, jnp.asarray(pos),
+                                      k_scale=ks, v_scale=vs)
+        out = make_sharded_sp_decode(mesh)(q, kq, vq, pos,
+                                           k_scale=ks, v_scale=vs)
+        _close(out.astype(jnp.float32), ref.astype(jnp.float32), tol=2e-2)
+
+    def test_scales_compose_with_kv_mask_and_window(self):
+        mesh = make_mesh(sp=4, devices=jax.devices()[:4])
+        q, kq, vq, ks, vs = self._quantized(heads=4, batch=2)
+        # Masked keys INSIDE the attention window (pos=90, window=40 →
+        # visible range 51..90), so the kv_mask measurably changes the
+        # output and a path that dropped it under int8 scales would fail.
+        kv_mask = jnp.ones((2, 128), bool).at[0, 60:70].set(False)
+        pos = 90
+        ref = L._gqa_decode_attention(q, kq, vq, jnp.asarray(pos),
+                                      window=40, kv_mask=kv_mask,
+                                      k_scale=ks, v_scale=vs)
+        out = make_sharded_sp_decode(mesh)(q, kq, vq, pos, window=40,
+                                           kv_mask=kv_mask,
+                                           k_scale=ks, v_scale=vs)
+        _close(out.astype(jnp.float32), ref.astype(jnp.float32), tol=2e-2)
+
+    def test_scale_pair_required_together(self):
+        mesh = make_mesh(sp=2, devices=jax.devices()[:2])
+        q, kq, vq, ks, _ = self._quantized()
+        with pytest.raises(ValueError, match="together"):
+            make_sharded_sp_decode(mesh)(q, kq, vq, 10, k_scale=ks)
