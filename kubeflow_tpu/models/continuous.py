@@ -232,6 +232,10 @@ class _BatcherBase:
             return
         self.tokens[slot, 0] = token
 
+    def _post_admit(self, slot: int, padded, prompt_mask) -> None:
+        """Hook for subclasses that keep a SECOND cache in lockstep (the
+        speculative batchers prefill their draft cache here)."""
+
     def _retire(self, slot: int) -> None:
         self._results[self._by_slot[slot].rid] = self._by_slot[slot].tokens
         self._release_slot(slot)
@@ -332,10 +336,6 @@ class ContinuousBatcher(_BatcherBase):
             self._by_slot[slot] = req
             req.budget = self.gen.max_new_tokens
             self._note_token(slot, first)
-
-    def _post_admit(self, slot: int, padded, prompt_mask) -> None:
-        """Hook for subclasses that keep a SECOND cache in lockstep (the
-        speculative batcher prefills its draft cache here)."""
 
     def _release_slot(self, slot: int) -> None:
         self._by_slot[slot] = None

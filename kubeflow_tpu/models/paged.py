@@ -131,72 +131,135 @@ def _paged_step(
     top_p: float,
 ) -> tuple[jax.Array, dict]:
     """One decode step across every slot, reading/writing through tables."""
-    b, maxb = tables.shape
-    x = _embed(params, cfg, tokens)
     cos, sin = rope_frequencies(cfg, positions)
-    blk = jnp.take_along_axis(
+    blks = jnp.take_along_axis(
         tables, (positions // block_size)[:, None], axis=1
-    )[:, 0]  # (B,) physical block for this step's token
-    off = positions % block_size
+    )  # (B, 1) physical block for this step's token
+    offs = (positions % block_size)[:, None]
+    x, new_pool = _paged_chunk_scan(
+        params, cfg, tokens, pool, tables, kv_mask, cos, sin, blks, offs,
+        positions, block_size,
+    )
+    logits = _lm_head_logits(_norm(x[:, 0], params["final_norm"], cfg), params)
+    nxt = sample_logits(logits, key, temperature, top_k, top_p)
+    return nxt, new_pool
+
+
+def _scatter_chunk(pool_l, k, v, blks, offs):
+    """Scatter a (B, Hkv, K, D) chunk into (block, offset) per token —
+    requests own disjoint blocks, so batch rows never collide; the small
+    static K unrolls. The pool pytree's structure decides the storage
+    format: scale leaves present → quantize on write (int8 KV,
+    models.llama kv_bits=8)."""
+    pool_l = dict(pool_l)
+    if "k_scale" in pool_l:
+        kq, ks = _kv_quantize(k)
+        vq, vs = _kv_quantize(v)
+        for j in range(blks.shape[1]):
+            bj, oj = blks[:, j], offs[:, j]
+            pool_l["k"] = pool_l["k"].at[bj, :, oj].set(kq[:, :, j])
+            pool_l["v"] = pool_l["v"].at[bj, :, oj].set(vq[:, :, j])
+            pool_l["k_scale"] = (
+                pool_l["k_scale"].at[bj, :, oj].set(ks[:, :, j])
+            )
+            pool_l["v_scale"] = (
+                pool_l["v_scale"].at[bj, :, oj].set(vs[:, :, j])
+            )
+    else:
+        for j in range(blks.shape[1]):
+            bj, oj = blks[:, j], offs[:, j]
+            pool_l["k"] = pool_l["k"].at[bj, :, oj].set(k[:, :, j])
+            pool_l["v"] = pool_l["v"].at[bj, :, oj].set(v[:, :, j])
+    return pool_l
+
+
+def _paged_chunk_scan(params, cfg, tokens, pool, tables, kv_mask, cos, sin,
+                      blks, offs, attn_positions, block_size):
+    """The ONE paged decode body (scan over layers), shared by the
+    ordinary decode step (K=1) and the speculative verify chunk (K>1) —
+    same discipline as llama._chunk_decode_scan: a single body means a
+    future change (norm placement, window semantics, int8
+    quantize-on-write) cannot diverge plain paged decode from
+    speculative verification."""
+    x = _embed(params, cfg, tokens)
 
     def gathered(pool_l):
-        # (NB, Hkv, BS[, D])[tables] → (B, MAXB, Hkv, BS[, D]) → logical
-        # per-slot view: (B, Hkv, MAXB·BS[, D]). Works for value leaves
-        # and (one rank lower) int8 scale leaves alike.
-        g = pool_l[tables]
-        perm = (0, 2, 1, 3) + ((4,) if g.ndim == 5 else ())
-        shape = (b, cfg.n_kv_heads, maxb * block_size)
-        if g.ndim == 5:
-            shape += (cfg.head_dim,)
-        return g.transpose(perm).reshape(shape)
+        return _gathered_view(
+            pool_l, tables, cfg.n_kv_heads, block_size, cfg.head_dim
+        )
 
     def body(x, scanned):
         layer, pool_l = scanned  # per-layer pool dict, leaves (NB, Hkv, …)
         h = _norm(x, layer["attn_norm"], cfg)
         hq, hk, hv = _qkv(h, layer)
-        q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin, per_batch=True)
+        q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin,
+                       per_batch=True)
         k = apply_rope(_split_heads(hk, cfg.n_kv_heads), cos, sin,
                        per_batch=True)
         v = _split_heads(hv, cfg.n_kv_heads)
-        # Scatter this token's K/V row into (block, offset) — requests own
-        # disjoint blocks, so batch rows never collide. The pool pytree's
-        # structure decides the storage format: scale leaves present →
-        # quantize on write (int8 KV, models.llama kv_bits=8).
-        pool_l = dict(pool_l)
-        if "k_scale" in pool_l:
-            kq, ks = _kv_quantize(k)
-            vq, vs = _kv_quantize(v)
-            pool_l["k"] = pool_l["k"].at[blk, :, off].set(kq[:, :, 0])
-            pool_l["v"] = pool_l["v"].at[blk, :, off].set(vq[:, :, 0])
-            pool_l["k_scale"] = (
-                pool_l["k_scale"].at[blk, :, off].set(ks[:, :, 0])
-            )
-            pool_l["v_scale"] = (
-                pool_l["v_scale"].at[blk, :, off].set(vs[:, :, 0])
-            )
-        else:
-            pool_l["k"] = pool_l["k"].at[blk, :, off].set(k[:, :, 0])
-            pool_l["v"] = pool_l["v"].at[blk, :, off].set(v[:, :, 0])
-        ks_g = (
-            gathered(pool_l["k_scale"]) if "k_scale" in pool_l else None
-        )
-        vs_g = (
-            gathered(pool_l["v_scale"]) if "v_scale" in pool_l else None
-        )
+        pool_l = _scatter_chunk(pool_l, k, v, blks, offs)
         attn = _gqa_decode_attention(
-            q, gathered(pool_l["k"]), gathered(pool_l["v"]), positions,
+            q, gathered(pool_l["k"]), gathered(pool_l["v"]), attn_positions,
             window=cfg.sliding_window, kv_mask=kv_mask, per_batch=True,
-            k_scale=ks_g, v_scale=vs_g,
+            k_scale=(gathered(pool_l["k_scale"])
+                     if "k_scale" in pool_l else None),
+            v_scale=(gathered(pool_l["v_scale"])
+                     if "v_scale" in pool_l else None),
         )
         x = x + _mm(_merge_heads(attn), layer["wo"])
         h = _norm(x, layer["mlp_norm"], cfg)
         x = x + _mlp(layer, h, cfg)
         return x, pool_l
 
-    x, new_pool = jax.lax.scan(body, x, (params["layers"], pool))
-    logits = _lm_head_logits(_norm(x[:, 0], params["final_norm"], cfg), params)
-    nxt = sample_logits(logits, key, temperature, top_k, top_p)
-    return nxt, new_pool
+    return jax.lax.scan(body, x, (params["layers"], pool))
+
+
+def _gathered_view(pool_l, tables, n_kv_heads, block_size, head_dim):
+    """(NB, Hkv, BS[, D])[tables] → logical per-slot view
+    (B, Hkv, MAXB·BS[, D]). Shared by the decode step and the speculative
+    verify chunk; handles value leaves and (one rank lower) int8 scale
+    leaves alike."""
+    b, maxb = tables.shape
+    g = pool_l[tables]
+    perm = (0, 2, 1, 3) + ((4,) if g.ndim == 5 else ())
+    shape = (b, n_kv_heads, maxb * block_size)
+    if g.ndim == 5:
+        shape += (head_dim,)
+    return g.transpose(perm).reshape(shape)
+
+
+@partial(
+    jax.jit, static_argnames=("cfg", "block_size"), donate_argnums=(3,)
+)
+def _paged_verify(
+    params: dict,
+    cfg: LlamaConfig,
+    chunk: jax.Array,  # (B, K) — [last, d_1..d_{K-1}] per row
+    pool: dict,
+    tables: jax.Array,  # (B, MAXB)
+    positions: jax.Array,  # (B,) per-row write offsets
+    kv_mask: jax.Array,  # (B, MAXB * BS)
+    block_size: int,
+) -> tuple[jax.Array, dict]:
+    """Target verification through the BLOCK POOL: decode a (B, K) chunk
+    at per-row offsets — row b's token j writes block
+    tables[b, (positions[b]+j) // BS] offset (positions[b]+j) % BS, and
+    query j attends logical slots <= positions[b]+j (chunk causality).
+    The paged analog of llama._decode_chunk_batch_impl; returns the
+    target's argmax predictions (B, K) + updated pool."""
+    b, k_len = chunk.shape
+    posmat = positions[:, None] + jnp.arange(k_len)[None, :]  # (B, K)
+    cos, sin = rope_frequencies(cfg, posmat.reshape(-1))
+    cos = cos.reshape(b, k_len, -1)
+    sin = sin.reshape(b, k_len, -1)
+    blks = jnp.take_along_axis(tables, posmat // block_size, axis=1)  # (B, K)
+    offs = posmat % block_size
+    x, new_pool = _paged_chunk_scan(
+        params, cfg, chunk, pool, tables, kv_mask, cos, sin, blks, offs,
+        posmat, block_size,
+    )
+    logits = _lm_head_logits(_norm(x, params["final_norm"], cfg), params)
+    return jnp.argmax(logits, axis=-1), new_pool  # (B, K)
 
 
 class PagedBatcher(_BatcherBase):
@@ -223,6 +286,7 @@ class PagedBatcher(_BatcherBase):
         key: Optional[jax.Array] = None,
         plan=None,  # parallel.mesh.MeshPlan → tp-sharded serving
         kv_bits: int = 0,  # 8 → int8 block pool (halved KV HBM)
+        headroom_tokens: int = 0,  # extra per-slot span (speculative rounds)
     ):
         self.gen = gen or GenerationConfig()
         if prompt_bucket % block_size:
@@ -240,8 +304,12 @@ class PagedBatcher(_BatcherBase):
         # step compiles once.
         # +1: a preempted continuation re-admits at a block-aligned padded
         # length, which can overhang the nominal span by up to one block.
+        # ``headroom_tokens``: a speculative round writes up to k_spec+1
+        # slots past the pointer before rewinding — the tables must be
+        # wide enough for those dead-by-rewind writes too.
         self.max_blocks = (
-            prompt_bucket + self.gen.max_new_tokens + block_size - 1
+            prompt_bucket + self.gen.max_new_tokens + headroom_tokens
+            + block_size - 1
         ) // block_size + 1
         self.key = jax.random.PRNGKey(0) if key is None else key
         self.pool = init_block_pool(cfg, num_blocks, block_size,
@@ -404,19 +472,23 @@ class PagedBatcher(_BatcherBase):
             req = _Request(req.rid, req.prompt, generated, blocks=blocks)
             req.budget = self.gen.max_new_tokens - len(generated)
             self._by_slot[slot] = req
+            self._post_admit(slot, jnp.asarray(padded), prompt_mask)
             self._note_token(slot, first)
 
-    def _ensure_step_blocks(self) -> list[int]:
-        """Every active slot whose NEXT write lands in an unallocated block
-        gets one before the step dispatches. A slot's request holds its
-        blocks in position order, so position p needs a block exactly when
-        p // block_size == len(req.blocks). Preemption inside _take_blocks
-        may evict slots (including a needing one); loop until stable."""
+    def _ensure_step_blocks(self, span: int = 1) -> list[int]:
+        """Every active slot whose next ``span`` writes reach an
+        unallocated block gets one before the step dispatches (span=1:
+        ordinary decode; span=k_spec+1: a speculative verify chunk). A
+        slot's request holds its blocks in position order, so positions
+        p..p+span-1 need coverage through (p+span-1) // block_size.
+        Preemption inside _take_blocks may evict slots (including a
+        needing one); loop until stable — multi-block deficits resolve
+        one block per pass."""
         while True:
             active = [i for i, r in enumerate(self._by_slot) if r is not None]
             needing = [
                 s for s in active
-                if self.positions[s] // self.block_size
+                if (int(self.positions[s]) + span - 1) // self.block_size
                 >= len(self._by_slot[s].blocks)
             ]
             if not needing:

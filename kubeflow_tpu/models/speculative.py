@@ -179,6 +179,47 @@ def speculative_generate(
     return jnp.asarray([o[:steps] for o in out], jnp.int32), stats
 
 
+def _apply_spec_round(outer, engine, active, preds_np, props_np) -> None:
+    """Accept/emit/rewind/stats for one SERVING speculative round — the
+    ONE home for the per-slot acceptance walk, the retired-mid-round
+    guard, and the consumed-proposals stat discipline, shared by the
+    continuous and paged spec engines so their emission semantics and
+    reported acceptance_rate cannot drift.
+
+    ``outer`` carries k_spec/proposed/accepted; ``engine`` is the inner
+    batcher (slots/positions/_note_token)."""
+    for slot in active:
+        n_accept = 0
+        while (
+            n_accept < outer.k_spec
+            and preds_np[slot, n_accept] == props_np[slot, n_accept]
+        ):
+            n_accept += 1
+        emitted = list(props_np[slot, :n_accept]) + [
+            int(preds_np[slot, n_accept])
+        ]
+        consumed = 0
+        for tok in emitted:
+            if engine._by_slot[slot] is None:
+                break  # retired mid-round (EOS/budget): drop the rest
+            engine._note_token(slot, int(tok))
+            consumed += 1
+        # Rewind the pointer past any rejected slots; stale cache/pool
+        # entries beyond it are causally invisible and overwritten next
+        # round. A retired slot's position resets at its next admit.
+        engine.positions[slot] += n_accept + 1
+        # Stats count only what the request actually consumed: a slot
+        # that retired mid-round discards its tail proposals, and
+        # counting them would skew acceptance_rate low near retirements
+        # (it is a REPORTED serving metric).
+        if consumed == len(emitted):
+            outer.proposed += outer.k_spec
+            outer.accepted += n_accept
+        else:
+            outer.proposed += consumed
+            outer.accepted += min(consumed, n_accept)
+
+
 class SpeculativeContinuousBatcher:
     """Continuous batching with speculative decoding as the STEP engine:
     every serving round, the draft proposes k tokens per slot and the
@@ -318,36 +359,145 @@ class SpeculativeContinuousBatcher:
             cb.params, cb.cfg, chunk, cb.cache, positions,
             kv_mask=cb.kv_mask,
         )
-        preds_np = np.asarray(preds)
-        props_np = np.asarray(proposals)
-        for slot in active:
-            n_accept = 0
-            while (
-                n_accept < self.k_spec
-                and preds_np[slot, n_accept] == props_np[slot, n_accept]
-            ):
-                n_accept += 1
-            emitted = list(props_np[slot, :n_accept]) + [
-                int(preds_np[slot, n_accept])
-            ]
-            consumed = 0
-            for tok in emitted:
-                if cb._by_slot[slot] is None:
-                    break  # retired mid-round (EOS/budget): drop the rest
-                cb._note_token(slot, int(tok))
-                consumed += 1
-            # Rewind the shared pointer past any rejected slots; both
-            # caches' stale entries beyond it are causally invisible and
-            # overwritten next round. A retired slot's position resets at
-            # its next admit.
-            cb.positions[slot] += n_accept + 1
-            # Stats count only what the request actually consumed: a slot
-            # that retired mid-round discards its tail proposals, and
-            # counting them would skew acceptance_rate low near
-            # retirements (it is a REPORTED serving metric).
-            if consumed == len(emitted):
-                self.proposed += self.k_spec
-                self.accepted += n_accept
-            else:
-                self.proposed += consumed
-                self.accepted += min(consumed, n_accept)
+        _apply_spec_round(self, cb, active, np.asarray(preds),
+                          np.asarray(proposals))
+
+
+class SpeculativePagedBatcher:
+    """Speculative decoding over the PAGED block pool: the draft proposes
+    k tokens per slot from a dense side cache, and the target verifies
+    them in one (B, k+1) forward that reads/writes THROUGH the block
+    tables (models.paged._paged_verify) — vLLM's spec-over-paged
+    composition. Memory stays pool-sized (the paged advantage) while
+    throughput multiplies by the acceptance rate; the greedy invariant is
+    the same as every spec engine here (tie-tolerant across chunk
+    shapes).
+
+    The draft cache is DENSE per slot: the draft is small by design, so
+    paging it would spend table-gather overhead to save little memory;
+    the pool pays for the big target cache, which is the one that
+    matters.
+
+    >>> sb = SpeculativePagedBatcher(params, cfg, dparams, dcfg,
+    ...                              slots=4, num_blocks=64)
+    >>> rids = [sb.submit(p) for p in prompts]
+    >>> results = sb.run()
+    >>> sb.acceptance_rate
+    """
+
+    def __init__(
+        self,
+        params: dict,
+        target_cfg: LlamaConfig,
+        draft_params: dict,
+        draft_cfg: LlamaConfig,
+        gen=None,
+        slots: int = 4,
+        num_blocks: int = 64,
+        block_size: int = 16,
+        prompt_bucket: int = 64,
+        key=None,
+        k_spec: int = 4,
+        plan=None,  # parallel.mesh.MeshPlan → tp-sharded spec serving
+        kv_bits: int = 0,  # 8 → int8 pool AND draft cache
+    ):
+        from kubeflow_tpu.models.paged import PagedBatcher
+        from kubeflow_tpu.models.serving import GenerationConfig
+
+        gen = gen or GenerationConfig()
+        if gen.temperature != 0.0:
+            raise ValueError(
+                "speculative serving is greedy-only (temperature must be 0: "
+                "acceptance compares argmaxes, sampling would break the "
+                "exactness guarantee)"
+            )
+        outer = self
+
+        class _Inner(PagedBatcher):
+            def _post_admit(self, slot, padded, prompt_mask):
+                outer._admit_draft(slot, padded, prompt_mask)
+
+            def _release_slot(self, slot):
+                super()._release_slot(slot)
+                outer.draft_kv_mask = outer.draft_kv_mask.at[slot].set(False)
+
+            def _step(self):
+                outer._spec_step()
+
+        self._pb = _Inner(
+            params, target_cfg, gen=gen, slots=slots, num_blocks=num_blocks,
+            block_size=block_size, prompt_bucket=prompt_bucket, key=key,
+            plan=plan, kv_bits=kv_bits,
+            # A spec round writes up to k_spec+1 slots past the pointer
+            # before rewinding; the block tables must span those too.
+            headroom_tokens=k_spec + 1,
+        )
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.k_spec = k_spec
+        # Dense draft cache spanning the pool's logical window (bucket
+        # overhang on preempted continuations included — max_blocks
+        # already accounts for it).
+        draft_len = self._pb.max_blocks * block_size
+        self.draft_cache = init_kv_cache(draft_cfg, slots, draft_len,
+                                         kv_bits=kv_bits)
+        self.draft_kv_mask = jnp.zeros((slots, draft_len), bool)
+        if plan is not None:
+            # sp is already rejected by PagedBatcher (no contiguous
+            # sequence axis); tp shards the draft like the target.
+            # shard_kv_cache owns the tp-divides-kv-heads validation and
+            # fires before params are placed.
+            self.draft_cache = plan.shard_kv_cache(self.draft_cache)
+            self.draft_params = plan.shard_params(draft_params)
+        self.proposed = 0
+        self.accepted = 0
+
+    # -- public surface (delegated) ----------------------------------------
+
+    def submit(self, prompt) -> int:
+        return self._pb.submit(prompt)
+
+    def run(self) -> dict:
+        return self._pb.run()
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+    @property
+    def free_blocks(self) -> int:
+        return self._pb.free_blocks
+
+    # -- internals ---------------------------------------------------------
+
+    def _admit_draft(self, slot, padded, prompt_mask) -> None:
+        from kubeflow_tpu.models.continuous import _admit_slot
+
+        _, self.draft_cache, self.draft_kv_mask = _admit_slot(
+            self.draft_params, self.draft_cfg, padded, prompt_mask,
+            self.draft_cache, self.draft_kv_mask,
+            jnp.asarray(slot, jnp.int32),
+        )
+
+    def _spec_step(self) -> None:
+        from kubeflow_tpu.models.paged import _paged_verify
+
+        pb = self._pb
+        # Allocate blocks covering the whole verify chunk up front (the
+        # call may preempt; it returns the post-preemption active set).
+        active = pb._ensure_step_blocks(span=self.k_spec + 1)
+        if not active:
+            return
+        positions = jnp.asarray(pb.positions, jnp.int32)
+        last = jnp.asarray(pb.tokens, jnp.int32)  # (B, 1) per-slot input
+        proposals, self.draft_cache = _draft_propose(
+            self.draft_params, self.draft_cfg, last, self.draft_cache,
+            positions, self.k_spec, kv_mask=self.draft_kv_mask,
+        )
+        chunk = jnp.concatenate([last, proposals], axis=1)
+        preds, pb.pool = _paged_verify(
+            pb.params, pb.cfg, chunk, pb.pool, jnp.array(pb.tables),
+            positions, pb.kv_mask, pb.block_size,
+        )
+        _apply_spec_round(self, pb, active, np.asarray(preds),
+                          np.asarray(proposals))

@@ -306,3 +306,112 @@ class TestFrozenRowClamp:
                          cache_len=cache_len)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
         assert 0.0 <= stats["acceptance_rate"] <= 1.0
+
+
+class TestSpeculativePagedServing:
+    """Speculative decoding composed with the paged block pool: the
+    target verifies (B, k+1) chunks THROUGH the block tables, memory
+    stays pool-sized, and every emitted token follows the greedy path of
+    its own prompt."""
+
+    def _make(self, target, draft, num_blocks=40, k_spec=3, slots=2,
+              max_new=8, kv_bits=0, plan=None, key=None):
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.models.speculative import SpeculativePagedBatcher
+
+        tcfg, tparams = target
+        dcfg, dparams = draft
+        gen = GenerationConfig(max_new_tokens=max_new, eos_id=-1)
+        return SpeculativePagedBatcher(
+            tparams, tcfg, dparams, dcfg, gen=gen, slots=slots,
+            num_blocks=num_blocks, block_size=8, prompt_bucket=16,
+            k_spec=k_spec, kv_bits=kv_bits, plan=plan, key=key,
+        )
+
+    def test_serving_stays_on_greedy_path(self, target, draft):
+        from tests.test_continuous import _assert_greedy_consistent
+
+        tcfg, tparams = target
+        ks = jax.random.split(jax.random.PRNGKey(21), 5)
+        prompts = [
+            [int(t) for t in jax.random.randint(k, (4 + i,), 3, 250)]
+            for i, k in enumerate(ks)
+        ]
+        sb = self._make(target, draft)
+        rids = [sb.submit(p) for p in prompts]
+        got = sb.run()
+        for rid, prompt in zip(rids, prompts):
+            assert len(got[rid]) == 8
+            _assert_greedy_consistent(tparams, tcfg, prompt, got[rid])
+        assert 0.0 <= sb.acceptance_rate <= 1.0
+        # Every block returned to the pool after the run.
+        assert sb.free_blocks == 39
+
+    def test_self_draft_accepts_everything(self, target):
+        sb = self._make(target, target)
+        rids = [sb.submit([3 + i, 41, 90]) for i in range(3)]
+        out = sb.run()
+        assert all(len(out[r]) == 8 for r in rids)
+        assert sb.acceptance_rate == 1.0
+
+    def test_int8_pool_runs(self, target, draft):
+        import jax.numpy as jnp
+
+        sb = self._make(target, draft, kv_bits=8)
+        assert sb._pb.pool["k"].dtype == jnp.int8
+        assert sb.draft_cache["k"].dtype == jnp.int8
+        rids = [sb.submit([5, 9, 17]), sb.submit([7, 3, 11, 2])]
+        out = sb.run()
+        assert all(len(out[r]) == 8 for r in rids)
+
+    def test_starved_pool_preempts_and_completes(self, target, draft):
+        """Pool too small for both slots' spans: preemption re-queues the
+        youngest, its continuation re-admits (draft re-prefills via the
+        _post_admit hook), and every request still completes its budget
+        on the greedy path."""
+        from tests.test_continuous import _assert_greedy_consistent
+
+        tcfg, tparams = target
+        prompts = [[5, 9, 17, 33], [7, 3, 11], [8, 44, 91, 7, 2]]
+        sb = self._make(target, draft, num_blocks=12, max_new=10, slots=2)
+        rids = [sb.submit(p) for p in prompts]
+        out = sb.run()
+        for rid, prompt in zip(rids, prompts):
+            assert len(out[rid]) == 10
+            _assert_greedy_consistent(tparams, tcfg, prompt, out[rid])
+
+    def test_eos_retires_early_and_frees_blocks(self, target, draft):
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.models.speculative import SpeculativePagedBatcher
+
+        tcfg, tparams = target
+        dcfg, dparams = draft
+        probe = self._make(target, draft, max_new=6)
+        r = probe.submit([5, 9, 17])
+        eos = probe.run()[r][2]  # third emitted token becomes the EOS
+
+        gen = GenerationConfig(max_new_tokens=6, eos_id=eos)
+        sb = SpeculativePagedBatcher(
+            tparams, tcfg, dparams, dcfg, gen=gen, slots=1,
+            num_blocks=40, block_size=8, prompt_bucket=16, k_spec=3,
+        )
+        r1, r2 = sb.submit([5, 9, 17]), sb.submit([8, 44, 91, 7])
+        out = sb.run()
+        assert eos not in out[r1]
+        assert len(out[r1]) == 2
+        assert len(out[r2]) <= 6
+        assert sb.free_blocks == 39
+
+    def test_tp_sharded_stays_on_greedy_path(self, target, draft):
+        from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+        from tests.test_continuous import _assert_greedy_consistent
+
+        tcfg, tparams = target
+        prompts = [[5, 9, 17], [3, 41, 90, 7]]
+        plan = MeshPlan(make_mesh(tp=2, devices=jax.devices()[:2]))
+        sb = self._make(target, draft, plan=plan)
+        rids = [sb.submit(p) for p in prompts]
+        out = sb.run()
+        for rid, prompt in zip(rids, prompts):
+            assert len(out[rid]) == 8
+            _assert_greedy_consistent(tparams, tcfg, prompt, out[rid])
