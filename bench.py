@@ -435,25 +435,38 @@ def run_full_bench(results: list) -> None:
             ]
             return jax.tree_util.tree_unflatten(treedef, noisy)
 
-        for sigma in (0.005, 0.05):
-            draft = degrade(sigma, jax.random.PRNGKey(int(sigma * 1e4)))
+        from kubeflow_tpu.models.speculative import truncated_draft
+
+        half = max(1, tcfg.n_layers // 2)
+        variants = [
+            (f"noisy sigma={s}",
+             lambda s=s: (degrade(s, jax.random.PRNGKey(int(s * 1e4))),
+                          tcfg))
+            for s in (0.005, 0.05)
+        ] + [
+            # The deployment-shaped draft: the target's own first half of
+            # layers, zero training, zero extra checkpoint.
+            (f"truncated {half}-layer",
+             lambda: truncated_draft(params, tcfg, half)),
+        ]
+        for label, make in variants:
+            draft, dcfg = make()
             # warm/compile, then time.
-            speculative_generate(params, tcfg, draft, tcfg, prompt,
+            speculative_generate(params, tcfg, draft, dcfg, prompt,
                                  steps=steps, cache_len=256, k_spec=4)
             t0 = time.perf_counter()
             _, stats = speculative_generate(
-                params, tcfg, draft, tcfg, prompt,
+                params, tcfg, draft, dcfg, prompt,
                 steps=steps, cache_len=256, k_spec=4,
             )
             dt = time.perf_counter() - t0
             report(
-                f"spec decode tokens/sec (1.1B noisy draft sigma={sigma},"
-                f" bs={bs}, k=4)",
+                f"spec decode tokens/sec (1.1B {label} draft, bs={bs}, k=4)",
                 bs * steps / dt, "tokens/sec",
                 f"(acceptance {stats['acceptance_rate']:.2f})",
             )
             results.append({
-                "metric": f"spec decode acceptance rate (sigma={sigma})",
+                "metric": f"spec decode acceptance rate ({label})",
                 "value": round(stats["acceptance_rate"], 3), "unit": "ratio",
             })
             del draft

@@ -28,6 +28,10 @@ def main() -> None:
                          "and with --paged/--tp/--sp)")
     ap.add_argument("--paged", action="store_true",
                     help="serve through the paged block-pool KV cache")
+    ap.add_argument("--speculative", type=int, default=0, metavar="N",
+                    help="speculative serving with a truncated-layer "
+                         "draft (first N layers of the target; greedy "
+                         "only); composes with --paged/--kv8/--tp")
     ap.add_argument("--num-blocks", type=int, default=64,
                     help="block-pool size for --paged (16-token blocks)")
     ap.add_argument("--tp", type=int, default=1,
@@ -107,11 +111,48 @@ def main() -> None:
             # The block pool has no contiguous sequence axis to shard.
             raise SystemExit("--paged supports --tp but not --sp "
                              "(use continuous batching for sp)")
+        if args.speculative and args.sp > 1:
+            # Chunked draft/verify has no split-KV sp merge.
+            raise SystemExit("--speculative supports --tp but not --sp "
+                             "(use plain continuous batching for sp)")
         n = args.tp * args.sp
         plan = MeshPlan(make_mesh(tp=args.tp, sp=args.sp,
                                   devices=jax.devices()[:n]))
 
-    if args.paged:
+    if args.speculative:
+        from kubeflow_tpu.models.speculative import (
+            SpeculativeContinuousBatcher,
+            SpeculativePagedBatcher,
+            truncated_draft,
+        )
+
+        if args.temperature:
+            raise SystemExit("--speculative is greedy-only (temperature 0)")
+        dparams, dcfg = truncated_draft(params, cfg, args.speculative)
+        bucket = 16 * ((max(len(p) for p in prompts) + 15) // 16)
+        if args.paged:
+            sb = SpeculativePagedBatcher(
+                params, cfg, dparams, dcfg, gen=gen,
+                slots=min(4, len(prompts)), num_blocks=args.num_blocks,
+                block_size=16, prompt_bucket=bucket,
+                key=jax.random.PRNGKey(0), plan=plan, kv_bits=kv_bits,
+            )
+        else:
+            k_spec = 4
+            sb = SpeculativeContinuousBatcher(
+                params, cfg, dparams, dcfg, gen=gen,
+                slots=min(4, len(prompts)),
+                cache_len=bucket + gen.max_new_tokens + k_spec + 1,
+                prompt_bucket=bucket, key=jax.random.PRNGKey(0),
+                k_spec=k_spec, plan=plan, kv_bits=kv_bits,
+            )
+        rids = [sb.submit(p) for p in prompts]
+        results = sb.run()
+        outs = [results[r] for r in rids]
+        print(f"speculative ({args.speculative}-layer draft, "
+              f"{'paged' if args.paged else 'continuous'}): acceptance "
+              f"{sb.acceptance_rate:.2f}")
+    elif args.paged:
         from kubeflow_tpu.models.paged import PagedBatcher
 
         bucket = 16 * ((max(len(p) for p in prompts) + 15) // 16)

@@ -220,7 +220,88 @@ def _apply_spec_round(outer, engine, active, preds_np, props_np) -> None:
             outer.accepted += min(consumed, n_accept)
 
 
-class SpeculativeContinuousBatcher:
+class _SpecServingBase:
+    """Shared scaffolding for the speculative SERVING engines (continuous
+    and paged): the greedy-only guard, the inner-engine subclass whose
+    hooks keep the dense draft cache in lockstep, the draft state
+    (+ optional tp sharding), the delegated public surface, and the
+    proposed/accepted stats that _apply_spec_round updates. One home, so
+    an edit to any of these cannot drift the engines apart."""
+
+    @staticmethod
+    def _require_greedy(gen) -> None:
+        if gen.temperature != 0.0:
+            raise ValueError(
+                "speculative serving is greedy-only (temperature must be 0: "
+                "acceptance compares argmaxes, sampling would break the "
+                "exactness guarantee)"
+            )
+
+    def _make_inner(self, engine_cls):
+        """Subclass of the inner serving engine wired to this wrapper:
+        admits prefill the draft, releases clear its mask rows, and the
+        step IS the speculative round."""
+        outer = self
+
+        class _Inner(engine_cls):
+            def _post_admit(self, slot, padded, prompt_mask):
+                outer._admit_draft(slot, padded, prompt_mask)
+
+            def _release_slot(self, slot):
+                super()._release_slot(slot)
+                outer.draft_kv_mask = outer.draft_kv_mask.at[slot].set(False)
+
+            def _step(self):
+                outer._spec_step()
+
+        return _Inner
+
+    def _init_draft(self, draft_params, draft_cfg, slots, draft_len,
+                    k_spec, plan, kv_bits) -> None:
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.k_spec = k_spec
+        self.draft_cache = init_kv_cache(draft_cfg, slots, draft_len,
+                                         kv_bits=kv_bits)
+        self.draft_kv_mask = jnp.zeros((slots, draft_len), bool)
+        if plan is not None:
+            # The draft rides the same mesh: its params shard by the same
+            # tp rules, its cache's kv-head axis over tp. GSPMD propagates
+            # through _draft_propose and the verify program — psum for tp
+            # matmuls, no code change. Cache first: shard_kv_cache owns
+            # the tp-divides-kv-heads validation (the draft's head count
+            # can differ from the target's), and must fire before params
+            # are placed.
+            self.draft_cache = plan.shard_kv_cache(self.draft_cache)
+            self.draft_params = plan.shard_params(draft_params)
+        self.proposed = 0
+        self.accepted = 0
+
+    # -- public surface (delegated) ----------------------------------------
+
+    def submit(self, prompt) -> int:
+        return self._engine.submit(prompt)
+
+    def run(self) -> dict:
+        return self._engine.run()
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+    # -- internals ---------------------------------------------------------
+
+    def _admit_draft(self, slot, padded, prompt_mask) -> None:
+        from kubeflow_tpu.models.continuous import _admit_slot
+
+        _, self.draft_cache, self.draft_kv_mask = _admit_slot(
+            self.draft_params, self.draft_cfg, padded, prompt_mask,
+            self.draft_cache, self.draft_kv_mask,
+            jnp.asarray(slot, jnp.int32),
+        )
+
+
+class SpeculativeContinuousBatcher(_SpecServingBase):
     """Continuous batching with speculative decoding as the STEP engine:
     every serving round, the draft proposes k tokens per slot and the
     target verifies them in one (B, k+1) forward at per-slot offsets —
@@ -261,12 +342,7 @@ class SpeculativeContinuousBatcher:
         from kubeflow_tpu.models.serving import GenerationConfig
 
         gen = gen or GenerationConfig()
-        if gen.temperature != 0.0:
-            raise ValueError(
-                "speculative serving is greedy-only (temperature must be 0: "
-                "acceptance compares argmaxes, sampling would break the "
-                "exactness guarantee)"
-            )
+        self._require_greedy(gen)
         if plan is not None and plan.mesh.shape.get("sp", 1) > 1:
             raise ValueError(
                 "SpeculativeContinuousBatcher does not support sp-sharded "
@@ -284,64 +360,12 @@ class SpeculativeContinuousBatcher:
                 f"k_spec {k_spec} + 1 speculative headroom"
             )
 
-        outer = self
-
-        class _Inner(ContinuousBatcher):
-            def _post_admit(self, slot, padded, prompt_mask):
-                outer._admit_draft(slot, padded, prompt_mask)
-
-            def _release_slot(self, slot):
-                super()._release_slot(slot)
-                outer.draft_kv_mask = outer.draft_kv_mask.at[slot].set(False)
-
-            def _step(self):
-                outer._spec_step()
-
-        self._cb = _Inner(
+        self._engine = self._cb = self._make_inner(ContinuousBatcher)(
             params, target_cfg, gen=gen, slots=slots, cache_len=cache_len,
             prompt_bucket=prompt_bucket, key=key, plan=plan, kv_bits=kv_bits,
         )
-        self.draft_params = draft_params
-        self.draft_cfg = draft_cfg
-        self.k_spec = k_spec
-        self.draft_cache = init_kv_cache(draft_cfg, slots, cache_len,
-                                         kv_bits=kv_bits)
-        self.draft_kv_mask = jnp.zeros((slots, cache_len), bool)
-        if plan is not None:
-            # The draft rides the same mesh: its params shard by the same
-            # tp rules, its cache's kv-head axis over tp. GSPMD propagates
-            # through _draft_propose/_target_verify (chunked decode) —
-            # psum for tp matmuls, no code change.
-            # Cache first: shard_kv_cache owns the tp-divides-kv-heads
-            # validation (the draft's head count can differ from the
-            # target's), and must fire before params are placed.
-            self.draft_cache = plan.shard_kv_cache(self.draft_cache)
-            self.draft_params = plan.shard_params(draft_params)
-        self.proposed = 0
-        self.accepted = 0
-
-    # -- public surface (delegated) ----------------------------------------
-
-    def submit(self, prompt) -> int:
-        return self._cb.submit(prompt)
-
-    def run(self) -> dict:
-        return self._cb.run()
-
-    @property
-    def acceptance_rate(self) -> float:
-        return self.accepted / self.proposed if self.proposed else 0.0
-
-    # -- internals ---------------------------------------------------------
-
-    def _admit_draft(self, slot, padded, prompt_mask) -> None:
-        from kubeflow_tpu.models.continuous import _admit_slot
-
-        _, self.draft_cache, self.draft_kv_mask = _admit_slot(
-            self.draft_params, self.draft_cfg, padded, prompt_mask,
-            self.draft_cache, self.draft_kv_mask,
-            jnp.asarray(slot, jnp.int32),
-        )
+        self._init_draft(draft_params, draft_cfg, slots, cache_len,
+                         k_spec, plan, kv_bits)
 
     def _spec_step(self) -> None:
         cb = self._cb
@@ -363,7 +387,7 @@ class SpeculativeContinuousBatcher:
                           np.asarray(proposals))
 
 
-class SpeculativePagedBatcher:
+class SpeculativePagedBatcher(_SpecServingBase):
     """Speculative decoding over the PAGED block pool: the draft proposes
     k tokens per slot from a dense side cache, and the target verifies
     them in one (B, k+1) forward that reads/writes THROUGH the block
@@ -405,26 +429,8 @@ class SpeculativePagedBatcher:
         from kubeflow_tpu.models.serving import GenerationConfig
 
         gen = gen or GenerationConfig()
-        if gen.temperature != 0.0:
-            raise ValueError(
-                "speculative serving is greedy-only (temperature must be 0: "
-                "acceptance compares argmaxes, sampling would break the "
-                "exactness guarantee)"
-            )
-        outer = self
-
-        class _Inner(PagedBatcher):
-            def _post_admit(self, slot, padded, prompt_mask):
-                outer._admit_draft(slot, padded, prompt_mask)
-
-            def _release_slot(self, slot):
-                super()._release_slot(slot)
-                outer.draft_kv_mask = outer.draft_kv_mask.at[slot].set(False)
-
-            def _step(self):
-                outer._spec_step()
-
-        self._pb = _Inner(
+        self._require_greedy(gen)
+        self._engine = self._pb = self._make_inner(PagedBatcher)(
             params, target_cfg, gen=gen, slots=slots, num_blocks=num_blocks,
             block_size=block_size, prompt_bucket=prompt_bucket, key=key,
             plan=plan, kv_bits=kv_bits,
@@ -432,52 +438,17 @@ class SpeculativePagedBatcher:
             # before rewinding; the block tables must span those too.
             headroom_tokens=k_spec + 1,
         )
-        self.draft_params = draft_params
-        self.draft_cfg = draft_cfg
-        self.k_spec = k_spec
         # Dense draft cache spanning the pool's logical window (bucket
         # overhang on preempted continuations included — max_blocks
-        # already accounts for it).
-        draft_len = self._pb.max_blocks * block_size
-        self.draft_cache = init_kv_cache(draft_cfg, slots, draft_len,
-                                         kv_bits=kv_bits)
-        self.draft_kv_mask = jnp.zeros((slots, draft_len), bool)
-        if plan is not None:
-            # sp is already rejected by PagedBatcher (no contiguous
-            # sequence axis); tp shards the draft like the target.
-            # shard_kv_cache owns the tp-divides-kv-heads validation and
-            # fires before params are placed.
-            self.draft_cache = plan.shard_kv_cache(self.draft_cache)
-            self.draft_params = plan.shard_params(draft_params)
-        self.proposed = 0
-        self.accepted = 0
-
-    # -- public surface (delegated) ----------------------------------------
-
-    def submit(self, prompt) -> int:
-        return self._pb.submit(prompt)
-
-    def run(self) -> dict:
-        return self._pb.run()
-
-    @property
-    def acceptance_rate(self) -> float:
-        return self.accepted / self.proposed if self.proposed else 0.0
+        # already accounts for it). sp is rejected by PagedBatcher itself
+        # (no contiguous sequence axis).
+        self._init_draft(draft_params, draft_cfg, slots,
+                         self._pb.max_blocks * block_size, k_spec, plan,
+                         kv_bits)
 
     @property
     def free_blocks(self) -> int:
         return self._pb.free_blocks
-
-    # -- internals ---------------------------------------------------------
-
-    def _admit_draft(self, slot, padded, prompt_mask) -> None:
-        from kubeflow_tpu.models.continuous import _admit_slot
-
-        _, self.draft_cache, self.draft_kv_mask = _admit_slot(
-            self.draft_params, self.draft_cfg, padded, prompt_mask,
-            self.draft_cache, self.draft_kv_mask,
-            jnp.asarray(slot, jnp.int32),
-        )
 
     def _spec_step(self) -> None:
         from kubeflow_tpu.models.paged import _paged_verify
@@ -501,3 +472,25 @@ class SpeculativePagedBatcher:
         )
         _apply_spec_round(self, pb, active, np.asarray(preds),
                           np.asarray(proposals))
+
+
+def truncated_draft(params: dict, cfg: LlamaConfig,
+                    n_layers: int) -> tuple[dict, LlamaConfig]:
+    """A zero-training draft from the TARGET's own weights: keep the
+    first ``n_layers`` of the stacked layer axis, share embed/final-norm/
+    lm-head. Early layers carry most next-token signal on trained
+    models, so this gives a usable acceptance rate with no second
+    checkpoint and no extra HBM beyond the sliced layer stack — the
+    standard self-speculative deployment shortcut.
+
+    Returns (draft_params, draft_cfg) ready for any spec engine."""
+    import dataclasses
+
+    if not 1 <= n_layers < cfg.n_layers:
+        raise ValueError(
+            f"draft n_layers must be in 1..{cfg.n_layers - 1}, "
+            f"got {n_layers}"
+        )
+    draft = dict(params)
+    draft["layers"] = jax.tree.map(lambda w: w[:n_layers], params["layers"])
+    return draft, dataclasses.replace(cfg, n_layers=n_layers)

@@ -415,3 +415,40 @@ class TestSpeculativePagedServing:
         for rid, prompt in zip(rids, prompts):
             assert len(out[rid]) == 8
             _assert_greedy_consistent(tparams, tcfg, prompt, out[rid])
+
+
+class TestTruncatedDraft:
+    def test_layers_sliced_and_rest_shared(self, target):
+        from kubeflow_tpu.models.speculative import truncated_draft
+
+        tcfg, tparams = target
+        dparams, dcfg = truncated_draft(tparams, tcfg, 1)
+        assert dcfg.n_layers == 1
+        assert dparams["layers"]["wq"].shape[0] == 1
+        assert dparams["embed"] is tparams["embed"]  # shared, not copied
+
+    def test_bounds_validated(self, target):
+        from kubeflow_tpu.models.speculative import truncated_draft
+
+        tcfg, tparams = target
+        with pytest.raises(ValueError, match="n_layers"):
+            truncated_draft(tparams, tcfg, tcfg.n_layers)
+        with pytest.raises(ValueError, match="n_layers"):
+            truncated_draft(tparams, tcfg, 0)
+
+    def test_spec_output_stays_target_greedy(self, target):
+        """The spec invariant is draft-independent: a truncated-layer
+        draft must still yield exactly the target's greedy output."""
+        from kubeflow_tpu.models.speculative import truncated_draft
+
+        tcfg, tparams = target
+        dparams, dcfg = truncated_draft(tparams, tcfg, 1)
+        prompt = _prompt(6)
+        ref = np.asarray(L.generate(tparams, tcfg, prompt, steps=12,
+                                    cache_len=48))
+        out, stats = speculative_generate(
+            tparams, tcfg, dparams, dcfg, prompt, steps=12, cache_len=48,
+            k_spec=3,
+        )
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        assert 0.0 <= stats["acceptance_rate"] <= 1.0
