@@ -49,6 +49,9 @@ loadtest: ## Notebook churn benchmark (reference: loadtest/start_notebooks.py)
 bench: ## Headline TPU benchmark — one JSON line
 	$(PYTHON) bench.py
 
+bench-smoke: ## Every bench section at toy shapes on CPU (executability gate)
+	BENCH_SMOKE=1 $(PYTHON) bench.py --full
+
 dryrun: ## Multi-chip sharding compile check on a virtual 8-device mesh
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
