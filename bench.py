@@ -471,6 +471,59 @@ def run_full_bench(results: list) -> None:
             })
             del draft
 
+    def spec_serving_section():
+        # Speculative SERVING throughput — the engine (continuous
+        # batching + paged pool + per-slot acceptance), not the raw
+        # speculative_generate loop: truncated-half-layer draft over the
+        # block pool, two-point timing so admit prefills cancel.
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.models.speculative import (
+            SpeculativePagedBatcher, truncated_draft,
+        )
+
+        tcfg = mid_cfg
+        params = L.init_params(tcfg, jax.random.PRNGKey(0))
+        half = max(1, tcfg.n_layers // 2)
+        draft, dcfg = truncated_draft(params, tcfg, half)
+        bs, plen = (2, 16) if smoke else (4, 32)
+        s1, s2 = (4, 8) if smoke else (24, 72)
+        rng = jax.random.randint(
+            jax.random.PRNGKey(1), (bs, plen), 3, tcfg.vocab_size
+        )
+        prompts = [list(map(int, row)) for row in rng]
+
+        def timed(steps: int):
+            # headroom pins max_blocks (hence tables/kv_mask/draft-cache
+            # shapes and every compiled program) constant across the
+            # timing points — otherwise compile time lands inside t1/t2
+            # and does NOT cancel in the subtraction.
+            sb = SpeculativePagedBatcher(
+                params, tcfg, draft, dcfg,
+                gen=GenerationConfig(max_new_tokens=steps, eos_id=-1),
+                slots=bs, num_blocks=64, block_size=16, prompt_bucket=plen,
+                k_spec=4, headroom_tokens=s2 - steps,
+            )
+            for p in prompts:
+                sb.submit(p)
+            t0 = time.perf_counter()
+            sb.run()
+            return time.perf_counter() - t0, sb.acceptance_rate
+
+        timed(2)  # compile admit + verify round (same shapes as below)
+        t1, _ = timed(s1)
+        t2, rate = timed(s2)
+        report(
+            f"spec-paged serving tokens/sec (1.1B, {half}-layer draft, "
+            f"bs={bs}, k=4)",
+            bs * (s2 - s1) / (t2 - t1), "tokens/sec",
+            f"(acceptance {rate:.2f}, block pool 64x16)",
+        )
+        results.append({
+            "metric": "spec-paged serving acceptance rate "
+                      f"({half}-layer draft)",
+            "value": round(rate, 3), "unit": "ratio",
+        })
+
     def decode_attr_section():
         # Decode-step ATTRIBUTION (bs=1 bf16 7B, the headline config):
         # where does the per-token time go? Each component is timed as a
@@ -625,6 +678,7 @@ def run_full_bench(results: list) -> None:
     section(batched_section)
     section(spec_section)
     section(spec_curve_section)
+    section(spec_serving_section)
     section(decode_attr_section)
     # Biggest-HBM sections LAST (7B prefill, then 7B + 4096-slot cache):
     # an OOM on a small chip must not rob the sections above of their

@@ -424,6 +424,7 @@ class SpeculativePagedBatcher(_SpecServingBase):
         k_spec: int = 4,
         plan=None,  # parallel.mesh.MeshPlan → tp-sharded spec serving
         kv_bits: int = 0,  # 8 → int8 pool AND draft cache
+        headroom_tokens: int = 0,  # extra table span beyond k_spec+1
     ):
         from kubeflow_tpu.models.paged import PagedBatcher
         from kubeflow_tpu.models.serving import GenerationConfig
@@ -436,7 +437,10 @@ class SpeculativePagedBatcher(_SpecServingBase):
             plan=plan, kv_bits=kv_bits,
             # A spec round writes up to k_spec+1 slots past the pointer
             # before rewinding; the block tables must span those too.
-            headroom_tokens=k_spec + 1,
+            # Caller ``headroom_tokens`` adds on top — e.g. to pin
+            # max_blocks (and so every compiled shape) constant across
+            # configs with different max_new_tokens.
+            headroom_tokens=k_spec + 1 + headroom_tokens,
         )
         # Dense draft cache spanning the pool's logical window (bucket
         # overhang on preempted continuations included — max_blocks
