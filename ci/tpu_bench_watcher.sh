@@ -12,7 +12,7 @@ echo "[watcher] start $(date -u +%FT%TZ) artifact=$ART" >> "$LOG"
 while true; do
     if timeout 90 python -c "import jax; jax.devices()" >> "$LOG" 2>&1; then
         echo "[watcher] tunnel healthy $(date -u +%FT%TZ); running bench --full" >> "$LOG"
-        if timeout 3000 python bench.py --full --artifact "$ART" >> "$LOG" 2>&1; then
+        if timeout 5400 python bench.py --full --artifact "$ART" >> "$LOG" 2>&1; then
             git add "$ART" 2>> "$LOG"
             git commit -m "Live TPU bench capture: $ART" --only "$ART" >> "$LOG" 2>&1
             echo "[watcher] bench captured + committed $(date -u +%FT%TZ)" >> "$LOG"
