@@ -34,6 +34,9 @@ def main() -> None:
                          "only); composes with --paged/--kv8/--tp")
     ap.add_argument("--num-blocks", type=int, default=64,
                     help="block-pool size for --paged (16-token blocks)")
+    ap.add_argument("--prompt-cache", action="store_true",
+                    help="(--paged) share identical prompts' KV blocks "
+                         "and skip their re-prefill")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel shards (continuous batching)")
     ap.add_argument("--sp", type=int, default=1,
@@ -160,7 +163,7 @@ def main() -> None:
             params, cfg, gen=gen, slots=min(4, len(prompts)),
             num_blocks=args.num_blocks, block_size=16, prompt_bucket=bucket,
             key=jax.random.PRNGKey(0), plan=plan,
-            kv_bits=kv_bits,
+            kv_bits=kv_bits, prompt_cache=args.prompt_cache,
         )
         rids = [pb.submit(p) for p in prompts]
         results = pb.run()

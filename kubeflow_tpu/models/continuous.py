@@ -177,6 +177,10 @@ class _Request:
     # Paged batcher only: physical block ids this request holds, in
     # position order. Harmless (empty) for the fixed-slot batcher.
     blocks: list[int] = dataclasses.field(default_factory=list)
+    # Paged prompt cache only: the subset of ``blocks`` that is SHARED
+    # (refcounted) rather than owned — released by decref, never freed
+    # directly to the pool.
+    shared: frozenset = frozenset()
 
 
 class _BatcherBase:

@@ -287,6 +287,7 @@ class PagedBatcher(_BatcherBase):
         plan=None,  # parallel.mesh.MeshPlan → tp-sharded serving
         kv_bits: int = 0,  # 8 → int8 block pool (halved KV HBM)
         headroom_tokens: int = 0,  # extra per-slot span (speculative rounds)
+        prompt_cache: bool = False,  # share identical prompts' blocks
     ):
         self.gen = gen or GenerationConfig()
         if prompt_bucket % block_size:
@@ -342,6 +343,18 @@ class PagedBatcher(_BatcherBase):
         # the shared pool's analog of the dense batcher's harmless
         # stale-slot writes.
         self._free = list(range(1, num_blocks))
+        # Prompt cache (opt-in): identical PADDED prompts produce
+        # byte-identical block contents at identical logical positions
+        # (absolute-position rope over the same left-padded layout), so
+        # their prompt blocks — and last-position logits — are shared
+        # instead of re-prefilled. Decode writes only ever land at
+        # positions >= bucket (a fresh owned block: bucket is
+        # block-aligned), so shared blocks are never mutated and no
+        # copy-on-write is needed. Entries are evicted (their blocks
+        # returned) before the allocator resorts to preemption.
+        self._prompt_cache_enabled = prompt_cache
+        self._prompt_cache: dict = {}  # padded-bytes -> {blocks, logits}
+        self._shared_refs: dict = {}  # block -> cache ref + active users
         self._init_base(self.gen, slots, prompt_bucket)
 
     @property
@@ -361,6 +374,11 @@ class PagedBatcher(_BatcherBase):
         under pressure — vLLM's policy split. None when the pool cannot
         supply n under the given policy."""
         while len(self._free) < n:
+            # Idle cached prompts are the cheapest capacity: evicting one
+            # costs a future re-prefill, preempting a RUNNING request
+            # costs a re-prefill NOW plus its lost decode progress.
+            if self._evict_cached_prompt():
+                continue
             if not preempt:
                 return None
             victim = self._youngest_active()
@@ -369,6 +387,19 @@ class PagedBatcher(_BatcherBase):
             self._preempt(victim)
         taken, self._free = self._free[:n], self._free[n:]
         return taken
+
+    def _evict_cached_prompt(self) -> bool:
+        """Drop one cached prompt no active request references (every
+        block at refcount 1 — the cache's own hold), returning its blocks
+        to the pool. Insertion order ≈ LRU-enough for a serving cache."""
+        for key, entry in self._prompt_cache.items():
+            if all(self._shared_refs.get(b, 0) == 1 for b in entry["blocks"]):
+                del self._prompt_cache[key]
+                for b in entry["blocks"]:
+                    del self._shared_refs[b]
+                    self._free.append(b)
+                return True
+        return False
 
     def _youngest_active(self) -> Optional[int]:
         slots = [
@@ -390,8 +421,17 @@ class PagedBatcher(_BatcherBase):
 
     def _release_slot(self, slot: int) -> None:
         req = self._by_slot[slot]
-        self._free.extend(req.blocks)
+        for blk in req.blocks:
+            if blk in req.shared:
+                self._shared_refs[blk] -= 1
+                if self._shared_refs[blk] == 0:
+                    # Cache entry already evicted; last user frees it.
+                    del self._shared_refs[blk]
+                    self._free.append(blk)
+            else:
+                self._free.append(blk)
         req.blocks = []
+        req.shared = frozenset()
         self._by_slot[slot] = None
         self.kv_mask = self.kv_mask.at[slot].set(False)
         self.tables[slot] = 0  # dead writes go to the null block
@@ -417,6 +457,32 @@ class PagedBatcher(_BatcherBase):
                     self.prompt_bucket,
                     -(-len(effective) // self.block_size) * self.block_size,
                 )
+                padded, mask = left_pad(
+                    [effective], self.gen.pad_id, bucket
+                )
+                # Prompt-cache hit (pure prompts only — a preempted
+                # continuation's effective tokens are request-unique):
+                # reuse the shared blocks + cached last-position logits,
+                # no allocation, no prefill. The key carries the validity
+                # MASK as well as the tokens: a prompt whose leading
+                # token equals pad_id pads to the same bytes as the
+                # shorter prompt without it, but their masks (and so
+                # their attention, KV, and logits) differ.
+                cache_key = (padded.tobytes(), mask.tobytes())
+                cache_hit = (
+                    self._prompt_cache.get(cache_key)
+                    if self._prompt_cache_enabled and not head.tokens
+                    else None
+                )
+                if cache_hit is not None:
+                    # Move-to-end: eviction scans insertion order, so a
+                    # hit must refresh recency or the hottest prompt is
+                    # evicted first (FIFO, not LRU).
+                    self._prompt_cache[cache_key] = self._prompt_cache.pop(
+                        cache_key
+                    )
+                    blocks = list(cache_hit["blocks"])
+                    break
                 need = bucket // self.block_size
                 # Watermark (vLLM's admission reserve): keep one free block
                 # per RUNNING request on top of the admit cost — otherwise
@@ -424,6 +490,9 @@ class PagedBatcher(_BatcherBase):
                 # their next boundary and the decode path immediately
                 # evicts the fresh admit (one-step-removed thrash).
                 reserve = sum(1 for r in self._by_slot if r is not None)
+                while (len(self._free) < need + reserve
+                       and self._evict_cached_prompt()):
+                    pass  # cached prompts yield before admission stalls
                 blocks = (
                     self._take_blocks(need, preempt=False)
                     if len(self._free) >= need + reserve else None
@@ -445,12 +514,30 @@ class PagedBatcher(_BatcherBase):
                 continue  # queue drained for this slot
             req = self._queue.pop(0)
             generated = list(req.tokens)
-            padded, mask = left_pad([effective], self.gen.pad_id, bucket)
             prompt_mask = None if mask.all() else jnp.asarray(mask)
-            logits, self.pool = _paged_admit(
-                self.params, self.cfg, jnp.asarray(padded), self.pool,
-                prompt_mask, jnp.asarray(blocks, jnp.int32), self.block_size,
-            )
+            shared: frozenset = frozenset()
+            if cache_hit is not None:
+                for blk in blocks:
+                    self._shared_refs[blk] += 1
+                logits = cache_hit["logits"]
+                shared = frozenset(blocks)
+            else:
+                logits, self.pool = _paged_admit(
+                    self.params, self.cfg, jnp.asarray(padded), self.pool,
+                    prompt_mask, jnp.asarray(blocks, jnp.int32),
+                    self.block_size,
+                )
+                if self._prompt_cache_enabled and not generated:
+                    # Retain: one ref for the cache + one for this
+                    # request; the blocks are shared from here on.
+                    self._prompt_cache[(padded.tobytes(), mask.tobytes())] = {
+                        "blocks": list(blocks), "logits": logits,
+                    }
+                    for blk in blocks:
+                        self._shared_refs[blk] = (
+                            self._shared_refs.get(blk, 0) + 2
+                        )
+                    shared = frozenset(blocks)
             self.key, sub = jax.random.split(self.key)
             first = int(
                 sample_logits(
@@ -469,7 +556,8 @@ class PagedBatcher(_BatcherBase):
             row = np.ones((self.max_blocks * self.block_size,), bool)
             row[:bucket] = np.asarray(mask)[0]
             self.kv_mask = self.kv_mask.at[slot].set(jnp.asarray(row))
-            req = _Request(req.rid, req.prompt, generated, blocks=blocks)
+            req = _Request(req.rid, req.prompt, generated, blocks=blocks,
+                           shared=shared)
             req.budget = self.gen.max_new_tokens - len(generated)
             self._by_slot[slot] = req
             self._post_admit(slot, jnp.asarray(padded), prompt_mask)

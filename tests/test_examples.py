@@ -57,7 +57,8 @@ def test_finetune_lora_runs_and_exports(tmp_path):
               ("--paged", "--tp", "2"), ("--kv8",), ("--int8", "--kv8"),
               ("--paged", "--kv8"), ("--kv8", "--tp", "2", "--sp", "2"),
               ("--paged", "--kv8", "--tp", "2"), ("--speculative", "1"),
-              ("--speculative", "1", "--paged", "--kv8")]
+              ("--speculative", "1", "--paged", "--kv8"),
+              ("--paged", "--prompt-cache")]
 )
 def test_serve_batched_runs(extra):
     res = _run("serve_batched.py", "--max-new-tokens", "4", *extra)

@@ -178,3 +178,120 @@ class TestShardedPaged:
         plan = MeshPlan(make_mesh(tp=1, sp=2, devices=jax.devices()[:2]))
         with pytest.raises(ValueError, match="sp"):
             PagedBatcher(params, cfg, plan=plan)
+
+
+class TestPromptCache:
+    """Identical-prompt block sharing (prompt_cache=True): same padded
+    prompt → shared prompt blocks + cached last-position logits; decode
+    only ever writes past the bucket boundary, so shared blocks are
+    never mutated."""
+
+    def _pb(self, params, cfg, num_blocks=32, max_new=6, slots=2, **kw):
+        gen = GenerationConfig(max_new_tokens=max_new, eos_id=-1)
+        return PagedBatcher(params, cfg, gen=gen, slots=slots,
+                            num_blocks=num_blocks, block_size=8,
+                            prompt_bucket=16, prompt_cache=True, **kw)
+
+    def test_identical_prompts_share_blocks_and_tokens(self, tiny):
+        cfg, params = tiny
+        prompt = [5, 9, 17, 33]
+        # Baseline without cache.
+        gen = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        base = PagedBatcher(params, cfg, gen=gen, slots=2, num_blocks=32,
+                            block_size=8, prompt_bucket=16)
+        r0 = base.submit(prompt)
+        want = base.run()[r0]
+
+        pb = self._pb(params, cfg)
+        rids = [pb.submit(prompt) for _ in range(4)]
+        out = pb.run()
+        for r in rids:
+            assert out[r] == want  # byte-identical greedy streams
+        # The cache retains the prompt's 2 blocks; everything else freed.
+        assert pb.free_blocks == 31 - 2
+        # One cached entry whose blocks are held only by the cache now.
+        (entry,) = pb._prompt_cache.values()
+        assert all(pb._shared_refs[b] == 1 for b in entry["blocks"])
+
+    def test_hit_skips_prefill(self, tiny):
+        cfg, params = tiny
+        pb = self._pb(params, cfg, slots=1)
+        calls = {"n": 0}
+        import kubeflow_tpu.models.paged as paged_mod
+
+        real_admit = paged_mod._paged_admit
+
+        def counting_admit(*a, **kw):
+            calls["n"] += 1
+            return real_admit(*a, **kw)
+
+        paged_mod._paged_admit = counting_admit
+        try:
+            r1 = pb.submit([5, 9, 17])
+            r2 = pb.submit([5, 9, 17])
+            out = pb.run()
+        finally:
+            paged_mod._paged_admit = real_admit
+        assert calls["n"] == 1  # second admission reused the blocks
+        assert out[r1] == out[r2]
+
+    def test_eviction_under_pressure(self, tiny):
+        """Cached prompts yield their blocks before admission stalls or
+        preemption fires; distinct prompts keep completing."""
+        cfg, params = tiny
+        pb = self._pb(params, cfg, num_blocks=10, max_new=6, slots=1)
+        prompts = [[3 + i, 41, 90] for i in range(4)]  # all distinct
+        rids = [pb.submit(p) for p in prompts]
+        out = pb.run()
+        assert all(len(out[r]) == 6 for r in rids)
+
+    def test_shared_blocks_survive_user_release(self, tiny):
+        """A request finishing decrefs shared blocks but the cache's own
+        ref keeps them resident for the next hit; a hit AFTER the first
+        user finished still reuses them and still matches."""
+        cfg, params = tiny
+        prompt = [7, 3, 11, 2]
+        pb = self._pb(params, cfg, slots=1)
+        r1 = pb.submit(prompt)
+        first = pb.run()[r1]
+        r2 = pb.submit(prompt)
+        second = pb.run()[r2]
+        assert first == second
+
+    def test_pad_id_leading_token_does_not_collide(self, tiny):
+        """A prompt whose LEADING token equals pad_id left-pads to the
+        same bytes as the shorter prompt without it — but their validity
+        masks (and so attention and logits) differ. The cache key must
+        separate them; each must match its own uncached stream."""
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        pad = gen.pad_id
+        with_lead = [pad, 5, 9]
+        without = [5, 9]
+
+        def uncached(prompt):
+            pb = PagedBatcher(params, cfg, gen=gen, slots=1, num_blocks=32,
+                              block_size=8, prompt_bucket=16)
+            r = pb.submit(prompt)
+            return pb.run()[r]
+
+        want_a, want_b = uncached(with_lead), uncached(without)
+        pb = self._pb(params, cfg, slots=1)
+        ra1 = pb.submit(with_lead)
+        rb1 = pb.submit(without)
+        ra2 = pb.submit(with_lead)
+        rb2 = pb.submit(without)
+        out = pb.run()
+        assert out[ra1] == want_a and out[ra2] == want_a
+        assert out[rb1] == want_b and out[rb2] == want_b
+        assert len(pb._prompt_cache) == 2  # distinct entries, no collision
+
+    def test_continuations_bypass_cache(self, tiny):
+        """Preempted continuations carry generated tokens — request-
+        unique, never cached or matched; the starved-pool recovery path
+        stays correct with the cache on."""
+        cfg, params = tiny
+        pb = self._pb(params, cfg, num_blocks=10, max_new=8, slots=2)
+        rids = [pb.submit([3 + i, 41, 90, 7]) for i in range(3)]
+        out = pb.run()
+        assert all(len(out[r]) == 8 for r in rids)
