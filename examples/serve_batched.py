@@ -139,6 +139,7 @@ def main() -> None:
                 slots=min(4, len(prompts)), num_blocks=args.num_blocks,
                 block_size=16, prompt_bucket=bucket,
                 key=jax.random.PRNGKey(0), plan=plan, kv_bits=kv_bits,
+                prompt_cache=args.prompt_cache,
             )
         else:
             k_spec = 4

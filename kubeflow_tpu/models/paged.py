@@ -457,9 +457,6 @@ class PagedBatcher(_BatcherBase):
                     self.prompt_bucket,
                     -(-len(effective) // self.block_size) * self.block_size,
                 )
-                padded, mask = left_pad(
-                    [effective], self.gen.pad_id, bucket
-                )
                 # Prompt-cache hit (pure prompts only — a preempted
                 # continuation's effective tokens are request-unique):
                 # reuse the shared blocks + cached last-position logits,
@@ -467,13 +464,16 @@ class PagedBatcher(_BatcherBase):
                 # MASK as well as the tokens: a prompt whose leading
                 # token equals pad_id pads to the same bytes as the
                 # shorter prompt without it, but their masks (and so
-                # their attention, KV, and logits) differ.
-                cache_key = (padded.tobytes(), mask.tobytes())
-                cache_hit = (
-                    self._prompt_cache.get(cache_key)
-                    if self._prompt_cache_enabled and not head.tokens
-                    else None
-                )
+                # their attention, KV, and logits) differ. Padding and
+                # key are computed here only when the cache is on — the
+                # default path pays nothing before allocation succeeds.
+                padded = mask = cache_key = cache_hit = None
+                if self._prompt_cache_enabled and not head.tokens:
+                    padded, mask = left_pad(
+                        [effective], self.gen.pad_id, bucket
+                    )
+                    cache_key = (padded.tobytes(), mask.tobytes())
+                    cache_hit = self._prompt_cache.get(cache_key)
                 if cache_hit is not None:
                     # Move-to-end: eviction scans insertion order, so a
                     # hit must refresh recency or the hottest prompt is
@@ -514,6 +514,8 @@ class PagedBatcher(_BatcherBase):
                 continue  # queue drained for this slot
             req = self._queue.pop(0)
             generated = list(req.tokens)
+            if padded is None:
+                padded, mask = left_pad([effective], self.gen.pad_id, bucket)
             prompt_mask = None if mask.all() else jnp.asarray(mask)
             shared: frozenset = frozenset()
             if cache_hit is not None:
@@ -527,10 +529,10 @@ class PagedBatcher(_BatcherBase):
                     prompt_mask, jnp.asarray(blocks, jnp.int32),
                     self.block_size,
                 )
-                if self._prompt_cache_enabled and not generated:
+                if cache_key is not None:
                     # Retain: one ref for the cache + one for this
                     # request; the blocks are shared from here on.
-                    self._prompt_cache[(padded.tobytes(), mask.tobytes())] = {
+                    self._prompt_cache[cache_key] = {
                         "blocks": list(blocks), "logits": logits,
                     }
                     for blk in blocks:

@@ -452,3 +452,27 @@ class TestTruncatedDraft:
         )
         np.testing.assert_array_equal(np.asarray(out), ref)
         assert 0.0 <= stats["acceptance_rate"] <= 1.0
+
+
+class TestSpecPagedPromptCache:
+    def test_identical_prompts_share_target_blocks(self, target, draft):
+        """prompt_cache composes with speculative paged serving: the
+        target's prompt blocks are shared on a hit (draft re-prefills its
+        own dense cache per slot), and both requests emit the same
+        greedy stream."""
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.models.speculative import SpeculativePagedBatcher
+
+        tcfg, tparams = target
+        dcfg, dparams = draft
+        gen = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        sb = SpeculativePagedBatcher(
+            tparams, tcfg, dparams, dcfg, gen=gen, slots=2, num_blocks=40,
+            block_size=8, prompt_bucket=16, k_spec=3, prompt_cache=True,
+        )
+        prompt = [5, 9, 17, 33]
+        r1, r2, r3 = sb.submit(prompt), sb.submit(prompt), sb.submit(prompt)
+        out = sb.run()
+        assert out[r1] == out[r2] == out[r3]
+        assert len(out[r1]) == 6
+        assert len(sb._pb._prompt_cache) == 1
