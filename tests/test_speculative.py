@@ -476,3 +476,27 @@ class TestSpecPagedPromptCache:
         assert out[r1] == out[r2] == out[r3]
         assert len(out[r1]) == 6
         assert len(sb._pb._prompt_cache) == 1
+
+
+class TestSpecPagedMultiBlockSpan:
+    def test_verify_chunk_wider_than_block(self, target, draft):
+        """k_spec+1 > block_size: one verify round spans MULTIPLE new
+        blocks; the span-aware allocator must cover them all (multi-pass)
+        and the stream must stay on the greedy path."""
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.models.speculative import SpeculativePagedBatcher
+        from tests.test_continuous import _assert_greedy_consistent
+
+        tcfg, tparams = target
+        dcfg, dparams = draft
+        gen = GenerationConfig(max_new_tokens=10, eos_id=-1)
+        sb = SpeculativePagedBatcher(
+            tparams, tcfg, dparams, dcfg, gen=gen, slots=2, num_blocks=48,
+            block_size=4, prompt_bucket=16, k_spec=6,  # span 7 > 4
+        )
+        prompts = [[5, 9, 17, 33], [7, 3, 11]]
+        rids = [sb.submit(p) for p in prompts]
+        out = sb.run()
+        for rid, prompt in zip(rids, prompts):
+            assert len(out[rid]) == 10
+            _assert_greedy_consistent(tparams, tcfg, prompt, out[rid])
