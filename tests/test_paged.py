@@ -295,3 +295,14 @@ class TestPromptCache:
         rids = [pb.submit([3 + i, 41, 90, 7]) for i in range(3)]
         out = pb.run()
         assert all(len(out[r]) == 8 for r in rids)
+
+    def test_prompt_cache_over_int8_pool(self, tiny):
+        """Cache hits reuse QUANTIZED blocks (values + scale leaves ride
+        the same tables); hit streams match the miss stream exactly."""
+        cfg, params = tiny
+        pb = self._pb(params, cfg, slots=2, kv_bits=8)
+        prompt = [5, 9, 17, 33]
+        r1, r2, r3 = pb.submit(prompt), pb.submit(prompt), pb.submit(prompt)
+        out = pb.run()
+        assert out[r1] == out[r2] == out[r3]
+        assert len(pb._prompt_cache) == 1
