@@ -238,3 +238,14 @@ def test_smoke_mode_refuses_artifact(bench):
     )
     assert proc.returncode == 2
     assert b"BENCH_SMOKE" in proc.stderr
+
+
+def test_smoke_flag_falsey_strings(bench, monkeypatch):
+    """Explicit BENCH_SMOKE=0/false/no means OFF — an operator forcing a
+    real-chip run must not be routed to the CPU toy path."""
+    for v in ("0", "false", "False", "no", "", "  "):
+        monkeypatch.setenv("BENCH_SMOKE", v)
+        assert not bench._smoke_enabled(), repr(v)
+    for v in ("1", "true", "yes", "on"):
+        monkeypatch.setenv("BENCH_SMOKE", v)
+        assert bench._smoke_enabled(), repr(v)

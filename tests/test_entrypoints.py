@@ -321,3 +321,15 @@ def test_transforming_client_round_trip():
     assert all("data" not in o for o in client.list("ConfigMap", "ns"))
     # Underlying store untouched (transform models the cache, not etcd).
     assert "data" in cluster.get("ConfigMap", "random-cm", "ns")
+
+
+def test_loadtest_p95_nearest_rank():
+    """One p95 formula serves every spawn artifact field."""
+    import importlib
+
+    lt = importlib.import_module("loadtest.start_notebooks")
+    # 20 values 1..20 ms in seconds: rank index max(0, int(0.95*20)-1)=18
+    # → the 19th value.
+    vals = [i / 1000 for i in range(1, 21)]
+    assert lt._p95_ms(vals) == 19.0
+    assert lt._p95_ms([0.005]) == 5.0
