@@ -515,3 +515,62 @@ class TestMetrics:
         env.manager.run_until_idle()
         text = env.metrics.expose().decode()
         assert "tpu_chips_in_use 0.0" in text
+
+
+class TestPrimingRegimeMajority:
+    def test_one_int_anomaly_among_ts_events_pins_ts_at_priming(self):
+        """An unpinned (fresh) cursor pins to the MAJORITY regime of the
+        visible events: on an opaque-rv cluster whose priming view
+        contains ONE rv that parses as an integer, the cursor must still
+        pin to the timestamp regime — so later ts-token warnings
+        surface."""
+        env = make_env()
+
+        class MostlyOpaqueRVClient:
+            """Opaque (ts-regime) rvs except one anomalous raw integer."""
+
+            def __init__(self, inner, raw_name):
+                self._inner = inner
+                self._raw = raw_name
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def list(self, kind, namespace, *a, **kw):
+                out = self._inner.list(kind, namespace, *a, **kw)
+                if kind == "Event":
+                    for e in out:
+                        rv = e["metadata"].get("resourceVersion")
+                        if rv is not None and e["metadata"]["name"] != self._raw:
+                            e["metadata"]["resourceVersion"] = f"op-{rv}"
+                return out
+
+        env.reconciler.client = MostlyOpaqueRVClient(env.cluster, "nb-0.anom")
+
+        def warn(name, reason, ts):
+            env.cluster.create({
+                "apiVersion": "v1", "kind": "Event",
+                "metadata": {"name": name, "namespace": "ns"},
+                "involvedObject": {"kind": "Pod", "name": "nb-0",
+                                   "namespace": "ns"},
+                "type": "Warning", "reason": reason, "message": "m",
+                "lastTimestamp": ts,
+            })
+
+        # Notebook + a mixed event set exist BEFORE the first reconcile:
+        # priming sees several ts tokens and one int token.
+        env.cluster.create(tpu_notebook())
+        warn("nb-0.anom", "Anomaly", "2026-07-30T11:59:00Z")
+        warn("nb-0.aaa", "Old1", "2026-07-30T11:59:01Z")
+        warn("nb-0.bbb", "Old2", "2026-07-30T11:59:02Z")
+        env.manager.run_until_idle()  # primes; history not re-emitted
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        cursor = nb["metadata"]["annotations"][ann.LAST_SEEN_EVENT_RV]
+        assert cursor.startswith("."), f"cursor pinned wrong regime: {cursor}"
+        # A fresh ts-regime warning after priming surfaces.
+        warn("nb-0.ccc", "Fresh", "2026-07-30T12:00:05Z")
+        env.manager.run_until_idle()
+        assert any(
+            e["reason"] == "Fresh"
+            for e in events_for(env.cluster, "Notebook", "nb", "ns")
+        )
