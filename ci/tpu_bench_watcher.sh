@@ -1,21 +1,67 @@
 #!/bin/bash
 # Round-5 tunnel watcher: probe TPU enumeration every cycle; at the FIRST
-# healthy window run the full bench and commit the artifact immediately
-# (VERDICT r4 "Next round" #1: capture EARLY and OFTEN, not at round end).
-# Exits after a successful bench+commit; a supervising loop may restart it
-# for later re-captures.
+# healthy window capture in two stages and commit each immediately
+# (VERDICT r4 "Next round" #1: capture EARLY and OFTEN, not at round end):
+#   1. the default HEADLINE bench (~30 s warm) -> BENCH_FULL_r05_headline.json
+#      — the scoreboard number, grabbed first because wedge windows can be
+#      shorter than the full section list (round 5 saw a 90 s window);
+#   2. the full section list -> BENCH_FULL_r05.json.
+# Exits after a successful full bench+commit; a supervising loop may
+# restart it for later re-captures.
 set -u
 cd /root/repo
 LOG=${1:-/tmp/tpu_watcher.log}
 ART=${2:-BENCH_FULL_r05.json}
+HEADLINE_ART=BENCH_FULL_r05_headline.json
 echo "[watcher] start $(date -u +%FT%TZ) artifact=$ART" >> "$LOG"
 while true; do
     if timeout 90 python -c "import jax; jax.devices()" >> "$LOG" 2>&1; then
-        echo "[watcher] tunnel healthy $(date -u +%FT%TZ); running bench --full" >> "$LOG"
+        echo "[watcher] tunnel healthy $(date -u +%FT%TZ); headline first" >> "$LOG"
+        # Liveness gate: BOTH fallback forms (cached replay AND the
+        # zero-value no-cached-artifact line) exit 1, so rc==0 is the
+        # live-measurement signal; the provenance check in the rewriter
+        # below is a second, belt-and-braces gate.
+        if HL=$(timeout 900 python bench.py 2>> "$LOG"); then
+            if python - "$HL" <<'EOF' >> "$LOG" 2>&1
+import json, sys, datetime
+entry = json.loads(sys.argv[1])
+if entry.get("provenance") == "cached" or not entry.get("value"):
+    raise SystemExit(f"not a live measurement: {entry}")
+entry["provenance"] = "live"
+entry["measured_at"] = datetime.datetime.now(
+    datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+entry["note"] = ("Round-5 live headline captured by ci/tpu_bench_watcher.sh "
+                 "at a healthy tunnel window (headline-first staging).")
+json.dump([entry], open("BENCH_FULL_r05_headline.json", "w"), indent=1)
+EOF
+            then
+                if git add "$HEADLINE_ART" >> "$LOG" 2>&1 \
+                   && git commit -m "Live TPU headline capture: $HEADLINE_ART" \
+                          --only "$HEADLINE_ART" >> "$LOG" 2>&1; then
+                    echo "[watcher] headline captured + committed $(date -u +%FT%TZ)" >> "$LOG"
+                else
+                    # Commit can legitimately no-op (identical re-capture);
+                    # log and continue to the full bench either way.
+                    echo "[watcher] headline commit no-op/failed $(date -u +%FT%TZ)" >> "$LOG"
+                fi
+            else
+                echo "[watcher] headline rewrite rejected $(date -u +%FT%TZ); retrying next cycle" >> "$LOG"
+                sleep 180
+                continue
+            fi
+        else
+            echo "[watcher] headline not live (rc=$?) $(date -u +%FT%TZ); retrying next cycle" >> "$LOG"
+            sleep 180
+            continue
+        fi
+        echo "[watcher] running bench --full" >> "$LOG"
         if timeout 5400 python bench.py --full --artifact "$ART" >> "$LOG" 2>&1; then
-            git add "$ART" 2>> "$LOG"
-            git commit -m "Live TPU bench capture: $ART" --only "$ART" >> "$LOG" 2>&1
-            echo "[watcher] bench captured + committed $(date -u +%FT%TZ)" >> "$LOG"
+            if git add "$ART" >> "$LOG" 2>&1 \
+               && git commit -m "Live TPU bench capture: $ART" --only "$ART" >> "$LOG" 2>&1; then
+                echo "[watcher] bench captured + committed $(date -u +%FT%TZ)" >> "$LOG"
+            else
+                echo "[watcher] full-bench commit no-op/failed $(date -u +%FT%TZ)" >> "$LOG"
+            fi
             exit 0
         else
             echo "[watcher] bench run failed rc=$? $(date -u +%FT%TZ); retrying next cycle" >> "$LOG"

@@ -37,6 +37,10 @@ def main() -> None:
     ap.add_argument("--prompt-cache", action="store_true",
                     help="(--paged) share identical prompts' KV blocks "
                          "and skip their re-prefill")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="(--paged) position-0-anchored admission: share "
+                         "common PREFIX blocks across different-length "
+                         "prompts, prefill only the unmatched tail")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel shards (continuous batching)")
     ap.add_argument("--sp", type=int, default=1,
@@ -48,6 +52,9 @@ def main() -> None:
     args = ap.parse_args()
     if args.int8 and args.int4:
         raise SystemExit("--int8 and --int4 are mutually exclusive")
+    if args.prompt_cache and args.prefix_cache:
+        raise SystemExit("--prompt-cache and --prefix-cache are mutually "
+                         "exclusive (prefix subsumes identical prompts)")
 
     import jax
 
@@ -140,6 +147,7 @@ def main() -> None:
                 block_size=16, prompt_bucket=bucket,
                 key=jax.random.PRNGKey(0), plan=plan, kv_bits=kv_bits,
                 prompt_cache=args.prompt_cache,
+                prefix_cache=args.prefix_cache,
             )
         else:
             k_spec = 4
@@ -165,6 +173,7 @@ def main() -> None:
             num_blocks=args.num_blocks, block_size=16, prompt_bucket=bucket,
             key=jax.random.PRNGKey(0), plan=plan,
             kv_bits=kv_bits, prompt_cache=args.prompt_cache,
+            prefix_cache=args.prefix_cache,
         )
         rids = [pb.submit(p) for p in prompts]
         results = pb.run()

@@ -30,12 +30,22 @@ which is also vLLM's recovery mechanism. Greedy continuations are
 byte-identical after re-prefill; sampled ones resume with a fresh key
 stream (documented, matching vLLM's recompute semantics).
 
+Two opt-in prompt-reuse tiers:
+- ``prompt_cache=True`` — whole-prompt: identical padded prompts share
+  refcounted blocks + cached first logits (left-padded layout kept);
+- ``prefix_cache=True`` — per-block: position-0-ANCHORED admission (no
+  left-padding; token i at logical position i) makes common PREFIXES
+  across different-length prompts content-addressable block-by-block
+  via a vLLM-style chain hash; only the unmatched tail is prefilled,
+  through the block tables (``_paged_prefix_admit``).
+
 No reference counterpart (control plane only); sits with serving/
 continuous/speculative as the in-notebook inference surface.
 """
 
 from __future__ import annotations
 
+import hashlib
 from functools import partial
 from typing import Optional
 
@@ -214,6 +224,21 @@ def _paged_chunk_scan(params, cfg, tokens, pool, tables, kv_mask, cos, sin,
     return jax.lax.scan(body, x, (params["layers"], pool))
 
 
+def _chunk_coords(cfg, tables, posmat, block_size):
+    """Per-token (cos, sin, blks, offs) for a (B, K) chunk decoded at
+    absolute positions ``posmat`` through ``tables`` — the ONE home for
+    the chunk coordinate math (rope batching, block index, offset),
+    shared by the speculative verify and the prefix-admit wrappers so
+    it cannot drift between them."""
+    b, k_len = posmat.shape
+    cos, sin = rope_frequencies(cfg, posmat.reshape(-1))
+    cos = cos.reshape(b, k_len, -1)
+    sin = sin.reshape(b, k_len, -1)
+    blks = jnp.take_along_axis(tables, posmat // block_size, axis=1)
+    offs = posmat % block_size
+    return cos, sin, blks, offs
+
+
 def _gathered_view(pool_l, tables, n_kv_heads, block_size, head_dim):
     """(NB, Hkv, BS[, D])[tables] → logical per-slot view
     (B, Hkv, MAXB·BS[, D]). Shared by the decode step and the speculative
@@ -247,19 +272,55 @@ def _paged_verify(
     query j attends logical slots <= positions[b]+j (chunk causality).
     The paged analog of llama._decode_chunk_batch_impl; returns the
     target's argmax predictions (B, K) + updated pool."""
-    b, k_len = chunk.shape
+    k_len = chunk.shape[1]
     posmat = positions[:, None] + jnp.arange(k_len)[None, :]  # (B, K)
-    cos, sin = rope_frequencies(cfg, posmat.reshape(-1))
-    cos = cos.reshape(b, k_len, -1)
-    sin = sin.reshape(b, k_len, -1)
-    blks = jnp.take_along_axis(tables, posmat // block_size, axis=1)  # (B, K)
-    offs = posmat % block_size
+    cos, sin, blks, offs = _chunk_coords(cfg, tables, posmat, block_size)
     x, new_pool = _paged_chunk_scan(
         params, cfg, chunk, pool, tables, kv_mask, cos, sin, blks, offs,
         posmat, block_size,
     )
     logits = _lm_head_logits(_norm(x, params["final_norm"], cfg), params)
     return jnp.argmax(logits, axis=-1), new_pool  # (B, K)
+
+
+@partial(
+    jax.jit, static_argnames=("cfg", "block_size"), donate_argnums=(3,)
+)
+def _paged_prefix_admit(
+    params: dict,
+    cfg: LlamaConfig,
+    chunk: jax.Array,  # (1, Kp) tail tokens, right-padded to block multiple
+    pool: dict,
+    table: jax.Array,  # (1, MAXB) — the slot's table, prefix blocks filled
+    pos0: jax.Array,  # scalar int32 — first tail position (m * BS)
+    kv_mask: jax.Array,  # (1, MAXB * BS)
+    last_idx: jax.Array,  # scalar int32 — last REAL token's chunk index
+    block_size: int,
+) -> tuple[jax.Array, dict]:
+    """Tail prefill THROUGH the block tables (prefix-cached admission).
+
+    Position-0-anchored layout: the prompt's token i lives at logical
+    position i, so a prefix shared with a cached chain occupies the SAME
+    blocks with the SAME rope rotations regardless of total prompt
+    length. Only the tail past the matched chain is computed — a (1, Kp)
+    chunk decoded at positions ``pos0..pos0+Kp-1`` that attends the
+    shared prefix blocks through the table (the same chunk-causal body
+    the speculative verify uses, so storage format and window semantics
+    cannot diverge).
+
+    Right-padding needs no mask: pad slots sit at positions ``> L-1`` —
+    FUTURE positions, causally invisible to every real query, and decode
+    overwrites each one before any query can reach it (scatter runs
+    before attention in the chunk body). Returns the logits at
+    ``last_idx`` (the real last token) + the updated pool."""
+    posmat = pos0 + jnp.arange(chunk.shape[1])[None, :]  # (1, Kp)
+    cos, sin, blks, offs = _chunk_coords(cfg, table, posmat, block_size)
+    x, new_pool = _paged_chunk_scan(
+        params, cfg, chunk, pool, table, kv_mask, cos, sin, blks, offs,
+        posmat, block_size,
+    )
+    x_last = _norm(x[0, last_idx], params["final_norm"], cfg)
+    return _lm_head_logits(x_last[None], params)[0], new_pool
 
 
 class PagedBatcher(_BatcherBase):
@@ -288,12 +349,20 @@ class PagedBatcher(_BatcherBase):
         kv_bits: int = 0,  # 8 → int8 block pool (halved KV HBM)
         headroom_tokens: int = 0,  # extra per-slot span (speculative rounds)
         prompt_cache: bool = False,  # share identical prompts' blocks
+        prefix_cache: bool = False,  # share common PREFIXES block-by-block
     ):
         self.gen = gen or GenerationConfig()
         if prompt_bucket % block_size:
             raise ValueError(
                 f"prompt_bucket {prompt_bucket} must be a multiple of "
                 f"block_size {block_size}"
+            )
+        if prompt_cache and prefix_cache:
+            raise ValueError(
+                "prompt_cache and prefix_cache are mutually exclusive: "
+                "prefix_cache subsumes whole-prompt sharing (identical "
+                "prompts share all their full blocks) under the "
+                "position-0-anchored layout"
             )
         self.params = params
         self.cfg = cfg
@@ -355,6 +424,19 @@ class PagedBatcher(_BatcherBase):
         self._prompt_cache_enabled = prompt_cache
         self._prompt_cache: dict = {}  # padded-bytes -> {blocks, logits}
         self._shared_refs: dict = {}  # block -> cache ref + active users
+        # Prefix cache (opt-in): position-0-ANCHORED admission (prompts
+        # live unpadded at positions 0..L-1, decode continues at L) makes
+        # a common prefix occupy byte-identical blocks at identical
+        # logical positions across prompts of ANY length — so full prompt
+        # blocks are content-addressed by a vLLM-style chain hash
+        # h_j = H(h_{j-1}, tokens_j) and shared block-by-block. The block
+        # holding the LAST prompt token is never registered (it is the
+        # one decode mutates, and a full-chain hit would otherwise leave
+        # no tail to recompute logits from). Entries form chains; only
+        # chain LEAVES are evictable (a broken chain's tail could never
+        # be matched again).
+        self._prefix_cache_enabled = prefix_cache
+        self._prefix_entries: dict = {}  # chain hash -> block/parent/children
         self._init_base(self.gen, slots, prompt_bucket)
 
     @property
@@ -377,7 +459,7 @@ class PagedBatcher(_BatcherBase):
             # Idle cached prompts are the cheapest capacity: evicting one
             # costs a future re-prefill, preempting a RUNNING request
             # costs a re-prefill NOW plus its lost decode progress.
-            if self._evict_cached_prompt():
+            if self._evict_cached():
                 continue
             if not preempt:
                 return None
@@ -387,6 +469,38 @@ class PagedBatcher(_BatcherBase):
             self._preempt(victim)
         taken, self._free = self._free[:n], self._free[n:]
         return taken
+
+    def _evict_cached(self) -> bool:
+        """Free one cache-held block set, whichever cache is on."""
+        if self._prompt_cache_enabled:
+            return self._evict_cached_prompt()
+        if self._prefix_cache_enabled:
+            return self._evict_prefix_leaf()
+        return False
+
+    def _evict_prefix_leaf(self) -> bool:
+        """Drop one prefix-chain LEAF no active request references
+        (refcount 1 — the cache's own hold), returning its block.
+        Leaf-only: evicting a middle link would orphan the chain's tail
+        (matching walks parent→child). Insertion order ≈ LRU (hits
+        re-append their matched chain)."""
+        for key, ent in self._prefix_entries.items():
+            if (ent["children"] == 0
+                    and self._shared_refs.get(ent["block"], 0) == 1):
+                del self._prefix_entries[key]
+                del self._shared_refs[ent["block"]]
+                self._free.append(ent["block"])
+                if ent["parent"] is not None:
+                    self._prefix_entries[ent["parent"]]["children"] -= 1
+                return True
+        return False
+
+    @staticmethod
+    def _chain_key(parent: Optional[bytes], tokens) -> bytes:
+        """Content address of one full block GIVEN its prefix chain."""
+        h = hashlib.sha1(b"root" if parent is None else parent)
+        h.update(np.asarray(tokens, np.int32).tobytes())
+        return h.digest()
 
     def _evict_cached_prompt(self) -> bool:
         """Drop one cached prompt no active request references (every
@@ -400,6 +514,39 @@ class PagedBatcher(_BatcherBase):
                     self._free.append(b)
                 return True
         return False
+
+    def _reserve_take(self, need: int) -> Optional[list[int]]:
+        """Watermark-guarded admission allocation (vLLM's admission
+        reserve, shared by both admission layouts): keep one free block
+        per RUNNING request on top of the admit cost — otherwise
+        admission grabs exactly the blocks running slots need at their
+        next boundary and the decode path immediately evicts the fresh
+        admit (one-step-removed thrash). Cached prompts yield first;
+        never preempts. None = stall (or pool-too-small if idle —
+        caller distinguishes)."""
+        reserve = sum(1 for r in self._by_slot if r is not None)
+        while len(self._free) < need + reserve and self._evict_cached():
+            pass
+        if len(self._free) < need + reserve:
+            return None
+        return self._take_blocks(need, preempt=False)
+
+    def _finish_admit(self, slot: int, req: _Request, logits,
+                      draft_tokens, draft_mask) -> None:
+        """Shared admission tail: sample the first token off the
+        admission logits, install the request, prime any lockstep draft
+        cache (_post_admit), and feed the token through retirement."""
+        self.key, sub = jax.random.split(self.key)
+        first = int(
+            sample_logits(
+                logits[None], sub, self.gen.temperature, self.gen.top_k,
+                self.gen.top_p,
+            )[0]
+        )
+        req.budget = self.gen.max_new_tokens - len(req.tokens)
+        self._by_slot[slot] = req
+        self._post_admit(slot, draft_tokens, draft_mask)
+        self._note_token(slot, first)
 
     def _youngest_active(self) -> Optional[int]:
         slots = [
@@ -440,6 +587,9 @@ class PagedBatcher(_BatcherBase):
     # -- internals ---------------------------------------------------------
 
     def _admit_free_slots(self) -> None:
+        if self._prefix_cache_enabled:
+            self._admit_free_slots_prefix()
+            return
         for slot in range(self.slots):
             if self._by_slot[slot] is not None:
                 continue
@@ -484,19 +634,7 @@ class PagedBatcher(_BatcherBase):
                     blocks = list(cache_hit["blocks"])
                     break
                 need = bucket // self.block_size
-                # Watermark (vLLM's admission reserve): keep one free block
-                # per RUNNING request on top of the admit cost — otherwise
-                # admission grabs exactly the blocks running slots need at
-                # their next boundary and the decode path immediately
-                # evicts the fresh admit (one-step-removed thrash).
-                reserve = sum(1 for r in self._by_slot if r is not None)
-                while (len(self._free) < need + reserve
-                       and self._evict_cached_prompt()):
-                    pass  # cached prompts yield before admission stalls
-                blocks = (
-                    self._take_blocks(need, preempt=False)
-                    if len(self._free) >= need + reserve else None
-                )
+                blocks = self._reserve_take(need)
                 if blocks is None:
                     if not any(r is not None for r in self._by_slot):
                         # Nothing running to wait on and still short: the
@@ -540,13 +678,6 @@ class PagedBatcher(_BatcherBase):
                             self._shared_refs.get(blk, 0) + 2
                         )
                     shared = frozenset(blocks)
-            self.key, sub = jax.random.split(self.key)
-            first = int(
-                sample_logits(
-                    logits[None], sub, self.gen.temperature, self.gen.top_k,
-                    self.gen.top_p,
-                )[0]
-            )
             self.tables[slot] = 0  # stale entries never alias freed blocks
             self.tables[slot, :len(blocks)] = blocks
             self.positions[slot] = bucket
@@ -558,12 +689,118 @@ class PagedBatcher(_BatcherBase):
             row = np.ones((self.max_blocks * self.block_size,), bool)
             row[:bucket] = np.asarray(mask)[0]
             self.kv_mask = self.kv_mask.at[slot].set(jnp.asarray(row))
-            req = _Request(req.rid, req.prompt, generated, blocks=blocks,
-                           shared=shared)
-            req.budget = self.gen.max_new_tokens - len(generated)
-            self._by_slot[slot] = req
-            self._post_admit(slot, jnp.asarray(padded), prompt_mask)
-            self._note_token(slot, first)
+            self._finish_admit(
+                slot,
+                _Request(req.rid, req.prompt, generated, blocks=blocks,
+                         shared=shared),
+                logits, jnp.asarray(padded), prompt_mask,
+            )
+
+    def _admit_free_slots_prefix(self) -> None:
+        """Admission under the position-0-anchored layout (prefix_cache):
+        match the longest cached block chain, allocate only the tail,
+        prefill the tail THROUGH the table, register fresh full blocks.
+
+        Anchoring removes padding entirely — token i sits at logical
+        position i, decode continues at position L — so the kv_mask row
+        is simply all-True (pad slots would be future positions, which
+        causality already hides; see _paged_prefix_admit)."""
+        bs = self.block_size
+        for slot in range(self.slots):
+            if self._by_slot[slot] is not None:
+                continue
+            while self._queue:
+                head = self._queue[0]
+                effective = head.prompt + head.tokens
+                lng = len(effective)
+                nblocks = -(-lng // bs)
+                # Longest cached chain over FULL blocks, excluding the
+                # last token's block (kept mutable + recomputable).
+                registrable = (lng - 1) // bs
+                keys: list[bytes] = []
+                shared_blocks: list[int] = []
+                parent: Optional[bytes] = None
+                for j in range(registrable):
+                    key = self._chain_key(
+                        parent, effective[j * bs:(j + 1) * bs]
+                    )
+                    ent = self._prefix_entries.get(key)
+                    if ent is None:
+                        break
+                    keys.append(key)
+                    shared_blocks.append(ent["block"])
+                    parent = key
+                m = len(shared_blocks)
+                # Pin the matched chain before eviction can run: its
+                # blocks are refcount>=2 from here, so the eviction loop
+                # below (and any later decode-path eviction) cannot take
+                # them out from under this admission.
+                for blk in shared_blocks:
+                    self._shared_refs[blk] += 1
+                for key in keys:  # hit refreshes recency (LRU-ish order)
+                    self._prefix_entries[key] = self._prefix_entries.pop(key)
+                need = nblocks - m
+                blocks = self._reserve_take(need)
+                if blocks is not None:
+                    break
+                for blk in shared_blocks:  # un-pin; admission stalled
+                    self._shared_refs[blk] -= 1
+                if not any(r is not None for r in self._by_slot):
+                    raise RuntimeError(
+                        f"block pool too small: {need} blocks needed for "
+                        f"a {lng}-token prompt ({m} matched cached), pool "
+                        f"has {self.num_blocks - 1} usable; raise "
+                        "num_blocks"
+                    )
+                return  # pool busy; retry after in-flight slots retire
+            else:
+                continue  # queue drained for this slot
+            req = self._queue.pop(0)
+            generated = list(req.tokens)
+            all_blocks = shared_blocks + blocks
+            self.tables[slot] = 0  # stale entries never alias freed blocks
+            self.tables[slot, :len(all_blocks)] = all_blocks
+            self.positions[slot] = lng
+            self.kv_mask = self.kv_mask.at[slot].set(True)
+            # Tail tokens right-padded to the owned blocks' span; every
+            # pad write lands at a future position inside an OWNED block.
+            start = m * bs
+            chunk = np.full((1, (nblocks - m) * bs), self.gen.pad_id,
+                            np.int32)
+            chunk[0, :lng - start] = effective[start:]
+            logits, self.pool = _paged_prefix_admit(
+                self.params, self.cfg, jnp.asarray(chunk), self.pool,
+                jnp.asarray(self.tables[slot:slot + 1]),
+                jnp.asarray(start, jnp.int32),
+                jnp.ones((1, self.max_blocks * bs), bool),
+                jnp.asarray(lng - 1 - start, jnp.int32), bs,
+            )
+            # Register the NEW full blocks onto the chain (content-
+            # addressed, so continuations' generated tokens are as
+            # shareable as prompt text): cache ref + this request's ref.
+            for j in range(m, registrable):
+                key = self._chain_key(parent,
+                                      effective[j * bs:(j + 1) * bs])
+                self._prefix_entries[key] = {
+                    "block": all_blocks[j], "parent": parent, "children": 0,
+                }
+                if parent is not None:
+                    self._prefix_entries[parent]["children"] += 1
+                self._shared_refs[all_blocks[j]] = 2
+                parent = key
+            # The spec draft primes right-anchored too: the full prompt
+            # at positions 0..L-1, no mask (anchored padding is causally
+            # invisible — same argument as the tail chunk above).
+            bucket = max(self.prompt_bucket, nblocks * bs)
+            dpad = np.full((1, bucket), self.gen.pad_id, np.int32)
+            dpad[0, :lng] = effective
+            self._finish_admit(
+                slot,
+                _Request(req.rid, req.prompt, generated,
+                         blocks=all_blocks,
+                         shared=frozenset(all_blocks[:registrable])),
+                logits, jnp.asarray(dpad), None,
+            )
 
     def _ensure_step_blocks(self, span: int = 1) -> list[int]:
         """Every active slot whose next ``span`` writes reach an

@@ -426,6 +426,7 @@ class SpeculativePagedBatcher(_SpecServingBase):
         kv_bits: int = 0,  # 8 → int8 pool AND draft cache
         headroom_tokens: int = 0,  # extra table span beyond k_spec+1
         prompt_cache: bool = False,  # share identical prompts' TARGET blocks
+        prefix_cache: bool = False,  # share common-prefix TARGET blocks
     ):
         from kubeflow_tpu.models.paged import PagedBatcher
         from kubeflow_tpu.models.serving import GenerationConfig
@@ -442,9 +443,11 @@ class SpeculativePagedBatcher(_SpecServingBase):
             # max_blocks (and so every compiled shape) constant across
             # configs with different max_new_tokens.
             headroom_tokens=k_spec + 1 + headroom_tokens,
-            # A hit skips only the TARGET prefill; the dense draft cache
-            # is per-slot state and re-prefills through _post_admit.
+            # A hit skips only the TARGET prefill (whole-prompt or
+            # per-block prefix); the dense draft cache is per-slot state
+            # and re-prefills through _post_admit.
             prompt_cache=prompt_cache,
+            prefix_cache=prefix_cache,
         )
         # Dense draft cache spanning the pool's logical window (bucket
         # overhang on preempted continuations included — max_blocks

@@ -58,7 +58,8 @@ def test_finetune_lora_runs_and_exports(tmp_path):
               ("--paged", "--kv8"), ("--kv8", "--tp", "2", "--sp", "2"),
               ("--paged", "--kv8", "--tp", "2"), ("--speculative", "1"),
               ("--speculative", "1", "--paged", "--kv8"),
-              ("--paged", "--prompt-cache")]
+              ("--paged", "--prompt-cache"), ("--paged", "--prefix-cache"),
+              ("--speculative", "1", "--paged", "--prefix-cache")]
 )
 def test_serve_batched_runs(extra):
     res = _run("serve_batched.py", "--max-new-tokens", "4", *extra)

@@ -306,3 +306,175 @@ class TestPromptCache:
         out = pb.run()
         assert out[r1] == out[r2] == out[r3]
         assert len(pb._prompt_cache) == 1
+
+
+class TestPrefixCache:
+    """Prefix-granular sharing (prefix_cache=True): position-0-anchored
+    admission makes a common prefix occupy identical blocks at identical
+    logical positions regardless of total prompt length, so full prompt
+    blocks are shared block-by-block via content-addressed chain hashes
+    and only the unmatched tail is prefilled (through the tables)."""
+
+    def _pb(self, params, cfg, num_blocks=32, max_new=6, slots=2,
+            prompt_bucket=16, **kw):
+        gen = GenerationConfig(max_new_tokens=max_new, eos_id=-1)
+        return PagedBatcher(params, cfg, gen=gen, slots=slots,
+                            num_blocks=num_blocks, block_size=8,
+                            prompt_bucket=prompt_bucket, prefix_cache=True,
+                            **kw)
+
+    def test_mutually_exclusive_with_prompt_cache(self, tiny):
+        cfg, params = tiny
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            PagedBatcher(params, cfg, slots=1, num_blocks=16, block_size=8,
+                         prompt_bucket=16, prompt_cache=True,
+                         prefix_cache=True)
+
+    def test_anchored_layout_stays_on_greedy_path(self, tiny):
+        """Anchoring alone (no cache interplay: disjoint prompts) must
+        preserve outputs — token i at position i is exactly the layout
+        of the unpadded reference forward."""
+        cfg, params = tiny
+        pb = self._pb(params, cfg, slots=3)
+        prompts = _prompts(cfg, 5)
+        rids = [pb.submit(p) for p in prompts]
+        results = pb.run()
+        for rid, prompt in zip(rids, prompts):
+            assert len(results[rid]) == 6
+            _assert_greedy_consistent(params, cfg, prompt, results[rid])
+
+    def test_common_prefix_shares_blocks_across_lengths(self, tiny):
+        """THE case prompt_cache cannot serve: same 8-token prefix,
+        different tails AND different total lengths. The second admission
+        must match one full block and prefill only its tail."""
+        cfg, params = tiny
+        import kubeflow_tpu.models.paged as paged_mod
+
+        prefix = [5, 9, 17, 33, 41, 2, 77, 13]  # exactly one block (BS=8)
+        a = prefix + [3, 8]           # 10 tokens
+        b = prefix + [60, 4, 29, 7, 90]  # 13 tokens
+        widths = []
+        real = paged_mod._paged_prefix_admit
+
+        def recording(params_, cfg_, chunk, *rest, **kw):
+            widths.append(int(chunk.shape[1]))
+            return real(params_, cfg_, chunk, *rest, **kw)
+
+        paged_mod._paged_prefix_admit = recording
+        try:
+            pb = self._pb(params, cfg, slots=1)
+            ra, rb = pb.submit(a), pb.submit(b)
+            out = pb.run()
+        finally:
+            paged_mod._paged_prefix_admit = real
+        # a: no match -> 2 blocks (16); b: prefix block matched -> only
+        # the 5-token tail's block (8).
+        assert widths == [16, 8]
+        _assert_greedy_consistent(params, cfg, a, out[ra])
+        _assert_greedy_consistent(params, cfg, b, out[rb])
+
+    def test_chain_hash_rejects_same_block_different_prefix(self, tiny):
+        """Block 1's TOKENS matching is not enough — its chain (block 0)
+        differs, so nothing may be shared (KV depends on all prior
+        positions through attention)."""
+        cfg, params = tiny
+        import kubeflow_tpu.models.paged as paged_mod
+
+        common_second = [7, 7, 7, 7, 6, 6, 6, 6]
+        a = [1] * 8 + common_second + [5]
+        b = [2] * 8 + common_second + [5]
+        widths = []
+        real = paged_mod._paged_prefix_admit
+
+        def recording(params_, cfg_, chunk, *rest, **kw):
+            widths.append(int(chunk.shape[1]))
+            return real(params_, cfg_, chunk, *rest, **kw)
+
+        paged_mod._paged_prefix_admit = recording
+        try:
+            pb = self._pb(params, cfg, slots=1, num_blocks=32,
+                          prompt_bucket=24)
+            ra, rb = pb.submit(a), pb.submit(b)
+            out = pb.run()
+        finally:
+            paged_mod._paged_prefix_admit = real
+        assert widths == [24, 24]  # full prefill both times: zero match
+        _assert_greedy_consistent(params, cfg, a, out[ra])
+        _assert_greedy_consistent(params, cfg, b, out[rb])
+
+    def test_identical_prompts_share_all_full_blocks(self, tiny):
+        """prefix_cache subsumes the identical-prompt case: every full
+        block short of the last token's is matched; outputs identical."""
+        cfg, params = tiny
+        prompt = [5, 9, 17, 33, 41, 2, 77, 13, 8, 1, 22, 4, 19, 3, 55, 6,
+                  31]  # 17 tokens: 2 registrable blocks + 1-token tail
+        pb = self._pb(params, cfg, slots=1, prompt_bucket=24)
+        r1 = pb.submit(prompt)
+        first = pb.run()[r1]
+        assert len(pb._prefix_entries) == 2
+        r2 = pb.submit(prompt)
+        second = pb.run()[r2]
+        assert first == second
+        assert len(pb._prefix_entries) == 2  # matched, not re-registered
+
+    def test_cache_survives_user_release_and_refcounts(self, tiny):
+        cfg, params = tiny
+        prompt = [5, 9, 17, 33, 41, 2, 77, 13] + [3, 8]
+        pb = self._pb(params, cfg, slots=2)
+        r1 = pb.submit(prompt)
+        pb.run()
+        (entry,) = pb._prefix_entries.values()
+        # Only the cache's own ref remains after the user retired.
+        assert pb._shared_refs[entry["block"]] == 1
+        # Registered block held by the cache; tail blocks back in _free.
+        assert pb.free_blocks == 31 - 1
+
+    def test_eviction_is_leaf_first(self, tiny):
+        """A chain's middle link must never be evicted while its child
+        is cached (the tail would be unmatchable garbage)."""
+        cfg, params = tiny
+        prompt = list(range(3, 3 + 24)) + [2]  # 25 tokens: 3 registrable
+        pb = self._pb(params, cfg, slots=1, num_blocks=32,
+                      prompt_bucket=32)
+        pb.submit(prompt)
+        pb.run()
+        assert len(pb._prefix_entries) == 3
+        by_block = {e["block"]: e for e in pb._prefix_entries.values()}
+        assert pb._evict_prefix_leaf()
+        remaining = list(pb._prefix_entries.values())
+        assert len(remaining) == 2
+        # The evicted one was the chain's LEAF: both survivors still have
+        # a consistent children count and the root is intact.
+        assert [e["children"] for e in remaining] == [1, 0]
+        assert all(e["block"] in by_block for e in remaining)
+
+    def test_preempted_continuation_rehits_prefix(self, tiny):
+        """Under pool pressure the preempted request's prompt blocks stay
+        cached (refcounted), so its re-admission matches them instead of
+        re-prefilling the whole effective prompt; everyone completes on
+        the greedy path."""
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=8, eos_id=-1)
+        pb = PagedBatcher(params, cfg, gen=gen, slots=3, num_blocks=10,
+                          block_size=8, prompt_bucket=16, prefix_cache=True)
+        prompts = _prompts(cfg, 4, key=11)
+        rids = [pb.submit(p) for p in prompts]
+        results = pb.run()
+        assert set(results) == set(rids)
+        for rid, prompt in zip(rids, prompts):
+            assert len(results[rid]) == 8
+            _assert_greedy_consistent(params, cfg, prompt, results[rid])
+
+    def test_prefix_cache_over_int8_pool(self, tiny):
+        """Shared prefix blocks are QUANTIZED blocks (scale leaves ride
+        the same tables); hit and miss streams agree."""
+        cfg, params = tiny
+        prefix = [5, 9, 17, 33, 41, 2, 77, 13]
+        a, b = prefix + [3, 8], prefix + [60, 4, 29]
+        pb = self._pb(params, cfg, slots=1, kv_bits=8)
+        ra, rb = pb.submit(a), pb.submit(b)
+        out = pb.run()
+        base = self._pb(params, cfg, slots=1, kv_bits=8)
+        rb2 = base.submit(b)
+        assert out[rb] == base.run()[rb2]  # hit stream == miss stream
+        assert len(out[ra]) == 6

@@ -478,6 +478,38 @@ class TestSpecPagedPromptCache:
         assert len(sb._pb._prompt_cache) == 1
 
 
+class TestSpecPagedPrefixCache:
+    def test_common_prefix_shares_target_blocks(self, target, draft):
+        """prefix_cache composes with speculative paged serving: the
+        position-0-anchored target pool shares common-PREFIX blocks
+        across different-length prompts while the dense draft cache
+        primes right-anchored per slot; outputs stay on each prompt's
+        greedy path (verified against the no-cache spec engine)."""
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.models.speculative import SpeculativePagedBatcher
+
+        tcfg, tparams = target
+        dcfg, dparams = draft
+        gen = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        prefix = [5, 9, 17, 33, 41, 2, 77, 13]  # one full block (BS=8)
+        prompts = [prefix + [3, 8], prefix + [60, 4, 29, 7, 90]]
+
+        def run(**kw):
+            sb = SpeculativePagedBatcher(
+                tparams, tcfg, dparams, dcfg, gen=gen, slots=2,
+                num_blocks=40, block_size=8, prompt_bucket=16, k_spec=3,
+                **kw,
+            )
+            rids = [sb.submit(p) for p in prompts]
+            out = sb.run()
+            return [out[r] for r in rids], sb
+
+        want, _ = run()
+        got, sb = run(prefix_cache=True)
+        assert got == want
+        assert len(sb._pb._prefix_entries) >= 1  # the prefix block cached
+
+
 class TestSpecPagedMultiBlockSpan:
     def test_verify_chunk_wider_than_block(self, target, draft):
         """k_spec+1 > block_size: one verify round spans MULTIPLE new
