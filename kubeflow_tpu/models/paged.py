@@ -350,12 +350,23 @@ class PagedBatcher(_BatcherBase):
         headroom_tokens: int = 0,  # extra per-slot span (speculative rounds)
         prompt_cache: bool = False,  # share identical prompts' blocks
         prefix_cache: bool = False,  # share common PREFIXES block-by-block
+        admit_chunk: Optional[int] = None,  # prefix-admission piece width
     ):
         self.gen = gen or GenerationConfig()
         if prompt_bucket % block_size:
             raise ValueError(
                 f"prompt_bucket {prompt_bucket} must be a multiple of "
                 f"block_size {block_size}"
+            )
+        if admit_chunk is None:
+            # ~256 tokens, rounded up to a block multiple so the default
+            # is valid for ANY block_size (admit_chunk only matters on
+            # the prefix_cache admission path).
+            admit_chunk = max(block_size, -(-256 // block_size) * block_size)
+        elif prefix_cache and (admit_chunk % block_size or admit_chunk <= 0):
+            raise ValueError(
+                f"admit_chunk {admit_chunk} must be a positive multiple "
+                f"of block_size {block_size}"
             )
         if prompt_cache and prefix_cache:
             raise ValueError(
@@ -437,6 +448,7 @@ class PagedBatcher(_BatcherBase):
         # be matched again).
         self._prefix_cache_enabled = prefix_cache
         self._prefix_entries: dict = {}  # chain hash -> block/parent/children
+        self.admit_chunk = admit_chunk
         self._init_base(self.gen, slots, prompt_bucket)
 
     @property
@@ -765,16 +777,32 @@ class PagedBatcher(_BatcherBase):
             # Tail tokens right-padded to the owned blocks' span; every
             # pad write lands at a future position inside an OWNED block.
             start = m * bs
-            chunk = np.full((1, (nblocks - m) * bs), self.gen.pad_id,
-                            np.int32)
+            padded_len = (nblocks - m) * bs
+            chunk = np.full((1, padded_len), self.gen.pad_id, np.int32)
             chunk[0, :lng - start] = effective[start:]
-            logits, self.pool = _paged_prefix_admit(
-                self.params, self.cfg, jnp.asarray(chunk), self.pool,
-                jnp.asarray(self.tables[slot:slot + 1]),
-                jnp.asarray(start, jnp.int32),
-                jnp.ones((1, self.max_blocks * bs), bool),
-                jnp.asarray(lng - 1 - start, jnp.int32), bs,
-            )
+            # Fixed-width pieces (the paged analog of prefill_chunked):
+            # admission compiles O(1) programs regardless of prompt
+            # length — every piece is admit_chunk wide except the final
+            # remainder (a block multiple < admit_chunk, so at most
+            # admit_chunk/BS distinct widths ever compile) — and score
+            # memory is bounded at O(admit_chunk · span) instead of
+            # O(tail · span). The final piece always holds the last real
+            # token (right-padding is < one block), so only its logits
+            # survive; earlier pieces' last_idx is clamped in-range and
+            # their logits row discarded.
+            off = 0
+            while off < padded_len:
+                width = min(self.admit_chunk, padded_len - off)
+                last_idx = min(max(lng - 1 - start - off, 0), width - 1)
+                logits, self.pool = _paged_prefix_admit(
+                    self.params, self.cfg,
+                    jnp.asarray(chunk[:, off:off + width]), self.pool,
+                    jnp.asarray(self.tables[slot:slot + 1]),
+                    jnp.asarray(start + off, jnp.int32),
+                    jnp.ones((1, self.max_blocks * bs), bool),
+                    jnp.asarray(last_idx, jnp.int32), bs,
+                )
+                off += width
             # Register the NEW full blocks onto the chain (content-
             # addressed, so continuations' generated tokens are as
             # shareable as prompt text): cache ref + this request's ref.

@@ -427,6 +427,7 @@ class SpeculativePagedBatcher(_SpecServingBase):
         headroom_tokens: int = 0,  # extra table span beyond k_spec+1
         prompt_cache: bool = False,  # share identical prompts' TARGET blocks
         prefix_cache: bool = False,  # share common-prefix TARGET blocks
+        admit_chunk=None,  # prefix-admission piece width (PagedBatcher)
     ):
         from kubeflow_tpu.models.paged import PagedBatcher
         from kubeflow_tpu.models.serving import GenerationConfig
@@ -448,6 +449,7 @@ class SpeculativePagedBatcher(_SpecServingBase):
             # and re-prefills through _post_admit.
             prompt_cache=prompt_cache,
             prefix_cache=prefix_cache,
+            admit_chunk=admit_chunk,
         )
         # Dense draft cache spanning the pool's logical window (bucket
         # overhang on preempted continuations included — max_blocks

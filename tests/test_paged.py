@@ -478,3 +478,52 @@ class TestPrefixCache:
         rb2 = base.submit(b)
         assert out[rb] == base.run()[rb2]  # hit stream == miss stream
         assert len(out[ra]) == 6
+
+    def test_long_prompt_admits_in_fixed_pieces(self, tiny):
+        """A tail longer than admit_chunk prefills in fixed-width pieces
+        (compile- and memory-bounded, the paged analog of
+        prefill_chunked) and stays on the greedy path; a prefix hit
+        shortens the piece walk to the remainder."""
+        cfg, params = tiny
+        import kubeflow_tpu.models.paged as paged_mod
+
+        prompt = [int(t) % 200 + 3 for t in range(40)]  # 5 blocks (BS=8)
+        longer = prompt[:32] + [9, 9, 9]  # shares 4 full blocks
+        widths = []
+        real = paged_mod._paged_prefix_admit
+
+        def recording(params_, cfg_, chunk, *rest, **kw):
+            widths.append(int(chunk.shape[1]))
+            return real(params_, cfg_, chunk, *rest, **kw)
+
+        paged_mod._paged_prefix_admit = recording
+        try:
+            pb = self._pb(params, cfg, slots=1, num_blocks=32,
+                          prompt_bucket=48, admit_chunk=16)
+            r1 = pb.submit(prompt)
+            out1 = pb.run()[r1]
+            r2 = pb.submit(longer)
+            out2 = pb.run()[r2]
+        finally:
+            paged_mod._paged_prefix_admit = real
+        assert widths[:3] == [16, 16, 8]  # 40-token miss: 16+16+8
+        assert widths[3:] == [8]  # 4 blocks matched; only the remainder
+        _assert_greedy_consistent(params, cfg, prompt, out1)
+        _assert_greedy_consistent(params, cfg, longer, out2)
+
+    def test_bad_admit_chunk_rejected(self, tiny):
+        cfg, params = tiny
+        with pytest.raises(ValueError, match="admit_chunk"):
+            self._pb(params, cfg, admit_chunk=12)  # not a block multiple
+
+    def test_admit_chunk_default_valid_for_any_block_size(self, tiny):
+        """The default admit_chunk rounds itself to a block multiple, so
+        configs whose block_size does not divide 256 still construct —
+        with and without the prefix cache."""
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=4, eos_id=-1)
+        for prefix in (False, True):
+            pb = PagedBatcher(params, cfg, gen=gen, slots=1, num_blocks=6,
+                              block_size=96, prompt_bucket=96,
+                              prefix_cache=prefix)
+            assert pb.admit_chunk % 96 == 0
