@@ -24,6 +24,7 @@ from typing import Optional
 from kubeflow_tpu.controller.culling import CullerConfig, CullingReconciler
 from kubeflow_tpu.controller.notebook import ControllerConfig, NotebookReconciler
 from kubeflow_tpu.controller.preemption import SliceHealthReconciler
+from kubeflow_tpu.controller.prepull import PrePullConfig, PrePullReconciler
 from kubeflow_tpu.controller.slicepool import SlicePoolReconciler
 from kubeflow_tpu.k8s.client import Client
 from kubeflow_tpu.k8s.health import HealthChecks, HealthServer, ping
@@ -73,6 +74,7 @@ class ManagerBundle:
     culling_reconciler: Optional[CullingReconciler]
     preemption_reconciler: SliceHealthReconciler
     slicepool_reconciler: Optional[SlicePoolReconciler] = None
+    prepull_reconciler: Optional["PrePullReconciler"] = None
     elector: Optional[LeaderElector] = None
     extra: dict = field(default_factory=dict)
 
@@ -119,6 +121,17 @@ def build(
     pools = SlicePoolReconciler(cluster, metrics=metrics, clock=manager.clock)
     pools.register(manager)
 
+    # Gate style as culling (reference main.go:111-123), but the
+    # reconciler ALWAYS registers: when the gate is off it reconciles an
+    # empty desired set, so pods created by a previously-enabled run are
+    # GC'd instead of orphaned (they carry no ownerReferences).
+    prepull = PrePullReconciler(
+        cluster, config=PrePullConfig.from_env(env), metrics=metrics,
+        clock=manager.clock,
+        enabled=env.get("ENABLE_IMAGE_PREPULL", "").lower() == "true",
+    )
+    prepull.register(manager)
+
     culler: Optional[CullingReconciler] = None
     culler_cfg = CullerConfig.from_env(env)
     # Reference main.go:111-123: culling controller only exists when enabled.
@@ -163,6 +176,7 @@ def build(
         culling_reconciler=culler,
         preemption_reconciler=preemption,
         slicepool_reconciler=pools,
+        prepull_reconciler=prepull,
         elector=elector,
     )
 

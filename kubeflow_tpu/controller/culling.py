@@ -53,12 +53,7 @@ def _fmt(ts: float) -> str:
 
 
 def _parse(ts: str) -> Optional[float]:
-    try:
-        import calendar
-
-        return float(calendar.timegm(time.strptime(ts, TIME_FORMAT)))
-    except (ValueError, TypeError):
-        return None
+    return obj_util.parse_timestamp(ts)
 
 
 @dataclass

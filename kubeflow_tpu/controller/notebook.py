@@ -19,7 +19,6 @@ key generalization from SURVEY.md §7 step 2: a notebook is N pods, not 1.
 
 from __future__ import annotations
 
-import calendar
 import copy
 import logging
 import time
@@ -620,9 +619,8 @@ class NotebookReconciler(Reconciler):
             return
         self._ready_observed.add(key)
         created = nb.obj.get("metadata", {}).get("creationTimestamp", "")
-        try:
-            created_s = calendar.timegm(time.strptime(created, "%Y-%m-%dT%H:%M:%SZ"))
-        except (ValueError, OverflowError):
+        created_s = obj_util.parse_timestamp(created)
+        if created_s is None:
             return
         elapsed = max(0.0, self.clock() - created_s)
         self.metrics.slice_ready_seconds.observe(elapsed)

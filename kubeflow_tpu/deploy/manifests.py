@@ -316,7 +316,14 @@ def sample_slicepool() -> dict:
 
 
 def _rule(api_groups, resources, verbs):
-    return {"apiGroups": api_groups, "resources": resources, "verbs": verbs}
+    # Copies, not references: shared verb lists (_READ) must not alias
+    # across rules — aliasing emits YAML anchors into the rendered RBAC
+    # and lets a mutation of one rule's verbs corrupt every other.
+    return {
+        "apiGroups": list(api_groups),
+        "resources": list(resources),
+        "verbs": list(verbs),
+    }
 
 
 _ALL = ["create", "delete", "get", "list", "patch", "update", "watch"]
@@ -347,9 +354,14 @@ def core_cluster_role() -> dict:
             # VirtualService per notebook (reference role.yaml
             # networking.istio.io rule).
             _rule(["networking.istio.io"], ["virtualservices"], _ALL),
-            _rule([""], ["pods"], _READ + ["delete"]),
+            # "create": the ENABLE_IMAGE_PREPULL controller maintains
+            # node-pinned pre-pull pods (controller/prepull.py); delete
+            # also serves failed-slice pod recreation.
+            _rule([""], ["pods"], _READ + ["create", "delete"]),
             _rule([""], ["events"], _READ + ["create", "patch"]),
             _rule([""], ["nodes"], _READ),
+            # Pre-pull image set source (notebook-prepull-images).
+            _rule([""], ["configmaps"], _READ),
             _rule(["coordination.k8s.io"], ["leases"], _ALL),
         ],
     }
@@ -426,6 +438,10 @@ def culler_config_map() -> dict:
             "CULL_IDLE_TIME": "1440",
             "IDLENESS_CHECK_PERIOD": "1",
             "CLUSTER_DOMAIN": "cluster.local",
+            # Dynamic per-TPU-node image pre-pull (controller/prepull.py);
+            # the static image_prepuller_daemonset sample is the
+            # controller-less alternative.
+            "ENABLE_IMAGE_PREPULL": "false",
         },
     }
 
@@ -643,34 +659,21 @@ def image_prepuller_daemonset(images=DEFAULT_PREPULL_IMAGES) -> dict:
     time blow it on cold nodes. Each image runs as an initContainer that
     exits immediately; the pause main container keeps the pod (and the
     cached image layers) resident. Targets any node carrying the GKE TPU
-    accelerator label via an Exists affinity."""
+    accelerator label via an Exists affinity.
+
+    This is the STATIC sample (fixed image list, applied by the
+    operator). ``ENABLE_IMAGE_PREPULL=true`` on the core manager runs
+    the dynamic counterpart instead (controller/prepull.py): image set
+    from the notebook-prepull-images ConfigMap UNION live TPU notebooks,
+    rolled on change, failed pulls retried with backoff."""
     # A prepull container must exit 0 no matter what the target image
     # contains — distroless/scratch images ship NO binaries at all. The
     # standard warm-puller recipe: copy a static no-op binary out of
     # busybox into an emptyDir first, then run THAT from every target
     # image's filesystem.
-    tools_mount = {"name": "prepull-tools", "mountPath": "/prepull-tools"}
-    init = [
-        {
-            "name": "copy-busybox",
-            "image": "busybox:1.36",
-            # busybox is a MULTICALL binary dispatching on argv[0]: it must
-            # be copied under its own name and invoked as "busybox sleep",
-            # not renamed (argv[0]="noop" would exit 127 applet-not-found).
-            "command": ["cp", "/bin/busybox", "/prepull-tools/busybox"],
-            "volumeMounts": [tools_mount],
-            "resources": {"limits": {"cpu": "100m", "memory": "64Mi"}},
-        }
-    ] + [
-        {
-            "name": f"prepull-{i}",
-            "image": image,
-            "command": ["/prepull-tools/busybox", "sleep", "0"],
-            "volumeMounts": [tools_mount],
-            "resources": {"limits": {"cpu": "100m", "memory": "64Mi"}},
-        }
-        for i, image in enumerate(images)
-    ]
+    from kubeflow_tpu.controller.prepull import prepull_init_containers
+
+    init = prepull_init_containers(images, name_prefix="prepull")
     return {
         "apiVersion": "apps/v1",
         "kind": "DaemonSet",

@@ -354,3 +354,63 @@ def _pod_tpu_request(pod: dict) -> int:
     for c in pod.get("spec", {}).get("containers", []):
         total += int(c.get("resources", {}).get("limits", {}).get("google.com/tpu", 0) or 0)
     return total
+
+
+class FakePodRunner(Reconciler):
+    """Runs node-pinned, ownerless, run-to-completion pods — the fake
+    analog of a kubelet executing a DaemonSet-style pinned pod (e.g. the
+    image pre-puller's): any Pod with ``spec.nodeName`` already set, no
+    ownerReferences, and ``restartPolicy: Never`` is driven to
+    ``Succeeded`` (image pulls complete instantly in the fake).
+
+    ``fail_images`` lets chaos tests model broken registries: a pod
+    whose spec references one of those images lands ``Failed`` instead
+    (the pre-puller's retry loop is delete + re-create)."""
+
+    def __init__(self, cluster: FakeCluster, fail_images: frozenset = frozenset()):
+        self.cluster = cluster
+        self.fail_images = frozenset(fail_images)
+
+    def register(self, manager: Manager) -> None:
+        manager.register(self, for_kind="Pod", name="FakePodRunner")
+
+    def reconcile(self, req: Request) -> Result:
+        try:
+            pod = self.cluster.get("Pod", req.name, req.namespace)
+        except NotFoundError:
+            return Result()
+        spec = pod.get("spec", {})
+        meta = pod.get("metadata", {})
+        if (
+            not spec.get("nodeName")
+            or meta.get("ownerReferences")
+            or spec.get("restartPolicy") != "Never"
+        ):
+            return Result()
+        if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+            return Result()
+        images = {
+            c.get("image")
+            for c in spec.get("containers", []) + spec.get("initContainers", [])
+        }
+        failed = images & self.fail_images
+        pod["status"] = {
+            "phase": "Failed" if failed else "Succeeded",
+            **(
+                {
+                    "message": f"image pull failed: {sorted(failed)[0]}",
+                    # Failure-time stamp, as a real kubelet records it —
+                    # retry backoffs key off THIS, not creationTimestamp.
+                    "containerStatuses": [{
+                        "name": "done",
+                        "state": {"terminated": {
+                            "exitCode": 1,
+                            "finishedAt": self.cluster._now(),
+                        }},
+                    }],
+                }
+                if failed else {}
+            ),
+        }
+        self.cluster.update_status(pod)
+        return Result()

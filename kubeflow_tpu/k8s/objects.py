@@ -204,3 +204,18 @@ def set_condition(obj: dict, condition: dict, now: Optional[str] = None) -> None
             return
     condition.setdefault("lastTransitionTime", now)
     conds.append(condition)
+
+
+def parse_timestamp(ts) -> "float | None":
+    """RFC3339 apiserver timestamp → epoch seconds, None if unparseable.
+
+    The ONE home for this parse (culling idleness math, spawn-latency
+    metrics, pre-pull retry backoff all consume apiserver timestamps);
+    a format tolerance added here reaches every consumer."""
+    import calendar
+    import time
+
+    try:
+        return float(calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ")))
+    except (ValueError, TypeError, OverflowError):
+        return None

@@ -95,6 +95,16 @@ class Metrics:
             "TPU chips currently held by running notebook slices",
             registry=self.registry,
         )
+        self.prepull_nodes_covered = Gauge(
+            "tpu_prepull_nodes_covered",
+            "TPU nodes whose pre-pull pod Succeeded for the current image set",
+            registry=self.registry,
+        )
+        self.prepull_nodes_target = Gauge(
+            "tpu_prepull_nodes_target",
+            "TPU nodes the image pre-puller is maintaining pods for",
+            registry=self.registry,
+        )
 
     def collect_running(self) -> None:
         """Recompute run-state gauges by listing StatefulSets, as the

@@ -1,0 +1,161 @@
+"""Image pre-pull controller: per-TPU-node coverage, set changes, retry.
+
+TPU-native subsystem with no reference counterpart (the reference's
+spawn path pulls images cold — SURVEY.md §6); this is the cold-node
+counterpart to SlicePool's warm-node image retention (BASELINE.md's
+<90 s p50 spawn budget).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu import k8s
+from kubeflow_tpu.controller.prepull import (
+    PREPULL_LABEL,
+    RETRY_FAILED_AFTER,
+    PrePullConfig,
+    PrePullReconciler,
+    image_set_digest,
+    prepull_pod_name,
+)
+from kubeflow_tpu.k8s.fixtures import FakePodRunner
+
+from tests.harness import make_env, tpu_notebook
+
+NS = "kubeflow"
+
+
+def _prepull_env(fail_images=(), images=("workbench:v1",)):
+    env = make_env()
+    if images:
+        env.cluster.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "notebook-prepull-images", "namespace": NS},
+            "data": {f"img{i}": img for i, img in enumerate(images)},
+        })
+    pre = PrePullReconciler(
+        env.cluster, config=PrePullConfig(namespace=NS),
+        metrics=env.metrics, clock=env.clock,
+    )
+    pre.register(env.manager)
+    FakePodRunner(env.cluster, fail_images=frozenset(fail_images)).register(
+        env.manager
+    )
+    return env, pre
+
+
+def _prepull_pods(env):
+    return [
+        p for p in env.cluster.list("Pod", NS)
+        if PREPULL_LABEL in (p["metadata"].get("labels") or {})
+    ]
+
+
+class TestPrePull:
+    def test_one_succeeded_pod_per_tpu_node(self):
+        env, _ = _prepull_env(images=("workbench:v1", "workbench:v2"))
+        env.manager.run_until_idle()
+        pods = _prepull_pods(env)
+        # Default harness pool: 4 TPU hosts; the CPU node is NOT covered.
+        assert len(pods) == 4
+        tpu_nodes = {
+            n["metadata"]["name"]
+            for n in env.cluster.list("Node")
+            if "cloud.google.com/gke-tpu-accelerator"
+            in (n["metadata"].get("labels") or {})
+        }
+        assert {p["spec"]["nodeName"] for p in pods} == tpu_nodes
+        for p in pods:
+            assert p["status"]["phase"] == "Succeeded"
+            pulled = [c["image"] for c in p["spec"]["initContainers"]
+                      if c["name"].startswith("pull-")]
+            assert pulled == ["workbench:v1", "workbench:v2"]
+            # The distroless-safe recipe: a copied busybox runs in every
+            # target image (deploy.manifests.image_prepuller_daemonset).
+            assert p["spec"]["initContainers"][0]["name"] == "copy-busybox"
+            # Never consumes chip capacity the scheduler could hand out.
+            for c in p["spec"]["containers"] + p["spec"]["initContainers"]:
+                limits = c.get("resources", {}).get("limits", {})
+                assert "google.com/tpu" not in limits
+        assert env.metrics.prepull_nodes_covered._value.get() == 4
+        assert env.metrics.prepull_nodes_target._value.get() == 4
+
+    def test_live_tpu_notebook_images_join_the_set(self):
+        env, _ = _prepull_env(images=("workbench:v1",))
+        env.manager.run_until_idle()
+        env.cluster.create(tpu_notebook(name="nb1"))
+        env.manager.run_until_idle()
+        pods = _prepull_pods(env)
+        assert pods, "pods must exist after the roll"
+        for p in pods:
+            pulled = {c["image"] for c in p["spec"]["initContainers"]
+                      if c["name"].startswith("pull-")}
+            assert pulled == {"workbench:v1", "jax-notebook:latest"}
+            assert p["status"]["phase"] == "Succeeded"
+
+    def test_image_set_change_rolls_pods(self):
+        env, _ = _prepull_env(images=("workbench:v1",))
+        env.manager.run_until_idle()
+        old = {p["metadata"]["name"] for p in _prepull_pods(env)}
+        cm = env.cluster.get("ConfigMap", "notebook-prepull-images", NS)
+        cm["data"]["img0"] = "workbench:v2"
+        env.cluster.update(cm)
+        env.manager.run_until_idle()
+        new = {p["metadata"]["name"] for p in _prepull_pods(env)}
+        assert new and not (new & old)  # full roll, nothing stale left
+        digest = image_set_digest(["workbench:v2"])
+        assert all(name.endswith(digest) for name in new)
+
+    def test_empty_image_set_removes_all_pods(self):
+        env, _ = _prepull_env(images=("workbench:v1",))
+        env.manager.run_until_idle()
+        assert _prepull_pods(env)
+        env.cluster.delete("ConfigMap", "notebook-prepull-images", NS)
+        env.manager.run_until_idle()
+        assert _prepull_pods(env) == []
+
+    def test_failed_pull_backs_off_then_retries(self):
+        env, _ = _prepull_env(
+            images=("broken:ref",), fail_images=("broken:ref",)
+        )
+        env.manager.run_until_idle()
+        pods = _prepull_pods(env)
+        assert pods and all(p["status"]["phase"] == "Failed" for p in pods)
+        first_names = {p["metadata"]["name"] for p in pods}
+        # Within the backoff window the Failed pods are KEPT (no thrash).
+        env.manager.run_until_idle()
+        assert {p["metadata"]["name"] for p in _prepull_pods(env)} == first_names
+        assert env.metrics.prepull_nodes_covered._value.get() == 0
+        # After the window, they are deleted and re-created (fresh pull
+        # attempt — which fails again here, but the attempt happened).
+        env.clock.advance(RETRY_FAILED_AFTER + 1)
+        env.manager.tick(0)
+        env.manager.run_until_idle()
+        again = _prepull_pods(env)
+        assert again and all(p["status"]["phase"] == "Failed" for p in again)
+
+    def test_manager_gate_wires_prepull(self):
+        from kubeflow_tpu.cmd.notebook_manager import build
+
+        cluster = k8s.FakeCluster()
+        on = build(cluster, env={"ENABLE_IMAGE_PREPULL": "true"}, argv=[])
+        assert on.prepull_reconciler is not None
+        assert on.prepull_reconciler.enabled
+        # Off still registers (disabled mode must GC leftovers) but
+        # maintains nothing.
+        off = build(cluster, env={}, argv=[])
+        assert off.prepull_reconciler is not None
+        assert not off.prepull_reconciler.enabled
+
+    def test_disabling_gate_garbage_collects_pods(self):
+        env, _ = _prepull_env(images=("workbench:v1",))
+        env.manager.run_until_idle()
+        assert _prepull_pods(env)
+        # Controller restart with the gate off: same cluster, disabled
+        # reconciler — leftover node-pinned pods must be swept.
+        pre = PrePullReconciler(
+            env.cluster, config=PrePullConfig(namespace=NS),
+            clock=env.clock, enabled=False,
+        )
+        from kubeflow_tpu.k8s.manager import Request
+        pre.reconcile(Request("notebook-prepull-images", NS))
+        assert _prepull_pods(env) == []
