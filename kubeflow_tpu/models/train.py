@@ -156,9 +156,12 @@ def make_train_step(
 
     When the mesh has an sp axis > 1 (``use_ring_sp`` defaults to True
     then), attention runs sequence-parallel using ``sp_impl``:
-    "ring" (K/V rotate via ppermute, overlapped with compute) or
+    "ring" (K/V rotate via ppermute, overlapped with compute),
     "ulysses" (two all_to_alls trade sequence shards for head shards;
-    needs heads-per-tp-shard divisible by sp).
+    needs heads-per-tp-shard divisible by sp), or "zigzag" (balanced
+    causal ring — each device holds a front+back chunk pair, halving
+    the causal ring's wasted FLOPs; causal-only, so incompatible with
+    sliding-window configs).
 
     ``grad_accum`` > 1 splits the batch into that many microbatches and
     accumulates gradients in a lax.scan before ONE optimizer update —
@@ -171,10 +174,12 @@ def make_train_step(
     ``remat`` picks the layer-stack checkpoint policy
     (llama._REMAT_POLICIES: "full" | "dots" | "none").
     """
-    if sp_impl not in ("ring", "ulysses"):
+    if sp_impl not in ("ring", "ulysses", "zigzag"):
         # Validate even when sp ends up inactive: a typo'd sp_impl on an
         # sp=1 mesh must not silently run dense attention.
-        raise ValueError(f"unknown sp_impl {sp_impl!r} (want 'ring'|'ulysses')")
+        raise ValueError(
+            f"unknown sp_impl {sp_impl!r} (want 'ring'|'ulysses'|'zigzag')"
+        )
     optimizer = optimizer or make_optimizer()
     mesh = plan.mesh
     if use_ring_sp is None:
@@ -186,6 +191,12 @@ def make_train_step(
         attn_impl = "auto"
     elif sp_impl == "ring":
         attn_impl = make_sharded_ring_attention(mesh)
+    elif sp_impl == "zigzag":
+        from kubeflow_tpu.parallel.zigzag_attention import (
+            make_sharded_zigzag_attention,
+        )
+
+        attn_impl = make_sharded_zigzag_attention(mesh)
     else:
         attn_impl = make_sharded_ulysses_attention(mesh)
 

@@ -48,6 +48,36 @@ NEG_INF = -1e30
 _RING_BLOCK = 1024
 
 
+def lse_merge(m, l, o, s, v_blk):
+    """One online-softmax (lse) recursion step shared by every SP
+    attention impl here (ring, zigzag): fold the already-masked score
+    block ``s`` and its values into the running (m, l, o)."""
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, o_new
+
+
+def pick_kblock(ck: int) -> int:
+    """Key-width sub-block for the flash-style inner scan: the largest
+    aligned divisor of ``ck`` up to _RING_BLOCK (single block if none)."""
+    blk = next((c for c in (_RING_BLOCK, 512, 256, 128) if ck % c == 0), ck)
+    return min(blk, ck)
+
+
+def safe_finish(m, l, o):
+    """Normalize + safe-softmax: rows with no visible keys output zero
+    instead of normalized garbage (shared convention with
+    ops.attention)."""
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.where((m > NEG_INF * 0.5)[..., None], out, 0.0)
+
+
 def ring_attention(
     q: jax.Array,  # local (B, H, Sq_local, D)
     k: jax.Array,  # local (B, H, Sk_local, D)
@@ -80,11 +110,7 @@ def ring_attention(
     # the materialized score buffer is (B, H, SqL, block), not
     # (B, H, SqL, SkL) — at 32k-context shards the full matrix is GBs. The
     # rematerialized sub-body keeps backward memory at O(block) too.
-    blk = next(
-        (c for c in (_RING_BLOCK, 512, 256, 128) if sk_local % c == 0),
-        sk_local,  # no aligned divisor (tiny/odd shard) → single block
-    )
-    blk = min(blk, sk_local)
+    blk = pick_kblock(sk_local)
     nblk = sk_local // blk
 
     def update(m, l, o, k_blk, v_blk, mask_blk, k_start):
@@ -103,15 +129,7 @@ def ring_attention(
             s = jnp.where(mask[None, None], s, NEG_INF)
         if mask_blk is not None:
             s = jnp.where(mask_blk[:, None, None, :], s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[..., None])
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        o_new = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
-            preferred_element_type=jnp.float32,
-        )
-        return m_new, l_new, o_new
+        return lse_merge(m, l, o, s, v_blk)
 
     def step(carry, step_idx):
         m, l, o, k_cur, v_cur, mask_cur = carry
@@ -151,11 +169,7 @@ def ring_attention(
     (m, l, o, _, _, _), _ = jax.lax.scan(
         step, (m0, l0, o0, k, v, kv_mask), jnp.arange(n)
     )
-    out = o / jnp.maximum(l, 1e-30)[..., None]
-    # Safe softmax (shared convention with ops.attention): rows with no
-    # visible keys output zero instead of normalized garbage.
-    out = jnp.where((m > NEG_INF * 0.5)[..., None], out, 0.0)
-    return out.astype(q.dtype)
+    return safe_finish(m, l, o).astype(q.dtype)
 
 
 def sp_decode_attention(
