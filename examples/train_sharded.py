@@ -26,7 +26,11 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--ckpt-dir", default=None)
-    ap.add_argument("--sp-impl", default="ring", choices=["ring", "ulysses"])
+    ap.add_argument("--sp-impl", default="ring",
+                    choices=["ring", "ulysses", "zigzag"])
+    ap.add_argument("--data", default=None,
+                    help="uint32 token corpus (data.write_token_file "
+                         "format); omitted = synthetic random tokens")
     args = ap.parse_args()
 
     import jax
@@ -63,15 +67,44 @@ def main() -> None:
     if resumed:
         print(f"resumed from step {resumed} (preemption recovery)")
 
+    loader = None
+    if args.data:
+        import numpy as np
+
+        from kubeflow_tpu.data import device_put_global, sharded_loader
+
+        # start_batch: the resumed run must not re-read the batches the
+        # lost run already consumed (exact-resume data discipline).
+        # sharded_loader gives THIS host its global_batch/num_processes
+        # rows from a process-disjoint stream.
+        loader = sharded_loader(
+            args.data, args.batch, args.seq, start_batch=start
+        )
     key = jax.random.PRNGKey(1)
     for i in range(start, args.steps):
-        key, sub = jax.random.split(key)
-        tokens = jax.random.randint(
-            sub, (args.batch, args.seq), 0, cfg.vocab_size
-        )
+        if loader is not None:
+            # Assemble the per-host rows into the GLOBAL batch laid out
+            # over the mesh — on one host this is a plain device_put.
+            local = np.remainder(loader.next(), cfg.vocab_size).astype(
+                np.int32
+            )
+            tokens = device_put_global(
+                local, plan.mesh, jax.sharding.PartitionSpec(
+                    ("dp", "fsdp"), "sp"
+                )
+            )
+        else:
+            # fold_in(i): per-step keys are a function of the STEP, so a
+            # resumed run continues the stream instead of replaying it.
+            tokens = jax.random.randint(
+                jax.random.fold_in(key, i), (args.batch, args.seq), 0,
+                cfg.vocab_size,
+            )
         state, loss = step(state, tokens)
         ckpt.save(i + 1, state)
         print(f"step {i + 1}: loss {float(loss):.4f}")
+    if loader is not None:
+        loader.close()
     ckpt.wait()
     print(f"done; checkpoints in {ckpt_dir}")
 

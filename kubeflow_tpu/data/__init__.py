@@ -1,3 +1,8 @@
 """Input pipeline: prefetching token loaders (native C++ + Python fallback)."""
 
-from kubeflow_tpu.data.loader import TokenLoader, write_token_file  # noqa: F401
+from kubeflow_tpu.data.loader import (  # noqa: F401
+    TokenLoader,
+    device_put_global,
+    sharded_loader,
+    write_token_file,
+)

@@ -28,8 +28,16 @@ _LIB = _NATIVE_DIR / "libkftpu_dataloader.so"
 _MASK = (1 << 64) - 1
 
 
-def _build_native() -> Optional[Path]:
-    if _LIB.exists() and (
+# Bumped with every C ABI change; dl_abi_version() in the .so must match
+# or the library is rebuilt (an mtime check alone lets a stale binary
+# with a preserved timestamp silently drop new trailing arguments — on
+# x86-64 a 5-arg dl_open called with 6 declared args just ignores
+# start_batch, resurrecting the resume re-read bug with no error).
+_ABI_VERSION = 2
+
+
+def _build_native(force: bool = False) -> Optional[Path]:
+    if not force and _LIB.exists() and (
         not _SRC.exists() or _LIB.stat().st_mtime >= _SRC.stat().st_mtime
     ):
         return _LIB
@@ -54,10 +62,23 @@ def _load_native() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(str(lib_path))
     except OSError:
         return None
+    if (getattr(lib, "dl_abi_version", None) is None
+            or lib.dl_abi_version() != _ABI_VERSION):
+        # Stale binary (pre-version or other version): rebuild once.
+        lib_path = _build_native(force=True)
+        if lib_path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(str(lib_path))
+        except OSError:
+            return None
+        if (getattr(lib, "dl_abi_version", None) is None
+                or lib.dl_abi_version() != _ABI_VERSION):
+            return None
     lib.dl_open.restype = ctypes.c_void_p
     lib.dl_open.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-        ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64,
     ]
     lib.dl_num_tokens.restype = ctypes.c_long
     lib.dl_num_tokens.argtypes = [ctypes.c_void_p]
@@ -75,14 +96,58 @@ def write_token_file(path: str | Path, tokens: np.ndarray) -> Path:
     return path
 
 
+def _xorshift_matrix() -> "np.ndarray":
+    """The xorshift64 state transition (x^=x>>12; x^=x<<25; x^=x>>27) as
+    a 64×64 GF(2) matrix acting on bit-column vectors (bit i = 2**i)."""
+    m = np.eye(64, dtype=np.uint8)
+
+    def shift_xor(mat, k):
+        # x ^= x >> k  (bit j of result has bit j+k mixed in)   [k > 0]
+        # x ^= x << k  (bit j has bit j-k mixed in)              [k < 0]
+        out = mat.copy()
+        if k > 0:
+            out[: 64 - k] ^= mat[k:]
+        else:
+            out[-k:] ^= mat[: 64 + k]
+        return out
+
+    for k in (12, -25, 27):
+        m = shift_xor(m, k)
+    return m
+
+
+def _xorshift_skip(state: int, n: int) -> int:
+    """Advance the xorshift64 state by ``n`` transitions in O(log n):
+    square-and-multiply over the GF(2) transition matrix. Bit-identical
+    to n sequential transitions (tests cross-check)."""
+    if n <= 0:
+        return state
+    vec = np.array([(state >> i) & 1 for i in range(64)], dtype=np.uint8)
+    m = _xorshift_matrix()
+    while n:
+        if n & 1:
+            vec = (m @ vec) & 1
+        m = (m @ m) & 1
+        n >>= 1
+    return int(sum(int(b) << i for i, b in enumerate(vec)))
+
+
 class _PyState:
     """Python mirror of the C++ sampler (same xorshift64* stream)."""
 
-    def __init__(self, path: Path, batch: int, seq: int, seed: int):
+    def __init__(self, path: Path, batch: int, seq: int, seed: int,
+                 start_batch: int = 0):
         self.tokens = np.memmap(path, dtype=np.uint32, mode="r")
         self.batch = batch
         self.seq = seq
         self.rng = seed if seed else 0x9E3779B97F4A7C15
+        # Resume skip (mirrors dl_open): the output multiply does not
+        # feed the state, so only the xorshift transition matters — and
+        # it is linear over GF(2), so deep skips jump in O(log n) 64×64
+        # bit-matrix squarings instead of an O(n) Python loop (resuming
+        # at step 1e6 × batch 1024 would otherwise stall for minutes on
+        # toolchain-less hosts where this fallback is the only option).
+        self.rng = _xorshift_skip(self.rng, start_batch * batch)
 
     def _next_rand(self) -> int:
         x = self.rng
@@ -112,6 +177,7 @@ class TokenLoader:
         seed: int = 1,
         prefetch: int = 4,
         force_python: bool = False,
+        start_batch: int = 0,  # checkpoint resume: skip consumed batches
     ):
         self.path = Path(path)
         if not self.path.exists():
@@ -127,12 +193,14 @@ class TokenLoader:
         self._handle = None
         if self._lib is not None:
             self._handle = self._lib.dl_open(
-                str(self.path).encode(), batch, seq, seed, prefetch
+                str(self.path).encode(), batch, seq, seed, prefetch,
+                start_batch,
             )
             if not self._handle:
                 self._lib = None
         if self._lib is None:
-            self._py = _PyState(self.path, batch, seq, seed)
+            self._py = _PyState(self.path, batch, seq, seed,
+                                start_batch=start_batch)
         else:
             # Reclaim the producer thread + mmap even if the user never
             # calls close() (abandoned loaders in re-run notebook cells).
@@ -197,6 +265,10 @@ def sharded_loader(
     Pair with :func:`device_put_global` to assemble the per-host batches
     into one global jax.Array laid out over the mesh — the host never
     materializes (and DCN never moves) the full global batch.
+
+    ``start_batch=`` (forwarded) makes checkpoint resume exact: a run
+    restored at step k skips the k batches the lost run consumed instead
+    of re-reading them.
     """
     import jax
 

@@ -8,7 +8,11 @@
 // — SURVEY.md §2.5; this is the framework's in-notebook input pipeline).
 //
 // C ABI (consumed via ctypes from kubeflow_tpu/data/loader.py):
-//   dl_open(path, batch, seq, seed, prefetch) -> opaque handle (NULL on error)
+//   dl_open(path, batch, seq, seed, prefetch, start_batch)
+//           -> opaque handle (NULL on error); start_batch fast-forwards
+//              the sample stream by that many batches (checkpoint resume
+//              must not re-read the batches the lost run already
+//              consumed — state advance only, ~3 ops per skipped draw)
 //   dl_num_tokens(h) -> corpus size in tokens
 //   dl_next(h, out)  -> fills batch*seq int32s; 0 on success
 //   dl_close(h)
@@ -78,8 +82,13 @@ struct Loader {
 
 extern "C" {
 
+// Must match loader.py _ABI_VERSION: the Python side refuses (and
+// rebuilds) a library whose ABI does not match, so a stale cached .so
+// can never silently drop a newly added argument.
+int dl_abi_version() { return 2; }
+
 void* dl_open(const char* path, int batch, int seq, uint64_t seed,
-              int prefetch) {
+              int prefetch, uint64_t start_batch) {
   if (batch <= 0 || seq <= 0 || prefetch <= 0) return nullptr;
   int fd = ::open(path, O_RDONLY);
   if (fd < 0) return nullptr;
@@ -101,6 +110,13 @@ void* dl_open(const char* path, int batch, int seq, uint64_t seed,
   h->batch = batch;
   h->seq = seq;
   h->rng = seed ? seed : 0x9E3779B97F4A7C15ULL;
+  // Resume skip: the output multiply does not feed the state, so
+  // fast-forward is the bare xorshift transition per skipped draw.
+  for (uint64_t i = 0; i < start_batch * static_cast<uint64_t>(batch); ++i) {
+    h->rng ^= h->rng >> 12;
+    h->rng ^= h->rng << 25;
+    h->rng ^= h->rng >> 27;
+  }
   h->capacity = prefetch;
   h->producer = std::thread([h] { h->produce(); });
   return h;

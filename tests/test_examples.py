@@ -44,6 +44,33 @@ def test_train_sharded_runs_and_resumes(tmp_path):
     assert "step 5" in second.stdout
 
 
+def test_train_sharded_with_data_corpus_and_resume(tmp_path):
+    """--data drives the real input pipeline (native/Python TokenLoader);
+    resume passes start_batch so the restored run continues the stream."""
+    import numpy as np
+
+    from kubeflow_tpu.data import write_token_file
+
+    corpus = tmp_path / "corpus.bin"
+    write_token_file(corpus, np.arange(8192, dtype=np.uint32))
+    ckpt = str(tmp_path / "ckpt")
+    first = _run("train_sharded.py", "--steps", "4", "--ckpt-dir", ckpt,
+                 "--data", str(corpus))
+    assert first.returncode == 0, first.stderr
+    second = _run("train_sharded.py", "--steps", "6", "--ckpt-dir", ckpt,
+                  "--data", str(corpus))
+    assert second.returncode == 0, second.stderr
+    assert "resumed from step 4" in second.stdout
+    assert "step 5" in second.stdout
+
+
+def test_train_sharded_zigzag_sp(tmp_path):
+    res = _run("train_sharded.py", "--steps", "2", "--sp-impl", "zigzag",
+               "--ckpt-dir", str(tmp_path / "ck"))
+    assert res.returncode == 0, res.stderr
+    assert "step 2" in res.stdout
+
+
 def test_finetune_lora_runs_and_exports(tmp_path):
     out = str(tmp_path / "merged.npz")
     res = _run("finetune_lora.py", "--steps", "3", "--export", out)
