@@ -128,7 +128,7 @@ def _bench_fn(fn, *args, n=3):
     return min(times)
 
 
-def run_full_bench(results: list) -> None:
+def run_full_bench(results: list, artifact: str | None = None) -> None:
     """Prefill / kernel / training measurements (stderr + artifact).
 
     ``BENCH_SMOKE=1`` shrinks every section to toy shapes so the WHOLE
@@ -136,7 +136,15 @@ def run_full_bench(results: list) -> None:
     run anywhere because the chip was unreachable all round; this mode
     proves executability, leaving only OOM/perf as chip-day risk. Smoke
     numbers are meaningless and never written to a BENCH_FULL artifact
-    (main() refuses --artifact under smoke)."""
+    (main() refuses --artifact under smoke).
+
+    ``artifact`` (chip runs only): the results list is flushed to this
+    path after EVERY section — the axon tunnel's healthy windows have
+    been shorter than the full section list twice now (r4: all round;
+    r5: 90 s), and an end-of-run-only write turns a mid-run wedge into
+    zero recorded measurements. Each flush is atomic (tmp+rename) so a
+    kill -9 mid-write cannot leave a torn JSON for the cached-headline
+    scanner to trip on."""
     import jax
     import jax.numpy as jnp
 
@@ -160,6 +168,20 @@ def run_full_bench(results: list) -> None:
         results.append({"metric": metric, "value": round(value, 2), "unit": unit})
         print(f"# {metric}: {value:.2f} {unit} {extra}", file=sys.stderr)
 
+    def flush():
+        if artifact is None or smoke:
+            return
+        import os
+
+        tmp = artifact + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(results, f, indent=1)
+            os.replace(tmp, artifact)
+        except OSError as err:
+            print(f"# incremental flush to {artifact} failed: {err}",
+                  file=sys.stderr)
+
     def section(fn):
         """Sections are independent measurements: one OOM (e.g. 7B prefill
         on a small chip) must not abort the ones that still fit; each
@@ -174,7 +196,10 @@ def run_full_bench(results: list) -> None:
         except Exception as err:
             failed_sections.append(fn.__name__)
             print(f"# bench section {fn.__name__} failed: {err}", file=sys.stderr)
+        flush()
         gc.collect()
+
+    flush()  # persist the headline before the first (long) section
 
     def kernel_section():
         R = 2 if smoke else 20
@@ -927,7 +952,7 @@ def main() -> int:
             if full:
                 results = [headline]
                 try:
-                    run_full_bench(results)
+                    run_full_bench(results, artifact=None if smoke else artifact)
                 except Exception as err:
                     print(f"# full bench failed partway: {err}", file=sys.stderr)
                     if smoke:

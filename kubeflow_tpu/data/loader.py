@@ -14,6 +14,8 @@ source; environments without a toolchain silently use the fallback.
 from __future__ import annotations
 
 import ctypes
+import os
+import shutil
 import subprocess
 import weakref
 from pathlib import Path
@@ -23,7 +25,6 @@ import numpy as np
 
 _NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
 _SRC = _NATIVE_DIR / "dataloader.cpp"
-_LIB = _NATIVE_DIR / "libkftpu_dataloader.so"
 
 _MASK = (1 << 64) - 1
 
@@ -35,6 +36,11 @@ _MASK = (1 << 64) - 1
 # start_batch, resurrecting the resume re-read bug with no error).
 _ABI_VERSION = 2
 
+# The ABI version is part of the filename so processes running different
+# package versions never fight over one cache path, and an old binary can
+# never be picked up by its name alone.
+_LIB = _NATIVE_DIR / f"libkftpu_dataloader.v{_ABI_VERSION}.so"
+
 
 def _build_native(force: bool = False) -> Optional[Path]:
     if not force and _LIB.exists() and (
@@ -43,14 +49,24 @@ def _build_native(force: bool = False) -> Optional[Path]:
         return _LIB
     if not _SRC.exists():
         return None
+    # Compile to a pid-suffixed temp path and rename into place: writing
+    # the cache path directly would truncate a .so another process may
+    # have mapped (SIGBUS there); rename keeps the old inode alive for
+    # existing mappings.
+    tmp = _LIB.with_name(f".{_LIB.name}.{os.getpid()}.tmp")
     try:
         subprocess.run(
             ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-             str(_SRC), "-o", str(_LIB)],
+             str(_SRC), "-o", str(tmp)],
             check=True, capture_output=True, timeout=120,
         )
+        os.replace(tmp, _LIB)
         return _LIB
     except (OSError, subprocess.SubprocessError):
+        try:
+            tmp.unlink()
+        except OSError:
+            pass
         return None
 
 
@@ -68,10 +84,21 @@ def _load_native() -> Optional[ctypes.CDLL]:
         lib_path = _build_native(force=True)
         if lib_path is None:
             return None
+        # dlopen caches handles per pathname, so CDLLing the rebuilt file
+        # at the same path would hand back the already-mapped STALE
+        # library; load it through a one-shot alias path instead — the
+        # mapping survives the unlink.
+        alias = lib_path.with_name(f".{lib_path.name}.{os.getpid()}.fresh")
         try:
-            lib = ctypes.CDLL(str(lib_path))
+            shutil.copy2(lib_path, alias)
+            lib = ctypes.CDLL(str(alias))
         except OSError:
             return None
+        finally:
+            try:
+                alias.unlink()
+            except OSError:
+                pass
         if (getattr(lib, "dl_abi_version", None) is None
                 or lib.dl_abi_version() != _ABI_VERSION):
             return None
@@ -182,6 +209,13 @@ class TokenLoader:
         self.path = Path(path)
         if not self.path.exists():
             raise FileNotFoundError(self.path)
+        if start_batch < 0:
+            # Must be rejected BEFORE reaching either backend: ctypes
+            # would wrap a negative into c_uint64 (~2**64 — the native
+            # skip then never terminates) while the Python fallback
+            # silently treats it as 0; neither is an acceptable answer
+            # to a corrupted resume offset.
+            raise ValueError(f"start_batch must be >= 0, got {start_batch}")
         self.batch = batch
         self.seq = seq
         n_tokens = self.path.stat().st_size // 4

@@ -12,7 +12,8 @@
 //           -> opaque handle (NULL on error); start_batch fast-forwards
 //              the sample stream by that many batches (checkpoint resume
 //              must not re-read the batches the lost run already
-//              consumed — state advance only, ~3 ops per skipped draw)
+//              consumed — O(log n) GF(2) matrix jump, mirroring the
+//              Python fallback's _xorshift_skip bit-for-bit)
 //   dl_num_tokens(h) -> corpus size in tokens
 //   dl_next(h, out)  -> fills batch*seq int32s; 0 on success
 //   dl_close(h)
@@ -78,6 +79,43 @@ struct Loader {
   }
 };
 
+// One xorshift64 state transition (the output multiply does not feed the
+// state, so resume-skip only needs this part).
+uint64_t xs_step(uint64_t x) {
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  return x;
+}
+
+// The transition is linear over GF(2); column i of its matrix is the image
+// of basis state 1<<i. Applying a matrix is then an XOR-fold of the columns
+// selected by the state's set bits.
+uint64_t xs_apply(const uint64_t* col, uint64_t x) {
+  uint64_t y = 0;
+  while (x) {
+    y ^= col[__builtin_ctzll(x)];
+    x &= x - 1;
+  }
+  return y;
+}
+
+// Advance by n transitions in O(log n) square-and-multiply — bit-identical
+// to n sequential xs_step calls (the Python side cross-checks), but a
+// resume at batch 1e8 costs ~64 squarings instead of stalling dl_open for
+// minutes inside an O(n) loop.
+uint64_t xs_jump(uint64_t state, uint64_t n) {
+  uint64_t m[64], sq[64];
+  for (int i = 0; i < 64; ++i) m[i] = xs_step(1ULL << i);
+  while (n) {
+    if (n & 1) state = xs_apply(m, state);
+    for (int i = 0; i < 64; ++i) sq[i] = xs_apply(m, m[i]);
+    std::memcpy(m, sq, sizeof m);
+    n >>= 1;
+  }
+  return state;
+}
+
 }  // namespace
 
 extern "C" {
@@ -110,13 +148,9 @@ void* dl_open(const char* path, int batch, int seq, uint64_t seed,
   h->batch = batch;
   h->seq = seq;
   h->rng = seed ? seed : 0x9E3779B97F4A7C15ULL;
-  // Resume skip: the output multiply does not feed the state, so
-  // fast-forward is the bare xorshift transition per skipped draw.
-  for (uint64_t i = 0; i < start_batch * static_cast<uint64_t>(batch); ++i) {
-    h->rng ^= h->rng >> 12;
-    h->rng ^= h->rng << 25;
-    h->rng ^= h->rng >> 27;
-  }
+  // Resume skip: O(log n) jump over the skipped draws. The Python caller
+  // rejects negative start_batch before it can wrap through c_uint64.
+  h->rng = xs_jump(h->rng, start_batch * static_cast<uint64_t>(batch));
   h->capacity = prefetch;
   h->producer = std::thread([h] { h->produce(); });
   return h;

@@ -248,3 +248,87 @@ class TestSkipInternals:
         lib = ld._load_native()
         assert lib is not None
         assert lib.dl_abi_version() == ld._ABI_VERSION
+
+    def test_rebuild_at_same_path_escapes_dlopen_cache(
+        self, tmp_path, monkeypatch
+    ):
+        """glibc dlopen caches handles per pathname: a rebuilt .so at the
+        SAME path, re-CDLLed directly, hands back the already-mapped STALE
+        library — the rebuild can then never succeed in the one process
+        that needs it. The loader must load the rebuild under a fresh
+        dlopen identity."""
+        import ctypes
+        import shutil
+        import subprocess
+
+        from kubeflow_tpu.data import loader as ld
+
+        real = ld._build_native()
+        if real is None:
+            import pytest
+
+            pytest.skip("no toolchain")
+        stale_src = tmp_path / "stale.cpp"
+        stale_src.write_text('extern "C" int dl_abi_version() { return 1; }')
+        lib_path = tmp_path / "libcache.so"
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", str(stale_src), "-o",
+             str(lib_path)], check=True, capture_output=True,
+        )
+        # Poison the per-path dlopen cache the way a real process does:
+        # the first _load_native call maps the stale library.
+        ctypes.CDLL(str(lib_path))
+        monkeypatch.setattr(ld, "_LIB", lib_path)
+
+        def rebuild(force=False):
+            if force:
+                # In-place rebuild at the SAME path (the scenario the
+                # alias load exists for).
+                shutil.copy2(real, lib_path)
+            return lib_path
+
+        monkeypatch.setattr(ld, "_build_native", rebuild)
+        lib = ld._load_native()
+        assert lib is not None
+        assert lib.dl_abi_version() == ld._ABI_VERSION
+
+    def test_negative_start_batch_rejected(self, tmp_path):
+        """ctypes would wrap a negative into c_uint64 (the native skip
+        then never terminates); the Python fallback would silently treat
+        it as 0. Both are wrong answers to a corrupted resume offset —
+        the loader must reject it up front."""
+        import numpy as np
+        import pytest
+
+        from kubeflow_tpu.data import TokenLoader, write_token_file
+
+        path = write_token_file(
+            tmp_path / "c.bin", np.arange(1024, dtype=np.uint32)
+        )
+        for force_python in (False, True):
+            with pytest.raises(ValueError, match="start_batch"):
+                TokenLoader(path, batch=2, seq=8, start_batch=-1,
+                            force_python=force_python)
+
+    def test_deep_resume_is_fast_and_consistent(self, tmp_path):
+        """Resuming a billion batches in must be an O(log n) jump on BOTH
+        backends (an O(n) native loop would stall dl_open for minutes) and
+        both must land on the same stream position."""
+        import time
+
+        import numpy as np
+
+        from kubeflow_tpu.data import TokenLoader, write_token_file
+
+        path = write_token_file(
+            tmp_path / "c.bin", np.arange(4096, dtype=np.uint32)
+        )
+        t0 = time.monotonic()
+        py = TokenLoader(path, batch=4, seq=8, start_batch=10**9,
+                         force_python=True)
+        nat = TokenLoader(path, batch=4, seq=8, start_batch=10**9)
+        assert time.monotonic() - t0 < 30, "deep resume took O(n) time"
+        if nat.native:
+            np.testing.assert_array_equal(nat.next(), py.next())
+        nat.close()
+        py.close()
