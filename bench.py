@@ -128,6 +128,31 @@ def _bench_fn(fn, *args, n=3):
     return min(times)
 
 
+def _load_prev_entries(path: str) -> list:
+    """Entries of an existing artifact, [] for missing/corrupt/non-list
+    files — a torn or foreign file must never abort a live capture."""
+    import os
+
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+    except (OSError, ValueError):
+        return []
+    if not isinstance(prev, list):
+        return []
+    return [e for e in prev if isinstance(e, dict)]
+
+
+def _merge_entries(new: list, prev: list) -> list:
+    """Union by metric name, ``new`` wins — lets a re-run EXTEND a partial
+    artifact instead of resetting it (wedge windows are shorter than the
+    section list; each window banks what it reached)."""
+    have = {e.get("metric") for e in new}
+    return new + [e for e in prev if e.get("metric") not in have]
+
+
 def run_full_bench(results: list, artifact: str | None = None) -> None:
     """Prefill / kernel / training measurements (stderr + artifact).
 
@@ -168,6 +193,16 @@ def run_full_bench(results: list, artifact: str | None = None) -> None:
         results.append({"metric": metric, "value": round(value, 2), "unit": unit})
         print(f"# {metric}: {value:.2f} {unit} {extra}", file=sys.stderr)
 
+    # Entries from a PREVIOUS run of this artifact: carried through every
+    # flush (newest wins per metric) so re-running after a partial capture
+    # extends the artifact instead of resetting it to [headline] — the
+    # merge lives HERE, next to the flush that would otherwise clobber,
+    # not in any particular caller.
+    carried = (
+        _load_prev_entries(artifact)
+        if artifact is not None and not smoke else []
+    )
+
     def flush():
         if artifact is None or smoke:
             return
@@ -176,7 +211,7 @@ def run_full_bench(results: list, artifact: str | None = None) -> None:
         tmp = artifact + ".tmp"
         try:
             with open(tmp, "w") as f:
-                json.dump(results, f, indent=1)
+                json.dump(_merge_entries(results, carried), f, indent=1)
             os.replace(tmp, artifact)
         except OSError as err:
             print(f"# incremental flush to {artifact} failed: {err}",
@@ -969,10 +1004,16 @@ def main() -> int:
                 # that already succeeded (a read-only repo checkout would
                 # otherwise turn the printed headline into an "attempt
                 # failed" re-run): fall back to cwd, then to stderr-only.
+                # Merge-aware like run_full_bench's incremental flush —
+                # entries a previous partial run measured and this run
+                # did not re-reach must survive the final write too.
                 for target in (artifact, os.path.basename(artifact)):
+                    merged = _merge_entries(results,
+                                            _load_prev_entries(target))
                     try:
-                        with open(target, "w") as f:
-                            json.dump(results, f, indent=1)
+                        with open(target + ".tmp", "w") as f:
+                            json.dump(merged, f, indent=1)
+                        os.replace(target + ".tmp", target)
                         print(f"# wrote {target}", file=sys.stderr)
                         break
                     except OSError as err:

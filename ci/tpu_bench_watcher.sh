@@ -6,10 +6,11 @@
 #      — the scoreboard number, grabbed first because wedge windows can be
 #      shorter than the full section list (round 5 saw a 90 s window);
 #   2. the full section list -> BENCH_FULL_r05.json. bench.py flushes the
-#      artifact after EVERY section, so a wedge mid-run still leaves the
-#      sections that finished; this script commits the partial artifact
-#      and MERGES across windows (union by metric name, newest wins) so a
-#      later, shorter window cannot clobber an earlier, richer capture.
+#      artifact after EVERY section AND merges with the artifact's prior
+#      contents (union by metric name, newest wins), so a wedge mid-run
+#      still leaves the finished sections and a later, shorter window
+#      cannot clobber an earlier, richer capture; this script just
+#      commits whatever exists after each attempt.
 # Exits after a fully-successful full bench+commit; a supervising loop may
 # restart it for later re-captures.
 set -u
@@ -59,37 +60,13 @@ EOF
             continue
         fi
         echo "[watcher] running bench --full" >> "$LOG"
-        # Preserve any previous window's partial capture: the bench's first
-        # incremental flush overwrites the artifact with just the headline.
-        [ -f "$ART" ] && cp "$ART" "$ART.prev"
+        # bench.py itself merges with any existing artifact at every
+        # per-section flush (newest wins per metric), so a re-run after a
+        # partial capture EXTENDS the artifact; this script only commits
+        # whatever exists afterward — a partial capture is chip evidence.
         timeout 5400 python bench.py --full --artifact "$ART" >> "$LOG" 2>&1
         rc=$?
-        # Merge prev + new (newest wins per metric), then commit whatever
-        # live sections exist — a partial capture is still chip evidence.
-        python - "$ART" <<'EOF' >> "$LOG" 2>&1
-import json, os, sys
-art = sys.argv[1]
-def load(p):
-    if not os.path.exists(p):
-        return []
-    try:
-        data = json.load(open(p))
-        return data if isinstance(data, list) else []
-    except ValueError:
-        return []
-new, prev = load(art), load(art + ".prev")
-if not new and not prev:
-    raise SystemExit("no artifact from this or any previous window")
-seen = {e.get("metric") for e in new}
-merged = new + [e for e in prev if e.get("metric") not in seen]
-tmp = art + ".tmp"
-json.dump(merged, open(tmp, "w"), indent=1)
-os.replace(tmp, art)
-print(f"[watcher-merge] {len(new)} new + {len(merged)-len(new)} carried = {len(merged)} entries")
-EOF
-        merge_rc=$?
-        rm -f "$ART.prev"
-        if [ "$merge_rc" -eq 0 ]; then
+        if [ -s "$ART" ]; then
             n=$(python -c "import json;print(len(json.load(open('$ART'))))" 2>> "$LOG")
             if [ "$rc" -eq 0 ]; then
                 msg="Live TPU bench capture: $ART"
@@ -106,7 +83,7 @@ EOF
                 exit 0
             fi
         else
-            echo "[watcher] no artifact produced rc=$rc $(date -u +%FT%TZ); retrying next cycle" >> "$LOG"
+            echo "[watcher] no artifact exists rc=$rc $(date -u +%FT%TZ); retrying next cycle" >> "$LOG"
         fi
     else
         echo "[watcher] probe unhealthy $(date -u +%FT%TZ)" >> "$LOG"

@@ -22,6 +22,10 @@ def main() -> None:
     ap.add_argument("--int8", action="store_true")
     ap.add_argument("--int4", action="store_true",
                     help="group-wise int4 weights (~4x fewer HBM bytes)")
+    ap.add_argument("--fp8", action="store_true",
+                    help="e4m3 weight-only (2x fewer HBM bytes; operands "
+                         "upcast at the matmul like int8 — use for format "
+                         "consistency with fp8-trained checkpoints)")
     ap.add_argument("--kv8", action="store_true",
                     help="int8 KV cache (halves per-token cache reads and "
                          "cache HBM; composes with --int8/--int4 weights "
@@ -50,8 +54,8 @@ def main() -> None:
     ap.add_argument("--prompt", action="append", default=None,
                     help="text prompt (needs --checkpoint tokenizer); repeatable")
     args = ap.parse_args()
-    if args.int8 and args.int4:
-        raise SystemExit("--int8 and --int4 are mutually exclusive")
+    if sum((args.int8, args.int4, args.fp8)) > 1:
+        raise SystemExit("--int8/--int4/--fp8 are mutually exclusive")
     if args.prompt_cache and args.prefix_cache:
         raise SystemExit("--prompt-cache and --prefix-cache are mutually "
                          "exclusive (prefix subsumes identical prompts)")
@@ -91,10 +95,16 @@ def main() -> None:
     # CLI flags win; otherwise the notebook runtime option applies (the
     # webhook projects the tpu-quantization annotation into
     # KUBEFLOW_TPU_QUANT — this is the consuming end of that contract).
-    bits = 4 if args.int4 else (8 if args.int8 else quant_bits_from_env())
+    bits = (
+        "fp8" if args.fp8
+        else 4 if args.int4
+        else 8 if args.int8
+        else quant_bits_from_env()
+    )
     if bits:
         params = quantize_params(params, free_source=True, bits=bits)
-        print(f"int{bits} weight-only quantization applied")
+        label = bits if bits == "fp8" else f"int{bits}"
+        print(f"{label} weight-only quantization applied")
     kv_bits = 8 if args.kv8 else 0
 
     if tokenizer is not None and args.prompt:

@@ -31,6 +31,11 @@ def main() -> None:
     ap.add_argument("--data", default=None,
                     help="uint32 token corpus (data.write_token_file "
                          "format); omitted = synthetic random tokens")
+    ap.add_argument("--fp8", action="store_true",
+                    help="train with fp8 matmul operands (delayed "
+                         "scaling; bf16 master weights — models/fp8.py). "
+                         "Numerics identical everywhere; the matmul-rate "
+                         "win engages where the MXU has fp8 lanes")
     args = ap.parse_args()
 
     import jax
@@ -57,8 +62,15 @@ def main() -> None:
         args.batch = ((args.batch + n - 1) // n) * n
         print(f"batch rounded up to {args.batch} (multiple of {n} devices)")
     cfg = L.LLAMA_CONFIGS[args.config]
-    init_state, step = make_train_step(cfg, plan, sp_impl=args.sp_impl)
-    state = shard_state(plan, init_state(L.init_params(cfg, jax.random.PRNGKey(0))))
+    init_state, step = make_train_step(
+        cfg, plan, sp_impl=args.sp_impl, fp8=args.fp8
+    )
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    if args.fp8:
+        from kubeflow_tpu.models.fp8 import wrap_params_fp8
+
+        params = wrap_params_fp8(params)
+    state = shard_state(plan, init_state(params))
 
     ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="kftpu-ckpt-")
     ckpt = CheckpointManager(ckpt_dir, save_interval_steps=2)

@@ -48,12 +48,12 @@ TPU_SLICE_INTERRUPTED = "notebooks.kubeflow.org/tpu-slice-interrupted"
 LAST_SEEN_EVENT_RV = "notebooks.kubeflow.org/last-seen-event-rv"
 # Webhook records the resolved slice shape so updates can be diffed cheaply.
 TPU_RESOLVED_TOPOLOGY = "notebooks.kubeflow.org/tpu-resolved-topology"
-# Serving quantization runtime option: "int8" | "int4" | "bf16". The webhook
-# projects it into the KUBEFLOW_TPU_QUANT env var consumed by
+# Serving quantization runtime option: "int8" | "int4" | "fp8" | "bf16".
+# The webhook projects it into the KUBEFLOW_TPU_QUANT env var consumed by
 # models.quant.quant_bits_from_env inside the notebook; the validating
 # webhook rejects unknown values at admission.
 TPU_QUANTIZATION = "notebooks.kubeflow.org/tpu-quantization"
-TPU_QUANTIZATION_VALUES = ("int8", "int4", "bf16")
+TPU_QUANTIZATION_VALUES = ("int8", "int4", "fp8", "bf16")
 QUANT_ENV_NAME = "KUBEFLOW_TPU_QUANT"
 # Profiling runtime option: a port number makes runtime.bootstrap start
 # jax.profiler.start_server on it; the controller surfaces the worker-0

@@ -1,0 +1,238 @@
+"""fp8 training and serving for the Llama family (v5p-class hardware).
+
+Two independent capabilities, both built on the IEEE-754 fp8 formats XLA
+ships (float8_e4m3fn for values, float8_e5m2 for gradients):
+
+- **fp8 TRAINING** (TransformerEngine-style delayed scaling): master
+  weights stay bf16/f32 and the optimizer is untouched, but every targeted
+  matmul runs with fp8 operands — forward operands in e4m3 (more mantissa),
+  gradients in e5m2 (more exponent range). Each weight carries an
+  ``Fp8Meta`` of per-tensor amax HISTORIES; the scale used at step N is
+  derived from the maxima observed at steps < N ("delayed scaling" — the
+  cast needs no extra pass over the tensor), and the amax observed at step
+  N is recorded for step N+1. On v5p-class MXUs the fp8 operands double
+  matmul throughput and halve weight/activation bytes; on hardware without
+  fp8 MXU lanes (v5e, CPU) XLA upcasts the operands, so the numerics are
+  identical everywhere and only the speedup is hardware-gated.
+
+  Meta updates ride the AUTODIFF pass ("overwrite with gradient", the
+  flax fp8_ops pattern): the custom_vjp reports each meta's NEXT value as
+  its cotangent, and ``fp8_meta_replace`` — wired automatically by
+  ``train.make_train_step`` via ``optax.multi_transform`` — applies that
+  "gradient" by replacement instead of gradient descent. This keeps the
+  whole mechanism inside the functional (params, grads, updates) cycle:
+  no mutable state, no side channels, shard_map/pjit-safe.
+
+- **fp8 weight-only SERVING**: ``quantize_weight_fp8`` stores projections
+  as e4m3 with a per-output-channel f32 scale — the same {"q", "s"} layout
+  as int8 (llama._mm consumes it unchanged) and the same 2× HBM cut,
+  which is the whole bandwidth-bound-decode win. Be precise about what it
+  is NOT (yet): _mm upcasts the e4m3 operand to the activation dtype
+  before the matmul, exactly like the int8 path, so no native-fp8 MXU
+  instruction is emitted — on v5e this costs nothing (no fp8 MXU lanes
+  exist), and on v5p wiring the operands through a true fp8 dot is a
+  compile-path change the stored format keeps open. The per-element grid
+  is COARSER than int8's (3 mantissa bits ≈ 6% relative error vs int8
+  per-channel's ≤0.8%); fp8's draw today is format consistency with
+  fp8-trained checkpoints, not accuracy.
+
+Reference parity: the reference (opendatahub-io/kubeflow) has no in-
+notebook ML runtime at all; this module is part of the added TPU-native
+runtime scope (SURVEY.md §2.5, ROADMAP "fp8 training + serving").
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+E4M3_MAX = 448.0      # largest finite float8_e4m3fn
+E5M2_MAX = 57344.0    # largest finite float8_e5m2
+
+# Matmul targets: the stacked (L, in, out) layer projections. lm_head is
+# deliberately excluded — logits are the classic fp8 casualty, and the
+# head is read once per token (vs once per layer), so the bandwidth win
+# is small relative to the accuracy risk.
+_LAYER_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+_HISTORY = 16  # amax history window (TransformerEngine's default order)
+
+
+def init_meta(history: int = _HISTORY) -> dict:
+    """Fresh per-weight fp8 metadata: amax histories for the forward
+    activation (x), the weight (w), and the backward gradient (g).
+    Zeros mean "nothing observed yet" → scale 1.0 on the first step."""
+    z = jnp.zeros((history,), jnp.float32)
+    return {"x_hist": z, "w_hist": z, "g_hist": z}
+
+
+def _scale_from(hist: jax.Array, fmax: float, margin: float = 1.0) -> jax.Array:
+    """Delayed scale: map the largest recently-observed amax to the fp8
+    format's max (divided by ``margin`` headroom). An all-zero history
+    (first step, or a dead tensor) scales by 1.0 rather than inf."""
+    amax = jnp.max(hist)
+    return jnp.where(amax > 0.0, fmax / (margin * amax), 1.0)
+
+
+def _record(hist: jax.Array, x: jax.Array) -> jax.Array:
+    """Roll the newest amax observation into the history window."""
+    return jnp.roll(hist, 1).at[0].set(jnp.max(jnp.abs(x)).astype(jnp.float32))
+
+
+def _cast(x: jax.Array, scale: jax.Array, dtype, fmax: float) -> jax.Array:
+    """Scale into the representable range and saturate-cast. The clip
+    matters: e4m3fn has no inf, and an overflow would become NaN."""
+    return jnp.clip(x.astype(jnp.float32) * scale, -fmax, fmax).astype(dtype)
+
+
+@jax.custom_vjp
+def fp8_matmul(x: jax.Array, w: jax.Array, meta: dict) -> jax.Array:
+    """``x @ w`` with fp8 operands and delayed scaling.
+
+    x: (..., K), w: (K, N), meta: init_meta() pytree. Differentiable in x
+    and w; meta's "gradient" is its next value (overwrite-with-gradient —
+    pair with ``fp8_meta_replace`` in the optimizer, which
+    train.make_train_step does automatically)."""
+    y, _ = _fp8_fwd(x, w, meta)
+    return y
+
+
+def _fp8_fwd(x, w, meta):
+    sx = _scale_from(meta["x_hist"], E4M3_MAX)
+    sw = _scale_from(meta["w_hist"], E4M3_MAX)
+    qx = _cast(x, sx, jnp.float8_e4m3fn, E4M3_MAX)
+    qw = _cast(w, sw, jnp.float8_e4m3fn, E4M3_MAX)
+    # f32 accumulation, then undo both operand scales in the epilogue.
+    y = (
+        jnp.matmul(qx, qw, preferred_element_type=jnp.float32)
+        / (sx * sw)
+    ).astype(x.dtype)
+    res = (
+        qx, qw, sx, sw,
+        _record(meta["x_hist"], x),
+        _record(meta["w_hist"], w),
+        meta["g_hist"],
+        # dtype carriers (a raw np.dtype is not a valid residual leaf)
+        jnp.zeros((), x.dtype), jnp.zeros((), w.dtype),
+    )
+    return y, res
+
+
+def _fp8_bwd(res, g):
+    qx, qw, sx, sw, new_x_hist, new_w_hist, g_hist, x_proto, w_proto = res
+    x_dtype, w_dtype = x_proto.dtype, w_proto.dtype
+    sg = _scale_from(g_hist, E5M2_MAX)
+    qg = _cast(g, sg, jnp.float8_e5m2, E5M2_MAX)
+    # dx = g @ w.T ; dw = x.T @ g — both with fp8 operands, f32 accum.
+    dx = (
+        jnp.matmul(qg, qw.T, preferred_element_type=jnp.float32) / (sg * sw)
+    ).astype(x_dtype)
+    qg2 = qg.reshape(-1, qg.shape[-1])
+    qx2 = qx.reshape(-1, qx.shape[-1])
+    dw = (
+        jnp.matmul(qx2.T, qg2, preferred_element_type=jnp.float32) / (sx * sg)
+    ).astype(w_dtype)
+    meta_next = {
+        "x_hist": new_x_hist,
+        "w_hist": new_w_hist,
+        "g_hist": _record(g_hist, g),
+    }
+    return dx, dw, meta_next
+
+
+fp8_matmul.defvjp(_fp8_fwd, _fp8_bwd)
+
+
+def wrap_params_fp8(params: dict, targets=_LAYER_TARGETS,
+                    history: int = _HISTORY) -> dict:
+    """bf16 param tree → fp8-training tree: each targeted projection
+    becomes {"hp": <master weight, unchanged>, "fp8": init_meta()}.
+    llama's matmul helper dispatches on the "hp" key; everything else
+    (embeddings, norms, lm_head, biases) is untouched. Stacked (L, ...)
+    weights get per-LAYER metas (histories stacked on the layer axis) so
+    each layer scales independently inside the lax.scan."""
+    layers = dict(params["layers"])
+    n_layers = None
+    for t in targets:
+        if t not in layers:
+            continue
+        w = layers[t]
+        n_layers = w.shape[0]
+        meta = init_meta(history)
+        meta = jax.tree_util.tree_map(
+            lambda h: jnp.broadcast_to(h, (n_layers,) + h.shape), meta
+        )
+        layers[t] = {"hp": w, "fp8": meta}
+    return {**params, "layers": layers}
+
+
+def unwrap_params_fp8(params: dict) -> dict:
+    """fp8-training tree → plain tree (the master weights), e.g. for
+    checkpoint export or switching to inference."""
+    layers = {
+        t: (w["hp"] if isinstance(w, dict) and "hp" in w else w)
+        for t, w in params["layers"].items()
+    }
+    return {**params, "layers": layers}
+
+
+def has_fp8_params(params: dict) -> bool:
+    return any(
+        isinstance(w, dict) and "hp" in w
+        for w in params.get("layers", {}).values()
+        if w is not None
+    )
+
+
+def fp8_meta_replace():
+    """GradientTransformation for fp8 meta leaves: the incoming "gradient"
+    IS the next meta value (overwrite-with-gradient), so the update is
+    ``next - current`` and optax.apply_updates lands exactly on ``next``.
+
+    NOTE on grad accumulation: summed-then-averaged microbatch "grads"
+    average the histories — a mild underestimate of the true window max,
+    covered by the delayed-scaling margin."""
+    import optax
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("fp8_meta_replace requires params")
+        return (
+            jax.tree_util.tree_map(lambda g, p: g - p, updates, params),
+            state,
+        )
+
+    return optax.GradientTransformation(init, update)
+
+
+def fp8_partition_labels(params: dict) -> dict:
+    """Label tree for optax.multi_transform: "fp8_meta" for every leaf
+    under an {"hp", "fp8"} wrapper's meta, "default" elsewhere."""
+    def label(path, leaf):
+        del leaf
+        return (
+            "fp8_meta"
+            if any(getattr(k, "key", None) == "fp8" for k in path)
+            else "default"
+        )
+
+    return jax.tree_util.tree_map_with_path(label, params)
+
+
+@partial(jax.jit, static_argnames=("axis",))
+def quantize_weight_fp8(w: jax.Array, axis: int) -> dict:
+    """Weight-only fp8 serving: per-output-channel scale maps each
+    channel's amax to E4M3_MAX. Same {"q", "s"} layout as int8 — the
+    dequant multiply rides the matmul epilogue unchanged (llama._mm),
+    and dequantize_weight's generic branch already handles it."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / E4M3_MAX
+    q = jnp.clip(wf / scale, -E4M3_MAX, E4M3_MAX).astype(jnp.float8_e4m3fn)
+    return {"q": q, "s": scale.astype(jnp.float32)}

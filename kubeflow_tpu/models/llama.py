@@ -189,12 +189,18 @@ def _qkv(h: jax.Array, layer: dict) -> tuple[jax.Array, jax.Array, jax.Array]:
 
 
 def _mm(x: jax.Array, w) -> jax.Array:
-    """x @ w where w is dense OR quantized (models/quant.py): int8 with a
+    """x @ w where w is dense OR quantized (models/quant.py) OR an
+    fp8-training wrapper (models/fp8.py). Quantized: int8/fp8 with a
     per-output-channel scale (dequant fuses into the matmul EPILOGUE) or
-    group-wise int4 (dequant fuses into the weight-operand read). Either
-    way the quantized tensor is what crosses HBM — the whole
-    weight-only-quant decode win."""
+    group-wise int4 (dequant fuses into the weight-operand read) — either
+    way the quantized tensor is what crosses HBM, the whole
+    weight-only-quant decode win. fp8 training: master weight "hp" +
+    delayed-scaling metas, matmul runs with fp8 operands."""
     if isinstance(w, dict):
+        if "hp" in w:
+            from kubeflow_tpu.models.fp8 import fp8_matmul
+
+            return fp8_matmul(x, w["hp"], w["fp8"])
         if w["q"].dtype == jnp.int4:
             from kubeflow_tpu.models.quant import dequantize_weight
 

@@ -117,8 +117,9 @@ def quantize_params(params: dict, targets=_LAYER_TARGETS,
                     quantize_lm_head: bool = True,
                     free_source: bool = False,
                     bits: int = 8, group: int = 128) -> dict:
-    """bf16 param tree → mixed tree with int8 (``bits=8``, per-channel)
-    or int4 (``bits=4``, group-wise) projections.
+    """bf16 param tree → mixed tree with int8 (``bits=8``, per-channel),
+    int4 (``bits=4``, group-wise), or fp8 (``bits="fp8"``, per-channel
+    e4m3 — models/fp8.py) projections.
 
     Stacked layer weights (L, in, out) contract over axis 1; lm_head
     (V, D) contracts over axis 1 (used as x @ lm_head.T).
@@ -127,7 +128,11 @@ def quantize_params(params: dict, targets=_LAYER_TARGETS,
     quantized copy exists — required to quantize a 7B model in place on a
     16 GB chip (13.5 GB bf16 + 7 GB int8 would not coexist). The input
     tree's projection leaves are invalid afterwards."""
-    if bits == 8:
+    if bits == "fp8":
+        from kubeflow_tpu.models.fp8 import quantize_weight_fp8
+
+        quantize = lambda w, axis: quantize_weight_fp8(w, axis=axis)  # noqa: E731
+    elif bits == 8:
         quantize = lambda w, axis: quantize_weight(w, axis=axis)  # noqa: E731
     elif bits == 4:
         quantize = lambda w, axis: quantize_weight_int4(  # noqa: E731
@@ -141,7 +146,7 @@ def quantize_params(params: dict, targets=_LAYER_TARGETS,
         if quantize_lm_head and "lm_head" in params:
             _check_int4_shape(params["lm_head"], 1, group)
     else:
-        raise ValueError(f"bits must be 8 or 4, got {bits}")
+        raise ValueError(f"bits must be 8, 4, or 'fp8', got {bits}")
     layers = dict(params["layers"])
     for t in targets:
         src = layers[t]
@@ -168,13 +173,14 @@ def quantized_bytes(params: dict) -> int:
     return total
 
 
-def quant_bits_from_env() -> int:
+def quant_bits_from_env():
     """Serving-side half of the notebook runtime option: the webhook
     projects the ``notebooks.kubeflow.org/tpu-quantization`` annotation
-    into KUBEFLOW_TPU_QUANT ("int8"|"int4"; absent/"bf16" = 0). Returns
-    the ``bits`` argument for quantize_params (0 = stay bf16). Raises on
-    values the validating webhook would have denied — a hand-set env var
-    must not silently serve full precision."""
+    into KUBEFLOW_TPU_QUANT ("int8"|"int4"|"fp8"; absent/"bf16" = 0).
+    Returns the ``bits`` argument for quantize_params (0 = stay bf16;
+    "fp8" passes through as the string quantize_params dispatches on).
+    Raises on values the validating webhook would have denied — a
+    hand-set env var must not silently serve full precision."""
     import os
 
     value = os.environ.get("KUBEFLOW_TPU_QUANT", "")
@@ -184,6 +190,8 @@ def quant_bits_from_env() -> int:
         return 8
     if value == "int4":
         return 4
+    if value == "fp8":
+        return "fp8"
     raise ValueError(
-        f"KUBEFLOW_TPU_QUANT={value!r}: want 'int8', 'int4', or 'bf16'"
+        f"KUBEFLOW_TPU_QUANT={value!r}: want 'int8', 'int4', 'fp8', or 'bf16'"
     )

@@ -151,6 +151,7 @@ def make_train_step(
     grad_accum: int = 1,
     loss_chunk: int = 512,
     remat: str = "full",
+    fp8: bool = False,
 ):
     """Build (init_state, train_step) jitted over plan.mesh.
 
@@ -173,6 +174,13 @@ def make_train_step(
     logits never materialize); 0 falls back to the dense loss.
     ``remat`` picks the layer-stack checkpoint policy
     (llama._REMAT_POLICIES: "full" | "dots" | "none").
+
+    ``fp8=True`` trains with fp8 matmul operands (models/fp8.py): pass
+    params through ``fp8.wrap_params_fp8`` first; the optimizer is
+    partitioned so AdamW sees the master weights while the fp8 metas are
+    overwritten with their autodiff-carried next values. init_state
+    raises if the params tree and the flag disagree — a wrapped tree
+    under a plain optimizer would adamw the amax histories.
     """
     if sp_impl not in ("ring", "ulysses", "zigzag"):
         # Validate even when sp ends up inactive: a typo'd sp_impl on an
@@ -181,6 +189,16 @@ def make_train_step(
             f"unknown sp_impl {sp_impl!r} (want 'ring'|'ulysses'|'zigzag')"
         )
     optimizer = optimizer or make_optimizer()
+    if fp8:
+        from kubeflow_tpu.models.fp8 import (
+            fp8_meta_replace,
+            fp8_partition_labels,
+        )
+
+        optimizer = optax.multi_transform(
+            {"default": optimizer, "fp8_meta": fp8_meta_replace()},
+            fp8_partition_labels,
+        )
     mesh = plan.mesh
     if use_ring_sp is None:
         use_ring_sp = mesh.shape.get("sp", 1) > 1
@@ -201,6 +219,14 @@ def make_train_step(
         attn_impl = make_sharded_ulysses_attention(mesh)
 
     def init_state(params):
+        from kubeflow_tpu.models.fp8 import has_fp8_params
+
+        if has_fp8_params(params) != fp8:
+            raise ValueError(
+                "params tree and fp8 flag disagree: "
+                f"has_fp8_params={has_fp8_params(params)}, fp8={fp8} "
+                "(wrap with fp8.wrap_params_fp8 AND pass fp8=True)"
+            )
         opt_state = optimizer.init(params)
         return {"params": params, "opt_state": opt_state, "step": jnp.zeros((), jnp.int32)}
 

@@ -77,6 +77,12 @@ class MeshPlan:
         llama-2-70b) or the wk/wv shard would split a head.
         """
         name = "/".join(path)
+        if "fp8" in path:
+            # fp8 delayed-scaling amax histories (models/fp8.py): a few
+            # floats per layer, replicated — the projection-name match
+            # below must not see "wq" in "layers/wq/fp8/x_hist" and hand
+            # a 3-axis weight spec to a (L, history) meta.
+            return P()
         if "embed" in name or "lm_head" in name:
             # (vocab, dim): vocab over tp, dim over fsdp
             return P("tp", "fsdp")

@@ -249,3 +249,28 @@ def test_smoke_flag_falsey_strings(bench, monkeypatch):
     for v in ("1", "true", "yes", "on"):
         monkeypatch.setenv("BENCH_SMOKE", v)
         assert bench._smoke_enabled(), repr(v)
+
+
+class TestArtifactMerge:
+    """Per-section incremental flushes merge with the artifact's PRIOR
+    contents (newest wins per metric): wedge windows are shorter than the
+    section list, so each window must extend — never reset — the capture."""
+
+    def test_merge_newest_wins_and_carries_old(self, bench):
+        new = [{"metric": "headline", "value": 2.0}]
+        prev = [{"metric": "headline", "value": 1.0},
+                {"metric": "train MFU", "value": 50.0}]
+        merged = bench._merge_entries(new, prev)
+        assert merged[0] == {"metric": "headline", "value": 2.0}
+        assert {"metric": "train MFU", "value": 50.0} in merged
+        assert len(merged) == 2
+
+    def test_load_prev_tolerates_missing_corrupt_nonlist(self, bench, tmp_path):
+        assert bench._load_prev_entries(str(tmp_path / "absent.json")) == []
+        p = tmp_path / "torn.json"
+        p.write_text('[{"metric": "x", "va')
+        assert bench._load_prev_entries(str(p)) == []
+        p.write_text('{"not": "a list"}')
+        assert bench._load_prev_entries(str(p)) == []
+        p.write_text('[{"metric": "x"}, "stray-string"]')
+        assert bench._load_prev_entries(str(p)) == [{"metric": "x"}]

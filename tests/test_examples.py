@@ -86,9 +86,19 @@ def test_finetune_lora_runs_and_exports(tmp_path):
               ("--paged", "--kv8", "--tp", "2"), ("--speculative", "1"),
               ("--speculative", "1", "--paged", "--kv8"),
               ("--paged", "--prompt-cache"), ("--paged", "--prefix-cache"),
-              ("--speculative", "1", "--paged", "--prefix-cache")]
+              ("--speculative", "1", "--paged", "--prefix-cache"),
+              ("--fp8",), ("--fp8", "--paged", "--kv8")]
 )
 def test_serve_batched_runs(extra):
     res = _run("serve_batched.py", "--max-new-tokens", "4", *extra)
     assert res.returncode == 0, res.stderr
     assert "[2]" in res.stdout  # three prompts served
+
+
+def test_train_sharded_fp8(tmp_path):
+    """--fp8 trains with fp8 matmul operands end to end (wrap + OWG
+    optimizer partitioning + checkpoint save)."""
+    res = _run("train_sharded.py", "--steps", "2", "--fp8",
+               "--ckpt-dir", str(tmp_path / "ck"))
+    assert res.returncode == 0, res.stderr
+    assert "step 2" in res.stdout

@@ -129,6 +129,14 @@ class TestQuantizationOption:
                 tpu_notebook(annotations={ann.TPU_QUANTIZATION: "fp4"})
             )
 
+    def test_fp8_value_projects_env(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(
+            tpu_notebook(annotations={ann.TPU_QUANTIZATION: "fp8"})
+        )
+        _, c = primary(env)
+        assert get_env_var(c, ann.QUANT_ENV_NAME)["value"] == "fp8"
+
     def test_env_consumed_by_runtime(self, monkeypatch):
         from kubeflow_tpu.models.quant import quant_bits_from_env
 
