@@ -584,6 +584,61 @@ def run_full_bench(results: list, artifact: str | None = None) -> None:
             "value": round(rate, 3), "unit": "ratio",
         })
 
+    def paged_kernel_section():
+        # Paged-serving decode: gathered-view path vs the pallas
+        # paged-attention kernel (ops/paged_attention.py). The gathered
+        # path materializes (B, Hkv, MAXB*BS, D) per layer per step; the
+        # kernel DMAs each slot's live blocks straight from the pool —
+        # the delta IS the gather's HBM cost. int8 weights (as in
+        # batched_section: bf16 7B + pool won't fit 16 GB), bf16 pool
+        # (the kernel's supported format).
+        from kubeflow_tpu.models.paged import PagedBatcher
+        from kubeflow_tpu.models.quant import quantize_params
+        from kubeflow_tpu.models.serving import GenerationConfig
+
+        cfg = L.LLAMA_CONFIGS[big]
+        params = quantize_params(
+            L.init_params(cfg, jax.random.PRNGKey(0)), free_source=True
+        )
+        bs, plen = (2, 16) if smoke else (8, 128)
+        d1, d2 = (4, 8) if smoke else (48, 112)
+        nblocks = 16 if smoke else 192
+        rng = jax.random.randint(
+            jax.random.PRNGKey(1), (bs, plen), 3, cfg.vocab_size
+        )
+        prompts = [list(map(int, row)) for row in rng]
+
+        def timed(steps: int, attn_kernel: bool) -> float:
+            # headroom pins max_blocks (and so every compiled shape)
+            # across the two timing points; min-of-2 after a compile run.
+            times = []
+            for _ in range(2):
+                pb = PagedBatcher(
+                    params, cfg,
+                    gen=GenerationConfig(max_new_tokens=steps, eos_id=-1),
+                    slots=bs, num_blocks=nblocks, block_size=16,
+                    prompt_bucket=max(16, plen),
+                    headroom_tokens=d2 - steps,
+                    attn_kernel=attn_kernel,
+                )
+                for p in prompts:
+                    pb.submit(p)
+                t0 = time.perf_counter()
+                pb.run()
+                times.append(time.perf_counter() - t0)
+            return min(times)
+
+        for attn_kernel, label in ((False, "gathered"), (True, "kernel")):
+            timed(2, attn_kernel)  # compile both step shapes
+            t1 = timed(d1, attn_kernel)
+            t2 = timed(d2, attn_kernel)
+            report(
+                f"{big} int8 paged decode tokens/sec (bs={bs}, "
+                f"{label} attention)",
+                bs * (d2 - d1) / (t2 - t1), "tokens/sec",
+                f"(block pool {nblocks}x16)",
+            )
+
     def decode_attr_section():
         # Decode-step ATTRIBUTION (bs=1 bf16 7B, the headline config):
         # where does the per-token time go? Each component is timed as a
@@ -739,6 +794,7 @@ def run_full_bench(results: list, artifact: str | None = None) -> None:
     section(spec_section)
     section(spec_curve_section)
     section(spec_serving_section)
+    section(paged_kernel_section)
     section(decode_attr_section)
     # Biggest-HBM sections LAST (7B prefill, then 7B + 4096-slot cache):
     # an OOM on a small chip must not rob the sections above of their

@@ -123,7 +123,9 @@ def _paged_admit(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "block_size", "temperature", "top_k", "top_p"),
+    static_argnames=(
+        "cfg", "block_size", "temperature", "top_k", "top_p", "attn_kernel",
+    ),
     donate_argnums=(3,),
 )
 def _paged_step(
@@ -139,6 +141,7 @@ def _paged_step(
     temperature: float,
     top_k: int,
     top_p: float,
+    attn_kernel: bool = False,
 ) -> tuple[jax.Array, dict]:
     """One decode step across every slot, reading/writing through tables."""
     cos, sin = rope_frequencies(cfg, positions)
@@ -148,7 +151,7 @@ def _paged_step(
     offs = (positions % block_size)[:, None]
     x, new_pool = _paged_chunk_scan(
         params, cfg, tokens, pool, tables, kv_mask, cos, sin, blks, offs,
-        positions, block_size,
+        positions, block_size, attn_kernel=attn_kernel,
     )
     logits = _lm_head_logits(_norm(x[:, 0], params["final_norm"], cfg), params)
     nxt = sample_logits(logits, key, temperature, top_k, top_p)
@@ -184,14 +187,30 @@ def _scatter_chunk(pool_l, k, v, blks, offs):
 
 
 def _paged_chunk_scan(params, cfg, tokens, pool, tables, kv_mask, cos, sin,
-                      blks, offs, attn_positions, block_size):
+                      blks, offs, attn_positions, block_size,
+                      attn_kernel=False):
     """The ONE paged decode body (scan over layers), shared by the
     ordinary decode step (K=1) and the speculative verify chunk (K>1) —
     same discipline as llama._chunk_decode_scan: a single body means a
     future change (norm placement, window semantics, int8
     quantize-on-write) cannot diverge plain paged decode from
-    speculative verification."""
+    speculative verification.
+
+    ``attn_kernel``: read the cache THROUGH the tables with the pallas
+    paged-attention kernel (ops/paged_attention.py) instead of
+    materializing the gathered logical view — one read of the live
+    blocks per step instead of gather-write-reread of all MAXB slots.
+    Applies to the bf16 single-token path (K=1, no sliding window, no
+    int8 pool); everything else keeps the gathered view, whose masking
+    the kernel is tested to match bit-for-bit in intent and to bf16
+    tolerance in value."""
     x = _embed(params, cfg, tokens)
+    use_kernel = (
+        attn_kernel
+        and tokens.shape[1] == 1
+        and not cfg.sliding_window
+        and "k_scale" not in pool
+    )
 
     def gathered(pool_l):
         return _gathered_view(
@@ -208,14 +227,26 @@ def _paged_chunk_scan(params, cfg, tokens, pool, tables, kv_mask, cos, sin,
                        per_batch=True)
         v = _split_heads(hv, cfg.n_kv_heads)
         pool_l = _scatter_chunk(pool_l, k, v, blks, offs)
-        attn = _gqa_decode_attention(
-            q, gathered(pool_l["k"]), gathered(pool_l["v"]), attn_positions,
-            window=cfg.sliding_window, kv_mask=kv_mask, per_batch=True,
-            k_scale=(gathered(pool_l["k_scale"])
-                     if "k_scale" in pool_l else None),
-            v_scale=(gathered(pool_l["v_scale"])
-                     if "v_scale" in pool_l else None),
-        )
+        if use_kernel:
+            from kubeflow_tpu.ops.paged_attention import (
+                paged_decode_attention,
+            )
+
+            attn = paged_decode_attention(
+                q[:, :, 0, :], pool_l["k"], pool_l["v"], tables, kv_mask,
+                attn_positions + 1, block_size,
+                interpret=jax.default_backend() not in ("tpu", "axon"),
+            )[:, :, None, :]
+        else:
+            attn = _gqa_decode_attention(
+                q, gathered(pool_l["k"]), gathered(pool_l["v"]),
+                attn_positions,
+                window=cfg.sliding_window, kv_mask=kv_mask, per_batch=True,
+                k_scale=(gathered(pool_l["k_scale"])
+                         if "k_scale" in pool_l else None),
+                v_scale=(gathered(pool_l["v_scale"])
+                         if "v_scale" in pool_l else None),
+            )
         x = x + _mm(_merge_heads(attn), layer["wo"])
         h = _norm(x, layer["mlp_norm"], cfg)
         x = x + _mlp(layer, h, cfg)
@@ -351,8 +382,39 @@ class PagedBatcher(_BatcherBase):
         prompt_cache: bool = False,  # share identical prompts' blocks
         prefix_cache: bool = False,  # share common PREFIXES block-by-block
         admit_chunk: Optional[int] = None,  # prefix-admission piece width
+        attn_kernel: Optional[bool] = None,  # pallas paged attention
     ):
         self.gen = gen or GenerationConfig()
+        # Decode attention THROUGH the tables (ops/paged_attention.py):
+        # default on where the pallas TPU backend exists; CPU runs the
+        # kernel interpreted (slow — tests opt in explicitly). Applies to
+        # the bf16 K=1 step; int8/window/verify keep the gathered path.
+        # A tp plan keeps the gathered path too: pallas_call does not
+        # auto-partition under GSPMD, so running it over a kv-head-
+        # sharded pool would silently gather the shards.
+        if attn_kernel and plan is not None:
+            raise ValueError(
+                "attn_kernel=True does not compose with plan= (the paged "
+                "kernel is single-device; a tp-sharded pool would be "
+                "gathered) — drop one of the two"
+            )
+        if attn_kernel and kv_bits:
+            raise ValueError(
+                "attn_kernel=True does not compose with kv_bits (the "
+                "kernel reads bf16 pools; an int8 pool would silently "
+                "run the gathered path) — drop one of the two"
+            )
+        if attn_kernel and cfg.sliding_window:
+            raise ValueError(
+                "attn_kernel=True does not support sliding-window "
+                "configs (the window bound lives in the gathered path) "
+                "— drop attn_kernel for this model"
+            )
+        self.attn_kernel = (
+            jax.default_backend() in ("tpu", "axon") and plan is None
+            and not kv_bits and not cfg.sliding_window
+            if attn_kernel is None else attn_kernel
+        )
         if prompt_bucket % block_size:
             raise ValueError(
                 f"prompt_bucket {prompt_bucket} must be a multiple of "
@@ -871,7 +933,7 @@ class PagedBatcher(_BatcherBase):
             self.params, self.cfg, jnp.array(self.tokens), self.pool,
             jnp.array(self.tables), jnp.array(self.positions), self.kv_mask,
             sub, self.block_size, self.gen.temperature, self.gen.top_k,
-            self.gen.top_p,
+            self.gen.top_p, attn_kernel=self.attn_kernel,
         )
         for slot in active:
             self.positions[slot] += 1

@@ -1,0 +1,203 @@
+"""Pallas paged-attention decode kernel: attend THROUGH the block tables.
+
+The paged serving engine's decode step previously materialized each
+slot's logical cache view before attending (``models/paged.py
+_gathered_view``: ``pool[tables]`` → (B, Hkv, MAXB·BS, D) per layer per
+step). Decode attention is cache-bandwidth-bound, so that gather roughly
+triples the bytes crossing HBM per step: read the pool blocks, write the
+contiguous copy, read it again inside attention — and it reads ALL MAXB
+table slots, allocated or not.
+
+This kernel reads each slot's blocks directly from the pool in HBM
+(vLLM-style): one grid program per slot, double-buffered async DMA of
+that slot's next (Hkv, BS, D) K and V blocks into VMEM while the current
+block's scores accumulate into an online softmax. Bytes per step become
+exactly one read of the slot's LIVE blocks — no materialized copy, no
+dead-slot traffic — and the loop bound is the slot's own block count,
+not MAXB.
+
+Design notes:
+- The block table and sequence lengths ride scalar prefetch
+  (``pltpu.PrefetchScalarGridSpec``): physical block ids must be known
+  to issue the DMA for a block, which is exactly what scalar-prefetch
+  args exist for (pallas_guide: "enabling index computation for DMA").
+- GQA runs on the unrepeated cache, like the dense-path
+  ``_gqa_decode_attention``: q is viewed (Hkv, G, D) and each kv head's
+  G query rows attend its single (BS, D) block — a (G, D)·(BS, D)ᵀ dot
+  per head. FLOPs are trivial at decode; the kernel exists for the
+  bytes, not the MXU.
+- The kv_mask (holes + partial tail blocks) is applied per block from a
+  VMEM-resident int8 mask, so semantics match the gathered path
+  bit-for-bit (tests assert numerical agreement).
+- bf16 pools only; int8-quantized pools and sliding-window configs keep
+  the gathered path (models/paged.py dispatches).
+
+Reference parity: the reference has no serving stack at all (SURVEY.md
+§2.5); within this framework the kernel is the paged analogue of
+ops/attention.py's flash kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+try:  # pragma: no cover - exercised via the public entry point
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pallas unavailable: caller must use the gathered path
+    pl = None
+    pltpu = None
+
+
+def _kernel(tables_ref, lens_ref, q_ref, kpool_ref, vpool_ref, mask_ref,
+            o_ref, kbuf, vbuf, sems, *, block_size, n_kv_heads, group,
+            head_dim):
+    b = pl.program_id(0)
+    seq_len = lens_ref[b]
+    nblk = jnp.maximum((seq_len + block_size - 1) // block_size, 1)
+    scale = 1.0 / math.sqrt(head_dim)
+
+    q = q_ref[0].reshape(n_kv_heads, group, head_dim).astype(jnp.float32)
+
+    def kdma(slot, i):
+        return pltpu.make_async_copy(
+            kpool_ref.at[tables_ref[b, i]], kbuf.at[slot], sems.at[slot, 0]
+        )
+
+    def vdma(slot, i):
+        return pltpu.make_async_copy(
+            vpool_ref.at[tables_ref[b, i]], vbuf.at[slot], sems.at[slot, 1]
+        )
+
+    # Warm up: first block's K and V in flight before the loop.
+    kdma(0, 0).start()
+    vdma(0, 0).start()
+
+    m0 = jnp.full((n_kv_heads, group, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((n_kv_heads, group, 1), jnp.float32)
+    acc0 = jnp.zeros((n_kv_heads, group, head_dim), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(i, 2)
+        nxt = 1 - slot
+
+        @pl.when(i + 1 < nblk)
+        def _():
+            kdma(nxt, i + 1).start()
+            vdma(nxt, i + 1).start()
+
+        kdma(slot, i).wait()
+        vdma(slot, i).wait()
+        k = kbuf[slot].astype(jnp.float32)  # (Hkv, BS, D)
+        v = vbuf[slot].astype(jnp.float32)
+
+        # Validity = stored kv_mask AND the positional causal bound: the
+        # batcher may mark a whole row True and lean on `k_pos <= pos`
+        # (llama._gqa_decode_attention's mask), so both must apply here.
+        k_pos = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (block_size,), 0
+        )
+        valid = (mask_ref[0, pl.ds(i * block_size, block_size)] != 0) & (
+            k_pos < seq_len
+        )  # (BS,)
+
+        # Per-kv-head scores: (G, D) · (BS, D)ᵀ — static unroll over the
+        # (small) kv-head count keeps every dot a plain 2D dot_general.
+        dn = (((1,), (1,)), ((), ()))
+        s = jnp.stack([
+            jax.lax.dot_general(q[h], k[h], dn,
+                                preferred_element_type=jnp.float32)
+            for h in range(n_kv_heads)
+        ]) * scale  # (Hkv, G, BS)
+        s = jnp.where(valid[None, None, :], s, -jnp.inf)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # A fully-masked block (hole spanning a whole block) keeps
+        # m_new = -inf; exp(-inf - -inf) would be NaN — pin alpha/p to 0.
+        alpha = jnp.where(jnp.isfinite(m_new), jnp.exp(m - m_new), 0.0)
+        p = jnp.where(jnp.isfinite(m_new), jnp.exp(s - m_new), 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.stack([
+            jax.lax.dot_general(
+                p[h], v[h], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            for h in range(n_kv_heads)
+        ])  # (Hkv, G, D)
+        return m_new, l_new, acc * alpha + pv
+
+    m, l, acc = jax.lax.fori_loop(0, nblk, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0] = out.reshape(n_kv_heads * group, head_dim).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "interpret")
+)
+def paged_decode_attention(
+    q: jax.Array,        # (B, Hq, D) — the single new token per slot
+    k_pool: jax.Array,   # (NB, Hkv, BS, D) bf16 block pool
+    v_pool: jax.Array,   # (NB, Hkv, BS, D)
+    tables: jax.Array,   # (B, MAXB) int32 physical block ids
+    kv_mask: jax.Array,  # (B, MAXB·BS) bool valid-key mask
+    seq_lens: jax.Array,  # (B,) int32 — position+1 (bounds the block loop)
+    block_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Paged GQA decode attention; returns (B, Hq, D).
+
+    Numerically equivalent to gathering the logical view and running
+    ``models.llama._gqa_decode_attention`` with the same kv_mask
+    (tests/test_paged_attention.py pins the agreement); reads only the
+    ``ceil(seq_len/BS)`` live blocks per slot.
+    """
+    if pl is None:
+        raise RuntimeError("pallas unavailable; use the gathered path")
+    b, hq, d = q.shape
+    nb, hkv, bs, _ = k_pool.shape
+    if bs != block_size:
+        raise ValueError(f"pool block size {bs} != block_size {block_size}")
+    if hq % hkv:
+        raise ValueError(f"{hq} q heads not divisible by {hkv} kv heads")
+    max_blocks = tables.shape[1]
+    if kv_mask.shape != (b, max_blocks * bs):
+        # The mask BlockSpec reads exactly (1, MAXB·BS) per slot — a mask
+        # built for a different table layout would be silently truncated
+        # or misaligned into wrong attention, not a shape error.
+        raise ValueError(
+            f"kv_mask shape {kv_mask.shape} != ({b}, {max_blocks * bs}) "
+            "(tables × block_size layout)"
+        )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, hq, d), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, max_blocks * bs), lambda i, *_: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hq, d), lambda i, *_: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, hkv, bs, d), k_pool.dtype),
+            pltpu.VMEM((2, hkv, bs, d), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(
+        _kernel, block_size=block_size, n_kv_heads=hkv, group=hq // hkv,
+        head_dim=d,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q, k_pool, v_pool, kv_mask.astype(jnp.int8))

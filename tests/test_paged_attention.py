@@ -1,0 +1,163 @@
+"""Pallas paged-attention decode kernel (ops/paged_attention.py).
+
+Numerical agreement with the gathered-view reference path is the whole
+contract: the kernel replaces ``pool[tables]`` materialization in the
+paged serving engine, so any masking/ordering divergence is a serving
+correctness bug, not a perf detail. CPU runs the kernel in interpret
+mode (slow but exact).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.llama import LLAMA_CONFIGS, _gqa_decode_attention
+from kubeflow_tpu.ops.paged_attention import paged_decode_attention
+
+
+def _setup(b=3, hq=8, hkv=4, d=128, bs=16, maxb=6, nb=32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q = jax.random.normal(ks[0], (b, hq, d), jnp.bfloat16)
+    kp = jax.random.normal(ks[1], (nb, hkv, bs, d), jnp.bfloat16)
+    vp = jax.random.normal(ks[2], (nb, hkv, bs, d), jnp.bfloat16)
+    tables = jax.random.permutation(ks[3], nb)[: b * maxb].reshape(
+        b, maxb
+    ).astype(jnp.int32)
+    return q, kp, vp, tables
+
+
+def _reference(q, kp, vp, tables, kv_mask, seq_lens, bs):
+    b, maxb = tables.shape
+    hkv, d = kp.shape[1], kp.shape[3]
+    g = kp[tables].transpose(0, 2, 1, 3, 4).reshape(b, hkv, maxb * bs, d)
+    gv = vp[tables].transpose(0, 2, 1, 3, 4).reshape(b, hkv, maxb * bs, d)
+    return _gqa_decode_attention(
+        q[:, :, None, :], g, gv, seq_lens - 1, kv_mask=kv_mask,
+        per_batch=True,
+    )[:, :, 0, :]
+
+
+def _assert_close(out, ref):
+    err = float(jnp.max(jnp.abs(
+        out.astype(jnp.float32) - ref.astype(jnp.float32)
+    )))
+    assert err < 2e-2, f"kernel diverges from gathered path: {err}"
+
+
+class TestKernelVsGathered:
+    def test_varied_lengths_and_partial_tail_blocks(self):
+        q, kp, vp, tables = _setup()
+        seq_lens = jnp.array([17, 40, 96], jnp.int32)  # partial tails
+        kv_mask = jnp.arange(6 * 16)[None, :] < seq_lens[:, None]
+        out = paged_decode_attention(
+            q, kp, vp, tables, kv_mask, seq_lens, 16, interpret=True
+        )
+        _assert_close(out, _reference(q, kp, vp, tables, kv_mask, seq_lens, 16))
+
+    def test_all_true_mask_rows_rely_on_positional_bound(self):
+        """The batcher may mark a whole kv_mask row True and lean on the
+        gathered path's `k_pos <= position` causal bound — the kernel
+        must apply the same bound, not just the stored mask."""
+        q, kp, vp, tables = _setup(seed=1)
+        seq_lens = jnp.array([1, 33, 96], jnp.int32)  # incl. 1-token slot
+        kv_mask = jnp.ones((3, 6 * 16), bool)
+        out = paged_decode_attention(
+            q, kp, vp, tables, kv_mask, seq_lens, 16, interpret=True
+        )
+        _assert_close(out, _reference(q, kp, vp, tables, kv_mask, seq_lens, 16))
+
+    def test_mask_holes_and_whole_masked_blocks(self):
+        """Holes inside the valid range (and a fully-masked block, which
+        must not NaN the online softmax) match the gathered path."""
+        q, kp, vp, tables = _setup(seed=2)
+        seq_lens = jnp.array([60, 60, 60], jnp.int32)
+        kv_mask = jnp.arange(6 * 16)[None, :] < seq_lens[:, None]
+        kv_mask = kv_mask.at[0, 5:9].set(False)
+        kv_mask = kv_mask.at[1, 16:32].set(False)  # block 1 fully masked
+        out = paged_decode_attention(
+            q, kp, vp, tables, kv_mask, seq_lens, 16, interpret=True
+        )
+        _assert_close(out, _reference(q, kp, vp, tables, kv_mask, seq_lens, 16))
+
+    def test_gqa_grouping(self):
+        """Hq > Hkv: each kv head serves its G query rows unrepeated."""
+        q, kp, vp, tables = _setup(hq=8, hkv=2, seed=3)
+        seq_lens = jnp.array([30, 50, 90], jnp.int32)
+        kv_mask = jnp.arange(6 * 16)[None, :] < seq_lens[:, None]
+        out = paged_decode_attention(
+            q, kp, vp, tables, kv_mask, seq_lens, 16, interpret=True
+        )
+        _assert_close(out, _reference(q, kp, vp, tables, kv_mask, seq_lens, 16))
+
+    def test_shape_validation(self):
+        q, kp, vp, tables = _setup()
+        seq_lens = jnp.array([4, 4, 4], jnp.int32)
+        kv_mask = jnp.ones((3, 96), bool)
+        with pytest.raises(ValueError, match="block size"):
+            paged_decode_attention(q, kp, vp, tables, kv_mask, seq_lens, 8,
+                                   interpret=True)
+        with pytest.raises(ValueError, match="divisible"):
+            paged_decode_attention(q[:, :5], kp, vp, tables, kv_mask,
+                                   seq_lens, 16, interpret=True)
+        # a mask built for a different table layout must be a shape
+        # error, not silently-truncated wrong attention
+        with pytest.raises(ValueError, match="kv_mask"):
+            paged_decode_attention(q, kp, vp, tables,
+                                   jnp.ones((3, 2 * 96), bool),
+                                   seq_lens, 16, interpret=True)
+
+
+class TestBatcherIntegration:
+    def test_kernel_batcher_matches_gathered_batcher(self):
+        """End to end: PagedBatcher(attn_kernel=True) must produce the
+        same greedy tokens as the gathered-path batcher."""
+        from kubeflow_tpu.models import llama as L
+        from kubeflow_tpu.models.paged import PagedBatcher
+        from kubeflow_tpu.models.serving import GenerationConfig
+
+        cfg = LLAMA_CONFIGS["tiny"]
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        gen = GenerationConfig(max_new_tokens=8)
+        prompts = [[1, 2, 3, 4, 5], [7, 8, 9], [11, 12, 13, 14]]
+
+        def serve(attn_kernel):
+            pb = PagedBatcher(params, cfg, gen=gen, slots=2, num_blocks=32,
+                              block_size=16, attn_kernel=attn_kernel)
+            rids = [pb.submit(p) for p in prompts]
+            outs = pb.run()
+            return [outs[r] for r in rids]
+
+        ref = serve(False)
+        got = serve(True)
+        assert got == ref
+
+    def test_kernel_rejects_plan_int8_window(self):
+        """Explicit attn_kernel=True with an unsupported composition must
+        raise, never silently run the gathered path while reporting the
+        kernel is on."""
+        import dataclasses
+
+        from kubeflow_tpu.models import llama as L
+        from kubeflow_tpu.models.paged import PagedBatcher
+        from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+
+        cfg = LLAMA_CONFIGS["tiny"]
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        plan = MeshPlan(make_mesh(tp=2, dp=4))
+        with pytest.raises(ValueError, match="attn_kernel"):
+            PagedBatcher(params, cfg, plan=plan, attn_kernel=True)
+        with pytest.raises(ValueError, match="kv_bits"):
+            PagedBatcher(params, cfg, kv_bits=8, attn_kernel=True)
+        wcfg = dataclasses.replace(cfg, sliding_window=8)
+        with pytest.raises(ValueError, match="sliding-window"):
+            PagedBatcher(params, wcfg, attn_kernel=True)
+
+    def test_auto_default_off_on_cpu(self):
+        from kubeflow_tpu.models import llama as L
+        from kubeflow_tpu.models.paged import PagedBatcher
+
+        cfg = LLAMA_CONFIGS["tiny"]
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        pb = PagedBatcher(params, cfg)
+        assert pb.attn_kernel is False  # tests force the CPU backend
