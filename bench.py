@@ -639,6 +639,44 @@ def run_full_bench(results: list, artifact: str | None = None) -> None:
                 f"(block pool {nblocks}x16)",
             )
 
+        # Dense engine, same question: ContinuousBatcher at a roomy
+        # cache_len with short fills — XLA reads all C slots per step,
+        # the length-bounded kernel reads each slot's filled prefix.
+        from kubeflow_tpu.models.continuous import ContinuousBatcher
+
+        C = 128 if smoke else 2048
+
+        def timed_dense(steps: int, attn_kernel: bool) -> float:
+            # Compiled shapes depend on slots/cache_len/prompt_bucket
+            # only (the dense cache is fixed-size), so different steps
+            # values share one executable and compile time cancels.
+            times = []
+            for _ in range(2):
+                cb = ContinuousBatcher(
+                    params, cfg,
+                    gen=GenerationConfig(max_new_tokens=steps, eos_id=-1),
+                    slots=bs, cache_len=C, prompt_bucket=max(16, plen),
+                    attn_kernel=attn_kernel,
+                )
+                for p in prompts:
+                    cb.submit(p)
+                t0 = time.perf_counter()
+                cb.run()
+                times.append(time.perf_counter() - t0)
+            return min(times)
+
+        for attn_kernel, label in ((False, "xla"), (True, "kernel")):
+            timed_dense(2, attn_kernel)
+            t1 = timed_dense(d1, attn_kernel)
+            t2 = timed_dense(d2, attn_kernel)
+            report(
+                f"{big} int8 dense decode tokens/sec (bs={bs}, cache {C}, "
+                f"{label} attention)",
+                bs * (d2 - d1) / (t2 - t1), "tokens/sec",
+                "(length-bounded cache reads)" if attn_kernel else
+                "(XLA reads all cache slots)",
+            )
+
     def decode_attr_section():
         # Decode-step ATTRIBUTION (bs=1 bf16 7B, the headline config):
         # where does the per-token time go? Each component is timed as a

@@ -98,7 +98,10 @@ def _admit_slot(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "temperature", "top_k", "top_p", "decode_attn"),
+    static_argnames=(
+        "cfg", "temperature", "top_k", "top_p", "decode_attn",
+        "attn_kernel",
+    ),
     donate_argnums=(3,),
 )
 def _cb_step(
@@ -113,12 +116,17 @@ def _cb_step(
     top_k: int,
     top_p: float,
     decode_attn=None,  # mesh-bound SP decode (make_sharded_sp_decode)
+    attn_kernel: int = 0,  # >0: pallas length-bounded decode, chunk size
 ) -> tuple[jax.Array, dict]:
     """One decode step across every slot at its own position.
 
     ``decode_attn`` (static) swaps the attention for a mesh-bound
     sequence-parallel split-KV decode when the cache's sequence axis is
-    sharded over sp; None is the dense/GSPMD path."""
+    sharded over sp; None is the dense/GSPMD path. ``attn_kernel`` > 0
+    swaps the XLA attention for ops/paged_attention.py's dense kernel
+    with that chunk size: XLA reads ALL cache_len slots per step, the
+    kernel reads each slot's filled prefix only (bf16 caches, no
+    window, no sp)."""
     x = _embed(params, cfg, tokens)  # (B, 1, D)
     cos, sin = rope_frequencies(cfg, positions)  # (B, half)
 
@@ -134,7 +142,17 @@ def _cb_step(
         # structure decides the storage format (quantize-on-write when the
         # scale leaves are present — models.llama init_kv_cache kv_bits=8).
         cache_l = _cache_store_rows(cache_l, k, v, positions)
-        if decode_attn is None:
+        if attn_kernel and decode_attn is None and "k_scale" not in cache_l:
+            from kubeflow_tpu.ops.paged_attention import (
+                dense_decode_attention,
+            )
+
+            attn = dense_decode_attention(
+                q[:, :, 0, :], cache_l["k"], cache_l["v"], kv_mask,
+                positions + 1, block_size=attn_kernel,
+                interpret=jax.default_backend() not in ("tpu", "axon"),
+            )[:, :, None, :]
+        elif decode_attn is None:
             attn = _gqa_decode_attention(
                 q, cache_l["k"], cache_l["v"], positions,
                 window=cfg.sliding_window, kv_mask=kv_mask, per_batch=True,
@@ -264,8 +282,53 @@ class ContinuousBatcher(_BatcherBase):
         key: Optional[jax.Array] = None,
         plan=None,  # parallel.mesh.MeshPlan → tp/sp-sharded serving
         kv_bits: int = 0,  # 8 → int8 KV storage (halved cache HBM)
+        attn_kernel: Optional[bool] = None,  # length-bounded pallas decode
     ):
         self.gen = gen or GenerationConfig()
+        # Length-bounded decode attention (ops/paged_attention.py dense
+        # kernel): XLA reads ALL cache_len slots per step; the kernel
+        # reads each slot's filled prefix only. Auto-on under the TPU
+        # backend for plain bf16 single-device serving; explicit True
+        # with an unsupported composition is a reasoned rejection, never
+        # a silent fallback.
+        if attn_kernel:
+            if plan is not None:
+                raise ValueError(
+                    "attn_kernel=True does not compose with plan= (the "
+                    "dense kernel is single-device) — drop one of the two"
+                )
+            if kv_bits:
+                raise ValueError(
+                    "attn_kernel=True does not compose with kv_bits (the "
+                    "kernel reads bf16 caches) — drop one of the two"
+                )
+            if cfg.sliding_window:
+                raise ValueError(
+                    "attn_kernel=True does not support sliding-window "
+                    "configs — drop attn_kernel for this model"
+                )
+        explicit = attn_kernel is True
+        if attn_kernel is None:
+            attn_kernel = (
+                jax.default_backend() in ("tpu", "axon") and plan is None
+                and not kv_bits and not cfg.sliding_window
+            )
+        # Chunk size: the largest power-of-two divisor of cache_len in
+        # [16, 512]. EXPLICIT True with an indivisible cache_len raises
+        # (same contract as plan/kv_bits/window above); the auto default
+        # quietly keeps XLA only because nothing was requested.
+        self._attn_kernel = 0
+        if attn_kernel:
+            for cand in (512, 256, 128, 64, 32, 16):
+                if cache_len % cand == 0:
+                    self._attn_kernel = cand
+                    break
+            if explicit and not self._attn_kernel:
+                raise ValueError(
+                    f"attn_kernel=True needs cache_len divisible by a "
+                    f"power of two in [16, 512]; {cache_len} is not — "
+                    "adjust cache_len or drop attn_kernel"
+                )
         if prompt_bucket + self.gen.max_new_tokens > cache_len:
             raise ValueError(
                 f"cache_len {cache_len} too small for prompt_bucket "
@@ -360,6 +423,7 @@ class ContinuousBatcher(_BatcherBase):
             jnp.array(self.positions), self.kv_mask, sub,
             self.gen.temperature, self.gen.top_k, self.gen.top_p,
             decode_attn=self._decode_attn,
+            attn_kernel=self._attn_kernel,
         )
         # The emitted token will occupy the next cache index of its slot.
         for slot in active:

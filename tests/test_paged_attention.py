@@ -161,3 +161,68 @@ class TestBatcherIntegration:
         params = L.init_params(cfg, jax.random.PRNGKey(0))
         pb = PagedBatcher(params, cfg)
         assert pb.attn_kernel is False  # tests force the CPU backend
+
+
+class TestDenseKernel:
+    def test_matches_xla_decode_attention(self):
+        from kubeflow_tpu.ops.paged_attention import dense_decode_attention
+
+        ks = jax.random.split(jax.random.PRNGKey(4), 3)
+        b, hq, hkv, d, c = 3, 8, 4, 128, 256
+        q = jax.random.normal(ks[0], (b, hq, d), jnp.bfloat16)
+        kc = jax.random.normal(ks[1], (b, hkv, c, d), jnp.bfloat16)
+        vc = jax.random.normal(ks[2], (b, hkv, c, d), jnp.bfloat16)
+        seq_lens = jnp.array([1, 100, 256], jnp.int32)
+        kv_mask = jnp.ones((b, c), bool).at[1, 10:20].set(False)
+        out = dense_decode_attention(q, kc, vc, kv_mask, seq_lens, 64,
+                                     interpret=True)
+        ref = _gqa_decode_attention(
+            q[:, :, None, :], kc, vc, seq_lens - 1, kv_mask=kv_mask,
+            per_batch=True,
+        )[:, :, 0, :]
+        _assert_close(out, ref)
+
+    def test_continuous_batcher_kernel_token_parity(self):
+        """ContinuousBatcher(attn_kernel=True) must emit the same greedy
+        tokens as the XLA-attention batcher."""
+        from kubeflow_tpu.models import llama as L
+        from kubeflow_tpu.models.continuous import ContinuousBatcher
+        from kubeflow_tpu.models.serving import GenerationConfig
+
+        cfg = LLAMA_CONFIGS["tiny"]
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        gen = GenerationConfig(max_new_tokens=8)
+        prompts = [[1, 2, 3, 4, 5], [7, 8, 9], [11, 12, 13, 14]]
+
+        def serve(attn_kernel):
+            cb = ContinuousBatcher(params, cfg, gen=gen, slots=2,
+                                   cache_len=128, prompt_bucket=16,
+                                   attn_kernel=attn_kernel)
+            rids = [cb.submit(p) for p in prompts]
+            outs = cb.run()
+            return [outs[r] for r in rids]
+
+        assert serve(True) == serve(False)
+
+    def test_continuous_rejections_and_auto_off(self):
+        import dataclasses
+
+        from kubeflow_tpu.models import llama as L
+        from kubeflow_tpu.models.continuous import ContinuousBatcher
+        from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+
+        cfg = LLAMA_CONFIGS["tiny"]
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="kv_bits"):
+            ContinuousBatcher(params, cfg, kv_bits=8, attn_kernel=True)
+        with pytest.raises(ValueError, match="plan"):
+            ContinuousBatcher(params, cfg, attn_kernel=True,
+                              plan=MeshPlan(make_mesh(tp=2, dp=4)))
+        wcfg = dataclasses.replace(cfg, sliding_window=8)
+        with pytest.raises(ValueError, match="sliding-window"):
+            ContinuousBatcher(params, wcfg, attn_kernel=True)
+        # explicit True with an indivisible cache_len raises, never a
+        # silent XLA fallback
+        with pytest.raises(ValueError, match="divisible"):
+            ContinuousBatcher(params, cfg, cache_len=1000, attn_kernel=True)
+        assert ContinuousBatcher(params, cfg)._attn_kernel == 0  # CPU
