@@ -1,0 +1,111 @@
+"""Serve a model over HTTP from inside a TPU notebook.
+
+The in-notebook complement to the controller's network plumbing: the
+webhook/NetworkPolicy stack exposes notebook ports; this gives one of
+them an OpenAI-completions-shaped inference endpoint over the
+continuous-batching engines.
+
+    python examples/serve_http.py --config tiny --port 8000 &
+    curl -s localhost:8000/v1/completions \
+      -d '{"prompt": [1, 2, 3, 4], "max_tokens": 8}'
+    curl -s localhost:8000/stats
+
+``--checkpoint`` loads HF weights + tokenizer (text prompts + decoded
+text in responses); without it, a random-init model serves token ids —
+enough to exercise the transport end to end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import signal
+import sys
+import threading
+
+# Runnable straight from a checkout (pip install not required in-notebook).
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="tiny")
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--cache-len", type=int, default=1024)
+    ap.add_argument("--prompt-bucket", type=int, default=64)
+    ap.add_argument("--max-new-tokens", type=int, default=128)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--int8", action="store_true",
+                    help="int8 weight-only quantization")
+    ap.add_argument("--paged", action="store_true",
+                    help="serve through the paged block-pool engine")
+    ap.add_argument("--num-blocks", type=int, default=256)
+    args = ap.parse_args()
+
+    import jax
+
+    from kubeflow_tpu.runtime.bootstrap import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
+    from kubeflow_tpu.models import llama as L
+    from kubeflow_tpu.models.serving import GenerationConfig
+    from kubeflow_tpu.models.server import InferenceServer
+
+    tokenizer = None
+    if args.checkpoint:
+        from kubeflow_tpu.models.convert import load_hf_checkpoint
+
+        cfg, params = load_hf_checkpoint(args.checkpoint)
+        try:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(args.checkpoint)
+        except Exception as err:
+            print(f"no tokenizer ({err}); serving token ids only",
+                  flush=True)
+    else:
+        cfg = L.LLAMA_CONFIGS[args.config]
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+    if args.int8:
+        from kubeflow_tpu.models.quant import quantize_params
+
+        params = quantize_params(params, free_source=True)
+
+    gen = GenerationConfig(max_new_tokens=args.max_new_tokens,
+                           temperature=args.temperature)
+    if args.paged:
+        from kubeflow_tpu.models.paged import PagedBatcher
+
+        engine = PagedBatcher(
+            params, cfg, gen=gen, slots=args.slots,
+            num_blocks=args.num_blocks,
+            prompt_bucket=args.prompt_bucket,
+        )
+    else:
+        from kubeflow_tpu.models.continuous import ContinuousBatcher
+
+        engine = ContinuousBatcher(
+            params, cfg, gen=gen, slots=args.slots,
+            cache_len=args.cache_len, prompt_bucket=args.prompt_bucket,
+        )
+
+    srv = InferenceServer(engine, host=args.host, port=args.port,
+                          tokenizer=tokenizer,
+                          model_name=args.checkpoint or args.config).start()
+    print(f"serving {args.config} on http://{srv.host}:{srv.port} "
+          f"({'paged' if args.paged else 'continuous'}, "
+          f"{args.slots} slots)", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()

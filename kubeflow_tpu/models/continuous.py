@@ -192,6 +192,10 @@ class _Request:
     prompt: list[int]
     tokens: list[int] = dataclasses.field(default_factory=list)
     budget: int = 0
+    # Per-request cap (None = the engine-wide gen.max_new_tokens). Admits
+    # clamp to the engine-wide value: cache/table shapes are compiled for
+    # it, so a request can ask for less, never more.
+    max_new: Optional[int] = None
     # Paged batcher only: physical block ids this request holds, in
     # position order. Harmless (empty) for the fixed-slot batcher.
     blocks: list[int] = dataclasses.field(default_factory=list)
@@ -216,8 +220,16 @@ class _BatcherBase:
         self._by_slot: list[Optional[_Request]] = [None] * slots
         self._results: dict[int, list[int]] = {}
         self._next_rid = 0
+        # Serving-frontend hooks (models/server.py): called under the
+        # frontend's engine lock. on_token(rid, token) per emitted token;
+        # on_retire(rid, tokens) when a request completes — when set,
+        # completed requests are DELIVERED instead of accumulating in
+        # _results (a long-running server must not grow without bound).
+        self.on_token = None
+        self.on_retire = None
 
-    def submit(self, prompt: Sequence[int]) -> int:
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None) -> int:
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) > self.prompt_bucket:
@@ -225,10 +237,19 @@ class _BatcherBase:
                 f"prompt length {len(prompt)} exceeds bucket "
                 f"{self.prompt_bucket} (raise prompt_bucket)"
             )
+        if max_new_tokens is not None and max_new_tokens <= 0:
+            raise ValueError(f"max_new_tokens must be > 0, got {max_new_tokens}")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, list(prompt)))
+        self._queue.append(_Request(rid, list(prompt), max_new=max_new_tokens))
         return rid
+
+    def _initial_budget(self, req: _Request) -> int:
+        """Per-request budget at admit time, clamped to the engine-wide
+        max (every compiled shape is sized for gen.max_new_tokens)."""
+        if req.max_new is None:
+            return self.gen.max_new_tokens
+        return min(req.max_new, self.gen.max_new_tokens)
 
     def run(self) -> dict[int, list[int]]:
         """Drive until queue and slots drain; returns {rid: tokens}."""
@@ -249,6 +270,8 @@ class _BatcherBase:
             self._retire(slot)
             return
         req.tokens.append(token)
+        if self.on_token is not None:
+            self.on_token(req.rid, token)
         if req.budget <= 0:
             self._retire(slot)
             return
@@ -259,7 +282,11 @@ class _BatcherBase:
         speculative batchers prefill their draft cache here)."""
 
     def _retire(self, slot: int) -> None:
-        self._results[self._by_slot[slot].rid] = self._by_slot[slot].tokens
+        req = self._by_slot[slot]
+        if self.on_retire is not None:
+            self.on_retire(req.rid, req.tokens)
+        else:
+            self._results[req.rid] = req.tokens
         self._release_slot(slot)
 
 
@@ -401,7 +428,7 @@ class ContinuousBatcher(_BatcherBase):
             )
             self.positions[slot] = self.prompt_bucket
             self._by_slot[slot] = req
-            req.budget = self.gen.max_new_tokens
+            req.budget = self._initial_budget(req)
             self._note_token(slot, first)
 
     def _release_slot(self, slot: int) -> None:

@@ -617,7 +617,7 @@ class PagedBatcher(_BatcherBase):
                 self.gen.top_p,
             )[0]
         )
-        req.budget = self.gen.max_new_tokens - len(req.tokens)
+        req.budget = self._initial_budget(req) - len(req.tokens)
         self._by_slot[slot] = req
         self._post_admit(slot, draft_tokens, draft_mask)
         self._note_token(slot, first)

@@ -1,0 +1,303 @@
+"""In-notebook HTTP inference server over the batching engines.
+
+The serving stack's missing front door: the engines (ContinuousBatcher,
+PagedBatcher, the speculative pair) are drive-to-completion batch APIs —
+a notebook cell submits N prompts and collects N results. A live
+endpoint needs the opposite shape: requests arrive whenever, responses
+stream back while other slots keep decoding. ``InferenceServer`` puts a
+stdlib ThreadingHTTPServer in front of ONE engine thread:
+
+- HTTP handler threads ``submit()`` under the engine lock and block on
+  (or stream from) a per-request queue;
+- the engine thread loops admit → step while any work exists, sleeping
+  on a condition variable when idle — continuous batching across
+  requests that never saw each other;
+- per-token delivery rides the engines' ``on_token``/``on_retire``
+  hooks (models/continuous.py _BatcherBase), so all four engines serve
+  unmodified.
+
+Endpoints (OpenAI-completions-shaped, token-native):
+- ``POST /v1/completions``: ``{"prompt": [ids] | "text", "max_tokens":
+  n?, "stream": false?}`` → ``{"id", "choices": [{"tokens", "text"?}],
+  "usage": {...}}``; with ``"stream": true`` the response is
+  ``text/event-stream`` lines ``data: {"token": id, "text"?: s}`` ending
+  with ``data: [DONE]``. Text prompts require a ``tokenizer``.
+- ``GET /healthz`` — liveness; ``GET /v1/models`` — the served config;
+  ``GET /stats`` — active slots / queue depth / served counts.
+
+Reference parity: the reference deploys notebook POD plumbing and leaves
+what runs inside to the user (no serving stack at all — SURVEY.md §2.5);
+this is added TPU-runtime scope, the consuming end of the controller's
+NB_PREFIX/port wiring.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_DONE = object()  # sentinel closing a request's token queue
+
+
+class InferenceServer:
+    """HTTP front-end driving one batching engine on a background thread.
+
+    >>> engine = ContinuousBatcher(params, cfg, slots=4, cache_len=512)
+    >>> srv = InferenceServer(engine, port=0)   # 0 = ephemeral
+    >>> srv.start()
+    >>> # POST http://127.0.0.1:{srv.port}/v1/completions
+    >>> srv.stop()
+    """
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 8000,
+                 tokenizer=None, model_name: str = "kubeflow-tpu"):
+        # The speculative engines are thin wrappers delegating to an
+        # inner batcher (`_engine`) that owns the queue/slots/step loop —
+        # hooks and the drive loop must target the inner one.
+        self.engine = getattr(engine, "_engine", engine)
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queues: dict[int, queue.Queue] = {}
+        self._shutdown = False
+        self._served = 0
+        self._engine_error: Optional[str] = None
+        self._httpd = ThreadingHTTPServer((host, port), self._handler_class())
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._engine_thread = threading.Thread(
+            target=self._drive, name="inference-engine", daemon=True
+        )
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="inference-http",
+            daemon=True,
+        )
+        # Hooks go on the RESOLVED engine — it is the object whose
+        # _note_token/_retire read them; the spec wrappers forward nothing.
+        self.engine.on_token = self._on_token
+        self.engine.on_retire = self._on_retire
+
+    # -- engine side (all under self._lock) --------------------------------
+
+    def _on_token(self, rid: int, token: int) -> None:
+        q = self._queues.get(rid)
+        if q is not None:
+            q.put(token)
+
+    def _on_retire(self, rid: int, tokens: list) -> None:
+        self._served += 1
+        q = self._queues.get(rid)
+        if q is not None:
+            q.put(_DONE)
+
+    def _drive(self) -> None:
+        while True:
+            with self._work:
+                while not self._shutdown and not self._has_work():
+                    self._work.wait(timeout=0.5)
+                if self._shutdown:
+                    return
+                # Admit + one decode step under the lock: handler threads
+                # only ever touch the engine between steps.
+                try:
+                    self.engine._admit_free_slots()
+                    self.engine._step()
+                except Exception as err:  # device OOM, preemption, ...
+                    # The engine is in an unknown state: fail loudly —
+                    # close every pending queue so no handler blocks
+                    # forever, flip /healthz red, and stop driving. A
+                    # silently-dead daemon thread would leave a hung
+                    # server that health checks keep calling healthy.
+                    self._engine_error = f"{type(err).__name__}: {err}"
+                    for q in self._queues.values():
+                        q.put(_DONE)
+                    return
+
+    def _has_work(self) -> bool:
+        return bool(self.engine._queue) or any(
+            r is not None for r in self.engine._by_slot
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        self._engine_thread.start()
+        self._http_thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._work:
+            self._shutdown = True
+            self._work.notify_all()
+        self._httpd.shutdown()
+        self._httpd.server_close()  # release the listening socket NOW
+        self._engine_thread.join(timeout=10)
+
+    # -- HTTP side ---------------------------------------------------------
+
+    def _submit(self, prompt: list[int],
+                max_tokens: Optional[int]) -> tuple[int, queue.Queue]:
+        q: queue.Queue = queue.Queue()
+        with self._work:
+            rid = self.engine.submit(prompt, max_new_tokens=max_tokens)
+            self._queues[rid] = q
+            self._work.notify_all()
+        return rid, q
+
+    def _finish(self, rid: int) -> None:
+        with self._lock:
+            self._queues.pop(rid, None)
+
+    def _decode_prompt(self, prompt) -> list[int]:
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError(
+                    "text prompt needs a tokenizer; send token ids"
+                )
+            return list(self.tokenizer(prompt)["input_ids"])
+        if (isinstance(prompt, list)
+                and all(isinstance(t, int) for t in prompt)):
+            return prompt
+        raise ValueError("prompt must be a string or a list of token ids")
+
+    def _text(self, tokens: list[int]) -> Optional[str]:
+        if self.tokenizer is None:
+            return None
+        return self.tokenizer.decode(tokens)
+
+    def _handler_class(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # Decode steps can take seconds under load; keep-alive off so
+            # clients never wait on a half-closed connection.
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet by default
+                pass
+
+            def _json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    if server._engine_error is not None:
+                        self._json(503, {"status": "engine failed",
+                                         "error": server._engine_error})
+                    else:
+                        self._json(200, {"status": "ok"})
+                elif self.path == "/v1/models":
+                    self._json(200, {
+                        "object": "list",
+                        "data": [{"id": server.model_name,
+                                  "object": "model"}],
+                    })
+                elif self.path == "/stats":
+                    with server._lock:
+                        active = sum(
+                            r is not None for r in server.engine._by_slot
+                        )
+                        depth = len(server.engine._queue)
+                    self._json(200, {
+                        "active_slots": active,
+                        "queued": depth,
+                        "slots": server.engine.slots,
+                        "served": server._served,
+                    })
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/v1/completions":
+                    self._json(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    prompt = server._decode_prompt(req.get("prompt"))
+                    max_tokens = req.get("max_tokens")
+                    if max_tokens is not None and (
+                        not isinstance(max_tokens, int)
+                        or isinstance(max_tokens, bool)
+                    ):
+                        raise ValueError(
+                            f"max_tokens must be an integer, got "
+                            f"{max_tokens!r}"
+                        )
+                    stream = bool(req.get("stream", False))
+                except (ValueError, TypeError, json.JSONDecodeError) as err:
+                    self._json(400, {"error": str(err)})
+                    return
+                try:
+                    rid, q = server._submit(prompt, max_tokens)
+                except ValueError as err:  # over-bucket prompt etc.
+                    self._json(400, {"error": str(err)})
+                    return
+                try:
+                    if stream:
+                        self._stream(rid, q)
+                    else:
+                        self._complete(rid, q, len(prompt))
+                finally:
+                    server._finish(rid)
+
+            def _complete(self, rid, q, prompt_len):
+                tokens = []
+                while True:
+                    item = q.get()
+                    if item is _DONE:
+                        break
+                    tokens.append(item)
+                if server._engine_error is not None:
+                    self._json(500, {"error": server._engine_error,
+                                     "partial_tokens": tokens})
+                    return
+                choice = {"index": 0, "tokens": tokens,
+                          "finish_reason": "stop"}
+                text = server._text(tokens)
+                if text is not None:
+                    choice["text"] = text
+                self._json(200, {
+                    "id": f"cmpl-{rid}",
+                    "object": "text_completion",
+                    "model": server.model_name,
+                    "choices": [choice],
+                    "usage": {
+                        "prompt_tokens": prompt_len,
+                        "completion_tokens": len(tokens),
+                        "total_tokens": prompt_len + len(tokens),
+                    },
+                })
+
+            def _stream(self, rid, q):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                # Length-unknown: close delimits the body.
+                self.send_header("Connection", "close")
+                self.end_headers()
+                while True:
+                    item = q.get()
+                    if item is _DONE:
+                        self.wfile.write(b"data: [DONE]\n\n")
+                        self.wfile.flush()
+                        return
+                    payload = {"id": f"cmpl-{rid}", "token": item}
+                    text = server._text([item])
+                    if text is not None:
+                        payload["text"] = text
+                    self.wfile.write(
+                        b"data: " + json.dumps(payload).encode() + b"\n\n"
+                    )
+                    self.wfile.flush()
+
+        return Handler

@@ -279,8 +279,8 @@ class _SpecServingBase:
 
     # -- public surface (delegated) ----------------------------------------
 
-    def submit(self, prompt) -> int:
-        return self._engine.submit(prompt)
+    def submit(self, prompt, max_new_tokens=None) -> int:
+        return self._engine.submit(prompt, max_new_tokens=max_new_tokens)
 
     def run(self) -> dict:
         return self._engine.run()

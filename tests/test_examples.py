@@ -95,6 +95,44 @@ def test_serve_batched_runs(extra):
     assert "[2]" in res.stdout  # three prompts served
 
 
+def test_serve_http_example(tmp_path):
+    """serve_http.py answers real HTTP completions (paged engine)."""
+    import json
+    import subprocess
+    import time
+    import urllib.request
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, str(EXAMPLES / "serve_http.py"), "--config",
+         "tiny", "--port", "0", "--paged", "--max-new-tokens", "4"],
+        env=env, cwd=str(EXAMPLES.parent),
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        line = proc.stdout.readline()  # "serving ... on http://host:port"
+        port = int(line.rsplit(":", 1)[1].split()[0])
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/completions",
+                    data=json.dumps({"prompt": [1, 2, 3]}).encode(),
+                )
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    out = json.loads(resp.read())
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(1)
+        assert len(out["choices"][0]["tokens"]) == 4
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+
+
 def test_train_sharded_fp8(tmp_path):
     """--fp8 trains with fp8 matmul operands end to end (wrap + OWG
     optimizer partitioning + checkpoint save)."""

@@ -1,0 +1,214 @@
+"""HTTP inference server (models/server.py) over the batching engines.
+
+Real sockets, real threads: each test starts the server on an ephemeral
+port, speaks actual HTTP with urllib, and asserts token-exactness
+against the engine driven directly — the server is transport, not
+model, so its output must be bit-identical to run().
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from kubeflow_tpu.models import llama as L
+from kubeflow_tpu.models.continuous import ContinuousBatcher
+from kubeflow_tpu.models.serving import GenerationConfig
+from kubeflow_tpu.models.server import InferenceServer
+
+CFG = L.LLAMA_CONFIGS["tiny"]
+PARAMS = L.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _engine(**kw):
+    kw.setdefault("gen", GenerationConfig(max_new_tokens=8))
+    kw.setdefault("slots", 2)
+    kw.setdefault("cache_len", 128)
+    kw.setdefault("prompt_bucket", 16)
+    return ContinuousBatcher(PARAMS, CFG, **kw)
+
+
+def _post(port, payload, path="/v1/completions"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture()
+def server():
+    srv = InferenceServer(_engine(), port=0).start()
+    yield srv
+    srv.stop()
+
+
+class TestCompletions:
+    def test_tokens_match_direct_engine_run(self, server):
+        prompt = [1, 2, 3, 4, 5]
+        out = _post(server.port, {"prompt": prompt})
+        direct = _engine()
+        rid = direct.submit(prompt)
+        want = direct.run()[rid]
+        assert out["choices"][0]["tokens"] == want
+        assert out["usage"]["completion_tokens"] == len(want)
+        assert out["usage"]["prompt_tokens"] == len(prompt)
+
+    def test_per_request_max_tokens(self, server):
+        out = _post(server.port, {"prompt": [1, 2, 3], "max_tokens": 3})
+        assert len(out["choices"][0]["tokens"]) == 3
+
+    def test_concurrent_requests_share_the_batch(self, server):
+        prompts = [[1, 2, 3], [5, 6, 7, 8], [9, 10]]
+        results = {}
+
+        def call(i):
+            results[i] = _post(server.port, {"prompt": prompts[i]})
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        direct = _engine()
+        rids = [direct.submit(p) for p in prompts]
+        want = direct.run()
+        for i, rid in enumerate(rids):
+            assert results[i]["choices"][0]["tokens"] == want[rid], i
+
+    def test_streaming_matches_non_streaming(self, server):
+        prompt = [2, 4, 6]
+        want = _post(server.port, {"prompt": prompt})["choices"][0]["tokens"]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            data=json.dumps({"prompt": prompt, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        tokens, done = [], False
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers["Content-Type"] == "text/event-stream"
+            for raw in resp:
+                line = raw.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                body = line[len("data: "):]
+                if body == "[DONE]":
+                    done = True
+                    break
+                tokens.append(json.loads(body)["token"])
+        assert done
+        assert tokens == want
+
+    def test_bad_requests(self, server):
+        for payload in (
+            {"prompt": "text without tokenizer"},
+            {"prompt": [1, "a"]},
+            {"prompt": []},
+            {"prompt": list(range(50))},  # over prompt_bucket
+            {"prompt": [1], "max_tokens": 0},
+        ):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(server.port, payload)
+            assert err.value.code == 400, payload
+
+    def test_health_models_stats(self, server):
+        assert _get(server.port, "/healthz")["status"] == "ok"
+        models = _get(server.port, "/v1/models")["data"]
+        assert models[0]["id"] == "kubeflow-tpu"
+        _post(server.port, {"prompt": [1, 2]})
+        stats = _get(server.port, "/stats")
+        assert stats["served"] >= 1
+        assert stats["slots"] == 2
+
+    def test_results_do_not_accumulate(self, server):
+        """A long-running server must deliver results, not hoard them."""
+        for _ in range(3):
+            _post(server.port, {"prompt": [1, 2, 3], "max_tokens": 2})
+        assert server.engine._results == {}
+        assert server._queues == {}
+
+
+class TestRobustness:
+    def test_speculative_engine_serves(self):
+        """The spec wrappers delegate to an inner engine; hooks must land
+        on the object whose _note_token reads them or completions hang."""
+        from kubeflow_tpu.models.speculative import (
+            SpeculativeContinuousBatcher, truncated_draft,
+        )
+
+        draft, dcfg = truncated_draft(PARAMS, CFG, 1)
+        spec = SpeculativeContinuousBatcher(
+            PARAMS, CFG, draft, dcfg,
+            gen=GenerationConfig(max_new_tokens=6),
+            slots=2, cache_len=128, prompt_bucket=16, k_spec=2,
+        )
+        srv = InferenceServer(spec, port=0).start()
+        try:
+            out = _post(srv.port, {"prompt": [1, 2, 3, 4]})
+            assert len(out["choices"][0]["tokens"]) == 6
+        finally:
+            srv.stop()
+
+    def test_bad_max_tokens_type_is_400(self, server):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server.port, {"prompt": [1], "max_tokens": "8"})
+        assert err.value.code == 400
+
+    def test_engine_failure_unblocks_and_flips_health(self):
+        """A step exception must fail pending requests (500) and turn
+        /healthz red — never a silently-dead thread + hung clients."""
+        srv = InferenceServer(_engine(), port=0)
+
+        def boom():
+            raise RuntimeError("synthetic device loss")
+
+        srv.engine._step = boom
+        srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(srv.port, {"prompt": [1, 2, 3]})
+            assert err.value.code == 500
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(srv.port, "/healthz")
+            assert err.value.code == 503
+        finally:
+            srv.stop()
+
+    def test_stop_releases_the_port(self):
+        srv = InferenceServer(_engine(), port=0).start()
+        port = srv.port
+        srv.stop()
+        # rebinding the same port must succeed immediately
+        srv2 = InferenceServer(_engine(), port=port).start()
+        try:
+            assert _get(port, "/healthz")["status"] == "ok"
+        finally:
+            srv2.stop()
+
+
+class TestEngineHooks:
+    def test_run_without_hooks_unchanged(self):
+        """The hook plumbing must not change the drive-to-completion
+        API: no callbacks set → results land in run() as before."""
+        eng = _engine()
+        rid = eng.submit([1, 2, 3])
+        out = eng.run()
+        assert rid in out and len(out[rid]) > 0
+
+    def test_max_new_tokens_clamped_to_engine_max(self):
+        eng = _engine()
+        rid = eng.submit([1, 2, 3], max_new_tokens=50)  # gen.max is 8
+        assert len(eng.run()[rid]) <= 8
