@@ -32,7 +32,9 @@ def main() -> None:
     ap.add_argument("--config", default="tiny")
     ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--host", default="127.0.0.1")
-    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--port", type=int, default=None,
+                    help="default: the webhook-projected "
+                         "KUBEFLOW_TPU_SERVING_PORT, else 8000")
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--cache-len", type=int, default=1024)
     ap.add_argument("--prompt-bucket", type=int, default=64)
@@ -53,7 +55,13 @@ def main() -> None:
 
     from kubeflow_tpu.models import llama as L
     from kubeflow_tpu.models.serving import GenerationConfig
-    from kubeflow_tpu.models.server import InferenceServer
+    from kubeflow_tpu.models.server import (
+        InferenceServer,
+        serving_port_from_env,
+    )
+
+    if args.port is None:
+        args.port = serving_port_from_env()
 
     tokenizer = None
     if args.checkpoint:

@@ -62,6 +62,14 @@ QUANT_ENV_NAME = "KUBEFLOW_TPU_QUANT"
 # through a port-forward or the gateway).
 TPU_PROFILING_PORT = "notebooks.kubeflow.org/tpu-profiling-port"
 PROFILING_ENV_NAME = "KUBEFLOW_TPU_PROFILING_PORT"
+# In-notebook HTTP inference endpoint (models/server.py): the webhook
+# projects the port into KUBEFLOW_TPU_SERVING_PORT (examples/serve_http
+# binds it), the ctrl NetworkPolicy opens it, and the controller surfaces
+# worker-0's address as status.tpu.servingEndpoint. Same port rules as
+# profiling (range at parse, reserved-ports at admission), plus the two
+# annotations may not claim the SAME port on one notebook.
+TPU_SERVING_PORT = "notebooks.kubeflow.org/tpu-serving-port"
+SERVING_ENV_NAME = "KUBEFLOW_TPU_SERVING_PORT"
 
 
 def _load_reserved_ports() -> dict:

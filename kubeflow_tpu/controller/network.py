@@ -78,11 +78,13 @@ def new_ctrl_policy(
 
 def _allowed_ports(nb: Notebook) -> list[int]:
     """8888 always; the profiling-port annotation opens the jax.profiler
-    server to the same peers (xprof connects via port-forward/gateway)."""
+    server to the same peers (xprof connects via port-forward/gateway);
+    the serving-port annotation opens the HTTP inference endpoint."""
     ports = [NOTEBOOK_PORT]
-    prof = ann.parse_profiling_port(nb.annotations.get(ann.TPU_PROFILING_PORT))
-    if prof is not None:
-        ports.append(prof)
+    for key in (ann.TPU_PROFILING_PORT, ann.TPU_SERVING_PORT):
+        port = ann.parse_profiling_port(nb.annotations.get(key))
+        if port is not None:
+            ports.append(port)
     return ports
 
 

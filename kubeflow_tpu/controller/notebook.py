@@ -594,6 +594,18 @@ class NotebookReconciler(Reconciler):
                     f"{headless_service_name(nb.name)}."
                     f"{nb.namespace}.svc.{self.config.cluster_domain}:{prof}"
                 )
+            serving = ann.parse_profiling_port(
+                nb.annotations.get(ann.TPU_SERVING_PORT)
+            )
+            if serving is not None:
+                # Worker 0 binds the HTTP inference endpoint on this port
+                # (models/server.py serving_port_from_env).
+                status["tpu"]["servingEndpoint"] = (
+                    f"{slice_sts_name(nb.name, 0)}-0."
+                    f"{headless_service_name(nb.name)}."
+                    f"{nb.namespace}.svc.{self.config.cluster_domain}"
+                    f":{serving}"
+                )
             if health == "Healthy":
                 self._observe_slice_ready(nb)
 

@@ -69,6 +69,7 @@ def _tpu_status_schema() -> dict:
             "acceleratorType": {"type": "string"},
             "jaxCoordinator": {"type": "string"},
             "profilingServer": {"type": "string"},
+            "servingEndpoint": {"type": "string"},
             "slices": {"type": "integer"},
             "hostsPerSlice": {"type": "integer"},
         },

@@ -637,7 +637,7 @@ class PagedBatcher(_BatcherBase):
         req = self._by_slot[slot]
         self._release_slot(slot)
         # Front of the queue: a preempted request outranks new arrivals.
-        cont = _Request(req.rid, req.prompt, req.tokens)
+        cont = _Request(req.rid, req.prompt, req.tokens, max_new=req.max_new)
         self._queue.insert(0, cont)
 
     def _release_slot(self, slot: int) -> None:
@@ -766,7 +766,7 @@ class PagedBatcher(_BatcherBase):
             self._finish_admit(
                 slot,
                 _Request(req.rid, req.prompt, generated, blocks=blocks,
-                         shared=shared),
+                         shared=shared, max_new=req.max_new),
                 logits, jnp.asarray(padded), prompt_mask,
             )
 
@@ -888,7 +888,8 @@ class PagedBatcher(_BatcherBase):
                 slot,
                 _Request(req.rid, req.prompt, generated,
                          blocks=all_blocks,
-                         shared=frozenset(all_blocks[:registrable])),
+                         shared=frozenset(all_blocks[:registrable]),
+                         max_new=req.max_new),
                 logits, jnp.asarray(dpad), None,
             )
 

@@ -42,6 +42,32 @@ from typing import Optional
 _DONE = object()  # sentinel closing a request's token queue
 
 
+class EngineFailedError(RuntimeError):
+    """The engine thread is dead (or shutting down); submits are refused."""
+
+
+def serving_port_from_env(default: int = 8000) -> int:
+    """Consuming end of the ``tpu-serving-port`` annotation: the webhook
+    projects it into KUBEFLOW_TPU_SERVING_PORT (api/annotations.py), the
+    controller opens it in the ctrl NetworkPolicy and surfaces worker-0's
+    address as status.tpu.servingEndpoint. Raises on garbage — a hand-set
+    env var must not silently serve on the wrong port."""
+    import os
+
+    value = os.environ.get("KUBEFLOW_TPU_SERVING_PORT", "").strip()
+    if not value:
+        return default
+    from kubeflow_tpu.api.annotations import parse_profiling_port
+
+    port = parse_profiling_port(value)
+    if port is None:
+        raise ValueError(
+            f"KUBEFLOW_TPU_SERVING_PORT={value!r}: want a port in "
+            "1024..65535"
+        )
+    return port
+
+
 class InferenceServer:
     """HTTP front-end driving one batching engine on a background thread.
 
@@ -133,6 +159,10 @@ class InferenceServer:
         with self._work:
             self._shutdown = True
             self._work.notify_all()
+            # Unblock every in-flight handler: a request mid-decode would
+            # otherwise hang its client past process exit.
+            for q in self._queues.values():
+                q.put(_DONE)
         self._httpd.shutdown()
         self._httpd.server_close()  # release the listening socket NOW
         self._engine_thread.join(timeout=10)
@@ -143,6 +173,12 @@ class InferenceServer:
                 max_tokens: Optional[int]) -> tuple[int, queue.Queue]:
         q: queue.Queue = queue.Queue()
         with self._work:
+            if self._engine_error is not None:
+                # The drive thread is dead; a submit would register a
+                # queue nothing will ever close.
+                raise EngineFailedError(self._engine_error)
+            if self._shutdown:
+                raise EngineFailedError("server is shutting down")
             rid = self.engine.submit(prompt, max_new_tokens=max_tokens)
             self._queues[rid] = q
             self._work.notify_all()
@@ -173,8 +209,10 @@ class InferenceServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
-            # Decode steps can take seconds under load; keep-alive off so
-            # clients never wait on a half-closed connection.
+            # HTTP/1.1 for chunk-free streaming semantics, but one
+            # request per connection: an idle keep-alive connection would
+            # pin a ThreadingHTTPServer handler thread per client with no
+            # read timeout.
             protocol_version = "HTTP/1.1"
 
             def log_message(self, *args):  # quiet by default
@@ -185,8 +223,10 @@ class InferenceServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                self.send_header("Connection", "close")
                 self.end_headers()
                 self.wfile.write(body)
+                self.close_connection = True
 
             def do_GET(self):
                 if self.path == "/healthz":
@@ -239,6 +279,9 @@ class InferenceServer:
                     return
                 try:
                     rid, q = server._submit(prompt, max_tokens)
+                except EngineFailedError as err:
+                    self._json(503, {"error": str(err)})
+                    return
                 except ValueError as err:  # over-bucket prompt etc.
                     self._json(400, {"error": str(err)})
                     return
@@ -257,6 +300,10 @@ class InferenceServer:
                     if item is _DONE:
                         break
                     tokens.append(item)
+                # Drop the queue BEFORE writing: a client that has seen
+                # the response must be able to observe the server state
+                # already cleaned up (the finally stays as a safety net).
+                server._finish(rid)
                 if server._engine_error is not None:
                     self._json(500, {"error": server._engine_error,
                                      "partial_tokens": tokens})
@@ -288,6 +335,15 @@ class InferenceServer:
                 while True:
                     item = q.get()
                     if item is _DONE:
+                        server._finish(rid)
+                        # An error-truncated stream must be
+                        # distinguishable from a completed one.
+                        if server._engine_error is not None:
+                            self.wfile.write(
+                                b"data: " + json.dumps(
+                                    {"error": server._engine_error}
+                                ).encode() + b"\n\n"
+                            )
                         self.wfile.write(b"data: [DONE]\n\n")
                         self.wfile.flush()
                         return

@@ -112,6 +112,7 @@ class NotebookMutatingWebhook:
             self._inject_tpu(nb)
             self._handle_quant_env(nb)
             self._handle_profiling_env(nb)
+            self._handle_serving_env(nb)
             mounts.check_and_mount_ca_bundle(nb, self.client)
             mounts.mount_runtime_images(nb, self.client)
             if self.config.set_pipeline_secret:
@@ -175,22 +176,30 @@ class NotebookMutatingWebhook:
             return
         upsert_env(container, [{"name": ann.QUANT_ENV_NAME, "value": value}])
 
-    def _handle_profiling_env(self, nb: Notebook) -> None:
-        """Project the profiling-port annotation into the env consumed by
-        runtime.bootstrap (jax.profiler.start_server). Invalid values are
-        denied by the validating webhook; never propagate them here."""
+    def _handle_port_env(self, nb: Notebook, annotation: str,
+                         env_name: str) -> None:
+        """Project a port annotation into its in-pod env: the profiling
+        port (consumed by runtime.bootstrap's jax.profiler.start_server)
+        and the serving port (bound by models/server.py
+        serving_port_from_env) share one projection rule, so a fix to
+        either applies to both. Invalid values are denied by the
+        validating webhook; never propagate them here."""
         container = nb.primary_container()
         if container is None:
             return
-        port = ann.parse_profiling_port(
-            nb.annotations.get(ann.TPU_PROFILING_PORT)
-        )
+        port = ann.parse_profiling_port(nb.annotations.get(annotation))
         if port is None:
-            remove_env(container, {ann.PROFILING_ENV_NAME})
+            remove_env(container, {env_name})
             return
-        upsert_env(
-            container, [{"name": ann.PROFILING_ENV_NAME, "value": str(port)}]
-        )
+        upsert_env(container, [{"name": env_name, "value": str(port)}])
+
+    def _handle_profiling_env(self, nb: Notebook) -> None:
+        self._handle_port_env(nb, ann.TPU_PROFILING_PORT,
+                              ann.PROFILING_ENV_NAME)
+
+    def _handle_serving_env(self, nb: Notebook) -> None:
+        self._handle_port_env(nb, ann.TPU_SERVING_PORT,
+                              ann.SERVING_ENV_NAME)
 
     def _resolve_image_from_registry(self, nb: Notebook, span=None) -> None:
         """Resolve "imagestream:tag" annotations to a digested image ref

@@ -54,6 +54,23 @@ class NotebookValidatingWebhook:
                     f"annotation {ann.TPU_PROFILING_PORT}: {why}"
                 )
 
+        serving = nb.annotations.get(ann.TPU_SERVING_PORT)
+        if serving is not None:
+            why = ann.profiling_port_error(serving)  # same port rules
+            if why is not None:
+                raise WebhookDeniedError(
+                    f"annotation {ann.TPU_SERVING_PORT}: {why}"
+                )
+            if prof is not None and (
+                ann.parse_profiling_port(serving)
+                == ann.parse_profiling_port(prof)
+            ):
+                raise WebhookDeniedError(
+                    f"annotations {ann.TPU_SERVING_PORT} and "
+                    f"{ann.TPU_PROFILING_PORT} claim the same port "
+                    f"{serving} — two servers cannot bind it"
+                )
+
         if req.operation != "UPDATE" or req.old_object is None:
             return
         old = Notebook(req.old_object)

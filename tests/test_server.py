@@ -187,6 +187,55 @@ class TestRobustness:
         finally:
             srv.stop()
 
+    def test_submit_after_engine_death_is_503_not_hang(self):
+        srv = InferenceServer(_engine(), port=0)
+
+        def boom():
+            raise RuntimeError("synthetic device loss")
+
+        srv.engine._step = boom
+        srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError):
+                _post(srv.port, {"prompt": [1, 2]})  # kills the engine
+            # a NEW request must be refused immediately, not hang on a
+            # queue the dead drive thread will never close
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(srv.port, {"prompt": [3, 4]})
+            assert err.value.code == 503
+        finally:
+            srv.stop()
+
+    def test_stop_unblocks_inflight_requests(self):
+        """stop() must close pending queues — an in-flight handler
+        blocked on q.get() would otherwise hang its client forever."""
+        import time
+
+        srv = InferenceServer(_engine(), port=0)
+        srv.start()
+        # Freeze the engine so the request stays in flight.
+        frozen = threading.Event()
+
+        def slow_step():
+            frozen.set()
+            time.sleep(0.2)
+
+        srv.engine._step = slow_step
+        result = {}
+
+        def call():
+            try:
+                result["out"] = _post(srv.port, {"prompt": [1, 2, 3]})
+            except Exception as err:
+                result["err"] = err
+
+        t = threading.Thread(target=call)
+        t.start()
+        assert frozen.wait(timeout=30)
+        srv.stop()
+        t.join(timeout=30)
+        assert not t.is_alive(), "handler still blocked after stop()"
+
     def test_stop_releases_the_port(self):
         srv = InferenceServer(_engine(), port=0).start()
         port = srv.port
@@ -212,3 +261,30 @@ class TestEngineHooks:
         eng = _engine()
         rid = eng.submit([1, 2, 3], max_new_tokens=50)  # gen.max is 8
         assert len(eng.run()[rid]) <= 8
+
+    def test_paged_preemption_keeps_per_request_cap(self):
+        """A preempted-and-re-admitted request must keep its max_new cap
+        — losing it under block pressure would overrun the client's
+        budget exactly when the server is loaded."""
+        from kubeflow_tpu.models.paged import PagedBatcher
+
+        pb = PagedBatcher(
+            PARAMS, CFG, gen=GenerationConfig(max_new_tokens=12),
+            slots=2, num_blocks=8, block_size=16, prompt_bucket=16,
+        )
+        rids = [pb.submit([1, 2, 3, 4], max_new_tokens=3),
+                pb.submit([5, 6, 7, 8], max_new_tokens=3),
+                pb.submit([9, 10, 11], max_new_tokens=3)]
+        out = pb.run()
+        for rid in rids:
+            assert len(out[rid]) <= 3, out
+        # and the preemption continuation itself carries the cap
+        pb2 = PagedBatcher(
+            PARAMS, CFG, gen=GenerationConfig(max_new_tokens=12),
+            slots=2, num_blocks=8, block_size=16, prompt_bucket=16,
+        )
+        pb2.submit([1, 2, 3], max_new_tokens=3)
+        pb2._admit_free_slots()
+        slot = next(i for i, r in enumerate(pb2._by_slot) if r is not None)
+        pb2._preempt(slot)
+        assert pb2._queue[0].max_new == 3

@@ -201,6 +201,69 @@ class TestProfilingOption:
                     tpu_notebook(annotations={ann.TPU_PROFILING_PORT: port})
                 )
 
+    def test_serving_port_projects_env_status_and_network(self):
+        """tpu-serving-port mirrors the profiling plumbing end to end:
+        env for the HTTP inference server, worker-0 endpoint in status,
+        and an opened ctrl NetworkPolicy port."""
+        env = make_env(webhooks=True, platform=True)
+        env.cluster.create(
+            tpu_notebook(annotations={ann.TPU_SERVING_PORT: "8200"})
+        )
+        env.manager.run_until_idle()
+        _, c = primary(env)
+        assert get_env_var(c, ann.SERVING_ENV_NAME)["value"] == "8200"
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert nb["status"]["tpu"]["servingEndpoint"] == (
+            "nb-0.nb-hosts.ns.svc.cluster.local:8200"
+        )
+        np_obj = env.cluster.get("NetworkPolicy", "nb-ctrl-np", "ns")
+        ports = [
+            p["port"] for rule in np_obj["spec"]["ingress"]
+            for p in rule["ports"]
+        ]
+        assert 8200 in ports
+
+    def test_serving_port_invalid_and_collision_denied(self):
+        env = make_env(webhooks=True)
+        with pytest.raises(WebhookDeniedError, match="not a port"):
+            env.cluster.create(
+                tpu_notebook(annotations={ann.TPU_SERVING_PORT: "80"})
+            )
+        with pytest.raises(WebhookDeniedError, match="already used in-pod"):
+            env.cluster.create(
+                tpu_notebook(annotations={ann.TPU_SERVING_PORT: "8888"})
+            )
+        # serving and profiling may not claim the same port
+        with pytest.raises(WebhookDeniedError, match="same port"):
+            env.cluster.create(
+                tpu_notebook(annotations={
+                    ann.TPU_SERVING_PORT: "9100",
+                    ann.TPU_PROFILING_PORT: "9100",
+                })
+            )
+
+    def test_serving_port_removal_drops_env(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(
+            tpu_notebook(annotations={ann.TPU_SERVING_PORT: "8200"})
+        )
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        del nb["metadata"]["annotations"][ann.TPU_SERVING_PORT]
+        env.cluster.update(nb)
+        _, c = primary(env)
+        assert get_env_var(c, ann.SERVING_ENV_NAME) is None
+
+    def test_serving_port_env_consumed_by_server(self, monkeypatch):
+        from kubeflow_tpu.models.server import serving_port_from_env
+
+        monkeypatch.delenv(ann.SERVING_ENV_NAME, raising=False)
+        assert serving_port_from_env() == 8000
+        monkeypatch.setenv(ann.SERVING_ENV_NAME, "8200")
+        assert serving_port_from_env() == 8200
+        monkeypatch.setenv(ann.SERVING_ENV_NAME, "not-a-port")
+        with pytest.raises(ValueError, match="SERVING_PORT"):
+            serving_port_from_env()
+
     def test_bootstrap_starts_profiler_server(self, monkeypatch):
         # runtime/__init__ re-exports the bootstrap FUNCTION under the same
         # name, shadowing the submodule attribute; resolve the module.
