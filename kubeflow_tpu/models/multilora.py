@@ -1,0 +1,355 @@
+"""Multi-LoRA serving: one base model, many adapters, chosen per request.
+
+A notebook that fine-tuned several LoRA adapters (models/lora.py) should
+serve them all from ONE copy of the base weights — merging each adapter
+(merge_lora) costs a full weight copy per adapter (13.5 GB on 7B), and a
+batcher per adapter forfeits cross-adapter batching. Here the adapters
+are STACKED on a leading adapter axis and every request carries an
+adapter id; one compiled step serves a batch whose rows use different
+adapters (the vLLM multi-LoRA insight, shaped for TPU):
+
+- stacked adapters: per target t, a: (N, L, in, r), b: (N, L, r, out) —
+  static shapes, so one executable regardless of which adapters are in
+  the batch;
+- per step, each slot's adapter pair is GATHERED by id ((B, L, in, r) —
+  tiny: rank·dim, not dim²) and the delta rides the base matmul as two
+  skinny einsums: y = x@W + (x@a_sel)@b_sel · scaling;
+- id -1 = base model, implemented as a zero row appended to the stack —
+  no branching inside jit, base and adapted rows share every op;
+- admission prefills THROUGH the same adapted body (a prompt prefilled
+  base-only would hand the adapter a cache it never produced).
+
+Correctness contract (pinned by tests/test_multilora.py): for every
+request tagged with adapter i, the emitted tokens are IDENTICAL to a
+plain ContinuousBatcher serving merge_lora(params, adapter_i) — and
+base-tagged rows match the unmerged base server.
+
+No reference counterpart (the reference has no serving stack —
+SURVEY.md §2.5); composes with the HTTP server (models/server.py): the
+request's "model" field selects the adapter by name.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.continuous import ContinuousBatcher
+from kubeflow_tpu.models.llama import (
+    LlamaConfig,
+    _cache_store_rows,
+    _embed,
+    _gqa_decode_attention,
+    _lm_head_logits,
+    _merge_heads,
+    _mlp,
+    _mm,
+    _norm,
+    _qkv,
+    _split_heads,
+    apply_rope,
+    init_kv_cache,
+    rope_frequencies,
+    sample_logits,
+)
+from kubeflow_tpu.models.lora import LoraConfig, init_lora_params
+from kubeflow_tpu.models.serving import GenerationConfig
+
+
+def stack_adapters(adapters: Sequence[dict], cfg: LlamaConfig,
+                   lcfg: LoraConfig) -> dict:
+    """[adapter tree, ...] → stacked tree with a zero "base" row LAST:
+    per target, {"a": (N+1, L, in, r), "b": (N+1, L, r, out)}. Requests
+    with no adapter index the zero row — the delta vanishes without any
+    branching inside the compiled step."""
+    if not adapters:
+        raise ValueError("need at least one adapter (else use "
+                         "ContinuousBatcher)")
+    zero = jax.tree_util.tree_map(
+        jnp.zeros_like, init_lora_params(cfg, lcfg, jax.random.PRNGKey(0))
+    )
+    out = {}
+    for target in adapters[0]:
+        for ad in adapters:
+            if ad[target]["a"].shape != adapters[0][target]["a"].shape:
+                raise ValueError(
+                    f"adapter shape mismatch on {target}: all adapters "
+                    "must share one LoraConfig"
+                )
+        out[target] = {
+            "a": jnp.stack([ad[target]["a"] for ad in adapters]
+                           + [zero[target]["a"]]),
+            "b": jnp.stack([ad[target]["b"] for ad in adapters]
+                           + [zero[target]["b"]]),
+        }
+    return out
+
+
+def _delta(h: jax.Array, sel: dict, target: str,
+           scaling: float) -> jax.Array:
+    """Per-row LoRA delta: h (B, K, D) × a (B, D, r) × b (B, r, O).
+    f32 accumulation like merge_lora, cast back to h's dtype."""
+    a, b = sel[target]["a"], sel[target]["b"]
+    lo = jnp.einsum("bkd,bdr->bkr", h.astype(jnp.float32),
+                    a.astype(jnp.float32))
+    return (
+        jnp.einsum("bkr,bro->bko", lo, b.astype(jnp.float32)) * scaling
+    ).astype(h.dtype)
+
+
+def _adapted_qkv(h, layer, sel, scaling):
+    q, k, v = _qkv(h, layer)
+    if "wq" in sel:
+        q = q + _delta(h, sel, "wq", scaling)
+    if "wk" in sel:
+        k = k + _delta(h, sel, "wk", scaling)
+    if "wv" in sel:
+        v = v + _delta(h, sel, "wv", scaling)
+    return q, k, v
+
+
+def _adapted_mlp(layer, x, cfg, sel, scaling):
+    if not (set(sel) & {"w_gate", "w_up", "w_down"}):
+        return _mlp(layer, x, cfg)
+    pre = _mm(x, layer["w_gate"])
+    if "w_gate" in sel:
+        pre = pre + _delta(x, sel, "w_gate", scaling)
+    pre = pre.astype(jnp.float32)
+    gate = (jax.nn.gelu(pre, approximate=True) if cfg.act == "gelu"
+            else jax.nn.silu(pre))
+    up = _mm(x, layer["w_up"])
+    if "w_up" in sel:
+        up = up + _delta(x, sel, "w_up", scaling)
+    hidden = (gate * up.astype(jnp.float32)).astype(x.dtype)
+    out = _mm(hidden, layer["w_down"])
+    if "w_down" in sel:
+        out = out + _delta(hidden, sel, "w_down", scaling)
+    return out
+
+
+def _gather_adapters(stacked: dict, ids: jax.Array) -> dict:
+    """Per-slot adapter slices, layer axis moved LEADING for the scan:
+    {"t": {"a": (L, B, in, r), "b": (L, B, r, out)}}."""
+    return {
+        t: {
+            "a": jnp.swapaxes(ab["a"][ids], 0, 1),
+            "b": jnp.swapaxes(ab["b"][ids], 0, 1),
+        }
+        for t, ab in stacked.items()
+    }
+
+
+def _scan_body(params, cfg, scaling, x, cos, sin, positions, kv_mask,
+               store_rows, per_batch):
+    """Shared layer-scan body builder for the adapted decode step and the
+    adapted prefill — ONE body so the two cannot drift (the same
+    discipline as llama._chunk_decode_scan / paged._paged_chunk_scan).
+    ``per_batch`` must be explicit: the decode step's (B,) positions and
+    the prefill's (sq,) positions are both rank-1 but mean different
+    things to rope and the attention mask."""
+
+    def body(x, scanned):
+        layer, cache_l, sel = scanned
+        h = _norm(x, layer["attn_norm"], cfg)
+        hq, hk, hv = _adapted_qkv(h, layer, sel, scaling)
+        q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin,
+                       per_batch=per_batch)
+        k = apply_rope(_split_heads(hk, cfg.n_kv_heads), cos, sin,
+                       per_batch=per_batch)
+        v = _split_heads(hv, cfg.n_kv_heads)
+        cache_l = store_rows(cache_l, k, v)
+        attn = _gqa_decode_attention(
+            q, cache_l["k"], cache_l["v"], positions,
+            window=cfg.sliding_window, kv_mask=kv_mask,
+            per_batch=per_batch,
+        )
+        merged = _merge_heads(attn)
+        o = _mm(merged, layer["wo"])
+        if "wo" in sel:
+            o = o + _delta(merged, sel, "wo", scaling)
+        x = x + o
+        h = _norm(x, layer["mlp_norm"], cfg)
+        x = x + _adapted_mlp(layer, h, cfg, sel, scaling)
+        return x, cache_l
+
+    return body
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "scaling", "temperature", "top_k", "top_p"),
+    donate_argnums=(4,),
+)
+def _ml_step(params, stacked, ids, tokens, cache, positions, kv_mask, key,
+             cfg: LlamaConfig, scaling: float, temperature: float,
+             top_k: int, top_p: float):
+    """One decode step across every slot, each under its own adapter."""
+    x = _embed(params, cfg, tokens)
+    cos, sin = rope_frequencies(cfg, positions)
+    sel = _gather_adapters(stacked, ids)
+
+    def store(cache_l, k, v):
+        return _cache_store_rows(cache_l, k, v, positions)
+
+    body = _scan_body(params, cfg, scaling, x, cos, sin, positions,
+                      kv_mask, store, per_batch=True)
+    x, new_cache = jax.lax.scan(body, x, (params["layers"], cache, sel))
+    logits = _lm_head_logits(_norm(x[:, 0], params["final_norm"], cfg),
+                             params)
+    nxt = sample_logits(logits, key, temperature, top_k, top_p)
+    return nxt, new_cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "scaling"))
+def _ml_admit(params, stacked, aid, tokens, prompt_mask, cache, kv_mask,
+              slot, cfg: LlamaConfig, scaling: float):
+    """Prefill one prompt THROUGH its adapter into ``slot``; mirrors
+    continuous._admit_slot but with the adapted body (a base-only
+    prefill would hand the adapter a cache it never produced)."""
+    cache_len = cache["k"].shape[3]
+    lb = tokens.shape[1]
+    temp = init_kv_cache(cfg, 1, cache_len)
+    x = _embed(params, cfg, tokens)
+    pos = jnp.arange(lb)
+    cos, sin = rope_frequencies(cfg, pos)
+    sel = _gather_adapters(stacked, aid[None])  # (1,) adapter row
+
+    def store(cache_l, k, v):
+        # temp cache leaves are (B=1, Hkv, C, D); write positions 0..lb
+        new_k = cache_l["k"].at[:, :, :lb].set(k)
+        new_v = cache_l["v"].at[:, :, :lb].set(v)
+        return {**cache_l, "k": new_k, "v": new_v}
+
+    mask = prompt_mask if prompt_mask is not None else jnp.ones(
+        (1, lb), bool
+    )
+    row = jnp.ones((1, cache_len), bool).at[:, :lb].set(mask)
+    # kv_mask spans the FULL cache width (the attention broadcasts it
+    # against the cache's key axis); keys beyond lb are additionally
+    # fenced by the positional bound (k_pos <= pos, max lb-1).
+    body = _scan_body(params, cfg, scaling, x, cos, sin, pos,
+                      row, store, per_batch=False)
+    x, temp = jax.lax.scan(body, x, (params["layers"], temp, sel))
+    logits = _lm_head_logits(
+        _norm(x[:, -1], params["final_norm"], cfg), params
+    )
+    new_cache = {
+        name: jax.lax.dynamic_update_slice(
+            cache[name], temp[name],
+            (0, slot) + (0,) * (cache[name].ndim - 2),
+        )
+        for name in cache
+    }
+    new_mask = jax.lax.dynamic_update_slice(kv_mask, row, (slot, 0))
+    return logits[0], new_cache, new_mask
+
+
+class MultiLoraBatcher(ContinuousBatcher):
+    """Fixed-slot continuous batching with a per-request LoRA adapter.
+
+    >>> stacked = stack_adapters([ad_math, ad_code], cfg, lcfg)
+    >>> mb = MultiLoraBatcher(params, cfg, stacked, lcfg,
+    ...                       adapter_names=["math", "code"])
+    >>> mb.submit(p1, adapter="math"); mb.submit(p2, adapter="code")
+    >>> mb.submit(p3)                  # base model, same batch
+    >>> results = mb.run()
+    """
+
+    def __init__(self, params, cfg, stacked: dict, lcfg: LoraConfig,
+                 adapter_names: Optional[Sequence[str]] = None, **kw):
+        for unsupported in ("plan", "kv_bits", "attn_kernel"):
+            if kw.get(unsupported):
+                raise ValueError(
+                    f"MultiLoraBatcher does not support {unsupported}= yet"
+                )
+        kw["attn_kernel"] = False
+        super().__init__(params, cfg, **kw)
+        first = next(iter(stacked.values()))["a"]
+        self.n_adapters = first.shape[0] - 1  # last row is the zero/base
+        self.stacked = stacked
+        self.scaling = lcfg.scaling
+        names = list(adapter_names) if adapter_names is not None else [
+            str(i) for i in range(self.n_adapters)
+        ]
+        if len(names) != self.n_adapters:
+            raise ValueError(
+                f"{len(names)} adapter_names for {self.n_adapters} adapters"
+            )
+        self.adapter_names = names
+        self._slot_adapter = np.full((self.slots,), self.n_adapters,
+                                     np.int32)  # base row
+
+    def resolve_adapter(self, adapter) -> int:
+        """Name | index | None → stacked row id (None = the base row)."""
+        if adapter is None:
+            return self.n_adapters
+        if isinstance(adapter, str):
+            try:
+                return self.adapter_names.index(adapter)
+            except ValueError:
+                raise ValueError(
+                    f"unknown adapter {adapter!r} "
+                    f"(serving: {', '.join(self.adapter_names)} + base)"
+                ) from None
+        if not 0 <= int(adapter) < self.n_adapters:
+            raise ValueError(
+                f"adapter index {adapter} out of range "
+                f"[0, {self.n_adapters})"
+            )
+        return int(adapter)
+
+    def submit(self, prompt, max_new_tokens=None, adapter=None) -> int:
+        aid = self.resolve_adapter(adapter)
+        rid = super().submit(prompt, max_new_tokens=max_new_tokens)
+        self._queue[-1].adapter_id = aid
+        return rid
+
+    def _admit_free_slots(self) -> None:
+        from kubeflow_tpu.models.serving import left_pad
+        from kubeflow_tpu.models.llama import sample_logits as _sl
+
+        for slot in range(self.slots):
+            if self._by_slot[slot] is not None or not self._queue:
+                continue
+            req = self._queue.pop(0)
+            aid = getattr(req, "adapter_id", self.n_adapters)
+            padded, mask = left_pad(
+                [req.prompt], self.gen.pad_id, self.prompt_bucket
+            )
+            prompt_mask = None if mask.all() else jnp.asarray(mask)
+            logits, self.cache, self.kv_mask = _ml_admit(
+                self.params, self.stacked, jnp.asarray(aid, jnp.int32),
+                jnp.asarray(padded), prompt_mask, self.cache, self.kv_mask,
+                jnp.asarray(slot, jnp.int32), self.cfg, self.scaling,
+            )
+            self.key, sub = jax.random.split(self.key)
+            first = int(_sl(
+                logits[None], sub, self.gen.temperature, self.gen.top_k,
+                self.gen.top_p,
+            )[0])
+            self.positions[slot] = self.prompt_bucket
+            self._slot_adapter[slot] = aid
+            self._by_slot[slot] = req
+            req.budget = self._initial_budget(req)
+            self._note_token(slot, first)
+
+    def _step(self) -> None:
+        active = [i for i, r in enumerate(self._by_slot) if r is not None]
+        if not active:
+            return
+        self.key, sub = jax.random.split(self.key)
+        nxt, self.cache = _ml_step(
+            self.params, self.stacked, jnp.asarray(self._slot_adapter),
+            jnp.array(self.tokens), self.cache, jnp.array(self.positions),
+            self.kv_mask, sub, self.cfg, self.scaling,
+            self.gen.temperature, self.gen.top_k, self.gen.top_p,
+        )
+        for slot in active:
+            self.positions[slot] += 1
+        host_next = np.asarray(nxt)
+        for slot in active:
+            self._note_token(slot, int(host_next[slot]))

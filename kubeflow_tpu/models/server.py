@@ -39,7 +39,16 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-_DONE = object()  # sentinel closing a request's token queue
+_DONE = object()  # sentinel closing a request's token queue: SUCCESS
+
+
+class _Abort:
+    """Queue sentinel for a request that did NOT complete (engine death,
+    server shutdown) — per-queue, so a request that already finished
+    normally can never be mislabeled by a later global failure."""
+
+    def __init__(self, reason: str):
+        self.reason = reason
 
 
 class EngineFailedError(RuntimeError):
@@ -138,9 +147,12 @@ class InferenceServer:
                     # forever, flip /healthz red, and stop driving. A
                     # silently-dead daemon thread would leave a hung
                     # server that health checks keep calling healthy.
+                    # Queues that already received _DONE completed
+                    # normally; only still-open ones get the abort.
                     self._engine_error = f"{type(err).__name__}: {err}"
+                    abort = _Abort(self._engine_error)
                     for q in self._queues.values():
-                        q.put(_DONE)
+                        q.put(abort)
                     return
 
     def _has_work(self) -> bool:
@@ -160,17 +172,21 @@ class InferenceServer:
             self._shutdown = True
             self._work.notify_all()
             # Unblock every in-flight handler: a request mid-decode would
-            # otherwise hang its client past process exit.
+            # otherwise hang its client past process exit. Shutdown
+            # truncation is an ABORT — a partial answer must never read
+            # as a completed generation (queues that already hold _DONE
+            # drain it first, FIFO, and complete normally).
+            abort = _Abort("server shutdown before generation finished")
             for q in self._queues.values():
-                q.put(_DONE)
+                q.put(abort)
         self._httpd.shutdown()
         self._httpd.server_close()  # release the listening socket NOW
         self._engine_thread.join(timeout=10)
 
     # -- HTTP side ---------------------------------------------------------
 
-    def _submit(self, prompt: list[int],
-                max_tokens: Optional[int]) -> tuple[int, queue.Queue]:
+    def _submit(self, prompt: list[int], max_tokens: Optional[int],
+                model: Optional[str] = None) -> tuple[int, queue.Queue]:
         q: queue.Queue = queue.Queue()
         with self._work:
             if self._engine_error is not None:
@@ -179,7 +195,22 @@ class InferenceServer:
                 raise EngineFailedError(self._engine_error)
             if self._shutdown:
                 raise EngineFailedError("server is shutting down")
-            rid = self.engine.submit(prompt, max_new_tokens=max_tokens)
+            if model is not None and model == self.model_name:
+                model = None  # the served base model, by its public name
+            if model is not None:
+                # Multi-LoRA routing (models/multilora.py): the request's
+                # "model" selects the adapter; resolve_adapter raises
+                # ValueError (→ 400) for unknown names.
+                if not hasattr(self.engine, "resolve_adapter"):
+                    raise ValueError(
+                        f"unknown model {model!r} (this server serves "
+                        f"{self.model_name!r})"
+                    )
+                rid = self.engine.submit(
+                    prompt, max_new_tokens=max_tokens, adapter=model
+                )
+            else:
+                rid = self.engine.submit(prompt, max_new_tokens=max_tokens)
             self._queues[rid] = q
             self._work.notify_all()
         return rid, q
@@ -223,10 +254,11 @@ class InferenceServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                # send_header("Connection", "close") also sets
+                # self.close_connection in stdlib http.server.
                 self.send_header("Connection", "close")
                 self.end_headers()
                 self.wfile.write(body)
-                self.close_connection = True
 
             def do_GET(self):
                 if self.path == "/healthz":
@@ -236,10 +268,12 @@ class InferenceServer:
                     else:
                         self._json(200, {"status": "ok"})
                 elif self.path == "/v1/models":
+                    ids = [server.model_name] + list(
+                        getattr(server.engine, "adapter_names", [])
+                    )
                     self._json(200, {
                         "object": "list",
-                        "data": [{"id": server.model_name,
-                                  "object": "model"}],
+                        "data": [{"id": i, "object": "model"} for i in ids],
                     })
                 elif self.path == "/stats":
                     with server._lock:
@@ -278,7 +312,8 @@ class InferenceServer:
                     self._json(400, {"error": str(err)})
                     return
                 try:
-                    rid, q = server._submit(prompt, max_tokens)
+                    rid, q = server._submit(prompt, max_tokens,
+                                            req.get("model"))
                 except EngineFailedError as err:
                     self._json(503, {"error": str(err)})
                     return
@@ -297,15 +332,15 @@ class InferenceServer:
                 tokens = []
                 while True:
                     item = q.get()
-                    if item is _DONE:
+                    if item is _DONE or isinstance(item, _Abort):
                         break
                     tokens.append(item)
                 # Drop the queue BEFORE writing: a client that has seen
                 # the response must be able to observe the server state
                 # already cleaned up (the finally stays as a safety net).
                 server._finish(rid)
-                if server._engine_error is not None:
-                    self._json(500, {"error": server._engine_error,
+                if isinstance(item, _Abort):
+                    self._json(500, {"error": item.reason,
                                      "partial_tokens": tokens})
                     return
                 choice = {"index": 0, "tokens": tokens,
@@ -334,14 +369,14 @@ class InferenceServer:
                 self.end_headers()
                 while True:
                     item = q.get()
-                    if item is _DONE:
+                    if item is _DONE or isinstance(item, _Abort):
                         server._finish(rid)
-                        # An error-truncated stream must be
+                        # An abort-truncated stream must be
                         # distinguishable from a completed one.
-                        if server._engine_error is not None:
+                        if isinstance(item, _Abort):
                             self.wfile.write(
                                 b"data: " + json.dumps(
-                                    {"error": server._engine_error}
+                                    {"error": item.reason}
                                 ).encode() + b"\n\n"
                             )
                         self.wfile.write(b"data: [DONE]\n\n")

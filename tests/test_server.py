@@ -235,6 +235,9 @@ class TestRobustness:
         srv.stop()
         t.join(timeout=30)
         assert not t.is_alive(), "handler still blocked after stop()"
+        # and shutdown truncation reads as an ERROR, not a completion
+        assert isinstance(result.get("err"), urllib.error.HTTPError)
+        assert result["err"].code == 500
 
     def test_stop_releases_the_port(self):
         srv = InferenceServer(_engine(), port=0).start()
