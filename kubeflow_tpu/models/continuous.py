@@ -414,10 +414,8 @@ class ContinuousBatcher(_BatcherBase):
                 [req.prompt], self.gen.pad_id, self.prompt_bucket
             )
             prompt_mask = None if mask.all() else jnp.asarray(mask)
-            logits, self.cache, self.kv_mask = _admit_slot(
-                self.params, self.cfg, jnp.asarray(padded), prompt_mask,
-                self.cache, self.kv_mask, jnp.asarray(slot, jnp.int32),
-            )
+            logits = self._prefill_into_slot(slot, req, jnp.asarray(padded),
+                                             prompt_mask)
             self._post_admit(slot, jnp.asarray(padded), prompt_mask)
             self.key, sub = jax.random.split(self.key)
             first = int(
@@ -430,6 +428,20 @@ class ContinuousBatcher(_BatcherBase):
             self._by_slot[slot] = req
             req.budget = self._initial_budget(req)
             self._note_token(slot, first)
+
+    def _prefill_into_slot(self, slot: int, req: _Request, padded,
+                           prompt_mask) -> jax.Array:
+        """The engine-specific half of admission: prefill ``padded`` into
+        ``slot`` and return the first logits. Overridden by multi-LoRA
+        (adapter-aware prefill) — everything around it (padding, the
+        _post_admit hook, sampling, budget, bookkeeping) stays in ONE
+        loop above so a fix there applies to every subclass."""
+        del req
+        logits, self.cache, self.kv_mask = _admit_slot(
+            self.params, self.cfg, padded, prompt_mask,
+            self.cache, self.kv_mask, jnp.asarray(slot, jnp.int32),
+        )
+        return logits
 
     def _release_slot(self, slot: int) -> None:
         self._by_slot[slot] = None

@@ -72,6 +72,16 @@ def stack_adapters(adapters: Sequence[dict], cfg: LlamaConfig,
     zero = jax.tree_util.tree_map(
         jnp.zeros_like, init_lora_params(cfg, lcfg, jax.random.PRNGKey(0))
     )
+    want = set(adapters[0])
+    for i, ad in enumerate(adapters[1:], 1):
+        if set(ad) != want:
+            # A silently-dropped target would break the merge_lora
+            # parity contract with no error; a missing one would be an
+            # unexplained KeyError below.
+            raise ValueError(
+                f"adapter {i} targets {sorted(ad)} != adapter 0 targets "
+                f"{sorted(want)}: all adapters must share one LoraConfig"
+            )
     out = {}
     for target in adapters[0]:
         for ad in adapters:
@@ -284,7 +294,11 @@ class MultiLoraBatcher(ContinuousBatcher):
                                      np.int32)  # base row
 
     def resolve_adapter(self, adapter) -> int:
-        """Name | index | None → stacked row id (None = the base row)."""
+        """Name | index | None → stacked row id (None = the base row).
+        Only str/int are accepted: a float would silently truncate to a
+        DIFFERENT adapter and a list/bool is a client bug — both must be
+        a clean ValueError (the HTTP layer turns it into a 400), never a
+        TypeError or a wrong-adapter response."""
         if adapter is None:
             return self.n_adapters
         if isinstance(adapter, str):
@@ -295,12 +309,17 @@ class MultiLoraBatcher(ContinuousBatcher):
                     f"unknown adapter {adapter!r} "
                     f"(serving: {', '.join(self.adapter_names)} + base)"
                 ) from None
-        if not 0 <= int(adapter) < self.n_adapters:
+        if not isinstance(adapter, int) or isinstance(adapter, bool):
+            raise ValueError(
+                f"adapter must be a name, an integer index, or None — "
+                f"got {type(adapter).__name__} {adapter!r}"
+            )
+        if not 0 <= adapter < self.n_adapters:
             raise ValueError(
                 f"adapter index {adapter} out of range "
                 f"[0, {self.n_adapters})"
             )
-        return int(adapter)
+        return adapter
 
     def submit(self, prompt, max_new_tokens=None, adapter=None) -> int:
         aid = self.resolve_adapter(adapter)
@@ -308,34 +327,17 @@ class MultiLoraBatcher(ContinuousBatcher):
         self._queue[-1].adapter_id = aid
         return rid
 
-    def _admit_free_slots(self) -> None:
-        from kubeflow_tpu.models.serving import left_pad
-        from kubeflow_tpu.models.llama import sample_logits as _sl
-
-        for slot in range(self.slots):
-            if self._by_slot[slot] is not None or not self._queue:
-                continue
-            req = self._queue.pop(0)
-            aid = getattr(req, "adapter_id", self.n_adapters)
-            padded, mask = left_pad(
-                [req.prompt], self.gen.pad_id, self.prompt_bucket
-            )
-            prompt_mask = None if mask.all() else jnp.asarray(mask)
-            logits, self.cache, self.kv_mask = _ml_admit(
-                self.params, self.stacked, jnp.asarray(aid, jnp.int32),
-                jnp.asarray(padded), prompt_mask, self.cache, self.kv_mask,
-                jnp.asarray(slot, jnp.int32), self.cfg, self.scaling,
-            )
-            self.key, sub = jax.random.split(self.key)
-            first = int(_sl(
-                logits[None], sub, self.gen.temperature, self.gen.top_k,
-                self.gen.top_p,
-            )[0])
-            self.positions[slot] = self.prompt_bucket
-            self._slot_adapter[slot] = aid
-            self._by_slot[slot] = req
-            req.budget = self._initial_budget(req)
-            self._note_token(slot, first)
+    def _prefill_into_slot(self, slot, req, padded, prompt_mask):
+        """Adapter-aware half of admission; the shared loop (padding,
+        _post_admit, sampling, budget) lives in ContinuousBatcher."""
+        aid = getattr(req, "adapter_id", self.n_adapters)
+        logits, self.cache, self.kv_mask = _ml_admit(
+            self.params, self.stacked, jnp.asarray(aid, jnp.int32),
+            padded, prompt_mask, self.cache, self.kv_mask,
+            jnp.asarray(slot, jnp.int32), self.cfg, self.scaling,
+        )
+        self._slot_adapter[slot] = aid
+        return logits
 
     def _step(self) -> None:
         active = [i for i, r in enumerate(self._by_slot) if r is not None]

@@ -93,6 +93,14 @@ class InferenceServer:
         # inner batcher (`_engine`) that owns the queue/slots/step loop —
         # hooks and the drive loop must target the inner one.
         self.engine = getattr(engine, "_engine", engine)
+        if model_name in getattr(self.engine, "adapter_names", ()):
+            # The "model == model_name → base" shortcut in _submit would
+            # make that adapter silently unreachable.
+            raise ValueError(
+                f"model_name {model_name!r} collides with an adapter "
+                "name — requests for the adapter would be routed to the "
+                "base model"
+            )
         self.tokenizer = tokenizer
         self.model_name = model_name
         self._lock = threading.Lock()

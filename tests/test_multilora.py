@@ -101,6 +101,11 @@ class TestApi:
             mb.resolve_adapter("nope")
         with pytest.raises(ValueError, match="out of range"):
             mb.resolve_adapter(5)
+        # non-str/int must be a clean ValueError (HTTP 400), and a float
+        # must never silently truncate to a different adapter
+        for bad in (["a0"], 1.7, True, {"name": "a0"}):
+            with pytest.raises(ValueError, match="adapter"):
+                mb.resolve_adapter(bad)
 
     def test_rejects_unsupported_compositions(self):
         with pytest.raises(ValueError, match="kv_bits"):
@@ -115,6 +120,25 @@ class TestApi:
             stack_adapters([AD0, other], CFG, LCFG)
         with pytest.raises(ValueError, match="at least one"):
             stack_adapters([], CFG, LCFG)
+        # differing TARGET SETS must be a clear error in both orders —
+        # silently dropping a target would break the merge_lora parity
+        narrower = init_lora_params(
+            CFG, LoraConfig(rank=4, targets=("wq",)), jax.random.PRNGKey(9)
+        )
+        with pytest.raises(ValueError, match="targets"):
+            stack_adapters([AD0, narrower], CFG, LCFG)
+        with pytest.raises(ValueError, match="targets"):
+            stack_adapters([narrower, AD0], CFG, LCFG)
+
+    def test_server_rejects_adapter_named_like_model(self):
+        from kubeflow_tpu.models.server import InferenceServer
+
+        mb = MultiLoraBatcher(PARAMS, CFG, STACKED, LCFG,
+                              adapter_names=["kubeflow-tpu", "a1"],
+                              gen=GEN, slots=2, cache_len=128,
+                              prompt_bucket=16)
+        with pytest.raises(ValueError, match="collides"):
+            InferenceServer(mb, port=0)
 
     def test_http_server_routes_model_field(self):
         """The HTTP front door's "model" field selects the adapter."""
