@@ -30,6 +30,7 @@ speculative as the in-notebook inference surface.
 from __future__ import annotations
 
 import dataclasses
+import math
 from functools import partial
 from typing import Optional, Sequence
 
@@ -54,6 +55,7 @@ from kubeflow_tpu.models.llama import (
     init_kv_cache,
     rope_frequencies,
     sample_logits,
+    sample_logits_per_row,
 )
 from kubeflow_tpu.models.serving import GenerationConfig, left_pad
 
@@ -99,7 +101,7 @@ def _admit_slot(
 @partial(
     jax.jit,
     static_argnames=(
-        "cfg", "temperature", "top_k", "top_p", "decode_attn",
+        "cfg", "top_k", "top_p", "decode_attn",
         "attn_kernel",
     ),
     donate_argnums=(3,),
@@ -112,7 +114,7 @@ def _cb_step(
     positions: jax.Array,  # (B,) write position per slot
     kv_mask: jax.Array,  # (B, C)
     key: jax.Array,
-    temperature: float,
+    temps: jax.Array,  # (B,) per-slot sampling temperature (0 = greedy)
     top_k: int,
     top_p: float,
     decode_attn=None,  # mesh-bound SP decode (make_sharded_sp_decode)
@@ -178,7 +180,7 @@ def _cb_step(
 
     x, new_cache = jax.lax.scan(body, x, (params["layers"], cache))
     logits = _lm_head_logits(_norm(x[:, 0], params["final_norm"], cfg), params)
-    nxt = sample_logits(logits, key, temperature, top_k, top_p)
+    nxt = sample_logits_per_row(logits, key, temps, top_k, top_p)
     return nxt, new_cache
 
 
@@ -196,6 +198,10 @@ class _Request:
     # clamp to the engine-wide value: cache/table shapes are compiled for
     # it, so a request can ask for less, never more.
     max_new: Optional[int] = None
+    # Per-request sampling temperature (None = the engine-wide
+    # gen.temperature). 0 = greedy for this row; top_k/top_p stay
+    # engine-wide (their shapes are compiled in).
+    temperature: Optional[float] = None
     # Paged batcher only: physical block ids this request holds, in
     # position order. Harmless (empty) for the fixed-slot batcher.
     blocks: list[int] = dataclasses.field(default_factory=list)
@@ -216,6 +222,9 @@ class _BatcherBase:
         self.gen = gen
         self.slots = slots
         self.prompt_bucket = prompt_bucket
+        # Per-slot effective temperature (request override or the
+        # engine-wide default), uploaded with each step.
+        self.temps = np.full((slots,), gen.temperature, np.float32)
         self._queue: list[_Request] = []
         self._by_slot: list[Optional[_Request]] = [None] * slots
         self._results: dict[int, list[int]] = {}
@@ -229,7 +238,8 @@ class _BatcherBase:
         self.on_retire = None
 
     def submit(self, prompt: Sequence[int],
-               max_new_tokens: Optional[int] = None) -> int:
+               max_new_tokens: Optional[int] = None,
+               temperature: Optional[float] = None) -> int:
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) > self.prompt_bucket:
@@ -239,9 +249,23 @@ class _BatcherBase:
             )
         if max_new_tokens is not None and max_new_tokens <= 0:
             raise ValueError(f"max_new_tokens must be > 0, got {max_new_tokens}")
+        if temperature is not None and (
+            not isinstance(temperature, (int, float))
+            or isinstance(temperature, bool)
+            or not math.isfinite(temperature) or temperature < 0
+        ):
+            # isfinite: JSON's NaN/Infinity parse as floats, pass a bare
+            # `< 0` check, and turn the row's logits into garbage.
+            raise ValueError(
+                f"temperature must be a finite number >= 0, got "
+                f"{temperature!r}"
+            )
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, list(prompt), max_new=max_new_tokens))
+        self._queue.append(_Request(
+            rid, list(prompt), max_new=max_new_tokens,
+            temperature=None if temperature is None else float(temperature),
+        ))
         return rid
 
     def _initial_budget(self, req: _Request) -> int:
@@ -418,13 +442,16 @@ class ContinuousBatcher(_BatcherBase):
                                              prompt_mask)
             self._post_admit(slot, jnp.asarray(padded), prompt_mask)
             self.key, sub = jax.random.split(self.key)
+            temp = (self.gen.temperature if req.temperature is None
+                    else req.temperature)
             first = int(
                 sample_logits(
-                    logits[None], sub, self.gen.temperature, self.gen.top_k,
+                    logits[None], sub, temp, self.gen.top_k,
                     self.gen.top_p,
                 )[0]
             )
             self.positions[slot] = self.prompt_bucket
+            self.temps[slot] = temp
             self._by_slot[slot] = req
             req.budget = self._initial_budget(req)
             self._note_token(slot, first)
@@ -460,7 +487,7 @@ class ContinuousBatcher(_BatcherBase):
         nxt, self.cache = _cb_step(
             self.params, self.cfg, jnp.array(self.tokens), self.cache,
             jnp.array(self.positions), self.kv_mask, sub,
-            self.gen.temperature, self.gen.top_k, self.gen.top_p,
+            jnp.array(self.temps), self.gen.top_k, self.gen.top_p,
             decode_attn=self._decode_attn,
             attn_kernel=self._attn_kernel,
         )

@@ -841,20 +841,46 @@ def sample_logits(
     temperature == 0 is greedy (argmax), matching generate()."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
-    logits = logits / temperature
+    logits = _filter_top_k_top_p(logits / temperature, top_k, top_p)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def _filter_top_k_top_p(logits: jax.Array, top_k: int,
+                        top_p: float) -> jax.Array:
+    """THE top-k / nucleus filter (shared by the scalar- and per-row
+    samplers so the edge cases cannot drift): top-k keeps the k best per
+    row; top-p cuts tokens whose EXCLUSIVE prefix mass already covers
+    top_p — the best token always survives."""
     if top_k:
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]  # (B, 1)
         logits = jnp.where(logits < kth, NEG_INF, logits)
     if top_p < 1.0:
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
-        # Exclusive cumulative mass BEFORE each token; tokens whose prefix
-        # already covers top_p are cut. The best token always survives.
         cum = jnp.cumsum(probs, axis=-1) - probs
         cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True) - 1
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff, NEG_INF, logits)
-    return jax.random.categorical(key, logits, axis=-1)
+    return logits
+
+
+def sample_logits_per_row(
+    logits: jax.Array,  # (B, V) f32
+    key: jax.Array,
+    temps: jax.Array,  # (B,) f32 — 0 = greedy for that row
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """sample_logits with a PER-ROW temperature: a serving batch mixes
+    requests that asked for different temperatures (greedy rows ride the
+    same categorical via a where — no branching, one compiled step for
+    any mix). top_k/top_p stay engine-wide: their shapes are static."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = _filter_top_k_top_p(
+        logits / jnp.maximum(temps, 1e-6)[:, None], top_k, top_p
+    )
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temps <= 0.0, greedy, sampled)
 
 
 @partial(

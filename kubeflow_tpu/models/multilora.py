@@ -54,7 +54,7 @@ from kubeflow_tpu.models.llama import (
     apply_rope,
     init_kv_cache,
     rope_frequencies,
-    sample_logits,
+    sample_logits_per_row,
 )
 from kubeflow_tpu.models.lora import LoraConfig, init_lora_params
 from kubeflow_tpu.models.serving import GenerationConfig
@@ -191,11 +191,11 @@ def _scan_body(params, cfg, scaling, x, cos, sin, positions, kv_mask,
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "scaling", "temperature", "top_k", "top_p"),
+    static_argnames=("cfg", "scaling", "top_k", "top_p"),
     donate_argnums=(4,),
 )
 def _ml_step(params, stacked, ids, tokens, cache, positions, kv_mask, key,
-             cfg: LlamaConfig, scaling: float, temperature: float,
+             temps, cfg: LlamaConfig, scaling: float,
              top_k: int, top_p: float):
     """One decode step across every slot, each under its own adapter."""
     x = _embed(params, cfg, tokens)
@@ -210,7 +210,7 @@ def _ml_step(params, stacked, ids, tokens, cache, positions, kv_mask, key,
     x, new_cache = jax.lax.scan(body, x, (params["layers"], cache, sel))
     logits = _lm_head_logits(_norm(x[:, 0], params["final_norm"], cfg),
                              params)
-    nxt = sample_logits(logits, key, temperature, top_k, top_p)
+    nxt = sample_logits_per_row(logits, key, temps, top_k, top_p)
     return nxt, new_cache
 
 
@@ -321,9 +321,11 @@ class MultiLoraBatcher(ContinuousBatcher):
             )
         return adapter
 
-    def submit(self, prompt, max_new_tokens=None, adapter=None) -> int:
+    def submit(self, prompt, max_new_tokens=None, adapter=None,
+               temperature=None) -> int:
         aid = self.resolve_adapter(adapter)
-        rid = super().submit(prompt, max_new_tokens=max_new_tokens)
+        rid = super().submit(prompt, max_new_tokens=max_new_tokens,
+                             temperature=temperature)
         self._queue[-1].adapter_id = aid
         return rid
 
@@ -347,8 +349,8 @@ class MultiLoraBatcher(ContinuousBatcher):
         nxt, self.cache = _ml_step(
             self.params, self.stacked, jnp.asarray(self._slot_adapter),
             jnp.array(self.tokens), self.cache, jnp.array(self.positions),
-            self.kv_mask, sub, self.cfg, self.scaling,
-            self.gen.temperature, self.gen.top_k, self.gen.top_p,
+            self.kv_mask, sub, jnp.array(self.temps), self.cfg,
+            self.scaling, self.gen.top_k, self.gen.top_p,
         )
         for slot in active:
             self.positions[slot] += 1

@@ -71,6 +71,7 @@ from kubeflow_tpu.models.llama import (
     init_kv_cache,
     rope_frequencies,
     sample_logits,
+    sample_logits_per_row,
 )
 from kubeflow_tpu.models.continuous import _BatcherBase, _Request
 from kubeflow_tpu.models.serving import GenerationConfig, left_pad
@@ -124,7 +125,7 @@ def _paged_admit(
 @partial(
     jax.jit,
     static_argnames=(
-        "cfg", "block_size", "temperature", "top_k", "top_p", "attn_kernel",
+        "cfg", "block_size", "top_k", "top_p", "attn_kernel",
     ),
     donate_argnums=(3,),
 )
@@ -138,7 +139,7 @@ def _paged_step(
     kv_mask: jax.Array,  # (B, MAXB * BS)
     key: jax.Array,
     block_size: int,
-    temperature: float,
+    temps: jax.Array,  # (B,) per-slot sampling temperature (0 = greedy)
     top_k: int,
     top_p: float,
     attn_kernel: bool = False,
@@ -154,7 +155,7 @@ def _paged_step(
         positions, block_size, attn_kernel=attn_kernel,
     )
     logits = _lm_head_logits(_norm(x[:, 0], params["final_norm"], cfg), params)
-    nxt = sample_logits(logits, key, temperature, top_k, top_p)
+    nxt = sample_logits_per_row(logits, key, temps, top_k, top_p)
     return nxt, new_pool
 
 
@@ -611,13 +612,16 @@ class PagedBatcher(_BatcherBase):
         admission logits, install the request, prime any lockstep draft
         cache (_post_admit), and feed the token through retirement."""
         self.key, sub = jax.random.split(self.key)
+        temp = (self.gen.temperature if req.temperature is None
+                else req.temperature)
         first = int(
             sample_logits(
-                logits[None], sub, self.gen.temperature, self.gen.top_k,
+                logits[None], sub, temp, self.gen.top_k,
                 self.gen.top_p,
             )[0]
         )
         req.budget = self._initial_budget(req) - len(req.tokens)
+        self.temps[slot] = temp
         self._by_slot[slot] = req
         self._post_admit(slot, draft_tokens, draft_mask)
         self._note_token(slot, first)
@@ -637,7 +641,8 @@ class PagedBatcher(_BatcherBase):
         req = self._by_slot[slot]
         self._release_slot(slot)
         # Front of the queue: a preempted request outranks new arrivals.
-        cont = _Request(req.rid, req.prompt, req.tokens, max_new=req.max_new)
+        cont = _Request(req.rid, req.prompt, req.tokens, max_new=req.max_new,
+                        temperature=req.temperature)
         self._queue.insert(0, cont)
 
     def _release_slot(self, slot: int) -> None:
@@ -766,7 +771,8 @@ class PagedBatcher(_BatcherBase):
             self._finish_admit(
                 slot,
                 _Request(req.rid, req.prompt, generated, blocks=blocks,
-                         shared=shared, max_new=req.max_new),
+                         shared=shared, max_new=req.max_new,
+                         temperature=req.temperature),
                 logits, jnp.asarray(padded), prompt_mask,
             )
 
@@ -889,7 +895,8 @@ class PagedBatcher(_BatcherBase):
                 _Request(req.rid, req.prompt, generated,
                          blocks=all_blocks,
                          shared=frozenset(all_blocks[:registrable]),
-                         max_new=req.max_new),
+                         max_new=req.max_new,
+                         temperature=req.temperature),
                 logits, jnp.asarray(dpad), None,
             )
 
@@ -933,7 +940,7 @@ class PagedBatcher(_BatcherBase):
         nxt, self.pool = _paged_step(
             self.params, self.cfg, jnp.array(self.tokens), self.pool,
             jnp.array(self.tables), jnp.array(self.positions), self.kv_mask,
-            sub, self.block_size, self.gen.temperature, self.gen.top_k,
+            sub, self.block_size, jnp.array(self.temps), self.gen.top_k,
             self.gen.top_p, attn_kernel=self.attn_kernel,
         )
         for slot in active:

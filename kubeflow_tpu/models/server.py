@@ -34,6 +34,7 @@ NB_PREFIX/port wiring.
 from __future__ import annotations
 
 import json
+import math
 import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -194,7 +195,9 @@ class InferenceServer:
     # -- HTTP side ---------------------------------------------------------
 
     def _submit(self, prompt: list[int], max_tokens: Optional[int],
-                model: Optional[str] = None) -> tuple[int, queue.Queue]:
+                model: Optional[str] = None,
+                temperature: Optional[float] = None,
+                ) -> tuple[int, queue.Queue]:
         q: queue.Queue = queue.Queue()
         with self._work:
             if self._engine_error is not None:
@@ -215,10 +218,12 @@ class InferenceServer:
                         f"{self.model_name!r})"
                     )
                 rid = self.engine.submit(
-                    prompt, max_new_tokens=max_tokens, adapter=model
+                    prompt, max_new_tokens=max_tokens, adapter=model,
+                    temperature=temperature,
                 )
             else:
-                rid = self.engine.submit(prompt, max_new_tokens=max_tokens)
+                rid = self.engine.submit(prompt, max_new_tokens=max_tokens,
+                                         temperature=temperature)
             self._queues[rid] = q
             self._work.notify_all()
         return rid, q
@@ -315,56 +320,88 @@ class InferenceServer:
                             f"max_tokens must be an integer, got "
                             f"{max_tokens!r}"
                         )
+                    temperature = req.get("temperature")
+                    if temperature is not None and (
+                        not isinstance(temperature, (int, float))
+                        or isinstance(temperature, bool)
+                        or not math.isfinite(temperature)
+                        or temperature < 0
+                    ):
+                        # isfinite: json.loads parses NaN/Infinity by
+                        # default, and NaN < 0 is False.
+                        raise ValueError(
+                            f"temperature must be a finite number >= 0, "
+                            f"got {temperature!r}"
+                        )
+                    n = req.get("n", 1)
+                    if not isinstance(n, int) or isinstance(n, bool) or (
+                        not 1 <= n <= 64
+                    ):
+                        raise ValueError(
+                            f"n must be an integer in [1, 64], got {n!r}"
+                        )
                     stream = bool(req.get("stream", False))
+                    if stream and n > 1:
+                        raise ValueError("stream does not support n > 1")
                 except (ValueError, TypeError, json.JSONDecodeError) as err:
                     self._json(400, {"error": str(err)})
                     return
+                subs = []
                 try:
-                    rid, q = server._submit(prompt, max_tokens,
-                                            req.get("model"))
-                except EngineFailedError as err:
-                    self._json(503, {"error": str(err)})
-                    return
-                except ValueError as err:  # over-bucket prompt etc.
-                    self._json(400, {"error": str(err)})
-                    return
-                try:
+                    try:
+                        for _ in range(n):
+                            subs.append(server._submit(
+                                prompt, max_tokens, req.get("model"),
+                                temperature,
+                            ))
+                    except EngineFailedError as err:
+                        self._json(503, {"error": str(err)})
+                        return
+                    except ValueError as err:  # over-bucket prompt etc.
+                        self._json(400, {"error": str(err)})
+                        return
                     if stream:
-                        self._stream(rid, q)
+                        self._stream(*subs[0])
                     else:
-                        self._complete(rid, q, len(prompt))
+                        self._complete(subs, len(prompt))
                 finally:
-                    server._finish(rid)
+                    for rid, _ in subs:
+                        server._finish(rid)
 
-            def _complete(self, rid, q, prompt_len):
-                tokens = []
-                while True:
-                    item = q.get()
-                    if item is _DONE or isinstance(item, _Abort):
-                        break
-                    tokens.append(item)
-                # Drop the queue BEFORE writing: a client that has seen
-                # the response must be able to observe the server state
-                # already cleaned up (the finally stays as a safety net).
-                server._finish(rid)
-                if isinstance(item, _Abort):
-                    self._json(500, {"error": item.reason,
-                                     "partial_tokens": tokens})
-                    return
-                choice = {"index": 0, "tokens": tokens,
-                          "finish_reason": "stop"}
-                text = server._text(tokens)
-                if text is not None:
-                    choice["text"] = text
+            def _complete(self, subs, prompt_len):
+                choices = []
+                for idx, (rid, q) in enumerate(subs):
+                    tokens = []
+                    while True:
+                        item = q.get()
+                        if item is _DONE or isinstance(item, _Abort):
+                            break
+                        tokens.append(item)
+                    # Drop the queue BEFORE writing: a client that has
+                    # seen the response must be able to observe the
+                    # server state already cleaned up (the finally stays
+                    # as a safety net).
+                    server._finish(rid)
+                    if isinstance(item, _Abort):
+                        self._json(500, {"error": item.reason,
+                                         "partial_tokens": tokens})
+                        return
+                    choice = {"index": idx, "tokens": tokens,
+                              "finish_reason": "stop"}
+                    text = server._text(tokens)
+                    if text is not None:
+                        choice["text"] = text
+                    choices.append(choice)
+                total = sum(len(c["tokens"]) for c in choices)
                 self._json(200, {
-                    "id": f"cmpl-{rid}",
+                    "id": f"cmpl-{subs[0][0]}",
                     "object": "text_completion",
                     "model": server.model_name,
-                    "choices": [choice],
+                    "choices": choices,
                     "usage": {
                         "prompt_tokens": prompt_len,
-                        "completion_tokens": len(tokens),
-                        "total_tokens": prompt_len + len(tokens),
+                        "completion_tokens": total,
+                        "total_tokens": prompt_len + total,
                     },
                 })
 

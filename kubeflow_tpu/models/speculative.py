@@ -244,6 +244,20 @@ class _SpecServingBase:
         outer = self
 
         class _Inner(engine_cls):
+            def submit(self, prompt, max_new_tokens=None, temperature=None,
+                       **kw):
+                # Speculative serving is greedy-only (acceptance compares
+                # argmaxes) — a sampled request would be silently served
+                # greedy, so reject it where the engine-wide guard lives.
+                if temperature:
+                    raise ValueError(
+                        "speculative serving is greedy-only; per-request "
+                        f"temperature {temperature} is not supported"
+                    )
+                return super().submit(
+                    prompt, max_new_tokens=max_new_tokens, **kw
+                )
+
             def _post_admit(self, slot, padded, prompt_mask):
                 outer._admit_draft(slot, padded, prompt_mask)
 
@@ -279,8 +293,12 @@ class _SpecServingBase:
 
     # -- public surface (delegated) ----------------------------------------
 
-    def submit(self, prompt, max_new_tokens=None) -> int:
-        return self._engine.submit(prompt, max_new_tokens=max_new_tokens)
+    def submit(self, prompt, max_new_tokens=None, temperature=None) -> int:
+        # Delegated verbatim: the inner engine owns the greedy-only
+        # temperature rejection, so library and HTTP callers get the
+        # same ValueError.
+        return self._engine.submit(prompt, max_new_tokens=max_new_tokens,
+                                   temperature=temperature)
 
     def run(self) -> dict:
         return self._engine.run()

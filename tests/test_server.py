@@ -291,3 +291,80 @@ class TestEngineHooks:
         slot = next(i for i, r in enumerate(pb2._by_slot) if r is not None)
         pb2._preempt(slot)
         assert pb2._queue[0].max_new == 3
+
+
+class TestSamplingOptions:
+    def test_per_request_temperature_mixes_greedy_and_sampled(self):
+        """A batch mixing temperature=0 and temperature>0 rows: the
+        greedy row must be bit-identical to an all-greedy server's
+        output (its neighbors' sampling must not perturb it)."""
+        eng = _engine(slots=2)
+        greedy_rid = eng.submit([1, 2, 3, 4], temperature=0.0)
+        eng.submit([5, 6, 7], temperature=1.5)
+        out = eng.run()
+
+        ref = _engine(slots=2)
+        rid2 = ref.submit([1, 2, 3, 4])
+        want = ref.run()[rid2]
+        assert out[greedy_rid] == want
+
+    def test_per_request_temperature_on_paged(self):
+        from kubeflow_tpu.models.paged import PagedBatcher
+
+        pb = PagedBatcher(PARAMS, CFG,
+                          gen=GenerationConfig(max_new_tokens=6,
+                                               temperature=1.0),
+                          slots=2, num_blocks=32, block_size=16,
+                          prompt_bucket=16)
+        rid = pb.submit([1, 2, 3, 4], temperature=0.0)
+        pb.submit([5, 6, 7])  # engine-default sampled
+        out = pb.run()
+
+        ref = PagedBatcher(PARAMS, CFG,
+                           gen=GenerationConfig(max_new_tokens=6),
+                           slots=2, num_blocks=32, block_size=16,
+                           prompt_bucket=16)
+        ref_rid = ref.submit([1, 2, 3, 4])
+        assert out[rid] == ref.run()[ref_rid]
+
+    def test_speculative_rejects_per_request_temperature(self):
+        from kubeflow_tpu.models.speculative import (
+            SpeculativeContinuousBatcher, truncated_draft,
+        )
+
+        draft, dcfg = truncated_draft(PARAMS, CFG, 1)
+        spec = SpeculativeContinuousBatcher(
+            PARAMS, CFG, draft, dcfg, gen=GenerationConfig(max_new_tokens=4),
+            slots=2, cache_len=128, prompt_bucket=16, k_spec=2,
+        )
+        with pytest.raises(ValueError, match="greedy-only"):
+            spec._engine.submit([1, 2, 3], temperature=0.7)
+        # the public wrapper surface gives the SAME clean error
+        with pytest.raises(ValueError, match="greedy-only"):
+            spec.submit([1, 2, 3], temperature=0.7)
+
+    def test_http_temperature_and_n(self, server):
+        # n greedy samples are identical; the response carries n choices
+        out = _post(server.port, {"prompt": [1, 2, 3], "n": 3,
+                                  "temperature": 0})
+        assert len(out["choices"]) == 3
+        assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+        toks = {str(c["tokens"]) for c in out["choices"]}
+        assert len(toks) == 1  # greedy => identical
+        assert out["usage"]["completion_tokens"] == sum(
+            len(c["tokens"]) for c in out["choices"]
+        )
+
+    def test_http_rejects_bad_sampling_params(self, server):
+        for payload in (
+            {"prompt": [1], "temperature": -1},
+            {"prompt": [1], "temperature": float("nan")},
+            {"prompt": [1], "temperature": float("inf")},
+            {"prompt": [1], "temperature": "hot"},
+            {"prompt": [1], "n": 0},
+            {"prompt": [1], "n": "three"},
+            {"prompt": [1], "n": 2, "stream": True},
+        ):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(server.port, payload)
+            assert err.value.code == 400, payload
