@@ -117,6 +117,7 @@ def _cb_step(
     temps: jax.Array,  # (B,) per-slot sampling temperature (0 = greedy)
     top_k: int,
     top_p: float,
+    bias=None,  # (B, V) per-slot logit bias, or None (bias-free program)
     decode_attn=None,  # mesh-bound SP decode (make_sharded_sp_decode)
     attn_kernel: int = 0,  # >0: pallas length-bounded decode, chunk size
 ) -> tuple[jax.Array, dict]:
@@ -180,6 +181,8 @@ def _cb_step(
 
     x, new_cache = jax.lax.scan(body, x, (params["layers"], cache))
     logits = _lm_head_logits(_norm(x[:, 0], params["final_norm"], cfg), params)
+    if bias is not None:
+        logits = logits + bias
     nxt = sample_logits_per_row(logits, key, temps, top_k, top_p)
     return nxt, new_cache
 
@@ -202,6 +205,16 @@ class _Request:
     # gen.temperature). 0 = greedy for this row; top_k/top_p stay
     # engine-wide (their shapes are compiled in).
     temperature: Optional[float] = None
+    # Per-request stop sequences (token-id lists). Checked host-side in
+    # _note_token after each emitted token; on a suffix match the
+    # request retires with the stop sequence EXCLUDED from its output
+    # (OpenAI semantics).
+    stop: tuple = ()
+    # Per-request logit bias {token_id: bias}, added to the row's logits
+    # before sampling (OpenAI logit_bias; ±100 effectively forces or
+    # bans a token). Device-resident per-slot rows — uploaded once at
+    # admit, not per step.
+    logit_bias: Optional[dict] = None
     # Paged batcher only: physical block ids this request holds, in
     # position order. Harmless (empty) for the fixed-slot batcher.
     blocks: list[int] = dataclasses.field(default_factory=list)
@@ -225,6 +238,10 @@ class _BatcherBase:
         # Per-slot effective temperature (request override or the
         # engine-wide default), uploaded with each step.
         self.temps = np.full((slots,), gen.temperature, np.float32)
+        # Per-slot logit-bias rows, device-resident, allocated lazily on
+        # the first biased request (None keeps the unbiased step's
+        # compiled program bias-free).
+        self._bias = None
         self._queue: list[_Request] = []
         self._by_slot: list[Optional[_Request]] = [None] * slots
         self._results: dict[int, list[int]] = {}
@@ -239,7 +256,9 @@ class _BatcherBase:
 
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
-               temperature: Optional[float] = None) -> int:
+               temperature: Optional[float] = None,
+               stop: Optional[Sequence[Sequence[int]]] = None,
+               logit_bias: Optional[dict] = None) -> int:
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) > self.prompt_bucket:
@@ -260,11 +279,39 @@ class _BatcherBase:
                 f"temperature must be a finite number >= 0, got "
                 f"{temperature!r}"
             )
+        stop_seqs: tuple = ()
+        if stop:
+            stop_seqs = tuple(tuple(int(t) for t in seq) for seq in stop)
+            if (not all(stop_seqs) or len(stop_seqs) > 8
+                    or any(len(s) > 64 for s in stop_seqs)):
+                # Bounded like every other client input: the suffix
+                # compare runs per emitted token under the engine lock —
+                # an unbounded sequence would stall every slot.
+                raise ValueError(
+                    "stop must be 1..8 non-empty token-id sequences of "
+                    "at most 64 tokens each"
+                )
+        bias = None
+        if logit_bias:
+            bias = {}
+            for tok, b in logit_bias.items():
+                tok = int(tok)
+                if not 0 <= tok < self.cfg.vocab_size:
+                    raise ValueError(
+                        f"logit_bias token {tok} outside vocab "
+                        f"[0, {self.cfg.vocab_size})"
+                    )
+                b = float(b)
+                if not math.isfinite(b):
+                    raise ValueError(f"logit_bias value {b!r} not finite")
+                # OpenAI clamps to ±100 (±100 effectively forces/bans).
+                bias[tok] = max(-100.0, min(100.0, b))
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(_Request(
             rid, list(prompt), max_new=max_new_tokens,
             temperature=None if temperature is None else float(temperature),
+            stop=stop_seqs, logit_bias=bias,
         ))
         return rid
 
@@ -274,6 +321,25 @@ class _BatcherBase:
         if req.max_new is None:
             return self.gen.max_new_tokens
         return min(req.max_new, self.gen.max_new_tokens)
+
+    def _install_bias(self, slot: int, req: _Request):
+        """Write the slot's logit-bias row (zeros for unbiased requests —
+        a stale row from the previous occupant must never leak) and
+        return the row's bias as a (V,) array for the ADMISSION sample,
+        or None. The (B, V) array is device-resident: uploaded rows at
+        admit, read every step, never re-uploaded."""
+        if req.logit_bias is None and self._bias is None:
+            return None
+        if self._bias is None:
+            self._bias = jnp.zeros(
+                (self.slots, self.cfg.vocab_size), jnp.float32
+            )
+        row = np.zeros((self.cfg.vocab_size,), np.float32)
+        for tok, b in (req.logit_bias or {}).items():
+            row[tok] = b
+        row = jnp.asarray(row)
+        self._bias = self._bias.at[slot].set(row)
+        return row if req.logit_bias else None
 
     def run(self) -> dict[int, list[int]]:
         """Drive until queue and slots drain; returns {rid: tokens}."""
@@ -296,6 +362,14 @@ class _BatcherBase:
         req.tokens.append(token)
         if self.on_token is not None:
             self.on_token(req.rid, token)
+        for seq in req.stop:
+            if (len(req.tokens) >= len(seq)
+                    and tuple(req.tokens[-len(seq):]) == seq):
+                # OpenAI semantics: generation ends AT the stop sequence
+                # and the sequence itself is excluded from the output.
+                del req.tokens[-len(seq):]
+                self._retire(slot)
+                return
         if req.budget <= 0:
             self._retire(slot)
             return
@@ -444,6 +518,9 @@ class ContinuousBatcher(_BatcherBase):
             self.key, sub = jax.random.split(self.key)
             temp = (self.gen.temperature if req.temperature is None
                     else req.temperature)
+            bias_row = self._install_bias(slot, req)
+            if bias_row is not None:
+                logits = logits + bias_row
             first = int(
                 sample_logits(
                     logits[None], sub, temp, self.gen.top_k,
@@ -488,6 +565,7 @@ class ContinuousBatcher(_BatcherBase):
             self.params, self.cfg, jnp.array(self.tokens), self.cache,
             jnp.array(self.positions), self.kv_mask, sub,
             jnp.array(self.temps), self.gen.top_k, self.gen.top_p,
+            bias=self._bias,
             decode_attn=self._decode_attn,
             attn_kernel=self._attn_kernel,
         )

@@ -195,7 +195,7 @@ def _scan_body(params, cfg, scaling, x, cos, sin, positions, kv_mask,
     donate_argnums=(4,),
 )
 def _ml_step(params, stacked, ids, tokens, cache, positions, kv_mask, key,
-             temps, cfg: LlamaConfig, scaling: float,
+             temps, bias, cfg: LlamaConfig, scaling: float,
              top_k: int, top_p: float):
     """One decode step across every slot, each under its own adapter."""
     x = _embed(params, cfg, tokens)
@@ -210,6 +210,8 @@ def _ml_step(params, stacked, ids, tokens, cache, positions, kv_mask, key,
     x, new_cache = jax.lax.scan(body, x, (params["layers"], cache, sel))
     logits = _lm_head_logits(_norm(x[:, 0], params["final_norm"], cfg),
                              params)
+    if bias is not None:
+        logits = logits + bias
     nxt = sample_logits_per_row(logits, key, temps, top_k, top_p)
     return nxt, new_cache
 
@@ -322,10 +324,11 @@ class MultiLoraBatcher(ContinuousBatcher):
         return adapter
 
     def submit(self, prompt, max_new_tokens=None, adapter=None,
-               temperature=None) -> int:
+               temperature=None, stop=None, logit_bias=None) -> int:
         aid = self.resolve_adapter(adapter)
         rid = super().submit(prompt, max_new_tokens=max_new_tokens,
-                             temperature=temperature)
+                             temperature=temperature, stop=stop,
+                             logit_bias=logit_bias)
         self._queue[-1].adapter_id = aid
         return rid
 
@@ -349,8 +352,8 @@ class MultiLoraBatcher(ContinuousBatcher):
         nxt, self.cache = _ml_step(
             self.params, self.stacked, jnp.asarray(self._slot_adapter),
             jnp.array(self.tokens), self.cache, jnp.array(self.positions),
-            self.kv_mask, sub, jnp.array(self.temps), self.cfg,
-            self.scaling, self.gen.top_k, self.gen.top_p,
+            self.kv_mask, sub, jnp.array(self.temps), self._bias,
+            self.cfg, self.scaling, self.gen.top_k, self.gen.top_p,
         )
         for slot in active:
             self.positions[slot] += 1

@@ -142,6 +142,7 @@ def _paged_step(
     temps: jax.Array,  # (B,) per-slot sampling temperature (0 = greedy)
     top_k: int,
     top_p: float,
+    bias=None,  # (B, V) per-slot logit bias, or None (bias-free program)
     attn_kernel: bool = False,
 ) -> tuple[jax.Array, dict]:
     """One decode step across every slot, reading/writing through tables."""
@@ -155,6 +156,8 @@ def _paged_step(
         positions, block_size, attn_kernel=attn_kernel,
     )
     logits = _lm_head_logits(_norm(x[:, 0], params["final_norm"], cfg), params)
+    if bias is not None:
+        logits = logits + bias
     nxt = sample_logits_per_row(logits, key, temps, top_k, top_p)
     return nxt, new_pool
 
@@ -614,6 +617,9 @@ class PagedBatcher(_BatcherBase):
         self.key, sub = jax.random.split(self.key)
         temp = (self.gen.temperature if req.temperature is None
                 else req.temperature)
+        bias_row = self._install_bias(slot, req)
+        if bias_row is not None:
+            logits = logits + bias_row
         first = int(
             sample_logits(
                 logits[None], sub, temp, self.gen.top_k,
@@ -642,7 +648,8 @@ class PagedBatcher(_BatcherBase):
         self._release_slot(slot)
         # Front of the queue: a preempted request outranks new arrivals.
         cont = _Request(req.rid, req.prompt, req.tokens, max_new=req.max_new,
-                        temperature=req.temperature)
+                        temperature=req.temperature, stop=req.stop,
+                        logit_bias=req.logit_bias)
         self._queue.insert(0, cont)
 
     def _release_slot(self, slot: int) -> None:
@@ -772,7 +779,8 @@ class PagedBatcher(_BatcherBase):
                 slot,
                 _Request(req.rid, req.prompt, generated, blocks=blocks,
                          shared=shared, max_new=req.max_new,
-                         temperature=req.temperature),
+                         temperature=req.temperature, stop=req.stop,
+                         logit_bias=req.logit_bias),
                 logits, jnp.asarray(padded), prompt_mask,
             )
 
@@ -896,7 +904,8 @@ class PagedBatcher(_BatcherBase):
                          blocks=all_blocks,
                          shared=frozenset(all_blocks[:registrable]),
                          max_new=req.max_new,
-                         temperature=req.temperature),
+                         temperature=req.temperature, stop=req.stop,
+                         logit_bias=req.logit_bias),
                 logits, jnp.asarray(dpad), None,
             )
 
@@ -941,7 +950,8 @@ class PagedBatcher(_BatcherBase):
             self.params, self.cfg, jnp.array(self.tokens), self.pool,
             jnp.array(self.tables), jnp.array(self.positions), self.kv_mask,
             sub, self.block_size, jnp.array(self.temps), self.gen.top_k,
-            self.gen.top_p, attn_kernel=self.attn_kernel,
+            self.gen.top_p, bias=self._bias,
+            attn_kernel=self.attn_kernel,
         )
         for slot in active:
             self.positions[slot] += 1

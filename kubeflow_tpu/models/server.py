@@ -34,13 +34,19 @@ NB_PREFIX/port wiring.
 from __future__ import annotations
 
 import json
-import math
 import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-_DONE = object()  # sentinel closing a request's token queue: SUCCESS
+class _Final:
+    """Success sentinel carrying the AUTHORITATIVE final token list: a
+    stop-sequence match truncates tokens the per-token stream already
+    delivered, so non-streaming responses must use the retire payload,
+    not the accumulated stream."""
+
+    def __init__(self, tokens: list):
+        self.tokens = tokens
 
 
 class _Abort:
@@ -136,7 +142,7 @@ class InferenceServer:
         self._served += 1
         q = self._queues.get(rid)
         if q is not None:
-            q.put(_DONE)
+            q.put(_Final(list(tokens)))
 
     def _drive(self) -> None:
         while True:
@@ -156,7 +162,7 @@ class InferenceServer:
                     # forever, flip /healthz red, and stop driving. A
                     # silently-dead daemon thread would leave a hung
                     # server that health checks keep calling healthy.
-                    # Queues that already received _DONE completed
+                    # Queues that already received _Final completed
                     # normally; only still-open ones get the abort.
                     self._engine_error = f"{type(err).__name__}: {err}"
                     abort = _Abort(self._engine_error)
@@ -183,7 +189,7 @@ class InferenceServer:
             # Unblock every in-flight handler: a request mid-decode would
             # otherwise hang its client past process exit. Shutdown
             # truncation is an ABORT — a partial answer must never read
-            # as a completed generation (queues that already hold _DONE
+            # as a completed generation (queues that already hold _Final
             # drain it first, FIFO, and complete normally).
             abort = _Abort("server shutdown before generation finished")
             for q in self._queues.values():
@@ -194,9 +200,45 @@ class InferenceServer:
 
     # -- HTTP side ---------------------------------------------------------
 
+    def _decode_stop(self, stop):
+        """OpenAI "stop": a string / list of strings (needs a tokenizer),
+        or token-native: a list of ints (one sequence) / list of lists."""
+        if stop is None:
+            return None
+        if isinstance(stop, str):
+            stop = [stop]
+        if not isinstance(stop, list) or not stop:
+            raise ValueError("stop must be a string or a non-empty list")
+        if all(isinstance(s, str) for s in stop):
+            if self.tokenizer is None:
+                raise ValueError(
+                    "string stop sequences need a tokenizer; send token "
+                    "id lists"
+                )
+            return [
+                list(self.tokenizer(s, add_special_tokens=False)["input_ids"])
+                for s in stop
+            ]
+        if all(isinstance(t, int) and not isinstance(t, bool)
+               for t in stop):
+            return [list(stop)]  # one token-id sequence
+        if all(
+            isinstance(s, list)
+            and s
+            and all(isinstance(t, int) and not isinstance(t, bool)
+                    for t in s)
+            for s in stop
+        ):
+            return [list(s) for s in stop]
+        raise ValueError(
+            "stop must be string(s), a token-id list, or a list of "
+            "token-id lists"
+        )
+
     def _submit(self, prompt: list[int], max_tokens: Optional[int],
                 model: Optional[str] = None,
                 temperature: Optional[float] = None,
+                stop=None, logit_bias=None,
                 ) -> tuple[int, queue.Queue]:
         q: queue.Queue = queue.Queue()
         with self._work:
@@ -219,11 +261,13 @@ class InferenceServer:
                     )
                 rid = self.engine.submit(
                     prompt, max_new_tokens=max_tokens, adapter=model,
-                    temperature=temperature,
+                    temperature=temperature, stop=stop,
+                    logit_bias=logit_bias,
                 )
             else:
                 rid = self.engine.submit(prompt, max_new_tokens=max_tokens,
-                                         temperature=temperature)
+                                         temperature=temperature, stop=stop,
+                                         logit_bias=logit_bias)
             self._queues[rid] = q
             self._work.notify_all()
         return rid, q
@@ -320,25 +364,26 @@ class InferenceServer:
                             f"max_tokens must be an integer, got "
                             f"{max_tokens!r}"
                         )
+                    # temperature is validated by the engine's submit()
+                    # (isfinite incl. the JSON NaN/Infinity hole) — the
+                    # ValueError it raises already becomes a 400 below;
+                    # a second copy here could silently diverge.
                     temperature = req.get("temperature")
-                    if temperature is not None and (
-                        not isinstance(temperature, (int, float))
-                        or isinstance(temperature, bool)
-                        or not math.isfinite(temperature)
-                        or temperature < 0
-                    ):
-                        # isfinite: json.loads parses NaN/Infinity by
-                        # default, and NaN < 0 is False.
-                        raise ValueError(
-                            f"temperature must be a finite number >= 0, "
-                            f"got {temperature!r}"
-                        )
                     n = req.get("n", 1)
                     if not isinstance(n, int) or isinstance(n, bool) or (
                         not 1 <= n <= 64
                     ):
                         raise ValueError(
                             f"n must be an integer in [1, 64], got {n!r}"
+                        )
+                    stop = server._decode_stop(req.get("stop"))
+                    logit_bias = req.get("logit_bias")
+                    if logit_bias is not None and not isinstance(
+                        logit_bias, dict
+                    ):
+                        raise ValueError(
+                            "logit_bias must be an object mapping token "
+                            "ids to biases"
                         )
                     stream = bool(req.get("stream", False))
                     if stream and n > 1:
@@ -352,7 +397,7 @@ class InferenceServer:
                         for _ in range(n):
                             subs.append(server._submit(
                                 prompt, max_tokens, req.get("model"),
-                                temperature,
+                                temperature, stop, logit_bias,
                             ))
                     except EngineFailedError as err:
                         self._json(503, {"error": str(err)})
@@ -374,9 +419,13 @@ class InferenceServer:
                     tokens = []
                     while True:
                         item = q.get()
-                        if item is _DONE or isinstance(item, _Abort):
+                        if isinstance(item, (_Final, _Abort)):
                             break
                         tokens.append(item)
+                    if isinstance(item, _Final):
+                        # Authoritative: a stop match truncated tokens
+                        # the stream already delivered.
+                        tokens = item.tokens
                     # Drop the queue BEFORE writing: a client that has
                     # seen the response must be able to observe the
                     # server state already cleaned up (the finally stays
@@ -414,7 +463,7 @@ class InferenceServer:
                 self.end_headers()
                 while True:
                     item = q.get()
-                    if item is _DONE or isinstance(item, _Abort):
+                    if isinstance(item, (_Final, _Abort)):
                         server._finish(rid)
                         # An abort-truncated stream must be
                         # distinguishable from a completed one.

@@ -245,7 +245,7 @@ class _SpecServingBase:
 
         class _Inner(engine_cls):
             def submit(self, prompt, max_new_tokens=None, temperature=None,
-                       **kw):
+                       logit_bias=None, **kw):
                 # Speculative serving is greedy-only (acceptance compares
                 # argmaxes) — a sampled request would be silently served
                 # greedy, so reject it where the engine-wide guard lives.
@@ -253,6 +253,11 @@ class _SpecServingBase:
                     raise ValueError(
                         "speculative serving is greedy-only; per-request "
                         f"temperature {temperature} is not supported"
+                    )
+                if logit_bias:
+                    raise ValueError(
+                        "speculative serving does not support logit_bias "
+                        "(verification compares UNbiased argmaxes)"
                     )
                 return super().submit(
                     prompt, max_new_tokens=max_new_tokens, **kw
@@ -293,12 +298,14 @@ class _SpecServingBase:
 
     # -- public surface (delegated) ----------------------------------------
 
-    def submit(self, prompt, max_new_tokens=None, temperature=None) -> int:
+    def submit(self, prompt, max_new_tokens=None, temperature=None,
+               stop=None, logit_bias=None) -> int:
         # Delegated verbatim: the inner engine owns the greedy-only
-        # temperature rejection, so library and HTTP callers get the
-        # same ValueError.
+        # temperature/logit_bias rejections, so library and HTTP callers
+        # get the same ValueError.
         return self._engine.submit(prompt, max_new_tokens=max_new_tokens,
-                                   temperature=temperature)
+                                   temperature=temperature, stop=stop,
+                                   logit_bias=logit_bias)
 
     def run(self) -> dict:
         return self._engine.run()

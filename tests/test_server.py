@@ -368,3 +368,102 @@ class TestSamplingOptions:
             with pytest.raises(urllib.error.HTTPError) as err:
                 _post(server.port, payload)
             assert err.value.code == 400, payload
+
+
+class TestStopAndBias:
+    def test_stop_sequence_truncates_and_excludes(self):
+        """Stop at the greedy continuation's own tokens: output ends
+        BEFORE the stop sequence (OpenAI semantics)."""
+        eng = _engine()
+        rid = eng.submit([1, 2, 3, 4])
+        full = eng.run()[rid]
+        assert len(full) >= 4
+        stop_seq = full[2:4]
+        eng2 = _engine()
+        rid2 = eng2.submit([1, 2, 3, 4], stop=[stop_seq])
+        got = eng2.run()[rid2]
+        assert got == full[:2]
+
+    def test_stop_carries_through_paged_preemption(self):
+        from kubeflow_tpu.models.paged import PagedBatcher
+
+        pb = PagedBatcher(PARAMS, CFG, gen=GenerationConfig(max_new_tokens=6),
+                          slots=2, num_blocks=32, block_size=16,
+                          prompt_bucket=16)
+        rid = pb.submit([1, 2, 3], stop=[[99999]])
+        pb._admit_free_slots()
+        slot = next(i for i, r in enumerate(pb._by_slot) if r is not None)
+        pb._preempt(slot)
+        assert pb._queue[0].stop == ((99999,),)
+        assert pb._queue[0].logit_bias is None
+
+    def test_logit_bias_forces_and_bans(self):
+        """+100 forces a token under greedy; banning the greedy token
+        changes the output."""
+        eng = _engine()
+        rid = eng.submit([1, 2, 3], max_new_tokens=4,
+                         logit_bias={7: 100.0})
+        assert eng.run()[rid] == [7, 7, 7, 7]
+
+        base = _engine()
+        b_rid = base.submit([1, 2, 3], max_new_tokens=1)
+        first = base.run()[b_rid][0]
+        banned = _engine()
+        n_rid = banned.submit([1, 2, 3], max_new_tokens=1,
+                              logit_bias={first: -100.0})
+        assert banned.run()[n_rid][0] != first
+
+    def test_unbiased_neighbor_unaffected(self):
+        """A biased row must not perturb its unbiased neighbor (zeroed
+        rows in the bias array, not stale ones)."""
+        ref = _engine(slots=2)
+        r = ref.submit([5, 6, 7], max_new_tokens=4)
+        want = ref.run()[r]
+        eng = _engine(slots=2)
+        eng.submit([1, 2, 3], max_new_tokens=4, logit_bias={7: 100.0})
+        rid = eng.submit([5, 6, 7], max_new_tokens=4)
+        assert eng.run()[rid] == want
+
+    def test_submit_validates_bias(self):
+        eng = _engine()
+        with pytest.raises(ValueError, match="vocab"):
+            eng.submit([1], logit_bias={10**7: 1.0})
+        with pytest.raises(ValueError, match="finite"):
+            eng.submit([1], logit_bias={5: float("nan")})
+
+    def test_speculative_rejects_bias(self):
+        from kubeflow_tpu.models.speculative import (
+            SpeculativeContinuousBatcher, truncated_draft,
+        )
+
+        draft, dcfg = truncated_draft(PARAMS, CFG, 1)
+        spec = SpeculativeContinuousBatcher(
+            PARAMS, CFG, draft, dcfg, gen=GenerationConfig(max_new_tokens=4),
+            slots=2, cache_len=128, prompt_bucket=16, k_spec=2,
+        )
+        with pytest.raises(ValueError, match="logit_bias"):
+            spec.submit([1, 2, 3], logit_bias={5: 1.0})
+
+    def test_http_stop_and_bias(self, server):
+        out = _post(server.port, {"prompt": [1, 2, 3], "max_tokens": 4,
+                                  "logit_bias": {"7": 100}})
+        assert out["choices"][0]["tokens"] == [7, 7, 7, 7]
+        out2 = _post(server.port, {"prompt": [1, 2, 3], "max_tokens": 4,
+                                   "logit_bias": {"7": 100},
+                                   "stop": [7, 7]})
+        assert out2["choices"][0]["tokens"] == []
+        for bad in ({"prompt": [1], "stop": "text"},  # needs tokenizer
+                    {"prompt": [1], "logit_bias": ["x"]},
+                    {"prompt": [1], "logit_bias": {"abc": 1}},
+                    {"prompt": [1], "stop": [[]]}):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(server.port, bad)
+            assert err.value.code == 400, bad
+
+
+def test_stop_sequence_length_bounded():
+    """An unbounded stop sequence would make every decode step do an
+    O(len) compare under the engine lock — reject like other inputs."""
+    eng = _engine()
+    with pytest.raises(ValueError, match="64"):
+        eng.submit([1], stop=[[0] * 100000])
