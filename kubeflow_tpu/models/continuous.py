@@ -184,7 +184,13 @@ def _cb_step(
     if bias is not None:
         logits = logits + bias
     nxt = sample_logits_per_row(logits, key, temps, top_k, top_p)
-    return nxt, new_cache
+    # Per-token logprob of the CHOSEN token under the (biased,
+    # temperature-independent) distribution — piggybacks on the step's
+    # existing (B,) readback.
+    lp = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), nxt[:, None], axis=-1
+    )[:, 0]
+    return nxt, lp, new_cache
 
 
 # ---------------------------------------------------------------------------
@@ -210,6 +216,9 @@ class _Request:
     # request retires with the stop sequence EXCLUDED from its output
     # (OpenAI semantics).
     stop: tuple = ()
+    # Chosen-token log-probabilities, aligned with ``tokens`` (may lag
+    # on engines that don't compute them, e.g. speculative rounds).
+    logprobs: list = dataclasses.field(default_factory=list)
     # Per-request logit bias {token_id: bias}, added to the row's logits
     # before sampling (OpenAI logit_bias; ±100 effectively forces or
     # bans a token). Device-resident per-slot rows — uploaded once at
@@ -230,6 +239,11 @@ class _BatcherBase:
     retirement. Subclasses provide ``_admit_free_slots``, ``_step``, and
     ``_release_slot`` (what freeing a slot means for their storage)."""
 
+    # Engines whose steps emit chosen-token logprobs. The speculative
+    # inner engines flip this off: their verified tokens come from
+    # chunked argmax rounds that never compute per-token logprobs.
+    supports_logprobs = True
+
     def _init_base(self, gen: GenerationConfig, slots: int,
                    prompt_bucket: int) -> None:
         self.gen = gen
@@ -245,6 +259,9 @@ class _BatcherBase:
         self._queue: list[_Request] = []
         self._by_slot: list[Optional[_Request]] = [None] * slots
         self._results: dict[int, list[int]] = {}
+        # Chosen-token logprobs per retired request, parallel to
+        # _results (run_logprobs() drains it alongside run()).
+        self._result_logprobs: dict[int, list[float]] = {}
         self._next_rid = 0
         # Serving-frontend hooks (models/server.py): called under the
         # frontend's engine lock. on_token(rid, token) per emitted token;
@@ -347,11 +364,23 @@ class _BatcherBase:
             self._admit_free_slots()
             self._step()
         out, self._results = self._results, {}
+        self._last_logprobs, self._result_logprobs = (
+            self._result_logprobs, {}
+        )
         return out
 
-    def _note_token(self, slot: int, token: int) -> None:
+    def run_logprobs(self) -> dict[int, list[float]]:
+        """Chosen-token logprobs for the most recent run(), {rid: [lp]}.
+        Engines that don't compute logprobs (speculative rounds) return
+        shorter-than-tokens lists."""
+        return getattr(self, "_last_logprobs", {})
+
+    def _note_token(self, slot: int, token: int,
+                    logprob: Optional[float] = None) -> None:
         """Record a sampled token for the slot's request; retire on EOS or
-        exhausted budget; otherwise feed it back as the next input."""
+        exhausted budget; otherwise feed it back as the next input.
+        ``logprob`` (chosen-token log-probability, engines that compute
+        it) accumulates alongside the tokens."""
         req = self._by_slot[slot]
         if req is None:
             return
@@ -360,6 +389,8 @@ class _BatcherBase:
             self._retire(slot)
             return
         req.tokens.append(token)
+        if logprob is not None:
+            req.logprobs.append(logprob)
         if self.on_token is not None:
             self.on_token(req.rid, token)
         for seq in req.stop:
@@ -368,6 +399,7 @@ class _BatcherBase:
                 # OpenAI semantics: generation ends AT the stop sequence
                 # and the sequence itself is excluded from the output.
                 del req.tokens[-len(seq):]
+                del req.logprobs[len(req.tokens):]
                 self._retire(slot)
                 return
         if req.budget <= 0:
@@ -382,9 +414,10 @@ class _BatcherBase:
     def _retire(self, slot: int) -> None:
         req = self._by_slot[slot]
         if self.on_retire is not None:
-            self.on_retire(req.rid, req.tokens)
+            self.on_retire(req.rid, req.tokens, req.logprobs)
         else:
             self._results[req.rid] = req.tokens
+            self._result_logprobs[req.rid] = req.logprobs
         self._release_slot(slot)
 
 
@@ -527,11 +560,14 @@ class ContinuousBatcher(_BatcherBase):
                     self.gen.top_p,
                 )[0]
             )
+            first_lp = float(
+                jax.nn.log_softmax(logits.astype(jnp.float32))[first]
+            )
             self.positions[slot] = self.prompt_bucket
             self.temps[slot] = temp
             self._by_slot[slot] = req
             req.budget = self._initial_budget(req)
-            self._note_token(slot, first)
+            self._note_token(slot, first, first_lp)
 
     def _prefill_into_slot(self, slot: int, req: _Request, padded,
                            prompt_mask) -> jax.Array:
@@ -561,7 +597,7 @@ class ContinuousBatcher(_BatcherBase):
         # jnp.array (not asarray): the CPU backend can alias numpy memory
         # zero-copy, and the host mutates tokens/positions below while the
         # dispatched step may still be reading them — upload COPIES.
-        nxt, self.cache = _cb_step(
+        nxt, lps, self.cache = _cb_step(
             self.params, self.cfg, jnp.array(self.tokens), self.cache,
             jnp.array(self.positions), self.kv_mask, sub,
             jnp.array(self.temps), self.gen.top_k, self.gen.top_p,
@@ -573,5 +609,7 @@ class ContinuousBatcher(_BatcherBase):
         for slot in active:
             self.positions[slot] += 1
         host_next = np.asarray(nxt)  # the one per-step readback
+        host_lps = np.asarray(lps)
         for slot in active:
-            self._note_token(slot, int(host_next[slot]))
+            self._note_token(slot, int(host_next[slot]),
+                             float(host_lps[slot]))

@@ -213,7 +213,10 @@ def _ml_step(params, stacked, ids, tokens, cache, positions, kv_mask, key,
     if bias is not None:
         logits = logits + bias
     nxt = sample_logits_per_row(logits, key, temps, top_k, top_p)
-    return nxt, new_cache
+    lp = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), nxt[:, None], axis=-1
+    )[:, 0]
+    return nxt, lp, new_cache
 
 
 @partial(jax.jit, static_argnames=("cfg", "scaling"))
@@ -349,7 +352,7 @@ class MultiLoraBatcher(ContinuousBatcher):
         if not active:
             return
         self.key, sub = jax.random.split(self.key)
-        nxt, self.cache = _ml_step(
+        nxt, lps, self.cache = _ml_step(
             self.params, self.stacked, jnp.asarray(self._slot_adapter),
             jnp.array(self.tokens), self.cache, jnp.array(self.positions),
             self.kv_mask, sub, jnp.array(self.temps), self._bias,
@@ -358,5 +361,7 @@ class MultiLoraBatcher(ContinuousBatcher):
         for slot in active:
             self.positions[slot] += 1
         host_next = np.asarray(nxt)
+        host_lps = np.asarray(lps)
         for slot in active:
-            self._note_token(slot, int(host_next[slot]))
+            self._note_token(slot, int(host_next[slot]),
+                             float(host_lps[slot]))

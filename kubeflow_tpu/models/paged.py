@@ -159,7 +159,10 @@ def _paged_step(
     if bias is not None:
         logits = logits + bias
     nxt = sample_logits_per_row(logits, key, temps, top_k, top_p)
-    return nxt, new_pool
+    lp = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), nxt[:, None], axis=-1
+    )[:, 0]
+    return nxt, lp, new_pool
 
 
 def _scatter_chunk(pool_l, k, v, blks, offs):
@@ -626,11 +629,14 @@ class PagedBatcher(_BatcherBase):
                 self.gen.top_p,
             )[0]
         )
+        first_lp = float(
+            jax.nn.log_softmax(logits.astype(jnp.float32))[first]
+        )
         req.budget = self._initial_budget(req) - len(req.tokens)
         self.temps[slot] = temp
         self._by_slot[slot] = req
         self._post_admit(slot, draft_tokens, draft_mask)
-        self._note_token(slot, first)
+        self._note_token(slot, first, first_lp)
 
     def _youngest_active(self) -> Optional[int]:
         slots = [
@@ -649,7 +655,8 @@ class PagedBatcher(_BatcherBase):
         # Front of the queue: a preempted request outranks new arrivals.
         cont = _Request(req.rid, req.prompt, req.tokens, max_new=req.max_new,
                         temperature=req.temperature, stop=req.stop,
-                        logit_bias=req.logit_bias)
+                        logit_bias=req.logit_bias,
+                        logprobs=req.logprobs)
         self._queue.insert(0, cont)
 
     def _release_slot(self, slot: int) -> None:
@@ -780,7 +787,8 @@ class PagedBatcher(_BatcherBase):
                 _Request(req.rid, req.prompt, generated, blocks=blocks,
                          shared=shared, max_new=req.max_new,
                          temperature=req.temperature, stop=req.stop,
-                         logit_bias=req.logit_bias),
+                         logit_bias=req.logit_bias,
+                         logprobs=req.logprobs),
                 logits, jnp.asarray(padded), prompt_mask,
             )
 
@@ -905,7 +913,8 @@ class PagedBatcher(_BatcherBase):
                          shared=frozenset(all_blocks[:registrable]),
                          max_new=req.max_new,
                          temperature=req.temperature, stop=req.stop,
-                         logit_bias=req.logit_bias),
+                         logit_bias=req.logit_bias,
+                         logprobs=req.logprobs),
                 logits, jnp.asarray(dpad), None,
             )
 
@@ -946,7 +955,7 @@ class PagedBatcher(_BatcherBase):
         if not active:
             return
         self.key, sub = jax.random.split(self.key)
-        nxt, self.pool = _paged_step(
+        nxt, lps, self.pool = _paged_step(
             self.params, self.cfg, jnp.array(self.tokens), self.pool,
             jnp.array(self.tables), jnp.array(self.positions), self.kv_mask,
             sub, self.block_size, jnp.array(self.temps), self.gen.top_k,
@@ -956,5 +965,7 @@ class PagedBatcher(_BatcherBase):
         for slot in active:
             self.positions[slot] += 1
         host_next = np.asarray(nxt)
+        host_lps = np.asarray(lps)
         for slot in active:
-            self._note_token(slot, int(host_next[slot]))
+            self._note_token(slot, int(host_next[slot]),
+                             float(host_lps[slot]))

@@ -40,13 +40,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 class _Final:
-    """Success sentinel carrying the AUTHORITATIVE final token list: a
+    """Success sentinel carrying the AUTHORITATIVE final token list (a
     stop-sequence match truncates tokens the per-token stream already
     delivered, so non-streaming responses must use the retire payload,
-    not the accumulated stream."""
+    not the accumulated stream) plus the chosen-token logprobs."""
 
-    def __init__(self, tokens: list):
+    def __init__(self, tokens: list, logprobs: list):
         self.tokens = tokens
+        self.logprobs = logprobs
 
 
 class _Abort:
@@ -138,11 +139,12 @@ class InferenceServer:
         if q is not None:
             q.put(token)
 
-    def _on_retire(self, rid: int, tokens: list) -> None:
+    def _on_retire(self, rid: int, tokens: list,
+                   logprobs: list) -> None:
         self._served += 1
         q = self._queues.get(rid)
         if q is not None:
-            q.put(_Final(list(tokens)))
+            q.put(_Final(list(tokens), list(logprobs)))
 
     def _drive(self) -> None:
         while True:
@@ -388,6 +390,18 @@ class InferenceServer:
                     stream = bool(req.get("stream", False))
                     if stream and n > 1:
                         raise ValueError("stream does not support n > 1")
+                    want_logprobs = bool(req.get("logprobs", False))
+                    if want_logprobs and stream:
+                        raise ValueError(
+                            "stream does not support logprobs"
+                        )
+                    if want_logprobs and not getattr(
+                        server.engine, "supports_logprobs", False
+                    ):
+                        raise ValueError(
+                            "this engine does not compute logprobs "
+                            "(speculative serving verifies argmax rounds)"
+                        )
                 except (ValueError, TypeError, json.JSONDecodeError) as err:
                     self._json(400, {"error": str(err)})
                     return
@@ -408,12 +422,12 @@ class InferenceServer:
                     if stream:
                         self._stream(*subs[0])
                     else:
-                        self._complete(subs, len(prompt))
+                        self._complete(subs, len(prompt), want_logprobs)
                 finally:
                     for rid, _ in subs:
                         server._finish(rid)
 
-            def _complete(self, subs, prompt_len):
+            def _complete(self, subs, prompt_len, want_logprobs=False):
                 choices = []
                 for idx, (rid, q) in enumerate(subs):
                     tokens = []
@@ -422,10 +436,12 @@ class InferenceServer:
                         if isinstance(item, (_Final, _Abort)):
                             break
                         tokens.append(item)
+                    logprobs = []
                     if isinstance(item, _Final):
                         # Authoritative: a stop match truncated tokens
                         # the stream already delivered.
                         tokens = item.tokens
+                        logprobs = item.logprobs
                     # Drop the queue BEFORE writing: a client that has
                     # seen the response must be able to observe the
                     # server state already cleaned up (the finally stays
@@ -437,6 +453,11 @@ class InferenceServer:
                         return
                     choice = {"index": idx, "tokens": tokens,
                               "finish_reason": "stop"}
+                    if want_logprobs:
+                        choice["logprobs"] = {
+                            "tokens": tokens,
+                            "token_logprobs": logprobs,
+                        }
                     text = server._text(tokens)
                     if text is not None:
                         choice["text"] = text

@@ -244,6 +244,8 @@ class _SpecServingBase:
         outer = self
 
         class _Inner(engine_cls):
+            supports_logprobs = False  # verified tokens are argmax rounds
+
             def submit(self, prompt, max_new_tokens=None, temperature=None,
                        logit_bias=None, **kw):
                 # Speculative serving is greedy-only (acceptance compares

@@ -467,3 +467,55 @@ def test_stop_sequence_length_bounded():
     eng = _engine()
     with pytest.raises(ValueError, match="64"):
         eng.submit([1], stop=[[0] * 100000])
+
+
+class TestLogprobs:
+    def test_engine_logprobs_align_with_tokens(self):
+        eng = _engine()
+        rid = eng.submit([1, 2, 3], max_new_tokens=5)
+        toks = eng.run()[rid]
+        lps = eng.run_logprobs()[rid]
+        assert len(lps) == len(toks)
+        assert all(lp <= 0.0 for lp in lps)
+
+    def test_stop_truncation_trims_logprobs_too(self):
+        eng = _engine()
+        rid = eng.submit([1, 2, 3, 4])
+        full = eng.run()[rid]
+        eng2 = _engine()
+        rid2 = eng2.submit([1, 2, 3, 4], stop=[full[2:4]])
+        toks = eng2.run()[rid2]
+        assert len(eng2.run_logprobs()[rid2]) == len(toks) == 2
+
+    def test_http_logprobs(self, server):
+        out = _post(server.port, {"prompt": [1, 2, 3], "max_tokens": 4,
+                                  "logprobs": True})
+        ch = out["choices"][0]
+        assert len(ch["logprobs"]["token_logprobs"]) == len(ch["tokens"])
+        assert all(lp <= 0 for lp in ch["logprobs"]["token_logprobs"])
+        plain = _post(server.port, {"prompt": [1, 2, 3], "max_tokens": 4})
+        assert "logprobs" not in plain["choices"][0]
+
+
+def test_logprobs_rejected_where_unsupported(server):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(server.port, {"prompt": [1], "logprobs": True,
+                            "stream": True})
+    assert err.value.code == 400
+
+    from kubeflow_tpu.models.speculative import (
+        SpeculativeContinuousBatcher, truncated_draft,
+    )
+
+    draft, dcfg = truncated_draft(PARAMS, CFG, 1)
+    spec = SpeculativeContinuousBatcher(
+        PARAMS, CFG, draft, dcfg, gen=GenerationConfig(max_new_tokens=4),
+        slots=2, cache_len=128, prompt_bucket=16, k_spec=2,
+    )
+    srv = InferenceServer(spec, port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(srv.port, {"prompt": [1, 2], "logprobs": True})
+        assert err.value.code == 400
+    finally:
+        srv.stop()
