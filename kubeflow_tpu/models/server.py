@@ -17,13 +17,27 @@ stdlib ThreadingHTTPServer in front of ONE engine thread:
   unmodified.
 
 Endpoints (OpenAI-completions-shaped, token-native):
-- ``POST /v1/completions``: ``{"prompt": [ids] | "text", "max_tokens":
-  n?, "stream": false?}`` → ``{"id", "choices": [{"tokens", "text"?}],
-  "usage": {...}}``; with ``"stream": true`` the response is
-  ``text/event-stream`` lines ``data: {"token": id, "text"?: s}`` ending
-  with ``data: [DONE]``. Text prompts require a ``tokenizer``.
-- ``GET /healthz`` — liveness; ``GET /v1/models`` — the served config;
-  ``GET /stats`` — active slots / queue depth / served counts.
+- ``POST /v1/completions`` request fields:
+  - ``prompt``: token-id list, or a string (needs a ``tokenizer``);
+  - ``max_tokens``: per-request cap, clamped to the engine-wide budget;
+  - ``temperature``: finite >= 0 (0 = greedy for this request; the
+    batch freely mixes greedy and sampled rows);
+  - ``n``: 1..64 choices decoded concurrently from one prompt;
+  - ``stop``: string(s) via the tokenizer, or token-id list(s) —
+    generation ends at (and excludes) the first match; streamed
+    responses may still carry the stop tokens (documented divergence);
+  - ``logit_bias``: {token id: bias}, clamped ±100 (force/ban);
+  - ``logprobs``: true → per-choice ``logprobs.token_logprobs``
+    (engines that compute them; rejected on speculative);
+  - ``model``: adapter name for multi-LoRA engines;
+  - ``stream``: true → ``text/event-stream`` lines
+    ``data: {"token": id, "text"?: s}`` ending ``data: [DONE]``
+    (requires n=1, no logprobs; error events precede [DONE] on abort).
+  Response: ``{"id", "choices": [{"index", "tokens", "text"?,
+  "logprobs"?, "finish_reason"}], "usage": {...}}``.
+- ``GET /healthz`` — liveness (503 once the engine thread died);
+  ``GET /v1/models`` — base + adapters; ``GET /stats`` — active slots /
+  queue depth / served counts.
 
 Reference parity: the reference deploys notebook POD plumbing and leaves
 what runs inside to the user (no serving stack at all — SURVEY.md §2.5);
