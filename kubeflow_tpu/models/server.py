@@ -36,8 +36,9 @@ Endpoints (OpenAI-completions-shaped, token-native):
   Response: ``{"id", "choices": [{"index", "tokens", "text"?,
   "logprobs"?, "finish_reason"}], "usage": {...}}``.
 - ``GET /healthz`` — liveness (503 once the engine thread died);
-  ``GET /v1/models`` — base + adapters; ``GET /stats`` — active slots /
-  queue depth / served counts.
+  ``GET /v1/models`` — base + adapters; ``GET /stats`` — active slots,
+  queue depth, served/token counts, lifetime tokens/sec, and p50/p95
+  time-to-first-token + end-to-end latency over the last 256 requests.
 
 Reference parity: the reference deploys notebook POD plumbing and leaves
 what runs inside to the user (no serving stack at all — SURVEY.md §2.5);
@@ -47,11 +48,26 @@ NB_PREFIX/port wiring.
 
 from __future__ import annotations
 
+import collections
 import json
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+def _percentiles(window) -> dict:
+    """{p50, p95} by nearest rank over one sort of the window."""
+    if not window:
+        return {"p50": None, "p95": None}
+    xs = sorted(window)
+    n = len(xs)
+
+    def rank(q):
+        return round(xs[min(n - 1, max(0, -(-q * n // 100) - 1))], 4)
+
+    return {"p50": rank(50), "p95": rank(95)}
+
 
 class _Final:
     """Success sentinel carrying the AUTHORITATIVE final token list (a
@@ -131,6 +147,16 @@ class InferenceServer:
         self._shutdown = False
         self._served = 0
         self._engine_error: Optional[str] = None
+        # Serving observability (host-side, O(1) per event): per-request
+        # submit/first-token stamps plus sliding windows of time-to-first-
+        # token and end-to-end latency, and a token counter for
+        # throughput. All read under the lock by /stats.
+        self._submit_ts: dict[int, float] = {}
+        self._first_ts: dict[int, float] = {}
+        self._ttft = collections.deque(maxlen=256)
+        self._e2e = collections.deque(maxlen=256)
+        self._tokens_out = 0
+        self._started_at = None  # stamped in start(): uptime = serving time
         self._httpd = ThreadingHTTPServer((host, port), self._handler_class())
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
@@ -149,6 +175,11 @@ class InferenceServer:
     # -- engine side (all under self._lock) --------------------------------
 
     def _on_token(self, rid: int, token: int) -> None:
+        self._tokens_out += 1
+        if rid not in self._first_ts and rid in self._submit_ts:
+            now = time.monotonic()
+            self._first_ts[rid] = now
+            self._ttft.append(now - self._submit_ts[rid])
         q = self._queues.get(rid)
         if q is not None:
             q.put(token)
@@ -156,6 +187,10 @@ class InferenceServer:
     def _on_retire(self, rid: int, tokens: list,
                    logprobs: list) -> None:
         self._served += 1
+        t0 = self._submit_ts.pop(rid, None)
+        self._first_ts.pop(rid, None)
+        if t0 is not None:
+            self._e2e.append(time.monotonic() - t0)
         q = self._queues.get(rid)
         if q is not None:
             q.put(_Final(list(tokens), list(logprobs)))
@@ -194,6 +229,7 @@ class InferenceServer:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "InferenceServer":
+        self._started_at = time.monotonic()
         self._engine_thread.start()
         self._http_thread.start()
         return self
@@ -285,12 +321,17 @@ class InferenceServer:
                                          temperature=temperature, stop=stop,
                                          logit_bias=logit_bias)
             self._queues[rid] = q
+            self._submit_ts[rid] = time.monotonic()
             self._work.notify_all()
         return rid, q
 
     def _finish(self, rid: int) -> None:
         with self._lock:
             self._queues.pop(rid, None)
+            # Aborted requests never retire: reap their stamps here so
+            # the timing dicts stay bounded on a long-running server.
+            self._submit_ts.pop(rid, None)
+            self._first_ts.pop(rid, None)
 
     def _decode_prompt(self, prompt) -> list[int]:
         if isinstance(prompt, str):
@@ -354,11 +395,24 @@ class InferenceServer:
                             r is not None for r in server.engine._by_slot
                         )
                         depth = len(server.engine._queue)
+                        ttft = list(server._ttft)
+                        e2e = list(server._e2e)
+                        tokens_out = server._tokens_out
+                    up = (
+                        time.monotonic() - server._started_at
+                        if server._started_at is not None else 0.0
+                    )
                     self._json(200, {
                         "active_slots": active,
                         "queued": depth,
                         "slots": server.engine.slots,
                         "served": server._served,
+                        "tokens_generated": tokens_out,
+                        "tokens_per_sec_lifetime": round(
+                            tokens_out / up, 2
+                        ) if up > 0 else 0.0,
+                        "ttft_s": _percentiles(ttft),
+                        "e2e_latency_s": _percentiles(e2e),
                     })
                 else:
                     self._json(404, {"error": "not found"})

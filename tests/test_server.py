@@ -519,3 +519,13 @@ def test_logprobs_rejected_where_unsupported(server):
         assert err.value.code == 400
     finally:
         srv.stop()
+
+
+def test_stats_latency_metrics(server):
+    for _ in range(3):
+        _post(server.port, {"prompt": [1, 2, 3], "max_tokens": 3})
+    stats = _get(server.port, "/stats")
+    assert stats["tokens_generated"] >= 9
+    assert stats["ttft_s"]["p50"] is not None and stats["ttft_s"]["p50"] > 0
+    assert stats["e2e_latency_s"]["p95"] >= stats["e2e_latency_s"]["p50"]
+    assert stats["tokens_per_sec_lifetime"] > 0
