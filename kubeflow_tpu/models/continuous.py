@@ -41,6 +41,7 @@ import numpy as np
 from kubeflow_tpu.models.llama import (
     LlamaConfig,
     _cache_store_rows,
+    _decode_chunk_batch_impl,
     _embed,
     _gqa_decode_attention,
     _lm_head_logits,
@@ -96,6 +97,32 @@ def _admit_slot(
         row = row.at[:, :lb].set(prompt_mask)
     new_mask = jax.lax.dynamic_update_slice(kv_mask, row, (slot, 0))
     return logits[0], new_cache, new_mask
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
+def _admit_chunk(params, cfg, tok_chunk, temp, pos, kv_mask):
+    """One admission piece: decode a (1, CS) prompt chunk into the
+    1-row temp cache at ``pos`` (chunk-causal, pads fenced by the full
+    kv_mask row); returns (last-position logits (V,), cache)."""
+    logits, temp = _decode_chunk_batch_impl(
+        params, cfg, tok_chunk, temp, pos, kv_mask=kv_mask
+    )
+    return logits[0, -1], temp
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def _install_temp_cache(temp, cache, kv_mask, row, slot):
+    """Copy the finished temp row into ``slot`` of the batch cache +
+    validity mask — the tail of _admit_slot, shared by chunked
+    admission."""
+    new_cache = {
+        name: jax.lax.dynamic_update_slice(
+            cache[name], temp[name], (0, slot) + (0,) * (cache[name].ndim - 2)
+        )
+        for name in cache
+    }
+    new_mask = jax.lax.dynamic_update_slice(kv_mask, row, (slot, 0))
+    return new_cache, new_mask
 
 
 @partial(
@@ -358,9 +385,17 @@ class _BatcherBase:
         self._bias = self._bias.at[slot].set(row)
         return row if req.logit_bias else None
 
+    def _pending(self) -> bool:
+        """Work exists: queued, decoding, or mid-(chunked-)admission."""
+        return (
+            bool(self._queue)
+            or any(r is not None for r in self._by_slot)
+            or getattr(self, "_admitting", None) is not None
+        )
+
     def run(self) -> dict[int, list[int]]:
         """Drive until queue and slots drain; returns {rid: tokens}."""
-        while self._queue or any(r is not None for r in self._by_slot):
+        while self._pending():
             self._admit_free_slots()
             self._step()
         out, self._results = self._results, {}
@@ -441,8 +476,28 @@ class ContinuousBatcher(_BatcherBase):
         plan=None,  # parallel.mesh.MeshPlan → tp/sp-sharded serving
         kv_bits: int = 0,  # 8 → int8 KV storage (halved cache HBM)
         attn_kernel: Optional[bool] = None,  # length-bounded pallas decode
+        admit_chunk: Optional[int] = None,  # interleave admission pieces
     ):
         self.gen = gen or GenerationConfig()
+        # Chunked admission: a long prompt's prefill runs in admit_chunk-
+        # token pieces with a DECODE STEP between pieces (the drive loop
+        # alternates _admit_free_slots/_step), so in-flight neighbors'
+        # inter-token latency stops paying for whole admissions. One
+        # admission in flight at a time; token-parity with one-shot
+        # admission is pinned by tests.
+        if admit_chunk is not None:
+            if admit_chunk <= 0 or prompt_bucket % admit_chunk:
+                raise ValueError(
+                    f"admit_chunk {admit_chunk} must be a positive "
+                    f"divisor-multiple of prompt_bucket {prompt_bucket}"
+                )
+            if plan is not None:
+                raise ValueError(
+                    "admit_chunk does not compose with plan= yet — "
+                    "drop one of the two"
+                )
+        self._admit_chunk = admit_chunk
+        self._admitting: Optional[dict] = None
         # Length-bounded decode attention (ops/paged_attention.py dense
         # kernel): XLA reads ALL cache_len slots per step; the kernel
         # reads each slot's filled prefix only. Auto-on under the TPU
@@ -496,6 +551,7 @@ class ContinuousBatcher(_BatcherBase):
         self.cfg = cfg
         self.cache_len = cache_len
         self.key = jax.random.PRNGKey(0) if key is None else key
+        self.kv_bits = kv_bits  # ONE home; never re-sniffed from keys
         self.cache = init_kv_cache(cfg, slots, cache_len, kv_bits=kv_bits)
         self.kv_mask = jnp.zeros((slots, cache_len), bool)
         # Host-side mutable state; uploaded once per step.
@@ -537,6 +593,9 @@ class ContinuousBatcher(_BatcherBase):
     # -- internals ---------------------------------------------------------
 
     def _admit_free_slots(self) -> None:
+        if getattr(self, "_admit_chunk", None):
+            self._admit_one_chunk()
+            return
         for slot in range(self.slots):
             if self._by_slot[slot] is not None or not self._queue:
                 continue
@@ -547,27 +606,90 @@ class ContinuousBatcher(_BatcherBase):
             prompt_mask = None if mask.all() else jnp.asarray(mask)
             logits = self._prefill_into_slot(slot, req, jnp.asarray(padded),
                                              prompt_mask)
-            self._post_admit(slot, jnp.asarray(padded), prompt_mask)
-            self.key, sub = jax.random.split(self.key)
-            temp = (self.gen.temperature if req.temperature is None
-                    else req.temperature)
-            bias_row = self._install_bias(slot, req)
-            if bias_row is not None:
-                logits = logits + bias_row
-            first = int(
-                sample_logits(
-                    logits[None], sub, temp, self.gen.top_k,
-                    self.gen.top_p,
-                )[0]
+            self._install_admitted(slot, req, jnp.asarray(padded),
+                                   prompt_mask, logits)
+
+    def _admit_one_chunk(self) -> None:
+        """Advance chunked admission by ONE piece (the drive loop runs a
+        decode step between calls — that interleaving is the feature)."""
+        a = self._admitting
+        if a is None:
+            slot = next(
+                (i for i in range(self.slots)
+                 if self._by_slot[i] is None), None,
             )
-            first_lp = float(
-                jax.nn.log_softmax(logits.astype(jnp.float32))[first]
+            if slot is None or not self._queue:
+                return
+            req = self._queue.pop(0)
+            padded, mask = left_pad(
+                [req.prompt], self.gen.pad_id, self.prompt_bucket
             )
-            self.positions[slot] = self.prompt_bucket
-            self.temps[slot] = temp
-            self._by_slot[slot] = req
-            req.budget = self._initial_budget(req)
-            self._note_token(slot, first, first_lp)
+            row = np.ones((1, self.cache_len), bool)
+            row[:, :self.prompt_bucket] = np.asarray(mask)
+            a = self._admitting = {
+                "slot": slot,
+                "req": req,
+                "padded": np.array(padded),
+                "prompt_mask": None if mask.all() else jnp.array(mask),
+                "row": jnp.array(row),
+                "temp": init_kv_cache(self.cfg, 1, self.cache_len,
+                                      kv_bits=self.kv_bits),
+                "pos": 0,
+                "logits": None,
+            }
+        cs = self._admit_chunk
+        # jnp.array (copy), not asarray: the CPU backend aliases numpy
+        # memory zero-copy and basic slicing returns a VIEW — dispatched
+        # chunks must never share mutable host buffers. The explicit
+        # block serializes each admission piece at its boundary: the
+        # interleaving this feature exists for is host-loop-level
+        # (decode step between pieces), and an unsynchronized per-chunk
+        # dispatch chain showed nondeterministic token corruption in
+        # review stress runs.
+        tok = jnp.array(a["padded"][:, a["pos"]:a["pos"] + cs])
+        a["logits"], a["temp"] = _admit_chunk(
+            self.params, self.cfg, tok, a["temp"],
+            jnp.asarray([a["pos"]], jnp.int32), a["row"],
+        )
+        jax.block_until_ready(a["logits"])
+        a["pos"] += cs
+        if a["pos"] >= self.prompt_bucket:
+            self.cache, self.kv_mask = _install_temp_cache(
+                a["temp"], self.cache, self.kv_mask, a["row"],
+                jnp.asarray(a["slot"], jnp.int32),
+            )
+            self._install_admitted(
+                a["slot"], a["req"], jnp.asarray(a["padded"]),
+                a["prompt_mask"], a["logits"],
+            )
+            self._admitting = None
+
+    def _install_admitted(self, slot: int, req: _Request, padded,
+                          prompt_mask, logits) -> None:
+        """Admission tail shared by one-shot and chunked admission: the
+        _post_admit hook, first-token sampling (request temperature +
+        bias + logprob), and slot bookkeeping."""
+        self._post_admit(slot, padded, prompt_mask)
+        self.key, sub = jax.random.split(self.key)
+        temp = (self.gen.temperature if req.temperature is None
+                else req.temperature)
+        bias_row = self._install_bias(slot, req)
+        if bias_row is not None:
+            logits = logits + bias_row
+        first = int(
+            sample_logits(
+                logits[None], sub, temp, self.gen.top_k,
+                self.gen.top_p,
+            )[0]
+        )
+        first_lp = float(
+            jax.nn.log_softmax(logits.astype(jnp.float32))[first]
+        )
+        self.positions[slot] = self.prompt_bucket
+        self.temps[slot] = temp
+        self._by_slot[slot] = req
+        req.budget = self._initial_budget(req)
+        self._note_token(slot, first, first_lp)
 
     def _prefill_into_slot(self, slot: int, req: _Request, padded,
                            prompt_mask) -> jax.Array:

@@ -276,12 +276,15 @@ class MultiLoraBatcher(ContinuousBatcher):
 
     def __init__(self, params, cfg, stacked: dict, lcfg: LoraConfig,
                  adapter_names: Optional[Sequence[str]] = None, **kw):
-        for unsupported in ("plan", "kv_bits", "attn_kernel"):
+        for unsupported in ("plan", "kv_bits", "attn_kernel",
+                            "admit_chunk"):
             if kw.get(unsupported):
                 raise ValueError(
                     f"MultiLoraBatcher does not support {unsupported}= yet"
                 )
         kw["attn_kernel"] = False
+        kw.pop("admit_chunk", None)  # chunked admission bypasses the
+        # adapter-aware prefill; rejected above when truthy
         super().__init__(params, cfg, **kw)
         first = next(iter(stacked.values()))["a"]
         self.n_adapters = first.shape[0] - 1  # last row is the zero/base

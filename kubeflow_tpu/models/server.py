@@ -222,9 +222,7 @@ class InferenceServer:
                     return
 
     def _has_work(self) -> bool:
-        return bool(self.engine._queue) or any(
-            r is not None for r in self.engine._by_slot
-        )
+        return self.engine._pending()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -395,6 +393,10 @@ class InferenceServer:
                             r is not None for r in server.engine._by_slot
                         )
                         depth = len(server.engine._queue)
+                        admitting = int(
+                            getattr(server.engine, "_admitting", None)
+                            is not None
+                        )
                         ttft = list(server._ttft)
                         e2e = list(server._e2e)
                         tokens_out = server._tokens_out
@@ -405,6 +407,10 @@ class InferenceServer:
                     self._json(200, {
                         "active_slots": active,
                         "queued": depth,
+                        # A chunked admission in flight is in neither
+                        # queue nor slot — it must not vanish from the
+                        # outstanding-work picture.
+                        "admitting": admitting,
                         "slots": server.engine.slots,
                         "served": server._served,
                         "tokens_generated": tokens_out,
