@@ -40,12 +40,19 @@ def main() -> None:
     ap.add_argument("--prompt-bucket", type=int, default=64)
     ap.add_argument("--max-new-tokens", type=int, default=128)
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--admit-chunk", type=int, default=None,
+                    help="(continuous engine) admit prompts in N-token "
+                         "pieces with decode steps between them — "
+                         "neighbors' latency stops paying for admissions")
     ap.add_argument("--int8", action="store_true",
                     help="int8 weight-only quantization")
     ap.add_argument("--paged", action="store_true",
                     help="serve through the paged block-pool engine")
     ap.add_argument("--num-blocks", type=int, default=256)
     args = ap.parse_args()
+    if args.paged and args.admit_chunk:
+        raise SystemExit("--admit-chunk is a continuous-engine feature; "
+                         "drop it or drop --paged")
 
     import jax
 
@@ -99,6 +106,7 @@ def main() -> None:
         engine = ContinuousBatcher(
             params, cfg, gen=gen, slots=args.slots,
             cache_len=args.cache_len, prompt_bucket=args.prompt_bucket,
+            admit_chunk=args.admit_chunk,
         )
 
     srv = InferenceServer(engine, host=args.host, port=args.port,

@@ -86,16 +86,10 @@ def _admit_slot(
     temp = init_kv_cache(cfg, 1, cache_len,
                          kv_bits=8 if "k_scale" in cache else 0)
     logits, temp = _prefill_impl(params, cfg, tokens, temp, kv_mask=prompt_mask)
-    new_cache = {
-        name: jax.lax.dynamic_update_slice(
-            cache[name], temp[name], (0, slot) + (0,) * (cache[name].ndim - 2)
-        )
-        for name in cache
-    }
     row = jnp.ones((1, cache_len), bool)
     if prompt_mask is not None:
         row = row.at[:, :lb].set(prompt_mask)
-    new_mask = jax.lax.dynamic_update_slice(kv_mask, row, (slot, 0))
+    new_cache, new_mask = _install_rows(temp, cache, kv_mask, row, slot)
     return logits[0], new_cache, new_mask
 
 
@@ -110,10 +104,10 @@ def _admit_chunk(params, cfg, tok_chunk, temp, pos, kv_mask):
     return logits[0, -1], temp
 
 
-@partial(jax.jit, donate_argnums=(1,))
-def _install_temp_cache(temp, cache, kv_mask, row, slot):
-    """Copy the finished temp row into ``slot`` of the batch cache +
-    validity mask — the tail of _admit_slot, shared by chunked
+def _install_rows(temp, cache, kv_mask, row, slot):
+    """THE slot-install: copy a finished 1-row temp cache into ``slot``
+    of the batch cache + validity mask. One home for both admission
+    paths — inlined by _admit_slot's jit, wrapped below for chunked
     admission."""
     new_cache = {
         name: jax.lax.dynamic_update_slice(
@@ -123,6 +117,11 @@ def _install_temp_cache(temp, cache, kv_mask, row, slot):
     }
     new_mask = jax.lax.dynamic_update_slice(kv_mask, row, (slot, 0))
     return new_cache, new_mask
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def _install_temp_cache(temp, cache, kv_mask, row, slot):
+    return _install_rows(temp, cache, kv_mask, row, slot)
 
 
 @partial(
@@ -626,6 +625,13 @@ class ContinuousBatcher(_BatcherBase):
             )
             row = np.ones((1, self.cache_len), bool)
             row[:, :self.prompt_bucket] = np.asarray(mask)
+            # Left-padding puts all pads FIRST: pieces before the
+            # first real token are pure padding (kv_mask-fenced anyway)
+            # and would multiply a short prompt's TTFT by bucket/chunk
+            # dispatches for zero work — start at the piece containing
+            # the first real token.
+            first_real = int(np.argmax(np.asarray(mask)[0]))
+            cs0 = self._admit_chunk
             a = self._admitting = {
                 "slot": slot,
                 "req": req,
@@ -634,7 +640,7 @@ class ContinuousBatcher(_BatcherBase):
                 "row": jnp.array(row),
                 "temp": init_kv_cache(self.cfg, 1, self.cache_len,
                                       kv_bits=self.kv_bits),
-                "pos": 0,
+                "pos": (first_real // cs0) * cs0,
                 "logits": None,
             }
         cs = self._admit_chunk

@@ -283,8 +283,9 @@ class MultiLoraBatcher(ContinuousBatcher):
                     f"MultiLoraBatcher does not support {unsupported}= yet"
                 )
         kw["attn_kernel"] = False
-        kw.pop("admit_chunk", None)  # chunked admission bypasses the
-        # adapter-aware prefill; rejected above when truthy
+        # admit_chunk: truthy values are rejected above (chunked
+        # admission bypasses the adapter-aware prefill); falsy ones flow
+        # through so the parent's own validation still fires (e.g. 0).
         super().__init__(params, cfg, **kw)
         first = next(iter(stacked.values()))["a"]
         self.n_adapters = first.shape[0] - 1  # last row is the zero/base
