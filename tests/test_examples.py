@@ -95,10 +95,13 @@ def test_serve_batched_runs(extra):
     assert "[2]" in res.stdout  # three prompts served
 
 
-def test_serve_http_example(tmp_path):
-    """serve_http.py answers real HTTP completions (paged engine)."""
+@pytest.mark.parametrize(
+    "flags", [("--paged",), ("--admit-chunk", "16")],
+    ids=["paged", "admit-chunk"],
+)
+def test_serve_http_example(flags):
+    """serve_http.py answers real HTTP completions (per engine mode)."""
     import json
-    import subprocess
     import time
     import urllib.request
 
@@ -106,7 +109,7 @@ def test_serve_http_example(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.Popen(
         [sys.executable, str(EXAMPLES / "serve_http.py"), "--config",
-         "tiny", "--port", "0", "--paged", "--max-new-tokens", "4"],
+         "tiny", "--port", "0", "--max-new-tokens", "4", *flags],
         env=env, cwd=str(EXAMPLES.parent),
         stdout=subprocess.PIPE, text=True,
     )
