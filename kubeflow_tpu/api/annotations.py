@@ -42,6 +42,22 @@ FEAST_INTEGRATION_LABEL = "opendatahub.io/feast-integration"
 # -- TPU-native extensions ---------------------------------------------------
 # Set by the culler when a slice host is preempted/evicted; cleared on recovery.
 TPU_SLICE_INTERRUPTED = "notebooks.kubeflow.org/tpu-slice-interrupted"
+# Recovery escalation state machine (controller/preemption.py). All four are
+# controller-owned lifecycle state: unix-seconds timestamps / counters, never
+# copied to pod templates (they would roll the StatefulSet).
+# When the current interruption was first observed.
+TPU_RECOVERY_STARTED = "notebooks.kubeflow.org/tpu-recovery-started"
+# How many escalations (warm-pool claim or STS recreate) this interruption
+# has consumed; past RecoveryConfig.max_escalations the state goes terminal.
+TPU_RECOVERY_ESCALATIONS = "notebooks.kubeflow.org/tpu-recovery-escalations"
+# When the most recent escalation fired (re-arms the recovery deadline).
+TPU_RECOVERY_LAST_ESCALATION = "notebooks.kubeflow.org/tpu-recovery-last-escalation"
+# Stamped on SliceRecovered with the interruption's wall-clock length, so
+# runtime/checkpoint.py restore hints can key off how stale in-notebook
+# state is. Survives until the next interruption completes.
+TPU_LAST_INTERRUPTION_DURATION = (
+    "notebooks.kubeflow.org/tpu-last-interruption-duration"
+)
 # Event re-emission cursor: resourceVersion of the newest namespace Event
 # already surfaced onto this Notebook (one read per reconcile, zero writes
 # to Event objects, restart-safe because it lives on the Notebook).

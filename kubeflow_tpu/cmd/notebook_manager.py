@@ -23,7 +23,7 @@ from typing import Optional
 
 from kubeflow_tpu.controller.culling import CullerConfig, CullingReconciler
 from kubeflow_tpu.controller.notebook import ControllerConfig, NotebookReconciler
-from kubeflow_tpu.controller.preemption import SliceHealthReconciler
+from kubeflow_tpu.controller.preemption import RecoveryConfig, SliceHealthReconciler
 from kubeflow_tpu.controller.prepull import PrePullConfig, PrePullReconciler
 from kubeflow_tpu.controller.slicepool import SlicePoolReconciler
 from kubeflow_tpu.k8s.client import Client
@@ -113,7 +113,12 @@ def build(
     )
     nb.register(manager)
 
-    preemption = SliceHealthReconciler(cluster, metrics=metrics)
+    preemption = SliceHealthReconciler(
+        cluster,
+        metrics=metrics,
+        clock=manager.clock,
+        config=RecoveryConfig.from_env(env),
+    )
     preemption.register(manager)
 
     # Warm slice pools: inert without SlicePool CRs, so always registered

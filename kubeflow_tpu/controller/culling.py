@@ -22,6 +22,7 @@ for large slices); tests inject a fake.
 
 from __future__ import annotations
 
+import concurrent.futures
 import json
 import logging
 import time
@@ -95,30 +96,72 @@ class ActivityProber(Protocol):
 class JupyterHTTPProber:
     """Probes Jupyter's /api/kernels + /api/terminals on worker 0 and the
     activity endpoint on every other host (reference getNotebookApiKernels
-    :277-322; DEV mode proxies via localhost as :253-257 does)."""
+    :277-322; DEV mode proxies via localhost as :253-257 does).
 
-    def __init__(self, timeout_s: float = 5.0, dev_proxy: Optional[str] = None):
+    Hosts are probed CONCURRENTLY under one per-slice deadline: serially, a
+    16-host slice behind a partition pinned the culler reconcile for
+    hosts × timeout (~80s); now the reconcile is bounded by
+    ``slice_deadline_s`` no matter how many hosts stall. A host whose probe
+    misses the deadline folds as unreachable — which the culler already
+    treats as "never judge" — and ``fold_host_activity`` stays the single
+    merge point shared with the native prober."""
+
+    def __init__(
+        self,
+        timeout_s: float = 5.0,
+        dev_proxy: Optional[str] = None,
+        slice_deadline_s: float = 15.0,
+        max_workers: int = 16,
+    ):
         self.timeout_s = timeout_s
         self.dev_proxy = dev_proxy
+        self.slice_deadline_s = slice_deadline_s
+        self.max_workers = max_workers
 
     def probe(self, nb: Notebook, hosts: list[str]) -> list[HostActivity]:
-        out = []
-        for i, host in enumerate(hosts):
-            base = (
-                f"{self.dev_proxy}/notebook/{nb.namespace}/{nb.name}"
-                if self.dev_proxy
-                else f"http://{host}:8888/notebook/{nb.namespace}/{nb.name}"
-            )
-            kernels = self._get_json(f"{base}/api/kernels")
-            # Dead host: don't burn a second timeout on terminals the fold
-            # would ignore anyway.
-            terminals = (
-                self._get_json(f"{base}/api/terminals")
-                if kernels is not None
-                else None
-            )
-            out.append(fold_host_activity(host, kernels, terminals))
-        return out
+        if not hosts:
+            return []
+        deadline = time.monotonic() + self.slice_deadline_s
+        pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, min(len(hosts), self.max_workers)),
+            thread_name_prefix="jupyter-probe",
+        )
+        try:
+            futures = [
+                pool.submit(self._probe_host, nb, host) for host in hosts
+            ]
+            out = []
+            for host, fut in zip(hosts, futures):
+                remaining = deadline - time.monotonic()
+                try:
+                    kernels, terminals = fut.result(
+                        timeout=max(0.0, remaining)
+                    )
+                except concurrent.futures.TimeoutError:
+                    fut.cancel()
+                    kernels, terminals = None, None
+                out.append(fold_host_activity(host, kernels, terminals))
+            return out
+        finally:
+            # Never block the reconcile on stragglers: abandoned probes
+            # finish (or time out) on daemon-ish pool threads.
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def _probe_host(self, nb: Notebook, host: str):
+        base = (
+            f"{self.dev_proxy}/notebook/{nb.namespace}/{nb.name}"
+            if self.dev_proxy
+            else f"http://{host}:8888/notebook/{nb.namespace}/{nb.name}"
+        )
+        kernels = self._get_json(f"{base}/api/kernels")
+        # Dead host: don't burn a second timeout on terminals the fold
+        # would ignore anyway.
+        terminals = (
+            self._get_json(f"{base}/api/terminals")
+            if kernels is not None
+            else None
+        )
+        return kernels, terminals
 
     def _get_json(self, url: str):
         try:

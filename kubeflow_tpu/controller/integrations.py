@@ -26,7 +26,7 @@ from kubeflow_tpu.api.names import (
 )
 from kubeflow_tpu.api.notebook import Notebook
 from kubeflow_tpu.controller import reconcilehelper as helper
-from kubeflow_tpu.k8s.client import Client
+from kubeflow_tpu.k8s.client import Client, retry_on_conflict
 from kubeflow_tpu.k8s.errors import NotFoundError
 
 log = logging.getLogger(__name__)
@@ -226,15 +226,18 @@ def sync_elyra_runtime_config(
     }
     # Owned by the DSPA CR, not the notebook (reference :354-363): the
     # secret outlives notebooks and dies with the pipeline application.
-    try:
-        existing = client.get("Secret", ELYRA_SECRET_NAME, nb.namespace)
-        if helper.copy_generic_fields(desired, existing):
-            client.update(existing)
-    except NotFoundError:
-        from kubeflow_tpu.k8s import objects as obj_util
+    def write():
+        try:
+            existing = client.get("Secret", ELYRA_SECRET_NAME, nb.namespace)
+            if helper.copy_generic_fields(desired, existing):
+                client.update(existing)
+        except NotFoundError:
+            from kubeflow_tpu.k8s import objects as obj_util
 
-        obj_util.set_controller_reference(dspa, desired)
-        client.create(desired)
+            obj_util.set_controller_reference(dspa, desired)
+            client.create(desired)
+
+    retry_on_conflict(write)
 
 
 # ---------------------------------------------------------------------------

@@ -59,6 +59,13 @@ _TEMPLATE_ANNOTATION_SKIP = {
     ann.LAST_ACTIVITY_CHECK,
     ann.UPDATE_PENDING,
     ann.TPU_SLICE_INTERRUPTED,
+    # Recovery state machine churns these while the slice is interrupted;
+    # copying them into the template would roll the StatefulSet (and restart
+    # the very pods recovery is waiting on).
+    ann.TPU_RECOVERY_STARTED,
+    ann.TPU_RECOVERY_ESCALATIONS,
+    ann.TPU_RECOVERY_LAST_ESCALATION,
+    ann.TPU_LAST_INTERRUPTION_DURATION,
 }
 
 # Dedup-cursor token regimes (compared as STRINGS; '!' < '.' < '0'..'9'):

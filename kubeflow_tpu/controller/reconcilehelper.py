@@ -15,7 +15,7 @@ from __future__ import annotations
 import copy
 from typing import Callable
 
-from kubeflow_tpu.k8s.client import Client
+from kubeflow_tpu.k8s.client import Client, retry_on_conflict
 from kubeflow_tpu.k8s.errors import NotFoundError
 from kubeflow_tpu.k8s import objects as obj_util
 
@@ -98,10 +98,16 @@ def reconcile_child(
     kind = desired.get("kind", "")
     name = obj_util.name_of(desired)
     namespace = obj_util.namespace_of(desired)
-    try:
-        existing = client.get(kind, name, namespace)
-    except NotFoundError:
-        return client.create(desired)
-    if copy_fields(desired, existing):
-        return client.update(existing)
-    return existing
+    def write():
+        try:
+            existing = client.get(kind, name, namespace)
+        except NotFoundError:
+            return client.create(desired)
+        if copy_fields(desired, existing):
+            return client.update(existing)
+        return existing
+
+    # The conflicting writer is usually a status update racing the spec
+    # copy; re-running the whole read-modify-write re-diffs against the
+    # fresh object, so the retry cannot clobber the other writer.
+    return retry_on_conflict(write)

@@ -19,6 +19,7 @@ Mechanics per ``kubeflow_tpu.api.slicepool``:
 
 from __future__ import annotations
 
+import copy
 import logging
 import time
 from typing import Callable, Optional
@@ -276,7 +277,7 @@ class SlicePoolReconciler(Reconciler):
                     "message": str(err),
                 }
             ]
-            self.client.update_status(obj)
+            self._write_status(obj)
             return Result()
 
         warm_target, requeue, scale_status = self._warm_target(pool)
@@ -337,7 +338,7 @@ class SlicePoolReconciler(Reconciler):
                 **scale_status,
             }
         )
-        self.client.update_status(obj)
+        self._write_status(obj)
         if self.metrics is not None:
             self.metrics.pool_warm_ready.labels(pool.name).set(ready)
         if changed:
@@ -346,6 +347,28 @@ class SlicePoolReconciler(Reconciler):
                 pool.namespace, pool.name, len(kept), ready,
             )
         return Result(requeue_after=requeue)
+
+    def _write_status(self, pool_obj: dict) -> None:
+        """Push this reconcile's computed status, retrying conflicts.
+
+        The usual concurrent writer is claim_warm_slice stamping demand
+        annotations — a bare update_status here would lose that race with
+        a 409 and abort the whole reconcile mid-transition. The computed
+        status is authoritative for this reconcile, so a retry re-reads
+        only the resourceVersion, not the decision."""
+        desired = copy.deepcopy(pool_obj.get("status", {}))
+        name = obj_util.name_of(pool_obj)
+        namespace = obj_util.namespace_of(pool_obj)
+
+        def write():
+            try:
+                fresh = self.client.get("SlicePool", name, namespace)
+            except NotFoundError:
+                return
+            fresh["status"] = desired
+            self.client.update_status(fresh)
+
+        retry_on_conflict(write)
 
     def _warm_target(self, pool: sp.SlicePool) -> tuple[int, float, dict]:
         """(warm target, requeue seconds, status fields).

@@ -31,9 +31,20 @@ INJECTION_TYPES = (
     "client-fault",
     "webhook-error",
     "placeholder-kill",
+    # Recovery escalation coverage (controller/preemption.py): repeated
+    # kills mid-recovery, capacity that never comes back, and an apiserver
+    # that flaps while the escalation ladder runs. Each must converge to
+    # SliceRecovered or the terminal SliceRecoveryFailed condition — a
+    # silent stall is the one outcome the state machine exists to forbid.
+    "preemption-storm",
+    "capacity-withheld",
+    "apiserver-flap",
 )
 STEADY_STATE_CHECKS = (
     "sliceReady", "notCulled", "notebookCreatable", "warmPoolReady",
+    # Recovery reached SliceRecovered or the terminal condition — never a
+    # silent stall with an interrupted slice and no requeue.
+    "recoveryConverged",
 )
 # Injection ↔ target coherence: a doc must declare the kind its handler
 # actually exercises, or a "pass" certifies a hypothesis that never ran.
@@ -44,6 +55,9 @@ TARGET_KIND_FOR_INJECTION = {
     "client-fault": "Notebook",
     "webhook-error": "Notebook",
     "placeholder-kill": "SlicePool",
+    "preemption-storm": "Notebook",
+    "capacity-withheld": "Notebook",
+    "apiserver-flap": "Notebook",
 }
 
 
@@ -153,6 +167,9 @@ class ExperimentRunner:
             "client-fault": self._run_client_fault,
             "webhook-error": self._run_webhook_error,
             "placeholder-kill": self._run_placeholder_kill,
+            "preemption-storm": self._run_preemption_storm,
+            "capacity-withheld": self._run_capacity_withheld,
+            "apiserver-flap": self._run_apiserver_flap,
         }
 
     def run(self, doc: dict) -> ExperimentResult:
@@ -249,6 +266,201 @@ class ExperimentRunner:
                 f"regenerated={regenerated} ready={ready}"
             ),
             observations={"placeholders_after": len(after)},
+        )
+
+    # -- recovery-escalation experiments -----------------------------------
+
+    @staticmethod
+    def _recovery_state(env, name: str = "nb") -> dict:
+        obj = env.cluster.get("Notebook", name, "ns")
+        anns = obj["metadata"].get("annotations", {})
+        conds = {
+            c.get("type"): c for c in obj.get("status", {}).get("conditions", [])
+        }
+        tpu = obj.get("status", {}).get("tpu", {})
+        return {
+            "interrupted": ann.TPU_SLICE_INTERRUPTED in anns,
+            "terminal": conds.get("SliceRecoveryFailed", {}).get("status") == "True",
+            "healthy": tpu.get("sliceHealth") == "Healthy",
+            "duration_stamped": ann.TPU_LAST_INTERRUPTION_DURATION in anns,
+        }
+
+    @staticmethod
+    def _metric_value(env, metric: str) -> float:
+        for line in env.metrics.expose().decode().splitlines():
+            if line.startswith(metric + " "):
+                return float(line.split()[-1])
+        return 0.0
+
+    def _run_preemption_storm(self, doc: dict) -> ExperimentResult:
+        """Repeated host kills DURING recovery (a maintenance wave rolling
+        through the slice's nodes). Every interruption must still converge
+        to SliceRecovered — with the recovery-latency histogram recording
+        each — never to a stuck half-recovered state."""
+        params = doc["spec"]["injection"].get("params", {})
+        kills = int(params.get("kills", 4))
+        interval = float(params.get("intervalSeconds", 45))
+        env = self.env_factory()
+        self._ready_slice(env)
+        if not self._slice_ready(env):
+            return ExperimentResult(
+                doc["metadata"]["name"], passed=False,
+                detail="steady state never reached",
+            )
+        for i in range(kills):
+            env.kubelet.preempt_pod(f"nb-{i % 4}", "ns")
+            env.manager.tick(interval)
+        # Storm over: let every pending requeue fire.
+        for _ in range(10):
+            env.manager.tick(60.0)
+        state = self._recovery_state(env)
+        recovered = self._slice_ready(env) and not state["interrupted"]
+        recoveries = self._metric_value(env, "tpu_slice_recovery_seconds_count")
+        errors = [f"{n}: {e}" for n, _, e in env.manager.reconcile_errors]
+        return ExperimentResult(
+            doc["metadata"]["name"],
+            passed=recovered and recoveries >= 1 and not errors,
+            detail=(
+                "" if recovered and recoveries >= 1 and not errors else
+                f"recovered={recovered} recoveries={recoveries} "
+                f"errors={errors[:3]}"
+            ),
+            observations={"recoveries_recorded": recoveries},
+        )
+
+    def _run_capacity_withheld(self, doc: dict) -> ExperimentResult:
+        """Replacement capacity never comes back (the preempted host's node
+        is gone). With a warm pool: the deadline escalation claims the
+        placeholder, freeing its nodes, and the slice recovers. Without:
+        escalations exhaust and the state goes terminal SliceRecoveryFailed.
+        Either way — convergence with an empty error list and no requeue
+        churn, never a silent stall."""
+        from kubeflow_tpu.api.notebook import TPUSpec
+        from kubeflow_tpu.api.slicepool import new_slicepool
+
+        params = doc["spec"]["injection"].get("params", {})
+        warm_pool = bool(params.get("warmPool", False))
+        hosts = 8 if warm_pool else 4
+        env = self.env_factory(
+            node_pools=(("tpu-v5-lite-podslice", "4x4", hosts, 4),)
+        )
+        if warm_pool:
+            env.cluster.create(
+                new_slicepool("pool", "ns", TPUSpec("v5e", "4x4"), warm_replicas=1)
+            )
+            env.manager.run_until_idle()
+        self._ready_slice(env)
+        if not self._slice_ready(env):
+            return ExperimentResult(
+                doc["metadata"]["name"], passed=False,
+                detail="steady state never reached",
+            )
+
+        # Withhold capacity: the host is preempted, THEN its node is
+        # reclaimed (spot order: the pod gets its DisruptionTarget first;
+        # injecting node-death first would also let the fake kubelet GC the
+        # Failed pod before slice-health observes the interruption).
+        pod = env.cluster.get("Pod", "nb-2", "ns")
+        env.kubelet.preempt_pod("nb-2", "ns")
+        env.cluster.delete("Node", pod["spec"]["nodeName"])
+        env.manager.run_until_idle()
+        # Drive wall-clock through the whole escalation ladder (default
+        # config: 300s deadline per phase, 2 escalations, then terminal).
+        for _ in range(40):
+            env.manager.tick(30.0)
+
+        state = self._recovery_state(env)
+        recovered = self._slice_ready(env) and not state["interrupted"]
+        converged = recovered if warm_pool else state["terminal"]
+        errors = [f"{n}: {e}" for n, _, e in env.manager.reconcile_errors]
+        # Churn guard: a converged slice must be quiet — recovered means no
+        # recovery requeues at all; terminal requeues only every
+        # terminal_requeue_s, so a 2-minute window fires nothing.
+        quiet_calls = env.manager.tick(120.0)
+        ok = converged and not errors and quiet_calls <= 4
+        return ExperimentResult(
+            doc["metadata"]["name"],
+            passed=ok,
+            detail="" if ok else (
+                f"recovered={recovered} terminal={state['terminal']} "
+                f"quiet_calls={quiet_calls} errors={errors[:3]}"
+            ),
+            observations={
+                "terminal": state["terminal"],
+                "recovered": recovered,
+                "escalations": self._metric_value(
+                    env, "tpu_slice_recovery_escalations_total"
+                ),
+                "quiet_calls": quiet_calls,
+            },
+        )
+
+    def _run_apiserver_flap(self, doc: dict) -> ExperimentResult:
+        """Apiserver flaps (intermittent write errors) WHILE the escalation
+        ladder runs against withheld capacity. Writes fail and retry, but
+        the ladder must still converge to the terminal condition once the
+        flap ends — the state machine lives in annotations, so a lost write
+        is re-derived, never double-counted into a wedged state."""
+        from kubeflow_tpu.controller.notebook import NotebookReconciler
+        from kubeflow_tpu.controller.preemption import SliceHealthReconciler
+        from kubeflow_tpu.k8s.chaos import ChaosClient, FaultConfig
+        from kubeflow_tpu.k8s.manager import Manager
+
+        params = doc["spec"]["injection"].get("params", {})
+        error_rate = float(params.get("errorRate", 0.3))
+        env = self.env_factory()
+        # Chaos-wrapped controllers on a dedicated manager (the
+        # client-fault pattern): the kubelet stays on the real cluster —
+        # the flap hits the controllers, not the node plane.
+        chaos = ChaosClient(env.cluster)
+        chaos_mgr = Manager(env.cluster, clock=env.clock)
+        NotebookReconciler(chaos, clock=env.clock).register(chaos_mgr)
+        slice_health = SliceHealthReconciler(chaos, clock=env.clock)
+        slice_health.register(chaos_mgr)
+        env.kubelet.register(chaos_mgr)
+
+        env.cluster.create(self.notebook_factory(name="nb"))
+        chaos_mgr.run_until_idle()
+        if not self._slice_ready(env):
+            return ExperimentResult(
+                doc["metadata"]["name"], passed=False,
+                detail="steady state never reached",
+            )
+        pod = env.cluster.get("Pod", "nb-2", "ns")
+        env.kubelet.preempt_pod("nb-2", "ns")
+        env.cluster.delete("Node", pod["spec"]["nodeName"])
+        chaos_mgr.run_until_idle()
+
+        fault = chaos.add_fault(
+            FaultConfig(
+                operations=("update", "update_status", "delete"),
+                kinds=("Notebook", "StatefulSet"),
+                error_rate=error_rate,
+            )
+        )
+        for _ in range(20):
+            chaos_mgr.tick(60.0)
+        injected = fault.injected_count
+        fault.deactivate()
+        # Injected errors were the POINT; convergence is judged clean-slate.
+        chaos_mgr.reconcile_errors.clear()
+        for _ in range(30):
+            chaos_mgr.tick(60.0)
+
+        state = self._recovery_state(env)
+        recovered = self._slice_ready(env) and not state["interrupted"]
+        converged = state["terminal"] or recovered
+        errors = [f"{n}: {e}" for n, _, e in chaos_mgr.reconcile_errors]
+        quiet_calls = chaos_mgr.tick(120.0)
+        ok = converged and not errors and quiet_calls <= 4
+        return ExperimentResult(
+            doc["metadata"]["name"],
+            passed=ok,
+            detail="" if ok else (
+                f"terminal={state['terminal']} recovered={recovered} "
+                f"quiet_calls={quiet_calls} errors={errors[:3]}"
+            ),
+            observations={"injected": injected, "terminal": state["terminal"]},
         )
 
     def _run_network_partition(self, doc: dict) -> ExperimentResult:

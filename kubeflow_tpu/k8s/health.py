@@ -12,12 +12,57 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable
+from typing import Callable, Optional
 
 
 def ping() -> None:
     """healthz.Ping analog: always healthy."""
+
+
+class ServeWatchdog:
+    """Readyz check that the serve loop is actually draining.
+
+    ``manager.reconcile_errors`` catches reconcilers that run and fail;
+    what it can NOT catch is a drain loop that stopped running at all — a
+    reconcile blocked forever in a hung client call, a deadlocked watch
+    stream, a loop crashed outside the per-cycle try. The serve loop calls
+    ``beat(manager.cursor)`` after every successful cycle; readyz turns
+    unready once no beat has landed within ``window_s``, so Kubernetes
+    restarts a wedged controller instead of routing to a zombie.
+    """
+
+    def __init__(
+        self,
+        window_s: float = 60.0,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.window_s = window_s
+        # Monotonic by default: a wall-clock step (NTP, suspend) must not
+        # fake a stall or mask a real one.
+        self._clock = clock or time.monotonic
+        self._last_beat: Optional[float] = None
+        self.last_cursor: Optional[int] = None
+
+    def beat(self, cursor: int) -> None:
+        """Record one completed drain cycle (cursor = manager.cursor)."""
+        self.last_cursor = cursor
+        self._last_beat = self._clock()
+
+    def check(self) -> None:
+        if self._last_beat is None:
+            raise RuntimeError("serve loop has not completed a cycle yet")
+        age = self._clock() - self._last_beat
+        if age > self.window_s:
+            raise RuntimeError(
+                f"serve loop stalled: no heartbeat for {age:.0f}s "
+                f"(window {self.window_s:.0f}s, last cursor "
+                f"{self.last_cursor})"
+            )
+
+    def register(self, checks: "HealthChecks", name: str = "serve-loop") -> None:
+        checks.add_readyz_check(name, self.check)
 
 
 class HealthChecks:

@@ -43,6 +43,7 @@ def serve(
     stop: Optional[threading.Event] = None,
     max_idle_wait: float = 1.0,
     max_iterations: int = 0,
+    watchdog=None,
 ) -> None:
     """Drive ``bundle`` (a ManagerBundle or PlatformBundle) until ``stop``.
 
@@ -51,11 +52,25 @@ def serve(
     registered reconcilers watch. Leadership gating lives in the bundle's
     ``tick``/``run_until_idle`` (non-leaders keep polling for the lease,
     as controller-runtime's leader election does).
+
+    When the bundle exposes a ``health`` HealthChecks registry, a
+    ServeWatchdog is registered on readyz (pass ``watchdog`` to override
+    the default window): every successful drain cycle beats it, so a loop
+    wedged in a hung call — or crash-looping every cycle — turns the
+    replica unready instead of serving as a zombie.
     """
     stop = stop or threading.Event()
     manager = bundle.manager
     elector = getattr(bundle, "elector", None)
     watches_started = False
+
+    health = getattr(bundle, "health", None)
+    if watchdog is None and health is not None:
+        from kubeflow_tpu.k8s.health import ServeWatchdog
+
+        watchdog = ServeWatchdog()
+    if watchdog is not None and health is not None:
+        watchdog.register(health)
 
     iterations = 0
     while not stop.is_set():
@@ -75,6 +90,11 @@ def serve(
                 bundle.tick(0)
             else:
                 bundle.run_until_idle()
+            if watchdog is not None:
+                # Only a COMPLETED cycle beats: a loop that raises every
+                # pass (or blocks inside tick) goes unready once the
+                # watchdog window lapses.
+                watchdog.beat(manager.cursor)
         except Exception:
             # A reconcile bug must not kill the process; level-triggered
             # retry will re-drive it (errors are also recorded on

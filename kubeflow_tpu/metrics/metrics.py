@@ -64,6 +64,22 @@ class Metrics:
             "Slice host preemptions/evictions observed",
             registry=self.registry,
         )
+        self.slice_recovery_seconds = Histogram(
+            "tpu_slice_recovery_seconds",
+            "Seconds from slice interruption to all hosts Ready again",
+            buckets=(10, 30, 60, 120, 300, 600, 1200, 1800, 3600),
+            registry=self.registry,
+        )
+        self.slice_recovery_escalations_total = Counter(
+            "tpu_slice_recovery_escalations_total",
+            "Recovery escalations (warm-pool claim or StatefulSet recreate)",
+            registry=self.registry,
+        )
+        self.slice_recovery_failed_total = Counter(
+            "tpu_slice_recovery_failed_total",
+            "Interruptions that exhausted escalations and went terminal",
+            registry=self.registry,
+        )
         self.chips_reclaimed_total = Counter(
             "tpu_chips_reclaimed_total",
             "TPU chips released by culling or stop",

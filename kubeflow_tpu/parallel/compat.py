@@ -1,0 +1,29 @@
+"""JAX API compatibility for the parallel kernels.
+
+The kernels target the current ``jax.shard_map`` API (``check_vma=``
+replication checking). Older JAX (≤ 0.4.x, still common on TPU VM images)
+only ships ``jax.experimental.shard_map.shard_map`` with the kwarg spelled
+``check_rep=``. This module presents the NEW surface on both: import
+``shard_map`` from here instead of ``jax`` and pass ``check_vma=``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:  # JAX ≥ 0.6: public API, check_vma kwarg.
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # JAX 0.4.x: experimental home, check_rep kwarg.
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+@functools.wraps(_shard_map)
+def shard_map(f, **kwargs):
+    check = kwargs.pop("check_vma", kwargs.pop("check_rep", None))
+    if check is not None:
+        kwargs[_CHECK_KW] = check
+    return _shard_map(f, **kwargs)

@@ -20,7 +20,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import optax
-from jax import shard_map
+from kubeflow_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.models.llama import (

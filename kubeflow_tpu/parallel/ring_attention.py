@@ -40,7 +40,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from kubeflow_tpu.parallel.compat import shard_map
 
 NEG_INF = -1e30
 # Key-width of the inner flash-style sub-block (see ring_attention): caps

@@ -29,7 +29,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from kubeflow_tpu.parallel.compat import shard_map
 
 from kubeflow_tpu.ops.attention import flash_attention
 

@@ -23,4 +23,9 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # JAX >= 0.5 knob; 0.4.x raises AttributeError (the XLA_FLAGS fallback
+    # above already provides the 8-device CPU mesh there).
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass

@@ -16,7 +16,7 @@ from kubeflow_tpu.api.notebook import TPUSpec, new_notebook
 from kubeflow_tpu.controller.culling import CullerConfig, CullingReconciler, HostActivity
 from kubeflow_tpu.controller.notebook import ControllerConfig, NotebookReconciler
 from kubeflow_tpu.controller.platform import PlatformConfig, PlatformReconciler
-from kubeflow_tpu.controller.preemption import SliceHealthReconciler
+from kubeflow_tpu.controller.preemption import RecoveryConfig, SliceHealthReconciler
 from kubeflow_tpu.controller.slicepool import SlicePoolReconciler
 from kubeflow_tpu.k8s.manager import FakeClock, Manager
 from kubeflow_tpu.metrics import Metrics
@@ -84,6 +84,7 @@ def make_env(
     platform_config: Optional[PlatformConfig] = None,
     cluster: Optional[k8s.FakeCluster] = None,
     controller_config: Optional[ControllerConfig] = None,
+    recovery_config: Optional[RecoveryConfig] = None,
 ) -> Env:
     """Build a controller environment. Passing an existing ``cluster``
     simulates a controller-process restart: fresh manager/reconcilers/
@@ -136,7 +137,10 @@ def make_env(
 
     health = None
     if slice_health:
-        health = SliceHealthReconciler(cluster, metrics=metrics)
+        health = SliceHealthReconciler(
+            cluster, metrics=metrics, clock=clock,
+            config=recovery_config or RecoveryConfig(),
+        )
         health.register(manager)
 
     if platform:

@@ -33,6 +33,13 @@ def test_catalog_has_reference_parity_experiments():
         "webhook-disrupt",
         # Beyond reference: the warm-capacity subsystem gets chaos coverage.
         "slicepool-placeholder-kill",
+        # Recovery escalation state machine (controller/preemption.py):
+        # storm, withheld capacity (both escalation outcomes), and an
+        # apiserver flap mid-ladder.
+        "slice-preemption-storm",
+        "capacity-withheld-warm-pool",
+        "capacity-withheld-no-pool",
+        "apiserver-flap-mid-escalation",
     }
 
 
@@ -63,6 +70,11 @@ def test_knowledge_model_valid_and_matches_code():
     assert ann.STOP in core["annotationsOwned"]
     assert ann.LAST_ACTIVITY in core["annotationsOwned"]
     assert ann.TPU_SLICE_INTERRUPTED in core["annotationsOwned"]
+    # The recovery escalation state machine's annotations are inventoried.
+    assert ann.TPU_RECOVERY_STARTED in core["annotationsOwned"]
+    assert ann.TPU_RECOVERY_ESCALATIONS in core["annotationsOwned"]
+    assert ann.TPU_RECOVERY_LAST_ESCALATION in core["annotationsOwned"]
+    assert ann.TPU_LAST_INTERRUPTION_DURATION in core["annotationsOwned"]
     # The warm-capacity subsystem is inventoried: SlicePool watched, and a
     # managedResources entry names the placeholder StatefulSets with the
     # naming scheme the code actually uses.

@@ -16,9 +16,10 @@ from kubeflow_tpu import k8s
 from kubeflow_tpu.cmd import notebook_manager, platform_manager
 from kubeflow_tpu.controller import tls
 from kubeflow_tpu.k8s.cache import STRIPPED_MARK, TransformingClient, strip_payload
-from kubeflow_tpu.k8s.health import HealthChecks, HealthServer, ping
+from kubeflow_tpu.k8s.health import HealthChecks, HealthServer, ServeWatchdog, ping
 from kubeflow_tpu.k8s.leader import LeaderElector
 from kubeflow_tpu.k8s.manager import FakeClock
+from kubeflow_tpu.k8s.serve import serve
 
 from tests.harness import FakeProber, tpu_notebook
 
@@ -139,6 +140,52 @@ def test_health_server_serves_http():
                 assert resp.status == 200
     finally:
         server.stop()
+
+
+def test_serve_watchdog_lifecycle():
+    clock = FakeClock()
+    dog = ServeWatchdog(window_s=60.0, clock=clock)
+    checks = HealthChecks()
+    dog.register(checks)
+
+    # Unready until the serve loop completes its first drain cycle.
+    code, body = checks.handle("/readyz")
+    assert code == 500
+    assert "not completed a cycle" in json.loads(body)["serve-loop"]
+
+    dog.beat(cursor=7)
+    assert checks.handle("/readyz")[0] == 200
+
+    # Still within the window: a quiet-but-alive loop stays ready.
+    clock.advance(59)
+    assert checks.handle("/readyz")[0] == 200
+
+    # Window lapses with no beat → wedged loop turns the replica unready,
+    # and the error names the last cursor for the postmortem.
+    clock.advance(2)
+    code, body = checks.handle("/readyz")
+    assert code == 500
+    assert "stalled" in json.loads(body)["serve-loop"]
+    assert "cursor 7" in json.loads(body)["serve-loop"]
+
+    # A late beat recovers readiness (level-triggered, like everything).
+    dog.beat(cursor=8)
+    assert checks.handle("/readyz")[0] == 200
+
+
+def test_serve_loop_beats_watchdog():
+    """serve() auto-registers a watchdog on the bundle's HealthChecks and
+    beats it each completed cycle — readyz flips from 500 to 200 once the
+    loop has actually drained."""
+    cluster, clock = _cluster_with_nodes()
+    bundle = notebook_manager.build(cluster, env={}, clock=clock)
+    code, _ = bundle.health.handle("/readyz")  # build() readyz is ping-only
+    assert code == 200
+
+    dog = ServeWatchdog(window_s=60.0)
+    serve(bundle, cluster, max_iterations=2, max_idle_wait=0.01, watchdog=dog)
+    assert dog.last_cursor == bundle.manager.cursor
+    assert bundle.health.handle("/readyz")[0] == 200
 
 
 # -- notebook manager wiring ----------------------------------------------

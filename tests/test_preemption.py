@@ -1,0 +1,336 @@
+"""Recovery escalation state machine (controller/preemption.py).
+
+FakeClock-driven coverage of the full ladder: interruption marking →
+deadline-bounded polling with progress events → escalation (warm-pool
+claim, else StatefulSet recreate) → terminal ``SliceRecoveryFailed`` →
+late recovery clearing all state and stamping the interruption duration.
+
+The chaos catalog (tests/test_chaos_catalog.py) exercises the same ladder
+under storms and apiserver flaps; these tests pin down each individual
+transition with exact clock control.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from kubeflow_tpu.api import annotations as ann
+from kubeflow_tpu.api.notebook import TPUSpec
+from kubeflow_tpu.api.slicepool import new_slicepool
+from kubeflow_tpu.controller.preemption import (
+    RECOVERY_FAILED_CONDITION,
+    RecoveryConfig,
+)
+from kubeflow_tpu.k8s import objects as obj_util
+from kubeflow_tpu.k8s.events import events_for
+
+from tests.harness import make_env, tpu_notebook
+
+# Small values so a full ladder (2 escalations + terminal) fits in a few
+# hundred simulated seconds.
+CFG = RecoveryConfig(
+    deadline_s=60.0,
+    poll_initial_s=5.0,
+    poll_max_s=20.0,
+    max_escalations=2,
+    terminal_requeue_s=600.0,
+)
+
+
+def _ready_env(node_hosts=4, warm_pool=False, recovery_config=CFG):
+    env = make_env(
+        node_pools=(("tpu-v5-lite-podslice", "4x4", node_hosts, 4),),
+        recovery_config=recovery_config,
+    )
+    if warm_pool:
+        env.cluster.create(
+            new_slicepool("pool", "ns", TPUSpec("v5e", "4x4"), warm_replicas=1)
+        )
+        env.manager.run_until_idle()
+    env.cluster.create(tpu_notebook())
+    env.manager.run_until_idle()
+    nb = env.cluster.get("Notebook", "nb", "ns")
+    assert nb["status"]["readyReplicas"] == 4
+    return env
+
+
+def _interrupt(env, pod="nb-2", kill_node=True):
+    """Preempt one host pod; optionally reclaim its node so the replacement
+    can never bind (withheld capacity). Preempt BEFORE deleting the node:
+    within the pod's MODIFIED event the slice-health map runs before the
+    fake kubelet's (registration order), so the Failed pod is observed;
+    node-death-first would let the kubelet GC it unseen. This is also the
+    physically accurate spot-reclaim order (pod gets DisruptionTarget,
+    then the node goes away)."""
+    node = env.cluster.get("Pod", pod, "ns")["spec"]["nodeName"]
+    node_obj = copy.deepcopy(env.cluster.get("Node", node))
+    env.kubelet.preempt_pod(pod, "ns")
+    if kill_node:
+        env.cluster.delete("Node", node)
+    env.manager.run_until_idle()
+    return node_obj
+
+
+def _restore_node(env, node_obj):
+    restored = copy.deepcopy(node_obj)
+    for key in ("uid", "resourceVersion", "generation", "creationTimestamp"):
+        restored["metadata"].pop(key, None)
+    env.cluster.create(restored)
+
+
+def _anns(env):
+    return obj_util.annotations_of(env.cluster.get("Notebook", "nb", "ns"))
+
+
+def _condition(env, cond_type):
+    nb = env.cluster.get("Notebook", "nb", "ns")
+    for c in nb.get("status", {}).get("conditions", []):
+        if c["type"] == cond_type:
+            return c
+    return None
+
+
+def _events(env, reason):
+    return [
+        e for e in events_for(env.cluster, "Notebook", "nb", "ns")
+        if e["reason"] == reason
+    ]
+
+
+def _metric(env, name):
+    for line in env.metrics.expose().decode().splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[-1])
+    return 0.0
+
+
+class TestInterruptionMarking:
+    def test_withheld_capacity_marks_state_and_starts_clock(self):
+        env = _ready_env()
+        t0 = env.clock.now()
+        _interrupt(env)
+
+        anns = _anns(env)
+        assert ann.TPU_SLICE_INTERRUPTED in anns
+        assert float(anns[ann.TPU_RECOVERY_STARTED]) == t0
+        assert ann.TPU_RECOVERY_ESCALATIONS not in anns
+        # Recovery is timer-driven from here on.
+        assert env.manager.next_requeue_in() is not None
+        assert env.manager.next_requeue_in() <= CFG.poll_initial_s
+
+    def test_repeat_failures_keep_original_start(self):
+        # The deadline measures the whole outage, not the last pod flap.
+        env = _ready_env()
+        t0 = env.clock.now()
+        _interrupt(env, pod="nb-2")
+        env.manager.tick(10)
+        env.kubelet.preempt_pod("nb-3", "ns")
+        env.manager.run_until_idle()
+        assert float(_anns(env)[ann.TPU_RECOVERY_STARTED]) == t0
+
+    def test_progress_events_dedup_across_polls(self):
+        env = _ready_env()
+        _interrupt(env)
+        for _ in range(5):
+            env.manager.tick(CFG.poll_initial_s)
+
+        progress = _events(env, "SliceRecoveryProgress")
+        # Identical ready/total message → one Event object, bumped count.
+        assert len(progress) == 1
+        assert progress[0]["count"] >= 2
+        assert "3/4 hosts Ready" in progress[0]["message"]
+
+
+class TestTransientRecovery:
+    def test_recovery_with_capacity_clears_state_and_stamps_duration(self):
+        env = _ready_env()
+        # Keep the node: the replacement pod binds right back.
+        _interrupt(env, kill_node=False)
+
+        anns = _anns(env)
+        assert ann.TPU_SLICE_INTERRUPTED not in anns
+        assert ann.TPU_RECOVERY_STARTED not in anns
+        assert anns[ann.TPU_LAST_INTERRUPTION_DURATION] == "0s"
+        assert _events(env, "SliceRecovered")
+        assert _metric(env, "tpu_slice_recovery_seconds_count") == 1.0
+        assert _metric(env, "tpu_slice_recovery_escalations_total") == 0.0
+
+    def test_duration_stamp_reflects_outage_length(self):
+        env = _ready_env()
+        node_obj = _interrupt(env)
+        for _ in range(4):  # 40s of withheld capacity, inside the deadline
+            env.manager.tick(10)
+        _restore_node(env, node_obj)
+        env.manager.tick(CFG.poll_max_s)
+
+        anns = _anns(env)
+        assert ann.TPU_SLICE_INTERRUPTED not in anns
+        stamp = float(anns[ann.TPU_LAST_INTERRUPTION_DURATION].rstrip("s"))
+        assert 40 <= stamp <= 60 + CFG.poll_max_s
+        recovered = _events(env, "SliceRecovered")
+        assert "interruption" in recovered[0]["message"]
+
+    def test_recovery_annotations_never_roll_the_pod_template(self):
+        # Lifecycle annotations must stay off the STS pod template, or each
+        # interruption would roll every host pod a second time.
+        env = _ready_env()
+        _interrupt(env, kill_node=False)
+        assert ann.TPU_LAST_INTERRUPTION_DURATION in _anns(env)
+        env.manager.run_until_idle()
+        sts = env.cluster.get("StatefulSet", "nb", "ns")
+        tmpl_anns = (
+            sts["spec"]["template"]["metadata"].get("annotations", {})
+        )
+        for key in (
+            ann.TPU_SLICE_INTERRUPTED,
+            ann.TPU_RECOVERY_STARTED,
+            ann.TPU_RECOVERY_ESCALATIONS,
+            ann.TPU_LAST_INTERRUPTION_DURATION,
+        ):
+            assert key not in tmpl_anns
+
+
+class TestEscalation:
+    def test_deadline_claims_warm_slice_and_recovers(self):
+        # 8 hosts: 4 for the notebook, 4 provisioned under the warm
+        # placeholder. Killing one notebook node leaves the replacement pod
+        # unschedulable until the claim frees placeholder capacity.
+        env = _ready_env(node_hosts=8, warm_pool=True)
+        _interrupt(env)
+        for _ in range(20):
+            env.manager.tick(10)
+
+        anns = _anns(env)
+        assert ann.TPU_SLICE_INTERRUPTED not in anns
+        assert ann.TPU_RECOVERY_ESCALATIONS not in anns
+        assert ann.TPU_LAST_INTERRUPTION_DURATION in anns
+        escalated = _events(env, "SliceRecoveryEscalated")
+        assert len(escalated) == 1
+        assert "warm slice from pool pool" in escalated[0]["message"]
+        assert _events(env, "ClaimedWarmSlice")
+        assert _events(env, "SliceRecovered")
+        assert _metric(env, "tpu_slice_recovery_escalations_total") == 1.0
+        assert _metric(env, "tpu_slice_recovery_seconds_count") == 1.0
+        assert not env.manager.reconcile_errors
+
+    def test_deadline_without_pool_recreates_statefulsets(self):
+        env = _ready_env()
+        old_uid = env.cluster.get("StatefulSet", "nb", "ns")["metadata"]["uid"]
+        _interrupt(env)
+        env.manager.tick(CFG.deadline_s + 1)
+
+        assert _anns(env)[ann.TPU_RECOVERY_ESCALATIONS] == "1"
+        escalated = _events(env, "SliceRecoveryEscalated")
+        assert len(escalated) == 1
+        assert "recreating StatefulSet" in escalated[0]["message"]
+        # The notebook reconciler already re-created the STS from spec.
+        sts = env.cluster.get("StatefulSet", "nb", "ns")
+        assert sts["metadata"]["uid"] != old_uid
+        assert _metric(env, "tpu_slice_recovery_escalations_total") == 1.0
+
+    def test_escalation_rearms_deadline_then_capacity_return_recovers(self):
+        env = _ready_env()
+        node_obj = _interrupt(env)
+        env.manager.tick(CFG.deadline_s + 1)
+        assert _anns(env)[ann.TPU_RECOVERY_ESCALATIONS] == "1"
+        # Inside the re-armed deadline: still polling, no second escalation.
+        env.manager.tick(CFG.poll_initial_s)
+        assert _anns(env)[ann.TPU_RECOVERY_ESCALATIONS] == "1"
+
+        _restore_node(env, node_obj)
+        for _ in range(4):
+            env.manager.tick(CFG.poll_max_s)
+        anns = _anns(env)
+        assert ann.TPU_SLICE_INTERRUPTED not in anns
+        assert ann.TPU_RECOVERY_ESCALATIONS not in anns
+        assert ann.TPU_LAST_INTERRUPTION_DURATION in anns
+        assert _condition(env, RECOVERY_FAILED_CONDITION) is None
+
+
+class TestTerminalState:
+    def _run_to_terminal(self, env):
+        for _ in range(40):
+            env.manager.tick(10)
+            cond = _condition(env, RECOVERY_FAILED_CONDITION)
+            if cond and cond["status"] == "True":
+                return cond
+        raise AssertionError("never reached SliceRecoveryFailed")
+
+    def test_exhausted_escalations_go_terminal(self):
+        env = _ready_env()
+        _interrupt(env)
+        cond = self._run_to_terminal(env)
+
+        assert cond["reason"] == "RecoveryDeadlineExceeded"
+        assert "2 escalations" in cond["message"]
+        failed_events = _events(env, RECOVERY_FAILED_CONDITION)
+        assert failed_events and failed_events[0]["type"] == "Warning"
+        assert _anns(env)[ann.TPU_RECOVERY_ESCALATIONS] == "2"
+        assert _metric(env, "tpu_slice_recovery_failed_total") == 1.0
+        assert _metric(env, "tpu_slice_recovery_escalations_total") == 2.0
+
+    def test_terminal_state_is_quiet(self):
+        # Visible but cheap: one long idle requeue per terminal_requeue_s,
+        # no event spam, no status churn.
+        env = _ready_env()
+        _interrupt(env)
+        self._run_to_terminal(env)
+        failed_before = len(_events(env, RECOVERY_FAILED_CONDITION))
+        calls = env.manager.tick(CFG.terminal_requeue_s)
+        assert calls <= 4
+        assert len(_events(env, RECOVERY_FAILED_CONDITION)) == failed_before
+        assert not env.manager.reconcile_errors
+
+    def test_late_capacity_flips_terminal_condition_and_recovers(self):
+        env = _ready_env()
+        node_obj = _interrupt(env)
+        self._run_to_terminal(env)
+
+        _restore_node(env, node_obj)
+        env.manager.tick(CFG.terminal_requeue_s)
+
+        anns = _anns(env)
+        assert ann.TPU_SLICE_INTERRUPTED not in anns
+        assert ann.TPU_RECOVERY_STARTED not in anns
+        assert ann.TPU_RECOVERY_ESCALATIONS not in anns
+        assert ann.TPU_LAST_INTERRUPTION_DURATION in anns
+        cond = _condition(env, RECOVERY_FAILED_CONDITION)
+        # Flipped, not deleted: the transition itself is signal.
+        assert cond["status"] == "False"
+        assert cond["reason"] == "Recovered"
+        assert _events(env, "SliceRecovered")
+        assert _metric(env, "tpu_slice_recovery_seconds_count") == 1.0
+
+
+class TestStopAndConfig:
+    def test_stopping_notebook_clears_recovery_state(self):
+        env = _ready_env()
+        _interrupt(env)
+        assert ann.TPU_SLICE_INTERRUPTED in _anns(env)
+
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        obj_util.annotations_of(nb)[ann.STOP] = "2026-01-01T00:00:00Z"
+        env.cluster.update(nb)
+        env.manager.run_until_idle()
+        env.manager.tick(CFG.poll_max_s)
+
+        anns = _anns(env)
+        for key in (
+            ann.TPU_SLICE_INTERRUPTED,
+            ann.TPU_RECOVERY_STARTED,
+            ann.TPU_RECOVERY_ESCALATIONS,
+            ann.TPU_RECOVERY_LAST_ESCALATION,
+        ):
+            assert key not in anns
+        assert ann.STOP in anns
+
+    def test_recovery_config_from_env(self):
+        cfg = RecoveryConfig.from_env({
+            "SLICE_RECOVERY_DEADLINE_SECONDS": "120",
+            "SLICE_RECOVERY_POLL_SECONDS": "2",
+            "SLICE_RECOVERY_POLL_MAX_SECONDS": "30",
+            "SLICE_RECOVERY_MAX_ESCALATIONS": "1",
+            "SLICE_RECOVERY_TERMINAL_REQUEUE_SECONDS": "900",
+        })
+        assert cfg == RecoveryConfig(120.0, 2.0, 30.0, 1, 900.0)
+        assert RecoveryConfig.from_env({}) == RecoveryConfig()

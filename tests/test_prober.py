@@ -272,3 +272,56 @@ def test_make_prober_dev_mode_uses_python_proxy_path():
     p = prober_mod.make_prober(dev_proxy="http://localhost:8001")
     assert isinstance(p, JupyterHTTPProber)
     assert p.dev_proxy == "http://localhost:8001"
+
+
+# -- JupyterHTTPProber concurrency (pure-Python fallback path) -------------
+
+
+class _ScriptedHTTPProber(JupyterHTTPProber):
+    """JupyterHTTPProber with the network layer replaced by scripted
+    per-host delays — exercises the real executor/deadline/fold plumbing
+    in probe() without sockets."""
+
+    def __init__(self, delays: dict, **kw):
+        super().__init__(**kw)
+        self.delays = delays
+
+    def _probe_host(self, nb, host):
+        time.sleep(self.delays.get(host, 0.0))
+        return IDLE, []
+
+
+def test_http_prober_fans_out_hosts_concurrently():
+    """8 hosts × 0.3s each must cost ~one delay, not 8× — the reason the
+    Python prober grew an executor (same property the native prober
+    asserts in test_fanout_wall_time_is_one_timeout_not_n)."""
+    hosts = [f"h{i}" for i in range(8)]
+    prober = _ScriptedHTTPProber(
+        {h: 0.3 for h in hosts}, slice_deadline_s=10.0
+    )
+    t0 = time.monotonic()
+    acts = prober.probe(_nb(), hosts)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 1.5  # sequential would be ≥ 2.4s
+    assert [a.host for a in acts] == hosts  # fold order == host order
+    assert all(a.reachable and not a.busy for a in acts)
+
+
+def test_http_prober_slice_deadline_folds_stragglers_unreachable():
+    """One host stalls past slice_deadline_s: the reconcile returns at the
+    deadline with that host folded unreachable (the culler's never-judge
+    state), the healthy hosts intact."""
+    prober = _ScriptedHTTPProber(
+        {"h0": 0.0, "h1": 5.0, "h2": 0.0}, slice_deadline_s=0.5
+    )
+    t0 = time.monotonic()
+    acts = prober.probe(_nb(), ["h0", "h1", "h2"])
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.0  # bounded by the deadline, not the 5s straggler
+    assert acts[0].reachable
+    assert not acts[1].reachable
+    assert acts[2].reachable
+
+
+def test_http_prober_empty_host_list():
+    assert JupyterHTTPProber().probe(_nb(), []) == []
