@@ -921,7 +921,18 @@ def _cached_headline(quant_bits: int = 0, kv_bits: int = 0):
             if rp not in seen:
                 seen.add(rp)
                 paths.append(p)
-    paths.sort(key=os.path.getmtime, reverse=True)
+    # Mtime alone mis-orders artifacts restored by a checkout (git stamps
+    # them all identically): break ties by the round suffix in the name,
+    # so BENCH_FULL_r05_headline.json beats BENCH_FULL_r03.json instead
+    # of an older round shadowing the live headline.
+    import re
+
+    def _round_of(p):
+        m = re.search(r"_r(\d+)", os.path.basename(p))
+        return int(m.group(1)) if m else -1
+
+    paths.sort(key=lambda p: (os.path.getmtime(p), _round_of(p)),
+               reverse=True)
     for path in paths:
         try:
             with open(path) as f:

@@ -49,6 +49,15 @@ def main() -> None:
     ap.add_argument("--paged", action="store_true",
                     help="serve through the paged block-pool engine")
     ap.add_argument("--num-blocks", type=int, default=256)
+    ap.add_argument("--max-queue-depth", type=int, default=64,
+                    help="pending requests past this shed with 429 "
+                         "instead of blocking handler threads")
+    ap.add_argument("--deadline-s", type=float, default=None,
+                    help="default per-request deadline; expired requests "
+                         "free their slot and get 504 with partials")
+    ap.add_argument("--drain-s", type=float, default=5.0,
+                    help="SIGTERM drain budget before stragglers are "
+                         "force-aborted")
     args = ap.parse_args()
     if args.paged and args.admit_chunk:
         raise SystemExit("--admit-chunk is a continuous-engine feature; "
@@ -111,7 +120,10 @@ def main() -> None:
 
     srv = InferenceServer(engine, host=args.host, port=args.port,
                           tokenizer=tokenizer,
-                          model_name=args.checkpoint or args.config).start()
+                          model_name=args.checkpoint or args.config,
+                          max_queue_depth=args.max_queue_depth,
+                          default_deadline_s=args.deadline_s,
+                          drain_s=args.drain_s).start()
     print(f"serving {args.config} on http://{srv.host}:{srv.port} "
           f"({'paged' if args.paged else 'continuous'}, "
           f"{args.slots} slots)", flush=True)

@@ -34,6 +34,9 @@ from kubeflow_tpu.k8s.manager import Manager, Reconciler, Request, Result
 log = logging.getLogger(__name__)
 
 FINALIZER = "notebooks.kubeflow.org/platform-cleanup"
+# Poll cadence while waiting for the token controller to mint the pod
+# ServiceAccount's image-pull secret (reference :155-186 wait step).
+PULL_SECRET_REQUEUE_S = 2.0
 
 
 @dataclass
@@ -155,10 +158,34 @@ class PlatformReconciler(Reconciler):
                 requeue = delay
 
         if nb.lock_held:
+            if not self._pull_secret_ready(nb):
+                # The pod would race its registry pull against the
+                # token controller minting the SA's pull secret and
+                # land in ImagePullBackOff; hold the lock and requeue
+                # (reference RemoveReconciliationLock :155-186 waits on
+                # the same secret before releasing).
+                self.recorder.eventf(
+                    nb.obj, "Normal", "WaitingForPullSecret",
+                    "ServiceAccount image-pull secret not yet minted; "
+                    "holding reconciliation lock",
+                )
+                return Result(requeue_after=PULL_SECRET_REQUEUE_S)
             self._remove_reconciliation_lock(nb)
         return Result(requeue_after=requeue)
 
     # ------------------------------------------------------------------
+    def _pull_secret_ready(self, nb: Notebook) -> bool:
+        """True once the pod's ServiceAccount exists AND carries an
+        imagePullSecrets entry. The pod runs as the template's
+        serviceAccountName when set (the auth webhook injects one), else
+        the namespace "default" SA."""
+        sa_name = nb.pod_spec.get("serviceAccountName") or "default"
+        try:
+            sa = self.client.get("ServiceAccount", sa_name, nb.namespace)
+        except NotFoundError:
+            return False
+        return bool(sa.get("imagePullSecrets"))
+
     def _remove_reconciliation_lock(self, nb: Notebook) -> None:
         """Everything is in place — release the lock so the slice starts
         (reference RemoveReconciliationLock :155-186, the merge-patch that

@@ -12,6 +12,11 @@ environment and asserts the steady-state checks recover.
 
 from __future__ import annotations
 
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable
@@ -39,12 +44,26 @@ INJECTION_TYPES = (
     "preemption-storm",
     "capacity-withheld",
     "apiserver-flap",
+    # Serving request-lifecycle coverage (models/server.py): the in-pod
+    # inference front door under client misbehavior. Disconnecting
+    # streamers must free their slots within one engine step, a full
+    # pending queue must shed (429) instead of parking handler threads,
+    # and a crashed engine thread must abort waiters loudly — never a
+    # slot decoding for nobody or a client hung forever.
+    "serving-disconnect-storm",
+    "serving-overload",
+    "serving-engine-stall",
 )
 STEADY_STATE_CHECKS = (
     "sliceReady", "notCulled", "notebookCreatable", "warmPoolReady",
     # Recovery reached SliceRecovered or the terminal condition — never a
     # silent stall with an interrupted slice and no requeue.
     "recoveryConverged",
+    # Serving: /healthz answers 200 and the engine thread is alive.
+    "servingHealthy",
+    # Serving: no slot (or queue entry) still holds work for a client
+    # that is gone — the disconnect-storm invariant.
+    "slotsReclaimed",
 )
 # Injection ↔ target coherence: a doc must declare the kind its handler
 # actually exercises, or a "pass" certifies a hypothesis that never ran.
@@ -58,6 +77,9 @@ TARGET_KIND_FOR_INJECTION = {
     "preemption-storm": "Notebook",
     "capacity-withheld": "Notebook",
     "apiserver-flap": "Notebook",
+    "serving-disconnect-storm": "InferenceServer",
+    "serving-overload": "InferenceServer",
+    "serving-engine-stall": "InferenceServer",
 }
 
 
@@ -139,6 +161,51 @@ def validate_knowledge(doc: dict) -> None:
 # Execution
 
 
+def _default_serving_factory(**kw):
+    """Tiny CPU-model serving stack for the serving-* experiments. The
+    model imports are lazy: catalog *validation* (the CI path) must not
+    require the jax stack."""
+    import jax
+
+    from kubeflow_tpu.models import llama as L
+    from kubeflow_tpu.models.continuous import ContinuousBatcher
+    from kubeflow_tpu.models.server import InferenceServer
+    from kubeflow_tpu.models.serving import GenerationConfig
+
+    cfg = L.LLAMA_CONFIGS["tiny"]
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ContinuousBatcher(
+        params, cfg,
+        slots=kw.pop("slots", 2),
+        cache_len=128,
+        prompt_bucket=16,
+        gen=GenerationConfig(max_new_tokens=kw.pop("max_new_tokens", 64)),
+    )
+    # Short drain: experiment teardown must not wait a full production
+    # drain window for work the experiment itself orphaned.
+    kw.setdefault("drain_s", 0.5)
+    return InferenceServer(engine, port=0, **kw)
+
+
+def _serving_post(port: int, payload: dict, timeout: float = 60.0):
+    """(status, body) for a completions POST — HTTPError is an outcome
+    here (429/503/500 are the behaviors under test), not an exception."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        try:
+            body = json.loads(err.read())
+        except Exception:
+            body = {}
+        return err.code, body
+
+
 @dataclass
 class ExperimentResult:
     name: str
@@ -157,9 +224,16 @@ class ExperimentRunner:
     across runs.
     """
 
-    def __init__(self, env_factory: Callable[..., object], notebook_factory: Callable[..., dict]):
+    def __init__(self, env_factory: Callable[..., object],
+                 notebook_factory: Callable[..., dict],
+                 serving_factory: Callable[..., object] = None):
         self.env_factory = env_factory
         self.notebook_factory = notebook_factory
+        # serving_factory(**knobs) -> an UNstarted models/server.py
+        # InferenceServer over a tiny engine; the serving-* handlers
+        # start/stop it per experiment. Defaults to a tiny CPU model so
+        # the catalog stays executable without the caller wiring one.
+        self.serving_factory = serving_factory or _default_serving_factory
         self._handlers: dict[str, Callable[[dict], ExperimentResult]] = {
             "pod-kill": self._run_pod_kill,
             "network-partition": self._run_network_partition,
@@ -170,6 +244,9 @@ class ExperimentRunner:
             "preemption-storm": self._run_preemption_storm,
             "capacity-withheld": self._run_capacity_withheld,
             "apiserver-flap": self._run_apiserver_flap,
+            "serving-disconnect-storm": self._run_serving_disconnect_storm,
+            "serving-overload": self._run_serving_overload,
+            "serving-engine-stall": self._run_serving_engine_stall,
         }
 
     def run(self, doc: dict) -> ExperimentResult:
@@ -611,3 +688,213 @@ class ExperimentRunner:
             passed=failed == creates and persisted == 0 and lock is not None,
             detail=f"failed={failed}/{creates} persisted={persisted} lock={lock}",
         )
+
+    # -- serving request-lifecycle experiments ------------------------------
+
+    def _run_serving_disconnect_storm(self, doc: dict) -> ExperimentResult:
+        """N streaming clients read one token and vanish (notebook tab
+        closed). Every slot decoding for a gone client must be reclaimed
+        at the engine's next _note_token — zero slots decoding dead work
+        — and the cancelled counter must match the storm size exactly."""
+        import http.client
+
+        params = doc["spec"]["injection"].get("params", {})
+        clients = int(params.get("clients", 4))
+        timeout = float(doc["spec"]["recoveryTimeoutSeconds"])
+        # Budget far past what decodes before a FIN registers: the
+        # requests must still be mid-decode when the broken pipes cancel
+        # them, or there is nothing left to reclaim.
+        srv = self.serving_factory(max_new_tokens=100).start()
+        try:
+            conns = []
+            for _ in range(clients):
+                c = http.client.HTTPConnection(srv.host, srv.port,
+                                               timeout=timeout)
+                c.request(
+                    "POST", "/v1/completions",
+                    json.dumps({"prompt": [1, 2, 3], "stream": True}),
+                    {"Content-Type": "application/json"},
+                )
+                conns.append(c)
+            for c in conns:
+                resp = c.getresponse()
+                while True:  # first token, then hang up without warning
+                    line = resp.fp.readline()
+                    if not line or line.startswith(b"data:"):
+                        break
+                # Connection: close responses own the socket; closing
+                # the response sends FIN mid-stream — the abrupt
+                # disconnect under test.
+                resp.close()
+                c.close()
+            busy, cancelled = True, 0
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with srv._lock:
+                    busy = (
+                        any(r is not None for r in srv.engine._by_slot)
+                        or bool(srv.engine._queue)
+                        or getattr(srv.engine, "_admitting", None)
+                        is not None
+                    )
+                    cancelled = srv._cancelled
+                if not busy and cancelled == clients:
+                    break
+                time.sleep(0.01)
+            healthy = srv._engine_error is None
+            passed = not busy and cancelled == clients and healthy
+            return ExperimentResult(
+                doc["metadata"]["name"],
+                passed=passed,
+                detail="" if passed else (
+                    f"busy={busy} cancelled={cancelled}/{clients} "
+                    f"healthy={healthy}"
+                ),
+                observations={"cancelled": cancelled},
+            )
+        finally:
+            srv.stop()
+
+    def _run_serving_overload(self, doc: dict) -> ExperimentResult:
+        """The engine stalls (long compile, slow step) while clients keep
+        arriving. Accepted requests park; once the pending queue is full,
+        every further arrival must shed with a FAST 429 — the shed path
+        takes no engine lock — and complete normally after the stall
+        lifts. Shed counter must equal observed 429s exactly."""
+        params = doc["spec"]["injection"].get("params", {})
+        depth = int(params.get("queueDepth", 3))
+        extras = int(params.get("extraClients", 3))
+        budget = float(params.get("shedLatencySeconds", 0.5))
+        srv = self.serving_factory(max_queue_depth=depth, slots=1)
+        stall = threading.Event()
+        real_step = srv.engine._step
+
+        def stalled_step():
+            if not stall.is_set():
+                time.sleep(0.005)  # stall: consume nothing, stay alive
+                return
+            real_step()
+
+        srv.engine._step = stalled_step
+        srv.start()
+        try:
+            accepted: list = []
+
+            def accept_post():
+                accepted.append(_serving_post(
+                    srv.port, {"prompt": [1, 2, 3], "max_tokens": 2}
+                ))
+
+            # Fill deterministically: one request into the slot, then
+            # exactly `depth` into the pending queue, each confirmed
+            # before the next — no admission race can over/undershoot.
+            threads = [threading.Thread(target=accept_post, daemon=True)]
+            threads[0].start()
+            deadline = time.monotonic() + 30
+            while (not any(r is not None for r in srv.engine._by_slot)
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            for i in range(depth):
+                t = threading.Thread(target=accept_post, daemon=True)
+                t.start()
+                threads.append(t)
+                while (len(srv.engine._queue) <= i
+                       and time.monotonic() < deadline):
+                    time.sleep(0.005)
+
+            shed_results = []
+            for _ in range(extras):
+                t0 = time.monotonic()
+                code, _body = _serving_post(
+                    srv.port, {"prompt": [1, 2, 3], "max_tokens": 2},
+                )
+                shed_results.append((code, time.monotonic() - t0))
+
+            stall.set()  # stall lifts; parked work must finish normally
+            for t in threads:
+                t.join(timeout=60)
+            with srv._shed_lock:
+                shed_counter = srv._shed
+            all_shed = all(code == 429 for code, _ in shed_results)
+            slow = [lat for _, lat in shed_results if lat > budget]
+            all_done = (
+                len(accepted) == depth + 1
+                and all(code == 200 for code, _ in accepted)
+            )
+            passed = (all_shed and not slow and all_done
+                      and shed_counter == extras)
+            return ExperimentResult(
+                doc["metadata"]["name"],
+                passed=passed,
+                detail="" if passed else (
+                    f"shed={[c for c, _ in shed_results]} slow={slow} "
+                    f"accepted={[c for c, _ in accepted]} "
+                    f"counter={shed_counter}/{extras}"
+                ),
+                observations={
+                    "shed_counter": shed_counter,
+                    "max_shed_latency_s": round(
+                        max(lat for _, lat in shed_results), 4
+                    ) if shed_results else None,
+                },
+            )
+        finally:
+            stall.set()
+            srv.stop()
+
+    def _run_serving_engine_stall(self, doc: dict) -> ExperimentResult:
+        """The engine thread crashes mid-step (device OOM, preemption).
+        Waiters must be aborted with the cause (no hung clients), healthz
+        must flip red naming it, and new submits must refuse — loud
+        containment, never a silently-dead daemon thread."""
+        params = doc["spec"]["injection"].get("params", {})
+        cause = str(params.get("cause", "injected engine stall"))
+        srv = self.serving_factory()
+
+        def crashing_step():
+            raise RuntimeError(cause)
+
+        srv.engine._step = crashing_step
+        srv.start()
+        try:
+            inflight: list = []
+
+            def post():
+                inflight.append(_serving_post(
+                    srv.port, {"prompt": [1, 2, 3], "max_tokens": 4}
+                ))
+
+            t = threading.Thread(target=post, daemon=True)
+            t.start()
+            t.join(timeout=30)
+            aborted_loudly = (
+                len(inflight) == 1
+                and inflight[0][0] == 500
+                and cause in inflight[0][1].get("error", "")
+            )
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/healthz", timeout=10
+                ) as resp:
+                    health_code, health = resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as err:
+                health_code, health = err.code, json.loads(err.read())
+            health_red = (
+                health_code == 503 and cause in health.get("error", "")
+            )
+            refuse_code, _ = _serving_post(
+                srv.port, {"prompt": [1, 2, 3], "max_tokens": 4},
+                timeout=10,
+            )
+            passed = aborted_loudly and health_red and refuse_code == 503
+            return ExperimentResult(
+                doc["metadata"]["name"],
+                passed=passed,
+                detail="" if passed else (
+                    f"inflight={inflight} health={health_code}:{health} "
+                    f"refuse={refuse_code}"
+                ),
+                observations={"health": health},
+            )
+        finally:
+            srv.stop()

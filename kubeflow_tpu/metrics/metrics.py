@@ -121,6 +121,35 @@ class Metrics:
             "TPU nodes the image pre-puller is maintaining pods for",
             registry=self.registry,
         )
+        # -- serving request lifecycle (models/server.py) ------------------
+        # The InferenceServer mirrors its /stats lifecycle counters here
+        # when constructed with metrics=; shed/cancel/deadline rates are
+        # the overload-protection observables the chaos experiments pin.
+        self.serving_requests_shed_total = Counter(
+            "tpu_serving_requests_shed_total",
+            "Requests refused with 429 because the pending queue was full",
+            registry=self.registry,
+        )
+        self.serving_requests_cancelled_total = Counter(
+            "tpu_serving_requests_cancelled_total",
+            "Requests cancelled before completing (client disconnects)",
+            registry=self.registry,
+        )
+        self.serving_deadline_expired_total = Counter(
+            "tpu_serving_deadline_expired_total",
+            "Requests retired engine-side after their deadline expired",
+            registry=self.registry,
+        )
+        self.serving_queue_depth = Gauge(
+            "tpu_serving_queue_depth",
+            "Pending (unslotted) inference requests",
+            registry=self.registry,
+        )
+        self.serving_drain_seconds = Gauge(
+            "tpu_serving_drain_seconds",
+            "Duration of the most recent graceful drain",
+            registry=self.registry,
+        )
 
     def collect_running(self) -> None:
         """Recompute run-state gauges by listing StatefulSets, as the

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 from functools import partial
 from typing import Optional, Sequence
 
@@ -257,6 +258,11 @@ class _Request:
     # (refcounted) rather than owned — released by decref, never freed
     # directly to the pool.
     shared: frozenset = frozenset()
+    # Absolute monotonic deadline (None = no deadline). Checked at every
+    # _note_token: an expired request retires through the abort path at
+    # its next emitted token, freeing the slot for live work instead of
+    # decoding to full budget for a caller that stopped waiting.
+    deadline: Optional[float] = None
 
 
 class _BatcherBase:
@@ -288,20 +294,37 @@ class _BatcherBase:
         # Chosen-token logprobs per retired request, parallel to
         # _results (run_logprobs() drains it alongside run()).
         self._result_logprobs: dict[int, list[float]] = {}
+        # rid → abort reason for requests retired WITHOUT completing
+        # (cancel/deadline), parallel to _results; drained by run() into
+        # run_aborted().
+        self._aborted: dict[int, str] = {}
         self._next_rid = 0
         # Serving-frontend hooks (models/server.py): called under the
         # frontend's engine lock. on_token(rid, token) per emitted token;
-        # on_retire(rid, tokens) when a request completes — when set,
-        # completed requests are DELIVERED instead of accumulating in
-        # _results (a long-running server must not grow without bound).
+        # on_retire(rid, tokens, logprobs, finish_reason) when a request
+        # completes — when set, completed requests are DELIVERED instead
+        # of accumulating in _results (a long-running server must not
+        # grow without bound). on_abort(rid, tokens, reason) when a
+        # request is retired WITHOUT completing (cancel/deadline).
         self.on_token = None
         self.on_retire = None
+        self.on_abort = None
+        # rid → reason for requests cancelled while holding a slot (or
+        # mid-admission): checked at the next _note_token so the slot is
+        # reclaimed within one engine step. Mutated only under the
+        # frontend's engine lock (cancel() and the drive loop both run
+        # under it).
+        self._cancelled: dict[int, str] = {}
+        # Injectable time source (tests swap in a fake clock to drive
+        # deadline expiry deterministically).
+        self._clock = time.monotonic
 
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
                stop: Optional[Sequence[Sequence[int]]] = None,
-               logit_bias: Optional[dict] = None) -> int:
+               logit_bias: Optional[dict] = None,
+               deadline_s: Optional[float] = None) -> int:
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) > self.prompt_bucket:
@@ -349,14 +372,73 @@ class _BatcherBase:
                     raise ValueError(f"logit_bias value {b!r} not finite")
                 # OpenAI clamps to ±100 (±100 effectively forces/bans).
                 bias[tok] = max(-100.0, min(100.0, b))
+        if deadline_s is not None and (
+            not isinstance(deadline_s, (int, float))
+            or isinstance(deadline_s, bool)
+            or not math.isfinite(deadline_s) or deadline_s <= 0
+        ):
+            raise ValueError(
+                f"deadline_s must be a finite number > 0, got "
+                f"{deadline_s!r}"
+            )
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(_Request(
             rid, list(prompt), max_new=max_new_tokens,
             temperature=None if temperature is None else float(temperature),
             stop=stop_seqs, logit_bias=bias,
+            deadline=None if deadline_s is None
+            else self._clock() + float(deadline_s),
         ))
         return rid
+
+    def cancel(self, rid: int, reason: str = "cancelled") -> bool:
+        """Retire ``rid`` without completing it. A queued request is
+        aborted immediately (it never cost a prefill); a request holding
+        a slot — or mid-chunked-admission — is marked and retired at its
+        next _note_token, i.e. within one engine step. Must be called
+        under the same lock that serializes the drive loop (the serving
+        frontend's engine lock). Returns False when the rid is unknown
+        or already retired (the cancel raced a normal completion — the
+        caller must not count it)."""
+        for i, req in enumerate(self._queue):
+            if req.rid == rid:
+                del self._queue[i]
+                self._deliver_abort(req, reason)
+                return True
+        admitting = getattr(self, "_admitting", None)
+        if admitting is not None and admitting["req"].rid == rid:
+            self._cancelled[rid] = reason
+            return True
+        for req in self._by_slot:
+            if req is not None and req.rid == rid:
+                self._cancelled[rid] = reason
+                return True
+        return False
+
+    def _deliver_abort(self, req: _Request, reason: str) -> None:
+        if self.on_abort is not None:
+            self.on_abort(req.rid, req.tokens, reason)
+        else:
+            # Drive-to-completion callers still get the partial output
+            # under the rid (deadline truncation is a result, not a
+            # crash); run_aborted() names the reason.
+            self._results[req.rid] = req.tokens
+            self._result_logprobs[req.rid] = req.logprobs
+            self._aborted[req.rid] = reason
+
+    def _abort_slot(self, slot: int, reason: str) -> None:
+        """Retire a slot through the abort path: deliver the partial
+        tokens with the abort reason, then free the slot exactly like a
+        normal retirement (same _release_slot invariants)."""
+        req = self._by_slot[slot]
+        self._deliver_abort(req, reason)
+        self._release_slot(slot)
+
+    def run_aborted(self) -> dict[int, str]:
+        """{rid: reason} for requests the most recent run() retired
+        through the abort path (cancel/deadline)."""
+        return getattr(self, "_last_aborted", {})
 
     def _initial_budget(self, req: _Request) -> int:
         """Per-request budget at admit time, clamped to the engine-wide
@@ -401,6 +483,7 @@ class _BatcherBase:
         self._last_logprobs, self._result_logprobs = (
             self._result_logprobs, {}
         )
+        self._last_aborted, self._aborted = self._aborted, {}
         return out
 
     def run_logprobs(self) -> dict[int, list[float]]:
@@ -417,6 +500,18 @@ class _BatcherBase:
         it) accumulates alongside the tokens."""
         req = self._by_slot[slot]
         if req is None:
+            return
+        # Retire-before-emit: a cancelled (disconnected client) or
+        # deadline-expired request must not hold its slot for another
+        # step, and its caller must never mistake the truncation for a
+        # completion — the abort path delivers the partial tokens with
+        # the reason instead of a _Final.
+        reason = self._cancelled.pop(req.rid, None)
+        if reason is None and req.deadline is not None \
+                and self._clock() >= req.deadline:
+            reason = "deadline"
+        if reason is not None:
+            self._abort_slot(slot, reason)
             return
         req.budget -= 1
         if token == self.gen.eos_id:
@@ -437,7 +532,9 @@ class _BatcherBase:
                 self._retire(slot)
                 return
         if req.budget <= 0:
-            self._retire(slot)
+            # Budget exhaustion is TRUNCATION, not completion — OpenAI
+            # reports it as finish_reason "length".
+            self._retire(slot, finish_reason="length")
             return
         self.tokens[slot, 0] = token
 
@@ -445,10 +542,10 @@ class _BatcherBase:
         """Hook for subclasses that keep a SECOND cache in lockstep (the
         speculative batchers prefill their draft cache here)."""
 
-    def _retire(self, slot: int) -> None:
+    def _retire(self, slot: int, finish_reason: str = "stop") -> None:
         req = self._by_slot[slot]
         if self.on_retire is not None:
-            self.on_retire(req.rid, req.tokens, req.logprobs)
+            self.on_retire(req.rid, req.tokens, req.logprobs, finish_reason)
         else:
             self._results[req.rid] = req.tokens
             self._result_logprobs[req.rid] = req.logprobs

@@ -331,11 +331,12 @@ class MultiLoraBatcher(ContinuousBatcher):
         return adapter
 
     def submit(self, prompt, max_new_tokens=None, adapter=None,
-               temperature=None, stop=None, logit_bias=None) -> int:
+               temperature=None, stop=None, logit_bias=None,
+               deadline_s=None) -> int:
         aid = self.resolve_adapter(adapter)
         rid = super().submit(prompt, max_new_tokens=max_new_tokens,
                              temperature=temperature, stop=stop,
-                             logit_bias=logit_bias)
+                             logit_bias=logit_bias, deadline_s=deadline_s)
         self._queue[-1].adapter_id = aid
         return rid
 

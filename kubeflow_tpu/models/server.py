@@ -35,10 +35,28 @@ Endpoints (OpenAI-completions-shaped, token-native):
     (requires n=1, no logprobs; error events precede [DONE] on abort).
   Response: ``{"id", "choices": [{"index", "tokens", "text"?,
   "logprobs"?, "finish_reason"}], "usage": {...}}``.
-- ``GET /healthz`` — liveness (503 once the engine thread died);
+- ``GET /healthz`` — liveness (503 once the engine thread died, or the
+  moment a drain starts);
   ``GET /v1/models`` — base + adapters; ``GET /stats`` — active slots,
-  queue depth, served/token counts, lifetime tokens/sec, and p50/p95
-  time-to-first-token + end-to-end latency over the last 256 requests.
+  queue depth, served/token counts, lifetime tokens/sec, p50/p95
+  time-to-first-token + end-to-end latency over the last 256 requests,
+  and the lifecycle counters (shed / cancelled / deadline-expired /
+  drain duration).
+
+Request lifecycle (overload protection — see ARCHITECTURE.md "Serving
+overload protection & request lifecycle"):
+- admission control: ``max_queue_depth`` bounds the pending queue;
+  full → 429 + Retry-After without touching the engine lock;
+  ``max_body_bytes`` caps Content-Length (413 past it);
+- deadlines: per-request ``deadline_s`` (server default/ceiling via
+  ``default_deadline_s``/``max_deadline_s``); expiry retires the slot
+  engine-side at the next _note_token → 504 with partial tokens;
+- disconnect cancellation: a broken stream pipe or a gone non-stream
+  client cancels its rids; the engine reclaims the slot within one step;
+- graceful drain: ``stop()`` rejects new submits (503 + Retry-After),
+  waits up to ``drain_s`` for in-flight work, force-aborts stragglers;
+- engine failure: a crashed drive loop aborts every waiting queue and
+  flips /healthz red with the cause.
 
 Reference parity: the reference deploys notebook POD plumbing and leaves
 what runs inside to the user (no serving stack at all — SURVEY.md §2.5);
@@ -50,7 +68,10 @@ from __future__ import annotations
 
 import collections
 import json
+import math
 import queue
+import select
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -73,24 +94,77 @@ class _Final:
     """Success sentinel carrying the AUTHORITATIVE final token list (a
     stop-sequence match truncates tokens the per-token stream already
     delivered, so non-streaming responses must use the retire payload,
-    not the accumulated stream) plus the chosen-token logprobs."""
+    not the accumulated stream) plus the chosen-token logprobs and the
+    finish reason ("stop" for EOS/stop-sequence, "length" for budget
+    truncation — OpenAI semantics)."""
 
-    def __init__(self, tokens: list, logprobs: list):
+    def __init__(self, tokens: list, logprobs: list,
+                 finish_reason: str = "stop"):
         self.tokens = tokens
         self.logprobs = logprobs
+        self.finish_reason = finish_reason
 
 
 class _Abort:
     """Queue sentinel for a request that did NOT complete (engine death,
-    server shutdown) — per-queue, so a request that already finished
-    normally can never be mislabeled by a later global failure."""
+    server shutdown, deadline, cancellation) — per-queue, so a request
+    that already finished normally can never be mislabeled by a later
+    global failure."""
 
     def __init__(self, reason: str):
         self.reason = reason
 
 
 class EngineFailedError(RuntimeError):
-    """The engine thread is dead (or shutting down); submits are refused."""
+    """The engine thread is dead; submits are refused (503)."""
+
+
+class OverloadedError(RuntimeError):
+    """The pending queue is at max_queue_depth: the request is SHED
+    (429 + Retry-After) instead of parking a handler thread on a queue
+    the engine will not reach for a long time."""
+
+
+class DrainingError(RuntimeError):
+    """The server is draining (stop()/SIGTERM): new submits are refused
+    (503 + Retry-After) while in-flight requests finish."""
+
+
+def _client_gone(conn) -> bool:
+    """True when the peer has closed its end: the socket selects
+    readable but a MSG_PEEK read returns b"" (EOF) or errors. A client
+    that is merely slow selects NOT-readable (it sent its whole request)
+    and is left alone."""
+    try:
+        r, _, _ = select.select([conn], [], [], 0)
+        if not r:
+            return False
+        return conn.recv(1, socket.MSG_PEEK) == b""
+    except (OSError, ValueError):
+        return True
+
+
+def _read_body(handler, limit: int) -> bytes:
+    """THE body read for handler threads: refuses Content-Length past
+    ``limit`` BEFORE reading a byte (the kftpu-unbounded-handler-read
+    semgrep rule forbids bare rfile.read in serving/webhook handlers —
+    an attacker-sized body must never be buffered whole into host
+    memory). Raises ValueError on garbage lengths."""
+    length = int(handler.headers.get("Content-Length", 0))
+    if length < 0:
+        raise ValueError(f"invalid Content-Length {length}")
+    if length > limit:
+        raise BodyTooLarge(length, limit)
+    return handler.rfile.read(length)
+
+
+class BodyTooLarge(ValueError):
+    def __init__(self, length: int, limit: int):
+        super().__init__(
+            f"request body {length} bytes exceeds the {limit}-byte limit"
+        )
+        self.length = length
+        self.limit = limit
 
 
 def serving_port_from_env(default: int = 8000) -> int:
@@ -126,7 +200,33 @@ class InferenceServer:
     """
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 8000,
-                 tokenizer=None, model_name: str = "kubeflow-tpu"):
+                 tokenizer=None, model_name: str = "kubeflow-tpu",
+                 max_queue_depth: int = 64,
+                 max_body_bytes: int = 4 << 20,
+                 default_deadline_s: Optional[float] = None,
+                 max_deadline_s: Optional[float] = None,
+                 drain_s: float = 5.0,
+                 metrics=None):
+        # Request-lifecycle knobs (all overload protection):
+        # - max_queue_depth: pending (unslotted) requests beyond this are
+        #   shed with 429 + Retry-After instead of parking handler
+        #   threads — NotebookOS-style bounded queueing;
+        # - max_body_bytes: Content-Length cap (413 past it);
+        # - default_deadline_s / max_deadline_s: per-request TTL applied
+        #   when the client sends none / ceiling on what it may ask for;
+        # - drain_s: stop()/SIGTERM lets in-flight requests finish this
+        #   long before force-aborting stragglers;
+        # - metrics: optional metrics.Metrics bundle mirroring the
+        #   /stats counters into Prometheus.
+        if max_queue_depth < 1:
+            raise ValueError(f"max_queue_depth must be >= 1, got "
+                             f"{max_queue_depth}")
+        self.max_queue_depth = max_queue_depth
+        self.max_body_bytes = max_body_bytes
+        self.default_deadline_s = default_deadline_s
+        self.max_deadline_s = max_deadline_s
+        self.drain_s = drain_s
+        self.metrics = metrics
         # The speculative engines are thin wrappers delegating to an
         # inner batcher (`_engine`) that owns the queue/slots/step loop —
         # hooks and the drive loop must target the inner one.
@@ -145,8 +245,20 @@ class InferenceServer:
         self._work = threading.Condition(self._lock)
         self._queues: dict[int, queue.Queue] = {}
         self._shutdown = False
+        self._draining = False
+        self._stopped = False
         self._served = 0
         self._engine_error: Optional[str] = None
+        # Lifecycle counters. _shed has its OWN lock: the shed fast path
+        # must not wait on the engine lock (held for whole decode steps)
+        # — a full queue answers 429 in milliseconds, and the counter
+        # still has to be exact under concurrent submits.
+        self._shed = 0
+        self._shed_lock = threading.Lock()
+        self._cancelled = 0          # disconnects + explicit cancels
+        self._deadline_expired = 0
+        self._drain_duration: Optional[float] = None
+        self._drain_started: Optional[float] = None
         # Serving observability (host-side, O(1) per event): per-request
         # submit/first-token stamps plus sliding windows of time-to-first-
         # token and end-to-end latency, and a token counter for
@@ -171,6 +283,7 @@ class InferenceServer:
         # _note_token/_retire read them; the spec wrappers forward nothing.
         self.engine.on_token = self._on_token
         self.engine.on_retire = self._on_retire
+        self.engine.on_abort = self._on_abort
 
     # -- engine side (all under self._lock) --------------------------------
 
@@ -185,7 +298,7 @@ class InferenceServer:
             q.put(token)
 
     def _on_retire(self, rid: int, tokens: list,
-                   logprobs: list) -> None:
+                   logprobs: list, finish_reason: str = "stop") -> None:
         self._served += 1
         t0 = self._submit_ts.pop(rid, None)
         self._first_ts.pop(rid, None)
@@ -193,7 +306,25 @@ class InferenceServer:
             self._e2e.append(time.monotonic() - t0)
         q = self._queues.get(rid)
         if q is not None:
-            q.put(_Final(list(tokens), list(logprobs)))
+            q.put(_Final(list(tokens), list(logprobs), finish_reason))
+
+    def _on_abort(self, rid: int, tokens: list, reason: str) -> None:
+        """Engine-side abort (cancel/deadline): the request retired
+        WITHOUT completing. Called under the engine lock, from cancel()
+        (queued requests) or _note_token (slotted ones)."""
+        if reason == "deadline":
+            self._deadline_expired += 1
+            if self.metrics is not None:
+                self.metrics.serving_deadline_expired_total.inc()
+        else:
+            self._cancelled += 1
+            if self.metrics is not None:
+                self.metrics.serving_requests_cancelled_total.inc()
+        self._submit_ts.pop(rid, None)
+        self._first_ts.pop(rid, None)
+        q = self._queues.get(rid)
+        if q is not None:
+            q.put(_Abort(reason))
 
     def _drive(self) -> None:
         while True:
@@ -233,17 +364,53 @@ class InferenceServer:
         return self
 
     def stop(self) -> None:
+        """Graceful drain, then hard stop. Phases:
+
+        1. flip ``_draining`` — new submits get 503 + Retry-After and
+           /healthz goes unready immediately (a load balancer must stop
+           routing here the moment drain starts, not when it ends);
+        2. wait up to ``drain_s`` for in-flight work to finish (the
+           engine thread keeps driving; queues empty out as requests
+           retire normally);
+        3. force-abort stragglers and shut the engine thread + listener
+           down. Shutdown truncation is an ABORT — a partial answer must
+           never read as a completed generation (queues already holding
+           _Final drain it first, FIFO, and complete normally).
+
+        Idempotent: a second call returns once the first finished."""
         with self._work:
+            if self._stopped:
+                return
+            if not self._draining:
+                self._draining = True
+            if self._drain_started is None:
+                self._drain_started = time.monotonic()
+            drain_started = self._drain_started
+            self._work.notify_all()
+        deadline = drain_started + self.drain_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                idle = (not self._queues
+                        and not self.engine._pending())
+                if self._engine_error is not None:
+                    idle = True  # nothing will ever finish; stop waiting
+            if idle:
+                break
+            time.sleep(min(0.05, self.drain_s))
+        with self._work:
+            if self._stopped:
+                return
+            self._stopped = True
             self._shutdown = True
             self._work.notify_all()
-            # Unblock every in-flight handler: a request mid-decode would
-            # otherwise hang its client past process exit. Shutdown
-            # truncation is an ABORT — a partial answer must never read
-            # as a completed generation (queues that already hold _Final
-            # drain it first, FIFO, and complete normally).
+            # Unblock every straggler: a request mid-decode would
+            # otherwise hang its client past process exit.
             abort = _Abort("server shutdown before generation finished")
             for q in self._queues.values():
                 q.put(abort)
+            self._drain_duration = time.monotonic() - drain_started
+            if self.metrics is not None:
+                self.metrics.serving_drain_seconds.set(self._drain_duration)
         self._httpd.shutdown()
         self._httpd.server_close()  # release the listening socket NOW
         self._engine_thread.join(timeout=10)
@@ -285,19 +452,56 @@ class InferenceServer:
             "token-id lists"
         )
 
+    def _shed_check(self) -> None:
+        """Admission control WITHOUT the engine lock. The drive thread
+        holds self._lock for whole admit+step cycles (a JAX compile can
+        take seconds), so a shed decision that waited on it would block
+        exactly when the server is busiest — the opposite of shedding.
+        len() on the engine deque and the flag reads are GIL-atomic;
+        the worst race is admitting one request past the cap or shedding
+        one early during a step boundary, both acceptable. The counter
+        itself is exact (own lock)."""
+        if self._draining or self._shutdown:
+            raise DrainingError("server is draining; retry elsewhere")
+        if self._engine_error is not None:
+            raise EngineFailedError(self._engine_error)
+        if len(self.engine._queue) >= self.max_queue_depth:
+            with self._shed_lock:
+                self._shed += 1
+            if self.metrics is not None:
+                self.metrics.serving_requests_shed_total.inc()
+            raise OverloadedError(
+                f"pending queue is full ({self.max_queue_depth} deep)"
+            )
+
+    def _resolve_deadline(self, deadline_s) -> Optional[float]:
+        """Client-requested TTL → effective TTL: default when absent,
+        clamped to max_deadline_s when configured. Validation of the
+        value itself (finite, > 0) lives in engine submit()."""
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        if deadline_s is not None and self.max_deadline_s is not None:
+            deadline_s = min(float(deadline_s), self.max_deadline_s)
+        return deadline_s
+
     def _submit(self, prompt: list[int], max_tokens: Optional[int],
                 model: Optional[str] = None,
                 temperature: Optional[float] = None,
                 stop=None, logit_bias=None,
+                deadline_s: Optional[float] = None,
                 ) -> tuple[int, queue.Queue]:
+        self._shed_check()  # fast path: 429/503 without the engine lock
         q: queue.Queue = queue.Queue()
+        deadline_s = self._resolve_deadline(deadline_s)
         with self._work:
+            # Re-check under the lock: flags may have flipped while we
+            # waited for a decode step to finish.
             if self._engine_error is not None:
                 # The drive thread is dead; a submit would register a
                 # queue nothing will ever close.
                 raise EngineFailedError(self._engine_error)
-            if self._shutdown:
-                raise EngineFailedError("server is shutting down")
+            if self._draining or self._shutdown:
+                raise DrainingError("server is draining; retry elsewhere")
             if model is not None and model == self.model_name:
                 model = None  # the served base model, by its public name
             if model is not None:
@@ -312,16 +516,32 @@ class InferenceServer:
                 rid = self.engine.submit(
                     prompt, max_new_tokens=max_tokens, adapter=model,
                     temperature=temperature, stop=stop,
-                    logit_bias=logit_bias,
+                    logit_bias=logit_bias, deadline_s=deadline_s,
                 )
             else:
                 rid = self.engine.submit(prompt, max_new_tokens=max_tokens,
                                          temperature=temperature, stop=stop,
-                                         logit_bias=logit_bias)
+                                         logit_bias=logit_bias,
+                                         deadline_s=deadline_s)
             self._queues[rid] = q
             self._submit_ts[rid] = time.monotonic()
+            if self.metrics is not None:
+                self.metrics.serving_queue_depth.set(
+                    len(self.engine._queue)
+                )
             self._work.notify_all()
         return rid, q
+
+    def _cancel(self, rid: int, reason: str = "client disconnected") -> None:
+        """Disconnect/abandonment path: mark the request cancelled under
+        the engine lock. Queued requests abort immediately (on_abort
+        fires inline); slotted ones retire at their next _note_token —
+        within one engine step — instead of decoding dead work to full
+        budget. Idempotent; unknown rids are a no-op."""
+        with self._work:
+            if self._engine_error is None and not self._stopped:
+                self.engine.cancel(rid, reason)
+            self._work.notify_all()
 
     def _finish(self, rid: int) -> None:
         with self._lock:
@@ -372,11 +592,29 @@ class InferenceServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _retry_after_close(self, error: str,
+                                   retry_after: int = 1) -> None:
+                """Finish a shed/drain response: the status line was
+                already sent; add Retry-After (RFC 6585 for 429,
+                RFC 9110 for 503) and the JSON detail."""
+                body = json.dumps({"error": error}).encode()
+                self.send_header("Retry-After", str(retry_after))
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 if self.path == "/healthz":
                     if server._engine_error is not None:
                         self._json(503, {"status": "engine failed",
                                          "error": server._engine_error})
+                    elif server._draining:
+                        # Unready the INSTANT drain starts: the load
+                        # balancer must route around this replica while
+                        # in-flight requests finish, not after.
+                        self._json(503, {"status": "draining"})
                     else:
                         self._json(200, {"status": "ok"})
                 elif self.path == "/v1/models":
@@ -400,6 +638,10 @@ class InferenceServer:
                         ttft = list(server._ttft)
                         e2e = list(server._e2e)
                         tokens_out = server._tokens_out
+                        cancelled = server._cancelled
+                        deadline_expired = server._deadline_expired
+                    with server._shed_lock:
+                        shed = server._shed
                     up = (
                         time.monotonic() - server._started_at
                         if server._started_at is not None else 0.0
@@ -419,6 +661,13 @@ class InferenceServer:
                         ) if up > 0 else 0.0,
                         "ttft_s": _percentiles(ttft),
                         "e2e_latency_s": _percentiles(e2e),
+                        # Lifecycle counters (the tentpole's observables):
+                        "requests_shed": shed,
+                        "requests_cancelled": cancelled,
+                        "deadline_expired": deadline_expired,
+                        "max_queue_depth": server.max_queue_depth,
+                        "draining": server._draining,
+                        "drain_duration_s": server._drain_duration,
                     })
                 else:
                     self._json(404, {"error": "not found"})
@@ -428,8 +677,15 @@ class InferenceServer:
                     self._json(404, {"error": "not found"})
                     return
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(length) or b"{}")
+                    body = _read_body(self, server.max_body_bytes)
+                except BodyTooLarge as err:
+                    self._json(413, {"error": str(err)})
+                    return
+                except ValueError as err:
+                    self._json(400, {"error": str(err)})
+                    return
+                try:
+                    req = json.loads(body or b"{}")
                     prompt = server._decode_prompt(req.get("prompt"))
                     max_tokens = req.get("max_tokens")
                     if max_tokens is not None and (
@@ -461,6 +717,17 @@ class InferenceServer:
                             "logit_bias must be an object mapping token "
                             "ids to biases"
                         )
+                    deadline_s = req.get("deadline_s")
+                    if deadline_s is not None and (
+                        isinstance(deadline_s, bool)
+                        or not isinstance(deadline_s, (int, float))
+                        or not math.isfinite(deadline_s)
+                        or deadline_s <= 0
+                    ):
+                        raise ValueError(
+                            f"deadline_s must be a finite number > 0, "
+                            f"got {deadline_s!r}"
+                        )
                     stream = bool(req.get("stream", False))
                     if stream and n > 1:
                         raise ValueError("stream does not support n > 1")
@@ -486,7 +753,24 @@ class InferenceServer:
                             subs.append(server._submit(
                                 prompt, max_tokens, req.get("model"),
                                 temperature, stop, logit_bias,
+                                deadline_s,
                             ))
+                    except OverloadedError as err:
+                        # Shed mid-loop for n>1: already-submitted
+                        # choices are dead work — cancel them so the
+                        # engine never decodes for a response that will
+                        # never be written.
+                        for rid, _ in subs:
+                            server._cancel(rid, "sibling choice shed")
+                        self.send_response(429)
+                        self._retry_after_close(str(err))
+                        return
+                    except DrainingError as err:
+                        for rid, _ in subs:
+                            server._cancel(rid, "sibling choice refused")
+                        self.send_response(503)
+                        self._retry_after_close(str(err))
+                        return
                     except EngineFailedError as err:
                         self._json(503, {"error": str(err)})
                         return
@@ -506,27 +790,43 @@ class InferenceServer:
                 for idx, (rid, q) in enumerate(subs):
                     tokens = []
                     while True:
-                        item = q.get()
+                        try:
+                            # Timed get doubles as a disconnect poll: a
+                            # client that hung up while its request was
+                            # still queued/decoding would otherwise pin
+                            # a slot to full budget writing to nobody.
+                            item = q.get(timeout=0.25)
+                        except queue.Empty:
+                            if _client_gone(self.connection):
+                                for r, _ in subs:
+                                    server._cancel(r)
+                                return  # nobody to answer
+                            continue
                         if isinstance(item, (_Final, _Abort)):
                             break
                         tokens.append(item)
                     logprobs = []
+                    finish_reason = "stop"
                     if isinstance(item, _Final):
                         # Authoritative: a stop match truncated tokens
                         # the stream already delivered.
                         tokens = item.tokens
                         logprobs = item.logprobs
+                        finish_reason = item.finish_reason
                     # Drop the queue BEFORE writing: a client that has
                     # seen the response must be able to observe the
                     # server state already cleaned up (the finally stays
                     # as a safety net).
                     server._finish(rid)
                     if isinstance(item, _Abort):
-                        self._json(500, {"error": item.reason,
-                                         "partial_tokens": tokens})
+                        # Deadline expiry is the client's own budget
+                        # running out — 504, with whatever was decoded.
+                        code = 504 if item.reason == "deadline" else 500
+                        self._json(code, {"error": item.reason,
+                                          "partial_tokens": tokens})
                         return
                     choice = {"index": idx, "tokens": tokens,
-                              "finish_reason": "stop"}
+                              "finish_reason": finish_reason}
                     if want_logprobs:
                         choice["logprobs"] = {
                             "tokens": tokens,
@@ -550,34 +850,51 @@ class InferenceServer:
                 })
 
             def _stream(self, rid, q):
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.send_header("Cache-Control", "no-cache")
-                # Length-unknown: close delimits the body.
-                self.send_header("Connection", "close")
-                self.end_headers()
-                while True:
-                    item = q.get()
-                    if isinstance(item, (_Final, _Abort)):
-                        server._finish(rid)
-                        # An abort-truncated stream must be
-                        # distinguishable from a completed one.
-                        if isinstance(item, _Abort):
-                            self.wfile.write(
-                                b"data: " + json.dumps(
-                                    {"error": item.reason}
-                                ).encode() + b"\n\n"
-                            )
-                        self.wfile.write(b"data: [DONE]\n\n")
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    # Length-unknown: close delimits the body.
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    while True:
+                        item = q.get()
+                        # A write into a dead socket only fails once the
+                        # peer's RST round-trips, so a fast decode can
+                        # drain its whole budget into the send buffer
+                        # before EPIPE ever fires. Peek for the FIN
+                        # before each write instead — deterministic the
+                        # moment the client hangs up.
+                        if _client_gone(self.connection):
+                            server._cancel(rid)
+                            return
+                        if isinstance(item, (_Final, _Abort)):
+                            server._finish(rid)
+                            # An abort-truncated stream must be
+                            # distinguishable from a completed one.
+                            if isinstance(item, _Abort):
+                                self.wfile.write(
+                                    b"data: " + json.dumps(
+                                        {"error": item.reason}
+                                    ).encode() + b"\n\n"
+                                )
+                            self.wfile.write(b"data: [DONE]\n\n")
+                            self.wfile.flush()
+                            return
+                        payload = {"id": f"cmpl-{rid}", "token": item}
+                        text = server._text([item])
+                        if text is not None:
+                            payload["text"] = text
+                        self.wfile.write(
+                            b"data: " + json.dumps(payload).encode()
+                            + b"\n\n"
+                        )
                         self.wfile.flush()
-                        return
-                    payload = {"id": f"cmpl-{rid}", "token": item}
-                    text = server._text([item])
-                    if text is not None:
-                        payload["text"] = text
-                    self.wfile.write(
-                        b"data: " + json.dumps(payload).encode() + b"\n\n"
-                    )
-                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    # The peer hung up mid-stream. Without cancellation
+                    # the slot decodes to full budget for nobody — the
+                    # disconnect-storm failure mode. Cancel retires it
+                    # at the engine's next _note_token.
+                    server._cancel(rid)
 
         return Handler

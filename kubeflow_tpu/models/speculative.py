@@ -301,13 +301,14 @@ class _SpecServingBase:
     # -- public surface (delegated) ----------------------------------------
 
     def submit(self, prompt, max_new_tokens=None, temperature=None,
-               stop=None, logit_bias=None) -> int:
+               stop=None, logit_bias=None, deadline_s=None) -> int:
         # Delegated verbatim: the inner engine owns the greedy-only
         # temperature/logit_bias rejections, so library and HTTP callers
         # get the same ValueError.
         return self._engine.submit(prompt, max_new_tokens=max_new_tokens,
                                    temperature=temperature, stop=stop,
-                                   logit_bias=logit_bias)
+                                   logit_bias=logit_bias,
+                                   deadline_s=deadline_s)
 
     def run(self) -> dict:
         return self._engine.run()

@@ -27,6 +27,34 @@ from kubeflow_tpu.webhook import (
 )
 
 
+class FakeTokenController:
+    """kube-controller-manager token-controller analog: mints an
+    image-pull secret onto every ServiceAccount that lacks one — the
+    thing platform.py's pull-secret wait (reference
+    notebook_controller.go:155-186) polls for before releasing the
+    reconciliation lock."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def register(self, manager: Manager) -> None:
+        manager.register(self, for_kind="ServiceAccount",
+                         name="TokenController")
+
+    def reconcile(self, req):
+        from kubeflow_tpu.k8s.errors import NotFoundError
+        from kubeflow_tpu.k8s.manager import Result
+
+        try:
+            sa = self.client.get("ServiceAccount", req.name, req.namespace)
+        except NotFoundError:
+            return Result()
+        if not sa.get("imagePullSecrets"):
+            sa["imagePullSecrets"] = [{"name": f"{req.name}-dockercfg"}]
+            self.client.update(sa)
+        return Result()
+
+
 class FakeProber:
     """Scriptable ActivityProber."""
 
@@ -85,6 +113,7 @@ def make_env(
     cluster: Optional[k8s.FakeCluster] = None,
     controller_config: Optional[ControllerConfig] = None,
     recovery_config: Optional[RecoveryConfig] = None,
+    sa_pull_secrets: bool = True,
 ) -> Env:
     """Build a controller environment. Passing an existing ``cluster``
     simulates a controller-process restart: fresh manager/reconcilers/
@@ -104,6 +133,21 @@ def make_env(
             k8s.add_tpu_node_pool(
                 cluster, accel_label, topo, hosts=hosts, chips_per_host=chips
             )
+    if sa_pull_secrets:
+        # The namespace "default" SA with its pull secret already minted
+        # (pods without a template serviceAccountName run as it), plus a
+        # token controller for SAs created later (auth sidecar SAs) —
+        # platform.py holds the reconciliation lock until the pod SA
+        # carries an imagePullSecrets entry. Disable via
+        # sa_pull_secrets=False to observe the wait itself.
+        if not reuse and not cluster.exists("ServiceAccount", "default", "ns"):
+            cluster.create({
+                "apiVersion": "v1",
+                "kind": "ServiceAccount",
+                "metadata": {"name": "default", "namespace": "ns"},
+                "imagePullSecrets": [{"name": "default-dockercfg"}],
+            })
+        FakeTokenController(cluster).register(manager)
 
     # Controllers register before the kubelet: within one event batch they
     # dispatch first, so transient pod states (Failed → recreated) are

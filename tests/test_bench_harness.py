@@ -100,6 +100,25 @@ def test_cached_headline_prefers_most_recent_artifact(bench, tmp_path, monkeypat
     assert entry["value"] == 48.9
 
 
+def test_cached_headline_breaks_mtime_ties_by_round(bench, tmp_path, monkeypatch):
+    """A fresh checkout stamps every committed artifact with the same
+    mtime — the round suffix must then decide, so an older round never
+    shadows the live headline (VERDICT.md round 5)."""
+    old = [{"metric": "decode bf16 tokens/sec", "value": 10.0,
+            "unit": "tokens/sec/chip", "vs_baseline": 0.3}]
+    new = [{"metric": "decode bf16 tokens/sec", "value": 48.9,
+            "unit": "tokens/sec/chip", "vs_baseline": 1.6}]
+    (tmp_path / "BENCH_FULL_r03.json").write_text(json.dumps(old))
+    (tmp_path / "BENCH_FULL_r05_headline.json").write_text(json.dumps(new))
+    for name in ("BENCH_FULL_r03.json", "BENCH_FULL_r05_headline.json"):
+        os.utime(tmp_path / name, (1_000_000, 1_000_000))
+    bench.__file__ = str(tmp_path / "bench.py")
+    monkeypatch.chdir(tmp_path)
+    entry, src = bench._cached_headline()
+    assert src == "BENCH_FULL_r05_headline.json"
+    assert entry["value"] == 48.9
+
+
 def test_cached_headline_skips_corrupt_and_zero_artifacts(bench, tmp_path, monkeypatch):
     (tmp_path / "BENCH_FULL_bad.json").write_text("{not json")
     (tmp_path / "BENCH_FULL_zero.json").write_text(

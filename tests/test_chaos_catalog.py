@@ -40,6 +40,11 @@ def test_catalog_has_reference_parity_experiments():
         "capacity-withheld-warm-pool",
         "capacity-withheld-no-pool",
         "apiserver-flap-mid-escalation",
+        # Serving request-lifecycle (models/server.py): dead clients,
+        # overload shedding, and engine-thread crash containment.
+        "serving-disconnect-storm",
+        "serving-overload",
+        "serving-engine-stall",
     }
 
 

@@ -31,6 +31,34 @@ class TestLifecycle:
         assert env.cluster.get("StatefulSet", "nb", "ns")["spec"]["replicas"] == 4
         assert nb["status"]["tpu"]["sliceHealth"] == "Healthy"
 
+    def test_lock_held_until_pull_secret_minted(self):
+        """Reference notebook_controller.go:155-186: the lock must not
+        release before the pod ServiceAccount carries its image-pull
+        secret — releasing early races the registry pull against the
+        token controller and lands in ImagePullBackOff."""
+        env = make_platform_env(sa_pull_secrets=False)
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        # No "default" SA with a pull secret exists: lock stays held and
+        # the slice stays stopped.
+        assert nb["metadata"]["annotations"][ann.STOP] == (
+            ann.RECONCILIATION_LOCK_VALUE
+        )
+        assert env.cluster.get("StatefulSet", "nb", "ns")["spec"]["replicas"] == 0
+
+        # Token controller catches up: SA appears with its pull secret.
+        env.cluster.create({
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {"name": "default", "namespace": "ns"},
+            "imagePullSecrets": [{"name": "default-dockercfg"}],
+        })
+        env.manager.tick(3.0)  # fire the pull-secret requeue
+        nb = env.cluster.get("Notebook", "nb", "ns")
+        assert ann.STOP not in nb["metadata"].get("annotations", {})
+        assert env.cluster.get("StatefulSet", "nb", "ns")["spec"]["replicas"] == 4
+
     def test_user_stop_annotation_survives_platform_reconcile(self):
         env = make_platform_env()
         env.cluster.create(tpu_notebook())

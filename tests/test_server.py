@@ -211,7 +211,9 @@ class TestRobustness:
         blocked on q.get() would otherwise hang its client forever."""
         import time
 
-        srv = InferenceServer(_engine(), port=0)
+        # Tiny drain budget: this test wants the force-abort path, not
+        # a graceful drain of the deliberately-frozen engine.
+        srv = InferenceServer(_engine(), port=0, drain_s=0.2)
         srv.start()
         # Freeze the engine so the request stays in flight.
         frozen = threading.Event()

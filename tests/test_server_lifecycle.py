@@ -1,0 +1,536 @@
+"""Request-lifecycle robustness (models/server.py + _BatcherBase):
+admission control / shedding, per-request deadlines, disconnect
+cancellation, graceful drain, and engine-crash containment.
+
+Determinism strategy: overload tests stall the engine with a no-op step
+(the queue can only grow), deadline tests inject a counting fake clock
+into the engine, and the crash test parks two waiters before the step
+raises — no sleeps standing in for synchronization.
+"""
+
+import http.client
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.models.serving import GenerationConfig
+from kubeflow_tpu.models.server import InferenceServer
+
+from tests.test_server import _engine, _get, _post
+
+
+def _post_status(port, payload, timeout=60.0):
+    """(status, body, headers) — 4xx/5xx are outcomes under test."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as err:
+        body = json.loads(err.read() or b"{}")
+        return err.code, body, dict(err.headers)
+
+
+def _stall(srv):
+    """Replace the engine step with a keep-alive no-op: admitted work
+    parks in its slot, everything else piles into the pending queue.
+    Returns an Event; set() restores real decoding."""
+    lifted = threading.Event()
+    real_step = srv.engine._step
+
+    def stalled_step():
+        if not lifted.is_set():
+            time.sleep(0.005)
+            return
+        real_step()
+
+    srv.engine._step = stalled_step
+    return lifted
+
+
+def _fill(srv, depth, accepted):
+    """Deterministically occupy one slot + ``depth`` queue entries on a
+    stalled single-slot server: each background POST is confirmed
+    admitted/queued before the next starts, so no admission race can
+    over- or undershoot the fill."""
+    threads = []
+
+    def accept_post():
+        accepted.append(_post_status(
+            srv.port, {"prompt": [1, 2, 3], "max_tokens": 2}
+        ))
+
+    deadline = time.monotonic() + 30
+    t = threading.Thread(target=accept_post, daemon=True)
+    t.start()
+    threads.append(t)
+    while (not any(r is not None for r in srv.engine._by_slot)
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    for i in range(depth):
+        t = threading.Thread(target=accept_post, daemon=True)
+        t.start()
+        threads.append(t)
+        while (len(srv.engine._queue) <= i
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+    assert len(srv.engine._queue) == depth, "fill never completed"
+    return threads
+
+
+class TestAdmissionControl:
+    def test_queue_full_sheds_429_fast_with_exact_counter(self):
+        srv = InferenceServer(
+            _engine(slots=1), port=0, max_queue_depth=2
+        )
+        lifted = _stall(srv)
+        srv.start()
+        try:
+            accepted = []
+            threads = _fill(srv, depth=2, accepted=accepted)
+            latencies = []
+            for _ in range(3):
+                t0 = time.monotonic()
+                code, body, headers = _post_status(
+                    srv.port, {"prompt": [1, 2, 3], "max_tokens": 2}
+                )
+                latencies.append(time.monotonic() - t0)
+                assert code == 429
+                assert headers.get("Retry-After") == "1"
+                assert "full" in body["error"]
+            # The shed path takes no engine lock: even with the engine
+            # mid-"step", a full queue answers within the 50ms budget.
+            assert max(latencies) < 0.05, latencies
+            assert srv._shed == 3
+            stats = _get(srv.port, "/stats")
+            assert stats["requests_shed"] == 3
+            assert stats["max_queue_depth"] == 2
+
+            lifted.set()  # parked work must complete untouched
+            for t in threads:
+                t.join(timeout=60)
+            assert [c for c, _, _ in accepted] == [200, 200, 200]
+            assert srv._shed == 3  # sheds counted exactly, no drift
+        finally:
+            lifted.set()
+            srv.stop()
+
+    def test_concurrent_submits_shed_exactly(self):
+        srv = InferenceServer(
+            _engine(slots=1), port=0, max_queue_depth=2
+        )
+        lifted = _stall(srv)
+        srv.start()
+        try:
+            accepted = []
+            fill_threads = _fill(srv, depth=2, accepted=accepted)
+            results = []
+            lock = threading.Lock()
+
+            def shed_post():
+                out = _post_status(
+                    srv.port, {"prompt": [1, 2, 3], "max_tokens": 2}
+                )
+                with lock:
+                    results.append(out[0])
+
+            storm = [threading.Thread(target=shed_post, daemon=True)
+                     for _ in range(8)]
+            for t in storm:
+                t.start()
+            for t in storm:
+                t.join(timeout=30)
+            assert results == [429] * 8
+            assert srv._shed == 8
+            lifted.set()
+            for t in fill_threads:
+                t.join(timeout=60)
+            assert [c for c, _, _ in accepted] == [200, 200, 200]
+        finally:
+            lifted.set()
+            srv.stop()
+
+    def test_oversized_body_is_413(self):
+        srv = InferenceServer(_engine(), port=0, max_body_bytes=256)
+        srv.start()
+        try:
+            code, body, _ = _post_status(
+                srv.port, {"prompt": list(range(1000))}
+            )
+            assert code == 413
+            assert "exceeds" in body["error"]
+            # A within-limit request still serves.
+            code, _, _ = _post_status(
+                srv.port, {"prompt": [1, 2], "max_tokens": 2}
+            )
+            assert code == 200
+        finally:
+            srv.stop()
+
+    def test_bad_deadline_is_400(self):
+        srv = InferenceServer(_engine(), port=0)
+        srv.start()
+        try:
+            for bad in (-1, 0, "soon", True, float("nan")):
+                code, _, _ = _post_status(
+                    srv.port, {"prompt": [1], "deadline_s": bad}
+                )
+                assert code == 400, bad
+        finally:
+            srv.stop()
+
+
+class TestDeadlines:
+    def test_deadline_expiry_mid_decode_engine_side(self):
+        """Fake clock advancing 1s per observation: a 3.5s deadline
+        yields ~3 tokens, then the slot is retired through the abort
+        path — never left decoding past its deadline."""
+        eng = _engine(gen=GenerationConfig(max_new_tokens=64))
+        t = [0.0]
+
+        def clk():
+            t[0] += 1.0
+            return t[0]
+
+        eng._clock = clk
+        rid = eng.submit([1, 2, 3], deadline_s=3.5)
+        results = eng.run()
+        aborted = eng.run_aborted()
+        assert aborted == {rid: "deadline"}
+        assert 1 <= len(results[rid]) <= 4  # partial, not full budget
+        assert all(r is None for r in eng._by_slot)  # slot reclaimed
+        # The engine is healthy for the next request.
+        rid2 = eng.submit([1, 2, 3])
+        assert len(eng.run()[rid2]) > 0
+
+    def test_expired_deadline_is_504_with_partials(self):
+        srv = InferenceServer(_engine(), port=0)
+        srv.start()
+        try:
+            code, body, _ = _post_status(
+                srv.port, {"prompt": [1, 2, 3], "deadline_s": 1e-6}
+            )
+            assert code == 504
+            assert body["error"] == "deadline"
+            assert "partial_tokens" in body
+            assert srv._deadline_expired == 1
+            assert _get(srv.port, "/stats")["deadline_expired"] == 1
+            # Slot reclaimed; server still serves.
+            out = _post(srv.port, {"prompt": [1, 2], "max_tokens": 2})
+            assert len(out["choices"][0]["tokens"]) == 2
+        finally:
+            srv.stop()
+
+    def test_default_deadline_applies_when_client_sends_none(self):
+        srv = InferenceServer(_engine(), port=0, default_deadline_s=1e-6)
+        srv.start()
+        try:
+            code, body, _ = _post_status(srv.port, {"prompt": [1, 2, 3]})
+            assert code == 504
+            assert body["error"] == "deadline"
+        finally:
+            srv.stop()
+
+    def test_max_deadline_clamps_client_request(self):
+        srv = InferenceServer(_engine(), port=0, max_deadline_s=1e-6)
+        srv.start()
+        try:
+            code, body, _ = _post_status(
+                srv.port, {"prompt": [1, 2, 3], "deadline_s": 3600.0}
+            )
+            assert code == 504
+        finally:
+            srv.stop()
+
+    def test_engine_rejects_bad_deadlines(self):
+        eng = _engine()
+        for bad in (0, -1.0, float("inf"), float("nan"), True, "x"):
+            with pytest.raises((ValueError, TypeError)):
+                eng.submit([1], deadline_s=bad)
+
+
+class TestDisconnectCancellation:
+    def test_disconnect_storm_reclaims_every_slot(self):
+        """Acceptance: N streaming clients hang up after their first
+        token; the engine converges to zero busy slots with the
+        cancelled counter matching the storm size exactly."""
+        clients = 4
+        # Budget far past what decodes before the FIN registers (a
+        # couple of writes): the request must still be mid-decode when
+        # the broken pipe cancels it, or there is nothing to reclaim.
+        srv = InferenceServer(
+            _engine(gen=GenerationConfig(max_new_tokens=100)), port=0
+        )
+        srv.start()
+        try:
+            conns = []
+            for _ in range(clients):
+                c = http.client.HTTPConnection(
+                    "127.0.0.1", srv.port, timeout=30
+                )
+                c.request(
+                    "POST", "/v1/completions",
+                    json.dumps({"prompt": [1, 2, 3], "stream": True}),
+                    {"Content-Type": "application/json"},
+                )
+                conns.append(c)
+            for c in conns:
+                resp = c.getresponse()
+                while True:  # first token, then hang up without warning
+                    line = resp.fp.readline()
+                    if not line or line.startswith(b"data:"):
+                        break
+                # Connection: close responses own the socket; closing
+                # the response sends FIN mid-stream — the abrupt
+                # disconnect under test.
+                resp.close()
+                c.close()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                with srv._lock:
+                    busy = (
+                        any(r is not None for r in srv.engine._by_slot)
+                        or bool(srv.engine._queue)
+                        or getattr(srv.engine, "_admitting", None)
+                        is not None
+                    )
+                    cancelled = srv._cancelled
+                if not busy and cancelled == clients:
+                    break
+                time.sleep(0.01)
+            assert not busy, "slots still decoding dead work"
+            assert cancelled == clients  # counter matches exactly
+            assert srv._engine_error is None
+            assert _get(srv.port, "/stats")["requests_cancelled"] == clients
+            # The freed capacity serves a live client immediately.
+            out = _post(srv.port, {"prompt": [1, 2], "max_tokens": 2})
+            assert len(out["choices"][0]["tokens"]) == 2
+        finally:
+            srv.stop()
+
+    def test_gone_nonstream_client_cancels_queued_request(self):
+        """A non-stream client that disconnects before the response is
+        detected by the completion poll and its request cancelled."""
+        srv = InferenceServer(_engine(slots=1), port=0)
+        lifted = _stall(srv)
+        srv.start()
+        try:
+            raw = socket.create_connection(("127.0.0.1", srv.port),
+                                           timeout=10)
+            payload = json.dumps({"prompt": [1, 2, 3]}).encode()
+            raw.sendall(
+                b"POST /v1/completions HTTP/1.1\r\n"
+                b"Host: x\r\nContent-Type: application/json\r\n"
+                + f"Content-Length: {len(payload)}\r\n\r\n".encode()
+                + payload
+            )
+            deadline = time.monotonic() + 30
+            while not srv._queues and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert srv._queues, "request never registered"
+            raw.close()  # client gone while the engine is stalled
+            # The poll marks it cancelled engine-side (slotted: marked
+            # for the next step; still queued: aborted immediately)...
+            while (not srv.engine._cancelled and srv._cancelled < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert srv.engine._cancelled or srv._cancelled >= 1, (
+                "disconnect never detected"
+            )
+            # ...and the next step (stall lifted) reclaims the slot.
+            lifted.set()
+            while ((any(r is not None for r in srv.engine._by_slot)
+                    or srv._cancelled < 1)
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert all(r is None for r in srv.engine._by_slot)
+            assert srv._cancelled == 1
+        finally:
+            lifted.set()
+            srv.stop()
+
+    def test_engine_cancel_semantics(self):
+        eng = _engine()
+        rid_q = eng.submit([1, 2, 3])
+        assert eng.cancel(rid_q, "test") is True  # queued: immediate
+        assert eng.run_aborted() == {}  # not yet run
+        assert not eng._queue
+        assert eng.cancel(999) is False  # unknown rid
+        rid2 = eng.submit([1, 2, 3])
+        out = eng.run()
+        assert rid_q not in out or out[rid_q] == []
+        assert len(out[rid2]) > 0
+
+
+class TestGracefulDrain:
+    def test_healthz_unready_the_moment_drain_starts(self):
+        srv = InferenceServer(_engine(), port=0)
+        srv.start()
+        try:
+            assert _get(srv.port, "/healthz")["status"] == "ok"
+            srv._draining = True
+            try:
+                _get(srv.port, "/healthz")
+                assert False, "expected 503"
+            except urllib.error.HTTPError as err:
+                assert err.code == 503
+                assert json.loads(err.read())["status"] == "draining"
+        finally:
+            srv._draining = False
+            srv.stop()
+
+    def test_drain_rejects_new_force_aborts_stragglers(self):
+        srv = InferenceServer(_engine(slots=1), port=0, drain_s=0.4)
+        lifted = _stall(srv)
+        srv.start()
+        straggler = {}
+
+        def call():
+            straggler["out"] = _post_status(
+                srv.port, {"prompt": [1, 2, 3]}, timeout=60
+            )
+
+        t = threading.Thread(target=call, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 30
+        while not srv._queues and time.monotonic() < deadline:
+            time.sleep(0.005)
+        stopper = threading.Thread(target=srv.stop, daemon=True)
+        stopper.start()
+        while not srv._draining and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # New arrivals during the drain window: 503 + Retry-After.
+        code, body, headers = _post_status(
+            srv.port, {"prompt": [1]}, timeout=10
+        )
+        assert code == 503
+        assert headers.get("Retry-After") == "1"
+        assert "draining" in body["error"]
+        stopper.join(timeout=30)
+        t.join(timeout=30)
+        # The straggler was force-aborted as an ERROR, not a completion.
+        assert straggler["out"][0] == 500
+        assert "shutdown" in straggler["out"][1]["error"]
+        assert srv._drain_duration is not None
+        assert srv._drain_duration >= 0.4  # waited the full window
+
+    def test_drain_lets_inflight_finish(self):
+        srv = InferenceServer(_engine(), port=0, drain_s=30.0)
+        srv.start()
+        result = {}
+
+        def call():
+            result["out"] = _post_status(
+                srv.port, {"prompt": [1, 2, 3]}, timeout=60
+            )
+
+        t = threading.Thread(target=call, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 30
+        while not srv._queues and time.monotonic() < deadline:
+            time.sleep(0.002)
+        srv.stop()
+        t.join(timeout=30)
+        assert result["out"][0] == 200
+        assert len(result["out"][1]["choices"][0]["tokens"]) == 8
+        assert srv._drain_duration is not None
+        assert srv._drain_duration < 30.0  # finished, not timed out
+
+    def test_stop_is_idempotent(self):
+        srv = InferenceServer(_engine(), port=0)
+        srv.start()
+        srv.stop()
+        srv.stop()  # second call must be a no-op, not an error
+
+
+class TestEngineCrashContainment:
+    def test_crash_aborts_all_waiting_queues_with_cause(self):
+        srv = InferenceServer(_engine(slots=1), port=0)
+        both_in = threading.Event()
+
+        def crashing_step():
+            if not both_in.wait(timeout=0.01):
+                return  # keep parking until both waiters registered
+            raise RuntimeError("device exploded")
+
+        srv.engine._step = crashing_step
+        srv.start()
+        results = []
+        lock = threading.Lock()
+
+        def call():
+            out = _post_status(srv.port, {"prompt": [1, 2, 3]}, timeout=30)
+            with lock:
+                results.append(out)
+
+        threads = [threading.Thread(target=call, daemon=True)
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30
+        while len(srv._queues) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(srv._queues) == 2, "waiters never parked"
+        both_in.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == 2
+        for code, body, _ in results:
+            assert code == 500
+            assert "device exploded" in body["error"]
+        # healthz reports the cause; new submits refuse with 503.
+        try:
+            _get(srv.port, "/healthz")
+            assert False, "expected 503"
+        except urllib.error.HTTPError as err:
+            assert err.code == 503
+            health = json.loads(err.read())
+            assert health["status"] == "engine failed"
+            assert "device exploded" in health["error"]
+        code, body, _ = _post_status(srv.port, {"prompt": [1]}, timeout=10)
+        assert code == 503
+        assert "device exploded" in body["error"]
+        srv.stop()
+
+
+class TestFinishReason:
+    def test_budget_truncation_reports_length(self):
+        srv = InferenceServer(_engine(), port=0)
+        srv.start()
+        try:
+            out = _post(srv.port, {"prompt": [1, 2, 3], "max_tokens": 3})
+            assert out["choices"][0]["finish_reason"] == "length"
+            # The engine-wide budget (8) truncating also reads "length".
+            out = _post(srv.port, {"prompt": [1, 2, 3]})
+            assert out["choices"][0]["finish_reason"] == "length"
+        finally:
+            srv.stop()
+
+    def test_stop_sequence_reports_stop(self):
+        eng = _engine()
+        rid = eng.submit([1, 2, 3, 4])
+        full = eng.run()[rid]
+        assert len(full) >= 4
+        srv = InferenceServer(_engine(), port=0)
+        srv.start()
+        try:
+            out = _post(srv.port, {
+                "prompt": [1, 2, 3, 4], "stop": full[2:4],
+            })
+            got = out["choices"][0]["tokens"]
+            # Truncated at (and excluding) the first stop match — with a
+            # degenerate greedy continuation that can be earlier than
+            # position 2, so assert the prefix property, not the index.
+            assert got == full[:len(got)]
+            assert len(got) < len(full)
+            assert out["choices"][0]["finish_reason"] == "stop"
+        finally:
+            srv.stop()
