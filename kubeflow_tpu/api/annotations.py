@@ -86,6 +86,21 @@ PROFILING_ENV_NAME = "KUBEFLOW_TPU_PROFILING_PORT"
 # annotations may not claim the SAME port on one notebook.
 TPU_SERVING_PORT = "notebooks.kubeflow.org/tpu-serving-port"
 SERVING_ENV_NAME = "KUBEFLOW_TPU_SERVING_PORT"
+# Checkpoint durability contract (runtime/checkpoint.py). The grace
+# annotation is seconds of termination grace the notebook wants for an
+# emergency checkpoint on SIGTERM: the webhook projects it into
+# TPU_CHECKPOINT_GRACE_S (bootstrap.install_preemption_handler budgets the
+# final save with it) AND sizes the pod template's
+# terminationGracePeriodSeconds (deploy.manifests.termination_grace_seconds
+# adds the kill-path margin) so the kubelet actually waits that long.
+TPU_CHECKPOINT_GRACE = "notebooks.kubeflow.org/tpu-checkpoint-grace-seconds"
+CHECKPOINT_GRACE_ENV_NAME = "TPU_CHECKPOINT_GRACE_S"
+# Where the checkpoint PVC is mounted inside the workbench container; the
+# webhook always projects it for TPU notebooks (annotation overrides the
+# default) so runtime code never hardcodes a path.
+TPU_CHECKPOINT_DIR = "notebooks.kubeflow.org/tpu-checkpoint-dir"
+CHECKPOINT_DIR_ENV_NAME = "KUBEFLOW_TPU_CHECKPOINT_DIR"
+DEFAULT_CHECKPOINT_DIR = "/mnt/checkpoints"
 
 
 def _load_reserved_ports() -> dict:
@@ -139,6 +154,20 @@ def parse_profiling_port(value) -> "int | None":
     except (TypeError, ValueError):
         return None
     return port if 1024 <= port <= 65535 else None
+
+def parse_checkpoint_grace(value) -> "int | None":
+    """THE one parser for the checkpoint-grace annotation (webhook env
+    projection, terminationGracePeriodSeconds sizing, escalation-ladder
+    messaging, in-pod bootstrap all share it): whole seconds in 1..3600,
+    else None. The ceiling keeps a typo'd value from pinning a slice's
+    nodes for hours after a delete; int() not isdigit() for the same
+    Unicode-digit reason as parse_profiling_port."""
+    try:
+        grace = int(str(value).strip())
+    except (TypeError, ValueError):
+        return None
+    return grace if 1 <= grace <= 3600 else None
+
 
 # -- labels ------------------------------------------------------------------
 NOTEBOOK_NAME_LABEL = "notebook-name"

@@ -175,12 +175,13 @@ class SliceHealthReconciler(Reconciler):
         failed = [(p, reason) for p, reason in failed if reason]
 
         if failed:
+            state_note = _checkpoint_state_note(nb)
             for pod, reason in failed:
                 self.metrics.slice_preemptions_total.inc()
                 self.recorder.eventf(
                     obj, "Warning", "SliceInterrupted",
                     f"Host pod {obj_util.name_of(pod)} lost ({reason}); "
-                    "recreating — in-notebook JAX state is gone",
+                    f"recreating — {state_note}",
                 )
                 # Delete so the STS/kubelet recreates the host pod.
                 try:
@@ -282,6 +283,7 @@ class SliceHealthReconciler(Reconciler):
         """One escalation step: warm-pool claim, else STS recreate."""
         from kubeflow_tpu.controller.notebook import slice_sts_names
         from kubeflow_tpu.controller.slicepool import claim_warm_slice
+        from kubeflow_tpu.deploy.manifests import termination_grace_seconds
 
         attempt = escalations + 1
         topo = nb.tpu.slice_topology()
@@ -305,11 +307,23 @@ class SliceHealthReconciler(Reconciler):
                     self.client.delete("StatefulSet", name, nb.namespace)
                 except NotFoundError:
                     pass
+            # An STS recreate TERMINATES the surviving healthy hosts too:
+            # say up front how long the kubelet will wait for their
+            # emergency checkpoints, so the event explains the extra
+            # teardown latency the ladder just signed up for.
+            grace = ann.parse_checkpoint_grace(
+                nb.annotations.get(ann.TPU_CHECKPOINT_GRACE)
+            )
+            grace_note = (
+                f"; surviving hosts get {termination_grace_seconds(grace)}s "
+                "termination grace for an emergency checkpoint"
+                if grace is not None else ""
+            )
             self.recorder.eventf(
                 obj, "Warning", "SliceRecoveryEscalated",
                 "Recovery deadline exceeded and no warm slice available; "
                 f"recreating StatefulSet(s) {', '.join(names)} for fresh "
-                f"placement (escalation {attempt})",
+                f"placement (escalation {attempt}){grace_note}",
             )
         self.metrics.slice_recovery_escalations_total.inc()
         log.warning(
@@ -429,6 +443,27 @@ class SliceHealthReconciler(Reconciler):
                 self.client.update(fresh)
 
         retry_on_conflict(write)
+
+
+def _checkpoint_state_note(nb: Notebook) -> str:
+    """How much in-notebook state the interruption cost, for the
+    SliceInterrupted event: with the checkpoint-grace annotation the pod
+    had a SIGTERM emergency-save window (runtime/checkpoint.py), so the
+    message points at the resumable checkpoint instead of declaring the
+    state gone."""
+    grace = ann.parse_checkpoint_grace(
+        nb.annotations.get(ann.TPU_CHECKPOINT_GRACE)
+    )
+    if grace is None:
+        return "in-notebook JAX state is gone"
+    ckpt_dir = (
+        nb.annotations.get(ann.TPU_CHECKPOINT_DIR, "").strip()
+        or ann.DEFAULT_CHECKPOINT_DIR
+    )
+    return (
+        f"resume from the emergency checkpoint in {ckpt_dir} "
+        f"(pod had {grace}s SIGTERM grace)"
+    )
 
 
 def _pod_to_notebook(ev) -> list[Request]:

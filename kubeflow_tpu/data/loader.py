@@ -289,6 +289,7 @@ def sharded_loader(
     seed: int = 1,
     process_id: Optional[int] = None,
     num_processes: Optional[int] = None,
+    start_batch: int = 0,
     **kwargs,
 ) -> TokenLoader:
     """Per-host loader for multi-host training: each process loads ONLY
@@ -300,9 +301,13 @@ def sharded_loader(
     into one global jax.Array laid out over the mesh — the host never
     materializes (and DCN never moves) the full global batch.
 
-    ``start_batch=`` (forwarded) makes checkpoint resume exact: a run
-    restored at step k skips the k batches the lost run consumed instead
-    of re-reading them.
+    ``start_batch`` makes checkpoint resume EXACT: pass
+    ``runtime.checkpoint.resume_start_batch(ckpt, at)`` after
+    ``restore_latest`` and every host skips precisely the batches the
+    lost run consumed (the cursor is a GLOBAL batch index — each host's
+    xorshift stream advances by the same count, so per-host streams stay
+    aligned and cross-host rows stay disjoint; nothing is replayed or
+    skipped).
     """
     import jax
 
@@ -317,7 +322,8 @@ def sharded_loader(
     # Keep the mixed seed nonzero (xorshift fixed point) and in int range.
     mixed = (mixed % ((1 << 63) - 1)) or 1
     return TokenLoader(
-        path, global_batch // num, seq, seed=mixed, **kwargs
+        path, global_batch // num, seq, seed=mixed,
+        start_batch=start_batch, **kwargs
     )
 
 

@@ -760,7 +760,13 @@ def sample_tpu_notebook() -> dict:
         "metadata": {
             "name": "sample-tpu-notebook",
             "namespace": "default",
-            "annotations": {"notebooks.opendatahub.io/inject-auth": "true"},
+            "annotations": {
+                "notebooks.opendatahub.io/inject-auth": "true",
+                # 60s of SIGTERM grace for an emergency checkpoint; the
+                # webhook projects TPU_CHECKPOINT_GRACE_S and sizes
+                # terminationGracePeriodSeconds from this.
+                "notebooks.kubeflow.org/tpu-checkpoint-grace-seconds": "60",
+            },
         },
         "spec": {
             "template": {
@@ -787,3 +793,26 @@ def sample_tpu_notebook() -> dict:
 
 def max_notebook_name_length() -> int:
     return MAX_NAME_LENGTH
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint grace-period sizing
+
+# Headroom added on top of the annotation's emergency-save budget when
+# sizing terminationGracePeriodSeconds: container runtime teardown, PVC
+# flush, and the process's own shutdown hooks all eat into the kubelet's
+# window, and the emergency save must get the WHOLE budget the user asked
+# for — otherwise the webhook's env contract promises time the kubelet
+# never grants.
+CHECKPOINT_FLUSH_MARGIN_S = 30
+# The Kubernetes default; used when no grace annotation is present.
+DEFAULT_TERMINATION_GRACE_S = 30
+
+
+def termination_grace_seconds(grace: "int | None") -> int:
+    """terminationGracePeriodSeconds for a notebook pod whose emergency
+    checkpoint budget is ``grace`` seconds (parse_checkpoint_grace output;
+    None means the annotation is absent/invalid → Kubernetes default)."""
+    if grace is None:
+        return DEFAULT_TERMINATION_GRACE_S
+    return int(grace) + CHECKPOINT_FLUSH_MARGIN_S

@@ -53,6 +53,15 @@ INJECTION_TYPES = (
     "serving-disconnect-storm",
     "serving-overload",
     "serving-engine-stall",
+    # Checkpoint durability coverage (runtime/checkpoint.py): a crash in
+    # the middle of an async save, bit-rot/truncation of the newest step,
+    # and a disk that fills mid-training. Each must leave training
+    # RESUMABLE from the newest valid step with zero loss-curve
+    # divergence — a torn or silently-wrong "latest" is the one outcome
+    # the atomic-commit protocol exists to forbid.
+    "checkpoint-kill-mid-save",
+    "checkpoint-restore-corrupt",
+    "checkpoint-disk-full",
 )
 STEADY_STATE_CHECKS = (
     "sliceReady", "notCulled", "notebookCreatable", "warmPoolReady",
@@ -64,6 +73,12 @@ STEADY_STATE_CHECKS = (
     # Serving: no slot (or queue entry) still holds work for a client
     # that is gone — the disconnect-storm invariant.
     "slotsReclaimed",
+    # Checkpoint: the newest COMMITTED step re-validates (manifest sizes +
+    # checksums) after the injection.
+    "checkpointValid",
+    # Checkpoint: a restore + continued training reproduces the
+    # uninterrupted run's loss curve exactly.
+    "trainingResumed",
 )
 # Injection ↔ target coherence: a doc must declare the kind its handler
 # actually exercises, or a "pass" certifies a hypothesis that never ran.
@@ -80,6 +95,9 @@ TARGET_KIND_FOR_INJECTION = {
     "serving-disconnect-storm": "InferenceServer",
     "serving-overload": "InferenceServer",
     "serving-engine-stall": "InferenceServer",
+    "checkpoint-kill-mid-save": "CheckpointManager",
+    "checkpoint-restore-corrupt": "CheckpointManager",
+    "checkpoint-disk-full": "CheckpointManager",
 }
 
 
@@ -187,6 +205,38 @@ def _default_serving_factory(**kw):
     return InferenceServer(engine, port=0, **kw)
 
 
+_TINY_TRAINER = None
+
+
+def _default_training_factory():
+    """Deterministic tiny-llama trainer for the checkpoint-* experiments
+    (models.train.make_tiny_trainer). Lazy jax import for the same reason
+    as the serving factory, and memoized: the three checkpoint handlers
+    share one jitted step so the catalog does not recompile per run (the
+    trainer is stateless — each handler builds fresh states from it)."""
+    global _TINY_TRAINER
+    if _TINY_TRAINER is None:
+        from kubeflow_tpu.models.train import make_tiny_trainer
+
+        _TINY_TRAINER = make_tiny_trainer()
+    return _TINY_TRAINER
+
+
+def _counter_value(counter) -> float:
+    """Current value of a prometheus Counter via its public collect()."""
+    for metric in counter.collect():
+        for sample in metric.samples:
+            if sample.name.endswith("_total"):
+                return sample.value
+    return 0.0
+
+
+class _SimulatedCrash(Exception):
+    """Raised by fault-injecting CheckpointIO to model a SIGKILL landing
+    mid-save: save() deliberately does NOT catch it (only OSError), so the
+    staging dir is left exactly as a dead process would leave it."""
+
+
 def _serving_post(port: int, payload: dict, timeout: float = 60.0):
     """(status, body) for a completions POST — HTTPError is an outcome
     here (429/503/500 are the behaviors under test), not an exception."""
@@ -226,7 +276,8 @@ class ExperimentRunner:
 
     def __init__(self, env_factory: Callable[..., object],
                  notebook_factory: Callable[..., dict],
-                 serving_factory: Callable[..., object] = None):
+                 serving_factory: Callable[..., object] = None,
+                 training_factory: Callable[..., object] = None):
         self.env_factory = env_factory
         self.notebook_factory = notebook_factory
         # serving_factory(**knobs) -> an UNstarted models/server.py
@@ -234,6 +285,9 @@ class ExperimentRunner:
         # start/stop it per experiment. Defaults to a tiny CPU model so
         # the catalog stays executable without the caller wiring one.
         self.serving_factory = serving_factory or _default_serving_factory
+        # training_factory() -> (step_fn, fresh_state, batches) for the
+        # checkpoint-* handlers; defaults to the shared tiny trainer.
+        self.training_factory = training_factory or _default_training_factory
         self._handlers: dict[str, Callable[[dict], ExperimentResult]] = {
             "pod-kill": self._run_pod_kill,
             "network-partition": self._run_network_partition,
@@ -247,6 +301,9 @@ class ExperimentRunner:
             "serving-disconnect-storm": self._run_serving_disconnect_storm,
             "serving-overload": self._run_serving_overload,
             "serving-engine-stall": self._run_serving_engine_stall,
+            "checkpoint-kill-mid-save": self._run_checkpoint_kill_mid_save,
+            "checkpoint-restore-corrupt": self._run_checkpoint_restore_corrupt,
+            "checkpoint-disk-full": self._run_checkpoint_disk_full,
         }
 
     def run(self, doc: dict) -> ExperimentResult:
@@ -898,3 +955,239 @@ class ExperimentRunner:
             )
         finally:
             srv.stop()
+
+    # -- checkpoint durability handlers ------------------------------------
+
+    @staticmethod
+    def _losses(step_fn, state, batches):
+        """Drive the trainer, returning (state, [float loss per step]).
+        float() synchronizes each step, so the curve is comparable
+        bit-for-bit across runs of the same compiled executable."""
+        out = []
+        for batch in batches:
+            state, loss = step_fn(state, batch)
+            out.append(float(loss))
+        return state, out
+
+    def _checkpoint_resume_result(
+        self, doc: dict, workdir: Path, expect_step: int,
+        expect_corrupt: int, ref_losses: list,
+        extra_ok: bool = True, extra_detail: str = "",
+        extra_observations: dict = None,
+    ) -> ExperimentResult:
+        """The restart half shared by every checkpoint experiment: a FRESH
+        manager (new 'process') must restore the newest step that
+        VALIDATES — quarantining exactly ``expect_corrupt`` others — and
+        training continued from it must reproduce the uninterrupted loss
+        curve exactly (checkpointValid + trainingResumed)."""
+        from kubeflow_tpu.metrics import Metrics
+        from kubeflow_tpu.runtime import checkpoint as ck
+
+        step_fn, fresh_state, batches = self.training_factory()
+        metrics = Metrics()
+        mgr = ck.CheckpointManager(workdir, max_to_keep=10, metrics=metrics)
+        # Restore into a DIFFERENT init (key 7): matching losses below can
+        # only come from the checkpoint bytes, not a lucky same-seed init.
+        restored, at = mgr.restore_latest(fresh_state(7))
+        counted = _counter_value(metrics.checkpoint_corrupt_total)
+        quarantined = [
+            p.name for p in workdir.iterdir()
+            if p.name.startswith(ck.CORRUPT_PREFIX)
+        ]
+        if at is None:
+            resumed_losses = []
+        else:
+            _, resumed_losses = self._losses(step_fn, restored, batches[at:])
+        curve_ok = at == expect_step and resumed_losses == ref_losses[at:]
+        passed = (
+            curve_ok
+            and counted == expect_corrupt
+            and len(quarantined) == expect_corrupt
+            and extra_ok
+        )
+        return ExperimentResult(
+            doc["metadata"]["name"],
+            passed=passed,
+            detail="" if passed else (
+                f"restored_step={at} (want {expect_step}) "
+                f"corrupt_counter={counted} quarantined={quarantined} "
+                f"(want {expect_corrupt}) resumed={resumed_losses} "
+                f"ref_tail={ref_losses[expect_step:]} {extra_detail}"
+            ),
+            observations={
+                "restored_step": at,
+                "quarantined": quarantined,
+                "resumed_losses": resumed_losses,
+                **(extra_observations or {}),
+            },
+        )
+
+    def _run_checkpoint_kill_mid_save(self, doc: dict) -> ExperimentResult:
+        """SIGKILL lands mid-save: the IO layer dies between file writes
+        (save() contains only OSError, so _SimulatedCrash abandons the
+        staging dir exactly as a dead process would). The previously
+        committed step must stay the restorable latest — the torn staging
+        dir is invisible to restore — and the resumed loss curve must
+        match the uninterrupted run's exactly."""
+        import shutil
+        import tempfile
+
+        from kubeflow_tpu.runtime import checkpoint as ck
+
+        params = doc["spec"]["injection"].get("params", {})
+        kill_step = int(params.get("killAtStep", 3))
+        files_before_kill = int(params.get("filesBeforeKill", 2))
+        step_fn, fresh_state, batches = self.training_factory()
+        _, ref_losses = self._losses(step_fn, fresh_state(0), batches)
+
+        class KillerIO(ck.CheckpointIO):
+            armed = False
+            writes = 0
+
+            def write_file(self, path, data):
+                if self.armed:
+                    self.writes += 1
+                    if self.writes > files_before_kill:
+                        raise _SimulatedCrash(f"died writing {path.name}")
+                super().write_file(path, data)
+
+        workdir = Path(tempfile.mkdtemp(prefix="chaos-ckpt-kill-"))
+        try:
+            io = KillerIO()
+            mgr = ck.CheckpointManager(workdir, max_to_keep=10, io=io)
+            state = fresh_state(0)
+            crashed = False
+            for i, batch in enumerate(batches):
+                state, _ = step_fn(state, batch)
+                if i + 1 == kill_step:
+                    io.armed = True
+                try:
+                    mgr.save(i + 1, state)
+                except _SimulatedCrash:
+                    crashed = True
+                    break
+            torn = [
+                p.name for p in workdir.iterdir()
+                if p.name.startswith(".tmp-")
+            ]
+            return self._checkpoint_resume_result(
+                doc, workdir,
+                expect_step=kill_step - 1, expect_corrupt=0,
+                ref_losses=ref_losses,
+                # The injection must actually have fired AND left a torn
+                # staging dir, or the hypothesis never ran.
+                extra_ok=crashed and bool(torn),
+                extra_detail=f"crashed={crashed} torn={torn}",
+                extra_observations={"torn_staging_dirs": torn},
+            )
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    def _run_checkpoint_restore_corrupt(self, doc: dict) -> ExperimentResult:
+        """Bit-rot or truncation on the newest committed step. Restore
+        must catch it against the manifest (CRC32 / size), quarantine the
+        step as corrupt-<step>-* with tpu_checkpoint_corrupt_total
+        incremented, fall back to the previous valid step, and resume with
+        zero loss-curve divergence."""
+        import shutil
+        import tempfile
+
+        from kubeflow_tpu.runtime import checkpoint as ck
+
+        params = doc["spec"]["injection"].get("params", {})
+        mode = str(params.get("corruption", "bitflip"))
+        step_fn, fresh_state, batches = self.training_factory()
+        _, ref_losses = self._losses(step_fn, fresh_state(0), batches)
+        workdir = Path(tempfile.mkdtemp(prefix="chaos-ckpt-rot-"))
+        try:
+            mgr = ck.CheckpointManager(workdir, max_to_keep=10)
+            state = fresh_state(0)
+            for i, batch in enumerate(batches):
+                state, _ = step_fn(state, batch)
+                mgr.save(i + 1, state)
+            newest = workdir / str(len(batches))
+            victim = sorted(newest.glob("*.bin"))[0]
+            blob = bytearray(victim.read_bytes())
+            if mode == "truncate":
+                victim.write_bytes(bytes(blob[:-8]))
+            else:
+                blob[len(blob) // 2] ^= 0xFF
+                victim.write_bytes(bytes(blob))
+            return self._checkpoint_resume_result(
+                doc, workdir,
+                expect_step=len(batches) - 1, expect_corrupt=1,
+                ref_losses=ref_losses,
+                extra_detail=f"corruption={mode} victim={victim.name}",
+            )
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    def _run_checkpoint_disk_full(self, doc: dict) -> ExperimentResult:
+        """The checkpoint volume fills mid-training (ENOSPC from the IO
+        layer). Saves must FAIL CLEANLY — counted, staging dirs removed,
+        training uninterrupted, last good step still restorable — and once
+        space returns the very next (emergency) save must commit: failure
+        history must not wedge the manager."""
+        import errno
+        import shutil
+        import tempfile
+
+        from kubeflow_tpu.runtime import checkpoint as ck
+
+        params = doc["spec"]["injection"].get("params", {})
+        full_from = int(params.get("fullFromStep", 3))
+        step_fn, fresh_state, batches = self.training_factory()
+        _, ref_losses = self._losses(step_fn, fresh_state(0), batches)
+        workdir = Path(tempfile.mkdtemp(prefix="chaos-ckpt-enospc-"))
+        try:
+
+            class FullDiskIO(ck.CheckpointIO):
+                full = False
+
+                def write_file(self, path, data):
+                    if self.full:
+                        raise OSError(errno.ENOSPC, "No space left on device")
+                    super().write_file(path, data)
+
+            io = FullDiskIO()
+            mgr = ck.CheckpointManager(workdir, max_to_keep=10, io=io)
+            state = fresh_state(0)
+            for i, batch in enumerate(batches):
+                state, _ = step_fn(state, batch)
+                if i + 1 == full_from:
+                    io.full = True
+                mgr.save(i + 1, state)
+            failures = mgr.save_failures
+            stray = [
+                p.name for p in workdir.iterdir()
+                if p.name.startswith(".tmp-")
+            ]
+            result = self._checkpoint_resume_result(
+                doc, workdir,
+                expect_step=full_from - 1, expect_corrupt=0,
+                ref_losses=ref_losses,
+                extra_ok=(
+                    failures == len(batches) - full_from + 1 and not stray
+                ),
+                extra_detail=f"save_failures={failures} stray_tmp={stray}",
+                extra_observations={"save_failures": failures},
+            )
+            # Space comes back: the manager's emergency path must flush the
+            # newest pending state on the first try.
+            io.full = False
+            recovered = (
+                mgr.emergency_save() and mgr.latest_step() == len(batches)
+            )
+            if not recovered:
+                return ExperimentResult(
+                    doc["metadata"]["name"], passed=False,
+                    detail=(
+                        "save did not recover after ENOSPC lifted "
+                        f"(latest={mgr.latest_step()}); prior: "
+                        f"{result.detail or 'resume ok'}"
+                    ),
+                    observations=result.observations,
+                )
+            return result
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)

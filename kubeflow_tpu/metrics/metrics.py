@@ -121,6 +121,27 @@ class Metrics:
             "TPU nodes the image pre-puller is maintaining pods for",
             registry=self.registry,
         )
+        # -- checkpoint durability (runtime/checkpoint.py) -----------------
+        # Exposed from the notebook runtime when the manager is built with
+        # metrics=; save duration feeds the emergency-save budget heuristic
+        # (a save slower than the grace window is skipped, not torn).
+        self.checkpoint_save_seconds = Histogram(
+            "tpu_checkpoint_save_seconds",
+            "Wall-clock duration of committed checkpoint saves",
+            buckets=(0.1, 0.5, 1, 2, 5, 10, 20, 30, 60, 120, 300),
+            registry=self.registry,
+        )
+        self.checkpoint_corrupt_total = Counter(
+            "tpu_checkpoint_corrupt_total",
+            "Checkpoint steps that failed manifest validation and were "
+            "quarantined at restore",
+            registry=self.registry,
+        )
+        self.checkpoint_emergency_total = Counter(
+            "tpu_checkpoint_emergency_total",
+            "Emergency (SIGTERM grace-window) checkpoint saves committed",
+            registry=self.registry,
+        )
         # -- serving request lifecycle (models/server.py) ------------------
         # The InferenceServer mirrors its /stats lifecycle counters here
         # when constructed with metrics=; shed/cancel/deadline rates are

@@ -323,6 +323,36 @@ def evaluate_perplexity(params: dict, cfg: LlamaConfig, batches) -> dict:
     return {"nll": nll, "perplexity": float(jnp.exp(nll)), "tokens": count}
 
 
+def make_tiny_trainer(steps: int = 4, batch: int = 2, seq: int = 16,
+                      seed: int = 0):
+    """Deterministic single-device tiny-llama trainer for durability/chaos
+    tests: ``(step_fn, fresh_state, batches)`` where ``fresh_state(key)``
+    builds a sharded init state and ``batches`` is a fixed token list.
+    Rebuilding with the same seed reproduces the exact run — which is what
+    lets checkpoint experiments assert ZERO loss-curve divergence between
+    an interrupted-and-resumed run and an uninterrupted one.
+    """
+    from kubeflow_tpu.models import llama as L
+    from kubeflow_tpu.parallel.mesh import make_mesh
+
+    plan = MeshPlan(make_mesh(devices=jax.devices()[:1]))
+    cfg = L.LLAMA_CONFIGS["tiny"]
+    init_state, step_fn = make_train_step(cfg, plan)
+
+    def fresh_state(key: int = 0):
+        params = L.init_params(cfg, jax.random.PRNGKey(key))
+        return shard_state(plan, init_state(params))
+
+    batches = [
+        jax.random.randint(
+            jax.random.PRNGKey(seed * 1000 + 100 + i),
+            (batch, seq), 0, cfg.vocab_size,
+        )
+        for i in range(steps)
+    ]
+    return step_fn, fresh_state, batches
+
+
 def shard_state(plan: MeshPlan, state: dict) -> dict:
     """Place params + optimizer state onto the mesh per the plan."""
     def place(path, value):

@@ -223,3 +223,85 @@ def maybe_start_profiler_server(env: Optional[dict] = None) -> Optional[int]:
     jax.profiler.start_server(port)
     _PROFILER_PORT = port
     return port
+
+
+# -- preemption-grace emergency checkpointing --------------------------------
+#
+# The other half of the webhook's checkpoint contract: the controller's
+# escalation ladder (or GKE maintenance) kills a host with SIGTERM and waits
+# terminationGracePeriodSeconds before SIGKILL. The webhook told us how much
+# of that window is ours (TPU_CHECKPOINT_GRACE_S) and where checkpoints live
+# (KUBEFLOW_TPU_CHECKPOINT_DIR); this wires a SIGTERM handler that spends
+# the budget on ONE final synchronous save — or nothing, when a fresh save
+# already exists or could not finish in time.
+
+
+def checkpoint_dir_from_env(env: Optional[dict] = None) -> Optional[str]:
+    """The webhook-projected checkpoint directory, or None off-platform."""
+    from kubeflow_tpu.api.annotations import CHECKPOINT_DIR_ENV_NAME
+
+    env = dict(os.environ) if env is None else env
+    return env.get(CHECKPOINT_DIR_ENV_NAME) or None
+
+
+def checkpoint_grace_from_env(env: Optional[dict] = None) -> Optional[int]:
+    """The emergency-save grace budget in seconds, or None when the
+    annotation was absent (same parser as admission: a value that would
+    have been denied is treated as unset, never honored half-way)."""
+    from kubeflow_tpu.api.annotations import (
+        CHECKPOINT_GRACE_ENV_NAME,
+        parse_checkpoint_grace,
+    )
+
+    env = dict(os.environ) if env is None else env
+    value = env.get(CHECKPOINT_GRACE_ENV_NAME, "")
+    return parse_checkpoint_grace(value) if value else None
+
+
+def install_preemption_handler(
+    ckpt,
+    env: Optional[dict] = None,
+    signum: Optional[int] = None,
+):
+    """Install a SIGTERM handler that runs ``ckpt.emergency_save`` with the
+    webhook-injected grace budget, then chains to the previously-installed
+    disposition (a notebook kernel's own SIGTERM handling must still run —
+    we borrow the signal, we don't own it).
+
+    Returns an ``uninstall()`` callable restoring the previous handler.
+    Must run on the main thread (Python signal API restriction); the
+    handler itself is re-entrancy-safe because CheckpointManager guards the
+    commit protocol with an RLock.
+    """
+    import signal
+
+    signum = signal.SIGTERM if signum is None else signum
+    grace = checkpoint_grace_from_env(env)
+    previous = signal.getsignal(signum)
+
+    def handle(received_signum, frame):
+        try:
+            ckpt.emergency_save(grace_s=grace)
+        except Exception:
+            # The exit path must keep exiting: a save bug cannot be allowed
+            # to swallow the termination signal.
+            log.exception("emergency checkpoint save failed")
+        if callable(previous):
+            previous(received_signum, frame)
+        elif previous is signal.SIG_DFL:
+            signal.signal(received_signum, signal.SIG_DFL)
+            signal.raise_signal(received_signum)
+        # SIG_IGN: the process had opted out of dying on this signal;
+        # honor that — we only added the save, not a new exit.
+
+    signal.signal(signum, handle)
+    log.info(
+        "installed emergency-checkpoint handler (signal %d, grace %s)",
+        signum, f"{grace}s" if grace is not None else "unbounded",
+    )
+
+    def uninstall():
+        if signal.getsignal(signum) is handle:
+            signal.signal(signum, previous)
+
+    return uninstall

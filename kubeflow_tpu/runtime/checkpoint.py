@@ -1,38 +1,126 @@
-"""In-notebook checkpoint/resume: the other half of preemption recovery.
+"""Crash-safe in-notebook checkpointing: the durability half of preemption
+recovery.
 
-The control plane recovers the *slice* (SliceHealthReconciler recreates
-preempted host pods), but in-notebook JAX state dies with the pod. This
-module closes the loop: periodic sharded checkpoints via orbax, so a
-notebook cell can resume training after a preemption with
+The control plane recovers the *slice* (SliceHealthReconciler's escalation
+ladder recreates preempted host pods), but in-notebook JAX state dies with
+the pod. This module makes the on-disk training state survive every way a
+notebook pod actually dies:
 
-    state, step = ckpt.restore_latest(state)
+- **Atomic commit.** Each step is written into a ``.tmp-*`` staging dir
+  with a manifest recording per-file sizes + CRC32 checksums, every byte is
+  fsynced, and only then is the staging dir renamed over the final
+  ``<step>/`` name (``CheckpointIO.commit`` — the single place a rename is
+  allowed, enforced by the ``kftpu-unfsynced-rename`` semgrep rule). A pod
+  SIGKILLed mid-save leaves a ``.tmp-*`` turd that restore never looks at;
+  it can never leave a torn "latest".
+- **Validated restore with quarantine.** ``restore_latest`` walks committed
+  steps newest-first, re-verifies the manifest (sizes + checksums), moves
+  anything torn or bit-rotted aside as ``corrupt-<step>-*`` (counted by
+  ``tpu_checkpoint_corrupt_total``), and falls back to the newest step that
+  still verifies instead of crashing on the newest directory.
+- **Deadline-bounded emergency save.** ``emergency_save`` is the SIGTERM
+  path (runtime.bootstrap.install_preemption_handler): one final
+  synchronous save sized to the pod's grace budget, skipped when a fresh
+  save already exists or the last observed save duration would blow the
+  budget — half a checkpoint helps nobody.
+- **Exact resume.** ``train_with_checkpointing`` records the data-loader
+  cursor (``{"start_batch": step}``) in each save's metadata;
+  ``restore_latest`` surfaces it via ``restored_metadata`` /
+  ``resume_start_batch`` so ``data.loader.sharded_loader(start_batch=...)``
+  replays nothing and skips nothing.
 
-The reference has no counterpart — its checkpoint story is "all state lives
-in CR annotations / PVCs" (SURVEY.md §5 checkpoint/resume); for an ML-facing
-platform the training state is the state that matters, and a PVC mount is
-exactly where these checkpoints land.
-
-TPU notes: orbax writes each shard from its owning host (multi-host safe,
-single-controller semantics via jax.distributed), and restore places shards
-per the provided sharding tree — no host ever materializes the full model.
+The format is plain numpy-bytes + JSON — no orbax dependency, so the
+save/restore path has no library between it and the fsyncs it promises.
+ml_dtypes dtypes (bfloat16, int4, fp8) round-trip exactly: leaves are
+serialized with ``tobytes()`` and revived via ``np.frombuffer`` with the
+dtype *name* from the manifest. jax is imported lazily (tree flatten /
+device placement only), so constructing a manager and validating
+checkpoints needs no accelerator stack.
 """
 
 from __future__ import annotations
 
+import json
+import logging
+import os
+import queue
+import shutil
+import threading
+import time
+import zlib
 from pathlib import Path
 from typing import Any, Optional
 
-import jax
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_FORMAT = 1
+# Staging dirs start with "." so ``<step>``.isdigit() scans never see them;
+# quarantine keeps the step number visible for the operator but breaks the
+# isdigit() match the same way.
+_TMP_PREFIX = ".tmp-"
+CORRUPT_PREFIX = "corrupt-"
+
+
+class CorruptCheckpointError(Exception):
+    """A committed step directory failed manifest validation."""
+
+
+class CheckpointIO:
+    """The file-IO seam of the commit protocol.
+
+    Split out so chaos experiments can inject faults (ENOSPC, a crash
+    between file writes) without touching the manager's policy logic.
+    Durability ordering is: file bytes fsynced → manifest fsynced →
+    staging dir fsynced → rename → parent dir fsynced. Only after the
+    final fsync is the step durably visible under its committed name.
+    """
+
+    def write_file(self, path: Path, data: bytes) -> None:
+        with open(path, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def fsync_dir(self, path: Path) -> None:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def commit(self, staged: Path, final: Path) -> None:
+        """Atomically publish ``staged`` as ``final``.
+
+        The ONE place checkpoint code may rename (semgrep
+        kftpu-unfsynced-rename pins this): the staged dir is fsynced so
+        its entries are durable BEFORE the rename makes them reachable,
+        and the parent is fsynced after so the rename itself is durable.
+        """
+        self.fsync_dir(staged)
+        os.replace(staged, final)
+        self.fsync_dir(final.parent)
 
 
 class CheckpointManager:
-    """Thin policy wrapper over orbax CheckpointManager.
+    """Durable checkpoint policy: atomic saves, validated restores.
 
     - ``save(step, state)`` honors ``save_interval_steps`` (returns whether
       a save actually happened) and keeps ``max_to_keep`` checkpoints.
+      With ``async_save=True`` the state is snapshotted to host memory
+      synchronously (safe with donated buffers) and written by a worker
+      thread; ``wait()`` joins the queue.
+    - Save *failures* (ENOSPC, quota) are contained: the staging dir is
+      removed, ``save_failures``/``last_save_error`` record the outcome,
+      training continues, and the previous committed step stays valid.
     - ``restore_latest(template)`` restores into the template's shardings
       (pass the freshly-sharded init state; arrays land where the mesh
-      says, not on host 0).
+      says, not on host 0), quarantining any step that fails validation.
+    - ``emergency_save(grace_s)`` is the preemption path: one synchronous
+      save of the newest state handed to ``save()``, skipped when already
+      committed or when it cannot finish inside the grace budget.
     """
 
     def __init__(
@@ -40,47 +128,381 @@ class CheckpointManager:
         directory: str | Path,
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
+        async_save: bool = False,
+        metrics: Any = None,
+        io: Optional[CheckpointIO] = None,
     ):
-        import orbax.checkpoint as ocp
-
-        self._ocp = ocp
         self.directory = Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
-        self._mgr = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
-                save_interval_steps=save_interval_steps,
-                enable_async_checkpointing=False,
-            ),
-        )
+        self.max_to_keep = max(1, int(max_to_keep))
+        self.save_interval_steps = max(1, int(save_interval_steps))
+        self.io = io or CheckpointIO()
+        self.metrics = metrics
+        # Metadata dict of the step restore_latest() last returned.
+        self.restored_metadata: dict = {}
+        self.last_save_error: Optional[BaseException] = None
+        self.save_failures = 0
+        # RLock: a SIGTERM handler may call emergency_save while the SAME
+        # (main) thread is inside a synchronous save.
+        self._lock = threading.RLock()
+        self._seq = 0  # staging-dir uniquifier (reentrant saves)
+        self._last_saved_step: Optional[int] = None  # interval gate
+        self._last_committed_step: Optional[int] = self.latest_step()
+        self._last_save_duration: Optional[float] = None
+        # Newest (step, host_leaves, treedef-free paths, metadata) handed to
+        # save(), committed or not — what emergency_save flushes.
+        self._pending: Optional[tuple] = None
+        self._async = bool(async_save)
+        self._queue: Optional[queue.Queue] = None
+        self._worker: Optional[threading.Thread] = None
 
-    def save(self, step: int, state: Any, force: bool = False) -> bool:
-        saved = self._mgr.save(
-            step,
-            args=self._ocp.args.StandardSave(state),
-            force=force,
-        )
-        return bool(saved)
+    # -- save ----------------------------------------------------------------
 
-    def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+    def save(
+        self,
+        step: int,
+        state: Any,
+        force: bool = False,
+        metadata: Optional[dict] = None,
+    ) -> bool:
+        """Persist ``state`` as ``step`` per policy; returns whether a save
+        was enqueued (async) or durably committed (sync). The state is
+        snapshotted to host memory before this returns, so callers may
+        donate/overwrite the device buffers."""
+        step = int(step)
+        meta = dict(metadata or {})
+        snapshot = _snapshot_to_host(state)
+        # Remember the newest state even when the interval skips it: an
+        # emergency save must flush what training last produced, not what
+        # the cadence last chose to keep.
+        self._pending = (step, snapshot, meta)
+        # Orbax-compatible cadence: steps that are multiples of the
+        # interval commit (plus the very first call, so short runs are
+        # never checkpoint-less); everything else is interval-skipped.
+        if (
+            not force
+            and self._last_saved_step is not None
+            and step % self.save_interval_steps != 0
+        ):
+            return False
+        self._last_saved_step = step
+        if self._async:
+            self._ensure_worker()
+            self._queue.put((step, snapshot, meta))
+            return True
+        with self._lock:
+            return self._write_step(step, snapshot, meta)
 
-    def restore_latest(self, template: Any) -> tuple[Any, Optional[int]]:
-        """(state, step) from the newest checkpoint, or (template, None)."""
-        step = self._mgr.latest_step()
-        if step is None:
-            return template, None
-        restored = self._mgr.restore(
-            step, args=self._ocp.args.StandardRestore(template)
-        )
-        return restored, step
+    def emergency_save(self, grace_s: Optional[float] = None) -> bool:
+        """One final synchronous save inside a termination grace budget.
+
+        Returns True only if a new step was durably committed. Skips (and
+        returns False) when there is nothing newer than the last committed
+        step, or when ``grace_s`` minus the time spent draining in-flight
+        saves is smaller than the last observed save duration — starting a
+        save that SIGKILL will tear only wastes the budget.
+        """
+        t0 = time.monotonic()
+        try:
+            self.wait()
+        except Exception:  # a failing async save must not block the exit path
+            log.exception("emergency save: draining pending saves failed")
+        pending = self._pending
+        if pending is None:
+            log.info("emergency save: no state has been handed to save()")
+            return False
+        step, snapshot, meta = pending
+        if self._last_committed_step == step:
+            log.info(
+                "emergency save: step %d already durably committed; skipping",
+                step,
+            )
+            return False
+        if grace_s is not None:
+            remaining = float(grace_s) - (time.monotonic() - t0)
+            estimate = self._last_save_duration
+            if remaining <= 0 or (estimate is not None and estimate > remaining):
+                log.error(
+                    "emergency save: skipping step %d — estimated save "
+                    "duration %s exceeds remaining grace budget %.2fs",
+                    step,
+                    f"{estimate:.2f}s" if estimate is not None else "unknown",
+                    max(0.0, remaining),
+                )
+                return False
+        with self._lock:
+            ok = self._write_step(step, snapshot, meta)
+        if ok:
+            self._last_saved_step = step
+            counter = getattr(self.metrics, "checkpoint_emergency_total", None)
+            if counter is not None:
+                counter.inc()
+            log.warning(
+                "emergency save: committed step %d in %.2fs",
+                step,
+                time.monotonic() - t0,
+            )
+        return ok
+
+    def _write_step(self, step: int, snapshot: list, meta: dict) -> bool:
+        """The atomic commit protocol; returns whether ``step`` committed.
+        OSError (disk full, quota, permissions) is contained — training
+        must outlive a sick disk — everything else propagates."""
+        t0 = time.monotonic()
+        final = self.directory / str(step)
+        with self._lock:
+            self._seq += 1
+            staged = self.directory / (
+                f"{_TMP_PREFIX}{step}-{os.getpid()}-{self._seq}"
+            )
+        try:
+            if staged.exists():
+                shutil.rmtree(staged)
+            staged.mkdir(parents=True)
+            files = []
+            for i, (path_str, arr) in enumerate(snapshot):
+                name = f"{i:05d}.bin"
+                data = arr.tobytes()
+                self.io.write_file(staged / name, data)
+                files.append({
+                    "name": name,
+                    "path": path_str,
+                    "dtype": arr.dtype.name,
+                    "shape": list(arr.shape),
+                    "size": len(data),
+                    "crc32": zlib.crc32(data) & 0xFFFFFFFF,
+                })
+            manifest = {
+                "format": MANIFEST_FORMAT,
+                "step": step,
+                "metadata": meta,
+                "files": files,
+            }
+            # Manifest written LAST: its presence certifies every data file
+            # above already hit the disk (write_file fsyncs each).
+            self.io.write_file(
+                staged / MANIFEST_NAME,
+                json.dumps(manifest, sort_keys=True).encode(),
+            )
+            if final.exists():  # re-saving a step (re-run notebook cell)
+                shutil.rmtree(final)
+            self.io.commit(staged, final)
+        except OSError as err:
+            self.last_save_error = err
+            self.save_failures += 1
+            log.error("checkpoint save of step %d failed: %s", step, err)
+            shutil.rmtree(staged, ignore_errors=True)
+            return False
+        duration = time.monotonic() - t0
+        self._last_save_duration = duration
+        self._last_committed_step = step
+        hist = getattr(self.metrics, "checkpoint_save_seconds", None)
+        if hist is not None:
+            hist.observe(duration)
+        self._prune()
+        return True
+
+    def _prune(self) -> None:
+        for s in self._committed_steps()[: -self.max_to_keep]:
+            shutil.rmtree(self.directory / str(s), ignore_errors=True)
+
+    # -- async worker --------------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None:
+            self._queue = queue.Queue()
+            self._worker = threading.Thread(
+                target=self._drain, name="checkpoint-save", daemon=True
+            )
+            self._worker.start()
+
+    def _drain(self) -> None:
+        while True:
+            item = self._queue.get()
+            try:
+                if item is None:
+                    return
+                step, snapshot, meta = item
+                with self._lock:
+                    self._write_step(step, snapshot, meta)
+            finally:
+                self._queue.task_done()
 
     def wait(self) -> None:
-        self._mgr.wait_until_finished()
+        """Block until every enqueued async save has committed or failed."""
+        if self._queue is not None:
+            self._queue.join()
 
     def close(self) -> None:
-        self._mgr.close()
+        self.wait()
+        if self._worker is not None:
+            self._queue.put(None)
+            self._worker.join()
+            self._worker = None
+            self._queue = None
+
+    # -- restore -------------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        """Newest committed step (manifest present). Cheap — full
+        size/checksum validation happens at restore."""
+        steps = self._committed_steps()
+        return steps[-1] if steps else None
+
+    def _committed_steps(self) -> list:
+        return sorted(
+            int(p.name)
+            for p in self.directory.iterdir()
+            if p.is_dir() and p.name.isdigit() and (p / MANIFEST_NAME).exists()
+        )
+
+    def restore_latest(self, template: Any) -> tuple:
+        """(state, step) from the newest checkpoint that VALIDATES, or
+        (template, None). Steps failing validation are quarantined as
+        ``corrupt-<step>-*`` (never deleted: torn bytes are evidence) and
+        the walk falls back to the next-newest step. The restored step's
+        metadata lands in ``self.restored_metadata``."""
+        self.restored_metadata = {}
+        candidates = sorted(
+            (
+                int(p.name)
+                for p in self.directory.iterdir()
+                if p.is_dir() and p.name.isdigit()
+            ),
+            reverse=True,
+        )
+        for step in candidates:
+            step_dir = self.directory / str(step)
+            try:
+                arrays, meta = _load_validated(step_dir)
+            except CorruptCheckpointError as err:
+                self._quarantine(step_dir, step, err)
+                continue
+            state = _restore_into_template(template, arrays, step_dir)
+            self.restored_metadata = meta
+            self._last_committed_step = step
+            return state, step
+        return template, None
+
+    def _quarantine(
+        self, step_dir: Path, step: int, err: CorruptCheckpointError
+    ) -> None:
+        with self._lock:
+            self._seq += 1
+            dest = self.directory / f"{CORRUPT_PREFIX}{step}-{self._seq}"
+            while dest.exists():
+                self._seq += 1
+                dest = self.directory / f"{CORRUPT_PREFIX}{step}-{self._seq}"
+        log.error(
+            "checkpoint step %d failed validation (%s); quarantined as %s",
+            step, err, dest.name,
+        )
+        # commit() (not a bare rename): quarantine is also a publication —
+        # after a crash the torn step must be durably OUT of the restore
+        # path, not resurrected by a lost rename.
+        self.io.commit(step_dir, dest)
+        counter = getattr(self.metrics, "checkpoint_corrupt_total", None)
+        if counter is not None:
+            counter.inc()
+
+
+# -- serialization helpers ---------------------------------------------------
+
+
+def _tree_util():
+    import jax  # lazy: validation/repair tooling must not need a backend
+
+    return jax.tree_util
+
+
+def _snapshot_to_host(state: Any) -> list:
+    """[(keypath_str, np.ndarray), ...] in tree-flatten order. np.asarray
+    materializes jax arrays on host (ml_dtypes views included) and leaves
+    numpy leaves alone; the copy makes donation/overwrite safe."""
+    tu = _tree_util()
+    leaves_with_paths, _ = tu.tree_flatten_with_path(state)
+    return [
+        (tu.keystr(path), np.asarray(leaf))
+        for path, leaf in leaves_with_paths
+    ]
+
+
+def _load_validated(step_dir: Path) -> tuple:
+    """(arrays, metadata) for a committed step, re-verifying sizes and
+    CRC32s against the manifest. Raises CorruptCheckpointError on ANY
+    mismatch — a checkpoint is valid entirely or not at all."""
+    manifest_path = step_dir / MANIFEST_NAME
+    if not manifest_path.exists():
+        raise CorruptCheckpointError("manifest missing")
+    try:
+        manifest = json.loads(manifest_path.read_text())
+    except (OSError, ValueError) as err:
+        raise CorruptCheckpointError(f"manifest unreadable: {err}") from err
+    if manifest.get("format") != MANIFEST_FORMAT:
+        raise CorruptCheckpointError(
+            f"unknown manifest format {manifest.get('format')!r}"
+        )
+    arrays = []
+    for entry in manifest.get("files", []):
+        fpath = step_dir / entry["name"]
+        try:
+            data = fpath.read_bytes()
+        except OSError as err:
+            raise CorruptCheckpointError(
+                f"{entry['name']} unreadable: {err}"
+            ) from err
+        if len(data) != entry["size"]:
+            raise CorruptCheckpointError(
+                f"{entry['name']}: size {len(data)} != manifest {entry['size']}"
+            )
+        if (zlib.crc32(data) & 0xFFFFFFFF) != entry["crc32"]:
+            raise CorruptCheckpointError(f"{entry['name']}: CRC32 mismatch")
+        arr = np.frombuffer(data, dtype=np.dtype(entry["dtype"]))
+        arrays.append((entry["path"], arr.reshape(entry["shape"])))
+    return arrays, dict(manifest.get("metadata", {}))
+
+
+def _restore_into_template(template: Any, arrays: list, step_dir: Path) -> Any:
+    """Rebuild the state tree, placing each array per the template leaf's
+    sharding. Structure mismatch is a caller error (wrong template), not
+    corruption — it raises ValueError and quarantines nothing."""
+    tu = _tree_util()
+    leaves_with_paths, treedef = tu.tree_flatten_with_path(template)
+    if len(leaves_with_paths) != len(arrays):
+        raise ValueError(
+            f"template has {len(leaves_with_paths)} leaves but checkpoint "
+            f"{step_dir.name} stored {len(arrays)} — restoring into a "
+            "different model/optimizer structure?"
+        )
+    placed = []
+    for (path, leaf), (saved_path, arr) in zip(leaves_with_paths, arrays):
+        key = tu.keystr(path)
+        if key != saved_path:
+            raise ValueError(
+                f"template leaf {key} does not match checkpoint leaf "
+                f"{saved_path} in {step_dir.name}"
+            )
+        if hasattr(leaf, "sharding"):
+            import jax
+
+            placed.append(jax.device_put(arr, leaf.sharding))
+        else:
+            placed.append(arr)
+    return tu.tree_unflatten(treedef, placed)
+
+
+# -- training loop -----------------------------------------------------------
+
+
+def resume_start_batch(ckpt: CheckpointManager, restored_step=None) -> int:
+    """The data-loader cursor to hand ``sharded_loader(start_batch=...)``
+    after ``restore_latest``: the ``start_batch`` the restored step's save
+    recorded, falling back to the restored step itself (the
+    train_with_checkpointing convention is one batch per step)."""
+    value = ckpt.restored_metadata.get("start_batch")
+    if value is not None:
+        return int(value)
+    return int(restored_step or 0)
 
 
 def train_with_checkpointing(
@@ -89,21 +511,31 @@ def train_with_checkpointing(
     batches,
     ckpt: CheckpointManager,
     start_step: int = 0,
-) -> tuple[Any, list]:
+) -> tuple:
     """Drive ``state, loss = step_fn(state, batch)`` over ``batches``,
     checkpointing per the manager's policy. Returns (state, losses).
 
-    Resumable: pass ``start_step`` = the restored step (saves are labeled
-    ``start_step + 1, start_step + 2, ...``) and the batch iterator
-    fast-forwarded past the ``start_step`` batches already consumed.
+    Resumable EXACTLY: each save carries ``{"start_batch": step}`` so a
+    restored run knows how many batches the lost run consumed — feed
+    ``resume_start_batch(ckpt, at)`` to ``sharded_loader(start_batch=...)``
+    and pass ``start_step=at``; no batch is replayed or skipped.
+
+    ``ckpt.wait()`` runs in a finally: an exception mid-loop (OOM, a NaN
+    guard, KeyboardInterrupt) must not strand enqueued async saves, and an
+    empty ``batches`` iterator is a no-op, not an IndexError.
     """
     losses = []
     step = start_step
-    for batch in batches:
-        state, loss = step_fn(state, batch)
-        losses.append(loss)
-        step += 1
-        ckpt.save(step, state)
-    ckpt.wait()
-    jax.block_until_ready(losses[-1] if losses else state)
+    try:
+        for batch in batches:
+            state, loss = step_fn(state, batch)
+            losses.append(loss)
+            step += 1
+            ckpt.save(step, state, metadata={"start_batch": step})
+    finally:
+        ckpt.wait()
+    if losses:
+        import jax
+
+        jax.block_until_ready(losses[-1])
     return state, losses

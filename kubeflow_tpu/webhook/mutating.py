@@ -113,6 +113,7 @@ class NotebookMutatingWebhook:
             self._handle_quant_env(nb)
             self._handle_profiling_env(nb)
             self._handle_serving_env(nb)
+            self._handle_checkpoint_env(nb)
             mounts.check_and_mount_ca_bundle(nb, self.client)
             mounts.mount_runtime_images(nb, self.client)
             if self.config.set_pipeline_secret:
@@ -192,6 +193,46 @@ class NotebookMutatingWebhook:
             remove_env(container, {env_name})
             return
         upsert_env(container, [{"name": env_name, "value": str(port)}])
+
+    def _handle_checkpoint_env(self, nb: Notebook) -> None:
+        """The checkpoint durability contract (runtime/checkpoint.py).
+
+        Every TPU notebook gets KUBEFLOW_TPU_CHECKPOINT_DIR (annotation
+        override or the platform default) — runtime code never hardcodes
+        the PVC mount path. The grace annotation additionally projects
+        TPU_CHECKPOINT_GRACE_S for bootstrap's SIGTERM handler AND sizes
+        terminationGracePeriodSeconds so the kubelet really waits that
+        long (budget + flush margin); absent/invalid values remove the env
+        and leave the user's grace period alone.
+        """
+        if nb.tpu is None:
+            return
+        container = nb.primary_container()
+        if container is None:
+            return
+        ckpt_dir = (
+            nb.annotations.get(ann.TPU_CHECKPOINT_DIR, "").strip()
+            or ann.DEFAULT_CHECKPOINT_DIR
+        )
+        upsert_env(
+            container,
+            [{"name": ann.CHECKPOINT_DIR_ENV_NAME, "value": ckpt_dir}],
+        )
+        grace = ann.parse_checkpoint_grace(
+            nb.annotations.get(ann.TPU_CHECKPOINT_GRACE)
+        )
+        if grace is None:
+            remove_env(container, {ann.CHECKPOINT_GRACE_ENV_NAME})
+            return
+        upsert_env(
+            container,
+            [{"name": ann.CHECKPOINT_GRACE_ENV_NAME, "value": str(grace)}],
+        )
+        from kubeflow_tpu.deploy.manifests import termination_grace_seconds
+
+        nb.pod_spec["terminationGracePeriodSeconds"] = (
+            termination_grace_seconds(grace)
+        )
 
     def _handle_profiling_env(self, nb: Notebook) -> None:
         self._handle_port_env(nb, ann.TPU_PROFILING_PORT,

@@ -45,6 +45,11 @@ def test_catalog_has_reference_parity_experiments():
         "serving-disconnect-storm",
         "serving-overload",
         "serving-engine-stall",
+        # Checkpoint durability (runtime/checkpoint.py): SIGKILL mid-save,
+        # on-disk corruption at restore, and ENOSPC during the save loop.
+        "checkpoint-kill-mid-save",
+        "checkpoint-restore-corrupt",
+        "checkpoint-disk-full",
     }
 
 
@@ -124,3 +129,38 @@ def test_experiment_executes_and_hypothesis_holds(doc):
     runner = cat.ExperimentRunner(make_env, tpu_notebook)
     result = runner.run(doc)
     assert result.passed, f"{result.name}: {result.detail}"
+
+
+def test_checkpoint_experiments_wired_and_faithful():
+    """The three durability experiments are first-class catalog members:
+    a registered handler each, YAML that survives a round-trip (so the
+    catalog can be applied by external chaos tooling), the checkpoint
+    steady-state checks, and hypotheses that actually promise what
+    tests/test_checkpoint.py proves (exact resume, zero divergence)."""
+    import yaml
+
+    checkpoint_names = {
+        "checkpoint-kill-mid-save",
+        "checkpoint-restore-corrupt",
+        "checkpoint-disk-full",
+    }
+    docs = {
+        d["metadata"]["name"]: d
+        for d in _experiments()
+        if d["metadata"]["name"] in checkpoint_names
+    }
+    assert set(docs) == checkpoint_names
+
+    runner = cat.ExperimentRunner(make_env, tpu_notebook)
+    for name, doc in docs.items():
+        injection = doc["spec"]["injection"]["type"]
+        assert injection in runner._handlers, name
+        assert injection in cat.INJECTION_TYPES, name
+        assert cat.TARGET_KIND_FOR_INJECTION[injection] == "CheckpointManager"
+        assert doc["spec"]["target"]["kind"] == "CheckpointManager", name
+        assert yaml.safe_load(yaml.safe_dump(doc)) == doc, name
+        checks = {s["check"] for s in doc["spec"]["steadyState"]}
+        assert {"checkpointValid", "trainingResumed"} <= checks, name
+        hypothesis = doc["spec"]["hypothesis"]
+        assert "zero" in hypothesis and "divergence" in hypothesis, name
+        assert "resume" in hypothesis, name

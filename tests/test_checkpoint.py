@@ -1,15 +1,61 @@
-"""In-notebook checkpoint/resume: sharded save/restore + preemption replay."""
+"""In-notebook checkpoint/resume: sharded save/restore + preemption replay,
+plus the durability protocol (atomic commit, validated restore/quarantine,
+SIGKILL/SIGTERM crash paths, exact data-loader cursor resume)."""
 
 from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kubeflow_tpu.models import llama as L
-from kubeflow_tpu.models.train import make_train_step, shard_state
+from kubeflow_tpu.models.train import (
+    make_tiny_trainer,
+    make_train_step,
+    shard_state,
+)
 from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
-from kubeflow_tpu.runtime.checkpoint import CheckpointManager, train_with_checkpointing
+from kubeflow_tpu.runtime.checkpoint import (
+    CORRUPT_PREFIX,
+    CheckpointIO,
+    CheckpointManager,
+    _load_validated,
+    resume_start_batch,
+    train_with_checkpointing,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _counter(counter) -> float:
+    for metric in counter.collect():
+        for sample in metric.samples:
+            if sample.name.endswith("_total"):
+                return sample.value
+    return 0.0
+
+
+def _run_losses(step_fn, state, batches):
+    losses = []
+    for batch in batches:
+        state, loss = step_fn(state, batch)
+        losses.append(float(loss))
+    return state, losses
+
+
+@pytest.fixture(scope="module")
+def tiny_trainer():
+    """One shared single-device trainer: the durability tests compare loss
+    curves bit-for-bit, which needs every run to share one jitted step."""
+    return make_tiny_trainer()
 
 
 def _tiny_setup():
@@ -131,3 +177,318 @@ def test_quantized_tree_round_trip(tmp_path):
         got = L.forward(restored, cfg, tokens)
         assert float(jnp.max(jnp.abs(ref - got))) == 0.0
         ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# Durability: atomic commit, validated restore, crash paths
+
+
+class _SimulatedKill(Exception):
+    """Models SIGKILL between file writes: save() contains only OSError,
+    so this abandons the staging dir exactly as a dead process would."""
+
+
+def test_torn_save_invisible_and_resume_matches_uninterrupted(
+    tmp_path, tiny_trainer
+):
+    """A crash mid-save (after the previous step committed) must leave the
+    torn step invisible: restore falls back to the last committed step with
+    NO quarantine, and the resumed loss curve equals the uninterrupted
+    run's exactly."""
+    step_fn, fresh_state, batches = tiny_trainer
+    _, ref_losses = _run_losses(step_fn, fresh_state(0), batches)
+
+    class KillerIO(CheckpointIO):
+        armed = False
+        writes = 0
+
+        def write_file(self, path, data):
+            if self.armed:
+                self.writes += 1
+                if self.writes > 2:
+                    raise _SimulatedKill(path.name)
+            super().write_file(path, data)
+
+    io = KillerIO()
+    ckpt = CheckpointManager(tmp_path / "torn", max_to_keep=10, io=io)
+    state = fresh_state(0)
+    with pytest.raises(_SimulatedKill):
+        for i, batch in enumerate(batches):
+            state, _ = step_fn(state, batch)
+            if i + 1 == 3:
+                io.armed = True
+            ckpt.save(i + 1, state)
+    torn = [p.name for p in (tmp_path / "torn").iterdir()
+            if p.name.startswith(".tmp-")]
+    assert torn, "the simulated kill must leave a torn staging dir"
+
+    # "Restart": fresh manager, DIFFERENT init — only the checkpoint bytes
+    # can make the resumed curve match.
+    from kubeflow_tpu.metrics import Metrics
+
+    m = Metrics()
+    mgr2 = CheckpointManager(tmp_path / "torn", max_to_keep=10, metrics=m)
+    assert mgr2.latest_step() == 2
+    restored, at = mgr2.restore_latest(fresh_state(7))
+    assert at == 2
+    assert _counter(m.checkpoint_corrupt_total) == 0
+    _, resumed = _run_losses(step_fn, restored, batches[at:])
+    assert resumed == ref_losses[at:]
+
+
+def test_restore_corrupt_newest_quarantines_and_falls_back(
+    tmp_path, tiny_trainer
+):
+    """Bit-rot on the newest step: restore must quarantine it (counted by
+    tpu_checkpoint_corrupt_total), restore the previous valid step, and
+    resume with zero loss-curve divergence."""
+    from kubeflow_tpu.metrics import Metrics
+
+    step_fn, fresh_state, batches = tiny_trainer
+    _, ref_losses = _run_losses(step_fn, fresh_state(0), batches)
+    workdir = tmp_path / "rot"
+    ckpt = CheckpointManager(workdir, max_to_keep=10)
+    state = fresh_state(0)
+    for i, batch in enumerate(batches):
+        state, _ = step_fn(state, batch)
+        ckpt.save(i + 1, state)
+    newest = workdir / str(len(batches))
+    victim = sorted(newest.glob("*.bin"))[0]
+    blob = bytearray(victim.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    victim.write_bytes(bytes(blob))
+
+    m = Metrics()
+    mgr2 = CheckpointManager(workdir, max_to_keep=10, metrics=m)
+    restored, at = mgr2.restore_latest(fresh_state(7))
+    assert at == len(batches) - 1
+    assert _counter(m.checkpoint_corrupt_total) == 1
+    quarantined = [p.name for p in workdir.iterdir()
+                   if p.name.startswith(CORRUPT_PREFIX)]
+    assert len(quarantined) == 1
+    assert quarantined[0].startswith(f"{CORRUPT_PREFIX}{len(batches)}-")
+    assert not (workdir / str(len(batches))).exists()
+    _, resumed = _run_losses(step_fn, restored, batches[at:])
+    assert resumed == ref_losses[at:]
+
+
+_SIGKILL_CHILD = """
+import sys, time
+sys.path.insert(0, sys.argv[2])
+import numpy as np
+from kubeflow_tpu.runtime.checkpoint import CheckpointIO, CheckpointManager
+
+class SlowIO(CheckpointIO):
+    def write_file(self, path, data):
+        time.sleep(0.05)
+        super().write_file(path, data)
+
+mgr = CheckpointManager(
+    sys.argv[1], io=SlowIO(), async_save=True, max_to_keep=100
+)
+step = 0
+while True:
+    step += 1
+    state = {
+        "b": np.full((8,), step * 0.5),
+        "w": np.full((32, 32), float(step)),
+    }
+    mgr.save(step, state)
+    time.sleep(0.01)
+"""
+
+
+@pytest.mark.skipif(os.name != "posix", reason="needs SIGKILL")
+def test_sigkill_during_async_save_leaves_valid_latest(tmp_path):
+    """The real thing: a child process checkpointing asynchronously is
+    SIGKILLed mid-stream. EVERY committed step must still validate
+    (manifest sizes + CRC32s), and restore must hand back a consistent
+    state — w == full(step), b == step * 0.5 — for the step it reports."""
+    ckpt_dir = tmp_path / "sigkill"
+    child = subprocess.Popen(
+        [sys.executable, "-c", _SIGKILL_CHILD, str(ckpt_dir), str(REPO_ROOT)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if ckpt_dir.exists():
+                committed = [
+                    p for p in ckpt_dir.iterdir()
+                    if p.name.isdigit() and (p / "manifest.json").exists()
+                ]
+                if len(committed) >= 2:
+                    break
+            if child.poll() is not None:
+                raise AssertionError(
+                    f"child died early (rc={child.returncode})"
+                )
+            time.sleep(0.01)
+        else:
+            raise AssertionError("child never committed 2 checkpoints")
+        time.sleep(0.02)  # land the kill mid-write of a later step
+    finally:
+        child.kill()
+        child.wait()
+
+    # Every committed dir must validate whole — the atomic-commit claim.
+    committed = sorted(
+        int(p.name) for p in ckpt_dir.iterdir()
+        if p.name.isdigit() and (p / "manifest.json").exists()
+    )
+    assert committed, "at least one committed step must exist"
+    for step in committed:
+        _load_validated(ckpt_dir / str(step))  # raises if torn
+
+    from kubeflow_tpu.metrics import Metrics
+
+    m = Metrics()
+    mgr = CheckpointManager(ckpt_dir, max_to_keep=100, metrics=m)
+    template = {"b": np.zeros((8,)), "w": np.zeros((32, 32))}
+    restored, at = mgr.restore_latest(template)
+    assert at == committed[-1]
+    assert _counter(m.checkpoint_corrupt_total) == 0
+    np.testing.assert_array_equal(restored["w"], np.full((32, 32), float(at)))
+    np.testing.assert_array_equal(restored["b"], np.full((8,), at * 0.5))
+
+
+def test_save_interval_skips_but_records_pending(tmp_path):
+    # Orbax-compatible cadence: multiples of the interval commit, the
+    # first call always commits, everything else is skipped-but-pending.
+    ckpt = CheckpointManager(tmp_path / "iv", save_interval_steps=2)
+    assert ckpt.save(1, {"w": np.zeros(4)})  # first call
+    assert ckpt.save(2, {"w": np.ones(4)})
+    assert not ckpt.save(3, {"w": np.full(4, 3.0)})
+    assert ckpt.save(4, {"w": np.full(4, 4.0)})
+    assert ckpt.latest_step() == 4
+    # The skipped step was still recorded for the emergency path.
+    assert not ckpt.save(5, {"w": np.full(4, 5.0)})
+    assert ckpt.emergency_save()
+    assert ckpt.latest_step() == 5
+
+
+def test_sigterm_emergency_save_commits_then_skips_fresh(tmp_path):
+    """bootstrap.install_preemption_handler: SIGTERM triggers one final
+    synchronous save of the newest pending state, chains to the previous
+    handler, and a second SIGTERM with nothing new to save skips."""
+    from kubeflow_tpu.metrics import Metrics
+    from kubeflow_tpu.runtime.bootstrap import install_preemption_handler
+
+    m = Metrics()
+    ckpt = CheckpointManager(
+        tmp_path / "em", save_interval_steps=100, metrics=m
+    )
+    assert ckpt.save(1, {"w": np.arange(16.0)})
+    latest_state = {"w": np.arange(16.0) * 2}
+    assert not ckpt.save(2, latest_state)  # interval-skipped, but pending
+
+    received = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: received.append(s))
+    try:
+        uninstall = install_preemption_handler(
+            ckpt, env={"TPU_CHECKPOINT_GRACE_S": "60"}
+        )
+        signal.raise_signal(signal.SIGTERM)
+        assert ckpt.latest_step() == 2
+        assert _counter(m.checkpoint_emergency_total) == 1
+        assert received == [signal.SIGTERM], "must chain to prior handler"
+        restored, at = ckpt.restore_latest({"w": np.zeros(16)})
+        assert at == 2
+        np.testing.assert_array_equal(restored["w"], latest_state["w"])
+
+        signal.raise_signal(signal.SIGTERM)  # fresh save exists -> skip
+        assert _counter(m.checkpoint_emergency_total) == 1
+        assert received == [signal.SIGTERM, signal.SIGTERM]
+
+        uninstall()
+        signal.raise_signal(signal.SIGTERM)  # handler restored: no saves
+        assert received == [signal.SIGTERM] * 3
+        assert _counter(m.checkpoint_emergency_total) == 1
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_emergency_save_skips_when_budget_too_small(tmp_path):
+    """A save that cannot finish inside the grace budget is SKIPPED —
+    starting a save SIGKILL will tear only wastes the window."""
+    ckpt = CheckpointManager(tmp_path / "budget", save_interval_steps=100)
+    assert ckpt.save(1, {"w": np.zeros(4)})
+    ckpt._last_save_duration = 999.0  # a save this size takes "forever"
+    assert not ckpt.save(2, {"w": np.ones(4)})
+    assert ckpt.emergency_save(grace_s=1.0) is False
+    assert ckpt.latest_step() == 1
+
+
+def test_save_failure_is_contained_and_recovers(tmp_path):
+    """ENOSPC mid-training: save() returns False (never raises), cleans
+    its staging dir, keeps the previous step restorable, and commits again
+    once space returns."""
+    import errno
+
+    class FullDiskIO(CheckpointIO):
+        full = False
+
+        def write_file(self, path, data):
+            if self.full:
+                raise OSError(errno.ENOSPC, "No space left on device")
+            super().write_file(path, data)
+
+    io = FullDiskIO()
+    ckpt = CheckpointManager(tmp_path / "enospc", io=io)
+    assert ckpt.save(1, {"w": np.zeros(8)})
+    io.full = True
+    assert ckpt.save(2, {"w": np.ones(8)}) is False
+    assert ckpt.save_failures == 1
+    assert ckpt.last_save_error is not None
+    assert ckpt.latest_step() == 1
+    assert not [p for p in (tmp_path / "enospc").iterdir()
+                if p.name.startswith(".tmp-")]
+    io.full = False
+    assert ckpt.save(3, {"w": np.full(8, 3.0)})
+    assert ckpt.latest_step() == 3
+
+
+def test_train_loop_flushes_async_saves_on_exception(tmp_path):
+    """An exception mid-loop must not strand enqueued async saves: the
+    finally-wait flushes step 1 before the exception propagates."""
+
+    class Boom(RuntimeError):
+        pass
+
+    def step_fn(state, batch):
+        if batch == "boom":
+            raise Boom()
+        return state + 1, np.float32(batch)
+
+    ckpt = CheckpointManager(tmp_path / "flush", async_save=True)
+    with pytest.raises(Boom):
+        train_with_checkpointing(step_fn, 0, [1.0, "boom", 3.0], ckpt)
+    assert ckpt.latest_step() == 1
+    ckpt.close()
+
+
+def test_train_loop_tolerates_empty_batches(tmp_path):
+    def step_fn(state, batch):  # pragma: no cover - never called
+        raise AssertionError("no batches, no steps")
+
+    ckpt = CheckpointManager(tmp_path / "empty")
+    state, losses = train_with_checkpointing(step_fn, 5, [], ckpt)
+    assert state == 5 and losses == []
+
+
+def test_checkpoint_metadata_carries_loader_cursor(tmp_path, tiny_trainer):
+    """train_with_checkpointing persists {"start_batch": step}; restore
+    hands it back so sharded_loader(start_batch=...) resumes exactly."""
+    step_fn, fresh_state, batches = tiny_trainer
+    ckpt = CheckpointManager(tmp_path / "cursor")
+    train_with_checkpointing(step_fn, fresh_state(0), batches[:2], ckpt)
+
+    mgr2 = CheckpointManager(tmp_path / "cursor")
+    _, at = mgr2.restore_latest(fresh_state(7))
+    assert at == 2
+    assert mgr2.restored_metadata == {"start_batch": 2}
+    assert resume_start_batch(mgr2, at) == 2
+    # A checkpoint without the cursor (older writer) falls back to the
+    # restored step — the one-batch-per-step convention.
+    empty = CheckpointManager(tmp_path / "other")
+    assert resume_start_batch(empty, 5) == 5

@@ -103,6 +103,41 @@ class TestShardedLoader:
             for j in range(i + 1, 4):
                 assert not np.array_equal(batches[i], batches[j])
 
+    def test_mid_epoch_restore_keeps_hosts_aligned(self, tmp_path):
+        """Checkpoint-resume discipline across hosts: after restoring at a
+        global batch cursor k, every host's stream must continue EXACTLY
+        where its uninterrupted stream would be (no host replays or skips
+        a batch relative to its peers), and cross-host rows must stay
+        disjoint — a single mis-stepped host silently trains on the wrong
+        global batch forever."""
+        from kubeflow_tpu.data.loader import sharded_loader, write_token_file
+
+        p = tmp_path / "corpus.bin"
+        write_token_file(p, np.arange(50000, dtype=np.uint32))
+        hosts, total, cursor = 4, 7, 3
+
+        full = []
+        for i in range(hosts):
+            ld = sharded_loader(p, 16, 32, process_id=i, num_processes=hosts,
+                                force_python=True)
+            full.append([ld.next() for _ in range(total)])
+
+        for i in range(hosts):
+            resumed = sharded_loader(p, 16, 32, process_id=i,
+                                     num_processes=hosts,
+                                     start_batch=cursor, force_python=True)
+            for k in range(cursor, total):
+                np.testing.assert_array_equal(
+                    resumed.next(), full[i][k],
+                    err_msg=f"host {i} diverged at global batch {k}",
+                )
+
+        # Alignment preserved => disjointness preserved, post-restore too.
+        for k in range(cursor, total):
+            for i in range(hosts):
+                for j in range(i + 1, hosts):
+                    assert not np.array_equal(full[i][k], full[j][k])
+
     def test_indivisible_global_batch_rejected(self, tmp_path):
         from kubeflow_tpu.data.loader import sharded_loader, write_token_file
 

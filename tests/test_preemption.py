@@ -37,7 +37,8 @@ CFG = RecoveryConfig(
 )
 
 
-def _ready_env(node_hosts=4, warm_pool=False, recovery_config=CFG):
+def _ready_env(node_hosts=4, warm_pool=False, recovery_config=CFG,
+               annotations=None):
     env = make_env(
         node_pools=(("tpu-v5-lite-podslice", "4x4", node_hosts, 4),),
         recovery_config=recovery_config,
@@ -47,7 +48,7 @@ def _ready_env(node_hosts=4, warm_pool=False, recovery_config=CFG):
             new_slicepool("pool", "ns", TPUSpec("v5e", "4x4"), warm_replicas=1)
         )
         env.manager.run_until_idle()
-    env.cluster.create(tpu_notebook())
+    env.cluster.create(tpu_notebook(annotations=annotations or {}))
     env.manager.run_until_idle()
     nb = env.cluster.get("Notebook", "nb", "ns")
     assert nb["status"]["readyReplicas"] == 4
@@ -334,3 +335,47 @@ class TestStopAndConfig:
         })
         assert cfg == RecoveryConfig(120.0, 2.0, 30.0, 1, 900.0)
         assert RecoveryConfig.from_env({}) == RecoveryConfig()
+
+
+class TestCheckpointAwareEvents:
+    """PR 3 links the escalation ladder to the in-pod emergency-save
+    window: interruption/escalation events must tell the operator whether
+    training state survived and where to resume from."""
+
+    GRACE = {ann.TPU_CHECKPOINT_GRACE: "60"}
+
+    def test_interruption_event_points_at_emergency_checkpoint(self):
+        env = _ready_env(annotations=self.GRACE)
+        _interrupt(env)
+        ev = _events(env, "SliceInterrupted")
+        assert len(ev) == 1
+        msg = ev[0]["message"]
+        assert "resume from the emergency checkpoint in /mnt/checkpoints" in msg
+        assert "60s SIGTERM grace" in msg
+
+    def test_interruption_event_without_grace_says_state_gone(self):
+        env = _ready_env()
+        _interrupt(env)
+        assert "in-notebook JAX state is gone" in (
+            _events(env, "SliceInterrupted")[0]["message"]
+        )
+
+    def test_sts_recreate_event_quotes_termination_grace(self):
+        """grace(60) + flush margin(30): the same number the webhook put
+        in terminationGracePeriodSeconds, so the event explains the slow
+        teardown the ladder just triggered."""
+        env = _ready_env(annotations=self.GRACE)
+        _interrupt(env)
+        env.manager.tick(CFG.deadline_s + 1)
+        escalated = _events(env, "SliceRecoveryEscalated")
+        assert len(escalated) == 1
+        assert ("surviving hosts get 90s termination grace for an "
+                "emergency checkpoint") in escalated[0]["message"]
+
+    def test_sts_recreate_event_silent_without_grace(self):
+        env = _ready_env()
+        _interrupt(env)
+        env.manager.tick(CFG.deadline_s + 1)
+        escalated = _events(env, "SliceRecoveryEscalated")
+        assert len(escalated) == 1
+        assert "termination grace" not in escalated[0]["message"]

@@ -590,3 +590,70 @@ class TestProfilingPortLayering:
             assert ann.profiling_port_error(bad) is not None
         assert ann.parse_profiling_port("9999") == 9999
         assert ann.profiling_port_error("9999") is None
+
+
+class TestCheckpointOption:
+    def test_grace_annotation_projects_env_and_sizes_termination(self):
+        """The grace annotation must land in BOTH places the durability
+        contract needs: TPU_CHECKPOINT_GRACE_S for bootstrap's SIGTERM
+        handler, and terminationGracePeriodSeconds = grace + flush margin
+        so the kubelet actually waits for the emergency save."""
+        from kubeflow_tpu.deploy.manifests import CHECKPOINT_FLUSH_MARGIN_S
+
+        env = make_env(webhooks=True)
+        env.cluster.create(
+            tpu_notebook(annotations={ann.TPU_CHECKPOINT_GRACE: "60"})
+        )
+        env.manager.run_until_idle()
+        nb, c = primary(env)
+        assert get_env_var(c, ann.CHECKPOINT_GRACE_ENV_NAME)["value"] == "60"
+        assert nb.pod_spec["terminationGracePeriodSeconds"] == (
+            60 + CHECKPOINT_FLUSH_MARGIN_S
+        )
+
+    def test_no_annotation_still_gets_checkpoint_dir_default(self):
+        """Every TPU notebook gets the checkpoint dir env (runtime code
+        must never hardcode the PVC path); without a grace annotation
+        there is no grace env and the pod's grace period is untouched."""
+        env = make_env(webhooks=True)
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        nb, c = primary(env)
+        assert get_env_var(c, ann.CHECKPOINT_DIR_ENV_NAME)["value"] == (
+            ann.DEFAULT_CHECKPOINT_DIR
+        )
+        assert get_env_var(c, ann.CHECKPOINT_GRACE_ENV_NAME) is None
+        assert "terminationGracePeriodSeconds" not in nb.pod_spec
+
+    def test_dir_annotation_overrides_default(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(
+            tpu_notebook(
+                annotations={ann.TPU_CHECKPOINT_DIR: "/data/ckpt "}
+            )
+        )
+        env.manager.run_until_idle()
+        _, c = primary(env)
+        assert get_env_var(c, ann.CHECKPOINT_DIR_ENV_NAME)["value"] == (
+            "/data/ckpt"
+        )
+
+    def test_invalid_grace_treated_as_absent(self):
+        for bad in ("0", "-5", "3601", "soon", ""):
+            env = make_env(webhooks=True)
+            env.cluster.create(
+                tpu_notebook(annotations={ann.TPU_CHECKPOINT_GRACE: bad})
+            )
+            env.manager.run_until_idle()
+            nb, c = primary(env)
+            assert get_env_var(c, ann.CHECKPOINT_GRACE_ENV_NAME) is None, bad
+            assert "terminationGracePeriodSeconds" not in nb.pod_spec, bad
+
+    def test_cpu_notebook_gets_no_checkpoint_env(self):
+        env = make_env(webhooks=True)
+        env.cluster.create(
+            cpu_notebook(annotations={ann.TPU_CHECKPOINT_GRACE: "60"})
+        )
+        _, c = primary(env)
+        assert get_env_var(c, ann.CHECKPOINT_DIR_ENV_NAME) is None
+        assert get_env_var(c, ann.CHECKPOINT_GRACE_ENV_NAME) is None
