@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from dataclasses import dataclass
 from typing import Optional
 
@@ -269,9 +270,14 @@ def install_preemption_handler(
     we borrow the signal, we don't own it).
 
     Returns an ``uninstall()`` callable restoring the previous handler.
-    Must run on the main thread (Python signal API restriction); the
-    handler itself is re-entrancy-safe because CheckpointManager guards the
-    commit protocol with an RLock.
+    Must run on the main thread (Python signal API restriction). The
+    handler never touches the checkpoint queue in handler context: the
+    save runs on a dedicated thread, because the signal may have
+    interrupted the main thread anywhere — including inside
+    ``queue.Queue.put``'s non-reentrant mutex, which a direct
+    ``emergency_save`` would then deadlock on for the whole grace window.
+    A worker thread contends on that lock like any other thread, bounded
+    by the manager's drain budget.
     """
     import signal
 
@@ -280,12 +286,24 @@ def install_preemption_handler(
     previous = signal.getsignal(signum)
 
     def handle(received_signum, frame):
-        try:
-            ckpt.emergency_save(grace_s=grace)
-        except Exception:
-            # The exit path must keep exiting: a save bug cannot be allowed
-            # to swallow the termination signal.
-            log.exception("emergency checkpoint save failed")
+        def run():
+            try:
+                ckpt.emergency_save(grace_s=grace)
+            except Exception:
+                # The exit path must keep exiting: a save bug cannot be
+                # allowed to swallow the termination signal.
+                log.exception("emergency checkpoint save failed")
+
+        saver = threading.Thread(
+            target=run, name="emergency-checkpoint", daemon=True
+        )
+        saver.start()
+        saver.join(None if grace is None else float(grace) + 5.0)
+        if saver.is_alive():
+            log.error(
+                "emergency checkpoint save still running past the grace "
+                "budget; proceeding with termination"
+            )
         if callable(previous):
             previous(received_signum, frame)
         elif previous is signal.SIG_DFL:

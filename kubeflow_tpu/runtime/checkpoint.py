@@ -28,14 +28,25 @@ notebook pod actually dies:
   ``restore_latest`` surfaces it via ``restored_metadata`` /
   ``resume_start_batch`` so ``data.loader.sharded_loader(start_batch=...)``
   replays nothing and skips nothing.
+- **Multi-host: one root per process.** On a multi-host slice every
+  process commits into its own ``proc<k>/`` subtree of the shared
+  checkpoint directory (identity from the webhook's TPU env contract, or
+  explicit ``process_index``/``process_count``), so commits never race on
+  one rename target. Non-fully-addressable ``jax.Array`` leaves are
+  serialized as this process's *addressable shards* (index + bytes) —
+  the full array is never gathered to one host — and restored straight
+  into the template's sharding. A step counts as restorable only when
+  EVERY process committed it, so a host that died mid-save poisons
+  nothing: survivors skip that step by intersection.
 
 The format is plain numpy-bytes + JSON — no orbax dependency, so the
 save/restore path has no library between it and the fsyncs it promises.
 ml_dtypes dtypes (bfloat16, int4, fp8) round-trip exactly: leaves are
 serialized with ``tobytes()`` and revived via ``np.frombuffer`` with the
-dtype *name* from the manifest. jax is imported lazily (tree flatten /
-device placement only), so constructing a manager and validating
-checkpoints needs no accelerator stack.
+dtype *name* from the manifest (resolved through a lazy ``ml_dtypes``
+import when numpy alone does not know the name). jax is imported lazily
+(tree flatten / device placement only), so constructing a manager and
+validating checkpoints needs no accelerator stack.
 """
 
 from __future__ import annotations
@@ -62,10 +73,24 @@ MANIFEST_FORMAT = 1
 # isdigit() match the same way.
 _TMP_PREFIX = ".tmp-"
 CORRUPT_PREFIX = "corrupt-"
+# An emergency save must never block forever: when no grace budget was
+# given, draining in-flight async saves is still bounded by this.
+_DEFAULT_EMERGENCY_DRAIN_S = 30.0
 
 
 class CorruptCheckpointError(Exception):
     """A committed step directory failed manifest validation."""
+
+
+def process_identity_from_env(env: Optional[dict] = None) -> tuple:
+    """(process_index, process_count) from the webhook's TPU env contract
+    (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES / MEGASCALE_*), via the same
+    parser bootstrap uses. Deliberately backend-free: asking jax would
+    initialize the TPU client, and constructing a manager must not."""
+    from kubeflow_tpu.runtime.bootstrap import runtime_from_env
+
+    rt = runtime_from_env(env)
+    return rt.process_id, rt.num_workers
 
 
 class CheckpointIO:
@@ -121,6 +146,11 @@ class CheckpointManager:
     - ``emergency_save(grace_s)`` is the preemption path: one synchronous
       save of the newest state handed to ``save()``, skipped when already
       committed or when it cannot finish inside the grace budget.
+    - Multi-host: each process owns ``<directory>/proc<k>/``; saves and
+      quarantines touch only the local root, while ``latest_step`` /
+      ``restore_latest`` consider only steps present in EVERY process's
+      root. Identity comes from ``process_index``/``process_count`` when
+      given, else from the webhook's TPU env contract, else (0, 1).
     """
 
     def __init__(
@@ -131,6 +161,9 @@ class CheckpointManager:
         async_save: bool = False,
         metrics: Any = None,
         io: Optional[CheckpointIO] = None,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
+        env: Optional[dict] = None,
     ):
         self.directory = Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
@@ -138,13 +171,33 @@ class CheckpointManager:
         self.save_interval_steps = max(1, int(save_interval_steps))
         self.io = io or CheckpointIO()
         self.metrics = metrics
+        if process_index is None or process_count is None:
+            env_index, env_count = process_identity_from_env(env)
+            process_index = env_index if process_index is None else process_index
+            process_count = env_count if process_count is None else process_count
+        self.process_index = int(process_index)
+        self.process_count = max(1, int(process_count))
+        if not 0 <= self.process_index < self.process_count:
+            raise ValueError(
+                f"process_index {self.process_index} not in "
+                f"[0, {self.process_count})"
+            )
+        self._root = (
+            self.directory
+            if self.process_count == 1
+            else self.directory / f"proc{self.process_index}"
+        )
+        self._root.mkdir(parents=True, exist_ok=True)
         # Metadata dict of the step restore_latest() last returned.
         self.restored_metadata: dict = {}
         self.last_save_error: Optional[BaseException] = None
         self.save_failures = 0
-        # RLock: a SIGTERM handler may call emergency_save while the SAME
-        # (main) thread is inside a synchronous save.
+        # Serializes whole checkpoint writes. The emergency path acquires
+        # it with a timeout (never blocking the exit path on a frozen
+        # writer); _seq has its own lock so a write can proceed even when
+        # this one could not be taken.
         self._lock = threading.RLock()
+        self._seq_lock = threading.Lock()
         self._seq = 0  # staging-dir uniquifier (reentrant saves)
         self._last_saved_step: Optional[int] = None  # interval gate
         self._last_committed_step: Optional[int] = self.latest_step()
@@ -172,6 +225,18 @@ class CheckpointManager:
         step = int(step)
         meta = dict(metadata or {})
         snapshot = _snapshot_to_host(state)
+        if self.process_count == 1 and any(
+            isinstance(payload, dict) for _, payload in snapshot
+        ):
+            raise RuntimeError(
+                "state contains jax.Arrays spanning non-addressable devices "
+                "but this CheckpointManager believes it is the only process. "
+                "Construct it with process_index=jax.process_index(), "
+                "process_count=jax.process_count() — the webhook's TPU env "
+                "contract fills these automatically in notebook pods — so "
+                "every host saves its own shards instead of attempting a "
+                "cross-host gather."
+            )
         # Remember the newest state even when the interval skips it: an
         # emergency save must flush what training last produced, not what
         # the cadence last chose to keep.
@@ -201,12 +266,28 @@ class CheckpointManager:
         step, or when ``grace_s`` minus the time spent draining in-flight
         saves is smaller than the last observed save duration — starting a
         save that SIGKILL will tear only wastes the budget.
+
+        Every blocking step in here is time-bounded: the drain of
+        in-flight async saves and the acquisition of the write lock both
+        carry deadlines, because this runs on the exit path — possibly
+        while the thread the signal interrupted still holds the queue
+        mutex or the write lock. The pending snapshot supersedes anything
+        still queued, so giving up on the drain loses nothing.
         """
         t0 = time.monotonic()
-        try:
-            self.wait()
-        except Exception:  # a failing async save must not block the exit path
-            log.exception("emergency save: draining pending saves failed")
+        if grace_s is None:
+            drain_timeout = _DEFAULT_EMERGENCY_DRAIN_S
+        else:
+            reserve = (self._last_save_duration or 0.0) + 1.0
+            drain_timeout = max(
+                0.0, min(float(grace_s) - reserve, float(grace_s) / 2)
+            )
+        if not self.wait(timeout=drain_timeout):
+            log.error(
+                "emergency save: pending async saves did not drain within "
+                "%.1fs; writing the newest snapshot anyway",
+                drain_timeout,
+            )
         pending = self._pending
         if pending is None:
             log.info("emergency save: no state has been handed to save()")
@@ -230,8 +311,26 @@ class CheckpointManager:
                     max(0.0, remaining),
                 )
                 return False
-        with self._lock:
+        if grace_s is not None:
+            lock_timeout = max(0.0, float(grace_s) - (time.monotonic() - t0))
+        else:
+            lock_timeout = _DEFAULT_EMERGENCY_DRAIN_S
+        locked = self._lock.acquire(timeout=lock_timeout)
+        if not locked:
+            # The holder is frozen (likely the very thread this signal
+            # interrupted). Writing anyway is safe: staging names are
+            # uniquified under _seq_lock, and a later duplicate commit of
+            # the same step surfaces as a contained OSError.
+            log.error(
+                "emergency save: write lock not acquired within %.1fs; "
+                "writing without it",
+                lock_timeout,
+            )
+        try:
             ok = self._write_step(step, snapshot, meta)
+        finally:
+            if locked:
+                self._lock.release()
         if ok:
             self._last_saved_step = step
             counter = getattr(self.metrics, "checkpoint_emergency_total", None)
@@ -247,12 +346,14 @@ class CheckpointManager:
     def _write_step(self, step: int, snapshot: list, meta: dict) -> bool:
         """The atomic commit protocol; returns whether ``step`` committed.
         OSError (disk full, quota, permissions) is contained — training
-        must outlive a sick disk — everything else propagates."""
+        must outlive a sick disk, and its staging dir is cleaned up.
+        Everything else propagates and abandons the staging dir exactly
+        as SIGKILL would: invisible to restore, evidence for debugging."""
         t0 = time.monotonic()
-        final = self.directory / str(step)
-        with self._lock:
+        final = self._root / str(step)
+        with self._seq_lock:
             self._seq += 1
-            staged = self.directory / (
+            staged = self._root / (
                 f"{_TMP_PREFIX}{step}-{os.getpid()}-{self._seq}"
             )
         try:
@@ -260,22 +361,46 @@ class CheckpointManager:
                 shutil.rmtree(staged)
             staged.mkdir(parents=True)
             files = []
-            for i, (path_str, arr) in enumerate(snapshot):
-                name = f"{i:05d}.bin"
-                data = arr.tobytes()
-                self.io.write_file(staged / name, data)
-                files.append({
-                    "name": name,
-                    "path": path_str,
-                    "dtype": arr.dtype.name,
-                    "shape": list(arr.shape),
-                    "size": len(data),
-                    "crc32": zlib.crc32(data) & 0xFFFFFFFF,
-                })
+            for i, (path_str, payload) in enumerate(snapshot):
+                if isinstance(payload, dict):  # this process's shards
+                    for j, (index, arr) in enumerate(payload["shards"]):
+                        name = f"{i:05d}.s{j}.bin"
+                        data = arr.tobytes()
+                        self.io.write_file(staged / name, data)
+                        files.append({
+                            "name": name,
+                            "path": path_str,
+                            "dtype": arr.dtype.name,
+                            "shape": list(arr.shape),
+                            "size": len(data),
+                            "crc32": zlib.crc32(data) & 0xFFFFFFFF,
+                            "shard": {
+                                "index": [list(p) for p in index],
+                                "global_shape": list(
+                                    payload["global_shape"]
+                                ),
+                            },
+                        })
+                else:
+                    name = f"{i:05d}.bin"
+                    data = payload.tobytes()
+                    self.io.write_file(staged / name, data)
+                    files.append({
+                        "name": name,
+                        "path": path_str,
+                        "dtype": payload.dtype.name,
+                        "shape": list(payload.shape),
+                        "size": len(data),
+                        "crc32": zlib.crc32(data) & 0xFFFFFFFF,
+                    })
             manifest = {
                 "format": MANIFEST_FORMAT,
                 "step": step,
                 "metadata": meta,
+                "process": {
+                    "index": self.process_index,
+                    "count": self.process_count,
+                },
                 "files": files,
             }
             # Manifest written LAST: its presence certifies every data file
@@ -303,14 +428,20 @@ class CheckpointManager:
         return True
 
     def _prune(self) -> None:
-        for s in self._committed_steps()[: -self.max_to_keep]:
-            shutil.rmtree(self.directory / str(s), ignore_errors=True)
+        for s in self._local_steps()[: -self.max_to_keep]:
+            shutil.rmtree(self._root / str(s), ignore_errors=True)
 
     # -- async worker --------------------------------------------------------
 
     def _ensure_worker(self) -> None:
+        if self._worker is not None and not self._worker.is_alive():
+            # _drain survives failing saves, but belt and braces: a dead
+            # worker must never turn save() into an enqueue-to-nowhere.
+            log.error("checkpoint worker thread died; restarting it")
+            self._worker = None
         if self._worker is None:
-            self._queue = queue.Queue()
+            if self._queue is None:
+                self._queue = queue.Queue()
             self._worker = threading.Thread(
                 target=self._drain, name="checkpoint-save", daemon=True
             )
@@ -323,20 +454,59 @@ class CheckpointManager:
                 if item is None:
                     return
                 step, snapshot, meta = item
-                with self._lock:
-                    self._write_step(step, snapshot, meta)
+                try:
+                    with self._lock:
+                        self._write_step(step, snapshot, meta)
+                except BaseException as err:
+                    # _write_step contains OSError itself; anything else
+                    # (unserializable metadata, MemoryError) must not kill
+                    # the worker and wedge every later wait()/close() in
+                    # queue.join() — record it and keep draining.
+                    self.last_save_error = err
+                    self.save_failures += 1
+                    log.exception(
+                        "async checkpoint save of step %d failed", step
+                    )
             finally:
                 self._queue.task_done()
 
-    def wait(self) -> None:
-        """Block until every enqueued async save has committed or failed."""
-        if self._queue is not None:
-            self._queue.join()
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every enqueued async save has committed or failed;
+        returns whether the queue fully drained. With a ``timeout`` the
+        wait is bounded — including the queue-lock acquisition itself, so
+        a caller on the signal path (which may have interrupted a thread
+        inside the queue's non-reentrant mutex) cannot deadlock."""
+        q = self._queue
+        if q is None:
+            return True
+        worker = self._worker
+        if worker is not None and not worker.is_alive() and q.unfinished_tasks:
+            log.error(
+                "checkpoint worker thread is dead with %d saves queued",
+                q.unfinished_tasks,
+            )
+            return False
+        if timeout is None:
+            q.join()
+            return True
+        deadline = time.monotonic() + timeout
+        if not q.all_tasks_done.acquire(timeout=timeout):
+            return False
+        try:
+            while q.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                q.all_tasks_done.wait(remaining)
+            return True
+        finally:
+            q.all_tasks_done.release()
 
     def close(self) -> None:
         self.wait()
         if self._worker is not None:
-            self._queue.put(None)
+            if self._worker.is_alive():
+                self._queue.put(None)
             self._worker.join()
             self._worker = None
             self._queue = None
@@ -344,16 +514,33 @@ class CheckpointManager:
     # -- restore -------------------------------------------------------------
 
     def latest_step(self) -> Optional[int]:
-        """Newest committed step (manifest present). Cheap — full
+        """Newest restorable step: manifest present locally AND (on a
+        multi-host slice) in every other process's root. Cheap — full
         size/checksum validation happens at restore."""
         steps = self._committed_steps()
         return steps[-1] if steps else None
 
-    def _committed_steps(self) -> list:
+    def _local_steps(self) -> list:
         return sorted(
             int(p.name)
-            for p in self.directory.iterdir()
+            for p in self._root.iterdir()
             if p.is_dir() and p.name.isdigit() and (p / MANIFEST_NAME).exists()
+        )
+
+    def _committed_steps(self) -> list:
+        """Locally committed steps, intersected with every peer root on a
+        multi-host slice — a step a dead host never committed is not a
+        checkpoint, it is a torn save with better marketing."""
+        steps = self._local_steps()
+        if self.process_count == 1:
+            return steps
+        return [s for s in steps if self._peers_committed(s)]
+
+    def _peers_committed(self, step: int) -> bool:
+        return all(
+            (self.directory / f"proc{j}" / str(step) / MANIFEST_NAME).exists()
+            for j in range(self.process_count)
+            if j != self.process_index
         )
 
     def restore_latest(self, template: Any) -> tuple:
@@ -361,18 +548,27 @@ class CheckpointManager:
         (template, None). Steps failing validation are quarantined as
         ``corrupt-<step>-*`` (never deleted: torn bytes are evidence) and
         the walk falls back to the next-newest step. The restored step's
-        metadata lands in ``self.restored_metadata``."""
+        metadata lands in ``self.restored_metadata``.
+
+        Multi-host: only steps every process committed are considered,
+        and each process restores its own shards from its own root. A
+        quarantine on one host removes the step from every later
+        restore's intersection, so hosts that restore after the
+        discovery agree on the fallback.
+        """
         self.restored_metadata = {}
         candidates = sorted(
             (
                 int(p.name)
-                for p in self.directory.iterdir()
+                for p in self._root.iterdir()
                 if p.is_dir() and p.name.isdigit()
             ),
             reverse=True,
         )
         for step in candidates:
-            step_dir = self.directory / str(step)
+            if self.process_count > 1 and not self._peers_committed(step):
+                continue
+            step_dir = self._root / str(step)
             try:
                 arrays, meta = _load_validated(step_dir)
             except CorruptCheckpointError as err:
@@ -387,12 +583,12 @@ class CheckpointManager:
     def _quarantine(
         self, step_dir: Path, step: int, err: CorruptCheckpointError
     ) -> None:
-        with self._lock:
+        with self._seq_lock:
             self._seq += 1
-            dest = self.directory / f"{CORRUPT_PREFIX}{step}-{self._seq}"
+            dest = self._root / f"{CORRUPT_PREFIX}{step}-{self._seq}"
             while dest.exists():
                 self._seq += 1
-                dest = self.directory / f"{CORRUPT_PREFIX}{step}-{self._seq}"
+                dest = self._root / f"{CORRUPT_PREFIX}{step}-{self._seq}"
         log.error(
             "checkpoint step %d failed validation (%s); quarantined as %s",
             step, err, dest.name,
@@ -416,21 +612,73 @@ def _tree_util():
 
 
 def _snapshot_to_host(state: Any) -> list:
-    """[(keypath_str, np.ndarray), ...] in tree-flatten order. np.asarray
-    materializes jax arrays on host (ml_dtypes views included) and leaves
-    numpy leaves alone; the copy makes donation/overwrite safe."""
+    """[(keypath_str, payload), ...] in tree-flatten order. Fully
+    addressable leaves (numpy, single-host jax arrays, ml_dtypes views)
+    become host np.ndarrays via np.asarray — the copy makes
+    donation/overwrite safe. Non-fully-addressable jax.Arrays (multi-host
+    shardings) are NEVER gathered: the payload is this process's
+    addressable shards, ``{"global_shape": ..., "shards": [(index, np),
+    ...]}``, deduped by index and sorted for deterministic manifests."""
     tu = _tree_util()
     leaves_with_paths, _ = tu.tree_flatten_with_path(state)
     return [
-        (tu.keystr(path), np.asarray(leaf))
+        (tu.keystr(path), _snapshot_leaf(leaf))
         for path, leaf in leaves_with_paths
     ]
+
+
+def _snapshot_leaf(leaf: Any):
+    if getattr(leaf, "is_fully_addressable", True) or not hasattr(
+        leaf, "addressable_shards"
+    ):
+        return np.asarray(leaf)
+    global_shape = tuple(int(d) for d in leaf.shape)
+    shards: dict = {}
+    for shard in leaf.addressable_shards:
+        index = _normalize_index(shard.index, global_shape)
+        if index not in shards:  # replicas on sibling local devices
+            shards[index] = np.asarray(shard.data)
+    return {"global_shape": global_shape, "shards": sorted(shards.items())}
+
+
+def _normalize_index(index, global_shape) -> tuple:
+    """A shard index (jax's tuple of slices) as hashable, JSON-able
+    ``((start, stop), ...)`` pairs covering every dimension."""
+    out = []
+    for dim, sl in zip(global_shape, index):
+        start, stop, step = sl.indices(dim)
+        if step != 1:
+            raise ValueError(f"non-contiguous shard slice {sl!r}")
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """np.dtype for a manifest dtype name. ml_dtypes names (bfloat16,
+    int4, the fp8 family) are not resolvable by numpy's string lookup, so
+    fall back to the ml_dtypes attribute of the same name; a name neither
+    knows makes the checkpoint unreadable — CorruptCheckpointError, so
+    restore quarantines and falls back instead of crashing."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+    except (ImportError, AttributeError, TypeError) as err:
+        raise CorruptCheckpointError(
+            f"unknown dtype {name!r}: {err}"
+        ) from err
 
 
 def _load_validated(step_dir: Path) -> tuple:
     """(arrays, metadata) for a committed step, re-verifying sizes and
     CRC32s against the manifest. Raises CorruptCheckpointError on ANY
-    mismatch — a checkpoint is valid entirely or not at all."""
+    mismatch — a checkpoint is valid entirely or not at all. Shard
+    entries of one leaf are grouped into a single
+    ``{"global_shape", "dtype", "shards"}`` record."""
     manifest_path = step_dir / MANIFEST_NAME
     if not manifest_path.exists():
         raise CorruptCheckpointError("manifest missing")
@@ -442,7 +690,8 @@ def _load_validated(step_dir: Path) -> tuple:
         raise CorruptCheckpointError(
             f"unknown manifest format {manifest.get('format')!r}"
         )
-    arrays = []
+    arrays: list = []
+    sharded: dict = {}
     for entry in manifest.get("files", []):
         fpath = step_dir / entry["name"]
         try:
@@ -457,8 +706,29 @@ def _load_validated(step_dir: Path) -> tuple:
             )
         if (zlib.crc32(data) & 0xFFFFFFFF) != entry["crc32"]:
             raise CorruptCheckpointError(f"{entry['name']}: CRC32 mismatch")
-        arr = np.frombuffer(data, dtype=np.dtype(entry["dtype"]))
-        arrays.append((entry["path"], arr.reshape(entry["shape"])))
+        arr = np.frombuffer(data, dtype=_resolve_dtype(entry["dtype"]))
+        try:
+            arr = arr.reshape(entry["shape"])
+        except ValueError as err:  # manifest shape/size disagree
+            raise CorruptCheckpointError(
+                f"{entry['name']}: {err}"
+            ) from err
+        shard = entry.get("shard")
+        if shard is None:
+            arrays.append((entry["path"], arr))
+            continue
+        rec = sharded.get(entry["path"])
+        if rec is None:
+            rec = {
+                "global_shape": tuple(shard["global_shape"]),
+                "dtype": arr.dtype,
+                "shards": [],
+            }
+            sharded[entry["path"]] = rec
+            arrays.append((entry["path"], rec))
+        rec["shards"].append(
+            (tuple((int(a), int(b)) for a, b in shard["index"]), arr)
+        )
     return arrays, dict(manifest.get("metadata", {}))
 
 
@@ -475,20 +745,69 @@ def _restore_into_template(template: Any, arrays: list, step_dir: Path) -> Any:
             "different model/optimizer structure?"
         )
     placed = []
-    for (path, leaf), (saved_path, arr) in zip(leaves_with_paths, arrays):
+    for (path, leaf), (saved_path, value) in zip(leaves_with_paths, arrays):
         key = tu.keystr(path)
         if key != saved_path:
             raise ValueError(
                 f"template leaf {key} does not match checkpoint leaf "
                 f"{saved_path} in {step_dir.name}"
             )
-        if hasattr(leaf, "sharding"):
+        if isinstance(value, dict):  # saved as per-process shards
+            placed.append(_assemble_sharded(leaf, value, key, step_dir))
+        elif hasattr(leaf, "sharding"):
             import jax
 
-            placed.append(jax.device_put(arr, leaf.sharding))
+            placed.append(jax.device_put(value, leaf.sharding))
         else:
-            placed.append(arr)
+            # frombuffer views are read-only; the restored state must be
+            # as mutable as the state that was saved.
+            placed.append(value.copy())
     return tu.tree_unflatten(treedef, placed)
+
+
+def _assemble_sharded(leaf: Any, rec: dict, key: str, step_dir: Path) -> Any:
+    """Rebuild a leaf saved as per-process shards. With a sharded template
+    leaf the shards land directly on this process's devices
+    (``jax.make_array_from_single_device_arrays`` — the exact inverse of
+    the save, no host gather). A plain template leaf gets a dense
+    np.ndarray, valid only when this process's shards cover the whole
+    array (single-host validation tooling)."""
+    global_shape = rec["global_shape"]
+    shards = dict(rec["shards"])
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None and hasattr(
+        sharding, "addressable_devices_indices_map"
+    ):
+        import jax
+
+        per_device = []
+        mapping = sharding.addressable_devices_indices_map(global_shape)
+        for device, nd_index in mapping.items():
+            index = _normalize_index(nd_index, global_shape)
+            arr = shards.get(index)
+            if arr is None:
+                raise ValueError(
+                    f"checkpoint {step_dir.name} leaf {key}: no saved shard "
+                    f"for index {index} — sharding or process topology "
+                    "changed since the save?"
+                )
+            per_device.append(jax.device_put(arr, device))
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, per_device
+        )
+    out = np.zeros(global_shape, dtype=rec["dtype"])
+    seen = np.zeros(global_shape, dtype=bool)
+    for index, arr in shards.items():
+        region = tuple(slice(a, b) for a, b in index)
+        out[region] = arr
+        seen[region] = True
+    if not seen.all():
+        raise ValueError(
+            f"checkpoint {step_dir.name} leaf {key}: this process's shards "
+            "do not cover the whole array; restore into a template carrying "
+            "the original sharding"
+        )
+    return out
 
 
 # -- training loop -----------------------------------------------------------

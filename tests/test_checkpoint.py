@@ -4,10 +4,12 @@ SIGKILL/SIGTERM crash paths, exact data-loader cursor resume)."""
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from pathlib import Path
 
@@ -474,6 +476,287 @@ def test_train_loop_tolerates_empty_batches(tmp_path):
     ckpt = CheckpointManager(tmp_path / "empty")
     state, losses = train_with_checkpointing(step_fn, 5, [], ckpt)
     assert state == 5 and losses == []
+
+
+def test_async_worker_survives_unserializable_metadata(tmp_path):
+    """A save whose metadata json.dumps cannot serialize must not kill the
+    worker thread: the failure is recorded, the queue still drains (wait()
+    and close() never hang), and the NEXT save commits."""
+    ckpt = CheckpointManager(tmp_path / "poison", async_save=True)
+    assert ckpt.save(1, {"w": np.zeros(4)}, metadata={"bad": object()})
+    assert ckpt.wait(timeout=30)
+    assert ckpt.save_failures == 1
+    assert isinstance(ckpt.last_save_error, TypeError)
+    assert ckpt.latest_step() is None
+    assert ckpt.save(2, {"w": np.ones(4)})
+    assert ckpt.wait(timeout=30)
+    assert ckpt.latest_step() == 2
+    ckpt.close()
+
+
+def _dead_thread():
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    t.join()
+    return t
+
+
+def test_ensure_worker_restarts_dead_worker(tmp_path):
+    """Belt and braces for worker death _drain cannot guard: save() must
+    restart the worker instead of enqueueing to nobody."""
+    ckpt = CheckpointManager(tmp_path / "dead", async_save=True)
+    assert ckpt.save(1, {"w": np.zeros(4)})
+    assert ckpt.wait(timeout=30)
+    ckpt.close()  # retire the real worker; then fake one that died
+    ckpt._worker = _dead_thread()
+    assert ckpt.save(2, {"w": np.ones(4)})
+    assert ckpt.wait(timeout=30)
+    assert ckpt.latest_step() == 2
+    ckpt.close()
+
+
+def test_wait_reports_dead_worker_instead_of_hanging(tmp_path):
+    """wait()/close() on a queue nobody drains must fail fast, not block
+    forever in queue.join()."""
+    import queue as queue_mod
+
+    ckpt = CheckpointManager(tmp_path / "wedge", async_save=True)
+    assert ckpt.save(1, {"w": np.zeros(4)})
+    assert ckpt.wait(timeout=30)
+    ckpt.close()  # retire the real worker, then fake a wedged state:
+    ckpt._queue = queue_mod.Queue()
+    ckpt._queue.put((2, [("['w']", np.ones(4))], {}))  # nobody drains this
+    ckpt._worker = _dead_thread()
+    t0 = time.monotonic()
+    assert ckpt.wait(timeout=30) is False
+    assert ckpt.wait() is False
+    assert time.monotonic() - t0 < 5.0
+    ckpt.close()  # must not hang either
+
+
+def test_emergency_save_survives_held_queue_mutex(tmp_path):
+    """SIGTERM can land while the interrupted thread is INSIDE
+    queue.Queue.put, holding the queue's non-reentrant mutex. The
+    emergency drain is time-bounded, so the newest pending state still
+    commits well inside the grace budget."""
+    ckpt = CheckpointManager(
+        tmp_path / "mutex", async_save=True, save_interval_steps=100
+    )
+    assert ckpt.save(1, {"w": np.zeros(4)})
+    assert ckpt.wait(timeout=30)
+    assert not ckpt.save(2, {"w": np.ones(4)})  # pending only
+    t0 = time.monotonic()
+    with ckpt._queue.mutex:  # what an interrupted put() looks like
+        assert ckpt.emergency_save(grace_s=4.0)
+    assert time.monotonic() - t0 < 4.0
+    assert ckpt.latest_step() == 2
+    ckpt.close()
+
+
+def test_sigterm_handler_defers_save_to_thread(tmp_path):
+    """install_preemption_handler must not run queue operations in signal
+    context: with the queue mutex held by the 'interrupted' code, the
+    deferred emergency save still commits and the handler still chains."""
+    from kubeflow_tpu.runtime.bootstrap import install_preemption_handler
+
+    ckpt = CheckpointManager(
+        tmp_path / "sig", async_save=True, save_interval_steps=100
+    )
+    assert ckpt.save(1, {"w": np.zeros(4)})
+    assert ckpt.wait(timeout=30)
+    assert not ckpt.save(2, {"w": np.full(4, 2.0)})
+    received = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: received.append(s))
+    try:
+        uninstall = install_preemption_handler(
+            ckpt, env={"TPU_CHECKPOINT_GRACE_S": "4"}
+        )
+        with ckpt._queue.mutex:
+            signal.raise_signal(signal.SIGTERM)
+        assert received == [signal.SIGTERM], "must chain to prior handler"
+        assert ckpt.latest_step() == 2
+        uninstall()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    ckpt.close()
+
+
+def test_ml_dtypes_round_trip_and_unknown_dtype_is_corruption(tmp_path):
+    """bfloat16 resolves through the lazy ml_dtypes fallback (numpy's
+    string lookup raises TypeError on it), and a manifest naming a dtype
+    nobody knows is CORRUPTION — quarantine + fall back, never a crash."""
+    import ml_dtypes
+
+    from kubeflow_tpu.metrics import Metrics
+
+    workdir = tmp_path / "mldt"
+    ckpt = CheckpointManager(workdir)
+    assert ckpt.save(1, {"w": np.arange(8, dtype=ml_dtypes.bfloat16)})
+    assert ckpt.save(2, {"w": np.ones(8, dtype=ml_dtypes.bfloat16)}, force=True)
+    restored, at = ckpt.restore_latest(
+        {"w": np.zeros(8, dtype=ml_dtypes.bfloat16)}
+    )
+    assert at == 2
+    assert restored["w"].dtype == ml_dtypes.bfloat16
+
+    manifest_path = workdir / "2" / "manifest.json"
+    blob = json.loads(manifest_path.read_text())
+    for entry in blob["files"]:
+        entry["dtype"] = "definitely-not-a-dtype"
+    manifest_path.write_text(json.dumps(blob))
+    m = Metrics()
+    mgr2 = CheckpointManager(workdir, metrics=m)
+    restored, at = mgr2.restore_latest(
+        {"w": np.zeros(8, dtype=ml_dtypes.bfloat16)}
+    )
+    assert at == 1
+    assert _counter(m.checkpoint_corrupt_total) == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"], dtype=np.float32),
+        np.arange(8, dtype=np.float32),
+    )
+
+
+def test_restored_numpy_leaves_are_writable(tmp_path):
+    """np.frombuffer views are read-only; the restored state must be as
+    mutable as the state that was saved."""
+    ckpt = CheckpointManager(tmp_path / "rw")
+    assert ckpt.save(1, {"w": np.arange(4.0)})
+    restored, at = ckpt.restore_latest({"w": np.zeros(4)})
+    assert at == 1
+    assert restored["w"].flags.writeable
+    restored["w"] += 1.0
+    np.testing.assert_array_equal(restored["w"], np.arange(4.0) + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Multi-host: per-process roots, addressable-shard serialization
+
+
+class _FakeGlobalArray:
+    """A jax.Array spanning non-addressable devices, as one process sees
+    it: np.asarray on it is exactly the multi-host crash the snapshot
+    must never trigger."""
+
+    is_fully_addressable = False
+
+    def __init__(self, arr):
+        self._arr = arr
+        self.shape = arr.shape
+        self.dtype = arr.dtype
+
+    @property
+    def addressable_shards(self):
+        return self._arr.addressable_shards
+
+    def __array__(self, *args, **kwargs):
+        raise RuntimeError("np.asarray on a non-addressable jax.Array")
+
+
+def _sharded_test_array():
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("x",))
+    data = np.arange(64, dtype=np.float32).reshape(8, 8)
+    arr = jax.device_put(data, NamedSharding(mesh, PartitionSpec("x", None)))
+    return data, arr
+
+
+def test_multihost_sharded_save_and_restore_via_sharding_tree(tmp_path):
+    """Non-fully-addressable leaves are saved as this process's
+    addressable shards — never gathered to one host — and restored
+    straight into the template's sharding via
+    make_array_from_single_device_arrays."""
+    data, arr = _sharded_test_array()
+    root = tmp_path / "mh"
+    managers = [
+        CheckpointManager(root, process_index=k, process_count=2)
+        for k in range(2)
+    ]
+    state = {"step": np.int64(3), "w": _FakeGlobalArray(arr)}
+    for mgr in managers:
+        assert mgr.save(1, state)
+    assert (root / "proc0" / "1" / "manifest.json").exists()
+    assert (root / "proc1" / "1" / "manifest.json").exists()
+
+    template = {
+        "step": np.int64(0),
+        "w": jax.device_put(np.zeros_like(data), arr.sharding),
+    }
+    restored, at = managers[0].restore_latest(template)
+    assert at == 1
+    assert restored["w"].sharding == arr.sharding
+    np.testing.assert_array_equal(np.asarray(restored["w"]), data)
+    assert int(restored["step"]) == 3
+
+    # A plain template assembles a dense host array (validation tooling).
+    dense, at = managers[1].restore_latest(
+        {"step": np.int64(0), "w": np.zeros_like(data)}
+    )
+    assert at == 1
+    np.testing.assert_array_equal(dense["w"], data)
+    for mgr in managers:
+        mgr.close()
+
+
+def test_multihost_step_requires_every_process_commit(tmp_path):
+    """A step only one host committed (the other died mid-save) is NOT
+    restorable: latest_step/restore intersect across the proc roots, so
+    every survivor falls back to the same fully-committed step."""
+    root = tmp_path / "partial"
+    m0 = CheckpointManager(root, process_index=0, process_count=2)
+    m1 = CheckpointManager(root, process_index=1, process_count=2)
+    assert m0.save(1, {"w": np.arange(4.0)})
+    assert m1.save(1, {"w": np.arange(4.0)})
+    assert m0.save(2, {"w": np.ones(4)})  # host 1 "died" before step 2
+    assert m0.latest_step() == 1 and m1.latest_step() == 1
+    for mgr in (m0, m1):
+        restored, at = mgr.restore_latest({"w": np.zeros(4)})
+        assert at == 1
+        np.testing.assert_array_equal(restored["w"], np.arange(4.0))
+
+
+def test_multihost_quarantine_breaks_global_commit(tmp_path):
+    """Bit-rot on one host's copy quarantines it there AND removes the
+    step from every later restore's intersection — no cross-host
+    divergence on the fallback step."""
+    root = tmp_path / "mq"
+    m0 = CheckpointManager(root, process_index=0, process_count=2)
+    m1 = CheckpointManager(root, process_index=1, process_count=2)
+    for s in (1, 2):
+        assert m0.save(s, {"w": np.full(4, float(s))}, force=True)
+        assert m1.save(s, {"w": np.full(4, float(s))}, force=True)
+    victim = next((root / "proc0" / "2").glob("*.bin"))
+    blob = bytearray(victim.read_bytes())
+    blob[0] ^= 0xFF
+    victim.write_bytes(bytes(blob))
+
+    restored, at = m0.restore_latest({"w": np.zeros(4)})
+    assert at == 1
+    restored, at = m1.restore_latest({"w": np.zeros(4)})
+    assert at == 1
+    np.testing.assert_array_equal(restored["w"], np.full(4, 1.0))
+
+
+def test_single_process_manager_rejects_nonaddressable_state(tmp_path):
+    """Without multi-host identity, saving a non-addressable array must
+    fail with instructions — not crash later inside np.asarray."""
+    data, arr = _sharded_test_array()
+    ckpt = CheckpointManager(tmp_path / "lone")
+    with pytest.raises(RuntimeError, match="process_count"):
+        ckpt.save(1, {"w": _FakeGlobalArray(arr)})
+
+
+def test_process_identity_from_webhook_env(tmp_path):
+    """The webhook's TPU env contract places each host in its own proc
+    root without the notebook passing anything explicitly."""
+    env = {"TPU_WORKER_ID": "1", "TPU_WORKER_HOSTNAMES": "h0,h1"}
+    ckpt = CheckpointManager(tmp_path / "envd", env=env)
+    assert (ckpt.process_index, ckpt.process_count) == (1, 2)
+    assert ckpt.save(1, {"w": np.zeros(2)})
+    assert (tmp_path / "envd" / "proc1" / "1" / "manifest.json").exists()
+    # Not restorable until proc0 commits the step too.
+    assert ckpt.latest_step() is None
 
 
 def test_checkpoint_metadata_carries_loader_cursor(tmp_path, tiny_trainer):
