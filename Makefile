@@ -55,6 +55,9 @@ bench-smoke: ## Every bench section at toy shapes on CPU (executability gate)
 dryrun: ## Multi-chip sharding compile check on a virtual 8-device mesh
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+lint: ## kftpu-lint: AST engine with cross-module contract checks (+ semgrep if present)
+	bash ci/lint.sh
+
 native: ## Build native C++ components (data loader, slice prober)
 	$(MAKE) -C native
 

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Static-analysis gate, two tiers:
+#   1. kftpu-lint — the in-repo AST engine (kubeflow_tpu/analysis): cross-
+#      module contract checks (env contract, metric registry, annotation
+#      vocabulary, chaos parity) plus concurrency lints. JSON mode; any
+#      unsuppressed finding fails the build. Required — it runs on the
+#      same Python the tests use.
+#   2. semgrep — the pattern tier (semgrep.yaml). Optional: skipped with a
+#      notice when the tool is unavailable, mirroring ci/kind_e2e.sh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "--- kftpu-lint (AST engine, JSON mode)"
+out=$(mktemp)
+if ! python -m kubeflow_tpu.analysis kubeflow_tpu/ --format json > "$out"; then
+  echo "FAIL: unsuppressed kftpu-lint findings:"
+  python -m kubeflow_tpu.analysis kubeflow_tpu/ || true
+  rm -f "$out"
+  exit 1
+fi
+python - "$out" <<'EOF'
+import json, sys
+report = json.load(open(sys.argv[1]))
+print(
+    f"kftpu-lint: {report['checked_files']} files checked, "
+    f"{report['unsuppressed']} unsuppressed, "
+    f"{report['suppressed']} suppressed"
+)
+EOF
+rm -f "$out"
+
+if command -v semgrep >/dev/null 2>&1; then
+  echo "--- semgrep (pattern tier)"
+  semgrep scan --config semgrep.yaml --error --quiet kubeflow_tpu/
+else
+  echo "SKIP: semgrep not available; the AST engine above is the required tier"
+fi

@@ -1,0 +1,20 @@
+"""kftpu-lint: the in-repo AST analysis engine.
+
+The reference repo's only correctness tooling is a pattern-level semgrep
+ruleset; patterns cannot see across files, and the bug classes this repo
+actually shipped (PR 3's blocking-queue-op-inside-a-signal-handler
+deadlock, env-contract literals drifting between webhook and runtime) are
+exactly the cross-file ones. This package loads the repo into per-module
+ASTs plus a cross-module index (ENV_CONTRACT, registered metrics,
+annotation constants, chaos-catalog handlers) and evaluates two rule
+families: single-module concurrency/safety rules and cross-module
+contract rules. See ARCHITECTURE.md §static-analysis.
+
+Run it:  python -m kubeflow_tpu.analysis [paths ...] [--format json]
+Gate:    tests/test_analysis.py asserts zero unsuppressed findings on
+         kubeflow_tpu/ (tier-1).
+"""
+
+from kubeflow_tpu.analysis.core import Finding  # noqa: F401
+from kubeflow_tpu.analysis.engine import Report, run_analysis  # noqa: F401
+from kubeflow_tpu.analysis.rules import ALL_RULES, rule_ids  # noqa: F401

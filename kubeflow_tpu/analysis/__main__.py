@@ -1,0 +1,54 @@
+"""CLI: python -m kubeflow_tpu.analysis [paths ...] [--format json]."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kubeflow_tpu.analysis.engine import run_analysis
+from kubeflow_tpu.analysis.rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubeflow_tpu.analysis",
+        description=(
+            "kftpu-lint: AST analysis with cross-module contract checks. "
+            "Exits 1 when unsuppressed findings exist."
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to check (default: kubeflow_tpu/)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (json includes suppressed findings with flags)",
+    )
+    parser.add_argument(
+        "--include-suppressed", action="store_true",
+        help="text mode: also print suppressed findings",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print every rule id and description, then exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id}\n    {' '.join(rule.description.split())}")
+        print("parse-error\n    File could not be parsed as Python (engine-emitted).")
+        return 0
+
+    report = run_analysis(paths=args.paths or None)
+    if args.format == "json":
+        print(json.dumps(report.as_dict(), indent=2, sort_keys=True))
+    else:
+        print(report.render_text(include_suppressed=args.include_suppressed))
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

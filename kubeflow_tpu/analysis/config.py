@@ -1,0 +1,86 @@
+"""kftpu-lint configuration: contract homes and declared allowlists.
+
+Every allowlist entry carries a mandatory reason string, mirroring the
+inline-suppression rule: nothing gets exempted silently.
+"""
+
+from __future__ import annotations
+
+import re
+
+# -- contract homes (repo-relative posix paths / prefixes) -------------------
+
+# THE spelling sites for platform env var names. A TPU_*/JAX_*/MEGASCALE_*/
+# KUBEFLOW_TPU_* string literal anywhere else is a finding.
+ENV_CONTRACT_MODULE = "kubeflow_tpu/webhook/tpu_env.py"
+ENV_NAME_HOMES = (
+    ENV_CONTRACT_MODULE,
+    "kubeflow_tpu/api/annotations.py",
+)
+
+# THE spelling site for notebooks.kubeflow.org/* style annotation, label,
+# and finalizer keys (plus the rest of the kubeflow_tpu/api constants).
+ANNOTATION_HOME_PREFIX = "kubeflow_tpu/api/"
+
+# Metric families register here and nowhere else.
+METRICS_MODULE = "kubeflow_tpu/metrics/metrics.py"
+
+# Chaos experiment handlers register here; chaos/experiments/*.yaml is the
+# declarative side of the same catalog.
+CHAOS_CATALOG_MODULE = "kubeflow_tpu/k8s/chaos_catalog.py"
+CHAOS_EXPERIMENTS_DIR = "chaos/experiments"
+
+# The linter does not lint its own rule tables (this package encodes the
+# contract names it checks for — every one would be a self-finding).
+SELF_PREFIX = "kubeflow_tpu/analysis/"
+
+# -- patterns ----------------------------------------------------------------
+
+ENV_NAME_RE = re.compile(r"^(TPU|JAX|MEGASCALE|KUBEFLOW_TPU)_[A-Z0-9_]+$")
+METRIC_NAME_RE = re.compile(r"^(tpu_|notebook_|last_notebook_)[a-z0-9_]+$")
+TPU_METRIC_RE = re.compile(r"^tpu_[a-z0-9_]+$")
+ANNOTATION_RE = re.compile(
+    r"^(notebooks\.(kubeflow|opendatahub)\.org|opendatahub\.io)/[A-Za-z0-9._/-]+$"
+)
+# Prometheus exposition suffixes a literal may legitimately carry on top
+# of the registered family name (Histogram series, counter _created).
+METRIC_SERIES_SUFFIXES = ("_count", "_sum", "_bucket", "_created")
+
+# -- allowlists --------------------------------------------------------------
+
+# Env vars that may be read without appearing in ENV_CONTRACT, and whose
+# names may be spelled at their owning read site: name -> reason.
+ENV_READ_ALLOWLIST = {
+    "JAX_PLATFORMS": (
+        "owned by the operator/test harness (backend selector); the "
+        "platform honors it but never produces it"
+    ),
+    "KUBEFLOW_TPU_FORCE_XLA_ATTENTION": (
+        "debug kill switch owned by ops/attention.py; deliberately not "
+        "part of the webhook contract"
+    ),
+}
+
+# The reference controller's metric set (notebook-controller
+# pkg/metrics/metrics.go:22-60) predates the tpu_* scheme; dashboards
+# already speak these names.
+REFERENCE_METRIC_NAMES = {
+    "notebook_create_total",
+    "notebook_create_failed_total",
+    "notebook_culling_total",
+    "last_notebook_culling_timestamp_seconds",
+    "notebook_running",
+}
+
+# Non-metric attributes and methods that legitimately hang off a Metrics
+# object (rule metric-attr-unregistered).
+METRICS_OBJECT_API = {
+    "registry",
+    "client",
+    "collect_running",
+    "expose",
+}
+
+# Prometheus metric constructor names (resolved through imports where
+# possible; a bare Name falls back to this set).
+PROM_CONSTRUCTORS = {"Counter", "Gauge", "Histogram", "Summary"}

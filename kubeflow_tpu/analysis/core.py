@@ -1,0 +1,251 @@
+"""kftpu-lint core: source modules, suppressions, constant resolution.
+
+Everything here is pure ``ast`` — the engine never imports the code it
+analyzes, so a module with a heavyweight import graph (jax, the webhook
+stack) costs the same to lint as a leaf utility, and a broken module
+surfaces as a ``parse-error`` finding instead of an ImportError.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterator, Optional
+
+# `# kftpu-lint: disable=<rule>[,<rule>...] — justification`
+# The separator before the justification may be an em dash, `--`, or `:`;
+# the justification itself is MANDATORY (enforced by the suppression rule,
+# which cannot itself be suppressed).
+SUPPRESS_RE = re.compile(
+    r"#\s*kftpu-lint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+    r"(?:\s*(?:—|--|:)\s*(.*?))?\s*$"
+)
+
+
+@dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    justification: str = ""
+
+    def render(self) -> str:
+        mark = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}{mark}"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "justification": self.justification,
+        }
+
+
+@dataclass
+class Suppression:
+    line: int  # 1-based line the comment sits on
+    rules: tuple
+    justification: str
+    own_line: bool  # a stand-alone comment also covers the next line
+
+    def covers(self, rule: str, line: int) -> bool:
+        if rule not in self.rules:
+            return False
+        return line == self.line or (self.own_line and line == self.line + 1)
+
+
+@dataclass
+class SourceModule:
+    """A parsed module plus the lookup tables the rules need."""
+
+    path: Path  # absolute
+    rel: str  # repo-relative posix path (display + home matching)
+    name: str  # dotted module name (kubeflow_tpu.webhook.tpu_env) or stem
+    tree: Optional[ast.Module]
+    lines: list = field(default_factory=list)
+    suppressions: list = field(default_factory=list)
+    # Module-level NAME = "literal" assignments (any scope's top level is
+    # fine for contract constants; we record module body only to keep the
+    # table honest about what other modules can import).
+    constants: dict = field(default_factory=dict)
+    # local binding -> dotted target. `import a.b.c` binds "a"->"a";
+    # `import a.b as x` binds "x"->"a.b"; `from a.b import N as y` binds
+    # "y"->"a.b.N". Function-local imports are included (lazy-import
+    # idiom is pervasive in runtime code).
+    imports: dict = field(default_factory=dict)
+    parents: dict = field(default_factory=dict)  # ast node -> parent node
+    parse_error: Optional[str] = None
+
+    def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
+        for sup in self.suppressions:
+            if sup.covers(rule, line):
+                return sup
+        return None
+
+    def walk(self) -> Iterator[ast.AST]:
+        if self.tree is None:
+            return iter(())
+        return ast.walk(self.tree)
+
+    def enclosing_function(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+
+def _parse_suppressions(lines: list) -> tuple:
+    sups, malformed = [], []
+    for i, raw in enumerate(lines, start=1):
+        if "kftpu-lint" not in raw or "disable" not in raw:
+            continue  # prose mention, not a suppression marker
+        m = SUPPRESS_RE.search(raw)
+        if not m:
+            # A kftpu-lint marker that doesn't parse is itself worth a
+            # finding — a typo'd suppression silently suppresses nothing.
+            malformed.append(i)
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(","))
+        justification = (m.group(2) or "").strip()
+        own_line = raw.lstrip().startswith("#")
+        sups.append(Suppression(i, rules, justification, own_line))
+    return sups, malformed
+
+
+def _collect_constants(tree: ast.Module) -> dict:
+    out = {}
+    for node in tree.body:
+        targets = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if not (isinstance(value, ast.Constant) and isinstance(value.value, str)):
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = value.value
+    return out
+
+
+def _collect_imports(tree: ast.Module, package: str) -> dict:
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    out[alias.asname] = alias.name
+                else:
+                    # `import a.b.c` binds only "a"; attribute chains are
+                    # resolved segment-by-segment in resolve_str.
+                    out.setdefault(alias.name.split(".")[0], alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                # Relative import: anchor on the module's own package.
+                parts = package.split(".") if package else []
+                parts = parts[: len(parts) - (node.level - 1)] if parts else []
+                base = ".".join(parts + ([node.module] if node.module else []))
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                out[alias.asname or alias.name] = f"{base}.{alias.name}" if base else alias.name
+    return out
+
+
+def load_module(path: Path, rel: str, name: str) -> SourceModule:
+    text = path.read_text(encoding="utf-8")
+    lines = text.splitlines()
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as err:
+        return SourceModule(
+            path, rel, name, None, lines, [], {}, {}, {},
+            parse_error=f"{err.msg} (line {err.lineno})",
+        )
+    sups, malformed = _parse_suppressions(lines)
+    package = name.rsplit(".", 1)[0] if "." in name else ""
+    mod = SourceModule(
+        path,
+        rel,
+        name,
+        tree,
+        lines,
+        sups,
+        _collect_constants(tree),
+        _collect_imports(tree, package),
+        {},
+    )
+    mod.malformed_suppression_lines = malformed
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            mod.parents[child] = parent
+    return mod
+
+
+# -- expression helpers ------------------------------------------------------
+
+
+def dotted_parts(node: ast.AST) -> Optional[list]:
+    """Flatten a Name/Attribute chain to its segments, or None."""
+    parts = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def resolved_callee(mod: SourceModule, call: ast.Call) -> Optional[str]:
+    """Canonical dotted name of the call target, first segment resolved
+    through the module's import table ('t.sleep' -> 'time.sleep',
+    from-imported 'sleep' -> 'time.sleep')."""
+    parts = dotted_parts(call.func)
+    if parts is None:
+        return None
+    head = mod.imports.get(parts[0], parts[0])
+    return ".".join([head] + parts[1:])
+
+
+def resolve_str(mod: SourceModule, node: ast.AST, index) -> Optional[str]:
+    """Resolve an expression to a compile-time string: a literal, a local
+    constant, or a (possibly aliased) reference to a constant in an
+    indexed module. None when not statically resolvable."""
+    if isinstance(node, ast.Constant):
+        return node.value if isinstance(node.value, str) else None
+    if isinstance(node, ast.Name):
+        if node.id in mod.constants:
+            return mod.constants[node.id]
+        target = mod.imports.get(node.id)
+        if target and "." in target:
+            owner, attr = target.rsplit(".", 1)
+            return index.get_constant(owner, attr)
+        return None
+    if isinstance(node, ast.Attribute):
+        parts = dotted_parts(node)
+        if not parts or len(parts) < 2:
+            return None
+        attr = parts[-1]
+        base = parts[:-1]
+        head = mod.imports.get(base[0], base[0])
+        owner = ".".join([head] + base[1:])
+        return index.get_constant(owner, attr)
+    return None

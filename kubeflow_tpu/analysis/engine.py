@@ -1,0 +1,136 @@
+"""kftpu-lint engine: load -> index -> check -> suppress -> report.
+
+The whole kubeflow_tpu package is always loaded into the index (contract
+tables live in webhook/, metrics/, api/, k8s/ and rules must resolve
+references into them no matter which subset of files is being checked);
+the target paths only decide which modules get *checked*.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+from kubeflow_tpu.analysis import config
+from kubeflow_tpu.analysis.core import Finding, load_module
+from kubeflow_tpu.analysis.index import RepoIndex
+from kubeflow_tpu.analysis.rules import ALL_RULES
+
+# Rules whose findings may never be suppressed: a suppressed suppression
+# problem (or parse error) would be invisible by construction.
+UNSUPPRESSABLE = {"suppression-hygiene", "parse-error"}
+
+PACKAGE_DIR = Path(__file__).resolve().parents[1]  # .../kubeflow_tpu
+REPO_ROOT = PACKAGE_DIR.parent
+
+
+@dataclass
+class Report:
+    findings: list = field(default_factory=list)
+    checked: list = field(default_factory=list)  # rel paths actually checked
+
+    @property
+    def unsuppressed(self) -> list:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> list:
+        return [f for f in self.findings if f.suppressed]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.unsuppressed else 0
+
+    def as_dict(self) -> dict:
+        return {
+            "checked_files": len(self.checked),
+            "findings": [f.as_dict() for f in self.findings],
+            "unsuppressed": len(self.unsuppressed),
+            "suppressed": len(self.suppressed),
+        }
+
+    def render_text(self, include_suppressed: bool = False) -> str:
+        shown = self.findings if include_suppressed else self.unsuppressed
+        lines = [f.render() for f in shown]
+        lines.append(
+            f"kftpu-lint: {len(self.checked)} files checked, "
+            f"{len(self.unsuppressed)} findings "
+            f"({len(self.suppressed)} suppressed)"
+        )
+        return "\n".join(lines)
+
+
+def _rel_and_name(path: Path, repo_root: Path) -> tuple:
+    try:
+        rel = path.relative_to(repo_root).as_posix()
+    except ValueError:
+        return path.name, path.stem
+    return rel, rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+
+
+def _iter_py_files(target: Path) -> Iterable[Path]:
+    if target.is_file():
+        if target.suffix == ".py":
+            yield target
+        return
+    for path in sorted(target.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        yield path
+
+
+def run_analysis(
+    paths: Optional[Iterable] = None,
+    repo_root: Optional[Path] = None,
+) -> Report:
+    root = Path(repo_root).resolve() if repo_root else REPO_ROOT
+    targets = [Path(p).resolve() for p in (paths or [])] or [root / "kubeflow_tpu"]
+
+    index = RepoIndex(root)
+    package_dir = root / "kubeflow_tpu"
+    if package_dir.is_dir():
+        for path in _iter_py_files(package_dir):
+            rel, name = _rel_and_name(path, root)
+            index.add(load_module(path, rel, name))
+
+    checked: dict = {}  # rel -> SourceModule
+    for target in targets:
+        for path in _iter_py_files(target):
+            rel, name = _rel_and_name(path, root)
+            mod = index.by_rel.get(rel)
+            if mod is None:
+                mod = load_module(path, rel, name)
+                index.add(mod)
+            if rel.startswith(config.SELF_PREFIX):
+                continue  # the linter's own tables encode the checked names
+            checked[rel] = mod
+
+    index.build()
+
+    findings: list = []
+    for rel in sorted(checked):
+        mod = checked[rel]
+        if mod.parse_error is not None:
+            findings.append(
+                Finding("parse-error", rel, 1, 0, f"cannot parse: {mod.parse_error}")
+            )
+            continue
+        for rule in ALL_RULES:
+            findings.extend(rule.check_module(mod, index))
+    for rule in ALL_RULES:
+        findings.extend(rule.check_repo(index, checked))
+
+    for finding in findings:
+        if finding.rule in UNSUPPRESSABLE:
+            continue
+        mod = index.by_rel.get(finding.path)
+        if mod is None:
+            continue
+        sup = mod.suppression_for(finding.rule, finding.line)
+        if sup is not None and sup.justification:
+            finding.suppressed = True
+            finding.justification = sup.justification
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return Report(findings=findings, checked=sorted(checked))

@@ -1,0 +1,175 @@
+"""kftpu-lint cross-module index.
+
+The piece pattern-level tools cannot build: one pass over the repo
+collects every contract surface — the env-var contract table, registered
+metric families, the api/ constants vocabulary, chaos-catalog handler
+registrations and the declarative experiment YAMLs — so rules can answer
+"is this name part of the contract?" instead of "does this line match?".
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Optional
+
+from kubeflow_tpu.analysis import config
+from kubeflow_tpu.analysis.core import SourceModule, dotted_parts, resolved_callee
+
+
+def _assign_targets(node: ast.AST):
+    """Normalize Assign/AnnAssign to (targets, value); ([], None) otherwise."""
+    if isinstance(node, ast.Assign):
+        return node.targets, node.value
+    if isinstance(node, ast.AnnAssign) and node.value is not None:
+        return [node.target], node.value
+    return [], None
+
+
+class RepoIndex:
+    def __init__(self, repo_root: Path):
+        self.repo_root = repo_root
+        self.modules: dict = {}  # dotted name -> SourceModule
+        self.by_rel: dict = {}  # rel path -> SourceModule
+        # env contract: var name -> producer description
+        self.env_contract: dict = {}
+        self.env_contract_line = 0
+        # metrics: Metrics attribute -> family name, plus the name set
+        self.metric_attrs: dict = {}
+        self.metric_names: set = set()
+        # chaos catalog: injection types from the three registration sites
+        self.chaos_injection_types: set = set()
+        self.chaos_injection_line = 0
+        self.chaos_handler_types: set = set()
+        self.chaos_handler_line = 0
+        self.chaos_target_kinds: set = set()
+        self.chaos_target_line = 0
+        # chaos YAMLs: injection type -> rel path of the experiment file
+        self.chaos_yaml_types: dict = {}
+        self.chaos_yaml_error: Optional[str] = None
+
+    def add(self, mod: SourceModule) -> None:
+        self.modules[mod.name] = mod
+        self.by_rel[mod.rel] = mod
+
+    def get_constant(self, owner: str, attr: str) -> Optional[str]:
+        mod = self.modules.get(owner)
+        if mod is None:
+            return None
+        return mod.constants.get(attr)
+
+    # -- builders ------------------------------------------------------------
+
+    def build(self) -> None:
+        env_mod = self.by_rel.get(config.ENV_CONTRACT_MODULE)
+        if env_mod is not None:
+            self._index_env_contract(env_mod)
+        metrics_mod = self.by_rel.get(config.METRICS_MODULE)
+        if metrics_mod is not None:
+            self._index_metrics(metrics_mod)
+        chaos_mod = self.by_rel.get(config.CHAOS_CATALOG_MODULE)
+        if chaos_mod is not None:
+            self._index_chaos_catalog(chaos_mod)
+        self._index_chaos_yamls()
+
+    def _index_env_contract(self, mod: SourceModule) -> None:
+        for node in mod.walk():
+            targets, dict_value = _assign_targets(node)
+            if not any(
+                isinstance(t, ast.Name) and t.id == "ENV_CONTRACT" for t in targets
+            ):
+                continue
+            if not isinstance(dict_value, ast.Dict):
+                continue
+            self.env_contract_line = node.lineno
+            from kubeflow_tpu.analysis.core import resolve_str
+
+            for key, value in zip(dict_value.keys, dict_value.values):
+                name = resolve_str(mod, key, self) if key is not None else None
+                if name is None:
+                    continue
+                desc = value.value if isinstance(value, ast.Constant) else ""
+                self.env_contract[name] = desc if isinstance(desc, str) else ""
+
+    def _index_metrics(self, mod: SourceModule) -> None:
+        for node in mod.walk():
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            callee = resolved_callee(mod, node.value) or ""
+            leaf = callee.rsplit(".", 1)[-1]
+            if leaf not in config.PROM_CONSTRUCTORS:
+                continue
+            if not (
+                node.value.args
+                and isinstance(node.value.args[0], ast.Constant)
+                and isinstance(node.value.args[0].value, str)
+            ):
+                continue
+            family = node.value.args[0].value
+            self.metric_names.add(family)
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    self.metric_attrs[t.attr] = family
+                elif isinstance(t, ast.Name):
+                    self.metric_attrs[t.id] = family
+
+    def _index_chaos_catalog(self, mod: SourceModule) -> None:
+        for node in mod.walk():
+            targets, value = _assign_targets(node)
+            for t in targets:
+                tname = t.id if isinstance(t, ast.Name) else (
+                    t.attr if isinstance(t, ast.Attribute) else None
+                )
+                if tname == "INJECTION_TYPES" and isinstance(
+                    value, (ast.Tuple, ast.List)
+                ):
+                    self.chaos_injection_line = node.lineno
+                    for elt in value.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            self.chaos_injection_types.add(elt.value)
+                elif tname == "TARGET_KIND_FOR_INJECTION" and isinstance(
+                    value, ast.Dict
+                ):
+                    self.chaos_target_line = node.lineno
+                    for key in value.keys:
+                        if isinstance(key, ast.Constant) and isinstance(
+                            key.value, str
+                        ):
+                            self.chaos_target_kinds.add(key.value)
+                elif tname == "_handlers" and isinstance(value, ast.Dict):
+                    self.chaos_handler_line = node.lineno
+                    for key in value.keys:
+                        if isinstance(key, ast.Constant) and isinstance(
+                            key.value, str
+                        ):
+                            self.chaos_handler_types.add(key.value)
+
+    def _index_chaos_yamls(self) -> None:
+        exp_dir = self.repo_root / config.CHAOS_EXPERIMENTS_DIR
+        if not exp_dir.is_dir():
+            return
+        try:
+            import yaml
+        except ImportError:  # pragma: no cover - yaml ships with the repo
+            self.chaos_yaml_error = "pyyaml unavailable; chaos parity skipped"
+            return
+        for path in sorted(exp_dir.glob("*.yaml")):
+            rel = path.relative_to(self.repo_root).as_posix()
+            try:
+                docs = list(yaml.safe_load_all(path.read_text()))
+            except Exception as err:  # malformed YAML is a parity finding
+                self.chaos_yaml_types[f"<unparseable:{rel}>"] = rel
+                self.chaos_yaml_error = f"{rel}: {err}"
+                continue
+            for doc in docs:
+                if not isinstance(doc, dict):
+                    continue
+                itype = (
+                    doc.get("spec", {}).get("injection", {}).get("type")
+                )
+                if isinstance(itype, str):
+                    self.chaos_yaml_types.setdefault(itype, rel)

@@ -1,0 +1,740 @@
+"""kftpu-lint rules.
+
+Two families:
+
+- single-module concurrency/safety rules — the bug classes this repo has
+  actually shipped (PR 3's emergency-save deadlock was a blocking queue
+  op inside a SIGTERM handler) plus the reconcile-loop disciplines the
+  controller tier depends on;
+- cross-module contract rules — names that must agree across layers
+  (webhook env contract <-> runtime reads, metric registrations <-> emit
+  sites, api/ annotation vocabulary, chaos YAMLs <-> catalog handlers),
+  resolved through the RepoIndex instead of string matching.
+
+Every rule is pure AST: no code under analysis is imported.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from kubeflow_tpu.analysis import config
+from kubeflow_tpu.analysis.core import (
+    Finding,
+    SourceModule,
+    dotted_parts,
+    resolve_str,
+    resolved_callee,
+)
+
+
+class Rule:
+    id = ""
+    description = ""
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        return []
+
+    def check_repo(self, index, checked: dict) -> list:
+        return []
+
+    def finding(self, mod: SourceModule, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            self.id, mod.rel, getattr(node, "lineno", 1),
+            getattr(node, "col_offset", 0), message,
+        )
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+
+def _direct_nodes(stmts) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/lambda
+    bodies: a nested def only runs when called, and calls are followed
+    explicitly by the reachability walker."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _receiver_leaf(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        parts = dotted_parts(call.func.value)
+        if parts:
+            return parts[-1]
+    return None
+
+
+def _queueish(call: ast.Call) -> bool:
+    leaf = _receiver_leaf(call)
+    if leaf is None:
+        return False
+    low = leaf.lower()
+    return low == "q" or "queue" in low
+
+
+def _kwarg_names(call: ast.Call) -> set:
+    return {kw.arg for kw in call.keywords if kw.arg}
+
+
+def _blocking_reason(
+    mod: SourceModule, call: ast.Call, in_signal_handler: bool
+) -> Optional[str]:
+    """Why this call can block indefinitely, or None. Calls that pass an
+    explicit bound (join/acquire/wait with a timeout, queue ops with
+    block=False or timeout=) are treated as deliberate and allowed."""
+    callee = resolved_callee(mod, call)
+    if callee == "time.sleep":
+        return "time.sleep()"
+    if callee and callee.endswith("urlopen"):
+        return "network I/O (urlopen)"
+    if in_signal_handler and callee == "open":
+        return "file I/O (open())"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    bare = not call.args and not call.keywords
+    if attr == "acquire" and bare and in_signal_handler:
+        return "unbounded lock acquire()"
+    if attr == "join" and bare:
+        # Zero-arg join() is Thread.join()/Queue.join() without a bound;
+        # str.join always takes an iterable, so no collision.
+        if not isinstance(call.func.value, ast.Constant):
+            return "unbounded join()"
+    if attr == "wait" and bare and in_signal_handler:
+        return "unbounded wait()"
+    if attr in ("put", "get") and _queueish(call):
+        kwargs = _kwarg_names(call)
+        if "timeout" in kwargs:
+            return None
+        for kw in call.keywords:
+            if kw.arg == "block" and isinstance(kw.value, ast.Constant) and not kw.value.value:
+                return None
+        return f"blocking queue .{attr}()"
+    return None
+
+
+def _function_defs(mod: SourceModule) -> dict:
+    defs: dict = {}
+    for node in mod.walk():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+# -- family 1: single-module concurrency/safety ------------------------------
+
+
+class BlockingInSignalHandler(Rule):
+    id = "blocking-in-signal-handler"
+    description = (
+        "Blocking call (queue op, lock acquire, sleep, unbounded join, "
+        "file I/O) reachable from a function registered with "
+        "signal.signal. The signal may have interrupted the current "
+        "owner of the very mutex the call needs (PR 3's emergency-save "
+        "deadlock: queue.Queue ops in a SIGTERM handler); do the work on "
+        "a dedicated thread and join it with a timeout."
+    )
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        defs = _function_defs(mod)
+        handlers: list = []
+        for node in mod.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            if resolved_callee(mod, node) != "signal.signal":
+                continue
+            if len(node.args) < 2:
+                continue
+            target = node.args[1]
+            name = None
+            if isinstance(target, ast.Name):
+                name = target.id
+            elif isinstance(target, ast.Attribute):
+                name = target.attr
+            if name and name in defs:
+                for fn in defs[name]:
+                    handlers.append((fn, node.lineno))
+            elif isinstance(target, ast.Lambda):
+                handlers.append((target, node.lineno))
+        findings = []
+        seen: set = set()
+        queue = list(handlers)
+        while queue:
+            fn, reg_line = queue.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+            for node in _direct_nodes(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                reason = _blocking_reason(mod, node, in_signal_handler=True)
+                if reason:
+                    findings.append(
+                        self.finding(
+                            mod, node,
+                            f"{reason} reachable from the signal handler "
+                            f"registered at line {reg_line}; run it on a "
+                            "dedicated thread and join with a timeout "
+                            "instead (PR 3 emergency-save deadlock)",
+                        )
+                    )
+                    continue
+                callee_name = None
+                if isinstance(node.func, ast.Name):
+                    callee_name = node.func.id
+                elif isinstance(node.func, ast.Attribute) and isinstance(
+                    node.func.value, ast.Name
+                ) and node.func.value.id in ("self", "cls"):
+                    callee_name = node.func.attr
+                if callee_name and callee_name in defs:
+                    for callee_fn in defs[callee_name]:
+                        queue.append((callee_fn, reg_line))
+        return findings
+
+
+class LockHeldBlockingCall(Rule):
+    id = "lock-held-blocking-call"
+    description = (
+        "Blocking I/O, time.sleep, or an unbounded join()/queue op "
+        "inside a `with <lock>:` block. Every other thread that needs "
+        "the lock stalls for the full duration — on the emergency-save "
+        "path that turns a slow request into a missed checkpoint window."
+    )
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        findings = []
+        for node in mod.walk():
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            lockish = False
+            for item in node.items:
+                parts = dotted_parts(item.context_expr)
+                if parts and "lock" in parts[-1].lower():
+                    lockish = True
+            if not lockish:
+                continue
+            for inner in _direct_nodes(node.body):
+                if not isinstance(inner, ast.Call):
+                    continue
+                reason = _blocking_reason(mod, inner, in_signal_handler=False)
+                if reason:
+                    findings.append(
+                        self.finding(
+                            mod, inner,
+                            f"{reason} while holding the lock taken at "
+                            f"line {node.lineno}; compute the value "
+                            "outside the critical section or bound the "
+                            "wait",
+                        )
+                    )
+        return findings
+
+
+class SleepInReconcile(Rule):
+    id = "sleep-in-reconcile"
+    description = (
+        "time.sleep inside reconcile-loop code. Reconcilers are "
+        "single-threaded and level-triggered: sleeping wedges every "
+        "other object's reconcile; return Result(requeue_after=...) and "
+        "let the manager's requeue heap own time."
+    )
+
+    def _applies(self, mod: SourceModule) -> bool:
+        if "/controller/" in f"/{mod.rel}":
+            return True
+        for node in mod.walk():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name == "reconcile":
+                    return True
+        return False
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        if not self._applies(mod):
+            return []
+        findings = []
+        for node in mod.walk():
+            if isinstance(node, ast.Call) and resolved_callee(mod, node) == "time.sleep":
+                findings.append(
+                    self.finding(
+                        mod, node,
+                        "time.sleep in reconcile-loop code blocks every "
+                        "queued reconcile; return "
+                        "Result(requeue_after=...) instead",
+                    )
+                )
+        return findings
+
+
+class ThreadWithoutDaemon(Rule):
+    id = "thread-no-daemon"
+    description = (
+        "threading.Thread started without a daemon= decision or a "
+        "join() story. A forgotten non-daemon thread keeps the process "
+        "alive past SIGTERM — the kubelet then SIGKILLs it mid-write."
+    )
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        findings = []
+        for node in mod.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            callee = resolved_callee(mod, node)
+            if callee != "threading.Thread":
+                continue
+            if "daemon" in _kwarg_names(node):
+                continue
+            target = None
+            parent = mod.parents.get(node)
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+                t = parent.targets[0]
+                target = t.id if isinstance(t, ast.Name) else (
+                    t.attr if isinstance(t, ast.Attribute) else None
+                )
+            if target and self._handled_later(mod, node, target):
+                continue
+            findings.append(
+                self.finding(
+                    mod, node,
+                    "Thread created without daemon= and never joined in "
+                    "this scope; pick one (daemon=True, or a bounded "
+                    ".join()) so process exit is deterministic",
+                )
+            )
+        return findings
+
+    def _handled_later(self, mod: SourceModule, call: ast.Call, target: str) -> bool:
+        fn = mod.enclosing_function(call)
+        scopes = [fn] if fn is not None else []
+        if mod.tree is not None:
+            scopes.append(mod.tree)  # self.X threads joined from other methods
+        for scope in scopes:
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and t.attr == "daemon"
+                            and (p := dotted_parts(t.value))
+                            and p[-1] == target
+                        ):
+                            return True
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"
+                    and (p := dotted_parts(node.func.value))
+                    and p[-1] == target
+                ):
+                    return True
+        return False
+
+
+# -- family 2: cross-module contracts ----------------------------------------
+
+
+def _env_read_name_node(mod: SourceModule, node: ast.AST) -> Optional[ast.AST]:
+    """The name-argument node of an env read (`os.environ.get(X)`,
+    `os.getenv(X)`, `env.get(X)`, `os.environ[X]`), or None."""
+    if isinstance(node, ast.Call):
+        callee = resolved_callee(mod, node)
+        if callee == "os.getenv":
+            return node.args[0] if node.args else None
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ("get", "pop", "setdefault"):
+            if _environish(f.value):
+                return node.args[0] if node.args else None
+    elif isinstance(node, ast.Subscript):
+        if _environish(node.value):
+            return node.slice
+    return None
+
+
+def _environish(expr: ast.AST) -> bool:
+    parts = dotted_parts(expr)
+    if not parts:
+        return False
+    if parts[-1] == "environ":
+        return True
+    return len(parts) == 1 and parts[0] == "env"
+
+
+class EnvReadUnknown(Rule):
+    id = "env-read-unknown"
+    description = (
+        "A TPU_*/JAX_*/MEGASCALE_*/KUBEFLOW_TPU_* env var is read but is "
+        "neither produced by the platform (webhook/tpu_env.py "
+        "ENV_CONTRACT) nor declared in the analysis allowlist — at "
+        "runtime the read silently sees the default value."
+    )
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        findings = []
+        for node in mod.walk():
+            name_node = _env_read_name_node(mod, node)
+            if name_node is None:
+                continue
+            name = resolve_str(mod, name_node, index)
+            if name is None or not config.ENV_NAME_RE.fullmatch(name):
+                continue
+            if name in index.env_contract or name in config.ENV_READ_ALLOWLIST:
+                continue
+            findings.append(
+                self.finding(
+                    mod, node,
+                    f"env var {name!r} is read but no producer declares "
+                    "it: add it to ENV_CONTRACT in "
+                    "kubeflow_tpu/webhook/tpu_env.py (with the producer) "
+                    "or to ENV_READ_ALLOWLIST in "
+                    "kubeflow_tpu/analysis/config.py (with a reason)",
+                )
+            )
+        return findings
+
+
+class EnvLiteralOutsideContract(Rule):
+    id = "env-literal"
+    description = (
+        "A platform env var name is spelled as a string literal outside "
+        "its contract home. The webhook<->runtime env contract drifted "
+        "exactly this way before: import the name from "
+        "kubeflow_tpu/webhook/tpu_env.py or kubeflow_tpu/api/annotations.py."
+    )
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        if mod.rel in config.ENV_NAME_HOMES:
+            return []
+        findings = []
+        for node in mod.walk():
+            if not (isinstance(node, ast.Constant) and isinstance(node.value, str)):
+                continue
+            value = node.value
+            if not config.ENV_NAME_RE.fullmatch(value):
+                continue
+            if value in config.ENV_READ_ALLOWLIST:
+                continue
+            findings.append(
+                self.finding(
+                    mod, node,
+                    f"env var name {value!r} re-typed as a literal; "
+                    "import it from kubeflow_tpu/webhook/tpu_env.py "
+                    "(ENV_CONTRACT) or kubeflow_tpu/api/annotations.py",
+                )
+            )
+        return findings
+
+
+class MetricLiteralUnregistered(Rule):
+    id = "metric-unregistered"
+    description = (
+        "A metric family name is referenced that metrics/metrics.py "
+        "never registers — the scrape/assertion reads a series that "
+        "will never exist."
+    )
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        if mod.rel == config.METRICS_MODULE:
+            return []
+        findings = []
+        for node in mod.walk():
+            if not (isinstance(node, ast.Constant) and isinstance(node.value, str)):
+                continue
+            value = node.value
+            if not config.METRIC_NAME_RE.fullmatch(value):
+                continue
+            if self._registered(value, index):
+                continue
+            findings.append(
+                self.finding(
+                    mod, node,
+                    f"metric name {value!r} is not registered in "
+                    "kubeflow_tpu/metrics/metrics.py (after stripping "
+                    "prometheus series suffixes); register it or fix the "
+                    "name drift",
+                )
+            )
+        return findings
+
+    @staticmethod
+    def _registered(name: str, index) -> bool:
+        if name in index.metric_names:
+            return True
+        for suffix in config.METRIC_SERIES_SUFFIXES:
+            if name.endswith(suffix) and name[: -len(suffix)] in index.metric_names:
+                return True
+        return False
+
+
+class MetricAttrUnregistered(Rule):
+    id = "metric-attr-unregistered"
+    description = (
+        "An attribute is read off a Metrics object that Metrics.__init__ "
+        "never defines — the emit site would AttributeError the first "
+        "time that code path runs in production."
+    )
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        if mod.rel == config.METRICS_MODULE:
+            return []
+        findings = []
+        for node in mod.walk():
+            if isinstance(node, ast.Attribute):
+                parts = dotted_parts(node)
+                if parts and parts[0] == "kubeflow_tpu":
+                    continue  # dotted module path, not a Metrics object
+                v = node.value
+                base_is_metrics = (
+                    isinstance(v, ast.Name) and v.id == "metrics"
+                ) or (isinstance(v, ast.Attribute) and v.attr == "metrics")
+                if not base_is_metrics:
+                    continue
+                attr = node.attr
+                if attr[:1].isupper() or attr == "metrics":
+                    continue  # module alias (metrics.Metrics / metrics.server)
+                if attr in index.metric_attrs or attr in config.METRICS_OBJECT_API:
+                    continue
+                findings.append(self._unknown(mod, node, attr))
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "getattr"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+            ):
+                parts = dotted_parts(node.args[0])
+                if not parts or parts[-1] != "metrics":
+                    continue
+                attr = node.args[1].value
+                if attr in index.metric_attrs or attr in config.METRICS_OBJECT_API:
+                    continue
+                findings.append(self._unknown(mod, node, attr))
+        return findings
+
+    def _unknown(self, mod: SourceModule, node: ast.AST, attr: str) -> Finding:
+        return self.finding(
+            mod, node,
+            f"Metrics object has no attribute {attr!r}; register the "
+            "metric in kubeflow_tpu/metrics/metrics.py or fix the emit "
+            "site",
+        )
+
+
+class MetricNameScheme(Rule):
+    id = "metric-name-scheme"
+    description = (
+        "Registered metric families must follow the tpu_* naming scheme "
+        "(reference notebook_* names are grandfathered) so dashboards "
+        "can select the platform's series with one matcher."
+    )
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        findings = []
+        for node in mod.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            callee = resolved_callee(mod, node) or ""
+            if callee.startswith("collections."):
+                continue
+            leaf = callee.rsplit(".", 1)[-1]
+            if leaf not in config.PROM_CONSTRUCTORS:
+                continue
+            if not (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            if len(node.args) < 2 and not (
+                _kwarg_names(node) & {"documentation", "registry", "labelnames"}
+            ):
+                continue  # not a prometheus registration signature
+            name = node.args[0].value
+            if config.TPU_METRIC_RE.fullmatch(name):
+                continue
+            if name in config.REFERENCE_METRIC_NAMES:
+                continue
+            findings.append(
+                self.finding(
+                    mod, node,
+                    f"metric family {name!r} does not follow the tpu_* "
+                    "naming scheme (and is not a grandfathered reference "
+                    "name)",
+                )
+            )
+        return findings
+
+
+class AnnotationLiteral(Rule):
+    id = "annotation-literal"
+    description = (
+        "A notebooks.kubeflow.org/* style annotation/label/finalizer key "
+        "is spelled as a literal outside kubeflow_tpu/api/. The api/ "
+        "modules are the wire-contract vocabulary; a re-typed key drifts "
+        "silently when the contract changes."
+    )
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        if mod.rel.startswith(config.ANNOTATION_HOME_PREFIX):
+            return []
+        findings = []
+        for node in mod.walk():
+            if not (isinstance(node, ast.Constant) and isinstance(node.value, str)):
+                continue
+            if not config.ANNOTATION_RE.fullmatch(node.value):
+                continue
+            findings.append(
+                self.finding(
+                    mod, node,
+                    f"annotation/label key {node.value!r} spelled inline; "
+                    "define it in kubeflow_tpu/api/annotations.py and "
+                    "import it",
+                )
+            )
+        return findings
+
+
+class ChaosParity(Rule):
+    id = "chaos-parity"
+    description = (
+        "chaos/experiments/*.yaml and the chaos_catalog handler registry "
+        "must cover each other exactly: a YAML without a handler never "
+        "runs; a handler without a YAML certifies a hypothesis nobody "
+        "declared."
+    )
+
+    def check_repo(self, index, checked: dict) -> list:
+        if config.CHAOS_CATALOG_MODULE not in checked:
+            return []
+        catalog_rel = config.CHAOS_CATALOG_MODULE
+        findings = []
+
+        def f(line: int, message: str, path: str = catalog_rel) -> Finding:
+            return Finding(self.id, path, line, 0, message)
+
+        if index.chaos_yaml_error:
+            findings.append(f(1, f"chaos YAML problem: {index.chaos_yaml_error}"))
+        yamls = {t for t in index.chaos_yaml_types if not t.startswith("<")}
+        handlers = index.chaos_handler_types
+        declared = index.chaos_injection_types
+        kinds = index.chaos_target_kinds
+        for t in sorted(yamls - handlers):
+            findings.append(
+                f(
+                    1,
+                    f"experiment {index.chaos_yaml_types[t]} declares "
+                    f"injection {t!r} but ChaosRunner registers no "
+                    "handler for it",
+                    path=index.chaos_yaml_types[t],
+                )
+            )
+        for t in sorted(handlers - yamls):
+            findings.append(
+                f(
+                    index.chaos_handler_line or 1,
+                    f"handler {t!r} has no declarative experiment under "
+                    "chaos/experiments/",
+                )
+            )
+        for t in sorted(declared - handlers):
+            findings.append(
+                f(
+                    index.chaos_injection_line or 1,
+                    f"INJECTION_TYPES declares {t!r} with no registered "
+                    "handler",
+                )
+            )
+        for t in sorted(handlers - declared):
+            findings.append(
+                f(
+                    index.chaos_handler_line or 1,
+                    f"handler {t!r} missing from INJECTION_TYPES (schema "
+                    "validation would reject its experiments)",
+                )
+            )
+        for t in sorted(declared - kinds):
+            findings.append(
+                f(
+                    index.chaos_target_line or 1,
+                    f"injection {t!r} missing from "
+                    "TARGET_KIND_FOR_INJECTION",
+                )
+            )
+        for t in sorted(kinds - declared):
+            findings.append(
+                f(
+                    index.chaos_target_line or 1,
+                    f"TARGET_KIND_FOR_INJECTION lists unknown injection "
+                    f"{t!r}",
+                )
+            )
+        return findings
+
+
+class SuppressionHygiene(Rule):
+    id = "suppression-hygiene"
+    description = (
+        "Every `# kftpu-lint: disable=` needs a real rule id and a "
+        "justification after the dash — an unexplained suppression is "
+        "how dead rules accumulate. This rule cannot be suppressed."
+    )
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        findings = []
+        known = rule_ids()
+        for line in getattr(mod, "malformed_suppression_lines", []):
+            findings.append(
+                Finding(
+                    self.id, mod.rel, line, 0,
+                    "kftpu-lint marker present but not parseable; "
+                    "expected `# kftpu-lint: disable=<rule>[,<rule>] — "
+                    "justification`",
+                )
+            )
+        for sup in mod.suppressions:
+            for rule in sup.rules:
+                if rule not in known:
+                    findings.append(
+                        Finding(
+                            self.id, mod.rel, sup.line, 0,
+                            f"suppression names unknown rule {rule!r}",
+                        )
+                    )
+            if not sup.justification:
+                findings.append(
+                    Finding(
+                        self.id, mod.rel, sup.line, 0,
+                        "suppression has no justification; say WHY after "
+                        "an em dash (— reason)",
+                    )
+                )
+        return findings
+
+
+ALL_RULES = [
+    BlockingInSignalHandler(),
+    LockHeldBlockingCall(),
+    SleepInReconcile(),
+    ThreadWithoutDaemon(),
+    EnvReadUnknown(),
+    EnvLiteralOutsideContract(),
+    MetricLiteralUnregistered(),
+    MetricAttrUnregistered(),
+    MetricNameScheme(),
+    AnnotationLiteral(),
+    ChaosParity(),
+    SuppressionHygiene(),
+]
+
+# `parse-error` is emitted by the engine itself for unparseable files.
+_ENGINE_RULES = ("parse-error",)
+
+
+def rule_ids() -> set:
+    return {r.id for r in ALL_RULES} | set(_ENGINE_RULES)

@@ -38,6 +38,9 @@ MLFLOW_INSTANCE = "opendatahub.io/mlflow-instance"
 REWRITE_URI = "notebooks.kubeflow.org/http-rewrite-uri"
 HEADERS_REQUEST_SET = "notebooks.kubeflow.org/http-headers-request-set"
 FEAST_INTEGRATION_LABEL = "opendatahub.io/feast-integration"
+# Runtime-image sync (reference notebook_runtime.go:43-152).
+RUNTIME_IMAGE_LABEL = "opendatahub.io/runtime-image"
+RUNTIME_IMAGE_NAME = "opendatahub.io/runtime-image-name"
 
 # -- TPU-native extensions ---------------------------------------------------
 # Set by the culler when a slice host is preempted/evicted; cleared on recovery.
@@ -168,6 +171,14 @@ def parse_checkpoint_grace(value) -> "int | None":
         return None
     return grace if 1 <= grace <= 3600 else None
 
+
+# -- controller-owned markers ------------------------------------------------
+# Marks image pre-pull pods (controller/prepull.py) so the reconciler can
+# list exactly its own pods and the ctrl NetworkPolicy can exempt them.
+PREPULL_LABEL = "notebooks.kubeflow.org/prepull"
+# Platform-notebook finalizer (controller/platform.py): blocks Notebook
+# deletion until the platform teardown (OAuth client, routes) ran.
+PLATFORM_CLEANUP_FINALIZER = "notebooks.kubeflow.org/platform-cleanup"
 
 # -- labels ------------------------------------------------------------------
 NOTEBOOK_NAME_LABEL = "notebook-name"

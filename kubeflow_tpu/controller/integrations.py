@@ -96,7 +96,7 @@ def reconcile_ca_bundle(
 # :43-152: ImageStreams labeled opendatahub.io/runtime-image in the
 # controller ns → per-user-ns ConfigMap; key sanitization :174-182)
 
-RUNTIME_IMAGE_LABEL = "opendatahub.io/runtime-image"
+RUNTIME_IMAGE_LABEL = ann.RUNTIME_IMAGE_LABEL
 
 
 def format_key_name(display_name: str) -> str:
@@ -115,7 +115,7 @@ def sync_runtime_images_config_map(
     for stream in streams:
         meta = stream.get("metadata", {})
         display = meta.get("annotations", {}).get(
-            "opendatahub.io/runtime-image-name", meta.get("name", "")
+            ann.RUNTIME_IMAGE_NAME, meta.get("name", "")
         )
         image_ref = ""
         for tag in stream.get("status", {}).get("tags", []):

@@ -45,6 +45,7 @@ from kubeflow_tpu.api.names import (
     derived_name,
     routing_service_name,
 )
+from kubeflow_tpu.webhook import tpu_env as envc
 from kubeflow_tpu.webhook.tpu_env import upsert_env
 
 NOTEBOOK_PORT_NAME = "notebook-port"
@@ -909,20 +910,20 @@ def _apply_multislice_env(
     upsert_env(
         container,
         [
-            {"name": "TPU_WORKER_HOSTNAMES", "value": ",".join(hostnames)},
-            {"name": "TPU_HOSTS_PER_SLICE", "value": str(slice_topo.hosts)},
-            {"name": "MEGASCALE_NUM_SLICES", "value": str(slice_count)},
-            {"name": "MEGASCALE_SLICE_ID", "value": str(slice_id)},
+            {"name": envc.TPU_WORKER_HOSTNAMES, "value": ",".join(hostnames)},
+            {"name": envc.TPU_HOSTS_PER_SLICE, "value": str(slice_topo.hosts)},
+            {"name": envc.MEGASCALE_NUM_SLICES, "value": str(slice_count)},
+            {"name": envc.MEGASCALE_SLICE_ID, "value": str(slice_id)},
             {
-                "name": "MEGASCALE_COORDINATOR_ADDRESS",
+                "name": envc.MEGASCALE_COORDINATOR_ADDRESS,
                 "value": f"{head}:{MEGASCALE_PORT}",
             },
             {
-                "name": "JAX_COORDINATOR_ADDRESS",
+                "name": envc.JAX_COORDINATOR_ADDRESS,
                 "value": f"{head}:{JAX_COORDINATOR_PORT}",
             },
             {
-                "name": "JAX_NUM_PROCESSES",
+                "name": envc.JAX_NUM_PROCESSES,
                 "value": str(slice_topo.hosts * slice_count),
             },
         ],

@@ -33,7 +33,7 @@ from kubeflow_tpu.k8s.manager import Manager, Reconciler, Request, Result
 
 log = logging.getLogger(__name__)
 
-FINALIZER = "notebooks.kubeflow.org/platform-cleanup"
+FINALIZER = ann.PLATFORM_CLEANUP_FINALIZER
 # Poll cadence while waiting for the token controller to mint the pod
 # ServiceAccount's image-pull secret (reference :155-186 wait step).
 PULL_SECRET_REQUEUE_S = 2.0

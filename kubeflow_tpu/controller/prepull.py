@@ -38,6 +38,7 @@ import logging
 from dataclasses import dataclass
 from typing import Optional
 
+from kubeflow_tpu.api import annotations as ann
 from kubeflow_tpu.api.names import derived_name
 from kubeflow_tpu.k8s import objects as obj_util
 from kubeflow_tpu.k8s.client import Client
@@ -48,7 +49,7 @@ from kubeflow_tpu.controller.slicepool import PLACEHOLDER_PRIORITY_CLASS
 log = logging.getLogger(__name__)
 
 PREPULL_CONFIGMAP = "notebook-prepull-images"
-PREPULL_LABEL = "notebooks.kubeflow.org/prepull"
+PREPULL_LABEL = ann.PREPULL_LABEL
 TPU_NODE_LABEL = "cloud.google.com/gke-tpu-accelerator"
 # A Failed pre-pull pod (broken ref, registry outage) is retried by
 # delete + re-create, but only after this backoff — immediate recreation

@@ -12,6 +12,7 @@ the reference's generator-drift CI check (ci/generate_code.sh).
 
 from __future__ import annotations
 
+from kubeflow_tpu.api import annotations as ann
 from kubeflow_tpu.api.notebook import GROUP, KIND, MAX_NAME_LENGTH, VERSIONS
 from kubeflow_tpu.tpu.topology import ACCELERATORS, _ALIASES
 
@@ -761,11 +762,11 @@ def sample_tpu_notebook() -> dict:
             "name": "sample-tpu-notebook",
             "namespace": "default",
             "annotations": {
-                "notebooks.opendatahub.io/inject-auth": "true",
+                ann.INJECT_AUTH: "true",
                 # 60s of SIGTERM grace for an emergency checkpoint; the
                 # webhook projects TPU_CHECKPOINT_GRACE_S and sizes
                 # terminationGracePeriodSeconds from this.
-                "notebooks.kubeflow.org/tpu-checkpoint-grace-seconds": "60",
+                ann.TPU_CHECKPOINT_GRACE: "60",
             },
         },
         "spec": {

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Callable, Iterable, Optional
 
+from kubeflow_tpu.api import annotations as ann
 from kubeflow_tpu.k8s.client import Client
 from kubeflow_tpu.controller.integrations import (
     CA_SOURCE_CONFIGMAPS,
@@ -28,7 +29,7 @@ DEFAULT_KEEP_NAMES = frozenset(
     {name for name, _key in CA_SOURCE_CONFIGMAPS}
     | {CA_TARGET_CONFIGMAP, "pipeline-runtime-images"}
 )
-DEFAULT_KEEP_LABELS = (RUNTIME_IMAGE_LABEL, "opendatahub.io/feast-integration")
+DEFAULT_KEEP_LABELS = (RUNTIME_IMAGE_LABEL, ann.FEAST_INTEGRATION_LABEL)
 
 
 def default_keep(obj: dict) -> bool:

@@ -183,7 +183,9 @@ def quant_bits_from_env():
     hand-set env var must not silently serve full precision."""
     import os
 
-    value = os.environ.get("KUBEFLOW_TPU_QUANT", "")
+    from kubeflow_tpu.api.annotations import QUANT_ENV_NAME
+
+    value = os.environ.get(QUANT_ENV_NAME, "")
     if value in ("", "bf16"):
         return 0
     if value == "int8":
@@ -193,5 +195,5 @@ def quant_bits_from_env():
     if value == "fp8":
         return "fp8"
     raise ValueError(
-        f"KUBEFLOW_TPU_QUANT={value!r}: want 'int8', 'int4', 'fp8', or 'bf16'"
+        f"{QUANT_ENV_NAME}={value!r}: want 'int8', 'int4', 'fp8', or 'bf16'"
     )

@@ -175,7 +175,9 @@ def serving_port_from_env(default: int = 8000) -> int:
     env var must not silently serve on the wrong port."""
     import os
 
-    value = os.environ.get("KUBEFLOW_TPU_SERVING_PORT", "").strip()
+    from kubeflow_tpu.api.annotations import SERVING_ENV_NAME
+
+    value = os.environ.get(SERVING_ENV_NAME, "").strip()
     if not value:
         return default
     from kubeflow_tpu.api.annotations import parse_profiling_port
@@ -183,7 +185,7 @@ def serving_port_from_env(default: int = 8000) -> int:
     port = parse_profiling_port(value)
     if port is None:
         raise ValueError(
-            f"KUBEFLOW_TPU_SERVING_PORT={value!r}: want a port in "
+            f"{SERVING_ENV_NAME}={value!r}: want a port in "
             "1024..65535"
         )
     return port

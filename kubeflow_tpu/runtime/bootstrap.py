@@ -118,24 +118,26 @@ class SliceRuntime:
 def runtime_from_env(env: Optional[dict] = None) -> SliceRuntime:
     """Parse the controller/webhook-injected environment into a
     SliceRuntime (multislice-aware: MEGASCALE_* + TPU_HOSTS_PER_SLICE)."""
+    from kubeflow_tpu.webhook import tpu_env as contract
+
     env = dict(os.environ) if env is None else env
-    hostnames_raw = env.get("TPU_WORKER_HOSTNAMES", "")
+    hostnames_raw = env.get(contract.TPU_WORKER_HOSTNAMES, "")
     hostnames = [h for h in hostnames_raw.split(",") if h]
     hosts_per_slice = int(
-        env.get("TPU_HOSTS_PER_SLICE") or str(max(1, len(hostnames)))
+        env.get(contract.TPU_HOSTS_PER_SLICE) or str(max(1, len(hostnames)))
     )
-    num_slices = int(env.get("MEGASCALE_NUM_SLICES", "1") or 1)
+    num_slices = int(env.get(contract.MEGASCALE_NUM_SLICES, "1") or 1)
     num = int(
-        env.get("JAX_NUM_PROCESSES") or str(hosts_per_slice * num_slices)
+        env.get(contract.JAX_NUM_PROCESSES) or str(hosts_per_slice * num_slices)
     )
     return SliceRuntime(
-        worker_id=int(env.get("TPU_WORKER_ID", "0") or 0),
+        worker_id=int(env.get(contract.TPU_WORKER_ID, "0") or 0),
         num_workers=num,
         worker_hostnames=hostnames,
-        coordinator_address=env.get("JAX_COORDINATOR_ADDRESS", ""),
-        accelerator_type=env.get("TPU_ACCELERATOR_TYPE", ""),
-        topology=env.get("TPU_TOPOLOGY", ""),
-        slice_id=int(env.get("MEGASCALE_SLICE_ID", "0") or 0),
+        coordinator_address=env.get(contract.JAX_COORDINATOR_ADDRESS, ""),
+        accelerator_type=env.get(contract.TPU_ACCELERATOR_TYPE, ""),
+        topology=env.get(contract.TPU_TOPOLOGY, ""),
+        slice_id=int(env.get(contract.MEGASCALE_SLICE_ID, "0") or 0),
         num_slices=num_slices,
         hosts_per_slice=hosts_per_slice,
     )
@@ -201,16 +203,19 @@ def maybe_start_profiler_server(env: Optional[dict] = None) -> Optional[int]:
     global _PROFILER_PORT
     import os
 
-    from kubeflow_tpu.api.annotations import parse_profiling_port
+    from kubeflow_tpu.api.annotations import (
+        PROFILING_ENV_NAME,
+        parse_profiling_port,
+    )
 
     env = env if env is not None else dict(os.environ)
-    value = env.get("KUBEFLOW_TPU_PROFILING_PORT", "")
+    value = env.get(PROFILING_ENV_NAME, "")
     if not value:
         return None
     port = parse_profiling_port(value)
     if port is None:
         raise ValueError(
-            f"KUBEFLOW_TPU_PROFILING_PORT={value!r}: not a port in 1024..65535"
+            f"{PROFILING_ENV_NAME}={value!r}: not a port in 1024..65535"
         )
     if _PROFILER_PORT is not None:
         if _PROFILER_PORT != port:

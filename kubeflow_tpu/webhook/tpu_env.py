@@ -20,11 +20,66 @@ Contract consumed by kubeflow_tpu.runtime.bootstrap inside the notebook:
 
 from __future__ import annotations
 
+from kubeflow_tpu.api import annotations as ann
 from kubeflow_tpu.api.names import JAX_COORDINATOR_PORT
 from kubeflow_tpu.api.notebook import Notebook
 from kubeflow_tpu.tpu.topology import SliceTopology
 
 POD_INDEX_LABEL = "apps.kubernetes.io/pod-index"
+
+# -- the environment contract ------------------------------------------------
+#
+# THE single spelling site for every TPU_* / JAX_* / MEGASCALE_* env var the
+# platform produces. Producers (this module, the controller's multislice
+# overrides, the webhook's annotation projections) and consumers
+# (runtime/bootstrap, models, ops) import these names; kftpu-lint's
+# env-contract rules flag any read of a TPU_*/JAX_* var that is not a key of
+# ENV_CONTRACT, and any re-typed string literal outside this module and
+# kubeflow_tpu/api/annotations.py.
+
+TPU_WORKER_ID = "TPU_WORKER_ID"
+TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+TPU_ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"
+TPU_TOPOLOGY = "TPU_TOPOLOGY"
+TPU_CHIPS_PER_HOST_BOUNDS = "TPU_CHIPS_PER_HOST_BOUNDS"
+TPU_HOST_BOUNDS = "TPU_HOST_BOUNDS"
+TPU_RUNTIME_VERSION = "TPU_RUNTIME_VERSION"
+TPU_HOSTS_PER_SLICE = "TPU_HOSTS_PER_SLICE"
+JAX_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
+JAX_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+MEGASCALE_COORDINATOR_ADDRESS = "MEGASCALE_COORDINATOR_ADDRESS"
+
+# name -> who produces it and from what. Annotation-projected env names are
+# defined next to their annotations in kubeflow_tpu/api/annotations.py and
+# joined into the contract here, so there is exactly one table that answers
+# "where does this variable come from".
+ENV_CONTRACT: dict = {
+    TPU_WORKER_ID: "webhook inject_tpu_env: pod-index label via downward API",
+    TPU_WORKER_HOSTNAMES: "webhook inject_tpu_env (this slice's hosts; "
+    "controller _apply_multislice_env overrides per slice)",
+    TPU_ACCELERATOR_TYPE: "webhook inject_tpu_env: spec.tpu.accelerator",
+    TPU_TOPOLOGY: "webhook inject_tpu_env: spec.tpu.topology",
+    TPU_CHIPS_PER_HOST_BOUNDS: "webhook inject_tpu_env: libtpu grid bounds",
+    TPU_HOST_BOUNDS: "webhook inject_tpu_env: libtpu grid bounds",
+    TPU_RUNTIME_VERSION: "webhook inject_tpu_env: spec.tpu.runtimeVersion",
+    TPU_HOSTS_PER_SLICE: "controller _apply_multislice_env: hosts per slice",
+    JAX_COORDINATOR_ADDRESS: "webhook inject_tpu_env (multi-host only); "
+    "controller _apply_multislice_env overrides for multislice",
+    JAX_NUM_PROCESSES: "webhook inject_tpu_env (multi-host only); "
+    "controller _apply_multislice_env overrides for multislice",
+    MEGASCALE_NUM_SLICES: "controller _apply_multislice_env",
+    MEGASCALE_SLICE_ID: "controller _apply_multislice_env",
+    MEGASCALE_COORDINATOR_ADDRESS: "controller _apply_multislice_env",
+    ann.CHECKPOINT_GRACE_ENV_NAME: "webhook project_checkpoint_env: "
+    "tpu-checkpoint-grace-seconds annotation",
+    ann.CHECKPOINT_DIR_ENV_NAME: "webhook project_checkpoint_env: "
+    "tpu-checkpoint-dir annotation (always set for TPU notebooks)",
+    ann.QUANT_ENV_NAME: "webhook: tpu-quantization annotation",
+    ann.PROFILING_ENV_NAME: "webhook: tpu-profiling-port annotation",
+    ann.SERVING_ENV_NAME: "webhook: tpu-serving-port annotation",
+}
 
 
 def inject_tpu_env(
@@ -52,37 +107,37 @@ def inject_tpu_env(
     )
     desired: list[dict] = [
         {
-            "name": "TPU_WORKER_ID",
+            "name": TPU_WORKER_ID,
             "valueFrom": {
                 "fieldRef": {"fieldPath": f"metadata.labels['{POD_INDEX_LABEL}']"}
             },
         },
-        {"name": "TPU_WORKER_HOSTNAMES", "value": ",".join(hostnames)},
-        {"name": "TPU_ACCELERATOR_TYPE", "value": topo.accelerator_type},
-        {"name": "TPU_TOPOLOGY", "value": topo.topology_str},
-        {"name": "TPU_CHIPS_PER_HOST_BOUNDS", "value": topo.chip_bounds_str()},
-        {"name": "TPU_HOST_BOUNDS", "value": topo.host_bounds_str()},
+        {"name": TPU_WORKER_HOSTNAMES, "value": ",".join(hostnames)},
+        {"name": TPU_ACCELERATOR_TYPE, "value": topo.accelerator_type},
+        {"name": TPU_TOPOLOGY, "value": topo.topology_str},
+        {"name": TPU_CHIPS_PER_HOST_BOUNDS, "value": topo.chip_bounds_str()},
+        {"name": TPU_HOST_BOUNDS, "value": topo.host_bounds_str()},
     ]
     stale: set[str] = set()
     if topo.hosts > 1:
         desired += [
             {
-                "name": "JAX_COORDINATOR_ADDRESS",
+                "name": JAX_COORDINATOR_ADDRESS,
                 "value": f"{hostnames[0]}:{JAX_COORDINATOR_PORT}",
             },
-            {"name": "JAX_NUM_PROCESSES", "value": str(topo.hosts)},
+            {"name": JAX_NUM_PROCESSES, "value": str(topo.hosts)},
         ]
     else:
         # A topology edit that shrank the slice to one host must drop the
         # multi-host env, or bootstrap would wait for workers that no
         # longer exist.
-        stale |= {"JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES"}
+        stale |= {JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES}
     if nb.tpu is not None and nb.tpu.runtime_version:
         desired.append(
-            {"name": "TPU_RUNTIME_VERSION", "value": nb.tpu.runtime_version}
+            {"name": TPU_RUNTIME_VERSION, "value": nb.tpu.runtime_version}
         )
     else:
-        stale.add("TPU_RUNTIME_VERSION")
+        stale.add(TPU_RUNTIME_VERSION)
     changed = upsert_env(container, desired)
     changed |= remove_env(container, stale)
     return changed

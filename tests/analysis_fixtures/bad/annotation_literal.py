@@ -1,0 +1,3 @@
+"""BAD: spells a wire-contract annotation key inline."""
+
+MADE_UP_KEY = "notebooks.kubeflow.org/made-up-key"

@@ -1,0 +1,16 @@
+"""BAD: blocking queue op inside a signal handler (the PR 3 deadlock shape)."""
+
+import queue
+import signal
+import time
+
+save_queue = queue.Queue(maxsize=1)
+
+
+def _handler(signum, frame):
+    save_queue.put(("emergency-save", signum))
+    time.sleep(0.1)
+
+
+def install():
+    signal.signal(signal.SIGTERM, _handler)

@@ -1,0 +1,3 @@
+"""BAD: re-types a contract env var name as a string literal."""
+
+WORKER_ID_VAR = "TPU_WORKER_ID"

@@ -1,0 +1,7 @@
+"""BAD: reads a TPU_* env var no producer declares (ENV_CONTRACT miss)."""
+
+import os
+
+
+def phantom_setting():
+    return os.environ.get("TPU_TOTALLY_UNDECLARED")

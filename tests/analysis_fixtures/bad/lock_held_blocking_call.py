@@ -1,0 +1,11 @@
+"""BAD: time.sleep while holding a lock stalls every other thread."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def flush():
+    with _lock:
+        time.sleep(1.0)

@@ -1,0 +1,5 @@
+"""BAD: emits on a Metrics attribute __init__ never defines."""
+
+
+def emit(metrics):
+    metrics.totally_unknown_counter.inc()

@@ -1,0 +1,5 @@
+"""BAD: registers a family outside the tpu_* naming scheme."""
+
+from prometheus_client import Counter
+
+ROGUE = Counter("weird_unprefixed_total", "A family dashboards cannot select")

@@ -1,0 +1,3 @@
+"""BAD: references a metric family metrics.py never registers."""
+
+EXPECTED_SERIES = "tpu_nonexistent_series_total"

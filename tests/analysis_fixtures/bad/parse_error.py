@@ -1,0 +1,2 @@
+"""BAD: not Python — the engine must report parse-error, not crash."""
+def broken(:

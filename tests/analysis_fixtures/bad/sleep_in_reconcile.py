@@ -1,0 +1,8 @@
+"""BAD: sleeping inside reconcile wedges every queued object."""
+
+import time
+
+
+def reconcile(obj):
+    time.sleep(5.0)
+    return None

@@ -1,0 +1,7 @@
+"""BAD: suppression without a justification (and it suppresses nothing)."""
+
+import time
+
+
+def reconcile(obj):
+    time.sleep(0.5)  # kftpu-lint: disable=sleep-in-reconcile

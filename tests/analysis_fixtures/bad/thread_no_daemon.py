@@ -1,0 +1,9 @@
+"""BAD: thread with neither daemon= nor a join() story outlives SIGTERM."""
+
+import threading
+
+
+def start_worker(fn):
+    worker = threading.Thread(target=fn)
+    worker.start()
+    return worker

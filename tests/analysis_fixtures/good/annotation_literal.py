@@ -1,0 +1,5 @@
+"""GOOD: annotation keys come from the api/ vocabulary."""
+
+from kubeflow_tpu.api import annotations as ann
+
+PREPULL_KEY = ann.PREPULL_LABEL

@@ -1,0 +1,14 @@
+"""GOOD: handler only flips an Event; the blocking work lives on a thread."""
+
+import signal
+import threading
+
+_stop = threading.Event()
+
+
+def _handler(signum, frame):
+    _stop.set()
+
+
+def install():
+    signal.signal(signal.SIGTERM, _handler)

@@ -1,0 +1,7 @@
+"""GOOD: imports the name from its contract home instead of re-typing it."""
+
+from kubeflow_tpu.webhook.tpu_env import TPU_TOPOLOGY
+
+
+def topology_var():
+    return TPU_TOPOLOGY

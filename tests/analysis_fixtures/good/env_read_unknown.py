@@ -1,0 +1,10 @@
+"""GOOD: reads through the contract constant; the name resolves to a
+declared ENV_CONTRACT key."""
+
+import os
+
+from kubeflow_tpu.webhook import tpu_env as contract
+
+
+def worker_id():
+    return os.environ.get(contract.TPU_WORKER_ID)

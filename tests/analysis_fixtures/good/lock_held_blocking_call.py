@@ -1,0 +1,15 @@
+"""GOOD: the critical section only copies state; the wait happens outside."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+_pending = []
+
+
+def flush():
+    with _lock:
+        batch = list(_pending)
+        _pending.clear()
+    time.sleep(0.01)
+    return batch

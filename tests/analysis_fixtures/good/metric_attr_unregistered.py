@@ -1,0 +1,8 @@
+"""GOOD: emits on registered attributes, including the getattr form."""
+
+
+def emit(metrics):
+    metrics.slice_preemptions_total.inc()
+    counter = getattr(metrics, "checkpoint_emergency_total", None)
+    if counter is not None:
+        counter.inc()

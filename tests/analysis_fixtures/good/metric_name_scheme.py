@@ -1,0 +1,5 @@
+"""GOOD: tpu_* family name (also registered, so no unregistered finding)."""
+
+from prometheus_client import Counter
+
+OK = Counter("tpu_slice_preemptions_total", "Scheme-conformant family")

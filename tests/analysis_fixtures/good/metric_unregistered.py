@@ -1,0 +1,4 @@
+"""GOOD: references a registered family (and a Histogram series suffix)."""
+
+EXPECTED_SERIES = "tpu_slice_preemptions_total"
+EXPECTED_HISTOGRAM_SERIES = "tpu_slice_recovery_seconds_count"

@@ -1,0 +1,5 @@
+"""GOOD: parseable module."""
+
+
+def fine():
+    return 1

@@ -1,0 +1,5 @@
+"""GOOD: reconcile hands time back to the manager's requeue heap."""
+
+
+def reconcile(obj):
+    return {"requeue_after": 30.0}

@@ -1,0 +1,7 @@
+"""GOOD: a justified suppression — the finding exists but is suppressed."""
+
+import time
+
+
+def reconcile(obj):
+    time.sleep(0.01)  # kftpu-lint: disable=sleep-in-reconcile — fixture: demonstrates the justified-suppression syntax

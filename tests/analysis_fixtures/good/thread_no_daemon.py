@@ -1,0 +1,16 @@
+"""GOOD: every thread either declares daemon= or is joined with a bound."""
+
+import threading
+
+
+def start_daemon(fn):
+    worker = threading.Thread(target=fn, daemon=True)
+    worker.start()
+    return worker
+
+
+def run_bounded(fn):
+    worker = threading.Thread(target=fn)
+    worker.start()
+    worker.join(timeout=5.0)
+    return worker

@@ -1,0 +1,210 @@
+"""kftpu-lint tests: per-rule fixture corpus, suppression syntax, and the
+tier-1 zero-unsuppressed-findings gate over kubeflow_tpu/.
+
+The gate is the point of the exercise: the contract rules only protect the
+webhook<->runtime env contract (and the metric/annotation vocabularies) if
+re-introducing a drifted literal turns the suite red.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu.analysis import rule_ids, run_analysis
+from kubeflow_tpu.analysis import config as lint_config
+from kubeflow_tpu.analysis.__main__ import main as lint_main
+from kubeflow_tpu.analysis.core import load_module
+from kubeflow_tpu.analysis.engine import REPO_ROOT
+from kubeflow_tpu.analysis.index import RepoIndex
+from kubeflow_tpu.analysis.rules import ChaosParity
+
+FIXTURES = Path(__file__).resolve().parent / "analysis_fixtures"
+
+# fixture stem -> the rule its bad/ variant must trigger
+RULE_FOR_FIXTURE = {
+    "blocking_in_signal_handler": "blocking-in-signal-handler",
+    "lock_held_blocking_call": "lock-held-blocking-call",
+    "sleep_in_reconcile": "sleep-in-reconcile",
+    "thread_no_daemon": "thread-no-daemon",
+    "env_read_unknown": "env-read-unknown",
+    "env_literal": "env-literal",
+    "metric_unregistered": "metric-unregistered",
+    "metric_attr_unregistered": "metric-attr-unregistered",
+    "metric_name_scheme": "metric-name-scheme",
+    "annotation_literal": "annotation-literal",
+    "suppression_hygiene": "suppression-hygiene",
+    "parse_error": "parse-error",
+}
+
+
+@pytest.fixture(scope="module")
+def bad_report():
+    return run_analysis([FIXTURES / "bad"])
+
+
+@pytest.fixture(scope="module")
+def good_report():
+    return run_analysis([FIXTURES / "good"])
+
+
+def _rules_for(report, stem):
+    return {
+        f.rule
+        for f in report.unsuppressed
+        if f.path.endswith(f"/{stem}.py")
+    }
+
+
+class TestFixtureCorpus:
+    @pytest.mark.parametrize("stem,rule", sorted(RULE_FOR_FIXTURE.items()))
+    def test_bad_fixture_triggers_rule(self, bad_report, stem, rule):
+        assert rule in _rules_for(bad_report, stem), (
+            f"bad/{stem}.py should trigger {rule}; got "
+            f"{sorted(_rules_for(bad_report, stem))}"
+        )
+
+    @pytest.mark.parametrize("stem,rule", sorted(RULE_FOR_FIXTURE.items()))
+    def test_good_fixture_is_clean(self, good_report, stem, rule):
+        assert not _rules_for(good_report, stem), (
+            f"good/{stem}.py should be clean; got "
+            + "\n".join(
+                f.render()
+                for f in good_report.unsuppressed
+                if f.path.endswith(f"/{stem}.py")
+            )
+        )
+
+    def test_bad_corpus_covers_at_least_eight_distinct_rules(self, bad_report):
+        distinct = {f.rule for f in bad_report.unsuppressed}
+        assert len(distinct) >= 8, sorted(distinct)
+
+    def test_every_fixture_rule_is_a_known_rule(self):
+        assert set(RULE_FOR_FIXTURE.values()) <= rule_ids()
+
+
+class TestSuppressions:
+    def test_good_suppression_is_recorded_with_justification(self, good_report):
+        sups = [
+            f for f in good_report.suppressed
+            if f.path.endswith("/suppression_hygiene.py")
+        ]
+        assert sups and sups[0].rule == "sleep-in-reconcile"
+        assert "fixture" in sups[0].justification
+
+    def test_unjustified_suppression_does_not_suppress(self, bad_report):
+        rules = _rules_for(bad_report, "suppression_hygiene")
+        # hygiene fires AND the target finding stays unsuppressed
+        assert {"suppression-hygiene", "sleep-in-reconcile"} <= rules
+
+    @pytest.mark.parametrize(
+        "comment",
+        [
+            "# kftpu-lint: disable=sleep-in-reconcile — harness wants wall-time",
+            "# kftpu-lint: disable=sleep-in-reconcile -- harness wants wall-time",
+            "# kftpu-lint: disable=sleep-in-reconcile: harness wants wall-time",
+        ],
+    )
+    def test_separator_variants_all_parse(self, tmp_path, comment):
+        src = f"import time\n\n\ndef reconcile(obj):\n    time.sleep(1)  {comment}\n"
+        path = tmp_path / "mod.py"
+        path.write_text(src)
+        mod = load_module(path, "mod.py", "mod")
+        sup = mod.suppression_for("sleep-in-reconcile", 5)
+        assert sup is not None and sup.justification == "harness wants wall-time"
+
+    def test_standalone_comment_covers_next_line(self, tmp_path):
+        src = (
+            "import time\n\n\ndef reconcile(obj):\n"
+            "    # kftpu-lint: disable=sleep-in-reconcile — next-line form\n"
+            "    time.sleep(1)\n"
+        )
+        path = tmp_path / "mod.py"
+        path.write_text(src)
+        mod = load_module(path, "mod.py", "mod")
+        assert mod.suppression_for("sleep-in-reconcile", 6) is not None
+
+    def test_malformed_marker_is_flagged(self, tmp_path):
+        path = tmp_path / "mod.py"
+        path.write_text("X = 1  # kftpu-lint: disable sleep-in-reconcile\n")
+        mod = load_module(path, "mod.py", "mod")
+        assert getattr(mod, "malformed_suppression_lines", []) == [1]
+
+    def test_unknown_rule_in_suppression_is_flagged(self, tmp_path):
+        path = tmp_path / "mod.py"
+        path.write_text(
+            "X = 1  # kftpu-lint: disable=no-such-rule — reason\n"
+        )
+        report = run_analysis([path])
+        assert any(
+            f.rule == "suppression-hygiene" and "no-such-rule" in f.message
+            for f in report.unsuppressed
+        )
+
+
+class TestRepoGate:
+    def test_repo_has_zero_unsuppressed_findings(self):
+        """Tier-1 gate: the whole package must lint clean."""
+        report = run_analysis()
+        assert not report.unsuppressed, "\n" + "\n".join(
+            f.render() for f in report.unsuppressed
+        )
+
+    def test_reverting_a_contract_fix_fails_the_gate(self, tmp_path):
+        """Re-hardcoding TPU_WORKER_ID in runtime/bootstrap.py (the drift
+        this PR fixed) must produce a finding again."""
+        src = (REPO_ROOT / "kubeflow_tpu/runtime/bootstrap.py").read_text()
+        assert "contract.TPU_WORKER_ID" in src  # the fix this test guards
+        reverted = src.replace("contract.TPU_WORKER_ID", '"TPU_WORKER_ID"')
+        path = tmp_path / "bootstrap_reverted.py"
+        path.write_text(reverted)
+        report = run_analysis([path])
+        assert any(
+            f.rule == "env-literal" and "TPU_WORKER_ID" in f.message
+            for f in report.unsuppressed
+        )
+
+
+class TestChaosParity:
+    def _index(self):
+        idx = RepoIndex(REPO_ROOT)
+        idx.chaos_injection_types = {"pod-kill", "declared-only"}
+        idx.chaos_injection_line = 10
+        idx.chaos_handler_types = {"pod-kill", "handler-only"}
+        idx.chaos_handler_line = 20
+        idx.chaos_target_kinds = {"pod-kill", "declared-only", "handler-only"}
+        idx.chaos_target_line = 30
+        idx.chaos_yaml_types = {"pod-kill": "chaos/experiments/pod-kill.yaml"}
+        return idx
+
+    def test_mismatches_in_every_direction(self):
+        findings = ChaosParity().check_repo(
+            self._index(), {lint_config.CHAOS_CATALOG_MODULE: None}
+        )
+        messages = "\n".join(f.message for f in findings)
+        assert "'handler-only' has no declarative experiment" in messages
+        assert "'declared-only' with no registered handler" in messages
+        assert "'handler-only' missing from INJECTION_TYPES" in messages
+        assert "unknown injection 'handler-only'" in messages
+
+    def test_skipped_when_catalog_not_in_scope(self):
+        assert ChaosParity().check_repo(self._index(), {}) == []
+
+
+class TestCli:
+    def test_list_rules(self, capsys):
+        assert lint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in sorted(rule_ids()):
+            assert rule in out
+
+    def test_json_output_clean_corpus(self, capsys):
+        import json
+
+        assert lint_main([str(FIXTURES / "good"), "--format", "json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["unsuppressed"] == 0
+        assert data["suppressed"] == 1
+        assert data["checked_files"] == len(list((FIXTURES / "good").glob("*.py")))
+
+    def test_nonzero_exit_on_findings(self, capsys):
+        assert lint_main([str(FIXTURES / "bad")]) == 1
