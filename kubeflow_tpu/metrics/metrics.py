@@ -171,6 +171,12 @@ class Metrics:
             "Duration of the most recent graceful drain",
             registry=self.registry,
         )
+        self.serving_ragged_batch_fill = Gauge(
+            "tpu_serving_ragged_batch_fill",
+            "Fraction of the ragged engine's last-step token budget "
+            "carrying real (decode or prefill-chunk) tokens",
+            registry=self.registry,
+        )
 
     def collect_running(self) -> None:
         """Recompute run-state gauges by listing StatefulSets, as the

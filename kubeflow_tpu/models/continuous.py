@@ -220,6 +220,48 @@ def _cb_step(
     return nxt, lp, new_cache
 
 
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "top_k", "top_p"),
+    donate_argnums=(3,),
+)
+def _cb_ragged_step(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # (B, K) per-slot chunk (decode rows: 1 real + pads)
+    cache: dict,
+    positions: jax.Array,  # (B,) chunk start per slot
+    kv_mask: jax.Array,  # (B, C)
+    cols: jax.Array,  # (B,) last-real column per row (0 for decode rows)
+    key: jax.Array,
+    temps: jax.Array,
+    top_k: int,
+    top_p: float,
+    bias=None,
+) -> tuple[jax.Array, jax.Array, dict]:
+    """One fused mixed prefill/decode dispatch for the fixed-slot
+    batcher: every row is a K-token chunk written at its own position —
+    decode rows carry one real token plus pads (pad writes at later
+    positions are causally invisible until real tokens overwrite them),
+    the admitting row carries its next prompt chunk — with chunk-causal
+    attention inside each row. Sampling reads each row's own last-real
+    column, so a completing admission's first token comes from the same
+    dispatch that finished its prefill."""
+    logits, cache = _decode_chunk_batch_impl(
+        params, cfg, tokens, cache, positions, kv_mask=kv_mask
+    )
+    row_logits = jnp.take_along_axis(
+        logits, cols[:, None, None], axis=1
+    )[:, 0]  # (B, V)
+    if bias is not None:
+        row_logits = row_logits + bias
+    nxt = sample_logits_per_row(row_logits, key, temps, top_k, top_p)
+    lp = jnp.take_along_axis(
+        jax.nn.log_softmax(row_logits, axis=-1), nxt[:, None], axis=-1
+    )[:, 0]
+    return nxt, lp, cache
+
+
 # ---------------------------------------------------------------------------
 # Host-side server
 
@@ -263,6 +305,41 @@ class _Request:
     # its next emitted token, freeing the slot for live work instead of
     # decoding to full budget for a caller that stopped waiting.
     deadline: Optional[float] = None
+
+
+class _AdmissionCursor:
+    """Prompt-prefill cursor for one in-flight admission.
+
+    THE position bookkeeping shared by ContinuousBatcher chunked
+    admission and the PagedBatcher ragged scheduler: the left-padded
+    prompt's validity row and the next position to prefill travel
+    together across pieces instead of being recomputed per chunk.
+    ``align`` keeps piece starts on compiled chunk boundaries (chunked
+    admission dispatches fixed-width pieces); the ragged scheduler
+    takes variable-width pieces under its token budget (align=1)."""
+
+    def __init__(self, mask_row, bucket: int, align: int = 1) -> None:
+        self.bucket = int(bucket)
+        row = np.asarray(mask_row).reshape(-1)[: self.bucket]
+        self.mask_row = row
+        # Left-padding puts all pads FIRST: pieces before the first real
+        # token are pure padding (kv_mask-fenced anyway) and would
+        # multiply a short prompt's TTFT for zero work — start at the
+        # aligned piece containing the first real token.
+        first_real = int(np.argmax(row)) if row.any() else 0
+        self.pos = (first_real // align) * align
+
+    @property
+    def done(self) -> bool:
+        return self.pos >= self.bucket
+
+    def take(self, width: int) -> tuple[int, int]:
+        """Claim the next up-to-``width`` positions: returns (start, n)
+        and advances the cursor past them."""
+        start = self.pos
+        n = min(int(width), self.bucket - start)
+        self.pos = start + n
+        return start, n
 
 
 class _BatcherBase:
@@ -410,6 +487,10 @@ class _BatcherBase:
         if admitting is not None and admitting["req"].rid == rid:
             self._cancelled[rid] = reason
             return True
+        for a in getattr(self, "_ragged_admit", {}).values():
+            if a["req"].rid == rid:
+                self._cancelled[rid] = reason
+                return True
         for req in self._by_slot:
             if req is not None and req.rid == rid:
                 self._cancelled[rid] = reason
@@ -472,6 +553,7 @@ class _BatcherBase:
             bool(self._queue)
             or any(r is not None for r in self._by_slot)
             or getattr(self, "_admitting", None) is not None
+            or bool(getattr(self, "_ragged_admit", {}))
         )
 
     def run(self) -> dict[int, list[int]]:
@@ -573,6 +655,7 @@ class ContinuousBatcher(_BatcherBase):
         kv_bits: int = 0,  # 8 → int8 KV storage (halved cache HBM)
         attn_kernel: Optional[bool] = None,  # length-bounded pallas decode
         admit_chunk: Optional[int] = None,  # interleave admission pieces
+        ragged: bool = False,  # fuse admission chunk + decodes per step
     ):
         self.gen = gen or GenerationConfig()
         # Chunked admission: a long prompt's prefill runs in admit_chunk-
@@ -594,6 +677,29 @@ class ContinuousBatcher(_BatcherBase):
                 )
         self._admit_chunk = admit_chunk
         self._admitting: Optional[dict] = None
+        # Ragged mode: admission chunks and decode tokens FUSE into one
+        # (B, admit_chunk) chunk-causal dispatch per step (_cb_ragged_step)
+        # instead of alternating admit-then-step — admission stops
+        # stalling in-flight decodes, and a completing admission's first
+        # token arrives with the same dispatch. Token-parity with the
+        # alternating path is pinned by tests.
+        if ragged:
+            if admit_chunk is None:
+                raise ValueError(
+                    "ragged=True needs admit_chunk= (the fused step's "
+                    "chunk width)"
+                )
+            if kv_bits:
+                raise ValueError(
+                    "ragged=True does not compose with kv_bits — "
+                    "drop one of the two"
+                )
+            if attn_kernel:
+                raise ValueError(
+                    "ragged=True does not compose with attn_kernel=True "
+                    "(the fused chunk step is XLA) — drop one of the two"
+                )
+        self.ragged = ragged
         # Length-bounded decode attention (ops/paged_attention.py dense
         # kernel): XLA reads ALL cache_len slots per step; the kernel
         # reads each slot's filled prefix only. Auto-on under the TPU
@@ -620,7 +726,7 @@ class ContinuousBatcher(_BatcherBase):
         if attn_kernel is None:
             attn_kernel = (
                 jax.default_backend() in ("tpu", "axon") and plan is None
-                and not kv_bits and not cfg.sliding_window
+                and not kv_bits and not cfg.sliding_window and not ragged
             )
         # Chunk size: the largest power-of-two divisor of cache_len in
         # [16, 512]. EXPLICIT True with an indivisible cache_len raises
@@ -689,6 +795,9 @@ class ContinuousBatcher(_BatcherBase):
     # -- internals ---------------------------------------------------------
 
     def _admit_free_slots(self) -> None:
+        if getattr(self, "ragged", False):
+            self._stage_ragged_admission()
+            return
         if getattr(self, "_admit_chunk", None):
             self._admit_one_chunk()
             return
@@ -722,13 +831,6 @@ class ContinuousBatcher(_BatcherBase):
             )
             row = np.ones((1, self.cache_len), bool)
             row[:, :self.prompt_bucket] = np.asarray(mask)
-            # Left-padding puts all pads FIRST: pieces before the
-            # first real token are pure padding (kv_mask-fenced anyway)
-            # and would multiply a short prompt's TTFT by bucket/chunk
-            # dispatches for zero work — start at the piece containing
-            # the first real token.
-            first_real = int(np.argmax(np.asarray(mask)[0]))
-            cs0 = self._admit_chunk
             a = self._admitting = {
                 "slot": slot,
                 "req": req,
@@ -737,10 +839,13 @@ class ContinuousBatcher(_BatcherBase):
                 "row": jnp.array(row),
                 "temp": init_kv_cache(self.cfg, 1, self.cache_len,
                                       kv_bits=self.kv_bits),
-                "pos": (first_real // cs0) * cs0,
+                "cursor": _AdmissionCursor(np.asarray(mask)[0],
+                                           self.prompt_bucket,
+                                           align=self._admit_chunk),
                 "logits": None,
             }
         cs = self._admit_chunk
+        start, _ = a["cursor"].take(cs)
         # jnp.array (copy), not asarray: the CPU backend aliases numpy
         # memory zero-copy and basic slicing returns a VIEW — dispatched
         # chunks must never share mutable host buffers. The explicit
@@ -749,14 +854,13 @@ class ContinuousBatcher(_BatcherBase):
         # (decode step between pieces), and an unsynchronized per-chunk
         # dispatch chain showed nondeterministic token corruption in
         # review stress runs.
-        tok = jnp.array(a["padded"][:, a["pos"]:a["pos"] + cs])
+        tok = jnp.array(a["padded"][:, start:start + cs])
         a["logits"], a["temp"] = _admit_chunk(
             self.params, self.cfg, tok, a["temp"],
-            jnp.asarray([a["pos"]], jnp.int32), a["row"],
+            jnp.asarray([start], jnp.int32), a["row"],
         )
         jax.block_until_ready(a["logits"])
-        a["pos"] += cs
-        if a["pos"] >= self.prompt_bucket:
+        if a["cursor"].done:
             self.cache, self.kv_mask = _install_temp_cache(
                 a["temp"], self.cache, self.kv_mask, a["row"],
                 jnp.asarray(a["slot"], jnp.int32),
@@ -766,6 +870,43 @@ class ContinuousBatcher(_BatcherBase):
                 a["prompt_mask"], a["logits"],
             )
             self._admitting = None
+
+    def _stage_ragged_admission(self) -> None:
+        """Stage (not dispatch) the next admission: in ragged mode the
+        prefill chunks ride the fused step dispatch, so staging only
+        claims the slot and installs the row's validity mask,
+        temperature, and bias — sampling state must be live BEFORE the
+        completing chunk's dispatch samples the first token."""
+        if self._admitting is not None or not self._queue:
+            return
+        slot = next(
+            (i for i in range(self.slots) if self._by_slot[i] is None),
+            None,
+        )
+        if slot is None:
+            return
+        req = self._queue.pop(0)
+        padded, mask = left_pad(
+            [req.prompt], self.gen.pad_id, self.prompt_bucket
+        )
+        row = np.ones((self.cache_len,), bool)
+        row[: self.prompt_bucket] = np.asarray(mask)[0]
+        # The row mask goes live before the positions are written;
+        # garbage under it is only reachable by this slot's own
+        # chunk-causal queries, which never look past their own chunk.
+        self.kv_mask = self.kv_mask.at[slot].set(jnp.asarray(row))
+        self.temps[slot] = (self.gen.temperature if req.temperature is None
+                            else req.temperature)
+        self._install_bias(slot, req)
+        self._admitting = {
+            "slot": slot,
+            "req": req,
+            "padded": np.array(padded),
+            "prompt_mask": None if mask.all() else jnp.array(mask),
+            "cursor": _AdmissionCursor(np.asarray(mask)[0],
+                                       self.prompt_bucket,
+                                       align=self._admit_chunk),
+        }
 
     def _install_admitted(self, slot: int, req: _Request, padded,
                           prompt_mask, logits) -> None:
@@ -815,6 +956,9 @@ class ContinuousBatcher(_BatcherBase):
         self.kv_mask = self.kv_mask.at[slot].set(False)
 
     def _step(self) -> None:
+        if getattr(self, "ragged", False):
+            self._step_ragged()
+            return
         active = [i for i, r in enumerate(self._by_slot) if r is not None]
         if not active:
             return
@@ -836,5 +980,55 @@ class ContinuousBatcher(_BatcherBase):
         host_next = np.asarray(nxt)  # the one per-step readback
         host_lps = np.asarray(lps)
         for slot in active:
+            self._note_token(slot, int(host_next[slot]),
+                             float(host_lps[slot]))
+
+    def _step_ragged(self) -> None:
+        """One fused mixed prefill/decode step: every active slot's
+        decode token plus the in-flight admission's next prompt chunk
+        go out as ONE (B, admit_chunk) chunk-causal dispatch."""
+        a = self._admitting
+        active = [i for i, r in enumerate(self._by_slot) if r is not None]
+        if not active and a is None:
+            return
+        cs = self._admit_chunk
+        tokens = np.full((self.slots, cs), self.gen.pad_id, np.int32)
+        positions = np.zeros((self.slots,), np.int32)
+        cols = np.zeros((self.slots,), np.int32)
+        for slot in active:
+            tokens[slot, 0] = self.tokens[slot, 0]
+            positions[slot] = self.positions[slot]
+        admit_done = False
+        if a is not None:
+            start, n = a["cursor"].take(cs)
+            tokens[a["slot"], :n] = a["padded"][0, start:start + n]
+            positions[a["slot"]] = start
+            cols[a["slot"]] = n - 1
+            admit_done = a["cursor"].done
+        self.key, sub = jax.random.split(self.key)
+        nxt, lps, self.cache = _cb_ragged_step(
+            self.params, self.cfg, jnp.array(tokens), self.cache,
+            jnp.array(positions), self.kv_mask, jnp.array(cols), sub,
+            jnp.array(self.temps), self.gen.top_k, self.gen.top_p,
+            bias=self._bias,
+        )
+        host_next = np.asarray(nxt)
+        host_lps = np.asarray(lps)
+        for slot in active:
+            self.positions[slot] += 1
+        for slot in active:
+            self._note_token(slot, int(host_next[slot]),
+                             float(host_lps[slot]))
+        if a is not None and admit_done:
+            # The completing chunk's dispatch already sampled the first
+            # token (its row's last-real column) — finish the admission
+            # bookkeeping without a separate prefill readback.
+            slot, req = a["slot"], a["req"]
+            self._post_admit(slot, jnp.asarray(a["padded"]),
+                             a["prompt_mask"])
+            self.positions[slot] = self.prompt_bucket
+            self._by_slot[slot] = req
+            req.budget = self._initial_budget(req)
+            self._admitting = None
             self._note_token(slot, int(host_next[slot]),
                              float(host_lps[slot]))

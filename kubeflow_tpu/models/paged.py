@@ -73,7 +73,11 @@ from kubeflow_tpu.models.llama import (
     sample_logits,
     sample_logits_per_row,
 )
-from kubeflow_tpu.models.continuous import _BatcherBase, _Request
+from kubeflow_tpu.models.continuous import (
+    _AdmissionCursor,
+    _BatcherBase,
+    _Request,
+)
 from kubeflow_tpu.models.serving import GenerationConfig, left_pad
 
 
@@ -165,6 +169,76 @@ def _paged_step(
     return nxt, lp, new_pool
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "block_size", "top_k", "top_p", "attn_kernel",
+    ),
+    donate_argnums=(3,),
+)
+def _paged_ragged_step(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # (T, 1) flattened mixed batch, tail-padded
+    pool: dict,
+    tables: jax.Array,  # (S, MAXB) int32 per-SLOT block tables
+    kv_mask: jax.Array,  # (S, MAXB * BS) per-slot validity
+    tok_pos: jax.Array,  # (T,) absolute kv position per token
+    tok_seq: jax.Array,  # (T,) owning slot per token (pads: 0)
+    n_tokens: jax.Array,  # scalar int32 — real rows; pads sit at the tail
+    seq_starts: jax.Array,  # (S,) first row of each slot's span
+    seq_lens: jax.Array,    # (S,) rows this step (0 = not participating)
+    kv_lens: jax.Array,     # (S,) kv length INCLUDING this step's span
+    last_rows: jax.Array,   # (S,) row of each slot's LAST token (0 if idle)
+    key: jax.Array,
+    block_size: int,
+    temps: jax.Array,  # (S,) per-slot sampling temperature
+    top_k: int,
+    top_p: float,
+    bias=None,  # (S, V) per-slot logit bias, or None
+    attn_kernel: bool = False,
+) -> tuple[jax.Array, jax.Array, dict]:
+    """ONE fused dispatch for a mixed decode/prefill batch (the ragged
+    entry point, arXiv 2604.15464): every participating slot contributes
+    a contiguous row span — one row for a decoding slot, its next prompt
+    chunk for an admitting slot — and the whole flattened batch runs the
+    SAME chunk body as plain paged decode (_paged_chunk_scan with T as
+    the batch axis, K=1). Each token scatters at its own (block, offset)
+    and attends its slot's view at its own absolute position, so chunk
+    causality and cross-chunk isolation fall out of the existing masking
+    rule; pads are routed to the null block and fenced by position.
+
+    Returns per-SLOT (next_token, chosen logprob) sampled from each
+    span's last row — a decoding slot's next token and an admission-
+    completing slot's FIRST token come out of the same dispatch — plus
+    the updated pool. Rows of mid-prefill or idle slots are sampled too
+    (static shapes) and discarded by the scheduler."""
+    posmat = tok_pos[:, None]
+    tok_tables = tables[tok_seq]
+    tok_mask = kv_mask[tok_seq]
+    cos, sin, blks, offs = _chunk_coords(cfg, tok_tables, posmat, block_size)
+    # Tail pads carry tok_seq 0 — their scatter targets must be forced to
+    # the null block, or they would overwrite slot 0's live KV.
+    tok_valid = jnp.arange(tokens.shape[0]) < n_tokens
+    blks = jnp.where(tok_valid[:, None], blks, 0)
+    x, new_pool = _paged_chunk_scan(
+        params, cfg, tokens, pool, tok_tables, tok_mask, cos, sin, blks,
+        offs, posmat, block_size, attn_kernel=attn_kernel,
+        ragged=(seq_starts, seq_lens, kv_lens, tables, kv_mask),
+    )
+    # Logits only at each slot's last row — the lm head runs S wide, not
+    # T wide (the budget is several× the slot count under load).
+    xs = x[last_rows, 0]  # (S, dim)
+    logits = _lm_head_logits(_norm(xs, params["final_norm"], cfg), params)
+    if bias is not None:
+        logits = logits + bias
+    nxt = sample_logits_per_row(logits, key, temps, top_k, top_p)
+    lp = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), nxt[:, None], axis=-1
+    )[:, 0]
+    return nxt, lp, new_pool
+
+
 def _scatter_chunk(pool_l, k, v, blks, offs):
     """Scatter a (B, Hkv, K, D) chunk into (block, offset) per token —
     requests own disjoint blocks, so batch rows never collide; the small
@@ -195,7 +269,7 @@ def _scatter_chunk(pool_l, k, v, blks, offs):
 
 def _paged_chunk_scan(params, cfg, tokens, pool, tables, kv_mask, cos, sin,
                       blks, offs, attn_positions, block_size,
-                      attn_kernel=False):
+                      attn_kernel=False, ragged=None):
     """The ONE paged decode body (scan over layers), shared by the
     ordinary decode step (K=1) and the speculative verify chunk (K>1) —
     same discipline as llama._chunk_decode_scan: a single body means a
@@ -210,7 +284,17 @@ def _paged_chunk_scan(params, cfg, tokens, pool, tables, kv_mask, cos, sin,
     Applies to the bf16 single-token path (K=1, no sliding window, no
     int8 pool); everything else keeps the gathered view, whose masking
     the kernel is tested to match bit-for-bit in intent and to bf16
-    tolerance in value."""
+    tolerance in value.
+
+    ``ragged``: ``(seq_starts, seq_lens, kv_lens, seq_tables, seq_mask)``
+    per-SEQUENCE metadata for a flattened mixed batch (the ragged entry
+    point, _paged_ragged_step). With ``attn_kernel`` it swaps the
+    per-token decode kernel for ops/ragged_attention.py's per-sequence
+    kernel — each slot's blocks are read ONCE and amortized over its
+    whole chunk instead of once per token. Without the kernel the
+    gathered per-token path below already handles the ragged layout
+    (``tables``/``kv_mask`` arrive pre-indexed per token), which is the
+    CPU fallback tier-1 exercises."""
     x = _embed(params, cfg, tokens)
     use_kernel = (
         attn_kernel
@@ -234,7 +318,18 @@ def _paged_chunk_scan(params, cfg, tokens, pool, tables, kv_mask, cos, sin,
                        per_batch=True)
         v = _split_heads(hv, cfg.n_kv_heads)
         pool_l = _scatter_chunk(pool_l, k, v, blks, offs)
-        if use_kernel:
+        if use_kernel and ragged is not None:
+            from kubeflow_tpu.ops.ragged_attention import (
+                ragged_paged_attention,
+            )
+
+            seq_starts, seq_lens, kv_lens, seq_tables, seq_mask = ragged
+            attn = ragged_paged_attention(
+                q[:, :, 0, :], pool_l["k"], pool_l["v"], seq_tables,
+                seq_mask, seq_starts, seq_lens, kv_lens, block_size,
+                interpret=jax.default_backend() not in ("tpu", "axon"),
+            )[:, :, None, :]
+        elif use_kernel:
             from kubeflow_tpu.ops.paged_attention import (
                 paged_decode_attention,
             )
@@ -390,6 +485,8 @@ class PagedBatcher(_BatcherBase):
         prefix_cache: bool = False,  # share common PREFIXES block-by-block
         admit_chunk: Optional[int] = None,  # prefix-admission piece width
         attn_kernel: Optional[bool] = None,  # pallas paged attention
+        ragged: bool = False,  # fused mixed prefill/decode batches
+        token_budget: Optional[int] = None,  # ragged rows per step
     ):
         self.gen = gen or GenerationConfig()
         # Decode attention THROUGH the tables (ops/paged_attention.py):
@@ -444,6 +541,47 @@ class PagedBatcher(_BatcherBase):
                 "prompts share all their full blocks) under the "
                 "position-0-anchored layout"
             )
+        # Ragged scheduling (arXiv 2604.15464): admission stops being a
+        # separate (1, Lb) prefill dispatch that stalls every in-flight
+        # decode. _admit_free_slots only ALLOCATES (blocks + cursor);
+        # _step assembles one flattened batch per engine step — every
+        # decoding slot's token plus each admitting slot's next prompt
+        # chunk, bounded by token_budget — and runs ONE fused dispatch
+        # (_paged_ragged_step). Sharing tiers and tp plans keep the
+        # legacy alternating path.
+        if ragged:
+            if plan is not None:
+                raise ValueError(
+                    "ragged=True does not compose with plan= (the ragged "
+                    "kernel is single-device; drop one of the two)"
+                )
+            if kv_bits:
+                raise ValueError(
+                    "ragged=True does not compose with kv_bits (the "
+                    "ragged kernel reads bf16 pools) — drop one of the two"
+                )
+            if prompt_cache or prefix_cache:
+                raise ValueError(
+                    "ragged=True does not compose with prompt_cache/"
+                    "prefix_cache yet — the sharing tiers admit through "
+                    "their own prefill programs; drop one of the two"
+                )
+            if token_budget is None:
+                token_budget = 512
+            if token_budget < slots:
+                raise ValueError(
+                    f"token_budget {token_budget} < slots {slots}: every "
+                    "decoding slot needs one row per step"
+                )
+        self.ragged = bool(ragged)
+        self.token_budget = int(token_budget) if ragged else 0
+        self._ragged_admit: dict[int, dict] = {}
+        # Batch-fill observability (models/server.py mirrors the gauge):
+        # fraction of the last step's budget carrying real tokens, plus
+        # lifetime token/step counters for bench.py's mixed mode.
+        self.ragged_fill = 0.0
+        self.ragged_steps = 0
+        self.ragged_tokens = 0
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -644,23 +782,37 @@ class PagedBatcher(_BatcherBase):
             for slot, req in enumerate(self._by_slot)
             if req is not None
         ]
+        # Mid-prefill ragged admissions hold their full bucket of blocks
+        # — they must be preemptable, or a decode-path allocation could
+        # dead-end while admissions sit on the whole pool.
+        slots += [
+            (a["req"].rid, slot) for slot, a in self._ragged_admit.items()
+        ]
         return max(slots)[1] if slots else None
 
     def _preempt(self, slot: int) -> None:
         """Free the slot and re-queue prompt+generated as a continuation
         (greedy continuations are identical after re-prefill; it re-admits
         at a block-aligned padded length, so it may exceed prompt_bucket)."""
-        req = self._by_slot[slot]
-        self._release_slot(slot)
+        if slot in self._ragged_admit:
+            # A mid-prefill ragged admission: nothing was sampled yet, so
+            # the continuation is simply the original request re-queued
+            # (its partial KV is discarded with the blocks).
+            req = self._ragged_admit.pop(slot)["req"]
+            self._clear_slot_storage(slot, req)
+        else:
+            req = self._by_slot[slot]
+            self._release_slot(slot)
         # Front of the queue: a preempted request outranks new arrivals.
         cont = _Request(req.rid, req.prompt, req.tokens, max_new=req.max_new,
                         temperature=req.temperature, stop=req.stop,
                         logit_bias=req.logit_bias,
-                        logprobs=req.logprobs)
+                        logprobs=req.logprobs, deadline=req.deadline)
         self._queue.insert(0, cont)
 
-    def _release_slot(self, slot: int) -> None:
-        req = self._by_slot[slot]
+    def _clear_slot_storage(self, slot: int, req: _Request) -> None:
+        """Return a request's blocks and fence the slot's device state —
+        shared by normal release and mid-prefill (ragged) teardown."""
         for blk in req.blocks:
             if blk in req.shared:
                 self._shared_refs[blk] -= 1
@@ -672,14 +824,21 @@ class PagedBatcher(_BatcherBase):
                 self._free.append(blk)
         req.blocks = []
         req.shared = frozenset()
-        self._by_slot[slot] = None
         self.kv_mask = self.kv_mask.at[slot].set(False)
         self.tables[slot] = 0  # dead writes go to the null block
         self.positions[slot] = 0
 
+    def _release_slot(self, slot: int) -> None:
+        req = self._by_slot[slot]
+        self._clear_slot_storage(slot, req)
+        self._by_slot[slot] = None
+
     # -- internals ---------------------------------------------------------
 
     def _admit_free_slots(self) -> None:
+        if self.ragged:
+            self._admit_free_slots_ragged()
+            return
         if self._prefix_cache_enabled:
             self._admit_free_slots_prefix()
             return
@@ -791,6 +950,65 @@ class PagedBatcher(_BatcherBase):
                          logprobs=req.logprobs),
                 logits, jnp.asarray(padded), prompt_mask,
             )
+
+    def _admit_free_slots_ragged(self) -> None:
+        """Ragged admission ALLOCATES only — blocks, table row, validity
+        mask, sampling state, and a prompt cursor. The prefill itself
+        rides the next _step_ragged dispatches as chunk rows under the
+        token budget, so admission never stalls in-flight decodes and a
+        short prompt's first token can arrive with the SAME dispatch
+        that finishes its prefill."""
+        for slot in range(self.slots):
+            if (self._by_slot[slot] is not None
+                    or slot in self._ragged_admit):
+                continue
+            if not self._queue:
+                return
+            head = self._queue[0]
+            effective = head.prompt + head.tokens
+            bucket = max(
+                self.prompt_bucket,
+                -(-len(effective) // self.block_size) * self.block_size,
+            )
+            need = bucket // self.block_size
+            blocks = self._reserve_take(need)
+            if blocks is None:
+                if (not any(r is not None for r in self._by_slot)
+                        and not self._ragged_admit):
+                    raise RuntimeError(
+                        f"block pool too small: {need} blocks needed for "
+                        f"a {len(effective)}-token prompt, pool has "
+                        f"{self.num_blocks - 1} usable; raise num_blocks"
+                    )
+                return  # pool busy; retry after in-flight slots retire
+            req = self._queue.pop(0)
+            padded, mask = left_pad([effective], self.gen.pad_id, bucket)
+            self.tables[slot] = 0  # stale entries never alias freed blocks
+            self.tables[slot, :len(blocks)] = blocks
+            # Decode continues at the bucket once installed; the cursor
+            # (not ``positions``) tracks mid-prefill progress.
+            self.positions[slot] = bucket
+            row = np.ones((self.max_blocks * self.block_size,), bool)
+            row[:bucket] = np.asarray(mask)[0]
+            self.kv_mask = self.kv_mask.at[slot].set(jnp.asarray(row))
+            installed = _Request(
+                req.rid, req.prompt, list(req.tokens), blocks=blocks,
+                max_new=req.max_new, temperature=req.temperature,
+                stop=req.stop, logit_bias=req.logit_bias,
+                logprobs=req.logprobs, deadline=req.deadline,
+            )
+            # Sampling state goes live NOW: the chunk that completes this
+            # prefill samples the first token inside its own dispatch.
+            self.temps[slot] = (self.gen.temperature
+                                if req.temperature is None
+                                else req.temperature)
+            self._install_bias(slot, installed)
+            self._ragged_admit[slot] = {
+                "req": installed,
+                "padded": np.array(padded),
+                "prompt_mask": None if mask.all() else jnp.asarray(mask),
+                "cursor": _AdmissionCursor(np.asarray(mask)[0], bucket),
+            }
 
     def _admit_free_slots_prefix(self) -> None:
         """Admission under the position-0-anchored layout (prefix_cache):
@@ -951,6 +1169,9 @@ class PagedBatcher(_BatcherBase):
                 req.blocks.append(blk)
 
     def _step(self) -> None:
+        if self.ragged:
+            self._step_ragged()
+            return
         active = self._ensure_step_blocks()
         if not active:
             return
@@ -967,5 +1188,115 @@ class PagedBatcher(_BatcherBase):
         host_next = np.asarray(nxt)
         host_lps = np.asarray(lps)
         for slot in active:
+            self._note_token(slot, int(host_next[slot]),
+                             float(host_lps[slot]))
+
+    def _expire_ragged_admissions(self) -> None:
+        """Cancelled or deadline-expired MID-PREFILL admissions retire
+        before the step assembles: a dead request must not spend budget
+        (slotted requests keep retiring through _note_token)."""
+        for slot, a in list(self._ragged_admit.items()):
+            req = a["req"]
+            reason = self._cancelled.pop(req.rid, None)
+            if reason is None and req.deadline is not None \
+                    and self._clock() >= req.deadline:
+                reason = "deadline"
+            if reason is not None:
+                del self._ragged_admit[slot]
+                self._clear_slot_storage(slot, req)
+                self._deliver_abort(req, reason)
+
+    def _step_ragged(self) -> None:
+        """Assemble ONE flattened mixed batch under the token budget —
+        every decoding slot's next token first (never squeezed out),
+        then each admitting slot's next prompt chunk — and run the
+        single fused dispatch. Spans are laid out in slot order, so
+        seq_starts is non-decreasing (the kernel's spill-row contract)."""
+        self._expire_ragged_admissions()
+        active = self._ensure_step_blocks()
+        if not active and not self._ragged_admit:
+            return
+        tb = self.token_budget
+        tokens = np.full((tb, 1), self.gen.pad_id, np.int32)
+        tok_pos = np.zeros((tb,), np.int32)
+        tok_seq = np.zeros((tb,), np.int32)
+        seq_starts = np.zeros((self.slots,), np.int32)
+        seq_lens = np.zeros((self.slots,), np.int32)
+        kv_lens = np.zeros((self.slots,), np.int32)
+        last_rows = np.zeros((self.slots,), np.int32)
+        budget = tb - len(active)  # prefill rides what decode leaves
+        rows = 0
+        completing: list[int] = []
+        for slot in range(self.slots):
+            if self._by_slot[slot] is not None:
+                tokens[rows, 0] = self.tokens[slot, 0]
+                tok_pos[rows] = self.positions[slot]
+                tok_seq[rows] = slot
+                seq_starts[slot] = rows
+                seq_lens[slot] = 1
+                kv_lens[slot] = self.positions[slot] + 1
+                last_rows[slot] = rows
+                rows += 1
+            elif slot in self._ragged_admit and budget > 0:
+                a = self._ragged_admit[slot]
+                start, n = a["cursor"].take(budget)
+                if n == 0:
+                    continue
+                budget -= n
+                tokens[rows:rows + n, 0] = a["padded"][0, start:start + n]
+                tok_pos[rows:rows + n] = np.arange(start, start + n)
+                tok_seq[rows:rows + n] = slot
+                seq_starts[slot] = rows
+                seq_lens[slot] = n
+                kv_lens[slot] = start + n
+                last_rows[slot] = rows + n - 1
+                rows += n
+                if a["cursor"].done:
+                    completing.append(slot)
+        if rows == 0:
+            return
+        # Dispatch width: the smallest power-of-two bucket that holds the
+        # assembled rows (floor 8, cap token_budget). The budget is
+        # CAPACITY, not shape — a mostly-decode step must not pay a full
+        # 512-row dispatch to carry 9 live rows; a decode-only step on a
+        # small engine should cost what the legacy (slots,1) step costs.
+        # Power-of-two buckets bound the compiled step variants at
+        # ~log2(budget).
+        width = 8
+        while width < rows:
+            width *= 2
+        width = min(width, tb)
+        self.key, sub = jax.random.split(self.key)
+        nxt, lps, self.pool = _paged_ragged_step(
+            self.params, self.cfg, jnp.array(tokens[:width]), self.pool,
+            jnp.array(self.tables), self.kv_mask,
+            jnp.array(tok_pos[:width]),
+            jnp.array(tok_seq[:width]), jnp.asarray(rows, jnp.int32),
+            jnp.array(seq_starts), jnp.array(seq_lens),
+            jnp.array(kv_lens), jnp.array(last_rows), sub,
+            self.block_size, jnp.array(self.temps), self.gen.top_k,
+            self.gen.top_p, bias=self._bias,
+            attn_kernel=self.attn_kernel,
+        )
+        self.ragged_steps += 1
+        self.ragged_tokens += rows
+        self.ragged_fill = rows / tb
+        host_next = np.asarray(nxt)
+        host_lps = np.asarray(lps)
+        for slot in active:
+            self.positions[slot] += 1
+        for slot in active:
+            self._note_token(slot, int(host_next[slot]),
+                             float(host_lps[slot]))
+        for slot in completing:
+            # The completing chunk's dispatch already sampled the first
+            # token (its span's last row) — finish the admission
+            # bookkeeping without a separate prefill readback.
+            a = self._ragged_admit.pop(slot)
+            req = a["req"]
+            req.budget = self._initial_budget(req) - len(req.tokens)
+            self._by_slot[slot] = req
+            self._post_admit(slot, jnp.asarray(a["padded"]),
+                             a["prompt_mask"])
             self._note_token(slot, int(host_next[slot]),
                              float(host_lps[slot]))

@@ -191,6 +191,40 @@ def serving_port_from_env(default: int = 8000) -> int:
     return port
 
 
+def ragged_from_env() -> tuple[bool, Optional[int]]:
+    """Consuming end of the serving-engine ragged knobs: the
+    ``(ragged, token_budget)`` pair for engine construction
+    (``PagedBatcher(ragged=..., token_budget=...)``), with None budget
+    meaning the engine default. Raises on garbage — a hand-set env var
+    must not silently fall back to defaults."""
+    import os
+
+    from kubeflow_tpu.webhook.tpu_env import (
+        KUBEFLOW_TPU_RAGGED_TOKEN_BUDGET,
+        KUBEFLOW_TPU_SERVING_RAGGED,
+    )
+
+    raw = os.environ.get(KUBEFLOW_TPU_SERVING_RAGGED, "").strip().lower()
+    if raw not in ("", "0", "1", "true", "false"):
+        raise ValueError(
+            f"{KUBEFLOW_TPU_SERVING_RAGGED}={raw!r}: want 0/1/true/false"
+        )
+    ragged = raw in ("1", "true")
+    budget: Optional[int] = None
+    raw_b = os.environ.get(KUBEFLOW_TPU_RAGGED_TOKEN_BUDGET, "").strip()
+    if raw_b:
+        try:
+            budget = int(raw_b)
+        except ValueError:
+            budget = 0
+        if budget <= 0:
+            raise ValueError(
+                f"{KUBEFLOW_TPU_RAGGED_TOKEN_BUDGET}={raw_b!r}: want a "
+                "positive integer"
+            )
+    return ragged, budget
+
+
 class InferenceServer:
     """HTTP front-end driving one batching engine on a background thread.
 
@@ -340,6 +374,11 @@ class InferenceServer:
                 try:
                     self.engine._admit_free_slots()
                     self.engine._step()
+                    if (self.metrics is not None
+                            and getattr(self.engine, "ragged", False)):
+                        self.metrics.serving_ragged_batch_fill.set(
+                            self.engine.ragged_fill
+                        )
                 except Exception as err:  # device OOM, preemption, ...
                     # The engine is in an unknown state: fail loudly —
                     # close every pending queue so no handler blocks
@@ -633,10 +672,26 @@ class InferenceServer:
                             r is not None for r in server.engine._by_slot
                         )
                         depth = len(server.engine._queue)
+                        # Mid-admission work is in neither queue nor
+                        # slot: one chunked admission, or any number of
+                        # ragged prompt cursors.
                         admitting = int(
                             getattr(server.engine, "_admitting", None)
                             is not None
-                        )
+                        ) + len(getattr(server.engine, "_ragged_admit", {}))
+                        rag = None
+                        if getattr(server.engine, "ragged", False):
+                            steps = server.engine.ragged_steps
+                            rag = {
+                                "batch_fill": round(
+                                    server.engine.ragged_fill, 4
+                                ),
+                                "steps": steps,
+                                "tokens": server.engine.ragged_tokens,
+                                "tokens_per_step": round(
+                                    server.engine.ragged_tokens / steps, 2
+                                ) if steps else 0.0,
+                            }
                         ttft = list(server._ttft)
                         e2e = list(server._e2e)
                         tokens_out = server._tokens_out
@@ -670,6 +725,7 @@ class InferenceServer:
                         "max_queue_depth": server.max_queue_depth,
                         "draining": server._draining,
                         "drain_duration_s": server._drain_duration,
+                        **({"ragged": rag} if rag is not None else {}),
                     })
                 else:
                     self._json(404, {"error": "not found"})

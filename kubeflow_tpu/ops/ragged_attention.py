@@ -1,0 +1,326 @@
+"""Pallas ragged paged attention: one fused dispatch for mixed batches.
+
+The paged serving engine alternated two compiled shapes per scheduling
+round: a (1, Lb) prompt prefill (``_paged_admit``) and a (B, 1) decode
+step — every admission stalled every in-flight decode for a full
+prefill, and short prompt chunks left the MXU idle. Ragged paged
+attention (arXiv 2604.15464) fuses both into ONE dispatch over a
+flattened token axis: N decode tokens plus M variable-length prefill
+chunks become a single ``(total_tokens,)`` batch with per-SEQUENCE
+``(seq_start, seq_len, kv_len)`` metadata describing which contiguous
+row span belongs to which slot and where that slot's KV history ends.
+
+This module owns the attention math for that layout:
+
+- ``ragged_paged_attention`` — the Pallas TPU kernel. One grid program
+  per sequence; each program tiles its query span ``q_tile`` rows at a
+  time (DMA'd from the flattened q in HBM into VMEM) and streams the
+  slot's KV blocks through the same double-buffered async-copy online
+  softmax as ops/paged_attention.py. Causality INSIDE the ragged chunk
+  falls out of absolute positions: query j of a chunk whose last token
+  sits at kv position ``kv_len - 1`` lives at ``kv_len - seq_len + j``
+  and attends ``k_pos <= kv_len - seq_len + j`` — so a decode token
+  (seq_len 1) sees its whole history and a prefill chunk is triangular
+  over itself, with no separate mask plumbing. Per-sequence KV blocks
+  are read ONCE and amortized over the whole chunk, instead of once per
+  token as a (T, 1)-shaped decode dispatch would.
+- ``ragged_attention_reference`` — the pure-jnp gather/segment-softmax
+  fallback, selected off-TPU (tier-1 runs CPU): derives each row's
+  owning sequence from the metadata, gathers the slot's logical view
+  through the tables, and applies the identical validity rule
+  (stored kv_mask AND ``k_pos <= q_pos``) in f32 — token-exact vs the
+  dense ``_gqa_decode_attention`` path by construction.
+
+Layout contract (enforced by the wrapper, produced by the schedulers):
+- sequences occupy disjoint, LEFT-TO-RIGHT row spans of q: seq_starts
+  is non-decreasing and span i ends before span i+1 begins. The kernel
+  relies on this — a partial last q-tile's spill rows land on the NEXT
+  sequence's span, which a LATER grid program overwrites (TPU grid
+  iterations run sequentially).
+- ``seq_lens[s] == 0`` marks an inactive slot (its program is a no-op).
+- kv_mask carries PADDING validity only; future positions may stay True
+  because the positional bound already hides them (the same convention
+  models/paged.py documents for its decode step).
+
+bf16 pools only; int8 pools and sliding-window configs keep the
+gathered path (models/paged.py dispatches, same contract as the decode
+kernel).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+try:  # pragma: no cover - exercised via the public entry point
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pallas unavailable: caller must use the reference
+    pl = None
+    pltpu = None
+
+
+def _ragged_kernel(starts_ref, lens_ref, kvlens_ref, tables_ref,
+                   q_hbm, kpool_ref, vpool_ref, mask_ref, o_hbm,
+                   qbuf, obuf, kbuf, vbuf, sems, qsem, osem, *,
+                   block_size, q_tile, n_kv_heads, group, head_dim):
+    """One program per sequence: tile the query span, stream KV blocks."""
+    s = pl.program_id(0)
+    qlen = lens_ref[s]
+
+    @pl.when(qlen > 0)
+    def _():
+        start = starts_ref[s]
+        kvlen = kvlens_ref[s]
+        base = kvlen - qlen  # absolute kv position of the chunk's row 0
+        scale = 1.0 / math.sqrt(head_dim)
+        nqt = (qlen + q_tile - 1) // q_tile
+
+        def kdma(slot, i):
+            return pltpu.make_async_copy(
+                kpool_ref.at[tables_ref[s, i]], kbuf.at[slot],
+                sems.at[slot, 0],
+            )
+
+        def vdma(slot, i):
+            return pltpu.make_async_copy(
+                vpool_ref.at[tables_ref[s, i]], vbuf.at[slot],
+                sems.at[slot, 1],
+            )
+
+        def tile_body(t, _):
+            row0 = start + t * q_tile
+            qcopy = pltpu.make_async_copy(
+                q_hbm.at[pl.ds(row0, q_tile)], qbuf, qsem
+            )
+            qcopy.start()
+            qcopy.wait()
+            # Rows are (token, group) pairs: row j // group is query
+            # token j // G of this tile, at absolute position
+            # base + t·q_tile + j // G. The tile's KV bound is its LAST
+            # query's position + 1 (clamped to the stored length).
+            q = jnp.stack([
+                qbuf[:, h * group:(h + 1) * group, :]
+                .reshape(q_tile * group, head_dim).astype(jnp.float32)
+                for h in range(n_kv_heads)
+            ])  # (Hkv, q_tile·G, D)
+            q_pos = (base + t * q_tile) + jax.lax.broadcasted_iota(
+                jnp.int32, (q_tile * group, 1), 0
+            ) // group  # (q_tile·G, 1)
+            hi = jnp.minimum(base + (t + 1) * q_tile, kvlen)
+            nblk = jnp.maximum((hi + block_size - 1) // block_size, 1)
+
+            kdma(0, 0).start()
+            vdma(0, 0).start()
+            m0 = jnp.full((n_kv_heads, q_tile * group, 1), -jnp.inf,
+                          jnp.float32)
+            l0 = jnp.zeros((n_kv_heads, q_tile * group, 1), jnp.float32)
+            acc0 = jnp.zeros((n_kv_heads, q_tile * group, head_dim),
+                             jnp.float32)
+
+            def body(i, carry):
+                m, l, acc = carry
+                slot = jax.lax.rem(i, 2)
+                nxt = 1 - slot
+
+                @pl.when(i + 1 < nblk)
+                def _():
+                    kdma(nxt, i + 1).start()
+                    vdma(nxt, i + 1).start()
+
+                kdma(slot, i).wait()
+                vdma(slot, i).wait()
+                k = kbuf[slot].astype(jnp.float32)  # (Hkv, BS, D)
+                v = vbuf[slot].astype(jnp.float32)
+
+                # Validity = stored kv_mask AND the positional causal
+                # bound per (query row, key) pair — identical rule to
+                # the decode kernel, widened to a 2D tile.
+                k_pos = i * block_size + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, block_size), 1
+                )  # (1, BS)
+                valid = (
+                    mask_ref[0, pl.ds(i * block_size, block_size)][None, :]
+                    != 0
+                ) & (k_pos <= q_pos)  # (q_tile·G, BS)
+
+                dn = (((1,), (1,)), ((), ()))
+                sc = jnp.stack([
+                    jax.lax.dot_general(q[h], k[h], dn,
+                                        preferred_element_type=jnp.float32)
+                    for h in range(n_kv_heads)
+                ]) * scale  # (Hkv, q_tile·G, BS)
+                sc = jnp.where(valid[None], sc, -jnp.inf)
+
+                m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
+                # Fully-masked rows (pad blocks, garbage tail rows) keep
+                # m_new = -inf; exp(-inf - -inf) would be NaN — pin to 0.
+                alpha = jnp.where(jnp.isfinite(m_new),
+                                  jnp.exp(m - m_new), 0.0)
+                p = jnp.where(jnp.isfinite(m_new), jnp.exp(sc - m_new), 0.0)
+                l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+                pv = jnp.stack([
+                    jax.lax.dot_general(
+                        p[h], v[h], (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    for h in range(n_kv_heads)
+                ])  # (Hkv, q_tile·G, D)
+                return m_new, l_new, acc * alpha + pv
+
+            m, l, acc = jax.lax.fori_loop(0, nblk, body, (m0, l0, acc0))
+            out = acc / jnp.maximum(l, 1e-30)
+            for h in range(n_kv_heads):
+                obuf[:, h * group:(h + 1) * group, :] = (
+                    out[h].reshape(q_tile, group, head_dim)
+                    .astype(obuf.dtype)
+                )
+            ocopy = pltpu.make_async_copy(
+                obuf, o_hbm.at[pl.ds(row0, q_tile)], osem
+            )
+            ocopy.start()
+            ocopy.wait()
+
+        jax.lax.fori_loop(0, nqt, tile_body, None)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "q_tile", "interpret")
+)
+def ragged_paged_attention(
+    q: jax.Array,          # (T, Hq, D) flattened mixed-batch queries
+    k_pool: jax.Array,     # (NB, Hkv, BS, D) bf16 block pool
+    v_pool: jax.Array,     # (NB, Hkv, BS, D)
+    tables: jax.Array,     # (S, MAXB) int32 physical block ids per slot
+    kv_mask: jax.Array,    # (S, MAXB·BS) bool valid-key mask per slot
+    seq_starts: jax.Array,  # (S,) int32 — first q row of each sequence
+    seq_lens: jax.Array,    # (S,) int32 — q rows this step (0 = inactive)
+    kv_lens: jax.Array,     # (S,) int32 — kv length INCLUDING this chunk
+    block_size: int,
+    q_tile: int = 16,
+    interpret: bool = False,
+) -> jax.Array:
+    """Ragged paged GQA attention over a mixed batch; returns (T, Hq, D).
+
+    Row r of sequence s (``seq_starts[s] <= r < seq_starts[s] +
+    seq_lens[s]``) attends slot s's pool blocks at kv positions
+    ``<= kv_lens[s] - seq_lens[s] + (r - seq_starts[s])`` where kv_mask
+    allows — numerically the gathered ``_gqa_decode_attention`` rule
+    (``ragged_attention_reference`` pins the agreement). Rows belonging
+    to no sequence return unspecified values; callers never read them.
+    """
+    if pl is None:
+        raise RuntimeError("pallas unavailable; use the reference path")
+    t, hq, d = q.shape
+    nb, hkv, bs, _ = k_pool.shape
+    if bs != block_size:
+        raise ValueError(f"pool block size {bs} != block_size {block_size}")
+    if hq % hkv:
+        raise ValueError(f"{hq} q heads not divisible by {hkv} kv heads")
+    s, max_blocks = tables.shape
+    if kv_mask.shape != (s, max_blocks * bs):
+        raise ValueError(
+            f"kv_mask shape {kv_mask.shape} != ({s}, {max_blocks * bs}) "
+            "(tables × block_size layout)"
+        )
+    # One q_tile of slack absorbs the last active tile's spill rows (the
+    # kernel writes whole tiles; see the layout contract in the module
+    # docstring) and keeps every tile's q DMA in bounds.
+    qp = jnp.pad(q, ((0, q_tile), (0, 0), (0, 0)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(s,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # q: tiles DMA'd per seq
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, max_blocks * bs), lambda i, *_: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((q_tile, hq, d), q.dtype),
+            pltpu.VMEM((q_tile, hq, d), q.dtype),
+            pltpu.VMEM((2, hkv, bs, d), k_pool.dtype),
+            pltpu.VMEM((2, hkv, bs, d), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    kernel = functools.partial(
+        _ragged_kernel, block_size=block_size, q_tile=q_tile,
+        n_kv_heads=hkv, group=hq // hkv, head_dim=d,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t + q_tile, hq, d), q.dtype),
+        interpret=interpret,
+    )(seq_starts.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      kv_lens.astype(jnp.int32), tables.astype(jnp.int32),
+      qp, k_pool, v_pool, kv_mask.astype(jnp.int8))
+    return out[:t]
+
+
+@functools.partial(jax.jit, static_argnames=("block_size",))
+def ragged_attention_reference(
+    q: jax.Array,          # (T, Hq, D)
+    k_pool: jax.Array,     # (NB, Hkv, BS, D)
+    v_pool: jax.Array,     # (NB, Hkv, BS, D)
+    tables: jax.Array,     # (S, MAXB)
+    kv_mask: jax.Array,    # (S, MAXB·BS)
+    seq_starts: jax.Array,  # (S,)
+    seq_lens: jax.Array,    # (S,)
+    kv_lens: jax.Array,     # (S,)
+    block_size: int,
+) -> jax.Array:
+    """Pure-jnp gather/segment-softmax fallback; returns (T, Hq, D).
+
+    The off-TPU selection of the ragged path: gathers each row's slot
+    view through the tables and applies the identical validity rule in
+    f32. Rows owned by no sequence come out 0 (never read). Same
+    numerics as the gathered ``_gqa_decode_attention`` — this is the
+    function the parity suite holds both the kernel and the schedulers
+    against.
+    """
+    t, hq, d = q.shape
+    s, maxb = tables.shape
+    hkv = k_pool.shape[1]
+    group = hq // hkv
+    rows = jnp.arange(t)
+    in_seq = (rows[None, :] >= seq_starts[:, None]) & (
+        rows[None, :] < (seq_starts + seq_lens)[:, None]
+    )  # (S, T)
+    tok_seq = jnp.argmax(in_seq, axis=0)  # (T,), 0 where unowned
+    tok_own = jnp.any(in_seq, axis=0)
+    tok_pos = (
+        kv_lens[tok_seq] - seq_lens[tok_seq]
+        + rows - seq_starts[tok_seq]
+    )  # absolute kv position per row
+
+    def gathered(pool):
+        g = pool[tables]  # (S, MAXB, Hkv, BS, D)
+        return g.transpose(0, 2, 1, 3, 4).reshape(
+            s, hkv, maxb * block_size, d
+        )
+
+    kg = gathered(k_pool)[tok_seq].astype(jnp.float32)  # (T, Hkv, L, D)
+    vg = gathered(v_pool)[tok_seq].astype(jnp.float32)
+    qf = q.reshape(t, hkv, group, d).astype(jnp.float32)
+    scores = jnp.einsum("thgd,thld->thgl", qf, kg) / math.sqrt(d)
+    k_pos = jnp.arange(maxb * block_size)
+    valid = (
+        kv_mask[tok_seq][:, None, None, :]
+        & (k_pos[None, None, None, :] <= tok_pos[:, None, None, None])
+        & tok_own[:, None, None, None]
+    )
+    scores = jnp.where(valid, scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.where(jnp.isfinite(m), jnp.exp(scores - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("thgl,thld->thgd", p, vg) / jnp.maximum(l, 1e-30)
+    return out.reshape(t, hq, d).astype(q.dtype)

@@ -50,6 +50,10 @@ JAX_NUM_PROCESSES = "JAX_NUM_PROCESSES"
 MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
 MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
 MEGASCALE_COORDINATOR_ADDRESS = "MEGASCALE_COORDINATOR_ADDRESS"
+# Serving-engine tuning knobs (models/server.py serve_from_env): ragged
+# mixed prefill/decode batching and its per-step token budget.
+KUBEFLOW_TPU_SERVING_RAGGED = "KUBEFLOW_TPU_SERVING_RAGGED"
+KUBEFLOW_TPU_RAGGED_TOKEN_BUDGET = "KUBEFLOW_TPU_RAGGED_TOKEN_BUDGET"
 
 # name -> who produces it and from what. Annotation-projected env names are
 # defined next to their annotations in kubeflow_tpu/api/annotations.py and
@@ -76,6 +80,12 @@ ENV_CONTRACT: dict = {
     "tpu-checkpoint-grace-seconds annotation",
     ann.CHECKPOINT_DIR_ENV_NAME: "webhook project_checkpoint_env: "
     "tpu-checkpoint-dir annotation (always set for TPU notebooks)",
+    KUBEFLOW_TPU_SERVING_RAGGED: "operator-set on the notebook container "
+    "(no webhook producer yet): 1 enables ragged mixed prefill/decode "
+    "batching in models/server.py engine construction",
+    KUBEFLOW_TPU_RAGGED_TOKEN_BUDGET: "operator-set on the notebook "
+    "container: per-step ragged token budget (default 512; must be >= "
+    "the engine's slot count)",
     ann.QUANT_ENV_NAME: "webhook: tpu-quantization annotation",
     ann.PROFILING_ENV_NAME: "webhook: tpu-profiling-port annotation",
     ann.SERVING_ENV_NAME: "webhook: tpu-serving-port annotation",

@@ -1,0 +1,380 @@
+"""Ragged paged attention: ops-level parity and fused-scheduler parity.
+
+The ragged path replaces the alternating admit-then-step dispatches with
+one fused mixed prefill/decode batch per engine step, so the whole
+contract is token-exactness vs the dense reference: the pure-jnp
+fallback must match the gathered ``_gqa_decode_attention`` rule row for
+row, the Pallas kernel (interpret mode on CPU) must match the fallback,
+and ``PagedBatcher(ragged=True)`` / ``ContinuousBatcher(ragged=True)``
+must emit the same greedy tokens as their legacy alternating paths.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama as L
+from kubeflow_tpu.models.continuous import ContinuousBatcher
+from kubeflow_tpu.models.llama import _gqa_decode_attention
+from kubeflow_tpu.models.paged import PagedBatcher
+from kubeflow_tpu.models.serving import GenerationConfig, batch_generate
+from kubeflow_tpu.ops.ragged_attention import (
+    ragged_attention_reference,
+    ragged_paged_attention,
+)
+
+from tests.test_continuous import _assert_greedy_consistent, _prompts
+
+
+# ---------------------------------------------------------------------------
+# Ops level: reference vs dense rule, kernel vs reference
+
+
+def _setup(s=3, hq=8, hkv=4, d=128, bs=16, maxb=6, nb=32, t=24, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q = jax.random.normal(ks[0], (t, hq, d), jnp.bfloat16)
+    kp = jax.random.normal(ks[1], (nb, hkv, bs, d), jnp.bfloat16)
+    vp = jax.random.normal(ks[2], (nb, hkv, bs, d), jnp.bfloat16)
+    tables = jax.random.permutation(ks[3], nb)[: s * maxb].reshape(
+        s, maxb
+    ).astype(jnp.int32)
+    return q, kp, vp, tables
+
+
+def _meta(spans, t, maxb, bs):
+    """Build (starts, lens, kvls, kv_mask) from [(seq_len, kv_len)]."""
+    starts, lens, kvls = [], [], []
+    row = 0
+    for n, kvl in spans:
+        starts.append(row)
+        lens.append(n)
+        kvls.append(kvl)
+        row += n
+    assert row <= t
+    kv_mask = jnp.arange(maxb * bs)[None, :] < jnp.asarray(kvls)[:, None]
+    return (jnp.asarray(starts, jnp.int32), jnp.asarray(lens, jnp.int32),
+            jnp.asarray(kvls, jnp.int32), kv_mask)
+
+
+def _dense_rows(q, kp, vp, tables, kv_mask, starts, lens, kvls, bs):
+    """Row-by-row dense reference through _gqa_decode_attention: query j
+    of sequence s sits at absolute position kv_len - seq_len + j and
+    attends the slot's gathered view at that position."""
+    t, hq, d = q.shape
+    hkv = kp.shape[1]
+    maxb = tables.shape[1]
+    out = np.zeros((t, hq, d), np.float32)
+    for s in range(tables.shape[0]):
+        n = int(lens[s])
+        if n == 0:
+            continue
+        g = np.asarray(kp[tables[s]], np.float32).transpose(
+            1, 0, 2, 3
+        ).reshape(hkv, maxb * bs, d)
+        gv = np.asarray(vp[tables[s]], np.float32).transpose(
+            1, 0, 2, 3
+        ).reshape(hkv, maxb * bs, d)
+        for j in range(n):
+            row = int(starts[s]) + j
+            pos = int(kvls[s]) - n + j
+            o = _gqa_decode_attention(
+                jnp.asarray(q[row], jnp.float32)[None, :, None, :],
+                jnp.asarray(g)[None], jnp.asarray(gv)[None],
+                jnp.asarray([pos]), kv_mask=kv_mask[s][None],
+                per_batch=True,
+            )[0, :, 0]
+            out[row] = np.asarray(o, np.float32)
+    return out
+
+
+def _assert_close(out, ref, owned_rows, tol=2e-2):
+    err = float(jnp.max(jnp.abs(
+        out.astype(jnp.float32)[owned_rows] - ref[owned_rows]
+    )))
+    assert err < tol, f"ragged path diverges from dense rule: {err}"
+
+
+def _owned(starts, lens, t):
+    rows = []
+    for s, n in zip(np.asarray(starts), np.asarray(lens)):
+        rows.extend(range(int(s), int(s) + int(n)))
+    return np.asarray(rows, np.int64)
+
+
+class TestReferenceVsDense:
+    @pytest.mark.parametrize("spans", [
+        [(1, 17), (1, 40), (1, 96)],          # decode-only
+        [(8, 8), (12, 12), (4, 20)],          # prefill-only chunks
+        [(1, 33), (10, 10), (1, 5)],          # mixed decode + prefill
+        [(1, 64), (1, 96), (6, 22)],          # mixed, longer histories
+        [(5, 30), (1, 1), (0, 0)],            # single-token tail + idle
+    ])
+    def test_rows_match_gathered_gqa_rule(self, spans):
+        q, kp, vp, tables = _setup()
+        starts, lens, kvls, kv_mask = _meta(spans, 24, 6, 16)
+        out = ragged_attention_reference(
+            q, kp, vp, tables, kv_mask, starts, lens, kvls, 16
+        )
+        ref = _dense_rows(q, kp, vp, tables, kv_mask, starts, lens, kvls, 16)
+        _assert_close(out, ref, _owned(starts, lens, 24))
+
+    def test_all_true_mask_relies_on_positional_bound(self):
+        """Schedulers may mark future positions True and lean on the
+        ``k_pos <= q_pos`` bound — the fallback must apply it."""
+        q, kp, vp, tables = _setup(seed=3)
+        starts, lens, kvls, _ = _meta(
+            [(1, 25), (7, 18), (1, 90)], 24, 6, 16
+        )
+        kv_mask = jnp.ones((3, 6 * 16), bool)
+        out = ragged_attention_reference(
+            q, kp, vp, tables, kv_mask, starts, lens, kvls, 16
+        )
+        ref = _dense_rows(q, kp, vp, tables, kv_mask, starts, lens, kvls, 16)
+        _assert_close(out, ref, _owned(starts, lens, 24))
+
+
+class TestKernelVsReference:
+    @pytest.mark.parametrize("spans,q_tile", [
+        ([(1, 17), (1, 40), (1, 96)], 8),      # decode-only
+        ([(8, 8), (12, 12), (4, 20)], 8),      # prefill-only
+        ([(1, 33), (20, 20), (1, 5)], 8),      # chunk spans 3 q-tiles
+        # Non-default q_tiles each pay their own interpret-mode compile;
+        # tier-1's wall budget keeps them in the full suite only.
+        pytest.param([(5, 30), (1, 1), (0, 0)], 16,
+                     marks=pytest.mark.slow),  # partial tile + idle slot
+        pytest.param([(3, 19), (9, 41), (12, 12)], 4,
+                     marks=pytest.mark.slow),  # every span spills a tile
+    ])
+    def test_kernel_matches_reference(self, spans, q_tile):
+        q, kp, vp, tables = _setup(seed=1)
+        starts, lens, kvls, kv_mask = _meta(spans, 24, 6, 16)
+        out = ragged_paged_attention(
+            q, kp, vp, tables, kv_mask, starts, lens, kvls, 16,
+            q_tile=q_tile, interpret=True,
+        )
+        ref = ragged_attention_reference(
+            q, kp, vp, tables, kv_mask, starts, lens, kvls, 16
+        ).astype(jnp.float32)
+        _assert_close(out, np.asarray(ref), _owned(starts, lens, 24))
+
+    def test_spill_rows_are_overwritten_not_leaked(self):
+        """A partial last q-tile writes whole tiles: its spill rows land
+        on the NEXT sequence's span and must be overwritten by the later
+        program — every owned row must still be exact."""
+        q, kp, vp, tables = _setup(seed=2)
+        # 5 rows then 7 rows: with q_tile=4 the first sequence's second
+        # tile covers rows 4..7, clobbering rows 5..7 of sequence 1.
+        starts, lens, kvls, kv_mask = _meta(
+            [(5, 21), (7, 39), (1, 64)], 24, 6, 16
+        )
+        out = ragged_paged_attention(
+            q, kp, vp, tables, kv_mask, starts, lens, kvls, 16,
+            q_tile=4, interpret=True,
+        )
+        ref = ragged_attention_reference(
+            q, kp, vp, tables, kv_mask, starts, lens, kvls, 16
+        ).astype(jnp.float32)
+        _assert_close(out, np.asarray(ref), _owned(starts, lens, 24))
+
+    def test_kv_mask_shape_validated(self):
+        q, kp, vp, tables = _setup()
+        starts, lens, kvls, _ = _meta([(1, 4), (1, 4), (1, 4)], 24, 6, 16)
+        with pytest.raises(ValueError, match="kv_mask shape"):
+            ragged_paged_attention(
+                q, kp, vp, tables, jnp.ones((3, 7), bool), starts, lens,
+                kvls, 16, interpret=True,
+            )
+
+
+# ---------------------------------------------------------------------------
+# Scheduler level: fused ragged batches vs the legacy alternating path
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = L.LLAMA_CONFIGS["tiny"]
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _run(batcher, prompts):
+    rids = [batcher.submit(p) for p in prompts]
+    out = batcher.run()
+    return [out[r] for r in rids]
+
+
+class TestPagedRagged:
+    def test_single_request_matches_fused_batch_path(self, tiny):
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=8, eos_id=-1)
+        prompt = [5, 9, 17, 33]
+        ref = batch_generate(params, cfg, [prompt], gen=gen, pad_to=16)[0]
+        pb = PagedBatcher(params, cfg, gen=gen, slots=1, num_blocks=16,
+                          block_size=8, prompt_bucket=16,
+                          attn_kernel=False, ragged=True, token_budget=8)
+        rid = pb.submit(prompt)
+        assert pb.run()[rid] == [int(t) for t in ref]
+
+    @pytest.mark.slow
+    def test_mixed_batch_token_parity_with_legacy(self, tiny):
+        """The headline invariant: fusing decode rows and prefill chunks
+        into one dispatch must not move any request off the greedy path
+        the alternating scheduler produced."""
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        prompts = _prompts(cfg, 6)
+        legacy = _run(
+            PagedBatcher(params, cfg, gen=gen, slots=3, num_blocks=24,
+                         block_size=8, prompt_bucket=16,
+                         attn_kernel=False),
+            prompts,
+        )
+        ragged = _run(
+            PagedBatcher(params, cfg, gen=gen, slots=3, num_blocks=24,
+                         block_size=8, prompt_bucket=16,
+                         attn_kernel=False, ragged=True, token_budget=12),
+            prompts,
+        )
+        assert legacy == ragged
+        for prompt, toks in zip(prompts, ragged):
+            _assert_greedy_consistent(params, cfg, prompt, toks)
+
+    @pytest.mark.slow
+    def test_starved_budget_still_completes_admissions(self, tiny):
+        """token_budget == slots leaves at most zero prefill rows on a
+        full step — admissions must still make progress on idle steps
+        and complete exactly."""
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=4, eos_id=-1)
+        prompts = _prompts(cfg, 4)
+        legacy = _run(
+            PagedBatcher(params, cfg, gen=gen, slots=2, num_blocks=24,
+                         block_size=8, prompt_bucket=16,
+                         attn_kernel=False),
+            prompts,
+        )
+        ragged = _run(
+            PagedBatcher(params, cfg, gen=gen, slots=2, num_blocks=24,
+                         block_size=8, prompt_bucket=16,
+                         attn_kernel=False, ragged=True, token_budget=2),
+            prompts,
+        )
+        assert legacy == ragged
+
+    @pytest.mark.slow
+    def test_preemption_mid_batch_token_parity(self, tiny):
+        """Pool pressure preempts mid-run (including mid-prefill
+        admissions holding their bucket) — both schedulers must converge
+        to the same greedy tokens and return every block."""
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=10, eos_id=-1)
+        prompts = _prompts(cfg, 4, key=11)
+        legacy = _run(
+            PagedBatcher(params, cfg, gen=gen, slots=3, num_blocks=10,
+                         block_size=8, prompt_bucket=16,
+                         attn_kernel=False),
+            prompts,
+        )
+        pb = PagedBatcher(params, cfg, gen=gen, slots=3, num_blocks=10,
+                          block_size=8, prompt_bucket=16,
+                          attn_kernel=False, ragged=True, token_budget=24)
+        ragged = _run(pb, prompts)
+        assert legacy == ragged
+        assert pb.free_blocks == 9  # everything released (block 0 null)
+
+    def test_mid_prefill_cancel_frees_blocks(self, tiny):
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        pb = PagedBatcher(params, cfg, gen=gen, slots=2, num_blocks=16,
+                          block_size=8, prompt_bucket=16,
+                          attn_kernel=False, ragged=True, token_budget=4)
+        p = _prompts(cfg, 2, key=3)
+        r1, r2 = pb.submit(p[0]), pb.submit(p[1])
+        pb._admit_free_slots()
+        pb._step()  # partial prefill in flight
+        assert pb._ragged_admit
+        assert pb.cancel(r1)
+        out = pb.run()
+        assert len(out[r2]) == 6
+        assert pb.run_aborted() == {r1: "cancelled"}
+        assert pb.free_blocks == 15
+
+    def test_fill_stats_populate(self, tiny):
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=4, eos_id=-1)
+        pb = PagedBatcher(params, cfg, gen=gen, slots=2, num_blocks=24,
+                          block_size=8, prompt_bucket=16,
+                          attn_kernel=False, ragged=True, token_budget=8)
+        _run(pb, _prompts(cfg, 3))
+        assert pb.ragged_steps > 0
+        assert pb.ragged_tokens > pb.ragged_steps  # prefill rows counted
+        assert 0.0 < pb.ragged_fill <= 1.0
+
+    @pytest.mark.slow
+    def test_kernel_smoke_end_to_end(self, tiny):
+        """attn_kernel=True off-TPU runs the Pallas kernel interpreted
+        through the full engine loop — slow, so one short request."""
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=2, eos_id=-1)
+        prompts = [[5, 9, 17, 33]]
+        ref = _run(
+            PagedBatcher(params, cfg, gen=gen, slots=1, num_blocks=16,
+                         block_size=8, prompt_bucket=16,
+                         attn_kernel=False, ragged=True, token_budget=8),
+            prompts,
+        )
+        out = _run(
+            PagedBatcher(params, cfg, gen=gen, slots=1, num_blocks=16,
+                         block_size=8, prompt_bucket=16,
+                         attn_kernel=True, ragged=True, token_budget=8),
+            prompts,
+        )
+        assert out == ref
+
+    def test_composition_rejections(self, tiny):
+        cfg, params = tiny
+        for kw in (
+            {"prompt_cache": True},
+            {"prefix_cache": True},
+            {"kv_bits": 8},
+        ):
+            with pytest.raises(ValueError, match="ragged"):
+                PagedBatcher(params, cfg, slots=2, num_blocks=16,
+                             block_size=8, prompt_bucket=16,
+                             attn_kernel=False, ragged=True, **kw)
+        with pytest.raises(ValueError, match="token_budget"):
+            PagedBatcher(params, cfg, slots=4, num_blocks=16, block_size=8,
+                         prompt_bucket=16, attn_kernel=False, ragged=True,
+                         token_budget=2)
+
+
+class TestContinuousRagged:
+    @pytest.mark.slow
+    def test_fused_admission_token_parity(self, tiny):
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        prompts = _prompts(cfg, 5)
+        legacy = _run(
+            ContinuousBatcher(params, cfg, gen=gen, slots=3, cache_len=64,
+                              prompt_bucket=16, attn_kernel=False),
+            prompts,
+        )
+        ragged = _run(
+            ContinuousBatcher(params, cfg, gen=gen, slots=3, cache_len=64,
+                              prompt_bucket=16, attn_kernel=False,
+                              admit_chunk=8, ragged=True),
+            prompts,
+        )
+        assert legacy == ragged
+        for prompt, toks in zip(prompts, ragged):
+            _assert_greedy_consistent(params, cfg, prompt, toks)
+
+    def test_requires_admit_chunk(self, tiny):
+        cfg, params = tiny
+        with pytest.raises(ValueError, match="admit_chunk"):
+            ContinuousBatcher(params, cfg, slots=2, cache_len=64,
+                              prompt_bucket=16, attn_kernel=False,
+                              ragged=True)
