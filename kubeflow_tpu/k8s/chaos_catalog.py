@@ -62,6 +62,13 @@ INJECTION_TYPES = (
     "checkpoint-kill-mid-save",
     "checkpoint-restore-corrupt",
     "checkpoint-disk-full",
+    # Fleet gateway coverage (models/gateway.py): a replica pod dies
+    # abruptly mid-stream. The error burst must be bounded to exactly the
+    # streams in flight on the dead replica (each terminated with a
+    # distinguishable error event, never silent truncation), the hash
+    # ring must heal within the probe interval, and post-heal traffic
+    # must succeed with zero further failures.
+    "gateway-replica-kill",
 )
 STEADY_STATE_CHECKS = (
     "sliceReady", "notCulled", "notebookCreatable", "warmPoolReady",
@@ -79,6 +86,9 @@ STEADY_STATE_CHECKS = (
     # Checkpoint: a restore + continued training reproduces the
     # uninterrupted run's loss curve exactly.
     "trainingResumed",
+    # Gateway: the dead replica left the ring, survivors serve, and the
+    # failed-stream count equals the in-flight burst — no silent loss.
+    "gatewayHealed",
 )
 # Injection ↔ target coherence: a doc must declare the kind its handler
 # actually exercises, or a "pass" certifies a hypothesis that never ran.
@@ -98,6 +108,7 @@ TARGET_KIND_FOR_INJECTION = {
     "checkpoint-kill-mid-save": "CheckpointManager",
     "checkpoint-restore-corrupt": "CheckpointManager",
     "checkpoint-disk-full": "CheckpointManager",
+    "gateway-replica-kill": "ServingGateway",
 }
 
 
@@ -237,6 +248,146 @@ class _SimulatedCrash(Exception):
     staging dir is left exactly as a dead process would leave it."""
 
 
+class _CrashableReplica:
+    """Minimal replica speaking the InferenceServer HTTP contract
+    (healthz / stats / streaming completions) with one extra affordance a
+    real server cannot offer in-process: ``crash()`` severs the listening
+    socket AND every accepted connection at once — what a SIGKILLed pod
+    looks like from the gateway's side of the wire. The gateway is the
+    system under test here; the engine behind the replica is not."""
+
+    def __init__(self, *, tokens: int = 40, token_delay_s: float = 0.05):
+        import socket as socket_mod
+        from http.server import (
+            BaseHTTPRequestHandler,
+            ThreadingHTTPServer,
+        )
+
+        self.tokens = tokens
+        self.token_delay_s = token_delay_s
+        self.lock = threading.Lock()
+        self.inflight = 0
+        self.served = 0
+        self.conns: set = set()
+        self._socket_mod = socket_mod
+        replica = self
+
+        class QuietServer(ThreadingHTTPServer):
+            daemon_threads = True
+
+            def handle_error(self, request, client_address):
+                pass  # crash() severs sockets mid-write by design
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._json(200, {"status": "ok"})
+                elif self.path == "/stats":
+                    with replica.lock:
+                        self._json(200, {
+                            "slots": 4,
+                            "active_slots": replica.inflight,
+                            "queued": 0,
+                            "served": replica.served,
+                        })
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                with replica.lock:
+                    replica.conns.add(self.connection)
+                    replica.inflight += 1
+                done = False
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    if req.get("stream"):
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/event-stream")
+                        self.send_header("Connection", "close")
+                        self.end_headers()
+                        for t in range(replica.tokens):
+                            time.sleep(replica.token_delay_s)
+                            self.wfile.write(
+                                b"data: "
+                                + json.dumps({"token": t}).encode()
+                                + b"\n\n"
+                            )
+                            self.wfile.flush()
+                        self.wfile.write(b"data: [DONE]\n\n")
+                        self.wfile.flush()
+                    else:
+                        self._json(200, {
+                            "id": "cmpl-0",
+                            "object": "text_completion",
+                            "choices": [{"index": 0, "tokens": [0, 1],
+                                         "finish_reason": "stop"}],
+                            "usage": {},
+                        })
+                    # Retire under the lock the moment [DONE] is on the
+                    # wire: a crash() racing this stream's completion
+                    # must not count it as severed.
+                    with replica.lock:
+                        replica.served += 1
+                        replica.inflight -= 1
+                        replica.conns.discard(self.connection)
+                        done = True
+                finally:
+                    if not done:
+                        with replica.lock:
+                            replica.inflight -= 1
+                            replica.conns.discard(self.connection)
+
+        self.httpd = QuietServer(("127.0.0.1", 0), Handler)
+        self.host, self.port = self.httpd.server_address[:2]
+        self.endpoint = f"{self.host}:{self.port}"
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self.crashed = False
+
+    def start(self) -> "_CrashableReplica":
+        self.thread.start()
+        return self
+
+    def crash(self) -> int:
+        """Abrupt death: returns the number of streams severed."""
+        with self.lock:
+            self.crashed = True
+            severed = list(self.conns)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        for sock in severed:
+            try:
+                sock.shutdown(self._socket_mod.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        return len(severed)
+
+    def stop(self) -> None:
+        if not self.crashed:
+            self.crash()
+
+
 def _serving_post(port: int, payload: dict, timeout: float = 60.0):
     """(status, body) for a completions POST — HTTPError is an outcome
     here (429/503/500 are the behaviors under test), not an exception."""
@@ -304,6 +455,7 @@ class ExperimentRunner:
             "checkpoint-kill-mid-save": self._run_checkpoint_kill_mid_save,
             "checkpoint-restore-corrupt": self._run_checkpoint_restore_corrupt,
             "checkpoint-disk-full": self._run_checkpoint_disk_full,
+            "gateway-replica-kill": self._run_gateway_replica_kill,
         }
 
     def run(self, doc: dict) -> ExperimentResult:
@@ -1021,6 +1173,124 @@ class ExperimentRunner:
                 **(extra_observations or {}),
             },
         )
+
+    def _run_gateway_replica_kill(self, doc: dict) -> ExperimentResult:
+        """A replica pod dies abruptly with streams in flight. The
+        gateway must (a) terminate exactly the severed streams with a
+        distinguishable error event — every stream still ends in [DONE],
+        silent truncation is the one outcome forbidden; (b) heal the
+        ring to the survivor within the recovery window; (c) serve
+        post-heal traffic with zero further failures."""
+        import http.client
+
+        from kubeflow_tpu.models.gateway import ServingGateway
+
+        params = doc["spec"]["injection"].get("params", {})
+        streams = int(params.get("streams", 3))
+        timeout = float(doc["spec"]["recoveryTimeoutSeconds"])
+        replicas = [_CrashableReplica().start() for _ in range(2)]
+        gw = ServingGateway(
+            [r.endpoint for r in replicas], port=0, block_size=4,
+            health_interval_s=0.1, reroute_budget=2,
+        ).start()
+        collected: list = [[] for _ in range(streams)]
+
+        def reader(i: int) -> None:
+            conn = http.client.HTTPConnection(gw.host, gw.port,
+                                              timeout=timeout)
+            try:
+                conn.request(
+                    "POST", "/v1/completions",
+                    json.dumps({"prompt": [10 * i + j for j in range(8)],
+                                "stream": True}).encode(),
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                while True:
+                    line = resp.fp.readline()
+                    if not line:
+                        break
+                    if line.startswith(b"data:"):
+                        collected[i].append(line)
+                    if line == b"data: [DONE]\n":
+                        break
+            finally:
+                conn.close()
+
+        try:
+            threads = [
+                threading.Thread(target=reader, args=(i,), daemon=True)
+                for i in range(streams)
+            ]
+            for t in threads:
+                t.start()
+            # Every stream must be past its first token before the kill,
+            # or there is nothing mid-stream to sever.
+            deadline = time.monotonic() + timeout
+            while (any(not lines for lines in collected)
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            victim = max(replicas, key=lambda r: r.inflight)
+            survivor = next(r for r in replicas if r is not victim)
+            burst = victim.crash()
+            for t in threads:
+                t.join(timeout=timeout)
+            # Bounded error burst, no silent truncation: every stream
+            # terminated with [DONE]; exactly the severed ones carry the
+            # mid-stream error event.
+            terminated = sum(
+                lines[-1] == b"data: [DONE]\n" for lines in collected
+            )
+            errored = sum(
+                any(b"replica lost mid-stream" in ln for ln in lines)
+                for lines in collected
+            )
+            # Ring heals to the survivor alone.
+            healed = False
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if gw.ring_nodes() == frozenset({survivor.endpoint}):
+                    healed = True
+                    break
+                time.sleep(0.02)
+            # Throughput recovers: post-heal traffic all succeeds and
+            # the failed count never grows past the burst.
+            failed_before = gw.stats()["failed"]
+            recovered = 0
+            for i in range(4):
+                code, _ = _serving_post(
+                    gw.port, {"prompt": [99, i], "stream": False},
+                    timeout=timeout,
+                )
+                recovered += code == 200
+            stats = gw.stats()
+            passed = (
+                burst >= 1
+                and terminated == streams
+                and errored == burst
+                and healed
+                and recovered == 4
+                and stats["failed"] == failed_before == burst
+            )
+            return ExperimentResult(
+                doc["metadata"]["name"],
+                passed=passed,
+                detail="" if passed else (
+                    f"burst={burst} terminated={terminated}/{streams} "
+                    f"errored={errored} healed={healed} "
+                    f"recovered={recovered}/4 failed={stats['failed']}"
+                ),
+                observations={
+                    "error_burst": burst,
+                    "errored_streams": errored,
+                    "reroutes": stats["reroutes"],
+                    "healed": healed,
+                },
+            )
+        finally:
+            gw.stop()
+            for r in replicas:
+                r.stop()
 
     def _run_checkpoint_kill_mid_save(self, doc: dict) -> ExperimentResult:
         """SIGKILL lands mid-save: the IO layer dies between file writes

@@ -177,6 +177,57 @@ class Metrics:
             "carrying real (decode or prefill-chunk) tokens",
             registry=self.registry,
         )
+        # -- prefix cache (models/paged.py PagedBatcher(prefix_cache=True))
+        # Mirrored from the engine's host-side counters by the
+        # InferenceServer drive loop; the gateway scrapes the same numbers
+        # from /stats for its routing report, so the fleet-level hit ratio
+        # and the per-replica Prometheus view can never disagree.
+        self.serving_prefix_cache_hits_total = Counter(
+            "tpu_serving_prefix_cache_hits_total",
+            "Prompt blocks admitted from the warm prefix-chain cache "
+            "(prefill skipped for these blocks)",
+            registry=self.registry,
+        )
+        self.serving_prefix_cache_misses_total = Counter(
+            "tpu_serving_prefix_cache_misses_total",
+            "Registrable prompt blocks that missed the prefix-chain cache "
+            "and were prefetched cold",
+            registry=self.registry,
+        )
+        self.serving_prefix_cache_evictions_total = Counter(
+            "tpu_serving_prefix_cache_evictions_total",
+            "Prefix-chain leaf blocks evicted to make room in the block "
+            "pool",
+            registry=self.registry,
+        )
+        self.serving_prefix_cached_blocks = Gauge(
+            "tpu_serving_prefix_cached_blocks",
+            "Blocks currently registered on warm prefix chains",
+            registry=self.registry,
+        )
+        # -- fleet gateway (models/gateway.py ServingGateway) --------------
+        self.gateway_requests_total = Counter(
+            "tpu_gateway_requests_total",
+            "Completion requests accepted and proxied to a replica",
+            registry=self.registry,
+        )
+        self.gateway_reroutes_total = Counter(
+            "tpu_gateway_reroutes_total",
+            "Requests re-routed to the next ring node after a "
+            "503/429/connect failure (bounded by the re-route budget)",
+            registry=self.registry,
+        )
+        self.gateway_shed_total = Counter(
+            "tpu_gateway_shed_total",
+            "Requests shed by the gateway's tenant-fair admission when "
+            "the whole fleet reported overload",
+            registry=self.registry,
+        )
+        self.gateway_replicas = Gauge(
+            "tpu_gateway_replicas",
+            "Replicas currently routable (present in the hash ring)",
+            registry=self.registry,
+        )
 
     def collect_running(self) -> None:
         """Recompute run-state gauges by listing StatefulSets, as the

@@ -1,0 +1,854 @@
+"""Fleet-scale serving gateway: prefix-affinity routing over N replicas.
+
+One engine per slice is the single-replica ceiling; this module is the
+front door over a FLEET of ``models/server.py`` InferenceServer replicas.
+The routing policy is the point: requests are placed by **consistent-hash
+prefix affinity** — the gateway walks the request's prompt through the
+same vLLM-style block chain hash the paged engine's prefix cache uses
+(``PagedBatcher._chain_key``), finds the longest chain prefix any earlier
+request shared, and hashes THAT key onto a virtual-node ring. Repeated
+system prompts therefore land on the replica whose block-pool prefix
+cache is already warm instead of re-prefilling cold on a random replica;
+``loadtest/serve_fleet.py`` measures the difference against the
+``random`` control arm on the same fleet.
+
+Integration with the existing stack, layer by layer:
+
+- **health/drain (PR-2 lifecycle):** a background probe loop GETs each
+  replica's ``/healthz``; ``draining`` (503 the instant a drain starts)
+  or an unreachable replica leaves the ring immediately — in-flight
+  streams on it finish (the replica's own drain budget protects them),
+  new work routes around it. A replica that comes back re-enters the
+  ring with minimal key movement (virtual nodes).
+- **bounded re-route:** a connect failure or a 503/429 answered BEFORE
+  any byte was relayed walks to the next distinct ring node, at most
+  ``reroute_budget`` alternates per request; the walk order is the ring
+  successor order, so a key's traffic stays maximally stable.
+- **tenant-fair load-shed:** when the whole fleet is at the gateway's
+  in-flight capacity, tenants above their fair share
+  (``ceil(capacity / active_tenants)``) are shed with 429 + Retry-After;
+  a tenant under its share is never shed by a noisy neighbor.
+- **streaming passthrough:** SSE bytes are relayed as they arrive; the
+  client's per-request ``deadline_s`` is decremented by gateway queueing
+  time before forwarding, and a client disconnect closes the upstream
+  connection so the replica's own ``_client_gone`` peek cancels the
+  request engine-side — cancellation is end-to-end.
+- **elastic capacity (controller/slicepool.py):** ``WarmSliceReplicaSource``
+  claims warm placeholder slices through the same ``claim_warm_slice``
+  path notebook spawns use (misses stamp the demand annotations the pool
+  autoscaler reads), so the fleet can follow load.
+
+The gateway itself never imports the jax stack — it is pure stdlib +
+numpy and can run on a CPU-only pod in front of TPU-backed replicas.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import http.client
+import itertools
+import json
+import math
+import threading
+import time
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from kubeflow_tpu.models.server import BodyTooLarge, _client_gone, _read_body
+
+AFFINITY_MODES = ("prefix", "random")
+
+
+def chain_key(parent: Optional[bytes], tokens) -> bytes:
+    """Content address of one full prompt block given its prefix chain —
+    byte-for-byte ``PagedBatcher._chain_key`` (tests assert the parity),
+    duplicated here so routing never imports the jax stack."""
+    h = hashlib.sha1(b"root" if parent is None else parent)
+    h.update(np.asarray(tokens, np.int32).tobytes())
+    return h.digest()
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    Each node owns ``vnodes`` pseudo-random positions; a key routes to
+    the first node position clockwise from its own hash. Join/leave
+    moves only the keys in the joining/leaving node's arcs (~1/N of the
+    space), which is the property the prefix cache needs: a replica
+    joining must not reshuffle every tenant's warm prefix to a cold
+    replica. ``seed`` perturbs every position so parallel fleets don't
+    co-shard the same hot prefixes. Not thread-safe — callers lock.
+    """
+
+    def __init__(self, vnodes: int = 64, seed: int = 0):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.vnodes = vnodes
+        self.seed = seed
+        self._members: set = set()
+        self._keys: list = []   # sorted vnode positions
+        self._owners: list = []  # node owning _keys[i]
+
+    def _pos(self, label) -> int:
+        if isinstance(label, str):
+            label = label.encode()
+        h = hashlib.sha1(b"%d|" % self.seed + label).digest()
+        return int.from_bytes(h[:8], "big")
+
+    def _rebuild(self) -> None:
+        pairs = sorted(
+            (self._pos(f"{node}#{i}".encode()), node)
+            for node in self._members
+            for i in range(self.vnodes)
+        )
+        self._keys = [p for p, _ in pairs]
+        self._owners = [n for _, n in pairs]
+
+    def add(self, node: str) -> None:
+        if node not in self._members:
+            self._members.add(node)
+            self._rebuild()
+
+    def remove(self, node: str) -> None:
+        if node in self._members:
+            self._members.discard(node)
+            self._rebuild()
+
+    def nodes(self) -> frozenset:
+        return frozenset(self._members)
+
+    def __len__(self) -> int:
+        return len(self._members)
+
+    def lookup(self, key: bytes) -> Optional[str]:
+        nodes = self.successors(key, 1)
+        return nodes[0] if nodes else None
+
+    def successors(self, key: bytes, limit: int) -> list:
+        """Up to ``limit`` DISTINCT nodes clockwise from the key's
+        position — the primary replica first, then the re-route walk."""
+        if not self._keys or limit < 1:
+            return []
+        idx = bisect.bisect_right(self._keys, self._pos(key))
+        out: list = []
+        seen: set = set()
+        for j in range(len(self._keys)):
+            node = self._owners[(idx + j) % len(self._keys)]
+            if node not in seen:
+                seen.add(node)
+                out.append(node)
+                if len(out) >= limit:
+                    break
+        return out
+
+
+class PrefixRouter:
+    """Longest-shared-prefix routing keys over the block chain hash.
+
+    Walks the prompt's full blocks through ``chain_key`` and returns the
+    deepest chain key some earlier request already produced — all
+    requests sharing that prefix compute the same key and co-locate on
+    one replica, exactly where the paged engine's prefix chain is warm.
+    A never-seen prefix routes by its FIRST block's key (deterministic,
+    so the tenant's very next request converges); prompts shorter than
+    one block hash whole. The seen-registry is a bounded LRU — stale
+    entries only cost one extra cold route after re-learning.
+    """
+
+    def __init__(self, block_size: int = 16, max_entries: int = 65536):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.block_size = block_size
+        self.max_entries = max_entries
+        self._seen: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+
+    def route_key(self, prompt: list) -> bytes:
+        bs = self.block_size
+        keys: list = []
+        parent: Optional[bytes] = None
+        for j in range(len(prompt) // bs):
+            parent = chain_key(parent, prompt[j * bs:(j + 1) * bs])
+            keys.append(parent)
+        if not keys:
+            keys = [chain_key(None, prompt)]
+        with self._lock:
+            best = keys[0]
+            for k in keys:
+                if k not in self._seen:
+                    # A chain's key is only ever registered together with
+                    # its whole parent chain, so the first miss ends the
+                    # longest shared prefix.
+                    break
+                best = k
+            for k in keys:
+                self._seen[k] = None
+                self._seen.move_to_end(k)
+            while len(self._seen) > self.max_entries:
+                self._seen.popitem(last=False)
+        return best
+
+
+def _parse_endpoint(endpoint: str) -> tuple:
+    """``host:port`` → (host, port), raising on garbage — a mistyped
+    replica list must not silently route into nothing."""
+    host, sep, port_s = endpoint.rpartition(":")
+    if not sep or not host:
+        raise ValueError(f"replica endpoint {endpoint!r}: want host:port")
+    try:
+        port = int(port_s)
+    except ValueError:
+        port = -1
+    if not 1 <= port <= 65535:
+        raise ValueError(f"replica endpoint {endpoint!r}: bad port")
+    return host, port
+
+
+class _Replica:
+    __slots__ = ("endpoint", "host", "port", "healthy", "draining", "stats")
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+        self.host, self.port = _parse_endpoint(endpoint)
+        self.healthy = True   # optimistic: routable until a probe says no
+        self.draining = False
+        self.stats: Optional[dict] = None  # last /stats scrape (subset)
+
+
+class GatewayOverloadedError(RuntimeError):
+    """The fleet is at capacity and this tenant is over its fair share."""
+
+
+class ServingGateway:
+    """HTTP gateway fronting N InferenceServer replicas (see module doc).
+
+    >>> gw = ServingGateway(["127.0.0.1:8001", "127.0.0.1:8002"], port=0)
+    >>> gw.start()
+    >>> # POST http://{gw.host}:{gw.port}/v1/completions  (same API shape)
+    >>> gw.stop()
+    """
+
+    def __init__(self, replicas=(), host: str = "127.0.0.1", port: int = 0,
+                 affinity: str = "prefix", block_size: int = 16,
+                 vnodes: int = 64, hash_seed: int = 0,
+                 reroute_budget: int = 2,
+                 health_interval_s: float = 0.5,
+                 health_timeout_s: float = 2.0,
+                 upstream_timeout_s: float = 120.0,
+                 max_inflight: Optional[int] = None,
+                 max_body_bytes: int = 4 << 20,
+                 metrics=None, replica_source=None):
+        if affinity not in AFFINITY_MODES:
+            raise ValueError(
+                f"affinity must be one of {AFFINITY_MODES}, got {affinity!r}"
+            )
+        if reroute_budget < 0:
+            raise ValueError(
+                f"reroute_budget must be >= 0, got {reroute_budget}"
+            )
+        self.affinity = affinity
+        self.reroute_budget = reroute_budget
+        self.health_interval_s = health_interval_s
+        self.health_timeout_s = health_timeout_s
+        self.upstream_timeout_s = upstream_timeout_s
+        self.max_inflight = max_inflight
+        self.max_body_bytes = max_body_bytes
+        self.metrics = metrics
+        self.replica_source = replica_source
+        self._lock = threading.Lock()
+        self._ring = HashRing(vnodes=vnodes, seed=hash_seed)
+        self._router = PrefixRouter(block_size=block_size)
+        self._spread = itertools.count()  # "random" arm: uniform, RNG-free
+        self._replicas: dict = {}
+        # Tenant-fair admission state + the routing-report counters.
+        self._inflight: dict = {}
+        self._total_inflight = 0
+        self._requests = 0
+        self._reroutes = 0
+        self._shed = 0
+        self._failed = 0          # exhausted budget / mid-stream loss
+        self._stopped = False
+        self._started = False
+        self._stop_evt = threading.Event()
+        self._httpd = ThreadingHTTPServer((host, port), self._handler_class())
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="gateway-http", daemon=True
+        )
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="gateway-health", daemon=True
+        )
+        for ep in replicas:
+            self.add_replica(ep)
+
+    # -- fleet membership --------------------------------------------------
+
+    def add_replica(self, endpoint: str) -> None:
+        """Register a replica and route to it immediately (optimistic —
+        the next probe pass demotes it if it is not actually healthy).
+        Idempotent; loadtests and the chaos harness call this mid-run."""
+        rep = _Replica(endpoint)
+        with self._lock:
+            if endpoint not in self._replicas:
+                self._replicas[endpoint] = rep
+                self._ring.add(endpoint)
+            self._mirror_ring_locked()
+
+    def remove_replica(self, endpoint: str) -> None:
+        with self._lock:
+            self._replicas.pop(endpoint, None)
+            self._ring.remove(endpoint)
+            self._mirror_ring_locked()
+
+    def replica_endpoints(self) -> list:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def ring_nodes(self) -> frozenset:
+        with self._lock:
+            return self._ring.nodes()
+
+    def scale_up(self, now: Optional[float] = None) -> Optional[str]:
+        """One more slice from the warm pool via the replica source
+        (None without one). Returns the pool name the claim came from;
+        the caller registers the endpoint with ``add_replica`` once the
+        replica's InferenceServer reports healthy."""
+        if self.replica_source is None:
+            return None
+        return self.replica_source.acquire(now=now)
+
+    def _mirror_ring_locked(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gateway_replicas.set(len(self._ring))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ServingGateway":
+        self._started = True
+        self._http_thread.start()
+        self._health_thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        self._stop_evt.set()
+        if self._started:
+            # shutdown() handshakes with serve_forever; on a never-
+            # started gateway it would wait forever for a loop that
+            # never ran, so only the socket is closed in that case.
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._started:
+            self._health_thread.join(timeout=10)
+
+    # -- health / scrape loop ----------------------------------------------
+
+    def _health_loop(self) -> None:
+        while not self._stop_evt.wait(self.health_interval_s):
+            self.probe_once()
+
+    def probe_once(self) -> None:
+        """One probe pass over every registered replica (public so tests
+        and the chaos harness can force a pass instead of sleeping):
+        healthz 200 → in the ring; draining/unreachable → out. In-ring
+        replicas also get a /stats scrape for the routing report."""
+        for rep in list(self._replicas.values()):
+            state = self._probe(rep)
+            with self._lock:
+                if rep.endpoint not in self._replicas:
+                    continue  # removed while we probed
+                rep.healthy = state == "ok"
+                rep.draining = state == "draining"
+                in_ring = rep.endpoint in self._ring.nodes()
+                if rep.healthy and not in_ring:
+                    self._ring.add(rep.endpoint)
+                elif not rep.healthy and in_ring:
+                    self._ring.remove(rep.endpoint)
+                self._mirror_ring_locked()
+            if rep.healthy:
+                rep.stats = self._scrape_stats(rep)
+
+    def _probe(self, rep: _Replica) -> str:
+        try:
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=self.health_timeout_s
+            )
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                body = resp.read()
+            finally:
+                conn.close()
+        except OSError:
+            return "down"
+        if resp.status == 200:
+            return "ok"
+        try:
+            status = json.loads(body).get("status", "")
+        except (ValueError, AttributeError):
+            status = ""
+        return "draining" if status == "draining" else "down"
+
+    def _scrape_stats(self, rep: _Replica) -> Optional[dict]:
+        try:
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=self.health_timeout_s
+            )
+            try:
+                conn.request("GET", "/stats")
+                resp = conn.getresponse()
+                body = resp.read()
+            finally:
+                conn.close()
+            stats = json.loads(body)
+        except (OSError, ValueError):
+            return rep.stats  # keep the last good scrape
+        keep = {k: stats.get(k) for k in
+                ("active_slots", "queued", "slots", "served")}
+        if "prefix_cache" in stats:
+            keep["prefix_cache"] = stats["prefix_cache"]
+        return keep
+
+    # -- admission (tenant-fair shed) --------------------------------------
+
+    def _capacity_locked(self) -> int:
+        if self.max_inflight is not None:
+            return self.max_inflight
+        # Heuristic fleet capacity: slots + a queue's worth per routable
+        # replica, from the last scrape (default 16 when unscraped yet).
+        cap = 0
+        for ep in self._ring.nodes():
+            rep = self._replicas.get(ep)
+            slots = (rep.stats or {}).get("slots") if rep else None
+            cap += 2 * int(slots) if slots else 16
+        return max(cap, 1)
+
+    def _admit(self, tenant: str) -> None:
+        with self._lock:
+            cap = self._capacity_locked()
+            if self._total_inflight >= cap:
+                active = len(self._inflight) + (
+                    0 if tenant in self._inflight else 1
+                )
+                share = math.ceil(cap / max(active, 1))
+                if self._inflight.get(tenant, 0) >= share:
+                    # Over fair share while the fleet is saturated: shed.
+                    # A tenant *under* its share is still admitted (the
+                    # overshoot is bounded by one share per tenant), so a
+                    # noisy neighbor can never starve a light one.
+                    self._shed += 1
+                    if self.metrics is not None:
+                        self.metrics.gateway_shed_total.inc()
+                    raise GatewayOverloadedError(
+                        f"fleet at capacity ({cap} in flight); tenant "
+                        f"{tenant!r} is over its fair share ({share})"
+                    )
+            self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+            self._total_inflight += 1
+
+    def _release(self, tenant: str) -> None:
+        with self._lock:
+            left = self._inflight.get(tenant, 0) - 1
+            if left > 0:
+                self._inflight[tenant] = left
+            else:
+                self._inflight.pop(tenant, None)
+            self._total_inflight = max(0, self._total_inflight - 1)
+
+    # -- routing -----------------------------------------------------------
+
+    def _route_key(self, prompt) -> bytes:
+        if self.affinity == "random":
+            # Counter-hashed: uniform spread with zero RNG state, and the
+            # ring seed still decorrelates parallel fleets.
+            return next(self._spread).to_bytes(8, "big")
+        if isinstance(prompt, list) and all(
+            isinstance(t, int) and not isinstance(t, bool) for t in prompt
+        ):
+            return self._router.route_key(prompt)
+        # Text prompts (tokenizer lives replica-side): whole-string
+        # affinity — identical notebooks still co-locate.
+        return hashlib.sha1(repr(prompt).encode()).digest()
+
+    def _candidates(self, key: bytes) -> list:
+        with self._lock:
+            return self._ring.successors(key, self.reroute_budget + 1)
+
+    def _count_reroute(self) -> None:
+        with self._lock:
+            self._reroutes += 1
+        if self.metrics is not None:
+            self.metrics.gateway_reroutes_total.inc()
+
+    def _count_request(self) -> None:
+        with self._lock:
+            self._requests += 1
+        if self.metrics is not None:
+            self.metrics.gateway_requests_total.inc()
+
+    def _count_failed(self) -> None:
+        with self._lock:
+            self._failed += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            replicas = {
+                ep: {
+                    "in_ring": ep in self._ring.nodes(),
+                    "healthy": rep.healthy,
+                    "draining": rep.draining,
+                    **({"stats": rep.stats} if rep.stats else {}),
+                }
+                for ep, rep in sorted(self._replicas.items())
+            }
+            hits = misses = 0
+            for rep in self._replicas.values():
+                pc = (rep.stats or {}).get("prefix_cache") or {}
+                hits += pc.get("hits", 0)
+                misses += pc.get("misses", 0)
+            return {
+                "affinity": self.affinity,
+                "ring_size": len(self._ring),
+                "replicas": replicas,
+                "requests": self._requests,
+                "reroutes": self._reroutes,
+                "shed": self._shed,
+                "failed": self._failed,
+                "inflight": dict(self._inflight),
+                # The fleet-level prefix-cache view, aggregated from the
+                # per-replica /stats scrapes (satellite: the gateway's
+                # routing report).
+                "fleet_prefix_cache": {
+                    "hits": hits,
+                    "misses": misses,
+                    "hit_ratio": round(hits / (hits + misses), 4)
+                    if hits + misses else 0.0,
+                },
+            }
+
+    # -- HTTP --------------------------------------------------------------
+
+    def _handler_class(self):
+        gw = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code: int, payload: dict,
+                      retry_after: Optional[int] = None) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(retry_after))
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except OSError:
+                    pass  # client gone; nothing to tell it
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    n = len(gw.ring_nodes())
+                    if n > 0:
+                        self._json(200, {"status": "ok", "replicas": n})
+                    else:
+                        self._json(503, {"status": "no healthy replicas"})
+                elif self.path == "/stats":
+                    self._json(200, gw.stats())
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/v1/completions":
+                    self._json(404, {"error": "not found"})
+                    return
+                arrival = time.monotonic()
+                try:
+                    body = _read_body(self, gw.max_body_bytes)
+                except BodyTooLarge as err:
+                    self._json(413, {"error": str(err)})
+                    return
+                except ValueError as err:
+                    self._json(400, {"error": str(err)})
+                    return
+                try:
+                    req = json.loads(body or b"{}")
+                    if not isinstance(req, dict):
+                        raise ValueError("request body must be an object")
+                except ValueError as err:
+                    self._json(400, {"error": str(err)})
+                    return
+                tenant = str(
+                    self.headers.get("x-tenant")
+                    or req.get("user") or "anonymous"
+                )
+                try:
+                    gw._admit(tenant)
+                except GatewayOverloadedError as err:
+                    self._json(429, {"error": str(err)}, retry_after=1)
+                    return
+                try:
+                    self._route(req, arrival)
+                finally:
+                    gw._release(tenant)
+
+            def _route(self, req: dict, arrival: float) -> None:
+                key = gw._route_key(req.get("prompt"))
+                candidates = gw._candidates(key)
+                if not candidates:
+                    self._json(503, {"error": "no healthy replicas"},
+                               retry_after=1)
+                    return
+                gw._count_request()
+                deadline_s = req.get("deadline_s")
+                stream = bool(req.get("stream", False))
+                last = None
+                for i, endpoint in enumerate(candidates):
+                    if i:
+                        gw._count_reroute()
+                    fwd = dict(req)
+                    if isinstance(deadline_s, (int, float)) and not \
+                            isinstance(deadline_s, bool):
+                        # The client's budget covers the WHOLE request:
+                        # forward only what gateway time left of it.
+                        remaining = deadline_s - (time.monotonic() - arrival)
+                        if remaining <= 0:
+                            self._json(504, {
+                                "error": "deadline expired at the gateway",
+                                "partial_tokens": [],
+                            })
+                            return
+                        fwd["deadline_s"] = remaining
+                    outcome, last = self._proxy(endpoint, fwd, stream)
+                    if outcome == "done":
+                        return
+                # Budget exhausted: every candidate refused or was down.
+                gw._count_failed()
+                code, detail = last if last else (503, "replicas unreachable")
+                self._json(code if code in (429, 503) else 503,
+                           {"error": f"fleet exhausted re-route budget "
+                                     f"({gw.reroute_budget}): {detail}"},
+                           retry_after=1)
+
+            def _proxy(self, endpoint: str, req: dict, stream: bool):
+                """One attempt against one replica. Returns
+                ("done", None) when a response (or a terminal error) was
+                relayed, ("retry", (code, detail)) when the replica
+                refused before any byte reached the client."""
+                rep = gw._replicas.get(endpoint)
+                if rep is None:
+                    return "retry", (503, f"{endpoint} left the fleet")
+                deadline_s = req.get("deadline_s")
+                timeout = gw.upstream_timeout_s
+                if isinstance(deadline_s, (int, float)):
+                    timeout = min(timeout, float(deadline_s) + 5.0)
+                try:
+                    conn = http.client.HTTPConnection(
+                        rep.host, rep.port, timeout=timeout
+                    )
+                    conn.request(
+                        "POST", "/v1/completions",
+                        json.dumps(req).encode(),
+                        {"Content-Type": "application/json"},
+                    )
+                    resp = conn.getresponse()
+                except OSError:
+                    return "retry", (503, f"{endpoint} unreachable")
+                if resp.status in (429, 503):
+                    # Replica-side shed/drain answered before we relayed
+                    # anything: eligible for the bounded re-route walk.
+                    try:
+                        detail = json.loads(resp.read()).get(
+                            "error", "refused")
+                    except (OSError, ValueError):
+                        detail = "refused"
+                    conn.close()
+                    return "retry", (resp.status, f"{endpoint}: {detail}")
+                ctype = resp.getheader("Content-Type", "")
+                try:
+                    if not stream or "text/event-stream" not in ctype:
+                        # Errors (400/504/...) answer JSON even for
+                        # stream requests — relay them as JSON too.
+                        body = resp.read()
+                        conn.close()
+                        self._json(resp.status, json.loads(body))
+                        return "done", None
+                    return self._relay_stream(conn, resp)
+                except (OSError, ValueError):
+                    # Replica died mid-body before ANY byte was relayed
+                    # client-side (non-stream read) — safe to re-route;
+                    # generation is idempotent.
+                    conn.close()
+                    if not stream:
+                        return "retry", (503, f"{endpoint} died mid-read")
+                    return "done", None
+
+            def _relay_stream(self, conn, resp):
+                """SSE passthrough: relay lines as they arrive, peek for
+                the client's FIN before each write (closing the upstream
+                connection is the cancellation signal the replica's own
+                _client_gone converts into an engine-side cancel)."""
+                started = False
+                finished = False
+                try:
+                    while True:
+                        line = resp.fp.readline()
+                        if not line:
+                            break
+                        if _client_gone(self.connection):
+                            conn.close()  # upstream FIN → replica cancels
+                            return "done", None
+                        if not started:
+                            self.send_response(resp.status)
+                            self.send_header("Content-Type",
+                                             "text/event-stream")
+                            self.send_header("Cache-Control", "no-cache")
+                            self.send_header("Connection", "close")
+                            self.end_headers()
+                            started = True
+                        self.wfile.write(line)
+                        if line == b"data: [DONE]\n":
+                            finished = True
+                        if line == b"\n":
+                            self.wfile.flush()
+                    conn.close()
+                    if not started:
+                        # EOF before the first event: nothing reached the
+                        # client, so the re-route walk may continue.
+                        return "retry", (503, "empty replica response")
+                    if not finished:
+                        # A killed replica's socket often closes with a
+                        # clean FIN, not a reset: EOF after bytes flowed
+                        # but before [DONE] is the same mid-stream loss.
+                        return self._stream_lost()
+                    return "done", None
+                except (BrokenPipeError, ConnectionResetError):
+                    conn.close()  # client hung up; cancel upstream
+                    return "done", None
+                except OSError:
+                    conn.close()
+                    if started:
+                        return self._stream_lost()
+                    # Nothing reached the client: the re-route walk may
+                    # continue (budget exhaustion counts the failure).
+                    return "retry", (503, "replica died before first byte")
+
+            def _stream_lost(self):
+                """UPSTREAM loss mid-stream: bytes already reached the
+                client, so a re-route would splice two generations —
+                terminate the stream distinguishably instead."""
+                gw._count_failed()
+                try:
+                    self.wfile.write(
+                        b'data: {"error": "replica lost '
+                        b'mid-stream"}\n\ndata: [DONE]\n\n'
+                    )
+                    self.wfile.flush()
+                except OSError:
+                    pass
+                return "done", None
+
+        return Handler
+
+
+class WarmSliceReplicaSource:
+    """Elastic replica capacity through ``controller/slicepool.py``.
+
+    ``acquire`` claims one warm all-Ready placeholder slice via the SAME
+    ``claim_warm_slice`` path notebook spawns use: a hit deletes the
+    placeholder StatefulSet (releasing its chips for the replica's pods)
+    and stamps LAST_CLAIM on the owning pool; a miss stamps the
+    LAST_MISS/MISS_COUNT demand annotations every matching autoscaled
+    pool reads — so a gateway scaling up under load is itself the demand
+    signal that grows the pool. The replica's lifecycle closes the loop
+    the other way: draining flips its healthz, the gateway drops it from
+    the ring, and the slice returns to the pool.
+    """
+
+    def __init__(self, client, namespace: str, topo,
+                 recorder=None, notebook=None):
+        self.client = client
+        self.namespace = namespace
+        self.topo = topo
+        self.recorder = recorder
+        self.notebook = notebook
+
+    def acquire(self, now: Optional[float] = None,
+                pools: Optional[list] = None) -> Optional[str]:
+        from kubeflow_tpu.controller.slicepool import claim_warm_slice
+
+        return claim_warm_slice(
+            self.client, self.namespace, self.topo,
+            recorder=self.recorder, notebook=self.notebook,
+            now=now if now is not None else time.time(), pools=pools,
+        )
+
+
+def gateway_from_env(metrics=None, replica_source=None) -> ServingGateway:
+    """Build an (unstarted) gateway from the KUBEFLOW_TPU_GATEWAY_* env
+    contract (webhook/tpu_env.py ENV_CONTRACT). Raises on garbage — a
+    hand-set env var must not silently fall back to defaults."""
+    import os
+
+    from kubeflow_tpu.webhook.tpu_env import (
+        KUBEFLOW_TPU_GATEWAY_AFFINITY,
+        KUBEFLOW_TPU_GATEWAY_HASH_SEED,
+        KUBEFLOW_TPU_GATEWAY_PORT,
+        KUBEFLOW_TPU_GATEWAY_REPLICAS,
+        KUBEFLOW_TPU_GATEWAY_REROUTE_BUDGET,
+    )
+
+    def _int(name: str, default: int, minimum: int) -> int:
+        raw = os.environ.get(name, "").strip()
+        if not raw:
+            return default
+        try:
+            value = int(raw)
+        except ValueError:
+            value = minimum - 1
+        if value < minimum:
+            raise ValueError(
+                f"{name}={raw!r}: want an integer >= {minimum}"
+            )
+        return value
+
+    port = _int(KUBEFLOW_TPU_GATEWAY_PORT, 8080, 0)
+    if port > 65535:
+        raise ValueError(f"{KUBEFLOW_TPU_GATEWAY_PORT}={port}: want <= 65535")
+    raw_replicas = os.environ.get(KUBEFLOW_TPU_GATEWAY_REPLICAS, "").strip()
+    replicas = [r.strip() for r in raw_replicas.split(",") if r.strip()]
+    for r in replicas:
+        _parse_endpoint(r)  # fail loudly before serving into nothing
+    affinity = os.environ.get(
+        KUBEFLOW_TPU_GATEWAY_AFFINITY, "").strip().lower() or "prefix"
+    if affinity not in AFFINITY_MODES:
+        raise ValueError(
+            f"{KUBEFLOW_TPU_GATEWAY_AFFINITY}={affinity!r}: want one of "
+            f"{AFFINITY_MODES}"
+        )
+    raw_seed = os.environ.get(KUBEFLOW_TPU_GATEWAY_HASH_SEED, "").strip()
+    try:
+        seed = int(raw_seed) if raw_seed else 0
+    except ValueError:
+        raise ValueError(
+            f"{KUBEFLOW_TPU_GATEWAY_HASH_SEED}={raw_seed!r}: want an integer"
+        )
+    budget = _int(KUBEFLOW_TPU_GATEWAY_REROUTE_BUDGET, 2, 0)
+    return ServingGateway(
+        replicas=replicas, port=port, affinity=affinity, hash_seed=seed,
+        reroute_budget=budget, metrics=metrics,
+        replica_source=replica_source,
+    )

@@ -655,6 +655,15 @@ class PagedBatcher(_BatcherBase):
         # be matched again).
         self._prefix_cache_enabled = prefix_cache
         self._prefix_entries: dict = {}  # chain hash -> block/parent/children
+        # Prefix-cache observability (host-side, O(1) per admission):
+        # hits/misses count REGISTRABLE prompt blocks at successful
+        # admission (a hit is a block whose prefill was skipped), so
+        # hits/(hits+misses) is exactly the fraction of prefill compute
+        # the cache saved. Mirrored into tpu_serving_prefix_cache_* by
+        # the InferenceServer and scraped by the fleet gateway.
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_evictions = 0
         self.admit_chunk = admit_chunk
         self._init_base(self.gen, slots, prompt_bucket)
 
@@ -711,8 +720,14 @@ class PagedBatcher(_BatcherBase):
                 self._free.append(ent["block"])
                 if ent["parent"] is not None:
                     self._prefix_entries[ent["parent"]]["children"] -= 1
+                self.prefix_evictions += 1
                 return True
         return False
+
+    @property
+    def prefix_cached_blocks(self) -> int:
+        """Blocks currently registered on warm prefix chains."""
+        return len(self._prefix_entries)
 
     @staticmethod
     def _chain_key(parent: Optional[bytes], tokens) -> bytes:
@@ -1070,6 +1085,10 @@ class PagedBatcher(_BatcherBase):
             else:
                 continue  # queue drained for this slot
             req = self._queue.pop(0)
+            # Counted only once allocation committed: a pool-stall retry
+            # re-walks the chain and must not double-count its blocks.
+            self.prefix_hits += m
+            self.prefix_misses += registrable - m
             generated = list(req.tokens)
             all_blocks = shared_blocks + blocks
             self.tables[slot] = 0  # stale entries never alias freed blocks

@@ -305,6 +305,9 @@ class InferenceServer:
         self._e2e = collections.deque(maxlen=256)
         self._tokens_out = 0
         self._started_at = None  # stamped in start(): uptime = serving time
+        # Prometheus Counters only inc(): mirror the engine's monotonic
+        # prefix-cache tallies by delta, last-mirrored snapshot here.
+        self._prefix_mirrored = (0, 0, 0)
         self._httpd = ThreadingHTTPServer((host, port), self._handler_class())
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
@@ -378,6 +381,22 @@ class InferenceServer:
                             and getattr(self.engine, "ragged", False)):
                         self.metrics.serving_ragged_batch_fill.set(
                             self.engine.ragged_fill
+                        )
+                    if (self.metrics is not None and getattr(
+                            self.engine, "_prefix_cache_enabled", False)):
+                        h = self.engine.prefix_hits
+                        ms = self.engine.prefix_misses
+                        ev = self.engine.prefix_evictions
+                        ph, pm, pe = self._prefix_mirrored
+                        self.metrics.serving_prefix_cache_hits_total.inc(
+                            h - ph)
+                        self.metrics.serving_prefix_cache_misses_total.inc(
+                            ms - pm)
+                        self.metrics.serving_prefix_cache_evictions_total \
+                            .inc(ev - pe)
+                        self._prefix_mirrored = (h, ms, ev)
+                        self.metrics.serving_prefix_cached_blocks.set(
+                            self.engine.prefix_cached_blocks
                         )
                 except Exception as err:  # device OOM, preemption, ...
                     # The engine is in an unknown state: fail loudly —
@@ -679,6 +698,21 @@ class InferenceServer:
                             getattr(server.engine, "_admitting", None)
                             is not None
                         ) + len(getattr(server.engine, "_ragged_admit", {}))
+                        pc = None
+                        if getattr(server.engine, "_prefix_cache_enabled",
+                                   False):
+                            hits = server.engine.prefix_hits
+                            misses = server.engine.prefix_misses
+                            pc = {
+                                "hits": hits,
+                                "misses": misses,
+                                "evictions": server.engine.prefix_evictions,
+                                "cached_blocks":
+                                    server.engine.prefix_cached_blocks,
+                                "hit_ratio": round(
+                                    hits / (hits + misses), 4
+                                ) if hits + misses else 0.0,
+                            }
                         rag = None
                         if getattr(server.engine, "ragged", False):
                             steps = server.engine.ragged_steps
@@ -726,6 +760,7 @@ class InferenceServer:
                         "draining": server._draining,
                         "drain_duration_s": server._drain_duration,
                         **({"ragged": rag} if rag is not None else {}),
+                        **({"prefix_cache": pc} if pc is not None else {}),
                     })
                 else:
                     self._json(404, {"error": "not found"})

@@ -54,6 +54,17 @@ MEGASCALE_COORDINATOR_ADDRESS = "MEGASCALE_COORDINATOR_ADDRESS"
 # mixed prefill/decode batching and its per-step token budget.
 KUBEFLOW_TPU_SERVING_RAGGED = "KUBEFLOW_TPU_SERVING_RAGGED"
 KUBEFLOW_TPU_RAGGED_TOKEN_BUDGET = "KUBEFLOW_TPU_RAGGED_TOKEN_BUDGET"
+# Fleet serving gateway (models/gateway.py gateway_from_env): the HTTP
+# front door over N InferenceServer replicas with consistent-hash
+# prefix-affinity routing.
+KUBEFLOW_TPU_GATEWAY_PORT = "KUBEFLOW_TPU_GATEWAY_PORT"
+KUBEFLOW_TPU_GATEWAY_REPLICAS = "KUBEFLOW_TPU_GATEWAY_REPLICAS"
+KUBEFLOW_TPU_GATEWAY_AFFINITY = "KUBEFLOW_TPU_GATEWAY_AFFINITY"
+KUBEFLOW_TPU_GATEWAY_HASH_SEED = "KUBEFLOW_TPU_GATEWAY_HASH_SEED"
+KUBEFLOW_TPU_GATEWAY_REROUTE_BUDGET = "KUBEFLOW_TPU_GATEWAY_REROUTE_BUDGET"
+# Persistent JAX compilation cache (bench.py capture windows; any runtime
+# entrypoint may opt in): compiled executables survive process restarts.
+KUBEFLOW_TPU_COMPILE_CACHE_DIR = "KUBEFLOW_TPU_COMPILE_CACHE_DIR"
 
 # name -> who produces it and from what. Annotation-projected env names are
 # defined next to their annotations in kubeflow_tpu/api/annotations.py and
@@ -86,6 +97,26 @@ ENV_CONTRACT: dict = {
     KUBEFLOW_TPU_RAGGED_TOKEN_BUDGET: "operator-set on the notebook "
     "container: per-step ragged token budget (default 512; must be >= "
     "the engine's slot count)",
+    KUBEFLOW_TPU_GATEWAY_PORT: "operator-set on the gateway container: "
+    "listen port for models/gateway.py (default 8080; 0 = ephemeral)",
+    KUBEFLOW_TPU_GATEWAY_REPLICAS: "operator-set on the gateway "
+    "container: comma-separated host:port InferenceServer replica "
+    "endpoints the gateway fronts at startup (the ring also follows "
+    "live add/remove and healthz state)",
+    KUBEFLOW_TPU_GATEWAY_AFFINITY: "operator-set on the gateway "
+    "container: routing mode, 'prefix' (consistent-hash on the longest "
+    "shared prompt prefix; default) or 'random' (uniform spread — the "
+    "control arm loadtest/serve_fleet.py measures against)",
+    KUBEFLOW_TPU_GATEWAY_HASH_SEED: "operator-set on the gateway "
+    "container: integer seed mixed into the ring's vnode positions so "
+    "parallel fleets don't co-shard hot prefixes (default 0)",
+    KUBEFLOW_TPU_GATEWAY_REROUTE_BUDGET: "operator-set on the gateway "
+    "container: max alternate ring nodes tried after a 503/429/connect "
+    "failure before the gateway gives up (default 2)",
+    KUBEFLOW_TPU_COMPILE_CACHE_DIR: "operator-set (bench watcher env or "
+    "notebook container): directory for JAX's persistent compilation "
+    "cache; bench.py enables it at startup and stamps the dir into "
+    "record provenance so warm-cache captures are distinguishable",
     ann.QUANT_ENV_NAME: "webhook: tpu-quantization annotation",
     ann.PROFILING_ENV_NAME: "webhook: tpu-profiling-port annotation",
     ann.SERVING_ENV_NAME: "webhook: tpu-serving-port annotation",

@@ -50,6 +50,9 @@ def test_catalog_has_reference_parity_experiments():
         "checkpoint-kill-mid-save",
         "checkpoint-restore-corrupt",
         "checkpoint-disk-full",
+        # Fleet gateway (models/gateway.py): replica death mid-stream —
+        # bounded error burst, ring heals, throughput recovers.
+        "gateway-replica-kill",
     }
 
 
