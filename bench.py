@@ -287,6 +287,30 @@ def _compile_cache_setup() -> str | None:
     return cache_dir
 
 
+def _trace_summary():
+    """Span-count + p95 engine-step span duration from the installed
+    trace ring (``KUBEFLOW_TPU_TRACE_*`` on), or None when tracing is
+    off. Stamped into emitted records so a benchmark artifact carries
+    the per-step span view that explains its own numbers."""
+    from kubeflow_tpu.observability import tracing
+
+    ring = tracing.trace_ring()
+    if ring is None:
+        return None
+    spans = ring.snapshot()
+    steps = sorted(
+        s["duration_ms"] for s in spans if s["name"] == "engine.step"
+    )
+    return {
+        "spans": len(spans),
+        "engine_step_spans": len(steps),
+        "p95_step_span_ms": (
+            steps[min(len(steps) - 1, int(0.95 * len(steps)))]
+            if steps else 0.0
+        ),
+    }
+
+
 def _stamp_provenance(entries: list, provenance: str = "live") -> list:
     """Every record written to a BENCH_*.json carries an explicit
     ``provenance: live|cached`` field. setdefault, not overwrite: entries
@@ -294,11 +318,14 @@ def _stamp_provenance(entries: list, provenance: str = "live") -> list:
     carried forward from a previous artifact keep whatever that capture
     recorded about itself. When the persistent compilation cache is on,
     records additionally carry the cache dir — a warmed measurement is
-    self-describing too."""
+    self-describing too, and a traced run stamps its span summary."""
+    trace = _trace_summary()
     for e in entries:
         e.setdefault("provenance", provenance)
         if _COMPILE_CACHE_DIR is not None:
             e.setdefault("compile_cache", _COMPILE_CACHE_DIR)
+        if trace is not None:
+            e.setdefault("trace_summary", trace)
     return entries
 
 
@@ -1234,6 +1261,10 @@ def _run_mixed_main(device, quant_bits: int, smoke: bool,
             "unit": "ratio",
             "provenance": prov,
         }
+        trace = _trace_summary()
+        if trace is not None:
+            entry["trace_summary"] = trace
+            fill_entry["trace_summary"] = trace
         print(json.dumps(entry))
         print(f"# {fill_entry['metric']}: {fill:.4f}", file=sys.stderr)
         if artifact is not None and not smoke:
@@ -1282,6 +1313,13 @@ def main() -> int:
             artifact_requested = True
 
     import os
+
+    # Tracing is opt-in via the KUBEFLOW_TPU_TRACE_* contract vars: when
+    # set, engine steps are spanned and every emitted record carries a
+    # trace_summary stamp (_stamp_provenance).
+    from kubeflow_tpu.observability import tracing
+
+    tracing.configure_from_env()
 
     smoke = _smoke_enabled()
     if smoke and artifact_requested:
@@ -1392,6 +1430,9 @@ def main() -> int:
                 **({"compile_cache": _COMPILE_CACHE_DIR}
                    if _COMPILE_CACHE_DIR else {}),
             }
+            trace = _trace_summary()
+            if trace is not None:
+                headline["trace_summary"] = trace
             print(json.dumps(headline))
             if full:
                 results = [headline]

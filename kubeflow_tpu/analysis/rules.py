@@ -571,6 +571,215 @@ class MetricNameScheme(Rule):
         return findings
 
 
+class SpanUnended(Rule):
+    id = "span-unended"
+    description = (
+        "A start_span() call whose span cannot be shown to end on every "
+        "path: use it as a context manager (`with ...start_span(...)`), "
+        "or assign it to a name a `finally` block .end()s. An exception "
+        "between start and a bare .end() leaks the span AND leaves it "
+        "installed as the thread's current span, so every later span on "
+        "that thread parents under a request that already finished. "
+        "begin_span (the cross-thread handoff form) is exempt — its "
+        "spans end in another thread's callback by design."
+    )
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        findings = []
+        for node in mod.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            if not (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "start_span"
+            ):
+                continue
+            parent = mod.parents.get(node)
+            if isinstance(parent, ast.withitem):
+                continue
+            if self._ended_in_finally(mod, node, parent):
+                continue
+            findings.append(
+                self.finding(
+                    mod, node,
+                    "span from start_span() is neither a `with` context "
+                    "manager nor .end()ed in a finally block; an "
+                    "exception on this path leaks an unended span that "
+                    "stays installed as the thread's current span (use "
+                    "`with`, try/finally + .end(), or begin_span for a "
+                    "span another thread ends)",
+                )
+            )
+        return findings
+
+    @staticmethod
+    def _ended_in_finally(
+        mod: SourceModule, call: ast.Call, parent
+    ) -> bool:
+        if not (
+            isinstance(parent, ast.Assign)
+            and len(parent.targets) == 1
+            and isinstance(parent.targets[0], ast.Name)
+        ):
+            return False
+        target = parent.targets[0].id
+        scope = mod.enclosing_function(call) or mod.tree
+        if scope is None:
+            return False
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Try) or not node.finalbody:
+                continue
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "end"
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == target
+                    ):
+                        return True
+        return False
+
+
+class MetricStatsParity(Rule):
+    id = "metric-stats-parity"
+    description = (
+        "Every tpu_serving_*/tpu_engine_* metric family registered in "
+        "metrics/metrics.py must be surfaced in a servers' JSON /stats "
+        "payload, recorded in the STATS_PARITY table (family -> /stats "
+        "key). An operator tailing /stats and a dashboard scraping "
+        "/metrics must never disagree about which observables exist."
+    )
+
+    @staticmethod
+    def _parity_entries(mod: SourceModule) -> tuple:
+        """(dict_node, {family: (stats_key_or_None, lineno)}) for a
+        module-level STATS_PARITY dict literal, or (None, {})."""
+        for node in mod.walk():
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Dict
+            ):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == "STATS_PARITY"
+                for t in node.targets
+            ):
+                continue
+            entries: dict = {}
+            for key, value in zip(node.value.keys, node.value.values):
+                if isinstance(key, ast.Constant) and isinstance(
+                    key.value, str
+                ):
+                    stats_key = (
+                        value.value
+                        if isinstance(value, ast.Constant)
+                        and isinstance(value.value, str)
+                        else None
+                    )
+                    entries[key.value] = (stats_key, key.lineno)
+            return node, entries
+        return None, {}
+
+    @staticmethod
+    def _local_registrations(mod: SourceModule) -> list:
+        """(family, call_node) for every prometheus registration in
+        THIS module (module-local, so fixtures are self-contained)."""
+        out = []
+        for node in mod.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            callee = resolved_callee(mod, node) or ""
+            if callee.rsplit(".", 1)[-1] not in config.PROM_CONSTRUCTORS:
+                continue
+            if (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                out.append((node.args[0].value, node))
+        return out
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        dict_node, parity = self._parity_entries(mod)
+        serving = [
+            (name, node)
+            for name, node in self._local_registrations(mod)
+            if config.STATS_PARITY_FAMILY_RE.fullmatch(name)
+        ]
+        if dict_node is None and not serving:
+            return []
+        findings = []
+        for name, node in serving:
+            if name not in parity:
+                findings.append(
+                    self.finding(
+                        mod, node,
+                        f"serving/engine metric family {name!r} is "
+                        "registered but has no STATS_PARITY entry "
+                        "mapping it to a /stats key — the JSON /stats "
+                        "view and the Prometheus view just diverged",
+                    )
+                )
+        registered = {n for n, _ in self._local_registrations(mod)}
+        for name, (stats_key, line) in parity.items():
+            if name not in registered:
+                findings.append(
+                    Finding(
+                        self.id, mod.rel, line, 0,
+                        f"STATS_PARITY lists {name!r} but this module "
+                        "never registers that family",
+                    )
+                )
+            if stats_key is None:
+                findings.append(
+                    Finding(
+                        self.id, mod.rel, line, 0,
+                        f"STATS_PARITY entry for {name!r} must map to "
+                        "a /stats key string literal",
+                    )
+                )
+        return findings
+
+    def check_repo(self, index, checked: dict) -> list:
+        if config.METRICS_MODULE not in checked:
+            return []
+        mod = index.by_rel.get(config.METRICS_MODULE)
+        if mod is None:
+            return []
+        dict_node, parity = self._parity_entries(mod)
+        if dict_node is None:
+            return [
+                Finding(
+                    self.id, config.METRICS_MODULE, 1, 0,
+                    "metrics module defines no STATS_PARITY table; the "
+                    "serving families' /stats surfacing is unrecorded",
+                )
+            ]
+        surface_literals: set = set()
+        for rel in config.STATS_SURFACE_MODULES:
+            smod = index.by_rel.get(rel)
+            if smod is None:
+                continue
+            for node in smod.walk():
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str
+                ):
+                    surface_literals.add(node.value)
+        findings = []
+        for name, (stats_key, line) in parity.items():
+            if stats_key is not None and stats_key not in surface_literals:
+                findings.append(
+                    Finding(
+                        self.id, config.METRICS_MODULE, line, 0,
+                        f"STATS_PARITY maps {name!r} to /stats key "
+                        f"{stats_key!r}, but that key never appears in "
+                        + " or ".join(config.STATS_SURFACE_MODULES),
+                    )
+                )
+        return findings
+
+
 class AnnotationLiteral(Rule):
     id = "annotation-literal"
     description = (
@@ -727,6 +936,8 @@ ALL_RULES = [
     MetricLiteralUnregistered(),
     MetricAttrUnregistered(),
     MetricNameScheme(),
+    MetricStatsParity(),
+    SpanUnended(),
     AnnotationLiteral(),
     ChaosParity(),
     SuppressionHygiene(),

@@ -34,6 +34,7 @@ from kubeflow_tpu.k8s.errors import NotFoundError
 from kubeflow_tpu.k8s.events import EventRecorder
 from kubeflow_tpu.k8s.manager import Manager, Reconciler, Request, Result
 from kubeflow_tpu.metrics import Metrics
+from kubeflow_tpu.observability import tracing
 from kubeflow_tpu.tpu.topology import InvalidTopologyError, SliceTopology
 
 log = logging.getLogger(__name__)
@@ -272,6 +273,15 @@ class NotebookReconciler(Reconciler):
 
     # ------------------------------------------------------------------
     def reconcile(self, req: Request) -> Result:
+        # Root span per reconcile pass; the phase methods below hang
+        # child spans off it (StatefulSet apply, Services/routes, status
+        # mirroring) so a slow reconcile decomposes in the trace export.
+        with tracing.get_tracer("controller").start_span(
+            "reconcile", notebook=req.name, namespace=req.namespace,
+        ):
+            return self._reconcile(req)
+
+    def _reconcile(self, req: Request) -> Result:
         try:
             obj = self.client.get("Notebook", req.name, req.namespace)
         except NotFoundError:
@@ -301,6 +311,45 @@ class NotebookReconciler(Reconciler):
             )
 
         slice_count = nb.tpu.slice_count if nb.tpu is not None else 1
+        with tracing.get_tracer("controller").start_span(
+            "reconcile.statefulsets", slices=slice_count,
+        ):
+            self._reconcile_slices(obj, nb, slice_topo, slice_count)
+        if nb.stopped:
+            self._clear_claim_annotations(obj, nb)
+
+        with tracing.get_tracer("controller").start_span(
+            "reconcile.services",
+        ):
+            service = generate_service(nb)
+            helper.reconcile_child(
+                self.client, obj, service, helper.copy_service_fields
+            )
+            if slice_topo is not None:
+                headless = generate_headless_service(nb, slice_topo)
+                helper.reconcile_child(
+                    self.client, obj, headless, helper.copy_service_fields
+                )
+            if self.config.use_istio:
+                helper.reconcile_child(
+                    self.client, obj,
+                    generate_virtual_service(nb, self.config),
+                    helper.copy_virtual_service_fields,
+                )
+
+        with tracing.get_tracer("controller").start_span(
+            "reconcile.status",
+        ):
+            self._reemit_pod_events(nb, slice_topo)
+            self._update_status(nb, slice_topo)
+            self._handle_restart(nb, slice_topo)
+        return Result()
+
+    def _reconcile_slices(self, obj: dict, nb: Notebook,
+                          slice_topo, slice_count: int) -> None:
+        """The StatefulSet-apply phase of one reconcile pass (its own
+        child span): per-slice generate/diff/apply plus warm-pool claims
+        and stale-slice pruning."""
         created_any = False
         for slice_id in range(slice_count):
             sts = generate_statefulset(
@@ -352,27 +401,6 @@ class NotebookReconciler(Reconciler):
                     f"name(s) {', '.join(fallback_names)}",
                 )
         self._prune_stale_slice_sts(nb, slice_count)
-        if nb.stopped:
-            self._clear_claim_annotations(obj, nb)
-
-        service = generate_service(nb)
-        helper.reconcile_child(self.client, obj, service, helper.copy_service_fields)
-        if slice_topo is not None:
-            headless = generate_headless_service(nb, slice_topo)
-            helper.reconcile_child(
-                self.client, obj, headless, helper.copy_service_fields
-            )
-        if self.config.use_istio:
-            helper.reconcile_child(
-                self.client, obj,
-                generate_virtual_service(nb, self.config),
-                helper.copy_virtual_service_fields,
-            )
-
-        self._reemit_pod_events(nb, slice_topo)
-        self._update_status(nb, slice_topo)
-        self._handle_restart(nb, slice_topo)
-        return Result()
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -47,6 +47,7 @@ from kubeflow_tpu.k8s.errors import NotFoundError
 from kubeflow_tpu.k8s.events import EventRecorder
 from kubeflow_tpu.k8s.manager import Manager, Reconciler, Request, Result
 from kubeflow_tpu.metrics import Metrics
+from kubeflow_tpu.observability import tracing
 
 log = logging.getLogger(__name__)
 
@@ -152,6 +153,16 @@ class SliceHealthReconciler(Reconciler):
         )
 
     def reconcile(self, req: Request) -> Result:
+        # One span per health pass; the recovery-ladder steps below add
+        # events/child spans, so an outage reads as a single trace:
+        # interruption → polls → escalations → recovered/terminal.
+        with tracing.get_tracer("controller").start_span(
+            "slice_health.reconcile",
+            notebook=req.name, namespace=req.namespace,
+        ):
+            return self._reconcile(req)
+
+    def _reconcile(self, req: Request) -> Result:
         try:
             obj = self.client.get("Notebook", req.name, req.namespace)
         except NotFoundError:
@@ -189,6 +200,9 @@ class SliceHealthReconciler(Reconciler):
                 except NotFoundError:
                     pass
             self._mark_interrupted(nb, failed[0][1], now)
+            tracing.current_span().add_event("slice_interrupted", {
+                "reason": failed[0][1], "pods_lost": len(failed),
+            })
             # Recovery is now OURS to drive: poll on a timer instead of
             # hoping replacement-pod events keep arriving.
             return Result(requeue_after=self.config.poll_initial_s)
@@ -281,6 +295,14 @@ class SliceHealthReconciler(Reconciler):
         self, nb: Notebook, obj: dict, escalations: int, now: float
     ) -> None:
         """One escalation step: warm-pool claim, else STS recreate."""
+        with tracing.get_tracer("controller").start_span(
+            "preemption.escalate", attempt=escalations + 1,
+        ) as span:
+            self._escalate_step(nb, obj, escalations, now, span)
+
+    def _escalate_step(
+        self, nb: Notebook, obj: dict, escalations: int, now: float, span
+    ) -> None:
         from kubeflow_tpu.controller.notebook import slice_sts_names
         from kubeflow_tpu.controller.slicepool import claim_warm_slice
         from kubeflow_tpu.deploy.manifests import termination_grace_seconds
@@ -326,6 +348,9 @@ class SliceHealthReconciler(Reconciler):
                 f"placement (escalation {attempt}){grace_note}",
             )
         self.metrics.slice_recovery_escalations_total.inc()
+        span.set_attribute(
+            "mode", "warm-claim" if pool else "sts-recreate"
+        )
         log.warning(
             "slice %s/%s: recovery escalation %d (%s)",
             nb.namespace, nb.name, attempt,
@@ -375,6 +400,9 @@ class SliceHealthReconciler(Reconciler):
             "slice %s/%s: recovery FAILED terminally (%d/%d hosts)",
             nb.namespace, nb.name, ready, hosts,
         )
+        tracing.current_span().record_error(RuntimeError(
+            f"recovery terminal: {ready}/{hosts} hosts Ready"
+        ))
         return Result(requeue_after=cfg.terminal_requeue_s)
 
     def _complete_recovery(
@@ -384,6 +412,11 @@ class SliceHealthReconciler(Reconciler):
         duration = max(0.0, now - started) if started is not None else None
         if duration is not None:
             self.metrics.slice_recovery_seconds.observe(duration)
+        tracing.current_span().add_event("slice_recovered", {
+            "hosts": hosts,
+            **({"duration_s": round(duration, 3)}
+               if duration is not None else {}),
+        })
         self._clear_recovery_state(nb, duration=duration)
         if _condition_true(obj, RECOVERY_FAILED_CONDITION):
             # Capacity came back after we went terminal: flip the condition

@@ -1245,6 +1245,18 @@ class ExperimentRunner:
                 any(b"replica lost mid-stream" in ln for ln in lines)
                 for lines in collected
             )
+            # Correlation survives the loss: by the time a stream dies
+            # the response headers are long gone, so the SSE error event
+            # itself must carry the request id — it is the only handle
+            # left for joining the truncated stream against gateway logs
+            # and the trace export.
+            error_events = [
+                ln for lines in collected for ln in lines
+                if b"replica lost mid-stream" in ln
+            ]
+            correlated = all(
+                b'"request_id"' in ln for ln in error_events
+            )
             # Ring heals to the survivor alone.
             healed = False
             deadline = time.monotonic() + timeout
@@ -1268,6 +1280,7 @@ class ExperimentRunner:
                 burst >= 1
                 and terminated == streams
                 and errored == burst
+                and correlated
                 and healed
                 and recovered == 4
                 and stats["failed"] == failed_before == burst
@@ -1277,12 +1290,14 @@ class ExperimentRunner:
                 passed=passed,
                 detail="" if passed else (
                     f"burst={burst} terminated={terminated}/{streams} "
-                    f"errored={errored} healed={healed} "
+                    f"errored={errored} correlated={correlated} "
+                    f"healed={healed} "
                     f"recovered={recovered}/4 failed={stats['failed']}"
                 ),
                 observations={
                     "error_burst": burst,
                     "errored_streams": errored,
+                    "correlated_errors": correlated,
                     "reroutes": stats["reroutes"],
                     "healed": healed,
                 },

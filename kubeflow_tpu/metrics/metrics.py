@@ -25,6 +25,26 @@ from prometheus_client import (
 
 from kubeflow_tpu.k8s.client import Client
 
+# Every serving/engine metric family must stay visible in the servers' JSON
+# /stats payload so an operator tailing /stats and a dashboard scraping
+# /metrics can never disagree about which observables exist.  The value is
+# the /stats key the family surfaces under (a string literal that must
+# appear in models/server.py or models/gateway.py); kftpu-lint's
+# metric-stats-parity rule enforces both directions of this table.
+STATS_PARITY = {
+    "tpu_serving_requests_shed_total": "requests_shed",
+    "tpu_serving_requests_cancelled_total": "requests_cancelled",
+    "tpu_serving_deadline_expired_total": "deadline_expired",
+    "tpu_serving_queue_depth": "queued",
+    "tpu_serving_drain_seconds": "drain_duration_s",
+    "tpu_serving_ragged_batch_fill": "batch_fill",
+    "tpu_serving_prefix_cache_hits_total": "hits",
+    "tpu_serving_prefix_cache_misses_total": "misses",
+    "tpu_serving_prefix_cache_evictions_total": "evictions",
+    "tpu_serving_prefix_cached_blocks": "cached_blocks",
+    "tpu_engine_step_stall_total": "engine_step_stalls",
+}
+
 
 class Metrics:
     """Per-manager metric bundle with an isolated registry (testable)."""
@@ -175,6 +195,15 @@ class Metrics:
             "tpu_serving_ragged_batch_fill",
             "Fraction of the ragged engine's last-step token budget "
             "carrying real (decode or prefill-chunk) tokens",
+            registry=self.registry,
+        )
+        # -- engine flight recorder (observability/flight.py) --------------
+        # Mirrored from the recorder's stall ledger by the InferenceServer
+        # drive loop (same delta pattern as the prefix-cache counters).
+        self.engine_step_stall_total = Counter(
+            "tpu_engine_step_stall_total",
+            "Engine steps whose duration exceeded the flight recorder's "
+            "stall threshold (k x rolling-median step time)",
             registry=self.registry,
         )
         # -- prefix cache (models/paged.py PagedBatcher(prefix_cache=True))

@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubeflow_tpu.observability import tracing
+
 from kubeflow_tpu.models.llama import (
     LlamaConfig,
     _cache_store_rows,
@@ -386,6 +388,18 @@ class _BatcherBase:
         self.on_token = None
         self.on_retire = None
         self.on_abort = None
+        # on_admit(rid): fires the moment a queued request is popped for
+        # admission (every admission path goes through _pop_queue) — the
+        # serving frontend ends its queue-wait span here, which is what
+        # lets TTFT decompose into queue_wait + prefill + first_decode.
+        self.on_admit = None
+        # Optional observability.flight.FlightRecorder attached by the
+        # serving frontend; drive_once feeds it one sample per quantum.
+        self.flight = None
+        # What the most recent drive quantum did (engine-specific: fill
+        # ratio, decode/prefill row split) — stamped by _step/_step_ragged,
+        # read by drive_once for the engine.step span attributes.
+        self.last_step: dict = {}
         # rid → reason for requests cancelled while holding a slot (or
         # mid-admission): checked at the next _note_token so the slot is
         # reclaimed within one engine step. Mutated only under the
@@ -556,11 +570,55 @@ class _BatcherBase:
             or bool(getattr(self, "_ragged_admit", {}))
         )
 
+    def _pop_queue(self, index: int = 0) -> "_Request":
+        """THE queue→admission transition: every admission path pops
+        through here so on_admit fires exactly once per request at
+        batcher pickup."""
+        req = self._queue.pop(index)
+        if self.on_admit is not None:
+            self.on_admit(req.rid)
+        return req
+
+    def drive_once(self) -> None:
+        """One drive quantum (admit + step), timed: shared by the batch
+        run() loop and the serving frontend's engine thread. Feeds the
+        attached flight recorder and — only when a recording tracer is
+        installed, so the default path pays nothing — wraps the quantum
+        in an ``engine.step`` span carrying whatever the engine stamped
+        into ``last_step`` (ragged fill, decode/prefill split)."""
+        span = None
+        if tracing.enabled():
+            span = tracing.get_tracer("engine").start_span("engine.step")
+        t0 = self._clock()
+        self.last_step = {}
+        try:
+            self._admit_free_slots()
+            self._step()
+        except Exception as err:
+            if span is not None:
+                span.record_error(err)
+            raise
+        finally:
+            dt = self._clock() - t0
+            stalled = False
+            if self.flight is not None:
+                stalled = self.flight.record_step(
+                    dt, self.last_step.get("fill")
+                )
+                if stalled:
+                    self.last_step["stalled"] = True
+            if span is not None:
+                for k, v in self.last_step.items():
+                    span.set_attribute(k, v)
+                span.set_attribute("duration_s", round(dt, 6))
+                if stalled:
+                    span.add_event("stall", {"duration_s": round(dt, 6)})
+                span.end()
+
     def run(self) -> dict[int, list[int]]:
         """Drive until queue and slots drain; returns {rid: tokens}."""
         while self._pending():
-            self._admit_free_slots()
-            self._step()
+            self.drive_once()
         out, self._results = self._results, {}
         self._last_logprobs, self._result_logprobs = (
             self._result_logprobs, {}
@@ -804,7 +862,7 @@ class ContinuousBatcher(_BatcherBase):
         for slot in range(self.slots):
             if self._by_slot[slot] is not None or not self._queue:
                 continue
-            req = self._queue.pop(0)
+            req = self._pop_queue()
             padded, mask = left_pad(
                 [req.prompt], self.gen.pad_id, self.prompt_bucket
             )
@@ -825,7 +883,7 @@ class ContinuousBatcher(_BatcherBase):
             )
             if slot is None or not self._queue:
                 return
-            req = self._queue.pop(0)
+            req = self._pop_queue()
             padded, mask = left_pad(
                 [req.prompt], self.gen.pad_id, self.prompt_bucket
             )
@@ -885,7 +943,7 @@ class ContinuousBatcher(_BatcherBase):
         )
         if slot is None:
             return
-        req = self._queue.pop(0)
+        req = self._pop_queue()
         padded, mask = left_pad(
             [req.prompt], self.gen.pad_id, self.prompt_bucket
         )
@@ -962,6 +1020,11 @@ class ContinuousBatcher(_BatcherBase):
         active = [i for i, r in enumerate(self._by_slot) if r is not None]
         if not active:
             return
+        self.last_step = {
+            "decode_rows": len(active),
+            "prefill_rows": 0,
+            "fill": len(active) / self.slots,
+        }
         self.key, sub = jax.random.split(self.key)
         # jnp.array (not asarray): the CPU backend can alias numpy memory
         # zero-copy, and the host mutates tokens/positions below while the
@@ -1005,6 +1068,12 @@ class ContinuousBatcher(_BatcherBase):
             positions[a["slot"]] = start
             cols[a["slot"]] = n - 1
             admit_done = a["cursor"].done
+        prefill_rows = 0 if a is None else 1
+        self.last_step = {
+            "decode_rows": len(active),
+            "prefill_rows": prefill_rows,
+            "fill": (len(active) + prefill_rows) / self.slots,
+        }
         self.key, sub = jax.random.split(self.key)
         nxt, lps, self.cache = _cb_ragged_step(
             self.params, self.cfg, jnp.array(tokens), self.cache,

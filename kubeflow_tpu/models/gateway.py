@@ -59,6 +59,7 @@ from typing import Optional
 import numpy as np
 
 from kubeflow_tpu.models.server import BodyTooLarge, _client_gone, _read_body
+from kubeflow_tpu.observability import tracing
 
 AFFINITY_MODES = ("prefix", "random")
 
@@ -250,6 +251,9 @@ class ServingGateway:
             raise ValueError(
                 f"reroute_budget must be >= 0, got {reroute_budget}"
             )
+        # Same opt-in as the replicas: KUBEFLOW_TPU_TRACE_* switches the
+        # process-wide provider on; default stays the no-op tracer.
+        tracing.configure_from_env()
         self.affinity = affinity
         self.reroute_budget = reroute_budget
         self.health_interval_s = health_interval_s
@@ -541,6 +545,12 @@ class ServingGateway:
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
+            # Correlation id for the request being handled: the trace id
+            # (caller's traceparent, or this gateway's fresh root trace).
+            # Echoed to the client (X-Request-Id, SSE error payloads) and
+            # forwarded to the replica so every layer logs the same id.
+            _req_id = None
+
             def log_message(self, *args):
                 pass
 
@@ -548,6 +558,8 @@ class ServingGateway:
                       retry_after: Optional[int] = None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
+                if self._req_id:
+                    self.send_header("X-Request-Id", self._req_id)
                 if retry_after is not None:
                     self.send_header("Retry-After", str(retry_after))
                 self.send_header("Content-Type", "application/json")
@@ -568,6 +580,11 @@ class ServingGateway:
                         self._json(503, {"status": "no healthy replicas"})
                 elif self.path == "/stats":
                     self._json(200, gw.stats())
+                elif self.path == "/debug/traces":
+                    ring = tracing.trace_ring()
+                    self._json(200, {
+                        "traces": ring.snapshot() if ring else [],
+                    })
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -576,6 +593,22 @@ class ServingGateway:
                     self._json(404, {"error": "not found"})
                     return
                 arrival = time.monotonic()
+                # Root span of the whole distributed trace (unless the
+                # caller already carries a traceparent, in which case the
+                # gateway joins it); the replica hop continues the same
+                # trace via the headers _proxy injects.
+                with tracing.get_tracer("gateway").start_span(
+                    "gateway.request",
+                    traceparent=self.headers.get("traceparent"),
+                ) as span:
+                    self._req_id = (
+                        self.headers.get("x-request-id")
+                        or span.trace_id
+                        or tracing.new_trace_id()
+                    )
+                    self._completions(arrival, span)
+
+            def _completions(self, arrival: float, span) -> None:
                 try:
                     body = _read_body(self, gw.max_body_bytes)
                 except BodyTooLarge as err:
@@ -595,9 +628,12 @@ class ServingGateway:
                     self.headers.get("x-tenant")
                     or req.get("user") or "anonymous"
                 )
+                span.set_attribute("tenant", tenant)
                 try:
                     gw._admit(tenant)
                 except GatewayOverloadedError as err:
+                    span.add_event("tenant_shed", {"tenant": tenant})
+                    span.record_error(err)
                     self._json(429, {"error": str(err)}, retry_after=1)
                     return
                 try:
@@ -608,7 +644,21 @@ class ServingGateway:
             def _route(self, req: dict, arrival: float) -> None:
                 key = gw._route_key(req.get("prompt"))
                 candidates = gw._candidates(key)
+                # The routing decision is its own span: affinity mode,
+                # candidate walk, and every re-route attempt (as events)
+                # in one place.
+                with tracing.get_tracer("gateway").start_span(
+                    "gateway.route", affinity=gw.affinity,
+                    candidates=len(candidates),
+                ) as span:
+                    self._route_span(req, arrival, candidates, span)
+
+            def _route_span(self, req: dict, arrival: float,
+                            candidates: list, span) -> None:
                 if not candidates:
+                    span.record_error(
+                        RuntimeError("no healthy replicas")
+                    )
                     self._json(503, {"error": "no healthy replicas"},
                                retry_after=1)
                     return
@@ -619,6 +669,12 @@ class ServingGateway:
                 for i, endpoint in enumerate(candidates):
                     if i:
                         gw._count_reroute()
+                        span.add_event("reroute", {
+                            "attempt": i, "endpoint": endpoint,
+                            "prior": f"{last[0]}: {last[1]}"
+                            if last else "unreachable",
+                        })
+                    span.set_attribute("endpoint", endpoint)
                     fwd = dict(req)
                     if isinstance(deadline_s, (int, float)) and not \
                             isinstance(deadline_s, bool):
@@ -638,6 +694,9 @@ class ServingGateway:
                 # Budget exhausted: every candidate refused or was down.
                 gw._count_failed()
                 code, detail = last if last else (503, "replicas unreachable")
+                span.record_error(RuntimeError(
+                    f"re-route budget exhausted: {detail}"
+                ))
                 self._json(code if code in (429, 503) else 503,
                            {"error": f"fleet exhausted re-route budget "
                                      f"({gw.reroute_budget}): {detail}"},
@@ -655,6 +714,17 @@ class ServingGateway:
                 timeout = gw.upstream_timeout_s
                 if isinstance(deadline_s, (int, float)):
                     timeout = min(timeout, float(deadline_s) + 5.0)
+                # Propagate the trace across the HTTP hop: the replica's
+                # server.request span joins this trace via the W3C
+                # traceparent header; X-Request-Id rides along even when
+                # tracing is off so the correlation id survives end to
+                # end regardless.
+                headers = {"Content-Type": "application/json"}
+                tp = tracing.format_traceparent(tracing.current_span())
+                if tp:
+                    headers["traceparent"] = tp
+                if self._req_id:
+                    headers["X-Request-Id"] = self._req_id
                 try:
                     conn = http.client.HTTPConnection(
                         rep.host, rep.port, timeout=timeout
@@ -662,7 +732,7 @@ class ServingGateway:
                     conn.request(
                         "POST", "/v1/completions",
                         json.dumps(req).encode(),
-                        {"Content-Type": "application/json"},
+                        headers,
                     )
                     resp = conn.getresponse()
                 except OSError:
@@ -713,6 +783,9 @@ class ServingGateway:
                             return "done", None
                         if not started:
                             self.send_response(resp.status)
+                            if self._req_id:
+                                self.send_header("X-Request-Id",
+                                                 self._req_id)
                             self.send_header("Content-Type",
                                              "text/event-stream")
                             self.send_header("Cache-Control", "no-cache")
@@ -752,9 +825,16 @@ class ServingGateway:
                 terminate the stream distinguishably instead."""
                 gw._count_failed()
                 try:
+                    # The error event carries the request id (the only
+                    # correlation handle left once headers are gone —
+                    # the chaos harness asserts it survives a replica
+                    # kill). json.dumps keeps insertion order, so the
+                    # "replica lost mid-stream" detail stays greppable.
                     self.wfile.write(
-                        b'data: {"error": "replica lost '
-                        b'mid-stream"}\n\ndata: [DONE]\n\n'
+                        b"data: " + json.dumps({
+                            "error": "replica lost mid-stream",
+                            "request_id": self._req_id,
+                        }).encode() + b"\n\ndata: [DONE]\n\n"
                     )
                     self.wfile.flush()
                 except OSError:

@@ -917,7 +917,7 @@ class PagedBatcher(_BatcherBase):
                 break
             else:
                 continue  # queue drained for this slot
-            req = self._queue.pop(0)
+            req = self._pop_queue()
             generated = list(req.tokens)
             if padded is None:
                 padded, mask = left_pad([effective], self.gen.pad_id, bucket)
@@ -996,7 +996,7 @@ class PagedBatcher(_BatcherBase):
                         f"{self.num_blocks - 1} usable; raise num_blocks"
                     )
                 return  # pool busy; retry after in-flight slots retire
-            req = self._queue.pop(0)
+            req = self._pop_queue()
             padded, mask = left_pad([effective], self.gen.pad_id, bucket)
             self.tables[slot] = 0  # stale entries never alias freed blocks
             self.tables[slot, :len(blocks)] = blocks
@@ -1084,7 +1084,7 @@ class PagedBatcher(_BatcherBase):
                 return  # pool busy; retry after in-flight slots retire
             else:
                 continue  # queue drained for this slot
-            req = self._queue.pop(0)
+            req = self._pop_queue()
             # Counted only once allocation committed: a pool-stall retry
             # re-walks the chain and must not double-count its blocks.
             self.prefix_hits += m
@@ -1194,6 +1194,11 @@ class PagedBatcher(_BatcherBase):
         active = self._ensure_step_blocks()
         if not active:
             return
+        self.last_step = {
+            "decode_rows": len(active),
+            "prefill_rows": 0,
+            "fill": len(active) / self.slots,
+        }
         self.key, sub = jax.random.split(self.key)
         nxt, lps, self.pool = _paged_step(
             self.params, self.cfg, jnp.array(self.tokens), self.pool,
@@ -1300,6 +1305,11 @@ class PagedBatcher(_BatcherBase):
         self.ragged_steps += 1
         self.ragged_tokens += rows
         self.ragged_fill = rows / tb
+        self.last_step = {
+            "decode_rows": len(active),
+            "prefill_rows": rows - len(active),
+            "fill": self.ragged_fill,
+        }
         host_next = np.asarray(nxt)
         host_lps = np.asarray(lps)
         for slot in active:

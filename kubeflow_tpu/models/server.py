@@ -77,6 +77,10 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from kubeflow_tpu.observability import tracing
+from kubeflow_tpu.observability.flight import FlightRecorder
+
+
 def _percentiles(window) -> dict:
     """{p50, p95} by nearest rank over one sort of the window."""
     if not window:
@@ -257,6 +261,9 @@ class InferenceServer:
         if max_queue_depth < 1:
             raise ValueError(f"max_queue_depth must be >= 1, got "
                              f"{max_queue_depth}")
+        # Env-gated tracing (no-op unless KUBEFLOW_TPU_TRACE_* is set, and
+        # never clobbers a provider a test already installed).
+        tracing.configure_from_env()
         self.max_queue_depth = max_queue_depth
         self.max_body_bytes = max_body_bytes
         self.default_deadline_s = default_deadline_s
@@ -308,6 +315,19 @@ class InferenceServer:
         # Prometheus Counters only inc(): mirror the engine's monotonic
         # prefix-cache tallies by delta, last-mirrored snapshot here.
         self._prefix_mirrored = (0, 0, 0)
+        self._stalls_mirrored = 0
+        # Per-request span registry for the TTFT decomposition: rid →
+        # {"root", "queue_wait", "prefill"} spans. queue_wait starts at
+        # submit (handler thread) and ends at batcher pickup (engine
+        # thread, via on_admit); prefill ends at the first token. All
+        # mutations happen under self._lock.
+        self._req_spans: dict[int, dict] = {}
+        self._admit_ts: dict[int, float] = {}
+        # Flight recorder: always on (a deque append per step), sharing
+        # the engine's injectable clock so stall tests can drive it.
+        self.flight = FlightRecorder(
+            clock=getattr(self.engine, "_clock", None)
+        )
         self._httpd = ThreadingHTTPServer((host, port), self._handler_class())
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
@@ -323,8 +343,39 @@ class InferenceServer:
         self.engine.on_token = self._on_token
         self.engine.on_retire = self._on_retire
         self.engine.on_abort = self._on_abort
+        self.engine.on_admit = self._on_admit
+        self.engine.flight = self.flight
 
     # -- engine side (all under self._lock) --------------------------------
+
+    def _on_admit(self, rid: int) -> None:
+        """Batcher pickup (engine thread): the queue-wait phase ends here
+        and the prefill phase begins — the span boundary that lets TTFT
+        decompose into queue_wait + prefill + first_decode."""
+        self._admit_ts[rid] = time.monotonic()
+        spans = self._req_spans.get(rid)
+        if spans is None:
+            return
+        qs = spans.pop("queue_wait", None)
+        if qs is not None:
+            qs.end()
+        spans["prefill"] = tracing.get_tracer("server").begin_span(
+            "prefill", parent=spans.get("root"), rid=rid
+        )
+
+    def _end_request_spans(self, rid: int, error: str = "") -> None:
+        """Close any still-open per-request child spans (the root span is
+        owned by the handler's with-block). Abort paths pass the reason so
+        a truncated request's spans read as errors."""
+        spans = self._req_spans.pop(rid, None)
+        if not spans:
+            return
+        for name, span in spans.items():
+            if name == "root" or span is None:
+                continue
+            if error:
+                span.record_error(RuntimeError(error))
+            span.end()
 
     def _on_token(self, rid: int, token: int) -> None:
         self._tokens_out += 1
@@ -332,6 +383,27 @@ class InferenceServer:
             now = time.monotonic()
             self._first_ts[rid] = now
             self._ttft.append(now - self._submit_ts[rid])
+            spans = self._req_spans.get(rid)
+            if spans is not None:
+                ps = spans.pop("prefill", None)
+                root = spans.get("root")
+                if ps is not None:
+                    ps.end()
+                    # First-token sampling is fused into the dispatch that
+                    # completes the prefill (PR 6), so first_decode is the
+                    # (≈0) tail between prefill end and token delivery:
+                    # queue_wait + prefill + first_decode sums exactly to
+                    # the submit→first-token wall clock.
+                    fd = tracing.get_tracer("server").begin_span(
+                        "first_decode", parent=root, rid=rid, fused=True
+                    )
+                    fd.start_time = ps.end_time
+                    fd.end()
+                if root is not None:
+                    root.add_event("first_token", {
+                        "rid": rid,
+                        "ttft_s": round(now - self._submit_ts[rid], 6),
+                    })
         q = self._queues.get(rid)
         if q is not None:
             q.put(token)
@@ -341,8 +413,10 @@ class InferenceServer:
         self._served += 1
         t0 = self._submit_ts.pop(rid, None)
         self._first_ts.pop(rid, None)
+        self._admit_ts.pop(rid, None)
         if t0 is not None:
             self._e2e.append(time.monotonic() - t0)
+        self._end_request_spans(rid)
         q = self._queues.get(rid)
         if q is not None:
             q.put(_Final(list(tokens), list(logprobs), finish_reason))
@@ -361,6 +435,8 @@ class InferenceServer:
                 self.metrics.serving_requests_cancelled_total.inc()
         self._submit_ts.pop(rid, None)
         self._first_ts.pop(rid, None)
+        self._admit_ts.pop(rid, None)
+        self._end_request_spans(rid, error=reason)
         q = self._queues.get(rid)
         if q is not None:
             q.put(_Abort(reason))
@@ -375,8 +451,23 @@ class InferenceServer:
                 # Admit + one decode step under the lock: handler threads
                 # only ever touch the engine between steps.
                 try:
-                    self.engine._admit_free_slots()
-                    self.engine._step()
+                    # drive_once = admit + step, timed: feeds the flight
+                    # recorder and the per-step engine span. Engines
+                    # without it (test fakes) get the raw pair.
+                    drive = getattr(self.engine, "drive_once", None)
+                    if drive is not None:
+                        drive()
+                    else:
+                        t0 = time.monotonic()
+                        self.engine._admit_free_slots()
+                        self.engine._step()
+                        self.flight.record_step(time.monotonic() - t0)
+                    if self.metrics is not None:
+                        stalls = self.flight.stalls
+                        self.metrics.engine_step_stall_total.inc(
+                            stalls - self._stalls_mirrored
+                        )
+                        self._stalls_mirrored = stalls
                     if (self.metrics is not None
                             and getattr(self.engine, "ragged", False)):
                         self.metrics.serving_ragged_batch_fill.set(
@@ -585,6 +676,20 @@ class InferenceServer:
                                          deadline_s=deadline_s)
             self._queues[rid] = q
             self._submit_ts[rid] = time.monotonic()
+            if tracing.enabled():
+                # Handler thread: the request root span (do_POST's with-
+                # block) is this thread's current span; queue_wait starts
+                # now and ends at batcher pickup in the ENGINE thread —
+                # begin_span, because a cross-thread span must not become
+                # this thread's contextvar-current span.
+                root = tracing.current_span()
+                self._req_spans[rid] = {
+                    "root": root,
+                    "queue_wait": tracing.get_tracer("server").begin_span(
+                        "queue_wait", parent=root, rid=rid,
+                        queue_depth=len(self.engine._queue),
+                    ),
+                }
             if self.metrics is not None:
                 self.metrics.serving_queue_depth.set(
                     len(self.engine._queue)
@@ -610,6 +715,8 @@ class InferenceServer:
             # the timing dicts stay bounded on a long-running server.
             self._submit_ts.pop(rid, None)
             self._first_ts.pop(rid, None)
+            self._admit_ts.pop(rid, None)
+            self._end_request_spans(rid)
 
     def _decode_prompt(self, prompt) -> list[int]:
         if isinstance(prompt, str):
@@ -638,12 +745,21 @@ class InferenceServer:
             # read timeout.
             protocol_version = "HTTP/1.1"
 
+            # Correlation id echoed on every completion response
+            # (X-Request-Id header and mid-stream SSE error payloads):
+            # the trace id when the caller sent a traceparent, a fresh
+            # id otherwise, so any response line can be joined against
+            # the trace export.
+            _req_id = None
+
             def log_message(self, *args):  # quiet by default
                 pass
 
             def _json(self, code: int, payload: dict) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
+                if self._req_id:
+                    self.send_header("X-Request-Id", self._req_id)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 # send_header("Connection", "close") also sets
@@ -737,6 +853,7 @@ class InferenceServer:
                         time.monotonic() - server._started_at
                         if server._started_at is not None else 0.0
                     )
+                    fl = server.flight.snapshot()
                     self._json(200, {
                         "active_slots": active,
                         "queued": depth,
@@ -761,6 +878,16 @@ class InferenceServer:
                         "drain_duration_s": server._drain_duration,
                         **({"ragged": rag} if rag is not None else {}),
                         **({"prefix_cache": pc} if pc is not None else {}),
+                        # Flight-recorder view (stall count surfaces the
+                        # tpu_engine_step_stall_total family per the
+                        # STATS_PARITY table in metrics/metrics.py).
+                        "engine_step_stalls": fl["stalls"],
+                        "flight": fl,
+                    })
+                elif self.path == "/debug/traces":
+                    ring = tracing.trace_ring()
+                    self._json(200, {
+                        "traces": ring.snapshot() if ring else [],
                     })
                 else:
                     self._json(404, {"error": "not found"})
@@ -769,6 +896,22 @@ class InferenceServer:
                 if self.path != "/v1/completions":
                     self._json(404, {"error": "not found"})
                     return
+                # Root span for the replica-side request. A gateway hop
+                # arrives with a traceparent header — the span joins
+                # that trace so the export shows one gateway→server→
+                # engine chain per request.
+                with tracing.get_tracer("server").start_span(
+                    "server.request",
+                    traceparent=self.headers.get("traceparent"),
+                ) as span:
+                    self._req_id = (
+                        self.headers.get("x-request-id")
+                        or span.trace_id
+                        or tracing.new_trace_id()
+                    )
+                    self._completions(span)
+
+            def _completions(self, span):
                 try:
                     body = _read_body(self, server.max_body_bytes)
                 except BodyTooLarge as err:
@@ -839,6 +982,9 @@ class InferenceServer:
                 except (ValueError, TypeError, json.JSONDecodeError) as err:
                     self._json(400, {"error": str(err)})
                     return
+                span.set_attribute("stream", stream)
+                span.set_attribute("n", n)
+                span.set_attribute("prompt_tokens", len(prompt))
                 subs = []
                 try:
                     try:
@@ -945,6 +1091,8 @@ class InferenceServer:
             def _stream(self, rid, q):
                 try:
                     self.send_response(200)
+                    if self._req_id:
+                        self.send_header("X-Request-Id", self._req_id)
                     self.send_header("Content-Type", "text/event-stream")
                     self.send_header("Cache-Control", "no-cache")
                     # Length-unknown: close delimits the body.
@@ -966,9 +1114,15 @@ class InferenceServer:
                             # An abort-truncated stream must be
                             # distinguishable from a completed one.
                             if isinstance(item, _Abort):
+                                # The error event carries the request id
+                                # so a truncated stream can be joined
+                                # against server logs and the trace
+                                # export without the (already-consumed)
+                                # response headers.
                                 self.wfile.write(
                                     b"data: " + json.dumps(
-                                        {"error": item.reason}
+                                        {"error": item.reason,
+                                         "request_id": self._req_id}
                                     ).encode() + b"\n\n"
                                 )
                             self.wfile.write(b"data: [DONE]\n\n")

@@ -1,10 +1,19 @@
-"""Observability: tracing spans (metrics live in kubeflow_tpu.metrics)."""
+"""Observability: tracing spans + engine flight recorder (metrics live in
+kubeflow_tpu.metrics)."""
 
+from kubeflow_tpu.observability.flight import FlightRecorder  # noqa: F401
 from kubeflow_tpu.observability.tracing import (  # noqa: F401
     InMemoryExporter,
+    JSONLExporter,
+    RingBufferExporter,
     Span,
     Tracer,
     TracerProvider,
+    configure_from_env,
+    current_span,
+    format_traceparent,
     get_tracer,
+    parse_traceparent,
     set_tracer_provider,
+    trace_ring,
 )

@@ -1,0 +1,102 @@
+"""Engine flight recorder: rolling step-time window with stall detection.
+
+The per-step spans answer "where did THIS request's time go"; the flight
+recorder answers "what has the engine been doing for the last N steps" —
+cheap enough to stay on unconditionally (a deque append per step), so it is
+populated even when tracing is sampled out or disabled. The serving layer
+surfaces ``snapshot()`` under ``/stats`` and mirrors the stall count into
+the ``tpu_engine_step_stall_total`` Prometheus counter.
+
+Stall rule: a step is a stall when its duration exceeds ``stall_factor`` ×
+the rolling median of the current window, once ``min_samples`` steps have
+been observed (the guard keeps the first JAX compilations — orders of
+magnitude slower than steady-state steps — from flagging every warm step
+after them, and from being flagged against an empty window).
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+
+class FlightRecorder:
+    """Thread-compatible: the engine drive loop records; HTTP handler
+    threads snapshot. A lock keeps the window and counters coherent."""
+
+    def __init__(
+        self,
+        window: int = 256,
+        stall_factor: float = 8.0,
+        min_samples: int = 16,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.window = max(2, int(window))
+        self.stall_factor = float(stall_factor)
+        self.min_samples = max(2, int(min_samples))
+        self.clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._durations: deque = deque(maxlen=self.window)
+        self._fills: deque = deque(maxlen=self.window)
+        self.steps = 0
+        self.stalls = 0
+        self.last_stall: Optional[dict] = None
+
+    def record_step(
+        self, duration_s: float, fill: Optional[float] = None
+    ) -> bool:
+        """Record one engine step; returns True when the step is a stall
+        (caller attaches the span event / bumps the counter)."""
+        with self._lock:
+            stalled = False
+            if len(self._durations) >= self.min_samples:
+                median = statistics.median(self._durations)
+                if median > 0 and duration_s > self.stall_factor * median:
+                    stalled = True
+                    self.stalls += 1
+                    self.last_stall = {
+                        "at": self.clock(),
+                        "step": self.steps,
+                        "duration_s": duration_s,
+                        "median_s": median,
+                        "factor": duration_s / median,
+                    }
+            self._durations.append(duration_s)
+            if fill is not None:
+                self._fills.append(fill)
+            self.steps += 1
+            return stalled
+
+    def snapshot(self) -> dict:
+        """Point-in-time view for ``/stats``: recent step-time distribution,
+        fill, and the stall ledger."""
+        with self._lock:
+            durations = sorted(self._durations)
+            n = len(durations)
+
+            def pct(p: float) -> float:
+                if not n:
+                    return 0.0
+                return durations[min(n - 1, int(p * n))]
+
+            return {
+                "steps": self.steps,
+                "window": n,
+                "stalls": self.stalls,
+                "last_stall": dict(self.last_stall) if self.last_stall else None,
+                "step_s": {
+                    "p50": pct(0.50),
+                    "p95": pct(0.95),
+                    "max": durations[-1] if n else 0.0,
+                },
+                "fill": {
+                    "mean": (
+                        sum(self._fills) / len(self._fills)
+                        if self._fills
+                        else 0.0
+                    ),
+                },
+            }

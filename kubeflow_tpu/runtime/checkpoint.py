@@ -64,6 +64,8 @@ from typing import Any, Optional
 
 import numpy as np
 
+from kubeflow_tpu.observability import tracing
+
 log = logging.getLogger(__name__)
 
 MANIFEST_NAME = "manifest.json"
@@ -260,6 +262,18 @@ class CheckpointManager:
 
     def emergency_save(self, grace_s: Optional[float] = None) -> bool:
         """One final synchronous save inside a termination grace budget.
+        (Traced as ``checkpoint.emergency_save`` — the grace-window span
+        is how a preemption trace shows where the budget went.)"""
+        with tracing.get_tracer("checkpoint").start_span(
+            "checkpoint.emergency_save",
+            **({"grace_s": grace_s} if grace_s is not None else {}),
+        ) as span:
+            ok = self._emergency_save(grace_s)
+            span.set_attribute("committed", ok)
+            return ok
+
+    def _emergency_save(self, grace_s: Optional[float] = None) -> bool:
+        """The emergency-save body (see ``emergency_save``).
 
         Returns True only if a new step was durably committed. Skips (and
         returns False) when there is nothing newer than the last committed
@@ -344,6 +358,16 @@ class CheckpointManager:
         return ok
 
     def _write_step(self, step: int, snapshot: list, meta: dict) -> bool:
+        with tracing.get_tracer("checkpoint").start_span(
+            "checkpoint.write", step=step,
+        ) as span:
+            ok = self._write_step_inner(step, snapshot, meta)
+            span.set_attribute("committed", ok)
+            return ok
+
+    def _write_step_inner(
+        self, step: int, snapshot: list, meta: dict
+    ) -> bool:
         """The atomic commit protocol; returns whether ``step`` committed.
         OSError (disk full, quota, permissions) is contained — training
         must outlive a sick disk, and its staging dir is cleaned up.
@@ -544,6 +568,14 @@ class CheckpointManager:
         )
 
     def restore_latest(self, template: Any) -> tuple:
+        with tracing.get_tracer("checkpoint").start_span(
+            "checkpoint.restore",
+        ) as span:
+            state, step = self._restore_latest(template)
+            span.set_attribute("restored_step", step)
+            return state, step
+
+    def _restore_latest(self, template: Any) -> tuple:
         """(state, step) from the newest checkpoint that VALIDATES, or
         (template, None). Steps failing validation are quarantined as
         ``corrupt-<step>-*`` (never deleted: torn bytes are evidence) and

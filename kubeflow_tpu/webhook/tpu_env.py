@@ -65,6 +65,11 @@ KUBEFLOW_TPU_GATEWAY_REROUTE_BUDGET = "KUBEFLOW_TPU_GATEWAY_REROUTE_BUDGET"
 # Persistent JAX compilation cache (bench.py capture windows; any runtime
 # entrypoint may opt in): compiled executables survive process restarts.
 KUBEFLOW_TPU_COMPILE_CACHE_DIR = "KUBEFLOW_TPU_COMPILE_CACHE_DIR"
+# Request tracing (observability/tracing.py configure_from_env): setting any
+# of these switches the process from the no-op provider to a recording one.
+KUBEFLOW_TPU_TRACE_EXPORT = "KUBEFLOW_TPU_TRACE_EXPORT"
+KUBEFLOW_TPU_TRACE_SAMPLE = "KUBEFLOW_TPU_TRACE_SAMPLE"
+KUBEFLOW_TPU_TRACE_RING = "KUBEFLOW_TPU_TRACE_RING"
 
 # name -> who produces it and from what. Annotation-projected env names are
 # defined next to their annotations in kubeflow_tpu/api/annotations.py and
@@ -117,6 +122,16 @@ ENV_CONTRACT: dict = {
     "notebook container): directory for JAX's persistent compilation "
     "cache; bench.py enables it at startup and stamps the dir into "
     "record provenance so warm-cache captures are distinguishable",
+    KUBEFLOW_TPU_TRACE_EXPORT: "operator-set (gateway / serving / bench "
+    "container): path of a JSONL file that every finished span is appended "
+    "to; setting it flips observability/tracing.py from the default no-op "
+    "provider to a recording one at component startup",
+    KUBEFLOW_TPU_TRACE_SAMPLE: "operator-set: head-sampling rate in [0,1] "
+    "(default 1.0). The decision is deterministic in the trace id, so the "
+    "gateway and every replica agree per request without coordination",
+    KUBEFLOW_TPU_TRACE_RING: "operator-set: capacity of the in-memory span "
+    "ring buffer behind the serving components' /debug/traces endpoint "
+    "(default 512 spans, oldest evicted first)",
     ann.QUANT_ENV_NAME: "webhook: tpu-quantization annotation",
     ann.PROFILING_ENV_NAME: "webhook: tpu-profiling-port annotation",
     ann.SERVING_ENV_NAME: "webhook: tpu-serving-port annotation",

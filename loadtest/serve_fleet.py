@@ -351,6 +351,36 @@ def run_churn(*, tenants: int, rounds: int,
             joiner.stop()
 
 
+def _verify_trace_export(min_chains: int):
+    """When ``KUBEFLOW_TPU_TRACE_EXPORT`` is set, the run doubles as the
+    tracing executability gate: the JSONL export must contain a complete
+    gateway→engine span chain (gateway.request → gateway.route →
+    server.request → queue_wait → prefill, one shared trace id) for at
+    least every completed request in the measured arms. Returns a small
+    summary dict, or None when export is off."""
+    from kubeflow_tpu.webhook.tpu_env import KUBEFLOW_TPU_TRACE_EXPORT
+
+    path = os.environ.get(KUBEFLOW_TPU_TRACE_EXPORT, "")
+    if not path:
+        return None
+    chain = {"gateway.request", "gateway.route", "server.request",
+             "queue_wait", "prefill"}
+    by_trace: dict = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            span = json.loads(line)
+            by_trace.setdefault(span["trace_id"], set()).add(span["name"])
+    chains = sum(1 for names in by_trace.values() if chain <= names)
+    if chains < min_chains:
+        raise SystemExit(
+            f"trace export {path}: only {chains} complete gateway→engine "
+            f"span chains for {min_chains} completed requests"
+        )
+    print(f"# trace export: {chains} complete gateway→engine chains "
+          f"across {len(by_trace)} traces ({path})", file=sys.stderr)
+    return {"complete_chains": chains, "traces": len(by_trace)}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=str(
@@ -382,6 +412,11 @@ def main() -> int:
     print("# churn phase: join + drain mid-run ...", file=sys.stderr)
     churn = run_churn(tenants=args.tenants, rounds=args.churn_rounds,
                       warm_chain_blocks=wcb)
+    # Floor: the two measured arms' completions (warm-up and churn
+    # completions only push the chain count higher).
+    trace_summary = _verify_trace_export(
+        affinity["requests_completed"] + random_arm["requests_completed"]
+    )
 
     speedup = round(
         affinity["requests_per_sec"]
@@ -405,6 +440,7 @@ def main() -> int:
         "random": random_arm,
         "churn": churn,
         "throughput_speedup": speedup,
+        **({"trace_summary": trace_summary} if trace_summary else {}),
     }
     print(json.dumps({
         "affinity_rps": affinity["requests_per_sec"],

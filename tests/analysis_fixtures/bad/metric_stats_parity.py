@@ -1,0 +1,16 @@
+"""BAD: registers a serving family no STATS_PARITY entry surfaces (and
+lists a family the module never registers)."""
+
+from prometheus_client import CollectorRegistry, Counter
+
+REGISTRY = CollectorRegistry()
+
+STATS_PARITY = {
+    "tpu_serving_requests_shed_total": "requests_shed",
+}
+
+orphan = Counter(
+    "tpu_serving_orphan_widgets_total",
+    "registered but absent from STATS_PARITY",
+    registry=REGISTRY,
+)

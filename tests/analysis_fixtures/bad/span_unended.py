@@ -1,0 +1,15 @@
+"""BAD: span started, .end() only on the happy path — an exception in
+do_work leaks the span and leaves it current on the handler thread."""
+
+from kubeflow_tpu.observability.tracing import get_tracer
+
+
+def handle(payload):
+    span = get_tracer("fixture").start_span("handle")
+    result = do_work(payload)
+    span.end()
+    return result
+
+
+def do_work(payload):
+    return payload

@@ -1,0 +1,16 @@
+"""GOOD: the one serving family registered here has a STATS_PARITY entry,
+and every STATS_PARITY key is registered in this module."""
+
+from prometheus_client import CollectorRegistry, Counter
+
+REGISTRY = CollectorRegistry()
+
+STATS_PARITY = {
+    "tpu_serving_requests_shed_total": "requests_shed",
+}
+
+shed = Counter(
+    "tpu_serving_requests_shed_total",
+    "fixture mirror of the real shed family",
+    registry=REGISTRY,
+)

@@ -1,0 +1,28 @@
+"""GOOD: the context-manager form, the try/finally form, and the
+begin_span cross-thread form (exempt by design — another thread ends it)."""
+
+from kubeflow_tpu.observability.tracing import get_tracer
+
+
+def handle(payload):
+    with get_tracer("fixture").start_span("handle") as span:
+        span.set_attribute("size", len(payload))
+        return do_work(payload)
+
+
+def drive(payload):
+    span = get_tracer("fixture").start_span("drive")
+    try:
+        return do_work(payload)
+    finally:
+        if span is not None:
+            span.end()
+
+
+def submit(payload, registry):
+    registry["queue_wait"] = get_tracer("fixture").begin_span("queue_wait")
+    return do_work(payload)
+
+
+def do_work(payload):
+    return payload

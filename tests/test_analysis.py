@@ -31,6 +31,8 @@ RULE_FOR_FIXTURE = {
     "metric_unregistered": "metric-unregistered",
     "metric_attr_unregistered": "metric-attr-unregistered",
     "metric_name_scheme": "metric-name-scheme",
+    "metric_stats_parity": "metric-stats-parity",
+    "span_unended": "span-unended",
     "annotation_literal": "annotation-literal",
     "suppression_hygiene": "suppression-hygiene",
     "parse_error": "parse-error",

@@ -1,4 +1,8 @@
-"""Tracing: webhook spans with an in-memory exporter.
+"""Tracing: webhook spans with an in-memory exporter, plus the PR-10
+end-to-end request path — W3C traceparent across a REAL gateway→replica
+hop, TTFT decomposition into queue_wait + prefill + first_decode spans,
+ring-buffer eviction bounds, deterministic sampling, and flight-recorder
+stall detection under a fake clock.
 
 Reference analog: opentelemetry_test.go:26-50 installs an in-memory
 exporter + real provider; specs assert root-span attributes and the
@@ -7,12 +11,22 @@ maybeRestartRunningNotebook child span.
 
 from __future__ import annotations
 
+import hashlib
+import json
+import time
+import urllib.request
+
 import pytest
 
+from kubeflow_tpu.observability.flight import FlightRecorder
 from kubeflow_tpu.observability.tracing import (
     InMemoryExporter,
+    RingBufferExporter,
     TracerProvider,
+    deterministic_sample,
+    format_traceparent,
     get_tracer,
+    parse_traceparent,
     set_tracer_provider,
 )
 
@@ -91,6 +105,279 @@ def test_webhook_records_imagestream_not_found_event(exporter):
     env.cluster.create(nb)
     (span,) = exporter.by_name("mutate-notebook")
     assert any(e["name"] == "imagestream-not-found" for e in span.events)
+
+
+class TestTraceparent:
+    def test_round_trip(self, exporter):
+        with get_tracer("t").start_span("parent") as span:
+            header = format_traceparent(span)
+        tid, pid, sampled = parse_traceparent(header)
+        assert (tid, pid, sampled) == (span.trace_id, span.span_id, True)
+
+    @pytest.mark.parametrize(
+        "header",
+        [
+            None,
+            "",
+            "garbage",
+            "00-zz-zz-01",
+            "00-" + "0" * 32 + "-" + "ab" * 8 + "-01",  # all-zero trace id
+            "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero span id
+        ],
+    )
+    def test_malformed_headers_are_dropped(self, header):
+        assert parse_traceparent(header) is None
+
+    def test_remote_parent_continues_the_trace(self, exporter):
+        tid, pid = "ab" * 16, "cd" * 8
+        with get_tracer("t").start_span(
+            "local", traceparent=f"00-{tid}-{pid}-01"
+        ) as span:
+            pass
+        assert span.trace_id == tid
+        assert span.parent_id == pid
+        assert exporter.by_name("local")  # sampled flag honored
+
+    def test_unsampled_remote_parent_propagates_without_recording(
+        self, exporter
+    ):
+        """flags=00 means some upstream hop decided not to sample: this
+        hop must agree (no export) but keep the ids flowing."""
+        tid, pid = "12" * 16, "34" * 8
+        span = get_tracer("t").start_span(
+            "local", traceparent=f"00-{tid}-{pid}-00"
+        )
+        assert span.trace_id == tid
+        header = format_traceparent(span)
+        assert header.endswith("-00")
+        span.end()
+        assert not exporter.by_name("local")
+
+
+class TestSampling:
+    @staticmethod
+    def _ids(n):
+        return [hashlib.sha256(str(i).encode()).hexdigest()[:32]
+                for i in range(n)]
+
+    def test_decision_is_deterministic_per_trace_id(self):
+        for tid in self._ids(64):
+            first = deterministic_sample(tid, 0.3)
+            assert all(
+                deterministic_sample(tid, 0.3) == first for _ in range(5)
+            )
+
+    def test_rate_extremes(self):
+        for tid in self._ids(16):
+            assert deterministic_sample(tid, 1.0)
+            assert not deterministic_sample(tid, 0.0)
+
+    def test_decision_is_monotonic_in_rate(self):
+        """A trace sampled at rate r stays sampled at any higher rate —
+        components configured with different rates still nest correctly."""
+        for tid in self._ids(64):
+            sampled_at = [
+                r for r in (0.1, 0.3, 0.5, 0.9)
+                if deterministic_sample(tid, r)
+            ]
+            assert sampled_at == sorted(sampled_at)
+            if sampled_at:
+                assert deterministic_sample(tid, 1.0)
+
+    def test_observed_rate_tracks_configured_rate(self):
+        ids = self._ids(2000)
+        hit = sum(deterministic_sample(t, 0.25) for t in ids)
+        assert 0.15 < hit / len(ids) < 0.35
+
+    def test_unsampled_local_root_still_carries_a_trace_id(self):
+        exp = InMemoryExporter()
+        set_tracer_provider(TracerProvider(exp, sample_rate=0.0))
+        try:
+            span = get_tracer("t").start_span("root")
+            assert span.trace_id  # X-Request-Id correlation survives
+            span.end()
+            assert not exp.spans
+        finally:
+            set_tracer_provider(TracerProvider())
+
+
+class TestRingBuffer:
+    def test_eviction_is_oldest_first_and_bounded(self):
+        ring = RingBufferExporter(capacity=8)
+        set_tracer_provider(TracerProvider(ring))
+        try:
+            for i in range(50):
+                with get_tracer("t").start_span(f"s{i}"):
+                    pass
+            assert len(ring) == 8
+            assert [s["name"] for s in ring.snapshot()] == [
+                f"s{i}" for i in range(42, 50)
+            ]
+        finally:
+            set_tracer_provider(TracerProvider())
+
+    def test_capacity_floor_is_one(self):
+        ring = RingBufferExporter(capacity=0)
+        assert ring.capacity == 1
+
+
+class TestFlightRecorder:
+    def test_stall_detected_against_rolling_median(self):
+        now = [100.0]
+        fr = FlightRecorder(
+            window=32, stall_factor=8.0, min_samples=4,
+            clock=lambda: now[0],
+        )
+        for _ in range(10):
+            assert not fr.record_step(0.01, fill=0.5)
+        now[0] = 123.0
+        assert fr.record_step(0.5)  # 50x the 10ms median
+        snap = fr.snapshot()
+        assert snap["stalls"] == 1
+        assert snap["last_stall"]["at"] == 123.0
+        assert snap["last_stall"]["factor"] == pytest.approx(50.0)
+        assert snap["step_s"]["max"] == pytest.approx(0.5)
+        assert snap["fill"]["mean"] == pytest.approx(0.5)
+
+    def test_min_samples_guard_spares_compile_steps(self):
+        """The first (compile-dominated) steps never flag, and a warm-up
+        window full of slow steps doesn't flag the fast steps after it."""
+        fr = FlightRecorder(min_samples=4, clock=lambda: 0.0)
+        assert not fr.record_step(30.0)  # jit compile, empty window
+        assert not fr.record_step(10.0)
+        assert not fr.record_step(0.01)
+        assert fr.snapshot()["stalls"] == 0
+
+    def test_window_is_bounded(self):
+        fr = FlightRecorder(window=16, clock=lambda: 0.0)
+        for _ in range(100):
+            fr.record_step(0.01)
+        snap = fr.snapshot()
+        assert snap["steps"] == 100
+        assert snap["window"] == 16
+
+
+def _wait_for(fn, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {fn}")
+
+
+class TestEndToEndFleet:
+    """A real request through a 2-replica fleet yields ONE trace covering
+    gateway routing, queue wait, prefill, and first decode — and the span
+    sum reconstructs TTFT (ISSUE-10 acceptance: within 10%)."""
+
+    def test_one_trace_spans_gateway_to_engine(self, exporter):
+        import jax
+
+        from kubeflow_tpu.models import llama as L
+        from kubeflow_tpu.models.continuous import ContinuousBatcher
+        from kubeflow_tpu.models.gateway import ServingGateway
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.models.server import InferenceServer
+
+        cfg = L.LLAMA_CONFIGS["tiny"]
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        servers = [
+            InferenceServer(
+                ContinuousBatcher(
+                    params, cfg,
+                    gen=GenerationConfig(max_new_tokens=4, eos_id=-1),
+                    slots=2, cache_len=128, prompt_bucket=16,
+                ),
+                port=0,
+            ).start()
+            for _ in range(2)
+        ]
+        gw = ServingGateway(
+            [f"{s.host}:{s.port}" for s in servers], port=0,
+            block_size=16, health_interval_s=0.2,
+        ).start()
+        try:
+            req = urllib.request.Request(
+                f"http://{gw.host}:{gw.port}/v1/completions",
+                data=json.dumps(
+                    {"prompt": [3, 4, 5, 6, 7], "max_tokens": 4}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                body = json.loads(resp.read())
+                req_id = resp.headers["X-Request-Id"]
+            assert body["choices"][0]["tokens"]
+
+            # The root span ends just after the response body is written;
+            # poll until the whole chain has been exported.
+            _wait_for(lambda: exporter.by_name("first_decode"))
+            (gw_root,) = exporter.by_name("gateway.request")
+            (route,) = exporter.by_name("gateway.route")
+            (srv_root,) = exporter.by_name("server.request")
+            (queue,) = exporter.by_name("queue_wait")
+            (prefill,) = exporter.by_name("prefill")
+            (first_decode,) = exporter.by_name("first_decode")
+
+            # One trace, correctly parented across the HTTP hop.
+            chain = [gw_root, route, srv_root, queue, prefill, first_decode]
+            assert {s.trace_id for s in chain} == {gw_root.trace_id}
+            assert route.parent_id == gw_root.span_id
+            assert srv_root.parent_id == route.span_id  # via traceparent
+            assert queue.parent_id == srv_root.span_id
+            assert prefill.parent_id == srv_root.span_id
+            assert req_id == gw_root.trace_id  # client-visible correlation
+            assert route.attributes["endpoint"] in {
+                f"{s.host}:{s.port}" for s in servers
+            }
+
+            # TTFT decomposition: the three phase spans sum to the
+            # submit→first-token wall clock the server measured.
+            (evt,) = [
+                e for e in srv_root.events if e["name"] == "first_token"
+            ]
+            ttft = evt["attributes"]["ttft_s"]
+            span_sum = (
+                queue.duration_s
+                + prefill.duration_s
+                + first_decode.duration_s
+            )
+            assert span_sum == pytest.approx(ttft, rel=0.10, abs=0.005)
+        finally:
+            gw.stop()
+            for s in servers:
+                s.stop()
+
+    def test_client_traceparent_is_continued_by_the_gateway(self, exporter):
+        """A caller that already carries a trace context keeps it: the
+        gateway's root span joins the caller's trace instead of minting a
+        fresh id. Fake replica — only the gateway hop is under test."""
+        from tests.test_gateway import _fleet, _teardown
+
+        gw, replicas = _fleet(2)
+        tid, pid = "ab" * 16, "cd" * 8
+        try:
+            req = urllib.request.Request(
+                f"http://{gw.host}:{gw.port}/v1/completions",
+                data=json.dumps(
+                    {"prompt": [1, 2, 3], "max_tokens": 2}
+                ).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    "traceparent": f"00-{tid}-{pid}-01",
+                },
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                resp.read()
+                assert resp.headers["X-Request-Id"] == tid
+            _wait_for(lambda: exporter.by_name("gateway.request"))
+            (gw_root,) = exporter.by_name("gateway.request")
+            assert gw_root.trace_id == tid
+            assert gw_root.parent_id == pid
+        finally:
+            _teardown(gw, replicas)
 
 
 class TestProfiling:
