@@ -969,11 +969,38 @@ class ExperimentRunner:
         arriving. Accepted requests park; once the pending queue is full,
         every further arrival must shed with a FAST 429 — the shed path
         takes no engine lock — and complete normally after the stall
-        lifts. Shed counter must equal observed 429s exactly."""
+        lifts. Shed counter must equal observed 429s exactly.
+
+        The same storm also exercises the SLO burn-rate engine
+        (observability/slo.py): request outcomes feed an error-ratio
+        objective, and the 100%-shed burst must trip the fast-window
+        burn alert mid-storm while an identical engine fed only the
+        healthy completions stays silent — the telemetry plane's
+        pages-on-overload / silent-when-healthy contract."""
+        from kubeflow_tpu.observability.signals import SignalHub
+        from kubeflow_tpu.observability.slo import Objective, SLOEngine
+
         params = doc["spec"]["injection"].get("params", {})
         depth = int(params.get("queueDepth", 3))
         extras = int(params.get("extraClients", 3))
         budget = float(params.get("shedLatencySeconds", 0.5))
+
+        def slo_pair():
+            # Windows scaled down to the experiment's seconds-long storm
+            # (the production engine uses 60s/300s/1800s); min_events=1
+            # because the deterministic burst is this small by design.
+            hub = SignalHub(window_s=1.0, windows=64)
+            engine = SLOEngine(
+                hub,
+                (Objective("error_ratio", "ratio", "bad_requests",
+                           total_signal="requests", budget=0.05),),
+                fast_windows=(5.0, 25.0), slow_window=60.0,
+                min_events=1,
+            )
+            return hub, engine
+
+        storm_hub, storm_slo = slo_pair()
+        healthy_hub, healthy_slo = slo_pair()
         srv = self.serving_factory(max_queue_depth=depth, slots=1)
         stall = threading.Event()
         real_step = srv.engine._step
@@ -1018,6 +1045,17 @@ class ExperimentRunner:
                     srv.port, {"prompt": [1, 2, 3], "max_tokens": 2},
                 )
                 shed_results.append((code, time.monotonic() - t0))
+                # Feed the storm SLO engine at resolution time: a shed
+                # is a bad request against the error-ratio objective.
+                storm_hub.inc("requests")
+                if code != 200:
+                    storm_hub.inc("bad_requests")
+
+            # Mid-storm evaluation: every arrival in the fast windows
+            # shed, so the error-ratio burn (1.0 / 0.05 = 20) must clear
+            # the fast-burn line in BOTH fast windows and page.
+            storm_report = storm_slo.evaluate()
+            storm_obj = storm_report["objectives"]["error_ratio"]
 
             stall.set()  # stall lifts; parked work must finish normally
             for t in threads:
@@ -1030,21 +1068,37 @@ class ExperimentRunner:
                 len(accepted) == depth + 1
                 and all(code == 200 for code, _ in accepted)
             )
+            # The healthy control sees the same completed traffic minus
+            # the storm: zero bad requests, so its engine must NOT page.
+            for code, _body in accepted:
+                healthy_hub.inc("requests")
+                if code != 200:
+                    healthy_hub.inc("bad_requests")
+            healthy_report = healthy_slo.evaluate()
+            healthy_obj = healthy_report["objectives"]["error_ratio"]
+            slo_tripped = storm_obj["fast_alert"] and storm_obj["breaching"]
+            slo_silent = (not healthy_obj["breaching"]
+                          and not healthy_obj["fast_alert"])
             passed = (all_shed and not slow and all_done
-                      and shed_counter == extras)
+                      and shed_counter == extras
+                      and slo_tripped and slo_silent)
             return ExperimentResult(
                 doc["metadata"]["name"],
                 passed=passed,
                 detail="" if passed else (
                     f"shed={[c for c, _ in shed_results]} slow={slow} "
                     f"accepted={[c for c, _ in accepted]} "
-                    f"counter={shed_counter}/{extras}"
+                    f"counter={shed_counter}/{extras} "
+                    f"slo_tripped={slo_tripped} slo_silent={slo_silent}"
                 ),
                 observations={
                     "shed_counter": shed_counter,
                     "max_shed_latency_s": round(
                         max(lat for _, lat in shed_results), 4
                     ) if shed_results else None,
+                    "slo_storm_burn_5s": storm_obj["burn"]["5s"],
+                    "slo_storm_breaches": storm_obj["breaches_total"],
+                    "slo_healthy_breaches": healthy_obj["breaches_total"],
                 },
             )
         finally:

@@ -43,6 +43,10 @@ STATS_PARITY = {
     "tpu_serving_prefix_cache_evictions_total": "evictions",
     "tpu_serving_prefix_cached_blocks": "cached_blocks",
     "tpu_engine_step_stall_total": "engine_step_stalls",
+    "tpu_gateway_requests_total": "requests",
+    "tpu_gateway_reroutes_total": "reroutes",
+    "tpu_gateway_shed_total": "shed",
+    "tpu_gateway_replicas": "ring_size",
 }
 
 
@@ -246,15 +250,35 @@ class Metrics:
             "503/429/connect failure (bounded by the re-route budget)",
             registry=self.registry,
         )
+        # The tenant label is bounded by the gateway's top-K + "other"
+        # bucketing (signals.TenantBuckets), never raw tenant names.
         self.gateway_shed_total = Counter(
             "tpu_gateway_shed_total",
             "Requests shed by the gateway's tenant-fair admission when "
             "the whole fleet reported overload",
+            ["tenant"],
             registry=self.registry,
         )
         self.gateway_replicas = Gauge(
             "tpu_gateway_replicas",
             "Replicas currently routable (present in the hash ring)",
+            registry=self.registry,
+        )
+        # -- SLO burn-rate engine (observability/slo.py) -------------------
+        # Deliberately outside STATS_PARITY: these are the telemetry
+        # plane's own output, surfaced as JSON under /debug/slo rather
+        # than the servers' /stats contract.
+        self.slo_burn_rate = Gauge(
+            "tpu_slo_burn_rate",
+            "Error-budget burn rate per SLO objective and window "
+            "(1.0 = burning exactly the budget)",
+            ["objective", "window"],
+            registry=self.registry,
+        )
+        self.slo_breach_total = Counter(
+            "tpu_slo_breach_total",
+            "SLO breach alerts latched by the burn-rate engine",
+            ["objective"],
             registry=self.registry,
         )
 

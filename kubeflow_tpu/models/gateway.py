@@ -60,6 +60,7 @@ import numpy as np
 
 from kubeflow_tpu.models.server import BodyTooLarge, _client_gone, _read_body
 from kubeflow_tpu.observability import tracing
+from kubeflow_tpu.observability.signals import FleetTelemetry, TenantBuckets
 
 AFFINITY_MODES = ("prefix", "random")
 
@@ -242,7 +243,9 @@ class ServingGateway:
                  upstream_timeout_s: float = 120.0,
                  max_inflight: Optional[int] = None,
                  max_body_bytes: int = 4 << 20,
-                 metrics=None, replica_source=None):
+                 metrics=None, replica_source=None,
+                 telemetry: Optional[FleetTelemetry] = None,
+                 tenant_top_k: int = 8):
         if affinity not in AFFINITY_MODES:
             raise ValueError(
                 f"affinity must be one of {AFFINITY_MODES}, got {affinity!r}"
@@ -263,6 +266,21 @@ class ServingGateway:
         self.max_body_bytes = max_body_bytes
         self.metrics = metrics
         self.replica_source = replica_source
+        # Fleet telemetry plane (observability/signals.py): None unless a
+        # FleetTelemetry is passed in or KUBEFLOW_TPU_SIGNALS_ENABLE opts
+        # in — every feed below checks `is not None` first, so the
+        # request hot path does zero telemetry work when disabled.
+        self.telemetry = (
+            telemetry if telemetry is not None
+            else FleetTelemetry.from_env(metrics=metrics)
+        )
+        # The shed counter's tenant label stays bounded even when the
+        # telemetry plane is off; share its buckets when it is on so the
+        # Prometheus label and the per-tenant series always agree.
+        self._tenant_buckets = (
+            self.telemetry.tenants if self.telemetry is not None
+            else TenantBuckets(tenant_top_k)
+        )
         self._lock = threading.Lock()
         self._ring = HashRing(vnodes=vnodes, seed=hash_seed)
         self._router = PrefixRouter(block_size=block_size)
@@ -378,6 +396,16 @@ class ServingGateway:
                 self._mirror_ring_locked()
             if rep.healthy:
                 rep.stats = self._scrape_stats(rep)
+                if self.telemetry is not None:
+                    self.telemetry.ingest_replica(rep.endpoint, rep.stats)
+        if self.telemetry is not None:
+            with self._lock:
+                ring_size = len(self._ring)
+            self.telemetry.ingest_ring(ring_size)
+            # Burn rates ride the probe cadence: cheap dict math over the
+            # signal rings, and the latch/metric/span emission lives in
+            # the engine, not here.
+            self.telemetry.evaluate_slo()
 
     def _probe(self, rep: _Replica) -> str:
         try:
@@ -415,9 +443,16 @@ class ServingGateway:
         except (OSError, ValueError):
             return rep.stats  # keep the last good scrape
         keep = {k: stats.get(k) for k in
-                ("active_slots", "queued", "slots", "served")}
-        if "prefix_cache" in stats:
-            keep["prefix_cache"] = stats["prefix_cache"]
+                ("active_slots", "queued", "slots", "served",
+                 "requests_shed", "tokens_generated",
+                 "engine_step_stalls")}
+        # Optional sub-dicts the telemetry plane turns into per-replica
+        # gauges (queue-wait/inter-token percentiles, ragged fill,
+        # prefix hit ratio); absent on engines without the feature.
+        for extra in ("prefix_cache", "queue_wait_s", "inter_token_s",
+                      "ragged", "flight"):
+            if extra in stats:
+                keep[extra] = stats[extra]
         return keep
 
     # -- admission (tenant-fair shed) --------------------------------------
@@ -448,8 +483,13 @@ class ServingGateway:
                     # overshoot is bounded by one share per tenant), so a
                     # noisy neighbor can never starve a light one.
                     self._shed += 1
+                    bucket = self._tenant_buckets.bucket(tenant)
                     if self.metrics is not None:
-                        self.metrics.gateway_shed_total.inc()
+                        self.metrics.gateway_shed_total.labels(
+                            tenant=bucket
+                        ).inc()
+                    if self.telemetry is not None:
+                        self.telemetry.observe_shed(tenant)
                     raise GatewayOverloadedError(
                         f"fleet at capacity ({cap} in flight); tenant "
                         f"{tenant!r} is over its fair share ({share})"
@@ -490,6 +530,8 @@ class ServingGateway:
             self._reroutes += 1
         if self.metrics is not None:
             self.metrics.gateway_reroutes_total.inc()
+        if self.telemetry is not None:
+            self.telemetry.observe_reroute()
 
     def _count_request(self) -> None:
         with self._lock:
@@ -585,6 +627,17 @@ class ServingGateway:
                     self._json(200, {
                         "traces": ring.snapshot() if ring else [],
                     })
+                elif self.path == "/debug/signals":
+                    tel = gw.telemetry
+                    self._json(200, tel.snapshot() if tel is not None
+                               else {"enabled": False})
+                elif self.path == "/debug/slo":
+                    tel = gw.telemetry
+                    if tel is None:
+                        self._json(200, {"enabled": False})
+                    else:
+                        self._json(200, {"enabled": True,
+                                         **tel.evaluate_slo()})
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -637,11 +690,12 @@ class ServingGateway:
                     self._json(429, {"error": str(err)}, retry_after=1)
                     return
                 try:
-                    self._route(req, arrival)
+                    self._route(req, arrival, tenant)
                 finally:
                     gw._release(tenant)
 
-            def _route(self, req: dict, arrival: float) -> None:
+            def _route(self, req: dict, arrival: float,
+                       tenant: str) -> None:
                 key = gw._route_key(req.get("prompt"))
                 candidates = gw._candidates(key)
                 # The routing decision is its own span: affinity mode,
@@ -651,14 +705,18 @@ class ServingGateway:
                     "gateway.route", affinity=gw.affinity,
                     candidates=len(candidates),
                 ) as span:
-                    self._route_span(req, arrival, candidates, span)
+                    self._route_span(req, arrival, candidates, span,
+                                     tenant)
 
             def _route_span(self, req: dict, arrival: float,
-                            candidates: list, span) -> None:
+                            candidates: list, span,
+                            tenant: str) -> None:
                 if not candidates:
                     span.record_error(
                         RuntimeError("no healthy replicas")
                     )
+                    if gw.telemetry is not None:
+                        gw.telemetry.observe_request(tenant, ok=False)
                     self._json(503, {"error": "no healthy replicas"},
                                retry_after=1)
                     return
@@ -682,17 +740,24 @@ class ServingGateway:
                         # forward only what gateway time left of it.
                         remaining = deadline_s - (time.monotonic() - arrival)
                         if remaining <= 0:
+                            if gw.telemetry is not None:
+                                gw.telemetry.observe_request(
+                                    tenant, ok=False
+                                )
                             self._json(504, {
                                 "error": "deadline expired at the gateway",
                                 "partial_tokens": [],
                             })
                             return
                         fwd["deadline_s"] = remaining
-                    outcome, last = self._proxy(endpoint, fwd, stream)
+                    outcome, last = self._proxy(endpoint, fwd, stream,
+                                                arrival, tenant)
                     if outcome == "done":
                         return
                 # Budget exhausted: every candidate refused or was down.
                 gw._count_failed()
+                if gw.telemetry is not None:
+                    gw.telemetry.observe_request(tenant, ok=False)
                 code, detail = last if last else (503, "replicas unreachable")
                 span.record_error(RuntimeError(
                     f"re-route budget exhausted: {detail}"
@@ -702,7 +767,8 @@ class ServingGateway:
                                      f"({gw.reroute_budget}): {detail}"},
                            retry_after=1)
 
-            def _proxy(self, endpoint: str, req: dict, stream: bool):
+            def _proxy(self, endpoint: str, req: dict, stream: bool,
+                       arrival: float, tenant: str):
                 """One attempt against one replica. Returns
                 ("done", None) when a response (or a terminal error) was
                 relayed, ("retry", (code, detail)) when the replica
@@ -755,8 +821,16 @@ class ServingGateway:
                         body = resp.read()
                         conn.close()
                         self._json(resp.status, json.loads(body))
+                        if gw.telemetry is not None:
+                            # Non-stream responses have no first-token
+                            # boundary: e2e only, so the ttft_s series
+                            # stays purely relay-measured.
+                            gw.telemetry.observe_request(
+                                tenant, ok=resp.status == 200,
+                                e2e_s=time.monotonic() - arrival,
+                            )
                         return "done", None
-                    return self._relay_stream(conn, resp)
+                    return self._relay_stream(conn, resp, arrival, tenant)
                 except (OSError, ValueError):
                     # Replica died mid-body before ANY byte was relayed
                     # client-side (non-stream read) — safe to re-route;
@@ -766,13 +840,23 @@ class ServingGateway:
                         return "retry", (503, f"{endpoint} died mid-read")
                     return "done", None
 
-            def _relay_stream(self, conn, resp):
+            def _relay_stream(self, conn, resp, arrival: float,
+                              tenant: str):
                 """SSE passthrough: relay lines as they arrive, peek for
                 the client's FIN before each write (closing the upstream
                 connection is the cancellation signal the replica's own
-                _client_gone converts into an engine-side cancel)."""
+                _client_gone converts into an engine-side cancel).
+
+                This is also where the telemetry plane's latencies come
+                from: TTFT = arrival → first relayed data line and the
+                gaps between data lines, measured at the point the bytes
+                leave for the client — the fleet numbers are what a
+                client actually experienced through the gateway."""
                 started = False
                 finished = False
+                ttft = None
+                last_data = None
+                gaps: list = []
                 try:
                     while True:
                         line = resp.fp.readline()
@@ -780,6 +864,12 @@ class ServingGateway:
                             break
                         if _client_gone(self.connection):
                             conn.close()  # upstream FIN → replica cancels
+                            if finished:
+                                # [DONE] already relayed: this is normal
+                                # client teardown, not a cancel — the
+                                # request completed.
+                                self._observe_stream(tenant, True, ttft,
+                                                     gaps, arrival)
                             return "done", None
                         if not started:
                             self.send_response(resp.status)
@@ -795,8 +885,22 @@ class ServingGateway:
                         self.wfile.write(line)
                         if line == b"data: [DONE]\n":
                             finished = True
+                        elif line.startswith(b"data:"):
+                            now_t = time.monotonic()
+                            if ttft is None:
+                                ttft = now_t - arrival
+                            elif last_data is not None:
+                                gaps.append(now_t - last_data)
+                            last_data = now_t
                         if line == b"\n":
                             self.wfile.flush()
+                            if finished:
+                                # Terminator relayed: the stream is
+                                # complete. Don't wait for upstream EOF —
+                                # a client that hangs up right after
+                                # [DONE] would race _client_gone and
+                                # lose the completed request.
+                                break
                     conn.close()
                     if not started:
                         # EOF before the first event: nothing reached the
@@ -806,18 +910,35 @@ class ServingGateway:
                         # A killed replica's socket often closes with a
                         # clean FIN, not a reset: EOF after bytes flowed
                         # but before [DONE] is the same mid-stream loss.
+                        self._observe_stream(tenant, False, ttft, gaps,
+                                             arrival)
                         return self._stream_lost()
+                    self._observe_stream(tenant, True, ttft, gaps, arrival)
                     return "done", None
                 except (BrokenPipeError, ConnectionResetError):
                     conn.close()  # client hung up; cancel upstream
+                    if finished:
+                        # The hangup came after the terminator: complete.
+                        self._observe_stream(tenant, True, ttft, gaps,
+                                             arrival)
                     return "done", None
                 except OSError:
                     conn.close()
                     if started:
+                        self._observe_stream(tenant, False, ttft, gaps,
+                                             arrival)
                         return self._stream_lost()
                     # Nothing reached the client: the re-route walk may
                     # continue (budget exhaustion counts the failure).
                     return "retry", (503, "replica died before first byte")
+
+            def _observe_stream(self, tenant: str, ok: bool, ttft,
+                                gaps: list, arrival: float) -> None:
+                if gw.telemetry is not None:
+                    gw.telemetry.observe_request(
+                        tenant, ok=ok, ttft_s=ttft, inter_token=gaps,
+                        e2e_s=time.monotonic() - arrival,
+                    )
 
             def _stream_lost(self):
                 """UPSTREAM loss mid-stream: bytes already reached the

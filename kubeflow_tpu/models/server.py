@@ -78,7 +78,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from kubeflow_tpu.observability import tracing
-from kubeflow_tpu.observability.flight import FlightRecorder
+from kubeflow_tpu.observability.flight import (
+    FlightRecorder,
+    stall_profiler_from_env,
+)
 
 
 def _percentiles(window) -> dict:
@@ -308,8 +311,15 @@ class InferenceServer:
         # throughput. All read under the lock by /stats.
         self._submit_ts: dict[int, float] = {}
         self._first_ts: dict[int, float] = {}
+        self._last_tok_ts: dict[int, float] = {}
         self._ttft = collections.deque(maxlen=256)
         self._e2e = collections.deque(maxlen=256)
+        # Telemetry-plane inputs (the gateway scrapes these off /stats):
+        # submit→batcher-pickup wait and the gap between consecutive
+        # tokens of one stream — the queue_wait_p95 / inter_token_p95
+        # SLO objectives replica-side.
+        self._queue_wait = collections.deque(maxlen=256)
+        self._itl = collections.deque(maxlen=256)
         self._tokens_out = 0
         self._started_at = None  # stamped in start(): uptime = serving time
         # Prometheus Counters only inc(): mirror the engine's monotonic
@@ -328,6 +338,12 @@ class InferenceServer:
         self.flight = FlightRecorder(
             clock=getattr(self.engine, "_clock", None)
         )
+        # Stall→profile capture: armed only when the env names a log dir
+        # (see flight.StallProfiler); the hook fires outside the
+        # recorder's lock, so the drive loop never waits on jax.profiler.
+        self._stall_profiler = stall_profiler_from_env()
+        if self._stall_profiler is not None:
+            self.flight.on_stall = self._stall_profiler.on_stall
         self._httpd = ThreadingHTTPServer((host, port), self._handler_class())
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
@@ -352,7 +368,11 @@ class InferenceServer:
         """Batcher pickup (engine thread): the queue-wait phase ends here
         and the prefill phase begins — the span boundary that lets TTFT
         decompose into queue_wait + prefill + first_decode."""
-        self._admit_ts[rid] = time.monotonic()
+        now = time.monotonic()
+        self._admit_ts[rid] = now
+        t0 = self._submit_ts.get(rid)
+        if t0 is not None:
+            self._queue_wait.append(now - t0)
         spans = self._req_spans.get(rid)
         if spans is None:
             return
@@ -379,6 +399,12 @@ class InferenceServer:
 
     def _on_token(self, rid: int, token: int) -> None:
         self._tokens_out += 1
+        if rid in self._submit_ts:
+            now_t = time.monotonic()
+            prev = self._last_tok_ts.get(rid)
+            if prev is not None:
+                self._itl.append(now_t - prev)
+            self._last_tok_ts[rid] = now_t
         if rid not in self._first_ts and rid in self._submit_ts:
             now = time.monotonic()
             self._first_ts[rid] = now
@@ -414,6 +440,7 @@ class InferenceServer:
         t0 = self._submit_ts.pop(rid, None)
         self._first_ts.pop(rid, None)
         self._admit_ts.pop(rid, None)
+        self._last_tok_ts.pop(rid, None)
         if t0 is not None:
             self._e2e.append(time.monotonic() - t0)
         self._end_request_spans(rid)
@@ -436,6 +463,7 @@ class InferenceServer:
         self._submit_ts.pop(rid, None)
         self._first_ts.pop(rid, None)
         self._admit_ts.pop(rid, None)
+        self._last_tok_ts.pop(rid, None)
         self._end_request_spans(rid, error=reason)
         q = self._queues.get(rid)
         if q is not None:
@@ -716,6 +744,7 @@ class InferenceServer:
             self._submit_ts.pop(rid, None)
             self._first_ts.pop(rid, None)
             self._admit_ts.pop(rid, None)
+            self._last_tok_ts.pop(rid, None)
             self._end_request_spans(rid)
 
     def _decode_prompt(self, prompt) -> list[int]:
@@ -844,6 +873,8 @@ class InferenceServer:
                             }
                         ttft = list(server._ttft)
                         e2e = list(server._e2e)
+                        queue_wait = list(server._queue_wait)
+                        itl = list(server._itl)
                         tokens_out = server._tokens_out
                         cancelled = server._cancelled
                         deadline_expired = server._deadline_expired
@@ -854,6 +885,10 @@ class InferenceServer:
                         if server._started_at is not None else 0.0
                     )
                     fl = server.flight.snapshot()
+                    if server._stall_profiler is not None:
+                        fl["stall_profiles"] = (
+                            server._stall_profiler.summary()
+                        )
                     self._json(200, {
                         "active_slots": active,
                         "queued": depth,
@@ -869,6 +904,11 @@ class InferenceServer:
                         ) if up > 0 else 0.0,
                         "ttft_s": _percentiles(ttft),
                         "e2e_latency_s": _percentiles(e2e),
+                        # Telemetry-plane inputs: the gateway's
+                        # FleetTelemetry scrape turns these into the
+                        # queue_wait_p95 SLO gauge per replica.
+                        "queue_wait_s": _percentiles(queue_wait),
+                        "inter_token_s": _percentiles(itl),
                         # Lifecycle counters (the tentpole's observables):
                         "requests_shed": shed,
                         "requests_cancelled": cancelled,

@@ -12,10 +12,18 @@ the rolling median of the current window, once ``min_samples`` steps have
 been observed (the guard keeps the first JAX compilations — orders of
 magnitude slower than steady-state steps — from flagging every warm step
 after them, and from being flagged against an empty window).
+
+A stall can also trigger an on-device profile capture: set ``on_stall``
+to a callback (the serving layer wires :class:`StallProfiler` in when
+``KUBEFLOW_TPU_STALL_PROFILE_DIR`` is set) and the recorder invokes it
+with the stall ledger entry — outside the recorder lock, so a slow
+callback can never block the engine's next ``record_step``.
 """
 
 from __future__ import annotations
 
+import os
+import pathlib
 import statistics
 import threading
 import time
@@ -44,6 +52,9 @@ class FlightRecorder:
         self.steps = 0
         self.stalls = 0
         self.last_stall: Optional[dict] = None
+        # Optional stall hook (e.g. StallProfiler.on_stall); called with
+        # a copy of the ledger entry, outside the recorder lock.
+        self.on_stall: Optional[Callable[[dict], object]] = None
 
     def record_step(
         self, duration_s: float, fill: Optional[float] = None
@@ -68,7 +79,10 @@ class FlightRecorder:
             if fill is not None:
                 self._fills.append(fill)
             self.steps += 1
-            return stalled
+            info = dict(self.last_stall) if stalled else None
+        if stalled and self.on_stall is not None:
+            self.on_stall(info)
+        return stalled
 
     def snapshot(self) -> dict:
         """Point-in-time view for ``/stats``: recent step-time distribution,
@@ -100,3 +114,135 @@ class FlightRecorder:
                     ),
                 },
             }
+
+
+class StallProfiler:
+    """Turns a stall event into a bounded XProf artifact.
+
+    Wired as ``FlightRecorder.on_stall``: on a stall it spawns a daemon
+    thread that runs ``observability.profiling.trace`` for
+    ``duration_s`` seconds, capturing the steps *after* the stall (the
+    stall itself already happened; what matters is whether the engine is
+    still degraded). Bounded three ways: at most one capture in flight,
+    at most one per ``cooldown_s``, each ``duration_s`` long. Skipped
+    stalls are counted, never queued.
+
+    Lives here rather than profiling.py so the import chain stays
+    jax-free (the gateway imports server imports flight); jax is only
+    touched inside the capture thread, and only when a stall actually
+    fires with profiling enabled. ``trace_fn`` is injectable for tests.
+    """
+
+    def __init__(self, log_dir, *, cooldown_s: float = 300.0,
+                 duration_s: float = 2.0,
+                 clock: Optional[Callable[[], float]] = None,
+                 trace_fn: Optional[Callable] = None):
+        if cooldown_s < 0:
+            raise ValueError(f"cooldown_s must be >= 0, got {cooldown_s}")
+        if duration_s <= 0:
+            raise ValueError(f"duration_s must be > 0, got {duration_s}")
+        self.log_dir = pathlib.Path(log_dir)
+        self.cooldown_s = float(cooldown_s)
+        self.duration_s = float(duration_s)
+        self.clock = clock or time.monotonic
+        self._trace_fn = trace_fn
+        self._lock = threading.Lock()
+        self._active = False
+        self._last_start: Optional[float] = None
+        self._seq = 0
+        self.skipped = 0
+        self.captures: list = []
+        self.last_error: Optional[str] = None
+
+    def on_stall(self, info: Optional[dict]) -> bool:
+        """FlightRecorder callback; returns True when a capture starts.
+        Never raises — the engine drive loop is above this call."""
+        with self._lock:
+            now = self.clock()
+            in_cooldown = (
+                self._last_start is not None
+                and now - self._last_start < self.cooldown_s
+            )
+            if self._active or in_cooldown:
+                self.skipped += 1
+                return False
+            self._active = True
+            self._last_start = now
+            self._seq += 1
+            seq = self._seq
+        threading.Thread(
+            target=self._capture,
+            args=(dict(info or {}), seq),
+            name=f"stall-profile-{seq}",
+            daemon=True,
+        ).start()
+        return True
+
+    def _capture(self, info: dict, seq: int) -> None:
+        try:
+            trace_fn = self._trace_fn
+            if trace_fn is None:
+                from kubeflow_tpu.observability.profiling import trace
+                trace_fn = trace
+            with trace_fn(self.log_dir, f"stall-{seq:03d}") as path:
+                time.sleep(self.duration_s)
+            with self._lock:
+                self.captures.append({
+                    "seq": seq,
+                    "path": str(path),
+                    "stall": info,
+                })
+        except Exception as exc:  # profiling must never hurt serving
+            with self._lock:
+                self.last_error = f"{type(exc).__name__}: {exc}"
+        finally:
+            with self._lock:
+                self._active = False
+
+    def summary(self) -> dict:
+        """Surfaced under /stats next to the flight recorder's ledger."""
+        with self._lock:
+            return {
+                "captures": len(self.captures),
+                "skipped": self.skipped,
+                "last": dict(self.captures[-1]) if self.captures else None,
+                "last_error": self.last_error,
+                "cooldown_s": self.cooldown_s,
+            }
+
+
+def stall_profiler_from_env(
+    clock: Optional[Callable[[], float]] = None,
+) -> Optional[StallProfiler]:
+    """None unless KUBEFLOW_TPU_STALL_PROFILE_DIR is set (capture stays
+    off by default). Raises on garbage knob values."""
+    from kubeflow_tpu.webhook.tpu_env import (
+        KUBEFLOW_TPU_STALL_PROFILE_COOLDOWN_S,
+        KUBEFLOW_TPU_STALL_PROFILE_DIR,
+        KUBEFLOW_TPU_STALL_PROFILE_SECONDS,
+    )
+
+    log_dir = os.environ.get(KUBEFLOW_TPU_STALL_PROFILE_DIR, "").strip()
+    if not log_dir:
+        return None
+
+    def _positive(name, default, minimum):
+        value = os.environ.get(name, "").strip()
+        if not value:
+            return default
+        try:
+            got = float(value)
+        except ValueError:
+            got = minimum - 1
+        if got < minimum:
+            raise ValueError(f"{name}={value!r}: want a number >= {minimum}")
+        return got
+
+    return StallProfiler(
+        log_dir,
+        cooldown_s=_positive(
+            KUBEFLOW_TPU_STALL_PROFILE_COOLDOWN_S, 300.0, 0
+        ),
+        duration_s=_positive(KUBEFLOW_TPU_STALL_PROFILE_SECONDS, 2.0, 0.001),
+        clock=clock,
+    )

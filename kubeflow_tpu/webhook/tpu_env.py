@@ -70,6 +70,26 @@ KUBEFLOW_TPU_COMPILE_CACHE_DIR = "KUBEFLOW_TPU_COMPILE_CACHE_DIR"
 KUBEFLOW_TPU_TRACE_EXPORT = "KUBEFLOW_TPU_TRACE_EXPORT"
 KUBEFLOW_TPU_TRACE_SAMPLE = "KUBEFLOW_TPU_TRACE_SAMPLE"
 KUBEFLOW_TPU_TRACE_RING = "KUBEFLOW_TPU_TRACE_RING"
+# Fleet telemetry plane (observability/signals.py signals_from_env): the
+# gateway's windowed-signal aggregator behind /debug/signals; a hot-path
+# no-op unless SIGNALS_ENABLE opts in.
+KUBEFLOW_TPU_SIGNALS_ENABLE = "KUBEFLOW_TPU_SIGNALS_ENABLE"
+KUBEFLOW_TPU_SIGNALS_WINDOW_S = "KUBEFLOW_TPU_SIGNALS_WINDOW_S"
+KUBEFLOW_TPU_SIGNALS_WINDOWS = "KUBEFLOW_TPU_SIGNALS_WINDOWS"
+KUBEFLOW_TPU_SIGNALS_TENANTS = "KUBEFLOW_TPU_SIGNALS_TENANTS"
+# SLO burn-rate engine (observability/slo.py slo_from_env): objective
+# thresholds and burn alert lines over the telemetry plane's signals.
+KUBEFLOW_TPU_SLO_TTFT_P95_MS = "KUBEFLOW_TPU_SLO_TTFT_P95_MS"
+KUBEFLOW_TPU_SLO_INTER_TOKEN_P95_MS = "KUBEFLOW_TPU_SLO_INTER_TOKEN_P95_MS"
+KUBEFLOW_TPU_SLO_QUEUE_WAIT_P95_MS = "KUBEFLOW_TPU_SLO_QUEUE_WAIT_P95_MS"
+KUBEFLOW_TPU_SLO_ERROR_BUDGET = "KUBEFLOW_TPU_SLO_ERROR_BUDGET"
+KUBEFLOW_TPU_SLO_FAST_BURN = "KUBEFLOW_TPU_SLO_FAST_BURN"
+KUBEFLOW_TPU_SLO_SLOW_BURN = "KUBEFLOW_TPU_SLO_SLOW_BURN"
+# Stall->profile capture (observability/flight.py stall_profiler_from_env):
+# setting the dir arms a bounded jax.profiler capture on engine stalls.
+KUBEFLOW_TPU_STALL_PROFILE_DIR = "KUBEFLOW_TPU_STALL_PROFILE_DIR"
+KUBEFLOW_TPU_STALL_PROFILE_COOLDOWN_S = "KUBEFLOW_TPU_STALL_PROFILE_COOLDOWN_S"
+KUBEFLOW_TPU_STALL_PROFILE_SECONDS = "KUBEFLOW_TPU_STALL_PROFILE_SECONDS"
 
 # name -> who produces it and from what. Annotation-projected env names are
 # defined next to their annotations in kubeflow_tpu/api/annotations.py and
@@ -132,6 +152,39 @@ ENV_CONTRACT: dict = {
     KUBEFLOW_TPU_TRACE_RING: "operator-set: capacity of the in-memory span "
     "ring buffer behind the serving components' /debug/traces endpoint "
     "(default 512 spans, oldest evicted first)",
+    KUBEFLOW_TPU_SIGNALS_ENABLE: "operator-set on the gateway container: "
+    "1/true builds the FleetTelemetry signal plane (windowed fleet series, "
+    "/debug/signals + /debug/slo, SLO burn-rate evaluation each probe "
+    "pass); unset/0 keeps the gateway hot path telemetry-free",
+    KUBEFLOW_TPU_SIGNALS_WINDOW_S: "operator-set: width of one aligned "
+    "telemetry window in seconds (default 10)",
+    KUBEFLOW_TPU_SIGNALS_WINDOWS: "operator-set: ring length in windows "
+    "(default 180 — the horizon must cover the SLO engine's 30m slow "
+    "window)",
+    KUBEFLOW_TPU_SIGNALS_TENANTS: "operator-set: per-tenant breakdown "
+    "cardinality — the first K distinct tenants get their own series and "
+    "label, the rest fold into 'other' (default 8)",
+    KUBEFLOW_TPU_SLO_TTFT_P95_MS: "operator-set: TTFT p95 objective "
+    "threshold in milliseconds (default 500)",
+    KUBEFLOW_TPU_SLO_INTER_TOKEN_P95_MS: "operator-set: inter-token p95 "
+    "objective threshold in milliseconds (default 200)",
+    KUBEFLOW_TPU_SLO_QUEUE_WAIT_P95_MS: "operator-set: per-replica "
+    "queue-wait p95 objective threshold in milliseconds (default 250)",
+    KUBEFLOW_TPU_SLO_ERROR_BUDGET: "operator-set: allowed bad fraction "
+    "shared by the stock objectives, in (0, 1] (default 0.05)",
+    KUBEFLOW_TPU_SLO_FAST_BURN: "operator-set: burn rate that must hold "
+    "in BOTH fast windows (1m and 5m) to page (default 14.4)",
+    KUBEFLOW_TPU_SLO_SLOW_BURN: "operator-set: burn rate over the 30m "
+    "slow window that pages on its own (default 2.0)",
+    KUBEFLOW_TPU_STALL_PROFILE_DIR: "operator-set on the serving "
+    "container: directory for stall-triggered jax.profiler captures; "
+    "setting it wires observability/flight.py's StallProfiler into the "
+    "flight recorder (unset = no capture, the default)",
+    KUBEFLOW_TPU_STALL_PROFILE_COOLDOWN_S: "operator-set: minimum seconds "
+    "between stall captures (default 300; extra stalls are counted as "
+    "skipped, never queued)",
+    KUBEFLOW_TPU_STALL_PROFILE_SECONDS: "operator-set: duration of each "
+    "stall-triggered profile capture (default 2.0)",
     ann.QUANT_ENV_NAME: "webhook: tpu-quantization annotation",
     ann.PROFILING_ENV_NAME: "webhook: tpu-profiling-port annotation",
     ann.SERVING_ENV_NAME: "webhook: tpu-serving-port annotation",

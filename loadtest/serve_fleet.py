@@ -32,6 +32,13 @@ A separate churn phase then proves elasticity on a live fleet: a third
 replica joins mid-run and a drained replica leaves mid-run, with zero
 failed (non-re-routed) requests end to end.
 
+Each measured arm also runs the fleet telemetry plane
+(``observability/signals.py``) and queries it over HTTP: the run gates
+on ``/debug/signals`` TTFT p95 agreeing with the clients' own stopwatch
+(±15%, small absolute floor) and on ``/debug/slo`` reporting ZERO
+breaches for a healthy fleet — the SLO gate. Both summaries are stamped
+into the artifact.
+
 The artifact (default SERVE_r07_fleet.json, written atomically) records
 both arms; the win condition is affinity throughput ≥ 1.2× random at a
 p95 TTFT no worse than random's, with zero churn failures.
@@ -234,11 +241,42 @@ def _prefix_totals(servers) -> dict:
     return {"hits": hits, "misses": misses, "evictions": evictions}
 
 
+def _debug_json(gw, path: str) -> dict:
+    """GET a gateway /debug endpoint — over HTTP on purpose, so the run
+    exercises the JSON surface an operator (or the autoscaler) uses, not
+    the in-process objects."""
+    conn = http.client.HTTPConnection(gw.host, gw.port, timeout=10)
+    try:
+        conn.request("GET", path)
+        return json.loads(conn.getresponse().read())
+    finally:
+        conn.close()
+
+
+def _build_telemetry():
+    """Telemetry plane for one measured arm. Objectives are generous
+    (the SLO gate asserts a HEALTHY run is silent, not that a tiny CPU
+    model is fast); the window ring still spans the 30m slow window."""
+    from kubeflow_tpu.observability.signals import (
+        FleetTelemetry,
+        SignalsConfig,
+    )
+    from kubeflow_tpu.observability.slo import default_objectives
+
+    return FleetTelemetry(
+        SignalsConfig(window_s=5.0, windows=360),
+        objectives=default_objectives(
+            ttft_p95_s=5.0, inter_token_p95_s=2.0, queue_wait_p95_s=5.0,
+        ),
+    )
+
+
 def run_arm(affinity: str, *, replicas: int, tenants: int, rounds: int,
             warm_chain_blocks: int, warmup_rounds: int = 2) -> dict:
     from kubeflow_tpu.models.gateway import ServingGateway
 
     servers, cfg = _build_replicas(replicas, warm_chain_blocks)
+    telemetry = _build_telemetry()
     gw = ServingGateway(
         [f"{s.host}:{s.port}" for s in servers], port=0,
         affinity=affinity, block_size=BLOCK_SIZE,
@@ -254,6 +292,11 @@ def run_arm(affinity: str, *, replicas: int, tenants: int, rounds: int,
             bad = [d for ok, _, d in sink if not ok]
             if bad:
                 raise RuntimeError(f"warm-up failures: {bad}")
+        # Attach the telemetry plane only now: its series must cover
+        # exactly the measured rounds, or cold warm-up TTFTs would skew
+        # the p95 the agreement gate compares against the clients'.
+        gw.telemetry = telemetry
+        gw._tenant_buckets = telemetry.tenants
         before = _prefix_totals(servers)
         outcomes: list = []
         t0 = time.perf_counter()
@@ -264,11 +307,27 @@ def run_arm(affinity: str, *, replicas: int, tenants: int, rounds: int,
         after = _prefix_totals(servers)
         gw.probe_once()  # final scrape → gateway-side aggregate view
         stats = gw.stats()
+        signals = _debug_json(gw, "/debug/signals")
+        slo = _debug_json(gw, "/debug/slo")
         failures = [d for ok, _, d in outcomes if not ok]
         ttfts = [ttft for ok, ttft, _ in outcomes if ok]
         completed = len(ttfts)
         hits = after["hits"] - before["hits"]
         misses = after["misses"] - before["misses"]
+        # Telemetry-plane agreement: the gateway-measured TTFT p95 (the
+        # autoscaler's input) vs the clients' own stopwatch, 15% with a
+        # small absolute floor for loopback-scale jitter on tiny TTFTs.
+        client_p95_ms = _p95_ms(ttfts) if ttfts else None
+        tel_p95_s = (signals.get("fleet", {}).get("ttft_s") or {}).get("p95")
+        tel_p95_ms = round(tel_p95_s * 1e3, 2) if tel_p95_s else None
+        agrees = (
+            client_p95_ms is not None and tel_p95_ms is not None
+            and abs(tel_p95_ms - client_p95_ms)
+            <= max(0.15 * client_p95_ms, 25.0)
+        )
+        breaches = sum(
+            o["breaches_total"] for o in slo.get("objectives", {}).values()
+        )
         return {
             "routing": affinity,
             "requests_completed": completed,
@@ -289,6 +348,19 @@ def run_arm(affinity: str, *, replicas: int, tenants: int, rounds: int,
                 "shed": stats["shed"],
                 "failed": stats["failed"],
                 "fleet_prefix_cache": stats.get("fleet_prefix_cache"),
+            },
+            # Telemetry plane vs client ground truth + the SLO verdict
+            # (satellite: stamped into SERVE_*.json; smoke gates on it).
+            "signals": {
+                "ttft_p95_ms": tel_p95_ms,
+                "client_p95_ttft_ms": client_p95_ms,
+                "agrees_within_15pct": agrees,
+                "requests_per_s": signals.get("fleet", {}).get(
+                    "requests_per_s"),
+            },
+            "slo": {
+                "breaching": slo.get("breaching", []),
+                "breaches_total": breaches,
             },
         }
     finally:
@@ -451,10 +523,30 @@ def main() -> int:
         "affinity_hit_ratio": affinity["prefix_cache"]["hit_ratio"],
         "random_hit_ratio": random_arm["prefix_cache"]["hit_ratio"],
         "churn_failures": len(churn["failures"]),
+        "telemetry_ttft_p95_ms": affinity["signals"]["ttft_p95_ms"],
+        "slo_breaches": (affinity["slo"]["breaches_total"]
+                         + random_arm["slo"]["breaches_total"]),
     }))
+    # SLO gate: a healthy run must report ZERO breaches, and the
+    # telemetry plane's TTFT p95 must agree with the clients' own
+    # measurement — otherwise the autoscaler's future input is lying.
+    slo_clean = all(
+        arm["signals"]["agrees_within_15pct"]
+        and arm["slo"]["breaches_total"] == 0
+        and not arm["slo"]["breaching"]
+        for arm in (affinity, random_arm)
+    )
+    if not slo_clean:
+        print("# SLO gate FAILED: "
+              + json.dumps({
+                  "affinity": {**affinity["signals"], **affinity["slo"]},
+                  "random": {**random_arm["signals"],
+                             **random_arm["slo"]},
+              }), file=sys.stderr)
     clean = (
         not affinity["failures"] and not random_arm["failures"]
         and not churn["failures"] and churn["ring_converged"]
+        and slo_clean
     )
     if args.smoke:
         # Executability proven; toy numbers must not persist where a

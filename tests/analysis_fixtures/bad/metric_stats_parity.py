@@ -1,5 +1,5 @@
-"""BAD: registers a serving family no STATS_PARITY entry surfaces (and
-lists a family the module never registers)."""
+"""BAD: registers serving AND gateway families no STATS_PARITY entry
+surfaces (and lists a family the module never registers)."""
 
 from prometheus_client import CollectorRegistry, Counter
 
@@ -12,5 +12,11 @@ STATS_PARITY = {
 orphan = Counter(
     "tpu_serving_orphan_widgets_total",
     "registered but absent from STATS_PARITY",
+    registry=REGISTRY,
+)
+
+gateway_orphan = Counter(
+    "tpu_gateway_orphan_hops_total",
+    "gateway family registered but absent from STATS_PARITY",
     registry=REGISTRY,
 )

@@ -1,5 +1,6 @@
-"""GOOD: the one serving family registered here has a STATS_PARITY entry,
-and every STATS_PARITY key is registered in this module."""
+"""GOOD: every serving/gateway family registered here has a
+STATS_PARITY entry, and every STATS_PARITY key is registered in this
+module."""
 
 from prometheus_client import CollectorRegistry, Counter
 
@@ -7,10 +8,18 @@ REGISTRY = CollectorRegistry()
 
 STATS_PARITY = {
     "tpu_serving_requests_shed_total": "requests_shed",
+    "tpu_gateway_shed_total": "shed",
 }
 
 shed = Counter(
     "tpu_serving_requests_shed_total",
     "fixture mirror of the real shed family",
+    registry=REGISTRY,
+)
+
+gateway_shed = Counter(
+    "tpu_gateway_shed_total",
+    "fixture mirror of the gateway shed family",
+    ["tenant"],
     registry=REGISTRY,
 )
