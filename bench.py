@@ -311,17 +311,33 @@ def _trace_summary():
     }
 
 
+def _host_kind() -> str:
+    """``tpu`` or ``cpu`` — which hardware actually produced a record.
+    Stamped next to ``provenance`` so a smoke artifact from a CPU CI
+    runner can never be mistaken for a chip measurement (and vice
+    versa: a live TPU number replayed later still says where it ran)."""
+    try:
+        import jax
+
+        return "tpu" if jax.default_backend() in ("tpu", "axon") else "cpu"
+    except Exception:
+        return "cpu"
+
+
 def _stamp_provenance(entries: list, provenance: str = "live") -> list:
     """Every record written to a BENCH_*.json carries an explicit
     ``provenance: live|cached`` field. setdefault, not overwrite: entries
     replayed by the cached fallback already say "cached", and entries
     carried forward from a previous artifact keep whatever that capture
-    recorded about itself. When the persistent compilation cache is on,
-    records additionally carry the cache dir — a warmed measurement is
-    self-describing too, and a traced run stamps its span summary."""
+    recorded about itself (including the ``host`` it was measured on).
+    When the persistent compilation cache is on, records additionally
+    carry the cache dir — a warmed measurement is self-describing too,
+    and a traced run stamps its span summary."""
     trace = _trace_summary()
+    host = _host_kind()
     for e in entries:
         e.setdefault("provenance", provenance)
+        e.setdefault("host", host)
         if _COMPILE_CACHE_DIR is not None:
             e.setdefault("compile_cache", _COMPILE_CACHE_DIR)
         if trace is not None:

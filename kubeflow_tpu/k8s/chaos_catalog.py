@@ -69,6 +69,15 @@ INJECTION_TYPES = (
     # ring must heal within the probe interval, and post-heal traffic
     # must succeed with zero further failures.
     "gateway-replica-kill",
+    # Disaggregated serving coverage (models/gateway.py tier routing):
+    # the prefill-tier replica dies mid-KV-export with a handoff in
+    # flight. The gateway must re-route the request to a surviving
+    # prefill replica within the re-route budget (or surface an explicit
+    # error event before [DONE] — silent truncation is the one outcome
+    # forbidden), drop the dead replica from the ring, and leave the
+    # decode tier untouched: post-heal traffic keeps streaming through
+    # the paged-KV handoff with zero transfer failures.
+    "serving-kv-handoff-loss",
 )
 STEADY_STATE_CHECKS = (
     "sliceReady", "notCulled", "notebookCreatable", "warmPoolReady",
@@ -89,6 +98,10 @@ STEADY_STATE_CHECKS = (
     # Gateway: the dead replica left the ring, survivors serve, and the
     # failed-stream count equals the in-flight burst — no silent loss.
     "gatewayHealed",
+    # Disaggregated serving: the decode tier answers /healthz, stays in
+    # the ring, and keeps importing KV payloads after a prefill-tier
+    # loss — tier failure must not cascade across the handoff boundary.
+    "decodeTierHealthy",
 )
 # Injection ↔ target coherence: a doc must declare the kind its handler
 # actually exercises, or a "pass" certifies a hypothesis that never ran.
@@ -109,6 +122,7 @@ TARGET_KIND_FOR_INJECTION = {
     "checkpoint-restore-corrupt": "CheckpointManager",
     "checkpoint-disk-full": "CheckpointManager",
     "gateway-replica-kill": "ServingGateway",
+    "serving-kv-handoff-loss": "ServingGateway",
 }
 
 
@@ -388,6 +402,137 @@ class _CrashableReplica:
             self.crash()
 
 
+class _CrashablePrefill:
+    """Minimal prefill-tier replica for the disaggregated fleet: answers
+    /healthz and /stats like an InferenceServer, then dies mid-export on
+    its first ``/kv/prefill`` — response headers and a torn body are on
+    the wire when the listener goes down. That is what a SIGKILLed
+    prefill pod looks like from the gateway's side of the KV handoff;
+    the gateway's re-route walk is the system under test, so the engine
+    behind this replica never needs to exist."""
+
+    def __init__(self):
+        from http.server import (
+            BaseHTTPRequestHandler,
+            ThreadingHTTPServer,
+        )
+
+        self.lock = threading.Lock()
+        self.hits = 0
+        self.crashed = False
+        replica = self
+
+        class QuietServer(ThreadingHTTPServer):
+            daemon_threads = True
+
+            def handle_error(self, request, client_address):
+                pass  # crash() tears sockets mid-write by design
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._json(200, {"status": "ok"})
+                elif self.path == "/stats":
+                    self._json(200, {"slots": 2, "active_slots": 0,
+                                     "queued": 0, "served": 0,
+                                     "tier_role": "prefill"})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(length)
+                if self.path != "/kv/prefill":
+                    self._json(404, {"error": "not found"})
+                    return
+                with replica.lock:
+                    replica.hits += 1
+                # Die mid-export: declare a body, ship a fragment of it,
+                # then take the whole pod down — the gateway reads an
+                # IncompleteRead off this socket, not a clean refusal.
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", "4096")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(b'{"payload": {"blocks": [')
+                self.wfile.flush()
+                replica.crash()
+
+        self.httpd = QuietServer(("127.0.0.1", 0), Handler)
+        self.host, self.port = self.httpd.server_address[:2]
+        self.endpoint = f"{self.host}:{self.port}"
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+
+    def start(self) -> "_CrashablePrefill":
+        self.thread.start()
+        return self
+
+    def crash(self) -> None:
+        with self.lock:
+            if self.crashed:
+                return
+            self.crashed = True
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def stop(self) -> None:
+        self.crash()
+
+
+def _paged_serving_factory(*, tier_role: str):
+    """Tiny paged-engine serving stack for the disaggregated-fleet
+    experiments: prefix_cache on (KV export/import requires the chain
+    index), lazy jax imports for the same reason as the default
+    factory."""
+    import jax
+
+    from kubeflow_tpu.models import llama as L
+    from kubeflow_tpu.models.paged import PagedBatcher
+    from kubeflow_tpu.models.server import InferenceServer
+    from kubeflow_tpu.models.serving import GenerationConfig
+
+    cfg = L.LLAMA_CONFIGS["tiny"]
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    engine = PagedBatcher(
+        params, cfg,
+        gen=GenerationConfig(max_new_tokens=16, eos_id=-1),
+        slots=2, num_blocks=32, block_size=8, prompt_bucket=16,
+        prefix_cache=True,
+    )
+    return InferenceServer(engine, port=0, drain_s=0.5,
+                           tier_role=tier_role)
+
+
+def _serving_get(port: int, path: str, timeout: float = 60.0):
+    """(status, body) for a replica GET — health and stats scrapes."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout
+        ) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, {}
+    except (OSError, ValueError):
+        return 0, {}
+
+
 def _serving_post(port: int, payload: dict, timeout: float = 60.0):
     """(status, body) for a completions POST — HTTPError is an outcome
     here (429/503/500 are the behaviors under test), not an exception."""
@@ -456,6 +601,7 @@ class ExperimentRunner:
             "checkpoint-restore-corrupt": self._run_checkpoint_restore_corrupt,
             "checkpoint-disk-full": self._run_checkpoint_disk_full,
             "gateway-replica-kill": self._run_gateway_replica_kill,
+            "serving-kv-handoff-loss": self._run_serving_kv_handoff_loss,
         }
 
     def run(self, doc: dict) -> ExperimentResult:
@@ -1360,6 +1506,163 @@ class ExperimentRunner:
             gw.stop()
             for r in replicas:
                 r.stop()
+
+    def _run_serving_kv_handoff_loss(self, doc: dict) -> ExperimentResult:
+        """The prefill-tier replica dies mid-KV-export with a handoff in
+        flight. The gateway must (a) re-route the in-flight request to
+        the surviving prefill replica within the re-route budget — the
+        client stream still delivers every token and ends in [DONE],
+        with silent truncation the one forbidden outcome; (b) drop the
+        dead replica from the ring within the recovery window; (c) keep
+        the decode tier healthy throughout: post-heal requests all
+        stream through the paged-KV handoff with zero transfer
+        failures."""
+        import http.client
+
+        from kubeflow_tpu.models.gateway import ServingGateway
+
+        params = doc["spec"]["injection"].get("params", {})
+        decode_tokens = int(params.get("decodeTokens", 5))
+        post_heal = int(params.get("postHealRequests", 3))
+        timeout = float(doc["spec"]["recoveryTimeoutSeconds"])
+
+        victim = _CrashablePrefill().start()
+        prefill = _paged_serving_factory(tier_role="prefill").start()
+        decode = _paged_serving_factory(tier_role="decode").start()
+        p_ep = f"{prefill.host}:{prefill.port}"
+        d_ep = f"{decode.host}:{decode.port}"
+        gw = ServingGateway(
+            [victim.endpoint, p_ep, d_ep], port=0, block_size=8,
+            health_interval_s=0.1, reroute_budget=2, tier_mode="disagg",
+            tier_roles={victim.endpoint: "prefill", p_ep: "prefill",
+                        d_ep: "decode"},
+        ).start()
+
+        def stream(prompt):
+            """(sse_lines, tokens) for one streamed completion."""
+            conn = http.client.HTTPConnection(gw.host, gw.port,
+                                              timeout=timeout)
+            lines, toks = [], []
+            try:
+                conn.request(
+                    "POST", "/v1/completions",
+                    json.dumps({"prompt": prompt, "stream": True,
+                                "max_tokens": decode_tokens}).encode(),
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                while True:
+                    line = resp.fp.readline()
+                    if not line:
+                        break
+                    if line.startswith(b"data:"):
+                        lines.append(line)
+                    if line == b"data: [DONE]\n":
+                        break
+                for ln in lines:
+                    if ln == b"data: [DONE]\n":
+                        continue
+                    body = json.loads(ln[5:])
+                    if "token" in body:
+                        toks.append(body["token"])
+            finally:
+                conn.close()
+            return lines, toks
+
+        try:
+            # All three replicas must be in the ring before the kill
+            # has a ring to matter in.
+            deadline = time.monotonic() + timeout
+            while (len(gw.ring_nodes()) < 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            # A prompt whose prefill walk starts at the victim: the
+            # in-flight handoff must land on the pod that dies, not on
+            # whichever replica the ring happens to prefer.
+            prompt = None
+            for nonce in range(3, 250):
+                cand = [nonce, 5, 7, 11, 13, 17, 19, 23, 29, 31]
+                walk = gw._tier_candidates(
+                    "prefill", gw._route_key(cand)
+                )
+                if walk and walk[0] == victim.endpoint:
+                    prompt = cand
+                    break
+            if prompt is None:
+                return ExperimentResult(
+                    doc["metadata"]["name"], passed=False,
+                    detail="no prompt routed to the victim replica",
+                )
+            sev_lines, sev_toks = stream(prompt)
+            mid = gw.stats()
+            rerouted = (
+                victim.hits >= 1
+                and bool(sev_lines)
+                and sev_lines[-1] == b"data: [DONE]\n"
+                and len(sev_toks) == decode_tokens
+                and not any(b'"error"' in ln for ln in sev_lines)
+                and mid["reroutes"] >= 1
+                and mid["kv_transfers"] == 1
+            )
+            # Ring heals: the dead prefill pod leaves within the window.
+            healed = False
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if gw.ring_nodes() == frozenset({p_ep, d_ep}):
+                    healed = True
+                    break
+                time.sleep(0.02)
+            # Decode tier stayed healthy: post-heal traffic still
+            # streams through the handoff, every import lands on the
+            # decode replica, and no transfer ever failed.
+            completed = 0
+            for i in range(post_heal):
+                lines, toks = stream(
+                    [40 + i, 41, 42, 43, 44, 45, 46, 47, 48, 49]
+                )
+                completed += (bool(lines)
+                              and lines[-1] == b"data: [DONE]\n"
+                              and len(toks) == decode_tokens)
+            code, _ = _serving_get(decode.port, "/healthz",
+                                   timeout=timeout)
+            _, dstats = _serving_get(decode.port, "/stats",
+                                     timeout=timeout)
+            stats = gw.stats()
+            decode_ok = (
+                code == 200
+                and completed == post_heal
+                and stats["kv_transfers"] == 1 + post_heal
+                and stats["kv_transfer_failures"] == 0
+                and dstats.get("kv_handoff", {}).get("imports")
+                == 1 + post_heal
+            )
+            passed = rerouted and healed and decode_ok
+            return ExperimentResult(
+                doc["metadata"]["name"],
+                passed=passed,
+                detail="" if passed else (
+                    f"rerouted={rerouted} (hits={victim.hits} "
+                    f"toks={len(sev_toks)}/{decode_tokens} "
+                    f"reroutes={mid['reroutes']}) healed={healed} "
+                    f"decode_ok={decode_ok} "
+                    f"(completed={completed}/{post_heal} "
+                    f"transfers={stats['kv_transfers']} "
+                    f"transfer_failures={stats['kv_transfer_failures']})"
+                ),
+                observations={
+                    "victim_hits": victim.hits,
+                    "reroutes": stats["reroutes"],
+                    "kv_transfers": stats["kv_transfers"],
+                    "kv_transfer_failures":
+                        stats["kv_transfer_failures"],
+                    "healed": healed,
+                },
+            )
+        finally:
+            gw.stop()
+            victim.stop()
+            prefill.stop()
+            decode.stop()
 
     def _run_checkpoint_kill_mid_save(self, doc: dict) -> ExperimentResult:
         """SIGKILL lands mid-save: the IO layer dies between file writes

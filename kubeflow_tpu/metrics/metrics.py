@@ -47,6 +47,10 @@ STATS_PARITY = {
     "tpu_gateway_reroutes_total": "reroutes",
     "tpu_gateway_shed_total": "shed",
     "tpu_gateway_replicas": "ring_size",
+    "tpu_serving_kv_transfer_total": "kv_transfers",
+    "tpu_serving_kv_transfer_failures_total": "kv_transfer_failures",
+    "tpu_serving_kv_transfer_bytes_total": "kv_transfer_bytes",
+    "tpu_serving_kv_transfer_latency_seconds": "kv_transfer_latency_s",
 }
 
 
@@ -262,6 +266,29 @@ class Metrics:
         self.gateway_replicas = Gauge(
             "tpu_gateway_replicas",
             "Replicas currently routable (present in the hash ring)",
+            registry=self.registry,
+        )
+        # -- disaggregated serving (prefill→decode paged-KV handoff) ------
+        self.serving_kv_transfer_total = Counter(
+            "tpu_serving_kv_transfer_total",
+            "Prefill→decode KV handoffs completed by the gateway",
+            registry=self.registry,
+        )
+        self.serving_kv_transfer_failures_total = Counter(
+            "tpu_serving_kv_transfer_failures_total",
+            "KV handoffs that failed (prefill hop, transfer, or decode "
+            "import) and fell back within the re-route budget",
+            registry=self.registry,
+        )
+        self.serving_kv_transfer_bytes_total = Counter(
+            "tpu_serving_kv_transfer_bytes_total",
+            "Serialized KV payload bytes shipped prefill→decode",
+            registry=self.registry,
+        )
+        self.serving_kv_transfer_latency_seconds = Gauge(
+            "tpu_serving_kv_transfer_latency_seconds",
+            "Duration of the most recent KV transfer hop (payload POST "
+            "through decode-side import acknowledgement)",
             registry=self.registry,
         )
         # -- SLO burn-rate engine (observability/slo.py) -------------------

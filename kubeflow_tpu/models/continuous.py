@@ -416,6 +416,23 @@ class _BatcherBase:
                stop: Optional[Sequence[Sequence[int]]] = None,
                logit_bias: Optional[dict] = None,
                deadline_s: Optional[float] = None) -> int:
+        req = self._build_request(
+            prompt, max_new_tokens=max_new_tokens, temperature=temperature,
+            stop=stop, logit_bias=logit_bias, deadline_s=deadline_s,
+        )
+        self._queue.append(req)
+        return req.rid
+
+    def _build_request(self, prompt: Sequence[int],
+                       max_new_tokens: Optional[int] = None,
+                       temperature: Optional[float] = None,
+                       stop: Optional[Sequence[Sequence[int]]] = None,
+                       logit_bias: Optional[dict] = None,
+                       deadline_s: Optional[float] = None) -> _Request:
+        """Validate client-supplied sampling fields and mint a _Request
+        with a fresh rid — shared by submit() and the paged KV-import
+        path (which installs a request directly into a slot instead of
+        queueing it)."""
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) > self.prompt_bucket:
@@ -474,14 +491,13 @@ class _BatcherBase:
             )
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(
+        return _Request(
             rid, list(prompt), max_new=max_new_tokens,
             temperature=None if temperature is None else float(temperature),
             stop=stop_seqs, logit_bias=bias,
             deadline=None if deadline_s is None
             else self._clock() + float(deadline_s),
-        ))
-        return rid
+        )
 
     def cancel(self, rid: int, reason: str = "cancelled") -> bool:
         """Retire ``rid`` without completing it. A queued request is

@@ -195,6 +195,23 @@ class PrefixRouter:
         return best
 
 
+def prompt_chain_keys(prompt: list, block_size: int) -> list:
+    """Chain keys of the prompt's REGISTRABLE full blocks — the first
+    ``(len(prompt) - 1) // block_size`` blocks, excluding the tail block
+    the decode path mutates. Byte-identical to the key walk
+    ``PagedBatcher.export_blocks`` stamps into a KV payload, so the
+    gateway can negotiate suffix-only transfers (/kv/probe) without
+    importing jax."""
+    keys: list = []
+    parent: Optional[bytes] = None
+    for j in range((len(prompt) - 1) // block_size):
+        parent = chain_key(
+            parent, prompt[j * block_size:(j + 1) * block_size]
+        )
+        keys.append(parent)
+    return keys
+
+
 def _parse_endpoint(endpoint: str) -> tuple:
     """``host:port`` → (host, port), raising on garbage — a mistyped
     replica list must not silently route into nothing."""
@@ -211,14 +228,19 @@ def _parse_endpoint(endpoint: str) -> tuple:
 
 
 class _Replica:
-    __slots__ = ("endpoint", "host", "port", "healthy", "draining", "stats")
+    __slots__ = ("endpoint", "host", "port", "healthy", "draining", "stats",
+                 "role")
 
-    def __init__(self, endpoint: str):
+    def __init__(self, endpoint: str, role: str = "fused"):
         self.endpoint = endpoint
         self.host, self.port = _parse_endpoint(endpoint)
         self.healthy = True   # optimistic: routable until a probe says no
         self.draining = False
         self.stats: Optional[dict] = None  # last /stats scrape (subset)
+        # Disaggregated tier membership: "fused" (default), "prefill", or
+        # "decode" — from gateway config (tier lists) or the replica's
+        # own /stats tier_role advertisement (config wins).
+        self.role = role
 
 
 class GatewayOverloadedError(RuntimeError):
@@ -245,7 +267,11 @@ class ServingGateway:
                  max_body_bytes: int = 4 << 20,
                  metrics=None, replica_source=None,
                  telemetry: Optional[FleetTelemetry] = None,
-                 tenant_top_k: int = 8):
+                 tenant_top_k: int = 8,
+                 tier_mode: str = "fused",
+                 tier_roles: Optional[dict] = None,
+                 kv_transfer_timeout_s: float = 30.0,
+                 kv_transfer_max_bytes: int = 64 << 20):
         if affinity not in AFFINITY_MODES:
             raise ValueError(
                 f"affinity must be one of {AFFINITY_MODES}, got {affinity!r}"
@@ -254,11 +280,38 @@ class ServingGateway:
             raise ValueError(
                 f"reroute_budget must be >= 0, got {reroute_budget}"
             )
+        if tier_mode not in ("fused", "disagg"):
+            raise ValueError(
+                f"tier_mode must be 'fused' or 'disagg', got {tier_mode!r}"
+            )
+        if kv_transfer_timeout_s <= 0:
+            raise ValueError(
+                f"kv_transfer_timeout_s must be > 0, got "
+                f"{kv_transfer_timeout_s}"
+            )
+        if kv_transfer_max_bytes < 1:
+            raise ValueError(
+                f"kv_transfer_max_bytes must be >= 1, got "
+                f"{kv_transfer_max_bytes}"
+            )
         # Same opt-in as the replicas: KUBEFLOW_TPU_TRACE_* switches the
         # process-wide provider on; default stays the no-op tracer.
         tracing.configure_from_env()
         self.affinity = affinity
         self.reroute_budget = reroute_budget
+        # Disaggregated prefill/decode serving: in "disagg" mode a
+        # streaming token-id request prefills on the prefill tier, ships
+        # its paged-KV payload to the decode tier, and streams from
+        # there; everything else (and every transfer failure, within the
+        # re-route budget) falls back to the fused path below.
+        self.tier_mode = tier_mode
+        self._tier_roles = dict(tier_roles or {})
+        self.kv_transfer_timeout_s = kv_transfer_timeout_s
+        self.kv_transfer_max_bytes = kv_transfer_max_bytes
+        self._kv_transfers = 0
+        self._kv_transfer_failures = 0
+        self._kv_transfer_bytes = 0
+        self._kv_transfer_last_s = 0.0
         self.health_interval_s = health_interval_s
         self.health_timeout_s = health_timeout_s
         self.upstream_timeout_s = upstream_timeout_s
@@ -314,7 +367,8 @@ class ServingGateway:
         """Register a replica and route to it immediately (optimistic —
         the next probe pass demotes it if it is not actually healthy).
         Idempotent; loadtests and the chaos harness call this mid-run."""
-        rep = _Replica(endpoint)
+        rep = _Replica(endpoint,
+                       role=self._tier_roles.get(endpoint, "fused"))
         with self._lock:
             if endpoint not in self._replicas:
                 self._replicas[endpoint] = rep
@@ -396,6 +450,12 @@ class ServingGateway:
                 self._mirror_ring_locked()
             if rep.healthy:
                 rep.stats = self._scrape_stats(rep)
+                if rep.endpoint not in self._tier_roles:
+                    # Tier membership follows the replica's own /stats
+                    # advertisement unless the gateway's config pinned it.
+                    role = (rep.stats or {}).get("tier_role")
+                    if role in ("fused", "prefill", "decode"):
+                        rep.role = role
                 if self.telemetry is not None:
                     self.telemetry.ingest_replica(rep.endpoint, rep.stats)
         if self.telemetry is not None:
@@ -449,8 +509,9 @@ class ServingGateway:
         # Optional sub-dicts the telemetry plane turns into per-replica
         # gauges (queue-wait/inter-token percentiles, ragged fill,
         # prefix hit ratio); absent on engines without the feature.
+        keep["tier_role"] = stats.get("tier_role")
         for extra in ("prefix_cache", "queue_wait_s", "inter_token_s",
-                      "ragged", "flight"):
+                      "ragged", "flight", "kv_handoff"):
             if extra in stats:
                 keep[extra] = stats[extra]
         return keep
@@ -525,6 +586,26 @@ class ServingGateway:
         with self._lock:
             return self._ring.successors(key, self.reroute_budget + 1)
 
+    def _tier_candidates(self, role: str, key: bytes) -> list:
+        """Ring-ordered healthy replicas of one tier role.
+
+        The full successor walk keeps prefix affinity *within* the tier:
+        the first decode replica after the key's ring position is stable
+        for a given prompt prefix, so its chain cache warms exactly like
+        a fused replica's would.
+        """
+        with self._lock:
+            out = []
+            for ep in self._ring.successors(key, len(self._ring)):
+                rep = self._replicas.get(ep)
+                if rep is None:
+                    continue
+                if (rep.role or "fused") == role:
+                    out.append(ep)
+                    if len(out) >= self.reroute_budget + 1:
+                        break
+            return out
+
     def _count_reroute(self) -> None:
         with self._lock:
             self._reroutes += 1
@@ -542,6 +623,27 @@ class ServingGateway:
     def _count_failed(self) -> None:
         with self._lock:
             self._failed += 1
+
+    def _count_kv_transfer(self, ok: bool, nbytes: int,
+                           latency_s: float) -> None:
+        with self._lock:
+            if ok:
+                self._kv_transfers += 1
+                self._kv_transfer_bytes += nbytes
+                self._kv_transfer_last_s = latency_s
+            else:
+                self._kv_transfer_failures += 1
+        if self.metrics is not None:
+            if ok:
+                self.metrics.serving_kv_transfer_total.inc()
+                self.metrics.serving_kv_transfer_bytes_total.inc(nbytes)
+                self.metrics.serving_kv_transfer_latency_seconds.set(
+                    latency_s
+                )
+            else:
+                self.metrics.serving_kv_transfer_failures_total.inc()
+        if self.telemetry is not None:
+            self.telemetry.observe_kv_transfer(nbytes, latency_s, ok=ok)
 
     def stats(self) -> dict:
         with self._lock:
@@ -561,12 +663,17 @@ class ServingGateway:
                 misses += pc.get("misses", 0)
             return {
                 "affinity": self.affinity,
+                "tier_mode": self.tier_mode,
                 "ring_size": len(self._ring),
                 "replicas": replicas,
                 "requests": self._requests,
                 "reroutes": self._reroutes,
                 "shed": self._shed,
                 "failed": self._failed,
+                "kv_transfers": self._kv_transfers,
+                "kv_transfer_failures": self._kv_transfer_failures,
+                "kv_transfer_bytes": self._kv_transfer_bytes,
+                "kv_transfer_latency_s": round(self._kv_transfer_last_s, 6),
                 "inflight": dict(self._inflight),
                 # The fleet-level prefix-cache view, aggregated from the
                 # per-replica /stats scrapes (satellite: the gateway's
@@ -697,6 +804,16 @@ class ServingGateway:
             def _route(self, req: dict, arrival: float,
                        tenant: str) -> None:
                 key = gw._route_key(req.get("prompt"))
+                counted = False
+                if gw.tier_mode == "disagg":
+                    outcome = self._route_disagg(req, arrival, tenant,
+                                                 key)
+                    if outcome == "done":
+                        return
+                    # "fallback-counted": the disagg attempt already
+                    # counted the request (prefill ran; only the decode
+                    # hop failed) — the fused retry must not double it.
+                    counted = outcome == "fallback-counted"
                 candidates = gw._candidates(key)
                 # The routing decision is its own span: affinity mode,
                 # candidate walk, and every re-route attempt (as events)
@@ -706,11 +823,11 @@ class ServingGateway:
                     candidates=len(candidates),
                 ) as span:
                     self._route_span(req, arrival, candidates, span,
-                                     tenant)
+                                     tenant, counted=counted)
 
             def _route_span(self, req: dict, arrival: float,
                             candidates: list, span,
-                            tenant: str) -> None:
+                            tenant: str, counted: bool = False) -> None:
                 if not candidates:
                     span.record_error(
                         RuntimeError("no healthy replicas")
@@ -720,7 +837,8 @@ class ServingGateway:
                     self._json(503, {"error": "no healthy replicas"},
                                retry_after=1)
                     return
-                gw._count_request()
+                if not counted:
+                    gw._count_request()
                 deadline_s = req.get("deadline_s")
                 stream = bool(req.get("stream", False))
                 last = None
@@ -766,6 +884,333 @@ class ServingGateway:
                            {"error": f"fleet exhausted re-route budget "
                                      f"({gw.reroute_budget}): {detail}"},
                            retry_after=1)
+
+            # -- disaggregated prefill/decode tiers -----------------------
+
+            def _route_disagg(self, req: dict, arrival: float,
+                              tenant: str, key: bytes) -> str:
+                """One disaggregated attempt: probe the decode tier's
+                prefix chains, prefill on the prefill tier (suffix-only
+                export), hand the paged-KV payload to a decode replica.
+
+                Returns "done" when a response reached the client,
+                "fallback" to run the fused path untouched, or
+                "fallback-counted" when the request was already counted
+                (prefill ran, decode hop failed)."""
+                prompt = req.get("prompt")
+                if not (isinstance(prompt, list) and prompt and all(
+                    isinstance(t, int) and not isinstance(t, bool)
+                    for t in prompt
+                )):
+                    return "fallback"  # text prompts tokenize replica-side
+                if not req.get("stream"):
+                    # Non-stream responses assemble replica-side; the
+                    # handoff's first-token boundary only pays off for
+                    # streamed decode.
+                    return "fallback"
+                if req.get("n", 1) != 1 or req.get("logprobs"):
+                    return "fallback"
+                if "kv_import" in req:
+                    return "fallback"  # already a decode-tier hop
+                mt = req.get("max_tokens")
+                if mt is not None and (
+                    not isinstance(mt, int) or isinstance(mt, bool)
+                    or mt < 1
+                ):
+                    return "fallback"  # let the fused path 400 it
+                prefills = gw._tier_candidates("prefill", key)
+                decodes = gw._tier_candidates("decode", key)
+                if not prefills or not decodes:
+                    return "fallback"
+                deadline_s = req.get("deadline_s")
+
+                def remaining():
+                    if isinstance(deadline_s, (int, float)) and not \
+                            isinstance(deadline_s, bool):
+                        return deadline_s - (time.monotonic() - arrival)
+                    return None
+
+                with tracing.get_tracer("gateway").start_span(
+                    "gateway.route", affinity=gw.affinity,
+                    tier_mode="disagg",
+                    prefill_candidates=len(prefills),
+                    decode_candidates=len(decodes),
+                ) as span:
+                    return self._disagg_span(
+                        req, arrival, tenant, prompt, prefills, decodes,
+                        remaining, span,
+                    )
+
+            def _disagg_span(self, req: dict, arrival: float,
+                             tenant: str, prompt: list, prefills: list,
+                             decodes: list, remaining, span) -> str:
+                # Probe the affinity-preferred decode replica for cached
+                # prefix chains so the prefill tier exports only suffix
+                # blocks — the same chain keys PagedBatcher stamps.
+                keys_hex = [
+                    k.hex() for k in prompt_chain_keys(
+                        prompt, gw._router.block_size
+                    )
+                ]
+                matched = self._kv_probe_replica(decodes[0], keys_hex) \
+                    if keys_hex else 0
+                skip = keys_hex[:matched]
+                span.set_attribute("prefix_blocks_skipped", len(skip))
+                result = None
+                for i, endpoint in enumerate(prefills):
+                    if i:
+                        gw._count_reroute()
+                        span.add_event("reroute", {
+                            "attempt": i, "endpoint": endpoint,
+                            "tier": "prefill",
+                        })
+                    rem = remaining()
+                    if rem is not None and rem <= 0:
+                        gw._count_request()
+                        if gw.telemetry is not None:
+                            gw.telemetry.observe_request(tenant, ok=False)
+                        self._json(504, {
+                            "error": "deadline expired at the gateway",
+                            "partial_tokens": [],
+                        })
+                        return "done"
+                    result = self._kv_prefill_replica(
+                        endpoint, req, skip, rem
+                    )
+                    if result is not None:
+                        span.set_attribute("prefill_endpoint", endpoint)
+                        break
+                if result is None:
+                    gw._count_kv_transfer(False, 0, 0.0)
+                    span.add_event("disagg_fallback", {"stage": "prefill"})
+                    return "fallback"
+                payload = result.get("payload")
+                fin = result.get("finished") or {}
+                mt = req.get("max_tokens")
+                need_decode = (
+                    payload is not None
+                    and fin.get("finish_reason") == "length"
+                    and (mt is None or mt > 1)
+                )
+                if not need_decode:
+                    # The prefill token was the whole generation (EOS,
+                    # stop sequence, or max_tokens == 1): answer from
+                    # the prefill result, no transfer needed.
+                    gw._count_request()
+                    self._synthesize(result.get("id"), fin, tenant,
+                                     arrival)
+                    return "done"
+                fwd = {k: v for k, v in req.items() if k != "prompt"}
+                fwd["kv_import"] = payload
+                rem = remaining()
+                if rem is not None:
+                    if rem <= 0:
+                        gw._count_request()
+                        if gw.telemetry is not None:
+                            gw.telemetry.observe_request(tenant, ok=False)
+                        self._json(504, {
+                            "error": "deadline expired at the gateway",
+                            "partial_tokens": [],
+                        })
+                        return "done"
+                    fwd["deadline_s"] = rem
+                body = json.dumps(fwd).encode()
+                if len(body) > gw.kv_transfer_max_bytes:
+                    gw._count_kv_transfer(False, len(body), 0.0)
+                    span.add_event("disagg_fallback", {
+                        "stage": "payload_size", "bytes": len(body),
+                    })
+                    return "fallback"
+                gw._count_request()
+                # A suffix-only payload binds to the probed replica (its
+                # chain cache holds the skipped blocks); a full payload
+                # may walk the decode tier.
+                targets = decodes[:1] if skip else decodes
+                last = None
+                for i, endpoint in enumerate(targets):
+                    if i:
+                        gw._count_reroute()
+                        span.add_event("reroute", {
+                            "attempt": i, "endpoint": endpoint,
+                            "tier": "decode",
+                        })
+                    outcome, last = self._kv_decode_hop(
+                        endpoint, fwd, body, arrival, tenant
+                    )
+                    if outcome == "done":
+                        span.set_attribute("decode_endpoint", endpoint)
+                        return "done"
+                span.add_event("disagg_fallback", {
+                    "stage": "decode",
+                    "prior": f"{last[0]}: {last[1]}" if last
+                    else "unreachable",
+                })
+                return "fallback-counted"
+
+            def _kv_probe_replica(self, endpoint: str,
+                                  keys_hex: list) -> int:
+                """How many consecutive prompt chain keys the decode
+                replica already holds. Advisory only (no pinning): a
+                racing eviction surfaces as an import 409 and the
+                request falls back to fused."""
+                rep = gw._replicas.get(endpoint)
+                if rep is None:
+                    return 0
+                try:
+                    conn = http.client.HTTPConnection(
+                        rep.host, rep.port, timeout=gw.health_timeout_s
+                    )
+                    try:
+                        conn.request(
+                            "POST", "/kv/probe",
+                            json.dumps({"keys": keys_hex}).encode(),
+                            {"Content-Type": "application/json"},
+                        )
+                        resp = conn.getresponse()
+                        body = resp.read()
+                    finally:
+                        conn.close()
+                    if resp.status != 200:
+                        return 0
+                    return max(0, int(json.loads(body).get("matched", 0)))
+                except (OSError, ValueError, http.client.HTTPException):
+                    return 0
+
+            def _kv_prefill_replica(self, endpoint: str, req: dict,
+                                    skip: list, rem):
+                """One prefill-tier attempt. Returns the parsed
+                ``/kv/prefill`` result (payload + finished tokens) or
+                None when this replica refused or was unreachable."""
+                rep = gw._replicas.get(endpoint)
+                if rep is None:
+                    return None
+                fwd = {"prompt": req["prompt"], "skip_keys": skip}
+                for k in ("temperature", "stop", "logit_bias", "model"):
+                    if k in req:
+                        fwd[k] = req[k]
+                if rem is not None:
+                    fwd["deadline_s"] = rem
+                timeout = gw.upstream_timeout_s
+                if rem is not None:
+                    timeout = min(timeout, rem + 5.0)
+                headers = {"Content-Type": "application/json"}
+                tp = tracing.format_traceparent(tracing.current_span())
+                if tp:
+                    headers["traceparent"] = tp
+                if self._req_id:
+                    headers["X-Request-Id"] = self._req_id
+                try:
+                    conn = http.client.HTTPConnection(
+                        rep.host, rep.port, timeout=timeout
+                    )
+                    try:
+                        conn.request("POST", "/kv/prefill",
+                                     json.dumps(fwd).encode(), headers)
+                        resp = conn.getresponse()
+                        body = resp.read()
+                    finally:
+                        conn.close()
+                    if resp.status != 200:
+                        return None
+                    out = json.loads(body)
+                    if isinstance(out, dict) and "finished" in out:
+                        return out
+                    return None
+                except (OSError, ValueError, http.client.HTTPException):
+                    # HTTPException covers the pod-death-mid-response
+                    # shapes (IncompleteRead, BadStatusLine) a plain
+                    # connection error never raises.
+                    return None
+
+            def _kv_decode_hop(self, endpoint: str, fwd: dict,
+                               body: bytes, arrival: float, tenant: str):
+                """POST the block payload to one decode replica and relay
+                its stream. The ``kv_transfer`` span covers request →
+                response headers — the wire hop plus the replica-side
+                import, i.e. the gap between the prefill tier's
+                ``prefill`` span and the decode tier's ``first_decode``."""
+                rep = gw._replicas.get(endpoint)
+                if rep is None:
+                    return "retry", (503, f"{endpoint} left the fleet")
+                timeout = gw.kv_transfer_timeout_s
+                deadline_s = fwd.get("deadline_s")
+                if isinstance(deadline_s, (int, float)):
+                    timeout = min(timeout, float(deadline_s) + 5.0)
+                headers = {"Content-Type": "application/json"}
+                tp = tracing.format_traceparent(tracing.current_span())
+                if tp:
+                    headers["traceparent"] = tp
+                if self._req_id:
+                    headers["X-Request-Id"] = self._req_id
+                t0 = time.monotonic()
+                try:
+                    with tracing.get_tracer("gateway").start_span(
+                        "kv_transfer", endpoint=endpoint,
+                        transfer_bytes=len(body),
+                    ) as tspan:
+                        try:
+                            conn = http.client.HTTPConnection(
+                                rep.host, rep.port, timeout=timeout
+                            )
+                            conn.request("POST", "/v1/completions",
+                                         body, headers)
+                            resp = conn.getresponse()
+                        except (OSError,
+                                http.client.HTTPException) as err:
+                            tspan.record_error(err)
+                            raise
+                except (OSError, http.client.HTTPException):
+                    gw._count_kv_transfer(False, len(body),
+                                          time.monotonic() - t0)
+                    return "retry", (503, f"{endpoint} unreachable")
+                latency = time.monotonic() - t0
+                ctype = resp.getheader("Content-Type", "")
+                if resp.status != 200 or "text/event-stream" not in ctype:
+                    try:
+                        detail = json.loads(resp.read()).get(
+                            "error", "refused")
+                    except (OSError, ValueError):
+                        detail = "refused"
+                    conn.close()
+                    gw._count_kv_transfer(False, len(body), latency)
+                    return "retry", (resp.status,
+                                     f"{endpoint}: {detail}")
+                gw._count_kv_transfer(True, len(body), latency)
+                if conn.sock is not None:
+                    # The transfer deadline bounded the hop; the stream
+                    # phase reverts to the ordinary upstream timeout.
+                    conn.sock.settimeout(gw.upstream_timeout_s)
+                return self._relay_stream(conn, resp, arrival, tenant)
+
+            def _synthesize(self, rid, fin: dict, tenant: str,
+                            arrival: float) -> None:
+                """Answer a stream request straight from the prefill
+                result (generation finished at the first token): same SSE
+                shape a replica emits, so clients can't tell."""
+                try:
+                    self.send_response(200)
+                    if self._req_id:
+                        self.send_header("X-Request-Id", self._req_id)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    for tok in fin.get("tokens") or []:
+                        self.wfile.write(
+                            b"data: " + json.dumps(
+                                {"id": rid, "token": tok}
+                            ).encode() + b"\n\n"
+                        )
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    self.wfile.flush()
+                except OSError:
+                    pass  # client gone mid-synthesis
+                if gw.telemetry is not None:
+                    gw.telemetry.observe_request(
+                        tenant, ok=True,
+                        ttft_s=time.monotonic() - arrival,
+                        e2e_s=time.monotonic() - arrival,
+                    )
 
             def _proxy(self, endpoint: str, req: dict, stream: bool,
                        arrival: float, tenant: str):
@@ -1010,6 +1455,11 @@ def gateway_from_env(metrics=None, replica_source=None) -> ServingGateway:
         KUBEFLOW_TPU_GATEWAY_PORT,
         KUBEFLOW_TPU_GATEWAY_REPLICAS,
         KUBEFLOW_TPU_GATEWAY_REROUTE_BUDGET,
+        KUBEFLOW_TPU_GATEWAY_TIER_DECODE,
+        KUBEFLOW_TPU_GATEWAY_TIER_MODE,
+        KUBEFLOW_TPU_GATEWAY_TIER_PREFILL,
+        KUBEFLOW_TPU_KV_TRANSFER_MAX_BYTES,
+        KUBEFLOW_TPU_KV_TRANSFER_TIMEOUT_S,
     )
 
     def _int(name: str, default: int, minimum: int) -> int:
@@ -1048,8 +1498,42 @@ def gateway_from_env(metrics=None, replica_source=None) -> ServingGateway:
             f"{KUBEFLOW_TPU_GATEWAY_HASH_SEED}={raw_seed!r}: want an integer"
         )
     budget = _int(KUBEFLOW_TPU_GATEWAY_REROUTE_BUDGET, 2, 0)
+    tier_mode = os.environ.get(
+        KUBEFLOW_TPU_GATEWAY_TIER_MODE, "").strip().lower() or "fused"
+    if tier_mode not in ("fused", "disagg"):
+        raise ValueError(
+            f"{KUBEFLOW_TPU_GATEWAY_TIER_MODE}={tier_mode!r}: want "
+            f"'fused' or 'disagg'"
+        )
+    tier_roles: dict = {}
+    for env_name, role in ((KUBEFLOW_TPU_GATEWAY_TIER_PREFILL, "prefill"),
+                           (KUBEFLOW_TPU_GATEWAY_TIER_DECODE, "decode")):
+        raw = os.environ.get(env_name, "").strip()
+        for ep in (r.strip() for r in raw.split(",") if r.strip()):
+            _parse_endpoint(ep)
+            if tier_roles.get(ep, role) != role:
+                raise ValueError(
+                    f"{env_name}: endpoint {ep!r} listed in both tiers"
+                )
+            tier_roles[ep] = role
+            if ep not in replicas:
+                replicas.append(ep)
+    raw_timeout = os.environ.get(
+        KUBEFLOW_TPU_KV_TRANSFER_TIMEOUT_S, "").strip()
+    try:
+        kv_timeout = float(raw_timeout) if raw_timeout else 30.0
+    except ValueError:
+        kv_timeout = 0.0
+    if kv_timeout <= 0:
+        raise ValueError(
+            f"{KUBEFLOW_TPU_KV_TRANSFER_TIMEOUT_S}={raw_timeout!r}: "
+            f"want a number > 0"
+        )
+    kv_max_bytes = _int(KUBEFLOW_TPU_KV_TRANSFER_MAX_BYTES, 64 << 20, 1)
     return ServingGateway(
         replicas=replicas, port=port, affinity=affinity, hash_seed=seed,
         reroute_budget=budget, metrics=metrics,
-        replica_source=replica_source,
+        replica_source=replica_source, tier_mode=tier_mode,
+        tier_roles=tier_roles, kv_transfer_timeout_s=kv_timeout,
+        kv_transfer_max_bytes=kv_max_bytes,
     )

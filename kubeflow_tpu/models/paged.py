@@ -45,6 +45,7 @@ continuous/speculative as the in-notebook inference surface.
 
 from __future__ import annotations
 
+import base64
 import hashlib
 from functools import partial
 from typing import Optional
@@ -92,6 +93,69 @@ def init_block_pool(
     dispatch off the pytree."""
     shape = (cfg.n_layers, num_blocks, cfg.n_kv_heads, block_size, cfg.head_dim)
     return _kv_cache_leaves(shape, cfg.dtype, kv_bits)
+
+
+def _kv_block_bytes(cfg: LlamaConfig, block_size: int, kv_bits: int = 0) -> int:
+    """Raw bytes ONE pool block occupies across every leaf (k + v, plus
+    the bf16 scale leaves under kv_bits=8)."""
+    rows = cfg.n_layers * cfg.n_kv_heads * block_size
+    if kv_bits == 8:
+        # int8 values + one bf16 scale per (layer, head, offset) row.
+        return 2 * rows * cfg.head_dim + 2 * rows * 2
+    return 2 * rows * cfg.head_dim * np.dtype(jnp.bfloat16).itemsize
+
+
+def pool_blocks_from_hbm(
+    cfg: LlamaConfig,
+    block_size: int,
+    kv_bits: int = 0,
+    *,
+    fraction: float = 0.5,
+    fallback: int = 64,
+    device=None,
+) -> int:
+    """Size a block pool from the accelerator's live memory stats: spend
+    ``fraction`` of the device's free HBM (bytes_limit - bytes_in_use) on
+    KV blocks. Backends without memory_stats (CPU, some plugins) return
+    ``fallback`` — today's constant block counts keep working there, so
+    notebooks stay runnable off-TPU while TPU pools scale with the chip.
+    """
+    if not 0.0 < fraction <= 1.0:
+        raise ValueError(f"fraction must be in (0, 1], got {fraction!r}")
+    if device is None:
+        devices = jax.local_devices()
+        if not devices:
+            return fallback
+        device = devices[0]
+    stats_fn = getattr(device, "memory_stats", None)
+    if stats_fn is None:
+        return fallback
+    try:
+        stats = stats_fn()
+    except Exception:
+        stats = None
+    if not stats:
+        return fallback
+    limit = int(stats.get("bytes_limit")
+                or stats.get("bytes_reservable_limit") or 0)
+    in_use = int(stats.get("bytes_in_use") or 0)
+    budget = int((limit - in_use) * fraction)
+    per_block = _kv_block_bytes(cfg, block_size, kv_bits)
+    if budget <= 0 or per_block <= 0:
+        return fallback
+    # Block 0 is the null block; 2 is the smallest pool with a usable one.
+    return max(2, budget // per_block)
+
+
+def _np_leaf_dtype(name: str) -> np.dtype:
+    """numpy dtype for a serialized pool-leaf dtype name. bf16 resolves
+    through ml_dtypes (a jax dependency): np.dtype("bfloat16") raises
+    TypeError while the registered scalar type works."""
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
 
 
 @partial(jax.jit, static_argnames=("cfg", "block_size"), donate_argnums=(3,))
@@ -487,6 +551,7 @@ class PagedBatcher(_BatcherBase):
         attn_kernel: Optional[bool] = None,  # pallas paged attention
         ragged: bool = False,  # fused mixed prefill/decode batches
         token_budget: Optional[int] = None,  # ragged rows per step
+        hbm_fraction: Optional[float] = None,  # size pool from device HBM
     ):
         self.gen = gen or GenerationConfig()
         # Decode attention THROUGH the tables (ops/paged_attention.py):
@@ -586,6 +651,13 @@ class PagedBatcher(_BatcherBase):
         self.cfg = cfg
         self.slots = slots
         self.block_size = block_size
+        if hbm_fraction is not None:
+            # Satellite of the paged pool: size from the accelerator's
+            # live memory stats, with num_blocks as the CPU fallback.
+            num_blocks = pool_blocks_from_hbm(
+                cfg, block_size, kv_bits,
+                fraction=hbm_fraction, fallback=num_blocks,
+            )
         self.num_blocks = num_blocks
         self.prompt_bucket = prompt_bucket
         # Capacity (in blocks) one request can ever hold; fixes MAXB so the
@@ -665,6 +737,16 @@ class PagedBatcher(_BatcherBase):
         self.prefix_misses = 0
         self.prefix_evictions = 0
         self.admit_chunk = admit_chunk
+        # Paged-KV handoff (disaggregated serving): lifetime counters
+        # mirrored into /stats by the serving frontend, plus the deferred
+        # first-token queue import_blocks feeds (delivered at the next
+        # drive quantum so the frontend can register per-request state
+        # between import returning and on_token firing).
+        self.kv_exports = 0
+        self.kv_imports = 0
+        self.kv_import_blocks_reused = 0
+        self.kv_import_blocks_written = 0
+        self._kv_pending_first: list[tuple] = []
         self._init_base(self.gen, slots, prompt_bucket)
 
     @property
@@ -848,9 +930,283 @@ class PagedBatcher(_BatcherBase):
         self._clear_slot_storage(slot, req)
         self._by_slot[slot] = None
 
+    # -- paged-KV handoff (disaggregated prefill/decode tiers) -------------
+
+    def export_blocks(self, rid: int, skip_keys=()) -> dict:
+        """Serialize a live request's prompt-KV blocks for a cross-replica
+        handoff. Called at FIRST-token time (on_token for a
+        max_new_tokens=1 prefill-tier request): positions[slot] still
+        equals the prompt KV length and the sampled token's KV is
+        unwritten, so the payload is exactly the prefill state a decode
+        replica needs plus the pending first token to deliver.
+
+        Full blocks are chain-keyed exactly like prefix admission
+        (``_chain_key`` / gateway.chain_key); a key listed in
+        ``skip_keys`` (hex) ships as a data-less stub — the suffix-only
+        transfer for a decode replica that already holds the prefix
+        chain. The tail block (last prompt token's block, never
+        registered) always ships data.
+
+        Requires prefix_cache=True: the position-0-anchored layout IS
+        the transfer wire format."""
+        if not self._prefix_cache_enabled:
+            raise RuntimeError(
+                "export_blocks requires prefix_cache=True (the anchored "
+                "admission layout is the transfer wire format)"
+            )
+        slot = None
+        for i, r in enumerate(self._by_slot):
+            if r is not None and r.rid == rid:
+                slot = i
+                break
+        if slot is None:
+            raise KeyError(
+                f"rid {rid} holds no slot — export at first-token time, "
+                "while the request is still installed"
+            )
+        req = self._by_slot[slot]
+        if not req.tokens:
+            raise RuntimeError(
+                "export_blocks before the first sampled token: the "
+                "pending token is part of the payload"
+            )
+        bs = self.block_size
+        lng = int(self.positions[slot])  # prompt KV length; pending unwritten
+        nblocks = -(-lng // bs)
+        kv_tokens = (req.prompt + req.tokens)[:lng]
+        registrable = (lng - 1) // bs  # == nblocks - 1: exactly one tail
+        skip = {k if isinstance(k, str) else bytes(k).hex()
+                for k in skip_keys}
+        keys: list[str] = []
+        parent: Optional[bytes] = None
+        for j in range(registrable):
+            parent = self._chain_key(parent, kv_tokens[j * bs:(j + 1) * bs])
+            keys.append(parent.hex())
+        send = [j for j in range(nblocks)
+                if j >= registrable or keys[j] not in skip]
+        blk_ids = np.asarray([req.blocks[j] for j in send], np.int32)
+        # One device gather per leaf for the blocks that actually ship.
+        leaf_rows = {
+            name: np.asarray(self.pool[name][:, jnp.asarray(blk_ids)])
+            for name in self.pool
+        }
+        at = {j: i for i, j in enumerate(send)}
+        blocks = []
+        for j in range(nblocks):
+            ent: dict = {"key": keys[j] if j < registrable else None}
+            i = at.get(j)
+            if i is not None:
+                ent["data"] = {
+                    name: base64.b64encode(
+                        np.ascontiguousarray(rows[:, i]).tobytes()
+                    ).decode("ascii")
+                    for name, rows in leaf_rows.items()
+                }
+            blocks.append(ent)
+        self.kv_exports += 1
+        return {
+            "version": 1,
+            "block_size": bs,
+            "kv_bits": 8 if "k_scale" in self.pool else 0,
+            "tokens": [int(t) for t in kv_tokens],
+            "pending_token": int(req.tokens[-1]),
+            "pending_logprob": (
+                float(req.logprobs[-1]) if req.logprobs else None
+            ),
+            "leaves": {
+                name: {
+                    "dtype": str(self.pool[name].dtype),
+                    "shape": list(self.pool[name].shape[:1]
+                                  + self.pool[name].shape[2:]),
+                }
+                for name in self.pool
+            },
+            "blocks": blocks,
+        }
+
+    def import_blocks(self, payload: dict,
+                      max_new_tokens: Optional[int] = None,
+                      temperature: Optional[float] = None,
+                      stop=None, logit_bias: Optional[dict] = None,
+                      deadline_s: Optional[float] = None) -> Optional[int]:
+        """Admit a request DIRECTLY into a free slot from an exported
+        KV payload, skipping re-prefill. Chain keys are recomputed
+        locally and checked against the payload (a mismatch means the
+        two replicas' chain hashing diverged — refused loudly, which is
+        what pins cross-host chain-key parity at runtime). With
+        prefix_cache on, the longest locally-cached chain is reused and
+        only the remainder is written; stub blocks past the local chain
+        raise KeyError (suffix-only transfer raced an eviction — the
+        caller retries with full data or falls back to fused routing).
+
+        Returns the new rid, or None when no slot/blocks are free under
+        the admission watermark (caller sheds or retries elsewhere).
+        The pending first token is delivered through the normal
+        retirement path at the next drive quantum."""
+        if not isinstance(payload, dict) or payload.get("version") != 1:
+            raise ValueError("kv payload: missing or unsupported version")
+        if int(payload.get("block_size", -1)) != self.block_size:
+            raise ValueError(
+                f"kv payload block_size {payload.get('block_size')!r} != "
+                f"engine block_size {self.block_size}"
+            )
+        kv_bits = 8 if "k_scale" in self.pool else 0
+        if int(payload.get("kv_bits", -1)) != kv_bits:
+            raise ValueError(
+                f"kv payload kv_bits {payload.get('kv_bits')!r} does not "
+                f"match this pool's storage format (kv_bits={kv_bits})"
+            )
+        leaves = payload.get("leaves") or {}
+        if set(leaves) != set(self.pool):
+            raise ValueError("kv payload leaves do not match this pool")
+        shapes: dict[str, tuple] = {}
+        for name, spec in leaves.items():
+            want = self.pool[name].shape[:1] + self.pool[name].shape[2:]
+            if (tuple(spec.get("shape") or ()) != want
+                    or spec.get("dtype") != str(self.pool[name].dtype)):
+                raise ValueError(
+                    f"kv payload leaf {name!r}: shape/dtype "
+                    f"{spec.get('shape')}/{spec.get('dtype')} != local "
+                    f"{list(want)}/{self.pool[name].dtype}"
+                )
+            shapes[name] = want
+        tokens = [int(t) for t in payload.get("tokens") or []]
+        bs = self.block_size
+        lng = len(tokens)
+        nblocks = -(-lng // bs)
+        entries = payload.get("blocks") or []
+        if lng == 0 or len(entries) != nblocks:
+            raise ValueError(
+                f"kv payload carries {len(entries)} blocks for a "
+                f"{lng}-token prompt (want {nblocks})"
+            )
+        # Validation (and rid mint) via the shared request builder.
+        req = self._build_request(
+            tokens, max_new_tokens=max_new_tokens, temperature=temperature,
+            stop=stop, logit_bias=logit_bias, deadline_s=deadline_s,
+        )
+        slot = None
+        for i, r in enumerate(self._by_slot):
+            if r is None and i not in self._ragged_admit:
+                slot = i
+                break
+        if slot is None:
+            return None
+        registrable = (lng - 1) // bs
+        keys: list[bytes] = []
+        parent: Optional[bytes] = None
+        for j in range(registrable):
+            parent = self._chain_key(parent, tokens[j * bs:(j + 1) * bs])
+            sent = entries[j].get("key")
+            if sent is not None and sent != parent.hex():
+                raise ValueError(
+                    f"kv payload chain-key mismatch at block {j}: the "
+                    "exporting replica's chain hashing diverged from ours"
+                )
+            keys.append(parent)
+        # Longest local chain match (empty when prefix_cache is off —
+        # import still works, it just writes every block).
+        m = 0
+        if self._prefix_cache_enabled:
+            for j in range(registrable):
+                if keys[j] in self._prefix_entries:
+                    m += 1
+                else:
+                    break
+        for j in range(nblocks):
+            if j >= m and "data" not in entries[j]:
+                raise KeyError(
+                    f"kv payload block {j} is a stub but its chain is "
+                    "not cached here (suffix-only transfer raced an "
+                    "eviction) — resend with full block data"
+                )
+        shared_blocks = [self._prefix_entries[k]["block"] for k in keys[:m]]
+        # Pin the matched chain, refresh recency — mirrors prefix
+        # admission exactly.
+        for blk in shared_blocks:
+            self._shared_refs[blk] += 1
+        for k in keys[:m]:
+            self._prefix_entries[k] = self._prefix_entries.pop(k)
+        need = nblocks - m
+        blocks = self._reserve_take(need)
+        if blocks is None:
+            for blk in shared_blocks:
+                self._shared_refs[blk] -= 1
+            return None
+        all_blocks = shared_blocks + blocks
+        # Batched per-leaf pool write of the shipped blocks.
+        idxs = jnp.asarray(all_blocks[m:], jnp.int32)
+        for name in self.pool:
+            dtype = _np_leaf_dtype(leaves[name]["dtype"])
+            stacked = np.stack(
+                [
+                    np.frombuffer(
+                        base64.b64decode(entries[j]["data"][name]),
+                        dtype=dtype,
+                    ).reshape(shapes[name])
+                    for j in range(m, nblocks)
+                ],
+                axis=1,
+            )
+            self.pool[name] = self.pool[name].at[:, idxs].set(
+                jnp.asarray(stacked)
+            )
+        # Register the imported FULL blocks on the chain (same refcount
+        # convention as prefix admission: cache ref + this request).
+        if self._prefix_cache_enabled:
+            chain_parent = keys[m - 1] if m else None
+            for j in range(m, registrable):
+                self._prefix_entries[keys[j]] = {
+                    "block": all_blocks[j], "parent": chain_parent,
+                    "children": 0,
+                }
+                if chain_parent is not None:
+                    self._prefix_entries[chain_parent]["children"] += 1
+                self._shared_refs[all_blocks[j]] = 2
+                chain_parent = keys[j]
+            req.shared = frozenset(all_blocks[:registrable])
+            self.prefix_hits += m
+            self.prefix_misses += registrable - m
+        # Install as a DECODING slot — table/positions/mask exactly as
+        # anchored admission leaves them, decode continues at lng.
+        req.blocks = all_blocks
+        self.tables[slot] = 0
+        self.tables[slot, :nblocks] = all_blocks
+        self.positions[slot] = lng
+        self.kv_mask = self.kv_mask.at[slot].set(True)
+        temp = (self.gen.temperature if req.temperature is None
+                else req.temperature)
+        self.temps[slot] = temp
+        self._install_bias(slot, req)
+        req.budget = self._initial_budget(req)
+        self._by_slot[slot] = req
+        self.kv_imports += 1
+        self.kv_import_blocks_reused += m
+        self.kv_import_blocks_written += need
+        self._kv_pending_first.append((
+            slot, req.rid, int(payload["pending_token"]),
+            payload.get("pending_logprob"),
+        ))
+        return req.rid
+
+    def _deliver_imported(self) -> None:
+        """Feed imported requests' pending first tokens through the
+        normal retirement path (EOS/stop/budget/cancel semantics apply
+        verbatim). Runs at the top of every admission pass — i.e. the
+        first drive quantum after import_blocks() returned, once the
+        serving frontend has registered its per-request state."""
+        while self._kv_pending_first:
+            slot, rid, token, lp = self._kv_pending_first.pop(0)
+            req = self._by_slot[slot]
+            if req is None or req.rid != rid:
+                continue  # preempted/cancelled before delivery
+            self._note_token(slot, token, lp)
+
     # -- internals ---------------------------------------------------------
 
     def _admit_free_slots(self) -> None:
+        if self._kv_pending_first:
+            self._deliver_imported()
         if self.ragged:
             self._admit_free_slots_ragged()
             return

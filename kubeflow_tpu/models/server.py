@@ -232,6 +232,23 @@ def ragged_from_env() -> tuple[bool, Optional[int]]:
     return ragged, budget
 
 
+def tier_role_from_env() -> str:
+    """Consuming end of the disaggregated-serving role knob: what this
+    replica advertises on /stats (the gateway's tier membership signal).
+    Raises on garbage — a hand-set env var must not silently fall back."""
+    import os
+
+    from kubeflow_tpu.webhook.tpu_env import KUBEFLOW_TPU_GATEWAY_TIER_ROLE
+
+    raw = os.environ.get(KUBEFLOW_TPU_GATEWAY_TIER_ROLE, "").strip().lower()
+    if raw not in ("", "fused", "prefill", "decode"):
+        raise ValueError(
+            f"{KUBEFLOW_TPU_GATEWAY_TIER_ROLE}={raw!r}: want "
+            "fused/prefill/decode"
+        )
+    return raw or "fused"
+
+
 class InferenceServer:
     """HTTP front-end driving one batching engine on a background thread.
 
@@ -249,7 +266,8 @@ class InferenceServer:
                  default_deadline_s: Optional[float] = None,
                  max_deadline_s: Optional[float] = None,
                  drain_s: float = 5.0,
-                 metrics=None):
+                 metrics=None,
+                 tier_role: str = "fused"):
         # Request-lifecycle knobs (all overload protection):
         # - max_queue_depth: pending (unslotted) requests beyond this are
         #   shed with 429 + Retry-After instead of parking handler
@@ -264,6 +282,16 @@ class InferenceServer:
         if max_queue_depth < 1:
             raise ValueError(f"max_queue_depth must be >= 1, got "
                              f"{max_queue_depth}")
+        if tier_role not in ("fused", "prefill", "decode"):
+            raise ValueError(
+                f"tier_role must be fused/prefill/decode, got {tier_role!r}"
+            )
+        # Disaggregated serving: the role this replica ADVERTISES on
+        # /stats ("prefill" runs chunked prefill + exports paged KV,
+        # "decode" imports payloads and streams tokens, "fused" does
+        # both). The role is advisory — the engine serves whatever
+        # arrives — tier membership is the gateway's routing decision.
+        self.tier_role = tier_role
         # Env-gated tracing (no-op unless KUBEFLOW_TPU_TRACE_* is set, and
         # never clobbers a provider a test already installed).
         tracing.configure_from_env()
@@ -333,6 +361,13 @@ class InferenceServer:
         # mutations happen under self._lock.
         self._req_spans: dict[int, dict] = {}
         self._admit_ts: dict[int, float] = {}
+        # Pending KV exports (disaggregated prefill tier): rid →
+        # {"skip", "payload", "error"}. Registered at submit under the
+        # engine lock; _on_token serializes the blocks at first-token
+        # time (the only moment the slot still holds them AND the
+        # sampled token is known); the /kv/prefill handler reads the
+        # result after _Final arrives. Reaped in _finish.
+        self._kv_exports: dict[int, dict] = {}
         # Flight recorder: always on (a deque append per step), sharing
         # the engine's injectable clock so stall tests can drive it.
         self.flight = FlightRecorder(
@@ -398,6 +433,19 @@ class InferenceServer:
             span.end()
 
     def _on_token(self, rid: int, token: int) -> None:
+        exp = self._kv_exports.get(rid)
+        if exp is not None and exp["payload"] is None and exp["error"] is None:
+            # First token of a prefill-tier request: the slot still holds
+            # its blocks and positions[slot] == prompt KV length, so this
+            # is the one moment the handoff payload can be cut. The
+            # request retires right after (max_new_tokens=1) — its prefix
+            # chains stay registered, warming this replica's cache.
+            try:
+                exp["payload"] = self.engine.export_blocks(
+                    rid, skip_keys=exp["skip"]
+                )
+            except Exception as err:  # surfaced to the gateway as failure
+                exp["error"] = str(err)
         self._tokens_out += 1
         if rid in self._submit_ts:
             now_t = time.monotonic()
@@ -668,6 +716,7 @@ class InferenceServer:
                 temperature: Optional[float] = None,
                 stop=None, logit_bias=None,
                 deadline_s: Optional[float] = None,
+                kv_export=None,  # skip-key set → register a pending export
                 ) -> tuple[int, queue.Queue]:
         self._shed_check()  # fast path: 429/503 without the engine lock
         q: queue.Queue = queue.Queue()
@@ -702,6 +751,14 @@ class InferenceServer:
                                          temperature=temperature, stop=stop,
                                          logit_bias=logit_bias,
                                          deadline_s=deadline_s)
+            if kv_export is not None:
+                # Registered under the same lock hold as the submit:
+                # on_token cannot fire for this rid until the drive
+                # thread re-acquires the lock, so the registry is always
+                # visible before the export moment.
+                self._kv_exports[rid] = {
+                    "skip": kv_export, "payload": None, "error": None,
+                }
             self._queues[rid] = q
             self._submit_ts[rid] = time.monotonic()
             if tracing.enabled():
@@ -725,6 +782,62 @@ class InferenceServer:
             self._work.notify_all()
         return rid, q
 
+    def _submit_import(self, payload: dict, max_tokens: Optional[int],
+                       temperature: Optional[float] = None,
+                       stop=None, logit_bias=None,
+                       deadline_s: Optional[float] = None,
+                       ) -> tuple[int, queue.Queue]:
+        """Decode-tier admission: install an exported KV payload directly
+        into a slot (no re-prefill, no queue). Mirrors _submit's
+        bookkeeping; the queue-wait phase is zero by construction, so the
+        span registered under "prefill" is the import itself — _on_token
+        closes it at the (deferred) first token, keeping the
+        queue_wait + prefill + first_decode TTFT decomposition intact."""
+        self._shed_check()
+        q: queue.Queue = queue.Queue()
+        deadline_s = self._resolve_deadline(deadline_s)
+        with self._work:
+            if self._engine_error is not None:
+                raise EngineFailedError(self._engine_error)
+            if self._draining or self._shutdown:
+                raise DrainingError("server is draining; retry elsewhere")
+            if not hasattr(self.engine, "import_blocks"):
+                raise ValueError(
+                    "this replica's engine cannot import KV payloads "
+                    "(paged engines only)"
+                )
+            rid = self.engine.import_blocks(
+                payload, max_new_tokens=max_tokens,
+                temperature=temperature, stop=stop,
+                logit_bias=logit_bias, deadline_s=deadline_s,
+            )
+            if rid is None:
+                # Admission-watermark refusal: no slot or blocks free.
+                # 429 like any other shed — the gateway retries/falls
+                # back to fused routing.
+                with self._shed_lock:
+                    self._shed += 1
+                if self.metrics is not None:
+                    self.metrics.serving_requests_shed_total.inc()
+                raise OverloadedError(
+                    "no free slot/blocks for KV import; retry elsewhere"
+                )
+            now = time.monotonic()
+            self._queues[rid] = q
+            self._submit_ts[rid] = now
+            self._admit_ts[rid] = now
+            if tracing.enabled():
+                root = tracing.current_span()
+                self._req_spans[rid] = {
+                    "root": root,
+                    "prefill": tracing.get_tracer("server").begin_span(
+                        "kv_import", parent=root, rid=rid,
+                        blocks=len(payload.get("blocks") or []),
+                    ),
+                }
+            self._work.notify_all()
+        return rid, q
+
     def _cancel(self, rid: int, reason: str = "client disconnected") -> None:
         """Disconnect/abandonment path: mark the request cancelled under
         the engine lock. Queued requests abort immediately (on_abort
@@ -739,6 +852,7 @@ class InferenceServer:
     def _finish(self, rid: int) -> None:
         with self._lock:
             self._queues.pop(rid, None)
+            self._kv_exports.pop(rid, None)
             # Aborted requests never retire: reap their stamps here so
             # the timing dicts stay bounded on a long-running server.
             self._submit_ts.pop(rid, None)
@@ -858,6 +972,16 @@ class InferenceServer:
                                     hits / (hits + misses), 4
                                 ) if hits + misses else 0.0,
                             }
+                        kv = None
+                        if hasattr(server.engine, "import_blocks"):
+                            kv = {
+                                "exports": server.engine.kv_exports,
+                                "imports": server.engine.kv_imports,
+                                "import_blocks_reused":
+                                    server.engine.kv_import_blocks_reused,
+                                "import_blocks_written":
+                                    server.engine.kv_import_blocks_written,
+                            }
                         rag = None
                         if getattr(server.engine, "ragged", False):
                             steps = server.engine.ragged_steps
@@ -916,6 +1040,11 @@ class InferenceServer:
                         "max_queue_depth": server.max_queue_depth,
                         "draining": server._draining,
                         "drain_duration_s": server._drain_duration,
+                        # Disaggregated serving: the gateway's tier-
+                        # membership signal plus the engine's handoff
+                        # counters.
+                        "tier_role": server.tier_role,
+                        **({"kv_handoff": kv} if kv is not None else {}),
                         **({"ragged": rag} if rag is not None else {}),
                         **({"prefix_cache": pc} if pc is not None else {}),
                         # Flight-recorder view (stall count surfaces the
@@ -933,7 +1062,10 @@ class InferenceServer:
                     self._json(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path != "/v1/completions":
+                if self.path == "/kv/probe":
+                    self._kv_probe()
+                    return
+                if self.path not in ("/v1/completions", "/kv/prefill"):
                     self._json(404, {"error": "not found"})
                     return
                 # Root span for the replica-side request. A gateway hop
@@ -949,7 +1081,157 @@ class InferenceServer:
                         or span.trace_id
                         or tracing.new_trace_id()
                     )
-                    self._completions(span)
+                    if self.path == "/kv/prefill":
+                        self._kv_prefill(span)
+                    else:
+                        self._completions(span)
+
+            def _kv_probe(self):
+                """Suffix-transfer negotiation: given the payload's chain
+                keys (hex, chain order), how many leading blocks does
+                this replica's prefix cache already hold? Matching does
+                NOT pin — an eviction can race the subsequent import,
+                which then refuses the stubbed payload (KeyError → 409)
+                and the gateway falls back to a full transfer."""
+                try:
+                    body = _read_body(self, server.max_body_bytes)
+                    req = json.loads(body or b"{}")
+                    keys = req.get("keys") or []
+                    if not isinstance(keys, list) or not all(
+                        isinstance(k, str) for k in keys
+                    ):
+                        raise ValueError("keys must be a list of hex strings")
+                    raw = [bytes.fromhex(k) for k in keys]
+                except BodyTooLarge as err:
+                    self._json(413, {"error": str(err)})
+                    return
+                except (ValueError, json.JSONDecodeError) as err:
+                    self._json(400, {"error": str(err)})
+                    return
+                matched = 0
+                with server._lock:
+                    entries = getattr(server.engine, "_prefix_entries", None)
+                    if entries is not None and getattr(
+                        server.engine, "_prefix_cache_enabled", False
+                    ):
+                        for k in raw:
+                            if k not in entries:
+                                break
+                            matched += 1
+                self._json(200, {"matched": matched})
+
+            def _kv_prefill(self, span):
+                """Prefill-tier hop: run the prompt's chunked prefill,
+                sample ONE token, and cut the paged-KV handoff payload at
+                first-token time. The request retires immediately after
+                (its prefix chains stay registered, so the prefill tier
+                self-warms); the decode continuation happens wherever the
+                gateway imports the payload. A request that finishes AT
+                the first token (EOS / 1-token stop match) returns its
+                final tokens with no decode hop needed."""
+                try:
+                    body = _read_body(self, server.max_body_bytes)
+                except BodyTooLarge as err:
+                    self._json(413, {"error": str(err)})
+                    return
+                except ValueError as err:
+                    self._json(400, {"error": str(err)})
+                    return
+                try:
+                    req = json.loads(body or b"{}")
+                    prompt = server._decode_prompt(req.get("prompt"))
+                    skip = req.get("skip_keys") or []
+                    if not isinstance(skip, list) or not all(
+                        isinstance(k, str) for k in skip
+                    ):
+                        raise ValueError(
+                            "skip_keys must be a list of hex strings"
+                        )
+                    temperature = req.get("temperature")
+                    stop = server._decode_stop(req.get("stop"))
+                    logit_bias = req.get("logit_bias")
+                    if logit_bias is not None and not isinstance(
+                        logit_bias, dict
+                    ):
+                        raise ValueError(
+                            "logit_bias must be an object mapping token "
+                            "ids to biases"
+                        )
+                    deadline_s = req.get("deadline_s")
+                    if deadline_s is not None and (
+                        isinstance(deadline_s, bool)
+                        or not isinstance(deadline_s, (int, float))
+                        or not math.isfinite(deadline_s)
+                        or deadline_s <= 0
+                    ):
+                        raise ValueError(
+                            f"deadline_s must be a finite number > 0, "
+                            f"got {deadline_s!r}"
+                        )
+                    if not hasattr(server.engine, "export_blocks"):
+                        raise ValueError(
+                            "this replica's engine cannot export KV "
+                            "payloads (prefix_cache paged engines only)"
+                        )
+                except (ValueError, TypeError, json.JSONDecodeError) as err:
+                    self._json(400, {"error": str(err)})
+                    return
+                span.set_attribute("prompt_tokens", len(prompt))
+                span.set_attribute("kv_prefill", True)
+                try:
+                    rid, q = server._submit(
+                        prompt, 1, req.get("model"), temperature, stop,
+                        logit_bias, deadline_s, kv_export=frozenset(skip),
+                    )
+                except OverloadedError as err:
+                    self.send_response(429)
+                    self._retry_after_close(str(err))
+                    return
+                except DrainingError as err:
+                    self.send_response(503)
+                    self._retry_after_close(str(err))
+                    return
+                except EngineFailedError as err:
+                    self._json(503, {"error": str(err)})
+                    return
+                except ValueError as err:
+                    self._json(400, {"error": str(err)})
+                    return
+                # The registry entry outlives _finish's pop — grab the
+                # reference now, read it once _Final lands.
+                exp = server._kv_exports.get(rid)
+                try:
+                    tokens: list = []
+                    while True:
+                        try:
+                            item = q.get(timeout=0.25)
+                        except queue.Empty:
+                            if _client_gone(self.connection):
+                                server._cancel(rid)
+                                return
+                            continue
+                        if isinstance(item, (_Final, _Abort)):
+                            break
+                        tokens.append(item)
+                    if isinstance(item, _Abort):
+                        code = 504 if item.reason == "deadline" else 500
+                        self._json(code, {"error": item.reason,
+                                          "partial_tokens": tokens})
+                        return
+                    if exp is not None and exp["error"] is not None:
+                        self._json(500, {"error": exp["error"]})
+                        return
+                    self._json(200, {
+                        "id": f"cmpl-{rid}",
+                        "payload": exp["payload"] if exp else None,
+                        "finished": {
+                            "tokens": item.tokens,
+                            "logprobs": item.logprobs,
+                            "finish_reason": item.finish_reason,
+                        },
+                    })
+                finally:
+                    server._finish(rid)
 
             def _completions(self, span):
                 try:
@@ -962,7 +1244,22 @@ class InferenceServer:
                     return
                 try:
                     req = json.loads(body or b"{}")
-                    prompt = server._decode_prompt(req.get("prompt"))
+                    kv_import = req.get("kv_import")
+                    if kv_import is not None and not isinstance(
+                        kv_import, dict
+                    ):
+                        raise ValueError(
+                            "kv_import must be an exported KV payload "
+                            "object"
+                        )
+                    if kv_import is not None:
+                        # The payload carries the prompt; its token list
+                        # doubles as the usage/span accounting below.
+                        prompt = [
+                            int(t) for t in kv_import.get("tokens") or []
+                        ]
+                    else:
+                        prompt = server._decode_prompt(req.get("prompt"))
                     max_tokens = req.get("max_tokens")
                     if max_tokens is not None and (
                         not isinstance(max_tokens, int)
@@ -1019,6 +1316,8 @@ class InferenceServer:
                             "this engine does not compute logprobs "
                             "(speculative serving verifies argmax rounds)"
                         )
+                    if kv_import is not None and n != 1:
+                        raise ValueError("kv_import does not support n > 1")
                 except (ValueError, TypeError, json.JSONDecodeError) as err:
                     self._json(400, {"error": str(err)})
                     return
@@ -1028,12 +1327,18 @@ class InferenceServer:
                 subs = []
                 try:
                     try:
-                        for _ in range(n):
-                            subs.append(server._submit(
-                                prompt, max_tokens, req.get("model"),
-                                temperature, stop, logit_bias,
-                                deadline_s,
+                        if kv_import is not None:
+                            subs.append(server._submit_import(
+                                kv_import, max_tokens, temperature,
+                                stop, logit_bias, deadline_s,
                             ))
+                        else:
+                            for _ in range(n):
+                                subs.append(server._submit(
+                                    prompt, max_tokens, req.get("model"),
+                                    temperature, stop, logit_bias,
+                                    deadline_s,
+                                ))
                     except OverloadedError as err:
                         # Shed mid-loop for n>1: already-submitted
                         # choices are dead work — cancel them so the
@@ -1055,6 +1360,13 @@ class InferenceServer:
                         return
                     except ValueError as err:  # over-bucket prompt etc.
                         self._json(400, {"error": str(err)})
+                        return
+                    except KeyError as err:
+                        # Stubbed KV payload whose chain is no longer
+                        # cached here (suffix transfer raced an eviction):
+                        # 409 tells the gateway to resend with full data
+                        # or fall back to fused routing.
+                        self._json(409, {"error": str(err)})
                         return
                     if stream:
                         self._stream(*subs[0])

@@ -524,6 +524,20 @@ class FleetTelemetry:
     def observe_reroute(self) -> None:
         self.hub.inc("reroutes")
 
+    def observe_kv_transfer(self, nbytes: int, latency_s: float,
+                            ok: bool = True) -> None:
+        """One prefill→decode paged-KV handoff attempt (disaggregated
+        serving): byte volume + hop latency, failures counted separately
+        so /debug/signals can show the fallback rate next to the
+        transfer rate."""
+        hub = self.hub
+        if ok:
+            hub.inc("kv_transfers")
+            hub.inc("kv_transfer_bytes", float(nbytes))
+            hub.observe("kv_transfer_s", latency_s)
+        else:
+            hub.inc("kv_transfer_failures")
+
     def ingest_ring(self, size: int) -> None:
         self.hub.set_gauge("ring_size", float(size))
 
@@ -629,6 +643,11 @@ class FleetTelemetry:
                 "errors_per_s": _rate("errors"),
                 "shed_per_s": _rate("shed"),
                 "reroutes_per_s": _rate("reroutes"),
+                # Disaggregated serving: KV handoff volume + hop latency.
+                "kv_transfers_per_s": _rate("kv_transfers"),
+                "kv_transfer_failures_per_s": _rate("kv_transfer_failures"),
+                "kv_transfer_bytes_per_s": _rate("kv_transfer_bytes"),
+                "kv_transfer_s": _hist("kv_transfer_s"),
                 "served_per_s": _rate("fleet_served"),
                 "tokens_per_s": _rate("fleet_tokens"),
                 "stalls_per_s": _rate("fleet_stalls"),

@@ -62,6 +62,15 @@ KUBEFLOW_TPU_GATEWAY_REPLICAS = "KUBEFLOW_TPU_GATEWAY_REPLICAS"
 KUBEFLOW_TPU_GATEWAY_AFFINITY = "KUBEFLOW_TPU_GATEWAY_AFFINITY"
 KUBEFLOW_TPU_GATEWAY_HASH_SEED = "KUBEFLOW_TPU_GATEWAY_HASH_SEED"
 KUBEFLOW_TPU_GATEWAY_REROUTE_BUDGET = "KUBEFLOW_TPU_GATEWAY_REROUTE_BUDGET"
+# Disaggregated prefill/decode serving (models/gateway.py tier routing +
+# models/server.py tier_role_from_env): tier membership and the
+# prefill→decode paged-KV transfer hop's limits.
+KUBEFLOW_TPU_GATEWAY_TIER_MODE = "KUBEFLOW_TPU_GATEWAY_TIER_MODE"
+KUBEFLOW_TPU_GATEWAY_TIER_PREFILL = "KUBEFLOW_TPU_GATEWAY_TIER_PREFILL"
+KUBEFLOW_TPU_GATEWAY_TIER_DECODE = "KUBEFLOW_TPU_GATEWAY_TIER_DECODE"
+KUBEFLOW_TPU_GATEWAY_TIER_ROLE = "KUBEFLOW_TPU_GATEWAY_TIER_ROLE"
+KUBEFLOW_TPU_KV_TRANSFER_TIMEOUT_S = "KUBEFLOW_TPU_KV_TRANSFER_TIMEOUT_S"
+KUBEFLOW_TPU_KV_TRANSFER_MAX_BYTES = "KUBEFLOW_TPU_KV_TRANSFER_MAX_BYTES"
 # Persistent JAX compilation cache (bench.py capture windows; any runtime
 # entrypoint may opt in): compiled executables survive process restarts.
 KUBEFLOW_TPU_COMPILE_CACHE_DIR = "KUBEFLOW_TPU_COMPILE_CACHE_DIR"
@@ -138,6 +147,30 @@ ENV_CONTRACT: dict = {
     KUBEFLOW_TPU_GATEWAY_REROUTE_BUDGET: "operator-set on the gateway "
     "container: max alternate ring nodes tried after a 503/429/connect "
     "failure before the gateway gives up (default 2)",
+    KUBEFLOW_TPU_GATEWAY_TIER_MODE: "operator-set on the gateway "
+    "container: 'fused' (default — every replica prefills and decodes) "
+    "or 'disagg' (token-id requests prefill on the prefill tier, ship "
+    "paged KV to the decode tier, and fall back to fused routing when "
+    "either tier is empty or the transfer fails within budget)",
+    KUBEFLOW_TPU_GATEWAY_TIER_PREFILL: "operator-set on the gateway "
+    "container: comma-separated host:port endpoints pinned to the "
+    "prefill tier (roles also follow each replica's /stats tier_role; "
+    "this list wins at startup)",
+    KUBEFLOW_TPU_GATEWAY_TIER_DECODE: "operator-set on the gateway "
+    "container: comma-separated host:port endpoints pinned to the "
+    "decode tier (see TIER_PREFILL)",
+    KUBEFLOW_TPU_GATEWAY_TIER_ROLE: "operator-set on the serving "
+    "container: the role this replica advertises on /stats — "
+    "fused (default) / prefill / decode — consumed by "
+    "models/server.py tier_role_from_env",
+    KUBEFLOW_TPU_KV_TRANSFER_TIMEOUT_S: "operator-set on the gateway "
+    "container: socket timeout for one prefill→decode KV-transfer hop "
+    "in seconds (default 30; each hop is also capped by the request's "
+    "remaining deadline)",
+    KUBEFLOW_TPU_KV_TRANSFER_MAX_BYTES: "operator-set on the gateway "
+    "container: serialized KV payload ceiling in bytes — larger "
+    "transfers fall back to fused routing (default 64 MiB; replica "
+    "max_body_bytes must admit at least this much)",
     KUBEFLOW_TPU_COMPILE_CACHE_DIR: "operator-set (bench watcher env or "
     "notebook container): directory for JAX's persistent compilation "
     "cache; bench.py enables it at startup and stamps the dir into "

@@ -110,6 +110,14 @@ def _load_model():
     return _MODEL
 
 
+def _record_host() -> str:
+    """``tpu`` or ``cpu`` next to ``provenance`` in every artifact: a
+    smoke record from a CPU runner must never read like a chip number."""
+    import jax
+
+    return "tpu" if jax.default_backend() in ("tpu", "axon") else "cpu"
+
+
 SLOTS = 2
 
 
@@ -453,18 +461,307 @@ def _verify_trace_export(min_chains: int):
     return {"complete_chains": chains, "traces": len(by_trace)}
 
 
+# -- disaggregated prefill/decode arm (--disagg) ------------------------
+
+DISAGG_LONG_BLOCKS = 12    # storm prompt length, in full KV blocks
+DISAGG_SHORT_TOKENS = 20   # one full block + a short tail
+DISAGG_DECODE_TOKENS = 10  # 9 inter-token gaps per short request
+DISAGG_SLOTS = 4
+
+
+def _disagg_prompt(nonce: int, length: int, vocab: int) -> list:
+    """Unique prompt per request (arithmetic in the nonce, no RNG): the
+    storm measures PREFILL interference with decode, so nothing may
+    prefix-hit and skip its prefill."""
+    return [3 + (nonce * 131 + i * 7) % (vocab - 4) for i in range(length)]
+
+
+def _make_disagg_engine():
+    from kubeflow_tpu.models.paged import PagedBatcher, pool_blocks_from_hbm
+    from kubeflow_tpu.models.serving import GenerationConfig
+
+    params, cfg = _load_model()
+    bucket = (DISAGG_LONG_BLOCKS + 2) * BLOCK_SIZE
+    per_seq = -(-(bucket + DISAGG_DECODE_TOKENS) // BLOCK_SIZE) + 1
+    floor = DISAGG_SLOTS * per_seq + 2
+    # Pools size themselves from the device's real HBM budget
+    # (memory_stats) on TPU; on CPU (no memory_stats) the fallback IS
+    # the computed worst-case constant, and the max() keeps a tiny HBM
+    # answer from under-sizing below what the slots can demand.
+    blocks = max(pool_blocks_from_hbm(
+        cfg, BLOCK_SIZE, fraction=0.3, fallback=floor), floor)
+    return PagedBatcher(
+        params, cfg,
+        gen=GenerationConfig(max_new_tokens=DISAGG_DECODE_TOKENS,
+                             eos_id=-1),
+        slots=DISAGG_SLOTS, num_blocks=blocks, block_size=BLOCK_SIZE,
+        prompt_bucket=bucket, prefix_cache=True,
+    )
+
+
+def _build_disagg_fleet(mode: str):
+    """mode="disagg": 1 prefill + 2 decode replicas behind a tier-aware
+    gateway; mode="fused": the control — 3 fused replicas, same engines
+    and total capacity, only the tier split differs."""
+    from kubeflow_tpu.models.gateway import ServingGateway
+    from kubeflow_tpu.models.server import InferenceServer
+
+    _, cfg = _load_model()
+    roles = (["prefill", "decode", "decode"] if mode == "disagg"
+             else ["fused"] * 3)
+    servers = [
+        InferenceServer(_make_disagg_engine(), port=0, drain_s=2.0,
+                        tier_role=role).start()
+        for role in roles
+    ]
+    tier_roles = {f"{s.host}:{s.port}": role
+                  for s, role in zip(servers, roles) if role != "fused"}
+    gw = ServingGateway(
+        [f"{s.host}:{s.port}" for s in servers], port=0,
+        affinity="prefix", block_size=BLOCK_SIZE, health_interval_s=0.2,
+        reroute_budget=2,
+        tier_mode="disagg" if mode == "disagg" else "fused",
+        tier_roles=tier_roles,
+    ).start()
+    return gw, servers, cfg
+
+
+def _stream_gaps(gw, prompt, tenant: str, timeout: float = 120.0):
+    """One streaming completion; returns (ok, [inter-token gaps in
+    seconds], detail). The gaps — wall-clock between consecutive SSE
+    data lines at the client — are the decode-interference signal the
+    disagg arm gates on."""
+    conn = http.client.HTTPConnection(gw.host, gw.port, timeout=timeout)
+    try:
+        conn.request(
+            "POST", "/v1/completions",
+            json.dumps({"prompt": prompt, "stream": True,
+                        "max_tokens": DISAGG_DECODE_TOKENS,
+                        "user": tenant}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return False, [], f"HTTP {resp.status}"
+        gaps: list = []
+        last = None
+        finished = False
+        error = None
+        while True:
+            line = resp.fp.readline()
+            if not line:
+                break
+            if not line.startswith(b"data:"):
+                continue
+            if line == b"data: [DONE]\n":
+                finished = True
+                break
+            if b'"error"' in line:
+                error = line.decode().strip()
+                continue
+            now = time.perf_counter()
+            if last is not None:
+                gaps.append(now - last)
+            last = now
+        if not finished or error:
+            return False, gaps, error or "truncated stream"
+        return True, gaps, ""
+    except OSError as err:
+        return False, [], str(err)
+    finally:
+        conn.close()
+
+
+def _drive_disagg_round(gw, vocab: int, nonce_base: int, per_round: int,
+                        long_every: int, outcomes: list) -> None:
+    """One concurrent round. long_every=0 → all-short (the quiet
+    baseline); long_every=4 → the 1-in-4 long-prompt storm."""
+    threads = []
+    for i in range(per_round):
+        is_long = bool(long_every) and i % long_every == 0
+        length = (DISAGG_LONG_BLOCKS * BLOCK_SIZE + 3 if is_long
+                  else DISAGG_SHORT_TOKENS)
+        prompt = _disagg_prompt(nonce_base + i, length, vocab)
+
+        def work(p=prompt, lng=is_long, name=f"tenant-{i % 4}"):
+            ok, gaps, detail = _stream_gaps(gw, p, name)
+            outcomes.append((lng, ok, gaps, detail))
+
+        th = threading.Thread(target=work, daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+
+
+def run_disagg_arm(mode: str, *, rounds: int, per_round: int) -> dict:
+    gw, servers, cfg = _build_disagg_fleet(mode)
+    telemetry = _build_telemetry()
+    try:
+        # Warm-up: one storm-shaped round compiles EVERY shape either
+        # phase can hit (short/long prefill, KV export gathers, import
+        # writes at both block counts) before anything is timed.
+        sink: list = []
+        _drive_disagg_round(gw, cfg.vocab_size, 5_000_000, per_round, 4,
+                            sink)
+        bad = [d for _, ok, _, d in sink if not ok]
+        if bad:
+            raise RuntimeError(f"{mode} warm-up failures: {bad}")
+        gw.telemetry = telemetry
+        gw._tenant_buckets = telemetry.tenants
+        quiet: list = []
+        for r in range(rounds):
+            _drive_disagg_round(gw, cfg.vocab_size, r * per_round,
+                                per_round, 0, quiet)
+        storm: list = []
+        for r in range(rounds):
+            _drive_disagg_round(gw, cfg.vocab_size,
+                                1_000_000 + r * per_round, per_round, 4,
+                                storm)
+        gw.probe_once()
+        stats = gw.stats()
+        signals = _debug_json(gw, "/debug/signals")
+        slo = _debug_json(gw, "/debug/slo")
+        failures = [d for _, ok, _, d in quiet + storm if not ok]
+        quiet_gaps = [g for _, ok, gaps, _ in quiet if ok for g in gaps]
+        # The gate reads SHORT requests only: a long request's own gaps
+        # say nothing about cross-request interference.
+        storm_gaps = [g for lng, ok, gaps, _ in storm
+                      if ok and not lng for g in gaps]
+        quiet_p95 = _p95_ms(quiet_gaps) if quiet_gaps else 0.0
+        storm_p95 = _p95_ms(storm_gaps) if storm_gaps else 0.0
+        breaches = sum(o["breaches_total"]
+                       for o in slo.get("objectives", {}).values())
+        return {
+            "mode": mode,
+            "requests_completed": sum(
+                1 for _, ok, _, _ in quiet + storm if ok),
+            "failures": failures,
+            "quiet_inter_token_p95_ms": quiet_p95,
+            "storm_inter_token_p95_ms": storm_p95,
+            "storm_over_quiet": round(storm_p95 / max(quiet_p95, 1e-9), 3),
+            "kv_transfers": stats["kv_transfers"],
+            "kv_transfer_failures": stats["kv_transfer_failures"],
+            "kv_transfer_bytes": stats["kv_transfer_bytes"],
+            "kv_transfer_latency_s": stats["kv_transfer_latency_s"],
+            "signals_kv_transfer_s": (signals.get("fleet") or {}).get(
+                "kv_transfer_s"),
+            "slo": {
+                "breaching": slo.get("breaching", []),
+                "breaches_total": breaches,
+            },
+        }
+    finally:
+        gw.stop()
+        for s in servers:
+            s.stop()
+
+
+def main_disagg(args) -> int:
+    """--disagg: the tier-split experiment. The disagg fleet's decode
+    tier must stay flat through the long-prompt storm (p95 inter-token
+    ≤ 1.1× its own quiet baseline, small absolute floor for loopback
+    jitter) while the same-capacity fused fleet degrades — plus the PR
+    11 SLO gate (zero breaches) and zero failed requests on both arms."""
+    global DISAGG_LONG_BLOCKS, DISAGG_DECODE_TOKENS
+    rounds, per_round = 3, 8
+    if args.smoke:
+        DISAGG_LONG_BLOCKS, DISAGG_DECODE_TOKENS = 4, 6
+        rounds, per_round = 1, 4
+    print("# disagg arm: 1 prefill + 2 decode replicas, 1-in-4 "
+          "long-prompt storm ...", file=sys.stderr)
+    disagg = run_disagg_arm("disagg", rounds=rounds, per_round=per_round)
+    print("# fused control arm (same engines, no tier split) ...",
+          file=sys.stderr)
+    fused = run_disagg_arm("fused", rounds=rounds, per_round=per_round)
+
+    record = {
+        "scenario": (
+            f"1-in-4 long-prompt storm ({DISAGG_LONG_BLOCKS} blocks) over "
+            "a 1-prefill + 2-decode tier split with paged-KV handoff vs "
+            "the same 3 engines fused"
+        ),
+        "model": "tiny",
+        "block_size": BLOCK_SIZE,
+        "long_blocks": DISAGG_LONG_BLOCKS,
+        "decode_tokens": DISAGG_DECODE_TOKENS,
+        "rounds": rounds,
+        "per_round": per_round,
+        "provenance": "smoke" if args.smoke else "live",
+        "host": _record_host(),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "disagg": disagg,
+        "fused": fused,
+    }
+    print(json.dumps({
+        "disagg_quiet_p95_ms": disagg["quiet_inter_token_p95_ms"],
+        "disagg_storm_p95_ms": disagg["storm_inter_token_p95_ms"],
+        "disagg_storm_over_quiet": disagg["storm_over_quiet"],
+        "fused_storm_over_quiet": fused["storm_over_quiet"],
+        "kv_transfers": disagg["kv_transfers"],
+        "kv_transfer_failures": disagg["kv_transfer_failures"],
+        "slo_breaches": (disagg["slo"]["breaches_total"]
+                         + fused["slo"]["breaches_total"]),
+    }))
+    clean = (
+        not disagg["failures"] and not fused["failures"]
+        and disagg["kv_transfers"] > 0
+        and disagg["kv_transfer_failures"] == 0
+        and disagg["slo"]["breaches_total"] == 0
+        and fused["slo"]["breaches_total"] == 0
+    )
+    if not clean:
+        print("# disagg gate FAILED: " + json.dumps({
+            "disagg_failures": disagg["failures"],
+            "fused_failures": fused["failures"],
+            "kv": {k: disagg[k] for k in
+                   ("kv_transfers", "kv_transfer_failures")},
+            "slo": {"disagg": disagg["slo"], "fused": fused["slo"]},
+        }), file=sys.stderr)
+    if args.smoke:
+        print("# --smoke: artifact write and win gate skipped",
+              file=sys.stderr)
+        return 0 if clean else 1
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(tmp, args.out)
+    print(f"# wrote {args.out}", file=sys.stderr)
+    flat = (
+        disagg["storm_inter_token_p95_ms"]
+        <= max(1.1 * disagg["quiet_inter_token_p95_ms"],
+               disagg["quiet_inter_token_p95_ms"] + 10.0)
+    )
+    degrades = fused["storm_over_quiet"] > 1.1
+    win = clean and flat and degrades
+    if not win:
+        print("# win gate: " + json.dumps({
+            "decode_tier_flat": flat, "fused_degrades": degrades,
+        }), file=sys.stderr)
+    return 0 if win else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default=str(
-        Path(__file__).resolve().parent.parent / "SERVE_r07_fleet.json"))
+    ap.add_argument("--out", default=None)
     ap.add_argument("--replicas", type=int, default=3)
     ap.add_argument("--tenants", type=int, default=6)
     ap.add_argument("--rounds", type=int, default=6)
     ap.add_argument("--churn-rounds", type=int, default=6)
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the disaggregated prefill/decode tier "
+                         "experiment instead of affinity-vs-random "
+                         "(artifact: SERVE_r08_disagg.json)")
     ap.add_argument("--smoke", action="store_true",
                     help="2 replicas x 2 tenants x 2 rounds, no artifact, "
                          "no win gate — CI executability tier")
     args = ap.parse_args()
+    root = Path(__file__).resolve().parent.parent
+    if args.out is None:
+        args.out = str(root / ("SERVE_r08_disagg.json" if args.disagg
+                               else "SERVE_r07_fleet.json"))
+    if args.disagg:
+        return main_disagg(args)
     if args.smoke:
         global PREFIX_BLOCKS
         args.replicas, args.tenants = 2, 2
@@ -507,6 +804,7 @@ def main() -> int:
         "block_size": BLOCK_SIZE,
         "prefix_blocks": PREFIX_BLOCKS,
         "provenance": "smoke" if args.smoke else "live",
+        "host": _record_host(),
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "affinity": affinity,
         "random": random_arm,

@@ -164,6 +164,8 @@ def main() -> int:
         "prompt_bucket": args.bucket,
         "token_budget": args.token_budget,
         "provenance": "live",
+        "host": "tpu" if jax.default_backend() in ("tpu", "axon")
+        else "cpu",
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "baseline": baseline,
         "ragged": ragged,

@@ -53,6 +53,10 @@ def test_catalog_has_reference_parity_experiments():
         # Fleet gateway (models/gateway.py): replica death mid-stream —
         # bounded error burst, ring heals, throughput recovers.
         "gateway-replica-kill",
+        # Disaggregated serving: prefill pod death mid-KV-export — the
+        # handoff re-routes within budget, never silent truncation, and
+        # the decode tier stays healthy.
+        "serving-kv-handoff-loss",
     }
 
 

@@ -1,0 +1,348 @@
+"""Disaggregated serving: paged-KV export/import handoff.
+
+The prefill→decode transfer must be invisible to the client: byte-exact
+KV blocks on the wire (bf16 AND the int8 ``kv_bits=8`` layout), token-
+exact decode after the handoff vs a single fused replica, suffix-only
+transfer when the decode side already holds the prefix chain, and the
+gateway's ``kv_transfer`` span stitched between the prefill tier's
+``prefill`` span and the decode tier's ``first_decode``.
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama as L
+from kubeflow_tpu.models.gateway import chain_key, prompt_chain_keys
+from kubeflow_tpu.models.paged import PagedBatcher, pool_blocks_from_hbm
+from kubeflow_tpu.models.serving import GenerationConfig
+
+BS = 8
+PROMPT = [5, 9, 17, 33, 2, 11, 44, 3, 8, 21]  # 10 tokens → 2 blocks
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = L.LLAMA_CONFIGS["tiny"]
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _engine(tiny, kv_bits=0, max_new=8, slots=2, num_blocks=16,
+            bucket=16, prefix_cache=True):
+    cfg, params = tiny
+    return PagedBatcher(
+        params, cfg, gen=GenerationConfig(max_new_tokens=max_new, eos_id=-1),
+        slots=slots, num_blocks=num_blocks, block_size=BS,
+        prompt_bucket=bucket, prefix_cache=prefix_cache, kv_bits=kv_bits,
+    )
+
+
+def _prefill_payload(engine, prompt, skip_keys=()):
+    """Run ``prompt`` as a prefill-tier request (max_new_tokens=1) and
+    export at first-token time — the same moment the server's on_token
+    hook exports."""
+    out = {}
+    engine.on_token = lambda rid, tok: out.setdefault(
+        rid, engine.export_blocks(rid, skip_keys=skip_keys))
+    rid = engine.submit(prompt, max_new_tokens=1)
+    engine.run()
+    engine.on_token = None
+    return out[rid]
+
+
+class TestChainKeyParity:
+    def test_three_implementations_and_pinned_digest(self):
+        """gateway.chain_key, PagedBatcher._chain_key, and
+        prompt_chain_keys walk the SAME hash chain — pinned to literal
+        digests so no implementation can drift without failing here
+        (cross-host handoff depends on byte-identical keys)."""
+        prompt = list(range(1, 20))  # 19 tokens → 2 registrable blocks
+        keys = prompt_chain_keys(prompt, BS)
+        assert [k.hex() for k in keys] == [
+            "11e25c6a60ac62686eb6e65c3ae15d0c19e1a458",
+            "5cad69e653e820a10b9e816d2cdd6a92f1069b42",
+        ]
+        k0 = chain_key(None, prompt[:BS])
+        k1 = chain_key(k0, prompt[BS:2 * BS])
+        assert [k0, k1] == keys
+        assert PagedBatcher._chain_key(None, prompt[:BS]) == k0
+        assert PagedBatcher._chain_key(k0, prompt[BS:2 * BS]) == k1
+
+    def test_tail_block_excluded(self):
+        # 16 tokens = exactly 2 blocks, but the last is the tail block
+        # (never registered), so only 1 key is walkable.
+        assert len(prompt_chain_keys(list(range(16)), BS)) == 1
+        assert prompt_chain_keys([1], BS) == []
+
+
+class TestExportImport:
+    @pytest.mark.parametrize("kv_bits", [0, 8])
+    def test_byte_roundtrip(self, tiny, kv_bits):
+        """Every exported leaf re-materializes byte-identically in the
+        importing pool — bf16 and the int8+scales layout."""
+        a = _engine(tiny, kv_bits=kv_bits)
+        payload = _prefill_payload(a, PROMPT)
+        assert payload["kv_bits"] == kv_bits
+        assert payload["pending_token"] >= 0
+        b = _engine(tiny, kv_bits=kv_bits)
+        rid = b.import_blocks(payload, max_new_tokens=1)
+        assert rid is not None
+        slot = next(i for i, r in enumerate(b._by_slot)
+                    if r is not None and r.rid == rid)
+        blocks = b._by_slot[slot].blocks
+        for j, ent in enumerate(payload["blocks"]):
+            for name, b64 in ent["data"].items():
+                got = np.ascontiguousarray(
+                    np.asarray(b.pool[name][:, blocks[j]])).tobytes()
+                assert got == base64.b64decode(b64), (kv_bits, j, name)
+
+    @pytest.mark.parametrize("kv_bits", [0, 8])
+    def test_decode_after_handoff_token_exact(self, tiny, kv_bits):
+        """Handoff decode == single-replica decode, token for token."""
+        a = _engine(tiny, kv_bits=kv_bits)
+        payload = _prefill_payload(a, PROMPT)
+        b = _engine(tiny, kv_bits=kv_bits)
+        rid = b.import_blocks(payload, max_new_tokens=8)
+        got = b.run()[rid]
+        c = _engine(tiny, kv_bits=kv_bits)
+        r = c.submit(PROMPT, max_new_tokens=8)
+        ref = c.run()[r]
+        assert got == ref
+        assert len(got) == 8
+        assert a.kv_exports == 1 and b.kv_imports == 1
+
+    def test_suffix_only_transfer_reuses_cached_chain(self, tiny):
+        """A decode replica already holding the prefix chain receives
+        stubs for those blocks and reuses its cached copies — still
+        token-exact."""
+        skip = [k.hex() for k in prompt_chain_keys(PROMPT, BS)]
+        b = _engine(tiny)
+        b.submit(PROMPT, max_new_tokens=8)
+        b.run()  # warms b's chain for the registrable prefix block
+        a = _engine(tiny)
+        payload = _prefill_payload(a, PROMPT, skip_keys=skip)
+        stubs = ["data" not in e for e in payload["blocks"]]
+        assert stubs == [True, False]  # prefix stubbed, tail ships
+        rid = b.import_blocks(payload, max_new_tokens=8)
+        got = b.run()[rid]
+        assert b.kv_import_blocks_reused == 1
+        assert b.kv_import_blocks_written == 1
+        c = _engine(tiny)
+        r = c.submit(PROMPT, max_new_tokens=8)
+        assert got == c.run()[r]
+
+    def test_import_returns_none_when_no_slot_or_blocks(self, tiny):
+        a = _engine(tiny)
+        payload = _prefill_payload(a, PROMPT)
+        # No free slot: both slots occupied by live requests.
+        b = _engine(tiny, slots=1)
+        b.submit([1, 2, 3], max_new_tokens=32)
+        b.drive_once()  # admits into the only slot
+        assert b.import_blocks(payload, max_new_tokens=4) is None
+        # No free blocks: pool too small for the payload's 2 blocks.
+        c = _engine(tiny, num_blocks=2)  # block 0 reserved → 1 usable
+        assert c.import_blocks(payload, max_new_tokens=4) is None
+        assert c.free_blocks == 1  # refusal leaked nothing
+
+    def test_import_validates_payload(self, tiny):
+        a = _engine(tiny)
+        payload = _prefill_payload(a, PROMPT)
+        b = _engine(tiny)
+        with pytest.raises(ValueError, match="version"):
+            b.import_blocks({**payload, "version": 2})
+        with pytest.raises(ValueError, match="block_size"):
+            b.import_blocks({**payload, "block_size": 16})
+        with pytest.raises(ValueError, match="kv_bits"):
+            b.import_blocks({**payload, "kv_bits": 8})
+        # Chain-key mismatch: replicas whose hashing diverged must be
+        # refused loudly, not decode garbage.
+        tampered = json.loads(json.dumps(payload))
+        tampered["blocks"][0]["key"] = "00" * 20
+        with pytest.raises(ValueError, match="chain-key mismatch"):
+            b.import_blocks(tampered)
+        # A stub for a chain this replica does not hold → KeyError (the
+        # suffix-only transfer raced an eviction; caller falls back).
+        stub = json.loads(json.dumps(payload))
+        del stub["blocks"][0]["data"]
+        with pytest.raises(KeyError, match="stub"):
+            b.import_blocks(stub)
+
+    def test_export_requires_prefix_cache_and_live_slot(self, tiny):
+        plain = _engine(tiny, prefix_cache=False)
+        rid = plain.submit(PROMPT, max_new_tokens=1)
+        plain.run()
+        with pytest.raises(RuntimeError, match="prefix_cache"):
+            plain.export_blocks(rid)
+        cached = _engine(tiny)
+        rid = cached.submit(PROMPT, max_new_tokens=1)
+        cached.run()  # retired: slot released
+        with pytest.raises(KeyError, match="holds no slot"):
+            cached.export_blocks(rid)
+
+
+class TestPoolFromHbm:
+    def test_cpu_falls_back_to_constant(self, tiny):
+        cfg, _ = tiny
+        # CPU devices have no usable HBM memory_stats → the fallback
+        # constant, untouched.
+        assert pool_blocks_from_hbm(cfg, BS, fallback=37) == 37
+
+    def test_budget_math_with_fake_device(self, tiny):
+        cfg, _ = tiny
+
+        class Dev:
+            def memory_stats(self):
+                return {"bytes_limit": 1 << 30, "bytes_in_use": 0}
+
+        n = pool_blocks_from_hbm(cfg, BS, fraction=0.5, fallback=7,
+                                 device=Dev())
+        rows = cfg.n_layers * cfg.n_kv_heads * BS
+        per_block = 2 * rows * cfg.head_dim * 2  # bf16 k + v
+        assert n == max(2, int(0.5 * (1 << 30)) // per_block)
+
+    def test_fraction_validated(self, tiny):
+        cfg, _ = tiny
+        with pytest.raises(ValueError):
+            pool_blocks_from_hbm(cfg, BS, fraction=0.0)
+        with pytest.raises(ValueError):
+            pool_blocks_from_hbm(cfg, BS, fraction=1.5)
+
+    def test_engine_accepts_hbm_fraction(self, tiny):
+        cfg, params = tiny
+        pb = PagedBatcher(params, cfg, slots=1, num_blocks=64,
+                          block_size=BS, prompt_bucket=16,
+                          hbm_fraction=0.25)
+        # On CPU the fraction resolves to the fallback: the passed
+        # num_blocks acts as the constant.
+        assert pb.num_blocks == 64
+
+
+class TestGatewayDisagg:
+    def test_end_to_end_handoff_span_chain_and_token_parity(self, tiny):
+        """One streamed request through a 1-prefill + 1-decode fleet:
+        tokens equal the fused replica's, the gateway counts the
+        transfer, and ONE trace carries prefill → kv_transfer →
+        first_decode (the kv_transfer span bridges the tiers)."""
+        from kubeflow_tpu.models.gateway import ServingGateway
+        from kubeflow_tpu.models.server import InferenceServer
+        from kubeflow_tpu.observability.tracing import (
+            InMemoryExporter,
+            TracerProvider,
+            set_tracer_provider,
+        )
+
+        exp = InMemoryExporter()
+        set_tracer_provider(TracerProvider(exp))
+        servers = {role: InferenceServer(
+            _engine(tiny, num_blocks=32, bucket=32), port=0, drain_s=0.5,
+            tier_role=role,
+        ).start() for role in ("prefill", "decode", "fused")}
+        eps = {role: f"{s.host}:{s.port}" for role, s in servers.items()}
+        gw = ServingGateway(
+            [eps["prefill"], eps["decode"]], port=0, block_size=BS,
+            health_interval_s=0.2, tier_mode="disagg",
+            tier_roles={eps[r]: r for r in ("prefill", "decode")},
+        ).start()
+        try:
+            def stream(host, port):
+                conn = http.client.HTTPConnection(host, port, timeout=120)
+                conn.request(
+                    "POST", "/v1/completions",
+                    json.dumps({"prompt": PROMPT, "max_tokens": 6,
+                                "stream": True}).encode(),
+                    {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 200
+                toks = []
+                while True:
+                    line = resp.fp.readline()
+                    if not line or line == b"data: [DONE]\n":
+                        break
+                    if line.startswith(b"data:"):
+                        body = json.loads(line[5:])
+                        assert "error" not in body, body
+                        toks.append(body["token"])
+                conn.close()
+                return toks
+
+            got = stream(gw.host, gw.port)
+            ref = stream(servers["fused"].host, servers["fused"].port)
+            assert got == ref and len(got) == 6
+
+            stats = gw.stats()
+            assert stats["tier_mode"] == "disagg"
+            assert stats["kv_transfers"] == 1
+            assert stats["kv_transfer_failures"] == 0
+            assert stats["kv_transfer_bytes"] > 0
+            assert stats["kv_transfer_latency_s"] > 0
+            assert servers["prefill"].engine.kv_exports == 1
+            assert servers["decode"].engine.kv_imports == 1
+
+            # The fused reference replica traced its own request too —
+            # the handoff trace is the one carrying kv_transfer.
+            (tspan,) = exp.by_name("kv_transfer")
+            trace = tspan.trace_id
+            (pspan,) = [s for s in exp.by_name("prefill")
+                        if s.trace_id == trace]
+            # Both tiers emit first_decode (the prefill tier's 1-token
+            # request delivers its pending token too); the decode
+            # tier's is the one that started after the transfer.
+            dspan = max((s for s in exp.by_name("first_decode")
+                         if s.trace_id == trace),
+                        key=lambda s: s.start_time)
+            # One distributed trace end to end, ordered prefill →
+            # kv_transfer → first_decode.
+            assert pspan.end_time <= tspan.end_time
+            assert tspan.start_time <= dspan.end_time
+            assert [s for s in exp.by_name("kv_import")
+                    if s.trace_id == trace]  # decode-side import span
+        finally:
+            set_tracer_provider(TracerProvider())
+            gw.stop()
+            for s in servers.values():
+                s.stop()
+
+    def test_tier_role_env_and_gateway_env_roundtrip(self, monkeypatch):
+        from kubeflow_tpu.models.gateway import gateway_from_env
+        from kubeflow_tpu.models.server import tier_role_from_env
+
+        monkeypatch.setenv("KUBEFLOW_TPU_GATEWAY_TIER_ROLE", "prefill")
+        assert tier_role_from_env() == "prefill"
+        monkeypatch.setenv("KUBEFLOW_TPU_GATEWAY_TIER_ROLE", "bogus")
+        with pytest.raises(ValueError):
+            tier_role_from_env()
+        monkeypatch.delenv("KUBEFLOW_TPU_GATEWAY_TIER_ROLE")
+
+        monkeypatch.setenv("KUBEFLOW_TPU_GATEWAY_TIER_MODE", "disagg")
+        monkeypatch.setenv("KUBEFLOW_TPU_GATEWAY_TIER_PREFILL",
+                           "10.0.0.1:8000")
+        monkeypatch.setenv("KUBEFLOW_TPU_GATEWAY_TIER_DECODE",
+                           "10.0.0.2:8000, 10.0.0.3:8000")
+        monkeypatch.setenv("KUBEFLOW_TPU_KV_TRANSFER_TIMEOUT_S", "12.5")
+        monkeypatch.setenv("KUBEFLOW_TPU_KV_TRANSFER_MAX_BYTES", "1048576")
+        gw = gateway_from_env()
+        assert gw.tier_mode == "disagg"
+        assert gw._tier_roles == {
+            "10.0.0.1:8000": "prefill",
+            "10.0.0.2:8000": "decode",
+            "10.0.0.3:8000": "decode",
+        }
+        assert gw.kv_transfer_timeout_s == 12.5
+        assert gw.kv_transfer_max_bytes == 1048576
+        assert set(gw._replicas) == {
+            "10.0.0.1:8000", "10.0.0.2:8000", "10.0.0.3:8000"}
+        monkeypatch.setenv("KUBEFLOW_TPU_GATEWAY_TIER_DECODE",
+                           "10.0.0.1:8000")
+        with pytest.raises(ValueError, match="both tiers"):
+            gateway_from_env()
+        monkeypatch.setenv("KUBEFLOW_TPU_GATEWAY_TIER_DECODE", "")
+        monkeypatch.setenv("KUBEFLOW_TPU_GATEWAY_TIER_MODE", "sharded")
+        with pytest.raises(ValueError, match="TIER_MODE"):
+            gateway_from_env()
