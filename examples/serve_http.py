@@ -103,11 +103,25 @@ def main() -> None:
                            temperature=args.temperature)
     if args.paged:
         from kubeflow_tpu.models.paged import PagedBatcher
+        from kubeflow_tpu.models.server import (
+            kv_pool_from_env,
+            ragged_from_env,
+        )
 
+        # HBM-economy knobs arrive via the webhook-projected env
+        # (KUBEFLOW_TPU_KV_BITS / _HBM_FRACTION / _KV_SWAP_BYTES), so a
+        # replica runs a quantized, HBM-sized, swap-enabled pool with no
+        # CLI flags. A swap tier only holds demoted PREFIX leaves —
+        # enabling it implies the prefix cache.
+        kv_kw = kv_pool_from_env()
+        ragged, token_budget = ragged_from_env()
         engine = PagedBatcher(
             params, cfg, gen=gen, slots=args.slots,
             num_blocks=args.num_blocks,
             prompt_bucket=args.prompt_bucket,
+            ragged=ragged, token_budget=token_budget,
+            prefix_cache=kv_kw.get("swap_bytes", 0) > 0,
+            **kv_kw,
         )
     else:
         from kubeflow_tpu.models.continuous import ContinuousBatcher

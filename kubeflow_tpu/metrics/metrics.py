@@ -51,6 +51,10 @@ STATS_PARITY = {
     "tpu_serving_kv_transfer_failures_total": "kv_transfer_failures",
     "tpu_serving_kv_transfer_bytes_total": "kv_transfer_bytes",
     "tpu_serving_kv_transfer_latency_seconds": "kv_transfer_latency_s",
+    "tpu_serving_kv_swap_out_total": "swap_out",
+    "tpu_serving_kv_swap_in_total": "swap_in",
+    "tpu_serving_kv_swap_restored_tokens_total": "restored_tokens",
+    "tpu_serving_kv_swap_bytes": "swap_bytes",
 }
 
 
@@ -289,6 +293,29 @@ class Metrics:
             "tpu_serving_kv_transfer_latency_seconds",
             "Duration of the most recent KV transfer hop (payload POST "
             "through decode-side import acknowledgement)",
+            registry=self.registry,
+        )
+        # -- HBM economy (host-RAM block swap, models/paged.py) ------------
+        self.serving_kv_swap_out_total = Counter(
+            "tpu_serving_kv_swap_out_total",
+            "Prefix-chain blocks demoted from the device pool to the "
+            "host-RAM swap tier instead of being evicted outright",
+            registry=self.registry,
+        )
+        self.serving_kv_swap_in_total = Counter(
+            "tpu_serving_kv_swap_in_total",
+            "Swap-resident blocks promoted back into the device pool at "
+            "admission or KV import (re-prefill skipped)",
+            registry=self.registry,
+        )
+        self.serving_kv_swap_restored_tokens_total = Counter(
+            "tpu_serving_kv_swap_restored_tokens_total",
+            "Prompt tokens whose prefill was skipped by a swap restore",
+            registry=self.registry,
+        )
+        self.serving_kv_swap_bytes = Gauge(
+            "tpu_serving_kv_swap_bytes",
+            "Host RAM currently held by the block-swap tier",
             registry=self.registry,
         )
         # -- SLO burn-rate engine (observability/slo.py) -------------------

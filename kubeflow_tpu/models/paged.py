@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import base64
 import hashlib
+from collections import OrderedDict
 from functools import partial
 from typing import Optional
 
@@ -113,38 +114,46 @@ def pool_blocks_from_hbm(
     fraction: float = 0.5,
     fallback: int = 64,
     device=None,
-) -> int:
+    with_source: bool = False,
+):
     """Size a block pool from the accelerator's live memory stats: spend
     ``fraction`` of the device's free HBM (bytes_limit - bytes_in_use) on
     KV blocks. Backends without memory_stats (CPU, some plugins) return
     ``fallback`` — today's constant block counts keep working there, so
     notebooks stay runnable off-TPU while TPU pools scale with the chip.
+
+    ``with_source`` returns ``(blocks, source)`` with source ``"hbm"``
+    (sized from live memory stats) or ``"fallback"`` — the /stats
+    pool-sizing record, so operators can see which branch actually ran.
     """
+    def _ret(blocks: int, source: str):
+        return (blocks, source) if with_source else blocks
+
     if not 0.0 < fraction <= 1.0:
         raise ValueError(f"fraction must be in (0, 1], got {fraction!r}")
     if device is None:
         devices = jax.local_devices()
         if not devices:
-            return fallback
+            return _ret(fallback, "fallback")
         device = devices[0]
     stats_fn = getattr(device, "memory_stats", None)
     if stats_fn is None:
-        return fallback
+        return _ret(fallback, "fallback")
     try:
         stats = stats_fn()
     except Exception:
         stats = None
     if not stats:
-        return fallback
+        return _ret(fallback, "fallback")
     limit = int(stats.get("bytes_limit")
                 or stats.get("bytes_reservable_limit") or 0)
     in_use = int(stats.get("bytes_in_use") or 0)
     budget = int((limit - in_use) * fraction)
     per_block = _kv_block_bytes(cfg, block_size, kv_bits)
     if budget <= 0 or per_block <= 0:
-        return fallback
+        return _ret(fallback, "fallback")
     # Block 0 is the null block; 2 is the smallest pool with a usable one.
-    return max(2, budget // per_block)
+    return _ret(max(2, budget // per_block), "hbm")
 
 
 def _np_leaf_dtype(name: str) -> np.dtype:
@@ -345,10 +354,12 @@ def _paged_chunk_scan(params, cfg, tokens, pool, tables, kv_mask, cos, sin,
     paged-attention kernel (ops/paged_attention.py) instead of
     materializing the gathered logical view — one read of the live
     blocks per step instead of gather-write-reread of all MAXB slots.
-    Applies to the bf16 single-token path (K=1, no sliding window, no
-    int8 pool); everything else keeps the gathered view, whose masking
-    the kernel is tested to match bit-for-bit in intent and to bf16
-    tolerance in value.
+    Applies to the single-token path (K=1, no sliding window); the
+    per-token decode kernel additionally requires a bf16 pool, while
+    the ragged kernel also reads int8-value + bf16-scale pools
+    (dequantized per streamed block). Everything else keeps the
+    gathered view, whose masking the kernel is tested to match
+    bit-for-bit in intent and to bf16 tolerance in value.
 
     ``ragged``: ``(seq_starts, seq_lens, kv_lens, seq_tables, seq_mask)``
     per-SEQUENCE metadata for a flattened mixed batch (the ragged entry
@@ -364,7 +375,9 @@ def _paged_chunk_scan(params, cfg, tokens, pool, tables, kv_mask, cos, sin,
         attn_kernel
         and tokens.shape[1] == 1
         and not cfg.sliding_window
-        and "k_scale" not in pool
+        # int8 pools compose with the RAGGED kernel (it dequantizes per
+        # block); the plain per-token decode kernel stays bf16-only.
+        and (ragged is not None or "k_scale" not in pool)
     )
 
     def gathered(pool_l):
@@ -392,6 +405,8 @@ def _paged_chunk_scan(params, cfg, tokens, pool, tables, kv_mask, cos, sin,
                 q[:, :, 0, :], pool_l["k"], pool_l["v"], seq_tables,
                 seq_mask, seq_starts, seq_lens, kv_lens, block_size,
                 interpret=jax.default_backend() not in ("tpu", "axon"),
+                k_scale_pool=pool_l.get("k_scale"),
+                v_scale_pool=pool_l.get("v_scale"),
             )[:, :, None, :]
         elif use_kernel:
             from kubeflow_tpu.ops.paged_attention import (
@@ -552,6 +567,7 @@ class PagedBatcher(_BatcherBase):
         ragged: bool = False,  # fused mixed prefill/decode batches
         token_budget: Optional[int] = None,  # ragged rows per step
         hbm_fraction: Optional[float] = None,  # size pool from device HBM
+        swap_bytes: int = 0,  # host-RAM swap tier for cold prefix chains
     ):
         self.gen = gen or GenerationConfig()
         # Decode attention THROUGH the tables (ops/paged_attention.py):
@@ -567,11 +583,13 @@ class PagedBatcher(_BatcherBase):
                 "kernel is single-device; a tp-sharded pool would be "
                 "gathered) — drop one of the two"
             )
-        if attn_kernel and kv_bits:
+        if attn_kernel and kv_bits and not ragged:
             raise ValueError(
-                "attn_kernel=True does not compose with kv_bits (the "
-                "kernel reads bf16 pools; an int8 pool would silently "
-                "run the gathered path) — drop one of the two"
+                "attn_kernel=True does not compose with kv_bits on the "
+                "per-token decode kernel (it reads bf16 pools; an int8 "
+                "pool would silently run the gathered path) — the RAGGED "
+                "kernel dequantizes int8 pools: add ragged=True or drop "
+                "one of the two"
             )
         if attn_kernel and cfg.sliding_window:
             raise ValueError(
@@ -581,7 +599,7 @@ class PagedBatcher(_BatcherBase):
             )
         self.attn_kernel = (
             jax.default_backend() in ("tpu", "axon") and plan is None
-            and not kv_bits and not cfg.sliding_window
+            and (not kv_bits or ragged) and not cfg.sliding_window
             if attn_kernel is None else attn_kernel
         )
         if prompt_bucket % block_size:
@@ -620,11 +638,6 @@ class PagedBatcher(_BatcherBase):
                     "ragged=True does not compose with plan= (the ragged "
                     "kernel is single-device; drop one of the two)"
                 )
-            if kv_bits:
-                raise ValueError(
-                    "ragged=True does not compose with kv_bits (the "
-                    "ragged kernel reads bf16 pools) — drop one of the two"
-                )
             if prompt_cache or prefix_cache:
                 raise ValueError(
                     "ragged=True does not compose with prompt_cache/"
@@ -654,10 +667,13 @@ class PagedBatcher(_BatcherBase):
         if hbm_fraction is not None:
             # Satellite of the paged pool: size from the accelerator's
             # live memory stats, with num_blocks as the CPU fallback.
-            num_blocks = pool_blocks_from_hbm(
+            num_blocks, self.pool_source = pool_blocks_from_hbm(
                 cfg, block_size, kv_bits,
                 fraction=hbm_fraction, fallback=num_blocks,
+                with_source=True,
             )
+        else:
+            self.pool_source = "config"
         self.num_blocks = num_blocks
         self.prompt_bucket = prompt_bucket
         # Capacity (in blocks) one request can ever hold; fixes MAXB so the
@@ -737,6 +753,24 @@ class PagedBatcher(_BatcherBase):
         self.prefix_misses = 0
         self.prefix_evictions = 0
         self.admit_chunk = admit_chunk
+        # Host-RAM block swap (opt-in via swap_bytes > 0): instead of
+        # LOSING a demoted prefix leaf's KV, its block's leaves are
+        # copied to host numpy keyed by the SAME chain hash, bounded by
+        # a byte budget with LRU demotion inside the tier. A returning
+        # request whose chain walk misses the device cache but hits the
+        # swap tier promotes the block back (device write + re-register)
+        # instead of re-prefilling — the admission path counts that as a
+        # prefix-cache hit, because the prefill compute is skipped
+        # either way. Entries store the parent chain key so promotion
+        # can refuse a stale/mismatched chain.
+        if swap_bytes < 0:
+            raise ValueError(f"swap_bytes must be >= 0, got {swap_bytes}")
+        self.swap_bytes_limit = int(swap_bytes)
+        self._swap: "OrderedDict[bytes, dict]" = OrderedDict()
+        self.swap_bytes_used = 0
+        self.kv_swap_out = 0
+        self.kv_swap_in = 0
+        self.kv_swap_restored_tokens = 0
         # Paged-KV handoff (disaggregated serving): lifetime counters
         # mirrored into /stats by the serving frontend, plus the deferred
         # first-token queue import_blocks feeds (delivered at the next
@@ -793,10 +827,13 @@ class PagedBatcher(_BatcherBase):
         (refcount 1 — the cache's own hold), returning its block.
         Leaf-only: evicting a middle link would orphan the chain's tail
         (matching walks parent→child). Insertion order ≈ LRU (hits
-        re-append their matched chain)."""
+        re-append their matched chain). With a swap budget the leaf is
+        DEMOTED to host RAM first, so its prefill survives eviction."""
         for key, ent in self._prefix_entries.items():
             if (ent["children"] == 0
                     and self._shared_refs.get(ent["block"], 0) == 1):
+                if self.swap_bytes_limit:
+                    self._swap_out(key, ent)
                 del self._prefix_entries[key]
                 del self._shared_refs[ent["block"]]
                 self._free.append(ent["block"])
@@ -805,6 +842,74 @@ class PagedBatcher(_BatcherBase):
                 self.prefix_evictions += 1
                 return True
         return False
+
+    # -- host-RAM block swap ----------------------------------------------
+
+    def _swap_out(self, key: bytes, ent: dict) -> None:
+        """Demote one prefix-chain leaf's block to the host-RAM tier:
+        copy every pool leaf's rows for the block to numpy, keyed by the
+        SAME chain hash the device cache used, bounded by
+        ``swap_bytes_limit`` with LRU eviction inside the tier. The
+        parent key rides along so promotion can refuse a chain that no
+        longer matches."""
+        leaves = {
+            name: np.asarray(leaf[:, ent["block"]])
+            for name, leaf in self.pool.items()
+        }
+        nbytes = sum(a.nbytes for a in leaves.values())
+        if nbytes > self.swap_bytes_limit:
+            return  # a single block over budget: plain eviction
+        old = self._swap.pop(key, None)
+        if old is not None:
+            self.swap_bytes_used -= old["bytes"]
+        self._swap[key] = {
+            "leaves": leaves, "parent": ent["parent"], "bytes": nbytes,
+        }
+        self.swap_bytes_used += nbytes
+        self.kv_swap_out += 1
+        while self.swap_bytes_used > self.swap_bytes_limit:
+            _, victim = self._swap.popitem(last=False)  # LRU
+            self.swap_bytes_used -= victim["bytes"]
+
+    def _swap_promote(self, key: bytes, parent: Optional[bytes]):
+        """Promote a swap-resident block back into the device pool and
+        re-register it on the prefix chain (cache hold, refcount 1).
+        Returns the fresh ``_prefix_entries`` record, or None when the
+        key is not swap-resident, its recorded parent does not match the
+        caller's chain walk, or the pool cannot spare a block under the
+        admission watermark (caller treats all three as a miss)."""
+        entry = self._swap.get(key)
+        if entry is None or entry["parent"] != parent:
+            return None
+        blocks = self._reserve_take(1)
+        if blocks is None:
+            return None
+        (blk,) = blocks
+        for name, host in entry["leaves"].items():
+            self.pool[name] = self.pool[name].at[:, blk].set(
+                jnp.asarray(host)
+            )
+        del self._swap[key]
+        self.swap_bytes_used -= entry["bytes"]
+        ent = {"block": blk, "parent": parent, "children": 0}
+        self._prefix_entries[key] = ent
+        if parent is not None:
+            self._prefix_entries[parent]["children"] += 1
+        self._shared_refs[blk] = 1
+        self.kv_swap_in += 1
+        self.kv_swap_restored_tokens += self.block_size
+        return ent
+
+    def swap_contains(self, key: bytes) -> bool:
+        """True when a chain key's block is resident in the host-RAM
+        swap tier (the /kv/probe advisory: a hit here is restorable
+        without re-prefill, it just needs a promotion on import)."""
+        return key in self._swap
+
+    @property
+    def swap_blocks(self) -> int:
+        """Blocks currently parked in the host-RAM swap tier."""
+        return len(self._swap)
 
     @property
     def prefix_cached_blocks(self) -> int:
@@ -1105,27 +1210,37 @@ class PagedBatcher(_BatcherBase):
                 )
             keys.append(parent)
         # Longest local chain match (empty when prefix_cache is off —
-        # import still works, it just writes every block).
+        # import still works, it just writes every block). A device miss
+        # falls through to the host-RAM swap tier: a swap-resident key
+        # is promoted back into the pool, so a /kv/probe advisory hit on
+        # swapped-out blocks is honored instead of raising on the stub.
+        # Matched blocks are pinned AS the walk advances — promotion
+        # allocates under the watermark and may evict unpinned leaves.
         m = 0
+        shared_blocks: list[int] = []
         if self._prefix_cache_enabled:
+            walk_parent: Optional[bytes] = None
             for j in range(registrable):
-                if keys[j] in self._prefix_entries:
-                    m += 1
-                else:
+                ent = self._prefix_entries.get(keys[j])
+                if ent is None and self._swap:
+                    ent = self._swap_promote(keys[j], walk_parent)
+                if ent is None:
                     break
-        for j in range(nblocks):
-            if j >= m and "data" not in entries[j]:
-                raise KeyError(
-                    f"kv payload block {j} is a stub but its chain is "
-                    "not cached here (suffix-only transfer raced an "
-                    "eviction) — resend with full block data"
-                )
-        shared_blocks = [self._prefix_entries[k]["block"] for k in keys[:m]]
-        # Pin the matched chain, refresh recency — mirrors prefix
-        # admission exactly.
-        for blk in shared_blocks:
-            self._shared_refs[blk] += 1
-        for k in keys[:m]:
+                shared_blocks.append(ent["block"])
+                self._shared_refs[ent["block"]] += 1
+                m += 1
+                walk_parent = keys[j]
+        bad = next((j for j in range(nblocks)
+                    if j >= m and "data" not in entries[j]), None)
+        if bad is not None:
+            for blk in shared_blocks:  # un-pin; promoted blocks stay warm
+                self._shared_refs[blk] -= 1
+            raise KeyError(
+                f"kv payload block {bad} is a stub but its chain is "
+                "not cached here (suffix-only transfer raced an "
+                "eviction) — resend with full block data"
+            )
+        for k in keys[:m]:  # hit refreshes recency (LRU-ish order)
             self._prefix_entries[k] = self._prefix_entries.pop(k)
         need = nblocks - m
         blocks = self._reserve_take(need)
@@ -1410,18 +1525,20 @@ class PagedBatcher(_BatcherBase):
                         parent, effective[j * bs:(j + 1) * bs]
                     )
                     ent = self._prefix_entries.get(key)
+                    if ent is None and self._swap:
+                        # Device miss, swap tier next: a promoted block
+                        # is a HIT (its prefill is skipped either way).
+                        ent = self._swap_promote(key, parent)
                     if ent is None:
                         break
                     keys.append(key)
                     shared_blocks.append(ent["block"])
+                    # Pin NOW, not after the walk: promotion allocates
+                    # under the watermark and may evict unpinned leaves
+                    # — including chain links this walk already matched.
+                    self._shared_refs[ent["block"]] += 1
                     parent = key
                 m = len(shared_blocks)
-                # Pin the matched chain before eviction can run: its
-                # blocks are refcount>=2 from here, so the eviction loop
-                # below (and any later decode-path eviction) cannot take
-                # them out from under this admission.
-                for blk in shared_blocks:
-                    self._shared_refs[blk] += 1
                 for key in keys:  # hit refreshes recency (LRU-ish order)
                     self._prefix_entries[key] = self._prefix_entries.pop(key)
                 need = nblocks - m

@@ -232,6 +232,57 @@ def ragged_from_env() -> tuple[bool, Optional[int]]:
     return ragged, budget
 
 
+def kv_pool_from_env() -> dict:
+    """Consuming end of the HBM-economy knobs: the ``kv_bits`` /
+    ``hbm_fraction`` / ``swap_bytes`` keyword dict for PagedBatcher
+    construction, so a replica runs a quantized, HBM-sized, swap-enabled
+    pool purely from env (examples/serve_http.py consumes this next to
+    ``ragged_from_env``). Unset vars keep the engine defaults. Raises on
+    garbage — a hand-set env var must not silently fall back."""
+    import os
+
+    from kubeflow_tpu.webhook.tpu_env import (
+        KUBEFLOW_TPU_HBM_FRACTION,
+        KUBEFLOW_TPU_KV_BITS,
+        KUBEFLOW_TPU_KV_SWAP_BYTES,
+    )
+
+    kw: dict = {}
+    raw = os.environ.get(KUBEFLOW_TPU_KV_BITS, "").strip()
+    if raw:
+        if raw not in ("0", "8"):
+            raise ValueError(
+                f"{KUBEFLOW_TPU_KV_BITS}={raw!r}: want 0 (bf16) or 8 "
+                "(int8 values + bf16 scales)"
+            )
+        kw["kv_bits"] = int(raw)
+    raw = os.environ.get(KUBEFLOW_TPU_HBM_FRACTION, "").strip()
+    if raw:
+        try:
+            fraction = float(raw)
+        except ValueError:
+            fraction = 0.0
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(
+                f"{KUBEFLOW_TPU_HBM_FRACTION}={raw!r}: want a float in "
+                "(0, 1]"
+            )
+        kw["hbm_fraction"] = fraction
+    raw = os.environ.get(KUBEFLOW_TPU_KV_SWAP_BYTES, "").strip()
+    if raw:
+        try:
+            swap = int(raw)
+        except ValueError:
+            swap = -1
+        if swap < 0:
+            raise ValueError(
+                f"{KUBEFLOW_TPU_KV_SWAP_BYTES}={raw!r}: want a "
+                "non-negative byte count"
+            )
+        kw["swap_bytes"] = swap
+    return kw
+
+
 def tier_role_from_env() -> str:
     """Consuming end of the disaggregated-serving role knob: what this
     replica advertises on /stats (the gateway's tier membership signal).
@@ -353,6 +404,7 @@ class InferenceServer:
         # Prometheus Counters only inc(): mirror the engine's monotonic
         # prefix-cache tallies by delta, last-mirrored snapshot here.
         self._prefix_mirrored = (0, 0, 0)
+        self._swap_mirrored = (0, 0, 0)
         self._stalls_mirrored = 0
         # Per-request span registry for the TTFT decomposition: rid →
         # {"root", "queue_wait", "prefill"} spans. queue_wait starts at
@@ -564,6 +616,20 @@ class InferenceServer:
                         self._prefix_mirrored = (h, ms, ev)
                         self.metrics.serving_prefix_cached_blocks.set(
                             self.engine.prefix_cached_blocks
+                        )
+                    if (self.metrics is not None and getattr(
+                            self.engine, "swap_bytes_limit", 0)):
+                        so = self.engine.kv_swap_out
+                        si = self.engine.kv_swap_in
+                        rt = self.engine.kv_swap_restored_tokens
+                        po, pi, pt = self._swap_mirrored
+                        self.metrics.serving_kv_swap_out_total.inc(so - po)
+                        self.metrics.serving_kv_swap_in_total.inc(si - pi)
+                        self.metrics.serving_kv_swap_restored_tokens_total \
+                            .inc(rt - pt)
+                        self._swap_mirrored = (so, si, rt)
+                        self.metrics.serving_kv_swap_bytes.set(
+                            self.engine.swap_bytes_used
                         )
                 except Exception as err:  # device OOM, preemption, ...
                     # The engine is in an unknown state: fail loudly —
@@ -982,6 +1048,26 @@ class InferenceServer:
                                 "import_blocks_written":
                                     server.engine.kv_import_blocks_written,
                             }
+                        swap = None
+                        if getattr(server.engine, "swap_bytes_limit", 0):
+                            swap = {
+                                "swap_out": server.engine.kv_swap_out,
+                                "swap_in": server.engine.kv_swap_in,
+                                "restored_tokens":
+                                    server.engine.kv_swap_restored_tokens,
+                                "swap_bytes": server.engine.swap_bytes_used,
+                                "swap_blocks": server.engine.swap_blocks,
+                                "swap_bytes_limit":
+                                    server.engine.swap_bytes_limit,
+                            }
+                        pool = None
+                        if getattr(server.engine, "num_blocks", None):
+                            pool = {
+                                "num_blocks": server.engine.num_blocks,
+                                "source": getattr(
+                                    server.engine, "pool_source", "config"
+                                ),
+                            }
                         rag = None
                         if getattr(server.engine, "ragged", False):
                             steps = server.engine.ragged_steps
@@ -1045,6 +1131,12 @@ class InferenceServer:
                         # counters.
                         "tier_role": server.tier_role,
                         **({"kv_handoff": kv} if kv is not None else {}),
+                        **({"kv_swap": swap} if swap is not None else {}),
+                        # HBM-economy sizing outcome: what
+                        # pool_blocks_from_hbm actually chose, so an
+                        # operator can tell a measured-HBM pool from the
+                        # conservative fallback floor.
+                        **({"kv_pool": pool} if pool is not None else {}),
                         **({"ragged": rag} if rag is not None else {}),
                         **({"prefix_cache": pc} if pc is not None else {}),
                         # Flight-recorder view (stall count surfaces the
@@ -1089,10 +1181,12 @@ class InferenceServer:
             def _kv_probe(self):
                 """Suffix-transfer negotiation: given the payload's chain
                 keys (hex, chain order), how many leading blocks does
-                this replica's prefix cache already hold? Matching does
-                NOT pin — an eviction can race the subsequent import,
-                which then refuses the stubbed payload (KeyError → 409)
-                and the gateway falls back to a full transfer."""
+                this replica's prefix cache already hold? Swap-resident
+                blocks count as held — import promotes them back to the
+                device pool. Matching does NOT pin — an eviction can
+                race the subsequent import, which then refuses the
+                stubbed payload (KeyError → 409) and the gateway falls
+                back to a full transfer."""
                 try:
                     body = _read_body(self, server.max_body_bytes)
                     req = json.loads(body or b"{}")
@@ -1114,8 +1208,11 @@ class InferenceServer:
                     if entries is not None and getattr(
                         server.engine, "_prefix_cache_enabled", False
                     ):
+                        swap_has = getattr(
+                            server.engine, "swap_contains", lambda _k: False
+                        )
                         for k in raw:
-                            if k not in entries:
+                            if k not in entries and not swap_has(k):
                                 break
                             matched += 1
                 self._json(200, {"matched": matched})

@@ -548,6 +548,12 @@ class FleetTelemetry:
         ("requests_shed", "fleet_replica_shed"),
         ("tokens_generated", "fleet_tokens"),
         ("engine_step_stalls", "fleet_stalls"),
+        # HBM economy: host-RAM swap-tier traffic. These live in the
+        # nested /stats ``kv_swap`` block — a dotted path descends one
+        # level per segment.
+        ("kv_swap.swap_out", "fleet_kv_swap_out"),
+        ("kv_swap.swap_in", "fleet_kv_swap_in"),
+        ("kv_swap.restored_tokens", "fleet_kv_swap_restored_tokens"),
     )
 
     def ingest_replica(self, endpoint: str, stats: Optional[dict]) -> None:
@@ -570,10 +576,14 @@ class FleetTelemetry:
                (stats.get("ragged") or {}).get("batch_fill"))
         _gauge("replica_prefix_hit_ratio",
                (stats.get("prefix_cache") or {}).get("hit_ratio"))
+        _gauge("replica_kv_swap_bytes",
+               (stats.get("kv_swap") or {}).get("swap_bytes"))
         with self._scrape_lock:
             base = self._replica_base.setdefault(endpoint, {})
             for stat, signal in self._REPLICA_COUNTERS:
-                cur = stats.get(stat)
+                cur: object = stats
+                for part in stat.split("."):
+                    cur = cur.get(part) if isinstance(cur, dict) else None
                 if not isinstance(cur, (int, float)) or isinstance(
                         cur, bool):
                     continue
@@ -648,6 +658,16 @@ class FleetTelemetry:
                 "kv_transfer_failures_per_s": _rate("kv_transfer_failures"),
                 "kv_transfer_bytes_per_s": _rate("kv_transfer_bytes"),
                 "kv_transfer_s": _hist("kv_transfer_s"),
+                # HBM economy: swap-tier churn as windowed rates, plus
+                # the per-replica resident swap bytes.
+                "kv_swap_out_per_s": _rate("fleet_kv_swap_out"),
+                "kv_swap_in_per_s": _rate("fleet_kv_swap_in"),
+                "kv_swap_restored_tokens_per_s": _rate(
+                    "fleet_kv_swap_restored_tokens"
+                ),
+                "replica_kv_swap_bytes": hub.gauge_children(
+                    "replica_kv_swap_bytes"
+                ),
                 "served_per_s": _rate("fleet_served"),
                 "tokens_per_s": _rate("fleet_tokens"),
                 "stalls_per_s": _rate("fleet_stalls"),

@@ -42,9 +42,13 @@ Layout contract (enforced by the wrapper, produced by the schedulers):
   because the positional bound already hides them (the same convention
   models/paged.py documents for its decode step).
 
-bf16 pools only; int8 pools and sliding-window configs keep the
-gathered path (models/paged.py dispatches, same contract as the decode
-kernel).
+Pools are bf16 OR int8-value + bf16-scale (the quantize-on-write format
+``models/paged.py`` produces for ``kv_bits=8``): pass ``k_scale_pool``/
+``v_scale_pool`` of shape (NB, Hkv, BS) and both paths dequantize each
+block as ``value.astype(f32) * scale[..., None]`` — one extra (Hkv, BS)
+DMA per block in the kernel, amortized over the whole chunk exactly
+like the values. Sliding-window configs keep the gathered path
+(models/paged.py dispatches, same contract as the decode kernel).
 """
 
 from __future__ import annotations
@@ -64,10 +68,22 @@ except ImportError:  # pallas unavailable: caller must use the reference
 
 
 def _ragged_kernel(starts_ref, lens_ref, kvlens_ref, tables_ref,
-                   q_hbm, kpool_ref, vpool_ref, mask_ref, o_hbm,
-                   qbuf, obuf, kbuf, vbuf, sems, qsem, osem, *,
-                   block_size, q_tile, n_kv_heads, group, head_dim):
-    """One program per sequence: tile the query span, stream KV blocks."""
+                   q_hbm, kpool_ref, vpool_ref, *rest,
+                   block_size, q_tile, n_kv_heads, group, head_dim,
+                   quantized):
+    """One program per sequence: tile the query span, stream KV blocks.
+
+    ``quantized`` is static: it decides at trace time whether the pool
+    carries int8 values with bf16 scale planes (two extra refs + two
+    extra scratch buffers in ``rest``) or plain bf16 values.
+    """
+    if quantized:
+        (kspool_ref, vspool_ref, mask_ref, o_hbm, qbuf, obuf,
+         kbuf, vbuf, ksbuf, vsbuf, sems, qsem, osem) = rest
+    else:
+        kspool_ref = vspool_ref = ksbuf = vsbuf = None
+        (mask_ref, o_hbm, qbuf, obuf, kbuf, vbuf,
+         sems, qsem, osem) = rest
     s = pl.program_id(0)
     qlen = lens_ref[s]
 
@@ -89,6 +105,18 @@ def _ragged_kernel(starts_ref, lens_ref, kvlens_ref, tables_ref,
             return pltpu.make_async_copy(
                 vpool_ref.at[tables_ref[s, i]], vbuf.at[slot],
                 sems.at[slot, 1],
+            )
+
+        def ksdma(slot, i):
+            return pltpu.make_async_copy(
+                kspool_ref.at[tables_ref[s, i]], ksbuf.at[slot],
+                sems.at[slot, 2],
+            )
+
+        def vsdma(slot, i):
+            return pltpu.make_async_copy(
+                vspool_ref.at[tables_ref[s, i]], vsbuf.at[slot],
+                sems.at[slot, 3],
             )
 
         def tile_body(t, _):
@@ -115,6 +143,9 @@ def _ragged_kernel(starts_ref, lens_ref, kvlens_ref, tables_ref,
 
             kdma(0, 0).start()
             vdma(0, 0).start()
+            if quantized:
+                ksdma(0, 0).start()
+                vsdma(0, 0).start()
             m0 = jnp.full((n_kv_heads, q_tile * group, 1), -jnp.inf,
                           jnp.float32)
             l0 = jnp.zeros((n_kv_heads, q_tile * group, 1), jnp.float32)
@@ -130,11 +161,19 @@ def _ragged_kernel(starts_ref, lens_ref, kvlens_ref, tables_ref,
                 def _():
                     kdma(nxt, i + 1).start()
                     vdma(nxt, i + 1).start()
+                    if quantized:
+                        ksdma(nxt, i + 1).start()
+                        vsdma(nxt, i + 1).start()
 
                 kdma(slot, i).wait()
                 vdma(slot, i).wait()
                 k = kbuf[slot].astype(jnp.float32)  # (Hkv, BS, D)
                 v = vbuf[slot].astype(jnp.float32)
+                if quantized:
+                    ksdma(slot, i).wait()
+                    vsdma(slot, i).wait()
+                    k = k * ksbuf[slot].astype(jnp.float32)[..., None]
+                    v = v * vsbuf[slot].astype(jnp.float32)[..., None]
 
                 # Validity = stored kv_mask AND the positional causal
                 # bound per (query row, key) pair — identical rule to
@@ -202,6 +241,8 @@ def ragged_paged_attention(
     block_size: int,
     q_tile: int = 16,
     interpret: bool = False,
+    k_scale_pool: jax.Array | None = None,  # (NB, Hkv, BS) bf16 scales
+    v_scale_pool: jax.Array | None = None,  # (NB, Hkv, BS)
 ) -> jax.Array:
     """Ragged paged GQA attention over a mixed batch; returns (T, Hq, D).
 
@@ -211,6 +252,10 @@ def ragged_paged_attention(
     allows — numerically the gathered ``_gqa_decode_attention`` rule
     (``ragged_attention_reference`` pins the agreement). Rows belonging
     to no sequence return unspecified values; callers never read them.
+
+    With ``k_scale_pool``/``v_scale_pool`` the value pools are int8 and
+    each streamed block is dequantized in-register before the softmax —
+    the ``kv_bits=8`` pool format.
     """
     if pl is None:
         raise RuntimeError("pallas unavailable; use the reference path")
@@ -220,6 +265,14 @@ def ragged_paged_attention(
         raise ValueError(f"pool block size {bs} != block_size {block_size}")
     if hq % hkv:
         raise ValueError(f"{hq} q heads not divisible by {hkv} kv heads")
+    quantized = k_scale_pool is not None
+    if quantized != (v_scale_pool is not None):
+        raise ValueError("k_scale_pool and v_scale_pool must come together")
+    if quantized and k_scale_pool.shape != (nb, hkv, bs):
+        raise ValueError(
+            f"scale pool shape {k_scale_pool.shape} != {(nb, hkv, bs)} "
+            "(one scale per stored kv position)"
+        )
     s, max_blocks = tables.shape
     if kv_mask.shape != (s, max_blocks * bs):
         raise ValueError(
@@ -231,6 +284,14 @@ def ragged_paged_attention(
     # docstring) and keeps every tile's q DMA in bounds.
     qp = jnp.pad(q, ((0, q_tile), (0, 0), (0, 0)))
 
+    scale_specs = (
+        [pl.BlockSpec(memory_space=pl.ANY),
+         pl.BlockSpec(memory_space=pl.ANY)] if quantized else []
+    )
+    scale_scratch = (
+        [pltpu.VMEM((2, hkv, bs), k_scale_pool.dtype),
+         pltpu.VMEM((2, hkv, bs), v_scale_pool.dtype)] if quantized else []
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(s,),
@@ -238,6 +299,7 @@ def ragged_paged_attention(
             pl.BlockSpec(memory_space=pl.ANY),  # q: tiles DMA'd per seq
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
+            *scale_specs,
             pl.BlockSpec((1, max_blocks * bs), lambda i, *_: (i, 0)),
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
@@ -246,15 +308,17 @@ def ragged_paged_attention(
             pltpu.VMEM((q_tile, hq, d), q.dtype),
             pltpu.VMEM((2, hkv, bs, d), k_pool.dtype),
             pltpu.VMEM((2, hkv, bs, d), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            *scale_scratch,
+            pltpu.SemaphoreType.DMA((2, 4 if quantized else 2)),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
         ],
     )
     kernel = functools.partial(
         _ragged_kernel, block_size=block_size, q_tile=q_tile,
-        n_kv_heads=hkv, group=hq // hkv, head_dim=d,
+        n_kv_heads=hkv, group=hq // hkv, head_dim=d, quantized=quantized,
     )
+    scale_args = [k_scale_pool, v_scale_pool] if quantized else []
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -262,7 +326,7 @@ def ragged_paged_attention(
         interpret=interpret,
     )(seq_starts.astype(jnp.int32), seq_lens.astype(jnp.int32),
       kv_lens.astype(jnp.int32), tables.astype(jnp.int32),
-      qp, k_pool, v_pool, kv_mask.astype(jnp.int8))
+      qp, k_pool, v_pool, *scale_args, kv_mask.astype(jnp.int8))
     return out[:t]
 
 
@@ -277,6 +341,8 @@ def ragged_attention_reference(
     seq_lens: jax.Array,    # (S,)
     kv_lens: jax.Array,     # (S,)
     block_size: int,
+    k_scale_pool: jax.Array | None = None,  # (NB, Hkv, BS)
+    v_scale_pool: jax.Array | None = None,  # (NB, Hkv, BS)
 ) -> jax.Array:
     """Pure-jnp gather/segment-softmax fallback; returns (T, Hq, D).
 
@@ -285,12 +351,15 @@ def ragged_attention_reference(
     f32. Rows owned by no sequence come out 0 (never read). Same
     numerics as the gathered ``_gqa_decode_attention`` — this is the
     function the parity suite holds both the kernel and the schedulers
-    against.
+    against. Scale pools dequantize int8 values exactly like the
+    kernel: ``value.astype(f32) * scale[..., None]``.
     """
     t, hq, d = q.shape
     s, maxb = tables.shape
     hkv = k_pool.shape[1]
     group = hq // hkv
+    if (k_scale_pool is None) != (v_scale_pool is None):
+        raise ValueError("k_scale_pool and v_scale_pool must come together")
     rows = jnp.arange(t)
     in_seq = (rows[None, :] >= seq_starts[:, None]) & (
         rows[None, :] < (seq_starts + seq_lens)[:, None]
@@ -302,14 +371,20 @@ def ragged_attention_reference(
         + rows - seq_starts[tok_seq]
     )  # absolute kv position per row
 
-    def gathered(pool):
+    def gathered(pool, scale=None):
         g = pool[tables]  # (S, MAXB, Hkv, BS, D)
-        return g.transpose(0, 2, 1, 3, 4).reshape(
+        g = g.transpose(0, 2, 1, 3, 4).reshape(
             s, hkv, maxb * block_size, d
         )
+        if scale is None:
+            return g
+        sg = scale[tables].transpose(0, 2, 1, 3).reshape(
+            s, hkv, maxb * block_size
+        )  # (S, Hkv, L)
+        return g.astype(jnp.float32) * sg.astype(jnp.float32)[..., None]
 
-    kg = gathered(k_pool)[tok_seq].astype(jnp.float32)  # (T, Hkv, L, D)
-    vg = gathered(v_pool)[tok_seq].astype(jnp.float32)
+    kg = gathered(k_pool, k_scale_pool)[tok_seq].astype(jnp.float32)
+    vg = gathered(v_pool, v_scale_pool)[tok_seq].astype(jnp.float32)
     qf = q.reshape(t, hkv, group, d).astype(jnp.float32)
     scores = jnp.einsum("thgd,thld->thgl", qf, kg) / math.sqrt(d)
     k_pos = jnp.arange(maxb * block_size)

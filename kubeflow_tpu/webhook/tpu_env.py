@@ -71,6 +71,13 @@ KUBEFLOW_TPU_GATEWAY_TIER_DECODE = "KUBEFLOW_TPU_GATEWAY_TIER_DECODE"
 KUBEFLOW_TPU_GATEWAY_TIER_ROLE = "KUBEFLOW_TPU_GATEWAY_TIER_ROLE"
 KUBEFLOW_TPU_KV_TRANSFER_TIMEOUT_S = "KUBEFLOW_TPU_KV_TRANSFER_TIMEOUT_S"
 KUBEFLOW_TPU_KV_TRANSFER_MAX_BYTES = "KUBEFLOW_TPU_KV_TRANSFER_MAX_BYTES"
+# HBM economy (models/server.py kv_pool_from_env → PagedBatcher): KV
+# quantization bits, HBM-fraction pool sizing, and the host-RAM swap
+# tier's byte budget — a replica runs a quantized, HBM-sized,
+# swap-enabled pool purely from env.
+KUBEFLOW_TPU_KV_BITS = "KUBEFLOW_TPU_KV_BITS"
+KUBEFLOW_TPU_HBM_FRACTION = "KUBEFLOW_TPU_HBM_FRACTION"
+KUBEFLOW_TPU_KV_SWAP_BYTES = "KUBEFLOW_TPU_KV_SWAP_BYTES"
 # Persistent JAX compilation cache (bench.py capture windows; any runtime
 # entrypoint may opt in): compiled executables survive process restarts.
 KUBEFLOW_TPU_COMPILE_CACHE_DIR = "KUBEFLOW_TPU_COMPILE_CACHE_DIR"
@@ -171,6 +178,18 @@ ENV_CONTRACT: dict = {
     "container: serialized KV payload ceiling in bytes — larger "
     "transfers fall back to fused routing (default 64 MiB; replica "
     "max_body_bytes must admit at least this much)",
+    KUBEFLOW_TPU_KV_BITS: "operator-set on the serving container: KV "
+    "block-pool storage width — 8 stores int8 values + bf16 scales "
+    "(half the KV HBM; composes with the ragged kernel), unset/0 keeps "
+    "bf16 — consumed by models/server.py kv_pool_from_env",
+    KUBEFLOW_TPU_HBM_FRACTION: "operator-set on the serving container: "
+    "fraction of free device HBM to spend on the KV block pool "
+    "(pool_blocks_from_hbm; unset keeps the configured block count, "
+    "which is also the CPU fallback)",
+    KUBEFLOW_TPU_KV_SWAP_BYTES: "operator-set on the serving container: "
+    "byte budget for the host-RAM block-swap tier — demoted prefix "
+    "chains park here instead of being lost, LRU within the budget; "
+    "unset/0 disables the tier",
     KUBEFLOW_TPU_COMPILE_CACHE_DIR: "operator-set (bench watcher env or "
     "notebook container): directory for JAX's persistent compilation "
     "cache; bench.py enables it at startup and stamps the dir into "

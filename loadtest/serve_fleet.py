@@ -47,8 +47,14 @@ p95 TTFT no worse than random's, with zero churn failures.
 model, skips the artifact and the win gate (executability only) — the
 integration-workflow tier.
 
+Two sibling experiments share the harness: ``--disagg`` (prefill/decode
+tier split, SERVE_r08_disagg.json) and ``--evict-storm`` (HBM economy:
+bf16 evict+re-prefill vs int8 KV + host-RAM swap on one byte budget,
+SERVE_r09_hbm.json).
+
 Usage: python loadtest/serve_fleet.py [--out SERVE_r07_fleet.json]
        [--replicas 3] [--tenants 6] [--rounds 6] [--smoke]
+       [--disagg | --evict-storm]
 """
 
 from __future__ import annotations
@@ -741,6 +747,324 @@ def main_disagg(args) -> int:
     return 0 if win else 1
 
 
+# -- HBM-economy eviction-storm arm (--evict-storm) ---------------------
+
+EVICT_PREFIX_BLOCKS = 6    # each tenant's chain, in full KV blocks
+EVICT_TAIL_TOKENS = 7      # unique per-request suffix
+EVICT_DECODE_TOKENS = 8
+EVICT_SLOTS = 2
+EVICT_BUDGET_CHAINS = 4    # warm chains the bf16 baseline pool can hold
+
+
+def _evict_prompt(tenant: int, nonce: int, vocab: int) -> list:
+    """Per-TENANT chain (shared across the tenant's returns) + a unique
+    tail, deterministic like _tenant_prompt but sized by the evict-storm
+    globals."""
+    prefix = [3 + (tenant * 131 + i * 7) % (vocab - 4)
+              for i in range(EVICT_PREFIX_BLOCKS * BLOCK_SIZE)]
+    tail = [3 + (nonce * 17 + i * 11) % (vocab - 4)
+            for i in range(EVICT_TAIL_TOKENS)]
+    return prefix + tail
+
+
+def _evict_block_bytes(kv_bits: int) -> int:
+    """Measured (not derived) per-block HBM bytes for the pool format:
+    sum the probe pool's leaf bytes so the bf16 and int8 arms are sized
+    from the SAME byte budget the engine actually allocates."""
+    from kubeflow_tpu.models.paged import PagedBatcher
+
+    params, cfg = _load_model()
+    probe = PagedBatcher(params, cfg, slots=1, num_blocks=2,
+                         block_size=BLOCK_SIZE, prompt_bucket=BLOCK_SIZE,
+                         kv_bits=kv_bits)
+    return sum(leaf.nbytes for leaf in probe.pool.values()) // 2
+
+
+def _make_evict_engine(kv_bits: int, num_blocks: int, swap_bytes: int):
+    from kubeflow_tpu.models.paged import PagedBatcher
+    from kubeflow_tpu.models.serving import GenerationConfig
+
+    params, cfg = _load_model()
+    prompt_len = EVICT_PREFIX_BLOCKS * BLOCK_SIZE + EVICT_TAIL_TOKENS
+    return PagedBatcher(
+        params, cfg,
+        gen=GenerationConfig(max_new_tokens=EVICT_DECODE_TOKENS, eos_id=-1),
+        slots=EVICT_SLOTS, num_blocks=num_blocks, block_size=BLOCK_SIZE,
+        prompt_bucket=-(-prompt_len // BLOCK_SIZE) * BLOCK_SIZE,
+        prefix_cache=True, kv_bits=kv_bits, swap_bytes=swap_bytes,
+        # Block-wide admission pieces: ONE prefill shape regardless of
+        # how many chain blocks hit, so TTFT tracks blocks actually
+        # prefilled instead of which padded bucket they landed in.
+        admit_chunk=BLOCK_SIZE,
+    )
+
+
+def _evict_pool_floor() -> int:
+    prompt_len = EVICT_PREFIX_BLOCKS * BLOCK_SIZE + EVICT_TAIL_TOKENS
+    per_seq = -(-(prompt_len + EVICT_DECODE_TOKENS) // BLOCK_SIZE) + 1
+    return EVICT_SLOTS * per_seq + 2
+
+
+def _stream_evict(host, port, prompt, tenant: str, timeout: float = 120.0):
+    """One streaming completion straight at a replica (no gateway: the
+    storm is a single-chip HBM story). Returns (ok, ttft_s, [inter-token
+    gaps s], detail) — TTFT carries the re-prefill vs swap-restore
+    signal, the gaps isolate decode speed from admission work."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        t0 = time.perf_counter()
+        conn.request(
+            "POST", "/v1/completions",
+            json.dumps({"prompt": prompt, "stream": True,
+                        "max_tokens": EVICT_DECODE_TOKENS,
+                        "user": tenant}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return False, 0.0, [], f"HTTP {resp.status}"
+        ttft = None
+        gaps: list = []
+        last = None
+        finished = False
+        error = None
+        while True:
+            line = resp.fp.readline()
+            if not line:
+                break
+            if not line.startswith(b"data:"):
+                continue
+            if line == b"data: [DONE]\n":
+                finished = True
+                break
+            if b'"error"' in line:
+                error = line.decode().strip()
+                continue
+            now = time.perf_counter()
+            if ttft is None:
+                ttft = now - t0
+            if last is not None:
+                gaps.append(now - last)
+            last = now
+        if not finished or error:
+            return False, ttft or 0.0, gaps, error or "truncated stream"
+        return True, ttft, gaps, ""
+    except OSError as err:
+        return False, 0.0, [], str(err)
+    finally:
+        conn.close()
+
+
+def _drive_evict_round(server, tenants: int, nonce_base: int, vocab: int,
+                       outcomes: list) -> None:
+    """Every tenant returns once, concurrently — with a pool that holds
+    only EVICT_BUDGET_CHAINS warm chains, each admission evicts someone
+    else's chain: the storm."""
+    threads = []
+    for t in range(tenants):
+        prompt = _evict_prompt(t, nonce_base + t, vocab)
+
+        def work(p=prompt, name=f"tenant-{t}"):
+            outcomes.append(_stream_evict(server.host, server.port, p,
+                                          name))
+
+        th = threading.Thread(target=work, daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+
+
+def run_evict_arm(label: str, kv_bits: int, swap: bool, *, tenants: int,
+                  rounds: int, hbm_bytes: int) -> dict:
+    """One arm of the storm on one replica sized from ``hbm_bytes``:
+    the baseline (bf16, no swap) loses every demoted chain to a full
+    re-prefill; the treatment (int8 + host swap) fits ~2x the chains on
+    chip and restores the rest from host RAM."""
+    from kubeflow_tpu.models.gateway import prompt_chain_keys
+    from kubeflow_tpu.models.server import InferenceServer
+
+    _, cfg = _load_model()
+    per_block = _evict_block_bytes(kv_bits)
+    num_blocks = max(_evict_pool_floor(), hbm_bytes // per_block)
+    chain_bytes = EVICT_PREFIX_BLOCKS * per_block
+    swap_bytes = 2 * tenants * chain_bytes if swap else 0
+    engine = _make_evict_engine(kv_bits, num_blocks, swap_bytes)
+    server = InferenceServer(engine, port=0, drain_s=2.0).start()
+    try:
+        sink: list = []
+        _drive_evict_round(server, tenants, 4_000_000, cfg.vocab_size,
+                           sink)  # warm-up: compiles + first prefills
+        bad = [d for ok, _, _, d in sink if not ok]
+        if bad:
+            raise RuntimeError(f"{label} warm-up failures: {bad}")
+        before_hits = engine.prefix_hits
+        before_misses = engine.prefix_misses
+        outcomes: list = []
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            _drive_evict_round(server, tenants, r * tenants,
+                               cfg.vocab_size, outcomes)
+        wall = time.perf_counter() - t0
+        failures = [d for ok, _, _, d in outcomes if not ok]
+        ttfts = [ttft for ok, ttft, _, _ in outcomes if ok]
+        gaps = [g for ok, _, gs, _ in outcomes if ok for g in gs]
+        # Concurrent resident sessions: tenants whose FULL chain is
+        # device-resident after the storm — the pool-capacity number the
+        # int8 halving is supposed to double.
+        with server._lock:
+            resident = 0
+            for t in range(tenants):
+                keys = prompt_chain_keys(
+                    _evict_prompt(t, 0, cfg.vocab_size)
+                    [:EVICT_PREFIX_BLOCKS * BLOCK_SIZE], BLOCK_SIZE)
+                if all(k in engine._prefix_entries for k in keys):
+                    resident += 1
+        conn = http.client.HTTPConnection(server.host, server.port,
+                                          timeout=10)
+        try:
+            conn.request("GET", "/stats")
+            stats = json.loads(conn.getresponse().read())
+        finally:
+            conn.close()
+        hits = engine.prefix_hits - before_hits
+        misses = engine.prefix_misses - before_misses
+        return {
+            "arm": label,
+            "kv_bits": kv_bits,
+            "swap_enabled": swap,
+            "num_blocks": num_blocks,
+            "pool_bytes": num_blocks * per_block,
+            "requests_completed": len(ttfts),
+            "failures": failures,
+            "resident_sessions": resident,
+            "p95_ttft_ms": _p95_ms(ttfts) if ttfts else None,
+            "mean_ttft_ms": round(sum(ttfts) / len(ttfts) * 1e3, 2)
+            if ttfts else None,
+            # Inter-token gaps isolate decode speed from admission work;
+            # the 5% gate compares the arms on THIS number.
+            "decode_tokens_per_sec": round(len(gaps) / sum(gaps), 2)
+            if gaps else None,
+            "wall_s": round(wall, 3),
+            "prefix_cache": {
+                "hits": hits,
+                "misses": misses,
+                "hit_ratio": round(hits / (hits + misses), 4)
+                if hits + misses else 0.0,
+            },
+            "kv_swap": stats.get("kv_swap"),
+            "kv_pool": stats.get("kv_pool"),
+        }
+    finally:
+        server.stop()
+
+
+def main_evict(args) -> int:
+    """--evict-storm: oversubscribed tenants cycling through one
+    replica's pool. Baseline bf16/no-swap re-prefills every returning
+    chain; the int8+swap treatment must hold >= 2x the resident sessions
+    on the same byte budget, decode within 5%, and beat the baseline's
+    p95 TTFT via swap restores."""
+    global EVICT_PREFIX_BLOCKS, EVICT_DECODE_TOKENS, EVICT_BUDGET_CHAINS
+    tenants, rounds = args.tenants * 2, args.rounds
+    if args.smoke:
+        # Small model/short chains, but still OVERSUBSCRIBED — for BOTH
+        # arms: 12 tenants x 3 blocks must exceed even the int8 pool
+        # (~2x the baseline's blocks), or the treatment never demotes
+        # and the swap path goes unexercised.
+        EVICT_PREFIX_BLOCKS, EVICT_DECODE_TOKENS = 3, 4
+        EVICT_BUDGET_CHAINS = 1
+        tenants, rounds = 12, 2
+    # ONE byte budget for both arms: what the bf16 pool needs to keep
+    # EVICT_BUDGET_CHAINS chains warm beyond its active slots. The int8
+    # arm spends the same bytes on ~2x the blocks.
+    hbm_bytes = _evict_block_bytes(0) * (
+        _evict_pool_floor() + EVICT_BUDGET_CHAINS * EVICT_PREFIX_BLOCKS
+    )
+    print(f"# evict-storm baseline: bf16, no swap ({tenants} tenants x "
+          f"{rounds} rounds, {hbm_bytes} pool bytes) ...", file=sys.stderr)
+    baseline = run_evict_arm("evict_reprefill", 0, False, tenants=tenants,
+                             rounds=rounds, hbm_bytes=hbm_bytes)
+    print("# evict-storm treatment: int8 KV + host-RAM swap ...",
+          file=sys.stderr)
+    treatment = run_evict_arm("int8_swap", 8, True, tenants=tenants,
+                              rounds=rounds, hbm_bytes=hbm_bytes)
+
+    resident_ratio = round(
+        treatment["resident_sessions"]
+        / max(baseline["resident_sessions"], 1), 3)
+    decode_ratio = round(
+        (treatment["decode_tokens_per_sec"] or 0.0)
+        / max(baseline["decode_tokens_per_sec"] or 1e-9, 1e-9), 3)
+    record = {
+        "scenario": (
+            f"{tenants} tenants with {EVICT_PREFIX_BLOCKS}-block chains "
+            "cycling through one replica whose pool holds "
+            f"{EVICT_BUDGET_CHAINS} warm bf16 chains: evict+re-prefill "
+            "vs int8 KV + host-RAM swap on the same byte budget"
+        ),
+        "model": "tiny",
+        "block_size": BLOCK_SIZE,
+        "prefix_blocks": EVICT_PREFIX_BLOCKS,
+        "decode_tokens": EVICT_DECODE_TOKENS,
+        "tenants": tenants,
+        "rounds": rounds,
+        "pool_byte_budget": hbm_bytes,
+        "provenance": "smoke" if args.smoke else "live",
+        "host": _record_host(),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "baseline": baseline,
+        "treatment": treatment,
+        "resident_sessions_ratio": resident_ratio,
+        "decode_tokens_per_sec_ratio": decode_ratio,
+    }
+    print(json.dumps({
+        "baseline_resident_sessions": baseline["resident_sessions"],
+        "treatment_resident_sessions": treatment["resident_sessions"],
+        "resident_sessions_ratio": resident_ratio,
+        "baseline_p95_ttft_ms": baseline["p95_ttft_ms"],
+        "treatment_p95_ttft_ms": treatment["p95_ttft_ms"],
+        "decode_tokens_per_sec_ratio": decode_ratio,
+        "swap_out": (treatment["kv_swap"] or {}).get("swap_out"),
+        "swap_in": (treatment["kv_swap"] or {}).get("swap_in"),
+    }))
+    swap_stats = treatment["kv_swap"] or {}
+    clean = (
+        not baseline["failures"] and not treatment["failures"]
+        and swap_stats.get("swap_out", 0) > 0
+        and swap_stats.get("swap_in", 0) > 0
+    )
+    if not clean:
+        print("# evict-storm gate FAILED: " + json.dumps({
+            "baseline_failures": baseline["failures"],
+            "treatment_failures": treatment["failures"],
+            "kv_swap": swap_stats,
+        }), file=sys.stderr)
+    if args.smoke:
+        print("# --smoke: artifact write and win gate skipped",
+              file=sys.stderr)
+        return 0 if clean else 1
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(tmp, args.out)
+    print(f"# wrote {args.out}", file=sys.stderr)
+    win = (
+        clean
+        and resident_ratio >= 2.0
+        and decode_ratio >= 0.95
+        and treatment["p95_ttft_ms"] < baseline["p95_ttft_ms"]
+    )
+    if not win:
+        print("# win gate: " + json.dumps({
+            "resident_ratio_ge_2x": resident_ratio >= 2.0,
+            "decode_within_5pct": decode_ratio >= 0.95,
+            "swap_beats_reprefill_ttft":
+                treatment["p95_ttft_ms"] < baseline["p95_ttft_ms"],
+        }), file=sys.stderr)
+    return 0 if win else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
@@ -752,14 +1076,22 @@ def main() -> int:
                     help="run the disaggregated prefill/decode tier "
                          "experiment instead of affinity-vs-random "
                          "(artifact: SERVE_r08_disagg.json)")
+    ap.add_argument("--evict-storm", action="store_true",
+                    help="run the HBM-economy eviction storm: bf16 "
+                         "evict+re-prefill vs int8 KV + host-RAM swap "
+                         "(artifact: SERVE_r09_hbm.json)")
     ap.add_argument("--smoke", action="store_true",
                     help="2 replicas x 2 tenants x 2 rounds, no artifact, "
                          "no win gate — CI executability tier")
     args = ap.parse_args()
     root = Path(__file__).resolve().parent.parent
     if args.out is None:
-        args.out = str(root / ("SERVE_r08_disagg.json" if args.disagg
-                               else "SERVE_r07_fleet.json"))
+        args.out = str(root / (
+            "SERVE_r09_hbm.json" if args.evict_storm
+            else "SERVE_r08_disagg.json" if args.disagg
+            else "SERVE_r07_fleet.json"))
+    if args.evict_storm:
+        return main_evict(args)
     if args.disagg:
         return main_disagg(args)
     if args.smoke:

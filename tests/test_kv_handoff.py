@@ -35,12 +35,13 @@ def tiny():
 
 
 def _engine(tiny, kv_bits=0, max_new=8, slots=2, num_blocks=16,
-            bucket=16, prefix_cache=True):
+            bucket=16, prefix_cache=True, swap_bytes=0):
     cfg, params = tiny
     return PagedBatcher(
         params, cfg, gen=GenerationConfig(max_new_tokens=max_new, eos_id=-1),
         slots=slots, num_blocks=num_blocks, block_size=BS,
         prompt_bucket=bucket, prefix_cache=prefix_cache, kv_bits=kv_bits,
+        swap_bytes=swap_bytes,
     )
 
 
@@ -185,6 +186,89 @@ class TestExportImport:
         cached.run()  # retired: slot released
         with pytest.raises(KeyError, match="holds no slot"):
             cached.export_blocks(rid)
+
+
+class TestSwapInterop:
+    """Disagg handoff × host-RAM swap: a `/kv/probe` advisory hit on a
+    swap-resident chain must be honorable — the probe counts it and the
+    import PROMOTES it instead of refusing the stubbed payload."""
+
+    def test_import_promotes_swap_resident_stub(self, tiny):
+        """Replica B demoted its prefix chain to host RAM; a suffix-only
+        payload whose stubs name those keys restores them from swap and
+        decodes token-exact."""
+        skip = [k.hex() for k in prompt_chain_keys(PROMPT, BS)]
+        b = _engine(tiny, swap_bytes=1 << 22)
+        b.submit(PROMPT, max_new_tokens=8)
+        b.run()
+        while b._evict_prefix_leaf():
+            pass
+        (key,) = prompt_chain_keys(PROMPT, BS)
+        assert b.swap_contains(key) and not b._prefix_entries
+        a = _engine(tiny)
+        payload = _prefill_payload(a, PROMPT, skip_keys=skip)
+        assert ["data" not in e for e in payload["blocks"]] == [True, False]
+        rid = b.import_blocks(payload, max_new_tokens=8)
+        assert rid is not None
+        got = b.run()[rid]
+        assert b.kv_swap_in == 1 and not b.swap_contains(key)
+        assert b.kv_import_blocks_reused == 1
+        c = _engine(tiny)
+        r = c.submit(PROMPT, max_new_tokens=8)
+        assert got == c.run()[r]
+
+    def test_stub_missing_from_device_and_swap_still_raises(self, tiny):
+        """Swap awareness must not weaken the refusal contract: a stub
+        whose chain is in NEITHER tier still raises KeyError."""
+        skip = [k.hex() for k in prompt_chain_keys(PROMPT, BS)]
+        a = _engine(tiny)
+        payload = _prefill_payload(a, PROMPT, skip_keys=skip)
+        b = _engine(tiny, swap_bytes=1 << 22)  # swap enabled but empty
+        with pytest.raises(KeyError, match="stub"):
+            b.import_blocks(payload)
+
+    def test_probe_and_stats_see_swap_tier(self, tiny):
+        """HTTP surfacing: /kv/probe counts swap-resident keys as
+        matched, /stats carries the kv_swap block and the pool-sizing
+        outcome."""
+        from kubeflow_tpu.models.server import InferenceServer
+
+        srv = InferenceServer(
+            _engine(tiny, swap_bytes=1 << 22), port=0, drain_s=0.5,
+        ).start()
+        try:
+            conn = http.client.HTTPConnection(srv.host, srv.port,
+                                              timeout=60)
+            conn.request(
+                "POST", "/v1/completions",
+                json.dumps({"prompt": PROMPT, "max_tokens": 2}).encode(),
+                {"Content-Type": "application/json"})
+            assert conn.getresponse().status == 200
+            keys = prompt_chain_keys(PROMPT, BS)
+            with srv._lock:
+                while srv.engine._evict_prefix_leaf():
+                    pass
+                assert srv.engine.swap_contains(keys[0])
+            conn.request(
+                "POST", "/kv/probe",
+                json.dumps({"keys": [k.hex() for k in keys]
+                            + ["00" * 20]}).encode(),
+                {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert json.loads(resp.read())["matched"] == 1
+            conn.request("GET", "/stats")
+            stats = json.loads(conn.getresponse().read())
+            conn.close()
+            assert stats["kv_swap"]["swap_out"] == 1
+            assert stats["kv_swap"]["swap_in"] == 0
+            assert stats["kv_swap"]["restored_tokens"] == 0
+            assert stats["kv_swap"]["swap_bytes"] > 0
+            assert stats["kv_swap"]["swap_blocks"] == 1
+            assert stats["kv_pool"] == {"num_blocks": 16,
+                                        "source": "config"}
+        finally:
+            srv.stop()
 
 
 class TestPoolFromHbm:

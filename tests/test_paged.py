@@ -8,6 +8,7 @@ KV demand exceeds what fixed-slot allocation could hold.
 from __future__ import annotations
 
 import jax
+import numpy as np
 import pytest
 
 from kubeflow_tpu.models import llama as L
@@ -527,3 +528,167 @@ class TestPrefixCache:
                               block_size=96, prompt_bucket=96,
                               prefix_cache=prefix)
             assert pb.admit_chunk % 96 == 0
+
+
+class TestHostSwap:
+    """Host-RAM block swap (swap_bytes > 0): demoted prefix leaves keep
+    their KV in host numpy keyed by the same chain hash, so a returning
+    chain restores its prefix instead of re-prefilling. The tier is
+    byte-budgeted with LRU demotion and refuses mismatched chains."""
+
+    PROMPT = [5, 9, 17, 33, 41, 2, 77, 13] + [3, 8]  # 1 registrable block
+
+    def _pb(self, params, cfg, swap_bytes=1 << 22, num_blocks=16,
+            max_new=6, prompt_bucket=16, **kw):
+        gen = GenerationConfig(max_new_tokens=max_new, eos_id=-1)
+        return PagedBatcher(params, cfg, gen=gen, slots=1,
+                            num_blocks=num_blocks, block_size=8,
+                            prompt_bucket=prompt_bucket, prefix_cache=True,
+                            swap_bytes=swap_bytes, **kw)
+
+    @staticmethod
+    def _block_leaves(pb, blk):
+        return {n: np.asarray(leaf[:, blk]) for n, leaf in pb.pool.items()}
+
+    def test_negative_budget_rejected(self, tiny):
+        cfg, params = tiny
+        with pytest.raises(ValueError, match="swap_bytes"):
+            self._pb(params, cfg, swap_bytes=-1)
+
+    def test_demote_restore_byte_exact(self, tiny):
+        """Evicting a leaf with a swap tier parks its block's leaves in
+        host RAM; the returning chain promotes them back bit-identical
+        and the admission counts a prefix HIT (no re-prefill)."""
+        cfg, params = tiny
+        pb = self._pb(params, cfg)
+        r1 = pb.submit(self.PROMPT)
+        first = pb.run()[r1]
+        ((key, ent),) = pb._prefix_entries.items()
+        before = self._block_leaves(pb, ent["block"])
+        hits0 = pb.prefix_hits
+        assert pb._evict_prefix_leaf()
+        assert pb.swap_contains(key)
+        assert pb.swap_blocks == 1 and pb.kv_swap_out == 1
+        assert pb.swap_bytes_used == sum(a.nbytes for a in before.values())
+        assert not pb._prefix_entries
+        r2 = pb.submit(self.PROMPT)
+        second = pb.run()[r2]
+        assert second == first  # restored chain stays on the greedy path
+        assert pb.kv_swap_in == 1
+        assert pb.kv_swap_restored_tokens == pb.block_size
+        assert pb.prefix_hits > hits0  # promotion IS a prefix hit
+        assert not pb.swap_contains(key) and pb.swap_bytes_used == 0
+        ((key2, ent2),) = pb._prefix_entries.items()
+        assert key2 == key
+        after = self._block_leaves(pb, ent2["block"])
+        for name in before:
+            np.testing.assert_array_equal(before[name], after[name])
+
+    def test_restored_chain_decode_matches_never_evicted(self, tiny):
+        """Control: an engine that never evicted serves the same prompt —
+        the swap-restored decode must be token-exact against it."""
+        cfg, params = tiny
+        pb = self._pb(params, cfg)
+        r1 = pb.submit(self.PROMPT)
+        pb.run()
+        ((key, _),) = pb._prefix_entries.items()
+        assert pb._evict_prefix_leaf() and pb.swap_contains(key)
+        r2 = pb.submit(self.PROMPT)
+        restored = pb.run()[r2]
+        control_pb = self._pb(params, cfg)
+        rc = control_pb.submit(self.PROMPT)
+        control_pb.run()
+        rc2 = control_pb.submit(self.PROMPT)  # warm-cache decode, no evict
+        assert restored == control_pb.run()[rc2]
+        assert control_pb.kv_swap_in == 0
+        del rc
+
+    def test_lru_order_under_byte_budget(self, tiny):
+        """Three leaves demoted into a two-block budget: the FIRST
+        demoted entry is the LRU victim; the later two survive."""
+        cfg, params = tiny
+        probe = self._pb(params, cfg)
+        block_bytes = sum(
+            a.nbytes for a in self._block_leaves(probe, 0).values()
+        )
+        pb = self._pb(params, cfg, swap_bytes=2 * block_bytes,
+                      num_blocks=32, prompt_bucket=32)
+        prompt = list(range(3, 3 + 24)) + [2]  # 3 registrable blocks
+        pb.submit(prompt)
+        pb.run()
+        assert len(pb._prefix_entries) == 3
+        demoted = []
+        for _ in range(3):  # leaf-first: deepest chain key demotes first
+            keys = set(pb._prefix_entries)
+            assert pb._evict_prefix_leaf()
+            demoted.extend(keys - set(pb._prefix_entries))
+        assert pb.kv_swap_out == 3
+        assert not pb.swap_contains(demoted[0])  # oldest popped (LRU)
+        assert pb.swap_contains(demoted[1]) and pb.swap_contains(demoted[2])
+        assert pb.swap_bytes_used == 2 * block_bytes <= pb.swap_bytes_limit
+
+    def test_single_block_over_budget_is_plain_eviction(self, tiny):
+        cfg, params = tiny
+        probe = self._pb(params, cfg)
+        block_bytes = sum(
+            a.nbytes for a in self._block_leaves(probe, 0).values()
+        )
+        pb = self._pb(params, cfg, swap_bytes=block_bytes - 1)
+        pb.submit(self.PROMPT)
+        pb.run()
+        assert pb._evict_prefix_leaf()
+        assert pb.swap_blocks == 0 and pb.kv_swap_out == 0
+        assert pb.swap_bytes_used == 0
+
+    def test_mismatched_chain_refused(self, tiny):
+        """A swap entry only restores onto the chain it was demoted
+        from: a different parent key is a miss and the entry stays."""
+        cfg, params = tiny
+        pb = self._pb(params, cfg)
+        pb.submit(self.PROMPT)
+        pb.run()
+        ((key, _),) = pb._prefix_entries.items()
+        assert pb._evict_prefix_leaf()
+        assert pb._swap_promote(key, b"not-the-parent") is None
+        assert pb._swap_promote(b"unknown-key", None) is None
+        assert pb.swap_contains(key)  # refusal must not consume the entry
+        assert pb.kv_swap_in == 0
+
+    def test_different_first_block_does_not_promote(self, tiny):
+        """Walk-level refusal: same second-block TOKENS under a different
+        first block hash to a different chain — the swap entry must not
+        leak KV across chains."""
+        cfg, params = tiny
+        common_second = [7, 7, 7, 7, 6, 6, 6, 6]
+        a = [1] * 8 + common_second + [5]
+        b = [2] * 8 + common_second + [5]
+        pb = self._pb(params, cfg, num_blocks=32, prompt_bucket=24)
+        pb.submit(a)
+        pb.run()
+        while pb._evict_prefix_leaf():
+            pass
+        assert pb.swap_blocks == 2
+        rb = pb.submit(b)
+        out = pb.run()[rb]
+        assert pb.kv_swap_in == 0  # nothing matched b's chain
+        _assert_greedy_consistent(params, cfg, b, out)
+
+    @pytest.mark.slow  # extra int8-engine compile; heavy for tier-1's wall budget
+    def test_swap_over_int8_pool_round_trips(self, tiny):
+        """Quantized pools swap all four leaves (values + scales);
+        restore is byte-exact and the hit stream matches the miss
+        stream."""
+        cfg, params = tiny
+        pb = self._pb(params, cfg, kv_bits=8)
+        r1 = pb.submit(self.PROMPT)
+        first = pb.run()[r1]
+        ((key, ent),) = pb._prefix_entries.items()
+        before = self._block_leaves(pb, ent["block"])
+        assert set(before) == {"k", "v", "k_scale", "v_scale"}
+        assert pb._evict_prefix_leaf() and pb.swap_contains(key)
+        r2 = pb.submit(self.PROMPT)
+        assert pb.run()[r2] == first
+        ((_, ent2),) = pb._prefix_entries.items()
+        after = self._block_leaves(pb, ent2["block"])
+        for name in before:
+            np.testing.assert_array_equal(before[name], after[name])

@@ -18,7 +18,7 @@ import pytest
 
 from kubeflow_tpu.models import llama as L
 from kubeflow_tpu.models.continuous import ContinuousBatcher
-from kubeflow_tpu.models.llama import _gqa_decode_attention
+from kubeflow_tpu.models.llama import _gqa_decode_attention, _kv_quantize
 from kubeflow_tpu.models.paged import PagedBatcher
 from kubeflow_tpu.models.serving import GenerationConfig, batch_generate
 from kubeflow_tpu.ops.ragged_attention import (
@@ -190,6 +190,81 @@ class TestKernelVsReference:
 
 
 # ---------------------------------------------------------------------------
+# int8 KV × ragged: the fused path over quantized block pools
+
+
+def _quantize_pools(kp, vp):
+    """(NB, Hkv, BS, D) bf16 pools → int8 values + (NB, Hkv, BS) bf16
+    scales, the same per-(block, head, slot) amax scheme the paged
+    engine's quantize-on-write scatter uses."""
+    kq, ks = _kv_quantize(kp)
+    vq, vs = _kv_quantize(vp)
+    return kq, ks, vq, vs
+
+
+class TestInt8Ragged:
+    # Pinned parity gate: int8 storage error through softmax on normal
+    # random pools. A wiring bug (wrong scale axis, mask, pointer) shows
+    # up orders of magnitude larger.
+    INT8_TOL = 8e-2
+
+    @pytest.mark.parametrize("spans", [
+        [(1, 17), (1, 40), (1, 96)],          # decode-only
+        [(8, 8), (12, 12), (4, 20)],          # prefill-only chunks
+        [(1, 33), (10, 10), (1, 5)],          # mixed decode + prefill
+    ])
+    def test_reference_dequant_within_quantization_error(self, spans):
+        """jnp fallback over an int8+scale pool vs the dense bf16 rule:
+        differences must be bounded by quantization error."""
+        q, kp, vp, tables = _setup(seed=4)
+        starts, lens, kvls, kv_mask = _meta(spans, 24, 6, 16)
+        kq, ks, vq, vs = _quantize_pools(kp, vp)
+        out = ragged_attention_reference(
+            q, kq, vq, tables, kv_mask, starts, lens, kvls, 16,
+            k_scale_pool=ks, v_scale_pool=vs,
+        )
+        ref = _dense_rows(q, kp, vp, tables, kv_mask, starts, lens, kvls, 16)
+        _assert_close(out, ref, _owned(starts, lens, 24), tol=self.INT8_TOL)
+
+    def test_kernel_matches_reference_on_int8_pool(self):
+        """Kernel and fallback dequantize the SAME stored values, so
+        they must agree to normal fp tolerance, not quantization
+        tolerance."""
+        q, kp, vp, tables = _setup(seed=5)
+        starts, lens, kvls, kv_mask = _meta(
+            [(1, 33), (10, 10), (1, 5)], 24, 6, 16
+        )
+        kq, ks, vq, vs = _quantize_pools(kp, vp)
+        out = ragged_paged_attention(
+            q, kq, vq, tables, kv_mask, starts, lens, kvls, 16,
+            q_tile=8, interpret=True,
+            k_scale_pool=ks, v_scale_pool=vs,
+        )
+        ref = ragged_attention_reference(
+            q, kq, vq, tables, kv_mask, starts, lens, kvls, 16,
+            k_scale_pool=ks, v_scale_pool=vs,
+        ).astype(jnp.float32)
+        _assert_close(out, np.asarray(ref), _owned(starts, lens, 24))
+
+    def test_scale_pools_are_both_or_neither(self):
+        q, kp, vp, tables = _setup()
+        starts, lens, kvls, kv_mask = _meta(
+            [(1, 4), (1, 4), (1, 4)], 24, 6, 16
+        )
+        kq, ks, vq, vs = _quantize_pools(kp, vp)
+        with pytest.raises(ValueError, match="scale"):
+            ragged_paged_attention(
+                q, kq, vq, tables, kv_mask, starts, lens, kvls, 16,
+                interpret=True, k_scale_pool=ks,
+            )
+        with pytest.raises(ValueError, match="scale"):
+            ragged_attention_reference(
+                q, kq, vq, tables, kv_mask, starts, lens, kvls, 16,
+                v_scale_pool=vs,
+            )
+
+
+# ---------------------------------------------------------------------------
 # Scheduler level: fused ragged batches vs the legacy alternating path
 
 
@@ -339,7 +414,6 @@ class TestPagedRagged:
         for kw in (
             {"prompt_cache": True},
             {"prefix_cache": True},
-            {"kv_bits": 8},
         ):
             with pytest.raises(ValueError, match="ragged"):
                 PagedBatcher(params, cfg, slots=2, num_blocks=16,
@@ -349,6 +423,70 @@ class TestPagedRagged:
             PagedBatcher(params, cfg, slots=4, num_blocks=16, block_size=8,
                          prompt_bucket=16, attn_kernel=False, ragged=True,
                          token_budget=2)
+        # int8 + fused kernel exists ONLY through the ragged path — the
+        # decode-step kernel still has no dequant epilogue.
+        with pytest.raises(ValueError, match="kv_bits"):
+            PagedBatcher(params, cfg, slots=2, num_blocks=16, block_size=8,
+                         prompt_bucket=16, attn_kernel=True, kv_bits=8)
+
+    def test_int8_ragged_constructs_and_serves(self, tiny):
+        """The PR 14 headline: ragged=True composes with kv_bits=8. The
+        fused jnp path reads the int8 pool and stays token-exact vs the
+        legacy alternating scheduler over the SAME quantized format
+        (identical stored values → identical greedy tokens)."""
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        prompts = _prompts(cfg, 3)
+        legacy = _run(
+            PagedBatcher(params, cfg, gen=gen, slots=2, num_blocks=24,
+                         block_size=8, prompt_bucket=16,
+                         attn_kernel=False, kv_bits=8),
+            prompts,
+        )
+        ragged = _run(
+            PagedBatcher(params, cfg, gen=gen, slots=2, num_blocks=24,
+                         block_size=8, prompt_bucket=16,
+                         attn_kernel=False, ragged=True, token_budget=12,
+                         kv_bits=8),
+            prompts,
+        )
+        assert legacy == ragged
+
+    def test_int8_ragged_greedy_matches_bf16(self, tiny):
+        """Token-exact greedy parity vs the bf16 ragged path on the tiny
+        model: prefill logits never read quantized storage and the decode
+        drift stays below the greedy margin at this depth."""
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=6, eos_id=-1)
+        prompt = [5, 9, 17, 33, 41, 2, 77, 13]
+        mk = lambda bits: PagedBatcher(  # noqa: E731
+            params, cfg, gen=gen, slots=1, num_blocks=16, block_size=8,
+            prompt_bucket=16, attn_kernel=False, ragged=True,
+            token_budget=8, kv_bits=bits,
+        )
+        assert _run(mk(8), [prompt]) == _run(mk(0), [prompt])
+
+    @pytest.mark.slow
+    def test_int8_ragged_kernel_smoke_end_to_end(self, tiny):
+        """attn_kernel=True + kv_bits=8 + ragged=True runs the quantized
+        Pallas variant interpreted through the full engine loop; tokens
+        must match the jnp-fallback int8 path exactly."""
+        cfg, params = tiny
+        gen = GenerationConfig(max_new_tokens=2, eos_id=-1)
+        prompts = [[5, 9, 17, 33]]
+        ref = _run(
+            PagedBatcher(params, cfg, gen=gen, slots=1, num_blocks=16,
+                         block_size=8, prompt_bucket=16, attn_kernel=False,
+                         ragged=True, token_budget=8, kv_bits=8),
+            prompts,
+        )
+        out = _run(
+            PagedBatcher(params, cfg, gen=gen, slots=1, num_blocks=16,
+                         block_size=8, prompt_bucket=16, attn_kernel=True,
+                         ragged=True, token_budget=8, kv_bits=8),
+            prompts,
+        )
+        assert out == ref
 
 
 class TestContinuousRagged:
