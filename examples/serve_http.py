@@ -105,8 +105,21 @@ def main() -> None:
         from kubeflow_tpu.models.paged import PagedBatcher
         from kubeflow_tpu.models.server import (
             kv_pool_from_env,
+            lora_cache_from_env,
             ragged_from_env,
+            spec_from_env,
         )
+
+        # Fail fast on a garbled KUBEFLOW_TPU_LORA_CACHE_SLOTS even though
+        # this example serves a single base model: the var is consumed by
+        # multi-LoRA engines (MultiLoraPagedBatcher — see
+        # loadtest/serve_fleet.py --multilora) and a typo should surface
+        # at startup, not when adapters are first registered.
+        lora_cache_slots = lora_cache_from_env()
+        if lora_cache_slots:
+            print(f"lora cache slots={lora_cache_slots} (no adapters "
+                  "registered by this example; knob applies to "
+                  "multi-LoRA engines)", flush=True)
 
         # HBM-economy knobs arrive via the webhook-projected env
         # (KUBEFLOW_TPU_KV_BITS / _HBM_FRACTION / _KV_SWAP_BYTES), so a
@@ -115,14 +128,44 @@ def main() -> None:
         # enabling it implies the prefix cache.
         kv_kw = kv_pool_from_env()
         ragged, token_budget = ragged_from_env()
-        engine = PagedBatcher(
-            params, cfg, gen=gen, slots=args.slots,
-            num_blocks=args.num_blocks,
-            prompt_bucket=args.prompt_bucket,
-            ragged=ragged, token_budget=token_budget,
-            prefix_cache=kv_kw.get("swap_bytes", 0) > 0,
-            **kv_kw,
-        )
+        draft_len, adaptive = spec_from_env()
+        if draft_len > 0:
+            # Speculation is a scheduling mode of the ragged engine:
+            # each slot contributes (1 + draft_len) verify rows to the
+            # fused dispatch, so the env knob requires ragged mode.
+            if not ragged:
+                raise SystemExit(
+                    "KUBEFLOW_TPU_SPEC_DRAFT_LEN needs the ragged "
+                    "engine (set KUBEFLOW_TPU_SERVING_RAGGED=1)")
+            from kubeflow_tpu.models.speculative import (
+                SpeculativePagedBatcher,
+                truncated_draft,
+            )
+
+            if set(kv_kw) - {"kv_bits"}:
+                raise SystemExit(
+                    "speculative serving supports KUBEFLOW_TPU_KV_BITS "
+                    "but not the HBM sizing / swap-tier knobs; unset "
+                    "KUBEFLOW_TPU_HBM_FRACTION / _KV_SWAP_BYTES")
+            d_params, d_cfg = truncated_draft(
+                params, cfg, max(1, cfg.n_layers // 4))
+            engine = SpeculativePagedBatcher(
+                params, cfg, d_params, d_cfg, gen=gen,
+                slots=args.slots, num_blocks=args.num_blocks,
+                prompt_bucket=args.prompt_bucket,
+                k_spec=draft_len, adaptive=adaptive,
+                ragged=True, token_budget=token_budget,
+                kv_bits=kv_kw.get("kv_bits", 0),
+            )
+        else:
+            engine = PagedBatcher(
+                params, cfg, gen=gen, slots=args.slots,
+                num_blocks=args.num_blocks,
+                prompt_bucket=args.prompt_bucket,
+                ragged=ragged, token_budget=token_budget,
+                prefix_cache=kv_kw.get("swap_bytes", 0) > 0,
+                **kv_kw,
+            )
     else:
         from kubeflow_tpu.models.continuous import ContinuousBatcher
 

@@ -55,6 +55,11 @@ STATS_PARITY = {
     "tpu_serving_kv_swap_in_total": "swap_in",
     "tpu_serving_kv_swap_restored_tokens_total": "restored_tokens",
     "tpu_serving_kv_swap_bytes": "swap_bytes",
+    "tpu_serving_spec_accept_total": "accepted",
+    "tpu_serving_spec_rounds_total": "rounds",
+    "tpu_serving_lora_cache_hits_total": "hits",
+    "tpu_serving_lora_cache_misses_total": "misses",
+    "tpu_serving_lora_cache_evictions_total": "evictions",
 }
 
 
@@ -316,6 +321,37 @@ class Metrics:
         self.serving_kv_swap_bytes = Gauge(
             "tpu_serving_kv_swap_bytes",
             "Host RAM currently held by the block-swap tier",
+            registry=self.registry,
+        )
+        # -- speculative decoding (models/speculative.py spec engines) -----
+        self.serving_spec_accept_total = Counter(
+            "tpu_serving_spec_accept_total",
+            "Draft proposals accepted by target verification (each one is "
+            "a decode token that cost 1/k of a target forward)",
+            registry=self.registry,
+        )
+        self.serving_spec_rounds_total = Counter(
+            "tpu_serving_spec_rounds_total",
+            "Speculative draft-verify rounds driven (one fused verify "
+            "dispatch per round on the ragged engine)",
+            registry=self.registry,
+        )
+        # -- multi-LoRA serving (models/multilora.py hot-adapter cache) ----
+        self.serving_lora_cache_hits_total = Counter(
+            "tpu_serving_lora_cache_hits_total",
+            "Requests whose adapter was already hot in the replica's "
+            "bounded adapter cache",
+            registry=self.registry,
+        )
+        self.serving_lora_cache_misses_total = Counter(
+            "tpu_serving_lora_cache_misses_total",
+            "Requests that had to load a cold adapter (the cost "
+            "(prefix, adapter) affinity routing exists to avoid)",
+            registry=self.registry,
+        )
+        self.serving_lora_cache_evictions_total = Counter(
+            "tpu_serving_lora_cache_evictions_total",
+            "Adapters evicted from the bounded hot-adapter cache (LRU)",
             registry=self.registry,
         )
         # -- SLO burn-rate engine (observability/slo.py) -------------------

@@ -307,6 +307,10 @@ class _Request:
     # its next emitted token, freeing the slot for live work instead of
     # decoding to full budget for a caller that stopped waiting.
     deadline: Optional[float] = None
+    # Multi-LoRA engines only: resolved adapter row index (None = base
+    # model). Travels with the request through preemption/requeue and is
+    # folded into the prefix chain key so KV never crosses adapters.
+    adapter_id: Optional[int] = None
 
 
 class _AdmissionCursor:

@@ -65,11 +65,18 @@ from kubeflow_tpu.observability.signals import FleetTelemetry, TenantBuckets
 AFFINITY_MODES = ("prefix", "random")
 
 
-def chain_key(parent: Optional[bytes], tokens) -> bytes:
+def chain_key(parent: Optional[bytes], tokens,
+              adapter: Optional[int] = None) -> bytes:
     """Content address of one full prompt block given its prefix chain —
     byte-for-byte ``PagedBatcher._chain_key`` (tests assert the parity),
-    duplicated here so routing never imports the jax stack."""
-    h = hashlib.sha1(b"root" if parent is None else parent)
+    duplicated here so routing never imports the jax stack. ``adapter``
+    salts the ROOT (a LoRA adapter changes every K/V the same tokens
+    produce, so chains fork at their first block); None keeps the legacy
+    base-model root byte-for-byte."""
+    if parent is None:
+        parent = (b"root" if adapter is None
+                  else b"root|adapter:%d" % int(adapter))
+    h = hashlib.sha1(parent)
     h.update(np.asarray(tokens, np.int32).tobytes())
     return h.digest()
 
@@ -271,7 +278,8 @@ class ServingGateway:
                  tier_mode: str = "fused",
                  tier_roles: Optional[dict] = None,
                  kv_transfer_timeout_s: float = 30.0,
-                 kv_transfer_max_bytes: int = 64 << 20):
+                 kv_transfer_max_bytes: int = 64 << 20,
+                 adapter_affinity: bool = True):
         if affinity not in AFFINITY_MODES:
             raise ValueError(
                 f"affinity must be one of {AFFINITY_MODES}, got {affinity!r}"
@@ -298,6 +306,12 @@ class ServingGateway:
         # process-wide provider on; default stays the no-op tracer.
         tracing.configure_from_env()
         self.affinity = affinity
+        # (prefix, adapter) affinity: fold the request's "model" field
+        # into the route key so one adapter's tenants co-locate — each
+        # replica's bounded hot-adapter cache then sees ~n_adapters/N
+        # distinct adapters instead of all of them. False = the
+        # adapter-oblivious baseline the loadtest measures against.
+        self.adapter_affinity = bool(adapter_affinity)
         self.reroute_budget = reroute_budget
         # Disaggregated prefill/decode serving: in "disagg" mode a
         # streaming token-id request prefills on the prefill tier, ships
@@ -511,7 +525,8 @@ class ServingGateway:
         # prefix hit ratio); absent on engines without the feature.
         keep["tier_role"] = stats.get("tier_role")
         for extra in ("prefix_cache", "queue_wait_s", "inter_token_s",
-                      "ragged", "flight", "kv_handoff"):
+                      "ragged", "flight", "kv_handoff", "speculative",
+                      "lora_cache"):
             if extra in stats:
                 keep[extra] = stats[extra]
         return keep
@@ -569,7 +584,7 @@ class ServingGateway:
 
     # -- routing -----------------------------------------------------------
 
-    def _route_key(self, prompt) -> bytes:
+    def _route_key(self, prompt, adapter=None) -> bytes:
         if self.affinity == "random":
             # Counter-hashed: uniform spread with zero RNG state, and the
             # ring seed still decorrelates parallel fleets.
@@ -577,10 +592,20 @@ class ServingGateway:
         if isinstance(prompt, list) and all(
             isinstance(t, int) and not isinstance(t, bool) for t in prompt
         ):
-            return self._router.route_key(prompt)
-        # Text prompts (tokenizer lives replica-side): whole-string
-        # affinity — identical notebooks still co-locate.
-        return hashlib.sha1(repr(prompt).encode()).digest()
+            key = self._router.route_key(prompt)
+        else:
+            # Text prompts (tokenizer lives replica-side): whole-string
+            # affinity — identical notebooks still co-locate.
+            key = hashlib.sha1(repr(prompt).encode()).digest()
+        if self.adapter_affinity and adapter is not None:
+            # Fold the adapter AFTER the prefix walk: same prefix + same
+            # adapter co-locate (warm chain AND hot adapter), while a
+            # different adapter lands elsewhere on the ring instead of
+            # thrashing the first replica's bounded adapter cache.
+            key = hashlib.sha1(
+                b"adapter|" + repr(adapter).encode() + b"|" + key
+            ).digest()
+        return key
 
     def _candidates(self, key: bytes) -> list:
         with self._lock:
@@ -803,7 +828,8 @@ class ServingGateway:
 
             def _route(self, req: dict, arrival: float,
                        tenant: str) -> None:
-                key = gw._route_key(req.get("prompt"))
+                key = gw._route_key(req.get("prompt"),
+                                    adapter=req.get("model"))
                 counted = False
                 if gw.tier_mode == "disagg":
                     outcome = self._route_disagg(req, arrival, tenant,

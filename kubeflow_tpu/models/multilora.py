@@ -31,6 +31,7 @@ request's "model" field selects the adapter by name.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Optional, Sequence
 
@@ -39,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_tpu.models.continuous import ContinuousBatcher
+from kubeflow_tpu.models.paged import PagedBatcher
 from kubeflow_tpu.models.llama import (
     LlamaConfig,
     _cache_store_rows,
@@ -263,30 +265,64 @@ def _ml_admit(params, stacked, aid, tokens, prompt_mask, cache, kv_mask,
     return logits[0], new_cache, new_mask
 
 
-class MultiLoraBatcher(ContinuousBatcher):
-    """Fixed-slot continuous batching with a per-request LoRA adapter.
+class _AdapterHotCache:
+    """Bounded per-replica hot-adapter LRU — the residency model for a
+    fleet where every replica holds the base weights but only
+    ``slots`` adapters stay "hot" (resident/uploaded) at once. On this
+    stack the stacked adapters already sit in device memory, so the
+    cache's job is OBSERVABILITY plus an honest miss cost: ``load_s``
+    simulates the host→device adapter upload a real deployment pays on
+    a cold adapter, and hits/misses/evictions feed `/stats` →
+    `tpu_serving_lora_cache_*` — the counters the gateway's
+    (prefix, adapter) affinity routing is meant to drive toward hits.
+    The base row is exempt (it IS the resident model)."""
 
-    >>> stacked = stack_adapters([ad_math, ad_code], cfg, lcfg)
-    >>> mb = MultiLoraBatcher(params, cfg, stacked, lcfg,
-    ...                       adapter_names=["math", "code"])
-    >>> mb.submit(p1, adapter="math"); mb.submit(p2, adapter="code")
-    >>> mb.submit(p3)                  # base model, same batch
-    >>> results = mb.run()
-    """
+    def __init__(self, slots: int, load_s: float = 0.0):
+        if slots < 1:
+            raise ValueError(f"lora cache slots must be >= 1, got {slots}")
+        self.slots = int(slots)
+        self.load_s = float(load_s)
+        self._lru: dict[int, None] = {}  # insertion-ordered residency set
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
 
-    def __init__(self, params, cfg, stacked: dict, lcfg: LoraConfig,
-                 adapter_names: Optional[Sequence[str]] = None, **kw):
-        for unsupported in ("plan", "kv_bits", "attn_kernel",
-                            "admit_chunk"):
-            if kw.get(unsupported):
-                raise ValueError(
-                    f"MultiLoraBatcher does not support {unsupported}= yet"
-                )
-        kw["attn_kernel"] = False
-        # admit_chunk: truthy values are rejected above (chunked
-        # admission bypasses the adapter-aware prefill); falsy ones flow
-        # through so the parent's own validation still fires (e.g. 0).
-        super().__init__(params, cfg, **kw)
+    def touch(self, aid: int) -> None:
+        if aid in self._lru:
+            self._lru.pop(aid)
+            self._lru[aid] = None  # re-insert = move to MRU end
+            self.hits += 1
+            return
+        self.misses += 1
+        if len(self._lru) >= self.slots:
+            self._lru.pop(next(iter(self._lru)))  # LRU end
+            self.evictions += 1
+        self._lru[aid] = None
+        if self.load_s:
+            time.sleep(self.load_s)  # simulated adapter upload
+
+    def stats(self) -> dict:
+        return {
+            "slots": self.slots,
+            "resident": len(self._lru),
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+        }
+
+
+class _AdapterRegistry:
+    """Shared adapter bookkeeping for the multi-LoRA engines (continuous
+    and paged): the stacked-weights registry, name→row resolution, and
+    the optional hot-adapter cache. One home so the two engines cannot
+    drift on what an adapter id MEANS (requests carry None for base,
+    0..n-1 for adapters; the stacked zero row n_adapters is a gather
+    detail, never a request-visible id)."""
+
+    def _init_adapters(self, stacked: dict, lcfg: LoraConfig,
+                       adapter_names: Optional[Sequence[str]],
+                       lora_cache_slots: int = 0,
+                       lora_load_s: float = 0.0) -> None:
         first = next(iter(stacked.values()))["a"]
         self.n_adapters = first.shape[0] - 1  # last row is the zero/base
         self.stacked = stacked
@@ -299,8 +335,10 @@ class MultiLoraBatcher(ContinuousBatcher):
                 f"{len(names)} adapter_names for {self.n_adapters} adapters"
             )
         self.adapter_names = names
-        self._slot_adapter = np.full((self.slots,), self.n_adapters,
-                                     np.int32)  # base row
+        self._adapter_cache = (
+            _AdapterHotCache(lora_cache_slots, lora_load_s)
+            if lora_cache_slots else None
+        )
 
     def resolve_adapter(self, adapter) -> int:
         """Name | index | None → stacked row id (None = the base row).
@@ -330,20 +368,72 @@ class MultiLoraBatcher(ContinuousBatcher):
             )
         return adapter
 
+    def _touch_adapter(self, aid: int) -> None:
+        """Count the hot-cache access for a non-base adapter; called on
+        the SUBMITTING thread so a simulated upload stall lands on the
+        request path (where a real upload would), never inside the
+        engine-driving loop."""
+        if self._adapter_cache is not None and aid != self.n_adapters:
+            self._adapter_cache.touch(aid)
+
+    def lora_cache_stats(self) -> Optional[dict]:
+        """The /stats "lora_cache" block, or None when uncapped."""
+        if self._adapter_cache is None:
+            return None
+        return self._adapter_cache.stats()
+
+
+class MultiLoraBatcher(_AdapterRegistry, ContinuousBatcher):
+    """Fixed-slot continuous batching with a per-request LoRA adapter.
+
+    >>> stacked = stack_adapters([ad_math, ad_code], cfg, lcfg)
+    >>> mb = MultiLoraBatcher(params, cfg, stacked, lcfg,
+    ...                       adapter_names=["math", "code"])
+    >>> mb.submit(p1, adapter="math"); mb.submit(p2, adapter="code")
+    >>> mb.submit(p3)                  # base model, same batch
+    >>> results = mb.run()
+    """
+
+    def __init__(self, params, cfg, stacked: dict, lcfg: LoraConfig,
+                 adapter_names: Optional[Sequence[str]] = None,
+                 lora_cache_slots: int = 0, lora_load_s: float = 0.0,
+                 **kw):
+        for unsupported in ("plan", "kv_bits", "attn_kernel",
+                            "admit_chunk"):
+            if kw.get(unsupported):
+                raise ValueError(
+                    f"MultiLoraBatcher does not support {unsupported}= yet"
+                )
+        kw["attn_kernel"] = False
+        # admit_chunk: truthy values are rejected above (chunked
+        # admission bypasses the adapter-aware prefill); falsy ones flow
+        # through so the parent's own validation still fires (e.g. 0).
+        super().__init__(params, cfg, **kw)
+        self._init_adapters(stacked, lcfg, adapter_names,
+                            lora_cache_slots, lora_load_s)
+        self._slot_adapter = np.full((self.slots,), self.n_adapters,
+                                     np.int32)  # base row
+
     def submit(self, prompt, max_new_tokens=None, adapter=None,
                temperature=None, stop=None, logit_bias=None,
                deadline_s=None) -> int:
         aid = self.resolve_adapter(adapter)
+        self._touch_adapter(aid)
         rid = super().submit(prompt, max_new_tokens=max_new_tokens,
                              temperature=temperature, stop=stop,
                              logit_bias=logit_bias, deadline_s=deadline_s)
-        self._queue[-1].adapter_id = aid
+        # None = base everywhere a request travels (chain keys, export
+        # payloads); the zero-row index exists only at gather time.
+        self._queue[-1].adapter_id = (
+            None if aid == self.n_adapters else aid
+        )
         return rid
 
     def _prefill_into_slot(self, slot, req, padded, prompt_mask):
         """Adapter-aware half of admission; the shared loop (padding,
         _post_admit, sampling, budget) lives in ContinuousBatcher."""
-        aid = getattr(req, "adapter_id", self.n_adapters)
+        aid = (self.n_adapters if req.adapter_id is None
+               else req.adapter_id)
         logits, self.cache, self.kv_mask = _ml_admit(
             self.params, self.stacked, jnp.asarray(aid, jnp.int32),
             padded, prompt_mask, self.cache, self.kv_mask,
@@ -370,3 +460,80 @@ class MultiLoraBatcher(ContinuousBatcher):
         for slot in active:
             self._note_token(slot, int(host_next[slot]),
                              float(host_lps[slot]))
+
+
+class MultiLoraPagedBatcher(_AdapterRegistry, PagedBatcher):
+    """Per-request LoRA on the PAGED RAGGED engine: adapter deltas ride
+    EVERY row of the fused dispatch — decode rows, admission prefill
+    chunk rows, and speculative verify spans alike — through the
+    `_ragged_adapters` hook (paged._row_adapters gathers each row's
+    owning slot's pair, so one compiled step serves a mixed-adapter
+    mixed-phase batch). The adapter id is folded into the prefix chain
+    key (paged._chain_key salts the root), so exported/imported KV
+    blocks never cross adapters, and int8 pools / the ragged attention
+    kernel compose unchanged (the delta touches projections, never the
+    cache format).
+
+    Ragged-only by design: the legacy alternating path admits through
+    base-only prefill programs, which would hand an adapter a cache it
+    never produced — exactly the bug the continuous engine's
+    `_ml_admit` exists to prevent. Requires ``ragged=True``.
+
+    >>> mb = MultiLoraPagedBatcher(params, cfg, stacked, lcfg,
+    ...                            adapter_names=["math", "code"],
+    ...                            ragged=True, lora_cache_slots=16)
+    >>> mb.submit(p1, adapter="math"); mb.submit(p2)   # adapter + base
+    >>> results = mb.run()
+    >>> mb.lora_cache_stats()   # {"hits": ..., "evictions": ...}
+    """
+
+    def __init__(self, params, cfg, stacked: dict, lcfg: LoraConfig,
+                 adapter_names: Optional[Sequence[str]] = None,
+                 lora_cache_slots: int = 0, lora_load_s: float = 0.0,
+                 **kw):
+        if not kw.get("ragged"):
+            raise ValueError(
+                "MultiLoraPagedBatcher requires ragged=True: adapter "
+                "deltas are applied per-row inside the fused ragged "
+                "dispatch; the legacy alternating path admits through "
+                "base-only prefill programs"
+            )
+        for unsupported in ("plan", "prompt_cache", "prefix_cache"):
+            if kw.get(unsupported):
+                raise ValueError(
+                    f"MultiLoraPagedBatcher does not support "
+                    f"{unsupported}= yet"
+                )
+        super().__init__(params, cfg, **kw)
+        self._init_adapters(stacked, lcfg, adapter_names,
+                            lora_cache_slots, lora_load_s)
+
+    def submit(self, prompt, max_new_tokens=None, adapter=None,
+               temperature=None, stop=None, logit_bias=None,
+               deadline_s=None) -> int:
+        aid = self.resolve_adapter(adapter)
+        # Touch on the submitting thread: a simulated upload stall lands
+        # on the request path, never inside the engine-driving loop.
+        self._touch_adapter(aid)
+        rid = super().submit(prompt, max_new_tokens=max_new_tokens,
+                             temperature=temperature, stop=stop,
+                             logit_bias=logit_bias, deadline_s=deadline_s)
+        self._queue[-1].adapter_id = (
+            None if aid == self.n_adapters else aid
+        )
+        return rid
+
+    def _ragged_adapters(self):
+        """(stacked, ids (S,), scaling) for this step's dispatch: each
+        slot's row — decoding OR mid-admission — maps to its request's
+        adapter (None → the stacked zero/base row), so prefill chunks
+        run through the same adapted body their decode rows will."""
+        ids = np.full((self.slots,), self.n_adapters, np.int32)
+        for slot, req in enumerate(self._by_slot):
+            if req is not None and req.adapter_id is not None:
+                ids[slot] = req.adapter_id
+        for slot, a in self._ragged_admit.items():
+            aid = a["req"].adapter_id
+            if aid is not None:
+                ids[slot] = aid
+        return self.stacked, jnp.asarray(ids), self.scaling

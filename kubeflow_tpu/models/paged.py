@@ -270,6 +270,7 @@ def _paged_ragged_step(
     top_p: float,
     bias=None,  # (S, V) per-slot logit bias, or None
     attn_kernel: bool = False,
+    adapters=None,  # (stacked, ids (S,), scaling) → per-row LoRA deltas
 ) -> tuple[jax.Array, jax.Array, dict]:
     """ONE fused dispatch for a mixed decode/prefill batch (the ragged
     entry point, arXiv 2604.15464): every participating slot contributes
@@ -285,7 +286,12 @@ def _paged_ragged_step(
     span's last row — a decoding slot's next token and an admission-
     completing slot's FIRST token come out of the same dispatch — plus
     the updated pool. Rows of mid-prefill or idle slots are sampled too
-    (static shapes) and discarded by the scheduler."""
+    (static shapes) and discarded by the scheduler.
+
+    ``adapters`` = (stacked, ids (S,), scaling): every row rides its
+    OWNING slot's LoRA adapter through the shared chunk body (multi-LoRA
+    over the ragged dispatch) — decode rows and admission chunk rows
+    alike, so prefill is adapter-aware for free."""
     posmat = tok_pos[:, None]
     tok_tables = tables[tok_seq]
     tok_mask = kv_mask[tok_seq]
@@ -298,6 +304,7 @@ def _paged_ragged_step(
         params, cfg, tokens, pool, tok_tables, tok_mask, cos, sin, blks,
         offs, posmat, block_size, attn_kernel=attn_kernel,
         ragged=(seq_starts, seq_lens, kv_lens, tables, kv_mask),
+        adapters=_row_adapters(adapters, tok_seq),
     )
     # Logits only at each slot's last row — the lm head runs S wide, not
     # T wide (the budget is several× the slot count under load).
@@ -310,6 +317,68 @@ def _paged_ragged_step(
         jax.nn.log_softmax(logits, axis=-1), nxt[:, None], axis=-1
     )[:, 0]
     return nxt, lp, new_pool
+
+
+def _row_adapters(adapters, tok_seq):
+    """Per-SLOT adapter spec → per-ROW gathered selection for the chunk
+    body: (stacked, ids (S,), scaling) becomes (sel, scaling) with sel's
+    leaves (L, T, in, r) — each flattened row indexes its owning slot's
+    adapter pair. None passes through (the base-only program)."""
+    if adapters is None:
+        return None
+    from kubeflow_tpu.models.multilora import _gather_adapters
+
+    stacked, ids, scaling = adapters
+    return _gather_adapters(stacked, ids[tok_seq]), scaling
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "block_size", "attn_kernel"),
+    donate_argnums=(3,),
+)
+def _paged_ragged_verify(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # (T, 1) flattened mixed batch, tail-padded
+    pool: dict,
+    tables: jax.Array,  # (S, MAXB)
+    kv_mask: jax.Array,  # (S, MAXB * BS)
+    tok_pos: jax.Array,  # (T,)
+    tok_seq: jax.Array,  # (T,)
+    n_tokens: jax.Array,  # scalar int32
+    seq_starts: jax.Array,  # (S,)
+    seq_lens: jax.Array,    # (S,)
+    kv_lens: jax.Array,     # (S,)
+    block_size: int,
+    attn_kernel: bool = False,
+    adapters=None,  # (stacked, ids (S,), scaling)
+) -> tuple[jax.Array, dict]:
+    """The ragged dispatch with a T-wide ARGMAX head — speculation as a
+    scheduling mode of the fused step. Row metadata is identical to
+    _paged_ragged_step; the difference is WHAT each span means: a
+    speculating slot contributes a (1 + draft_len) verify span
+    [last, d_1..d_k] whose row j is the target's prediction after
+    ...[last, d_1..d_j], so the lm head must run at EVERY row, not just
+    last_rows (greedy acceptance walks the whole span; an admission-
+    completing span's first token is its last row's argmax). Returns
+    (per-row argmax predictions (T,), updated pool)."""
+    posmat = tok_pos[:, None]
+    tok_tables = tables[tok_seq]
+    tok_mask = kv_mask[tok_seq]
+    cos, sin, blks, offs = _chunk_coords(cfg, tok_tables, posmat, block_size)
+    tok_valid = jnp.arange(tokens.shape[0]) < n_tokens
+    blks = jnp.where(tok_valid[:, None], blks, 0)
+    x, new_pool = _paged_chunk_scan(
+        params, cfg, tokens, pool, tok_tables, tok_mask, cos, sin, blks,
+        offs, posmat, block_size, attn_kernel=attn_kernel,
+        ragged=(seq_starts, seq_lens, kv_lens, tables, kv_mask),
+        adapters=_row_adapters(adapters, tok_seq),
+    )
+    logits = _lm_head_logits(
+        _norm(x[:, 0], params["final_norm"], cfg), params
+    )
+    return jnp.argmax(logits, axis=-1), new_pool  # (T,)
 
 
 def _scatter_chunk(pool_l, k, v, blks, offs):
@@ -342,7 +411,7 @@ def _scatter_chunk(pool_l, k, v, blks, offs):
 
 def _paged_chunk_scan(params, cfg, tokens, pool, tables, kv_mask, cos, sin,
                       blks, offs, attn_positions, block_size,
-                      attn_kernel=False, ragged=None):
+                      attn_kernel=False, ragged=None, adapters=None):
     """The ONE paged decode body (scan over layers), shared by the
     ordinary decode step (K=1) and the speculative verify chunk (K>1) —
     same discipline as llama._chunk_decode_scan: a single body means a
@@ -369,7 +438,24 @@ def _paged_chunk_scan(params, cfg, tokens, pool, tables, kv_mask, cos, sin,
     whole chunk instead of once per token. Without the kernel the
     gathered per-token path below already handles the ragged layout
     (``tables``/``kv_mask`` arrive pre-indexed per token), which is the
-    CPU fallback tier-1 exercises."""
+    CPU fallback tier-1 exercises.
+
+    ``adapters``: ``(sel, scaling)`` — per-ROW LoRA selections with
+    layer-leading leaves (L, B, in, r), already gathered by adapter id
+    (_row_adapters). The deltas ride the base matmuls inside this ONE
+    body (multilora's skinny-einsum scheme), so every caller — decode,
+    ragged mixed batches, speculative verify — is adapter-correct
+    without a second forward."""
+    if adapters is not None:
+        # Lazy: multilora subclasses PagedBatcher, so the module-level
+        # import direction is multilora → paged.
+        from kubeflow_tpu.models.multilora import (
+            _adapted_mlp,
+            _adapted_qkv,
+            _delta,
+        )
+
+        sel_all, scaling = adapters
     x = _embed(params, cfg, tokens)
     use_kernel = (
         attn_kernel
@@ -386,9 +472,16 @@ def _paged_chunk_scan(params, cfg, tokens, pool, tables, kv_mask, cos, sin,
         )
 
     def body(x, scanned):
-        layer, pool_l = scanned  # per-layer pool dict, leaves (NB, Hkv, …)
+        if adapters is None:
+            layer, pool_l = scanned  # per-layer pool dict, (NB, Hkv, …)
+            sel = None
+        else:
+            layer, pool_l, sel = scanned
         h = _norm(x, layer["attn_norm"], cfg)
-        hq, hk, hv = _qkv(h, layer)
+        if sel is None:
+            hq, hk, hv = _qkv(h, layer)
+        else:
+            hq, hk, hv = _adapted_qkv(h, layer, sel, scaling)
         q = apply_rope(_split_heads(hq, cfg.n_heads), cos, sin,
                        per_batch=True)
         k = apply_rope(_split_heads(hk, cfg.n_kv_heads), cos, sin,
@@ -428,12 +521,19 @@ def _paged_chunk_scan(params, cfg, tokens, pool, tables, kv_mask, cos, sin,
                 v_scale=(gathered(pool_l["v_scale"])
                          if "v_scale" in pool_l else None),
             )
-        x = x + _mm(_merge_heads(attn), layer["wo"])
+        merged = _merge_heads(attn)
+        o = _mm(merged, layer["wo"])
+        if sel is not None and "wo" in sel:
+            o = o + _delta(merged, sel, "wo", scaling)
+        x = x + o
         h = _norm(x, layer["mlp_norm"], cfg)
-        x = x + _mlp(layer, h, cfg)
+        x = x + (_mlp(layer, h, cfg) if sel is None
+                 else _adapted_mlp(layer, h, cfg, sel, scaling))
         return x, pool_l
 
-    return jax.lax.scan(body, x, (params["layers"], pool))
+    if adapters is None:
+        return jax.lax.scan(body, x, (params["layers"], pool))
+    return jax.lax.scan(body, x, (params["layers"], pool, sel_all))
 
 
 def _chunk_coords(cfg, tables, posmat, block_size):
@@ -463,6 +563,29 @@ def _gathered_view(pool_l, tables, n_kv_heads, block_size, head_dim):
     if g.ndim == 5:
         shape += (head_dim,)
     return g.transpose(perm).reshape(shape)
+
+
+def _gather_cells(pool: dict, blks, offs) -> dict:
+    """Snapshot the pool cells addressed by parallel (block, offset)
+    lists — the read half of speculative rollback. Generic over the
+    storage format: value leaves (L, NB, Hkv, BS, D) gather to
+    (N, L, Hkv, D), int8 scale leaves (one rank lower) to (N, L, Hkv) —
+    mixed basic/advanced indexing moves the advanced axes to the
+    front."""
+    bi = jnp.asarray(blks, jnp.int32)
+    oi = jnp.asarray(offs, jnp.int32)
+    return {name: leaf[:, bi, :, oi] for name, leaf in pool.items()}
+
+
+def _restore_cells(pool: dict, snap: dict, blks, offs) -> dict:
+    """Write a _gather_cells snapshot back — the unwind half of
+    speculative rollback: a rejected-suffix cell returns to its exact
+    pre-dispatch bytes, so the pool is byte-identical to a
+    never-speculated run (pinned by tests)."""
+    bi = jnp.asarray(blks, jnp.int32)
+    oi = jnp.asarray(offs, jnp.int32)
+    return {name: pool[name].at[:, bi, :, oi].set(snap[name])
+            for name in pool}
 
 
 @partial(
@@ -917,9 +1040,18 @@ class PagedBatcher(_BatcherBase):
         return len(self._prefix_entries)
 
     @staticmethod
-    def _chain_key(parent: Optional[bytes], tokens) -> bytes:
-        """Content address of one full block GIVEN its prefix chain."""
-        h = hashlib.sha1(b"root" if parent is None else parent)
+    def _chain_key(parent: Optional[bytes], tokens,
+                   adapter: Optional[int] = None) -> bytes:
+        """Content address of one full block GIVEN its prefix chain.
+        ``adapter`` salts the ROOT: a LoRA adapter changes every K/V the
+        same tokens produce, so chains must never cross-hit between
+        adapters — the whole chain forks at its first block. None keeps
+        the legacy base-model root byte-for-byte (gateway.chain_key
+        mirrors this exactly; parity is pinned by tests)."""
+        if parent is None:
+            parent = (b"root" if adapter is None
+                      else b"root|adapter:%d" % int(adapter))
+        h = hashlib.sha1(parent)
         h.update(np.asarray(tokens, np.int32).tobytes())
         return h.digest()
 
@@ -1009,7 +1141,8 @@ class PagedBatcher(_BatcherBase):
         cont = _Request(req.rid, req.prompt, req.tokens, max_new=req.max_new,
                         temperature=req.temperature, stop=req.stop,
                         logit_bias=req.logit_bias,
-                        logprobs=req.logprobs, deadline=req.deadline)
+                        logprobs=req.logprobs, deadline=req.deadline,
+                        adapter_id=req.adapter_id)
         self._queue.insert(0, cont)
 
     def _clear_slot_storage(self, slot: int, req: _Request) -> None:
@@ -1085,7 +1218,8 @@ class PagedBatcher(_BatcherBase):
         keys: list[str] = []
         parent: Optional[bytes] = None
         for j in range(registrable):
-            parent = self._chain_key(parent, kv_tokens[j * bs:(j + 1) * bs])
+            parent = self._chain_key(parent, kv_tokens[j * bs:(j + 1) * bs],
+                                     adapter=req.adapter_id)
             keys.append(parent.hex())
         send = [j for j in range(nblocks)
                 if j >= registrable or keys[j] not in skip]
@@ -1113,6 +1247,7 @@ class PagedBatcher(_BatcherBase):
             "version": 1,
             "block_size": bs,
             "kv_bits": 8 if "k_scale" in self.pool else 0,
+            "adapter": req.adapter_id,
             "tokens": [int(t) for t in kv_tokens],
             "pending_token": int(req.tokens[-1]),
             "pending_logprob": (
@@ -1185,11 +1320,19 @@ class PagedBatcher(_BatcherBase):
                 f"kv payload carries {len(entries)} blocks for a "
                 f"{lng}-token prompt (want {nblocks})"
             )
+        adapter = payload.get("adapter")
+        if adapter is not None and (
+                not isinstance(adapter, int) or isinstance(adapter, bool)):
+            raise ValueError(
+                f"kv payload adapter must be an int or null, "
+                f"got {adapter!r}"
+            )
         # Validation (and rid mint) via the shared request builder.
         req = self._build_request(
             tokens, max_new_tokens=max_new_tokens, temperature=temperature,
             stop=stop, logit_bias=logit_bias, deadline_s=deadline_s,
         )
+        req.adapter_id = adapter
         slot = None
         for i, r in enumerate(self._by_slot):
             if r is None and i not in self._ragged_admit:
@@ -1201,7 +1344,8 @@ class PagedBatcher(_BatcherBase):
         keys: list[bytes] = []
         parent: Optional[bytes] = None
         for j in range(registrable):
-            parent = self._chain_key(parent, tokens[j * bs:(j + 1) * bs])
+            parent = self._chain_key(parent, tokens[j * bs:(j + 1) * bs],
+                                     adapter=adapter)
             sent = entries[j].get("key")
             if sent is not None and sent != parent.hex():
                 raise ValueError(
@@ -1433,7 +1577,8 @@ class PagedBatcher(_BatcherBase):
                          shared=shared, max_new=req.max_new,
                          temperature=req.temperature, stop=req.stop,
                          logit_bias=req.logit_bias,
-                         logprobs=req.logprobs),
+                         logprobs=req.logprobs,
+                         adapter_id=req.adapter_id),
                 logits, jnp.asarray(padded), prompt_mask,
             )
 
@@ -1482,6 +1627,7 @@ class PagedBatcher(_BatcherBase):
                 max_new=req.max_new, temperature=req.temperature,
                 stop=req.stop, logit_bias=req.logit_bias,
                 logprobs=req.logprobs, deadline=req.deadline,
+                adapter_id=req.adapter_id,
             )
             # Sampling state goes live NOW: the chunk that completes this
             # prefill samples the first token inside its own dispatch.
@@ -1522,7 +1668,8 @@ class PagedBatcher(_BatcherBase):
                 parent: Optional[bytes] = None
                 for j in range(registrable):
                     key = self._chain_key(
-                        parent, effective[j * bs:(j + 1) * bs]
+                        parent, effective[j * bs:(j + 1) * bs],
+                        adapter=head.adapter_id,
                     )
                     ent = self._prefix_entries.get(key)
                     if ent is None and self._swap:
@@ -1602,7 +1749,8 @@ class PagedBatcher(_BatcherBase):
             # shareable as prompt text): cache ref + this request's ref.
             for j in range(m, registrable):
                 key = self._chain_key(parent,
-                                      effective[j * bs:(j + 1) * bs])
+                                      effective[j * bs:(j + 1) * bs],
+                                      adapter=req.adapter_id)
                 self._prefix_entries[key] = {
                     "block": all_blocks[j], "parent": parent, "children": 0,
                 }
@@ -1624,7 +1772,8 @@ class PagedBatcher(_BatcherBase):
                          max_new=req.max_new,
                          temperature=req.temperature, stop=req.stop,
                          logit_bias=req.logit_bias,
-                         logprobs=req.logprobs),
+                         logprobs=req.logprobs,
+                         adapter_id=req.adapter_id),
                 logits, jnp.asarray(dpad), None,
             )
 
@@ -1703,16 +1852,27 @@ class PagedBatcher(_BatcherBase):
                 self._clear_slot_storage(slot, req)
                 self._deliver_abort(req, reason)
 
-    def _step_ragged(self) -> None:
-        """Assemble ONE flattened mixed batch under the token budget —
-        every decoding slot's next token first (never squeezed out),
-        then each admitting slot's next prompt chunk — and run the
-        single fused dispatch. Spans are laid out in slot order, so
-        seq_starts is non-decreasing (the kernel's spill-row contract)."""
-        self._expire_ragged_admissions()
-        active = self._ensure_step_blocks()
-        if not active and not self._ragged_admit:
-            return
+    def _ragged_adapters(self):
+        """Per-slot adapter spec for the fused dispatches, or None (the
+        base-only program). Overridden by MultiLoraPagedBatcher with
+        (stacked, ids (S,), scaling) — every scheduling mode (plain
+        decode, admission chunks, speculative verify spans) routes
+        through this ONE hook, so they cannot disagree about a slot's
+        adapter."""
+        return None
+
+    def _assemble_ragged(self, spans: dict):
+        """Lay out ONE flattened mixed batch under the token budget —
+        every decode span in ``spans`` (slot → (token_list, pos0)) first,
+        in slot order (never squeezed out; seq_starts stays
+        non-decreasing, the kernel's spill-row contract), then each
+        admitting slot's next prompt chunk rides whatever budget is
+        left. A plain decode step passes 1-token spans; a speculative
+        step passes (1 + draft_len) verify spans — span length is the
+        ONLY difference between the two scheduling modes.
+
+        Returns (tokens, tok_pos, tok_seq, seq_starts, seq_lens,
+        kv_lens, last_rows, rows, completing)."""
         tb = self.token_budget
         tokens = np.full((tb, 1), self.gen.pad_id, np.int32)
         tok_pos = np.zeros((tb,), np.int32)
@@ -1721,19 +1881,22 @@ class PagedBatcher(_BatcherBase):
         seq_lens = np.zeros((self.slots,), np.int32)
         kv_lens = np.zeros((self.slots,), np.int32)
         last_rows = np.zeros((self.slots,), np.int32)
-        budget = tb - len(active)  # prefill rides what decode leaves
+        budget = tb - sum(len(toks) for toks, _ in spans.values())
         rows = 0
         completing: list[int] = []
         for slot in range(self.slots):
-            if self._by_slot[slot] is not None:
-                tokens[rows, 0] = self.tokens[slot, 0]
-                tok_pos[rows] = self.positions[slot]
-                tok_seq[rows] = slot
+            span = spans.get(slot)
+            if span is not None:
+                toks, pos0 = span
+                n = len(toks)
+                tokens[rows:rows + n, 0] = toks
+                tok_pos[rows:rows + n] = np.arange(pos0, pos0 + n)
+                tok_seq[rows:rows + n] = slot
                 seq_starts[slot] = rows
-                seq_lens[slot] = 1
-                kv_lens[slot] = self.positions[slot] + 1
-                last_rows[slot] = rows
-                rows += 1
+                seq_lens[slot] = n
+                kv_lens[slot] = pos0 + n
+                last_rows[slot] = rows + n - 1
+                rows += n
             elif slot in self._ragged_admit and budget > 0:
                 a = self._ragged_admit[slot]
                 start, n = a["cursor"].take(budget)
@@ -1750,19 +1913,64 @@ class PagedBatcher(_BatcherBase):
                 rows += n
                 if a["cursor"].done:
                     completing.append(slot)
-        if rows == 0:
-            return
-        # Dispatch width: the smallest power-of-two bucket that holds the
-        # assembled rows (floor 8, cap token_budget). The budget is
-        # CAPACITY, not shape — a mostly-decode step must not pay a full
-        # 512-row dispatch to carry 9 live rows; a decode-only step on a
-        # small engine should cost what the legacy (slots,1) step costs.
-        # Power-of-two buckets bound the compiled step variants at
-        # ~log2(budget).
+        return (tokens, tok_pos, tok_seq, seq_starts, seq_lens, kv_lens,
+                last_rows, rows, completing)
+
+    def _dispatch_width(self, rows: int) -> int:
+        """Dispatch width: the smallest power-of-two bucket that holds
+        the assembled rows (floor 8, cap token_budget). The budget is
+        CAPACITY, not shape — a mostly-decode step must not pay a full
+        512-row dispatch to carry 9 live rows; power-of-two buckets
+        bound the compiled step variants at ~log2(budget)."""
         width = 8
         while width < rows:
             width *= 2
-        width = min(width, tb)
+        return min(width, self.token_budget)
+
+    def _stamp_ragged(self, rows: int, decode_rows: int) -> None:
+        """Per-dispatch observability shared by both scheduling modes:
+        lifetime ragged counters + the drive span's last_step record."""
+        self.ragged_steps += 1
+        self.ragged_tokens += rows
+        self.ragged_fill = rows / self.token_budget
+        self.last_step = {
+            "decode_rows": decode_rows,
+            "prefill_rows": rows - decode_rows,
+            "fill": self.ragged_fill,
+        }
+
+    def _complete_ragged_admissions(self, completing, first_tok: dict,
+                                    first_lp: dict) -> None:
+        """Finish admissions whose last prompt chunk just dispatched:
+        the SAME dispatch already produced each one's first token
+        (``first_tok``/``first_lp`` per slot; lp None on argmax-only
+        verify dispatches) — no separate prefill readback."""
+        for slot in completing:
+            a = self._ragged_admit.pop(slot)
+            req = a["req"]
+            req.budget = self._initial_budget(req) - len(req.tokens)
+            self._by_slot[slot] = req
+            self._post_admit(slot, jnp.asarray(a["padded"]),
+                             a["prompt_mask"])
+            self._note_token(slot, first_tok[slot], first_lp.get(slot))
+
+    def _step_ragged(self) -> None:
+        """One fused mixed prefill/decode dispatch: every decoding
+        slot's next token plus admission chunks under the token budget
+        (_assemble_ragged), sampled at each span's last row."""
+        self._expire_ragged_admissions()
+        active = self._ensure_step_blocks()
+        if not active and not self._ragged_admit:
+            return
+        spans = {
+            slot: ([int(self.tokens[slot, 0])], int(self.positions[slot]))
+            for slot in active
+        }
+        (tokens, tok_pos, tok_seq, seq_starts, seq_lens, kv_lens,
+         last_rows, rows, completing) = self._assemble_ragged(spans)
+        if rows == 0:
+            return
+        width = self._dispatch_width(rows)
         self.key, sub = jax.random.split(self.key)
         nxt, lps, self.pool = _paged_ragged_step(
             self.params, self.cfg, jnp.array(tokens[:width]), self.pool,
@@ -1774,15 +1982,9 @@ class PagedBatcher(_BatcherBase):
             self.block_size, jnp.array(self.temps), self.gen.top_k,
             self.gen.top_p, bias=self._bias,
             attn_kernel=self.attn_kernel,
+            adapters=self._ragged_adapters(),
         )
-        self.ragged_steps += 1
-        self.ragged_tokens += rows
-        self.ragged_fill = rows / tb
-        self.last_step = {
-            "decode_rows": len(active),
-            "prefill_rows": rows - len(active),
-            "fill": self.ragged_fill,
-        }
+        self._stamp_ragged(rows, decode_rows=len(active))
         host_next = np.asarray(nxt)
         host_lps = np.asarray(lps)
         for slot in active:
@@ -1790,15 +1992,10 @@ class PagedBatcher(_BatcherBase):
         for slot in active:
             self._note_token(slot, int(host_next[slot]),
                              float(host_lps[slot]))
-        for slot in completing:
-            # The completing chunk's dispatch already sampled the first
-            # token (its span's last row) — finish the admission
-            # bookkeeping without a separate prefill readback.
-            a = self._ragged_admit.pop(slot)
-            req = a["req"]
-            req.budget = self._initial_budget(req) - len(req.tokens)
-            self._by_slot[slot] = req
-            self._post_admit(slot, jnp.asarray(a["padded"]),
-                             a["prompt_mask"])
-            self._note_token(slot, int(host_next[slot]),
-                             float(host_lps[slot]))
+        # The completing chunk's dispatch already sampled the first
+        # token (its span's last row).
+        self._complete_ragged_admissions(
+            completing,
+            {s: int(host_next[s]) for s in completing},
+            {s: float(host_lps[s]) for s in completing},
+        )

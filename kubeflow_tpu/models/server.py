@@ -300,6 +300,72 @@ def tier_role_from_env() -> str:
     return raw or "fused"
 
 
+def spec_from_env() -> tuple[int, bool]:
+    """Consuming end of the speculative-decoding knobs: the
+    ``(draft_len, adaptive)`` pair for engine construction — draft_len 0
+    means speculation off, otherwise
+    ``SpeculativePagedBatcher(k_spec=draft_len, adaptive=...)``
+    (examples/serve_http.py consumes this next to ``ragged_from_env``).
+    Raises on garbage — a hand-set env var must not silently fall
+    back."""
+    import os
+
+    from kubeflow_tpu.webhook.tpu_env import (
+        KUBEFLOW_TPU_SPEC_ADAPTIVE,
+        KUBEFLOW_TPU_SPEC_DRAFT_LEN,
+    )
+
+    draft_len = 0
+    raw = os.environ.get(KUBEFLOW_TPU_SPEC_DRAFT_LEN, "").strip()
+    if raw:
+        try:
+            draft_len = int(raw)
+        except ValueError:
+            draft_len = -1
+        if draft_len < 0:
+            raise ValueError(
+                f"{KUBEFLOW_TPU_SPEC_DRAFT_LEN}={raw!r}: want a "
+                "non-negative draft length (0 disables speculation)"
+            )
+    raw = os.environ.get(KUBEFLOW_TPU_SPEC_ADAPTIVE, "").strip().lower()
+    if raw not in ("", "0", "1", "true", "false"):
+        raise ValueError(
+            f"{KUBEFLOW_TPU_SPEC_ADAPTIVE}={raw!r}: want 0/1/true/false"
+        )
+    adaptive = raw in ("1", "true")
+    if adaptive and not draft_len:
+        raise ValueError(
+            f"{KUBEFLOW_TPU_SPEC_ADAPTIVE}=1 without "
+            f"{KUBEFLOW_TPU_SPEC_DRAFT_LEN}: the adaptive range is "
+            "[1, draft_len], so a draft length must be set"
+        )
+    return draft_len, adaptive
+
+
+def lora_cache_from_env() -> int:
+    """Consuming end of the hot-adapter cache bound: slots for
+    ``MultiLoraPagedBatcher(lora_cache_slots=...)`` (0 = uncapped
+    residency, counters off). Raises on garbage — a hand-set env var
+    must not silently fall back."""
+    import os
+
+    from kubeflow_tpu.webhook.tpu_env import KUBEFLOW_TPU_LORA_CACHE_SLOTS
+
+    raw = os.environ.get(KUBEFLOW_TPU_LORA_CACHE_SLOTS, "").strip()
+    if not raw:
+        return 0
+    try:
+        slots = int(raw)
+    except ValueError:
+        slots = -1
+    if slots < 0:
+        raise ValueError(
+            f"{KUBEFLOW_TPU_LORA_CACHE_SLOTS}={raw!r}: want a "
+            "non-negative slot count (0 leaves residency uncapped)"
+        )
+    return slots
+
+
 class InferenceServer:
     """HTTP front-end driving one batching engine on a background thread.
 
@@ -354,8 +420,10 @@ class InferenceServer:
         self.metrics = metrics
         # The speculative engines are thin wrappers delegating to an
         # inner batcher (`_engine`) that owns the queue/slots/step loop —
-        # hooks and the drive loop must target the inner one.
+        # hooks and the drive loop must target the inner one. The WRAPPER
+        # owns the acceptance stats, so keep a ref for /stats + metrics.
         self.engine = getattr(engine, "_engine", engine)
+        self._spec = engine if hasattr(engine, "spec_stats") else None
         if model_name in getattr(self.engine, "adapter_names", ()):
             # The "model == model_name → base" shortcut in _submit would
             # make that adapter silently unreachable.
@@ -405,6 +473,8 @@ class InferenceServer:
         # prefix-cache tallies by delta, last-mirrored snapshot here.
         self._prefix_mirrored = (0, 0, 0)
         self._swap_mirrored = (0, 0, 0)
+        self._spec_mirrored = (0, 0)
+        self._lora_mirrored = (0, 0, 0)
         self._stalls_mirrored = 0
         # Per-request span registry for the TTFT decomposition: rid →
         # {"root", "queue_wait", "prefill"} spans. queue_wait starts at
@@ -631,6 +701,28 @@ class InferenceServer:
                         self.metrics.serving_kv_swap_bytes.set(
                             self.engine.swap_bytes_used
                         )
+                    if self.metrics is not None and self._spec is not None:
+                        st = self._spec.spec_stats()
+                        acc, rnd = st["accepted"], st["rounds"]
+                        pa, pr = self._spec_mirrored
+                        self.metrics.serving_spec_accept_total.inc(acc - pa)
+                        self.metrics.serving_spec_rounds_total.inc(rnd - pr)
+                        self._spec_mirrored = (acc, rnd)
+                    lc_fn = getattr(self.engine, "lora_cache_stats", None)
+                    if self.metrics is not None and lc_fn is not None:
+                        lc = lc_fn()
+                        if lc is not None:
+                            h, ms, ev = (lc["hits"], lc["misses"],
+                                         lc["evictions"])
+                            ph, pm, pe = self._lora_mirrored
+                            self.metrics.serving_lora_cache_hits_total \
+                                .inc(h - ph)
+                            self.metrics.serving_lora_cache_misses_total \
+                                .inc(ms - pm)
+                            self.metrics \
+                                .serving_lora_cache_evictions_total \
+                                .inc(ev - pe)
+                            self._lora_mirrored = (h, ms, ev)
                 except Exception as err:  # device OOM, preemption, ...
                     # The engine is in an unknown state: fail loudly —
                     # close every pending queue so no handler blocks
@@ -1081,6 +1173,16 @@ class InferenceServer:
                                     server.engine.ragged_tokens / steps, 2
                                 ) if steps else 0.0,
                             }
+                        # Speculative wrapper stats ("accepted"/"rounds"
+                        # surface tpu_serving_spec_* per STATS_PARITY)
+                        # and the bounded hot-adapter cache's counters
+                        # ("hits"/"misses"/"evictions" →
+                        # tpu_serving_lora_cache_*).
+                        spec = (server._spec.spec_stats()
+                                if server._spec is not None else None)
+                        lc_fn = getattr(server.engine,
+                                        "lora_cache_stats", None)
+                        lora = lc_fn() if lc_fn is not None else None
                         ttft = list(server._ttft)
                         e2e = list(server._e2e)
                         queue_wait = list(server._queue_wait)
@@ -1138,6 +1240,10 @@ class InferenceServer:
                         # conservative fallback floor.
                         **({"kv_pool": pool} if pool is not None else {}),
                         **({"ragged": rag} if rag is not None else {}),
+                        **({"speculative": spec}
+                           if spec is not None else {}),
+                        **({"lora_cache": lora}
+                           if lora is not None else {}),
                         **({"prefix_cache": pc} if pc is not None else {}),
                         # Flight-recorder view (stall count surfaces the
                         # tpu_engine_step_stall_total family per the

@@ -179,7 +179,8 @@ def speculative_generate(
     return jnp.asarray([o[:steps] for o in out], jnp.int32), stats
 
 
-def _apply_spec_round(outer, engine, active, preds_np, props_np) -> None:
+def _apply_spec_round(outer, engine, active, preds_np, props_np,
+                      k_spec=None) -> dict:
     """Accept/emit/rewind/stats for one SERVING speculative round — the
     ONE home for the per-slot acceptance walk, the retired-mid-round
     guard, and the consumed-proposals stat discipline, shared by the
@@ -187,11 +188,18 @@ def _apply_spec_round(outer, engine, active, preds_np, props_np) -> None:
     reported acceptance_rate cannot drift.
 
     ``outer`` carries k_spec/proposed/accepted; ``engine`` is the inner
-    batcher (slots/positions/_note_token)."""
+    batcher (slots/positions/_note_token). ``k_spec`` overrides the
+    round's draft length (adaptive ragged rounds propose
+    outer.k_cur ≤ outer.k_spec); defaults to outer.k_spec. Returns
+    {slot: n_accept} so paged callers can roll back the rejected
+    suffix's block-pool writes."""
+    k = outer.k_spec if k_spec is None else k_spec
+    outer.rounds += 1
+    accepts: dict[int, int] = {}
     for slot in active:
         n_accept = 0
         while (
-            n_accept < outer.k_spec
+            n_accept < k
             and preds_np[slot, n_accept] == props_np[slot, n_accept]
         ):
             n_accept += 1
@@ -213,11 +221,13 @@ def _apply_spec_round(outer, engine, active, preds_np, props_np) -> None:
         # counting them would skew acceptance_rate low near retirements
         # (it is a REPORTED serving metric).
         if consumed == len(emitted):
-            outer.proposed += outer.k_spec
+            outer.proposed += k
             outer.accepted += n_accept
         else:
             outer.proposed += consumed
             outer.accepted += min(consumed, n_accept)
+        accepts[slot] = n_accept
+    return accepts
 
 
 class _SpecServingBase:
@@ -230,7 +240,10 @@ class _SpecServingBase:
 
     @staticmethod
     def _require_greedy(gen) -> None:
-        if gen.temperature != 0.0:
+        # Both greedy spellings pass: temperature=0.0 AND the
+        # sampling-off default temperature=None (None != 0.0, so the
+        # naive comparison used to reject the default config).
+        if gen.temperature is not None and gen.temperature != 0.0:
             raise ValueError(
                 "speculative serving is greedy-only (temperature must be 0: "
                 "acceptance compares argmaxes, sampling would break the "
@@ -297,6 +310,11 @@ class _SpecServingBase:
             self.draft_params = plan.shard_params(draft_params)
         self.proposed = 0
         self.accepted = 0
+        self.rounds = 0
+        # Adaptive ragged rounds move k_cur within 1..k_spec; every other
+        # path proposes the full k_spec (it is a static program arg).
+        self.k_cur = k_spec
+        self._accept_ema = None  # EMA of per-round acceptance (adaptive)
 
     # -- public surface (delegated) ----------------------------------------
 
@@ -317,7 +335,38 @@ class _SpecServingBase:
     def acceptance_rate(self) -> float:
         return self.accepted / self.proposed if self.proposed else 0.0
 
+    def spec_stats(self) -> dict:
+        """The /stats "speculative" block (server.py mirrors accepted/
+        rounds deltas into the metric registry; signals.py windows them
+        into fleet rates)."""
+        return {
+            "rounds": self.rounds,
+            "proposed": self.proposed,
+            "accepted": self.accepted,
+            "acceptance_rate": self.acceptance_rate,
+            "draft_len": self.k_cur,
+        }
+
     # -- internals ---------------------------------------------------------
+
+    def _adapt_draft_len(self, n_proposed: int, n_accepted: int) -> None:
+        """Acceptance-rate-adaptive draft length (EMA-smoothed): a draft
+        that keeps getting rejected wastes verify rows in the shared
+        ragged token budget, so k_cur shrinks toward 1; sustained high
+        acceptance grows it back toward k_spec. Only the ragged
+        scheduling mode consults k_cur per round — the fixed-slot
+        programs bake k_spec in as a static arg."""
+        if not n_proposed:
+            return
+        r = n_accepted / n_proposed
+        self._accept_ema = (
+            r if self._accept_ema is None
+            else 0.8 * self._accept_ema + 0.2 * r
+        )
+        if self._accept_ema >= 0.8 and self.k_cur < self.k_spec:
+            self.k_cur += 1
+        elif self._accept_ema < 0.4 and self.k_cur > 1:
+            self.k_cur -= 1
 
     def _admit_draft(self, slot, padded, prompt_mask) -> None:
         from kubeflow_tpu.models.continuous import _admit_slot
@@ -456,12 +505,35 @@ class SpeculativePagedBatcher(_SpecServingBase):
         prompt_cache: bool = False,  # share identical prompts' TARGET blocks
         prefix_cache: bool = False,  # share common-prefix TARGET blocks
         admit_chunk=None,  # prefix-admission piece width (PagedBatcher)
+        ragged: bool = False,  # speculation as a ragged scheduling mode
+        token_budget=None,  # ragged: verify+prefill rows per fused step
+        adaptive: bool = False,  # ragged: acceptance-adaptive draft len
+        attn_kernel=None,  # forwarded to PagedBatcher (ragged verify)
     ):
         from kubeflow_tpu.models.paged import PagedBatcher
         from kubeflow_tpu.models.serving import GenerationConfig
 
         gen = gen or GenerationConfig()
         self._require_greedy(gen)
+        if adaptive and not ragged:
+            raise ValueError(
+                "adaptive=True requires ragged=True: the fixed-slot "
+                "verify program bakes k_spec in as a static shape; only "
+                "ragged rounds can vary the span length per step"
+            )
+        if ragged:
+            # Every decoding slot contributes 1+k_spec verify rows to the
+            # fused dispatch; the budget must hold a full-house round
+            # (admission chunks ride whatever is left).
+            if token_budget is None:
+                token_budget = max(512, slots * (k_spec + 1))
+            if token_budget < slots * (k_spec + 1):
+                raise ValueError(
+                    f"token_budget {token_budget} < slots*(k_spec+1) = "
+                    f"{slots * (k_spec + 1)}: every decoding slot "
+                    "contributes 1+k_spec verify rows per ragged step"
+                )
+        self.adaptive = bool(adaptive)
         self._engine = self._pb = self._make_inner(PagedBatcher)(
             params, target_cfg, gen=gen, slots=slots, num_blocks=num_blocks,
             block_size=block_size, prompt_bucket=prompt_bucket, key=key,
@@ -478,6 +550,8 @@ class SpeculativePagedBatcher(_SpecServingBase):
             prompt_cache=prompt_cache,
             prefix_cache=prefix_cache,
             admit_chunk=admit_chunk,
+            ragged=ragged, token_budget=token_budget,
+            attn_kernel=attn_kernel,
         )
         # Dense draft cache spanning the pool's logical window (bucket
         # overhang on preempted continuations included — max_blocks
@@ -492,6 +566,9 @@ class SpeculativePagedBatcher(_SpecServingBase):
         return self._pb.free_blocks
 
     def _spec_step(self) -> None:
+        if self._pb.ragged:
+            self._spec_step_ragged()
+            return
         from kubeflow_tpu.models.paged import _paged_verify
 
         pb = self._pb
@@ -513,6 +590,134 @@ class SpeculativePagedBatcher(_SpecServingBase):
         )
         _apply_spec_round(self, pb, active, np.asarray(preds),
                           np.asarray(proposals))
+
+    def _spec_step_ragged(self) -> None:
+        """One speculative round as a RAGGED scheduling mode: each
+        decoding slot contributes a (1 + k) verify span — its last
+        token plus the draft's k proposals — to the SAME fused dispatch
+        that carries admission prefill chunks; the verify rows land
+        in the paged blocks through the tables exactly like decode
+        rows (span causality comes from the kernel's position bound,
+        so a span never sees its own later rows' writes).
+
+        Rollback protocol: the (1+k) cells each span will write are
+        snapshotted BEFORE the dispatch; after the acceptance walk the
+        rejected suffix's cells are restored byte-identical and any
+        trailing blocks the rewound pointer no longer covers are freed
+        — the pool ends every round exactly as if the accepted tokens
+        had been decoded one at a time."""
+        from kubeflow_tpu.models.paged import (
+            _gather_cells,
+            _paged_ragged_verify,
+            _restore_cells,
+        )
+
+        pb = self._pb
+        pb._expire_ragged_admissions()
+        k = self.k_cur if self.adaptive else self.k_spec
+        # Allocate blocks covering every slot's whole verify span up
+        # front (may preempt; returns the post-preemption active set).
+        active = pb._ensure_step_blocks(span=k + 1)
+        if not active and not pb._ragged_admit:
+            return
+        props_np = None
+        if active:
+            positions = jnp.asarray(pb.positions, jnp.int32)
+            last = jnp.asarray(pb.tokens, jnp.int32)  # (B, 1) inputs
+            proposals, self.draft_cache = _draft_propose(
+                self.draft_params, self.draft_cfg, last, self.draft_cache,
+                positions, k, kv_mask=self.draft_kv_mask,
+            )
+            props_np = np.asarray(proposals)
+        spans = {
+            slot: (
+                [int(pb.tokens[slot, 0])]
+                + [int(t) for t in props_np[slot]],
+                int(pb.positions[slot]),
+            )
+            for slot in active
+        }
+        (tokens, tok_pos, tok_seq, seq_starts, seq_lens, kv_lens,
+         last_rows, rows, completing) = pb._assemble_ragged(spans)
+        if rows == 0:
+            return
+        # Snapshot the cells every verify span will write (positions
+        # p0..p0+k per slot) so the rejected suffix can be rolled back
+        # byte-identical. Cell lists are ordered [slot-major, offset-
+        # minor]: span i's offset j lives at index i*(k+1)+j.
+        cell_blks: list[int] = []
+        cell_offs: list[int] = []
+        for slot in active:
+            req = pb._by_slot[slot]
+            p0 = int(pb.positions[slot])
+            for j in range(k + 1):
+                pos = p0 + j
+                cell_blks.append(req.blocks[pos // pb.block_size])
+                cell_offs.append(pos % pb.block_size)
+        snap = (_gather_cells(pb.pool, cell_blks, cell_offs)
+                if cell_blks else None)
+        width = pb._dispatch_width(rows)
+        preds, pb.pool = _paged_ragged_verify(
+            pb.params, pb.cfg, jnp.array(tokens[:width]), pb.pool,
+            jnp.array(pb.tables), pb.kv_mask,
+            jnp.array(tok_pos[:width]), jnp.array(tok_seq[:width]),
+            jnp.asarray(rows, jnp.int32), jnp.array(seq_starts),
+            jnp.array(seq_lens), jnp.array(kv_lens), pb.block_size,
+            attn_kernel=pb.attn_kernel, adapters=pb._ragged_adapters(),
+        )
+        pb._stamp_ragged(rows, decode_rows=(k + 1) * len(active))
+        host_preds = np.asarray(preds)
+        if active:
+            # Per-slot verdicts, indexed by SLOT like the fixed-slot
+            # path so _apply_spec_round is shared verbatim.
+            preds_mat = np.zeros((pb.slots, k + 1), host_preds.dtype)
+            for slot in active:
+                s0 = int(seq_starts[slot])
+                preds_mat[slot] = host_preds[s0:s0 + k + 1]
+            p_before, a_before = self.proposed, self.accepted
+            accepts = _apply_spec_round(self, pb, active, preds_mat,
+                                        props_np, k_spec=k)
+            # Roll back the rejected suffix: restore its cells to the
+            # pre-dispatch bytes in ONE scatter. Restores into blocks a
+            # retired slot already freed are harmless (the cells are
+            # re-zeroed or rewritten at the block's next allocation).
+            idx = [
+                j
+                for i, slot in enumerate(active)
+                for j in range(i * (k + 1) + accepts[slot] + 1,
+                               (i + 1) * (k + 1))
+            ]
+            if idx:
+                pb.pool = _restore_cells(
+                    pb.pool,
+                    {name: leaf[np.asarray(idx)]
+                     for name, leaf in snap.items()},
+                    [cell_blks[j] for j in idx],
+                    [cell_offs[j] for j in idx],
+                )
+            # Free trailing blocks the rewound pointer no longer covers,
+            # leaving each live slot with exactly the lazily-grown block
+            # count the never-speculated path would hold.
+            for slot in active:
+                req = pb._by_slot[slot]
+                if req is None:
+                    continue  # retired mid-round: blocks already freed
+                keep = (int(pb.positions[slot]) - 1) // pb.block_size + 1
+                while len(req.blocks) > max(keep, 1):
+                    blk = req.blocks.pop()
+                    pb.tables[slot, len(req.blocks)] = 0
+                    pb._free.append(blk)
+            if self.adaptive:
+                self._adapt_draft_len(self.proposed - p_before,
+                                      self.accepted - a_before)
+        # Admissions whose last prompt chunk rode this dispatch: their
+        # first token is the argmax at their span's last row (no
+        # logprob — verify dispatches are argmax-only).
+        pb._complete_ragged_admissions(
+            completing,
+            {s: int(host_preds[int(last_rows[s])]) for s in completing},
+            {},
+        )
 
 
 def truncated_draft(params: dict, cfg: LlamaConfig,

@@ -554,6 +554,15 @@ class FleetTelemetry:
         ("kv_swap.swap_out", "fleet_kv_swap_out"),
         ("kv_swap.swap_in", "fleet_kv_swap_in"),
         ("kv_swap.restored_tokens", "fleet_kv_swap_restored_tokens"),
+        # Speculative decoding: accepted proposals + verify rounds from
+        # the /stats ``speculative`` block → fleet acceptance rates.
+        ("speculative.accepted", "fleet_spec_accept"),
+        ("speculative.rounds", "fleet_spec_rounds"),
+        # Multi-LoRA: hot-adapter cache churn from the ``lora_cache``
+        # block — the (prefix, adapter) affinity router's scoreboard.
+        ("lora_cache.hits", "fleet_lora_cache_hits"),
+        ("lora_cache.misses", "fleet_lora_cache_misses"),
+        ("lora_cache.evictions", "fleet_lora_cache_evictions"),
     )
 
     def ingest_replica(self, endpoint: str, stats: Optional[dict]) -> None:
@@ -671,6 +680,16 @@ class FleetTelemetry:
                 "served_per_s": _rate("fleet_served"),
                 "tokens_per_s": _rate("fleet_tokens"),
                 "stalls_per_s": _rate("fleet_stalls"),
+                # Speculative decoding + multi-LoRA serving rates.
+                "spec_accept_per_s": _rate("fleet_spec_accept"),
+                "spec_rounds_per_s": _rate("fleet_spec_rounds"),
+                "lora_cache_hits_per_s": _rate("fleet_lora_cache_hits"),
+                "lora_cache_misses_per_s": _rate(
+                    "fleet_lora_cache_misses"
+                ),
+                "lora_cache_evictions_per_s": _rate(
+                    "fleet_lora_cache_evictions"
+                ),
                 "ring_size": hub.gauge_last("ring_size"),
                 "replica_queue_depth": hub.gauge_children(
                     "replica_queue_depth"

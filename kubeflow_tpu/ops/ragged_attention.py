@@ -41,6 +41,14 @@ Layout contract (enforced by the wrapper, produced by the schedulers):
 - kv_mask carries PADDING validity only; future positions may stay True
   because the positional bound already hides them (the same convention
   models/paged.py documents for its decode step).
+- Speculative verify spans are ordinary clients of this contract: a
+  decoding slot in spec mode contributes a (1 + draft_len) row span —
+  last committed token plus the draft proposals — and the positional
+  bound ``k_pos <= kv_len - seq_len + j`` makes each verify row causal
+  over exactly the prefix it would see in sequential decode, so target
+  verification of all draft positions rides the same fused dispatch as
+  plain decode rows and prefill chunks with no kernel changes
+  (models/speculative.py ``_spec_step_ragged`` builds these spans).
 
 Pools are bf16 OR int8-value + bf16-scale (the quantize-on-write format
 ``models/paged.py`` produces for ``kv_bits=8``): pass ``k_scale_pool``/

@@ -78,6 +78,13 @@ KUBEFLOW_TPU_KV_TRANSFER_MAX_BYTES = "KUBEFLOW_TPU_KV_TRANSFER_MAX_BYTES"
 KUBEFLOW_TPU_KV_BITS = "KUBEFLOW_TPU_KV_BITS"
 KUBEFLOW_TPU_HBM_FRACTION = "KUBEFLOW_TPU_HBM_FRACTION"
 KUBEFLOW_TPU_KV_SWAP_BYTES = "KUBEFLOW_TPU_KV_SWAP_BYTES"
+# Speculative decoding + multi-LoRA serving (models/server.py
+# spec_from_env / lora_cache_from_env → SpeculativePagedBatcher /
+# MultiLoraPagedBatcher): draft length, acceptance-adaptive draft
+# shrink/grow, and the per-replica hot-adapter cache bound.
+KUBEFLOW_TPU_SPEC_DRAFT_LEN = "KUBEFLOW_TPU_SPEC_DRAFT_LEN"
+KUBEFLOW_TPU_SPEC_ADAPTIVE = "KUBEFLOW_TPU_SPEC_ADAPTIVE"
+KUBEFLOW_TPU_LORA_CACHE_SLOTS = "KUBEFLOW_TPU_LORA_CACHE_SLOTS"
 # Persistent JAX compilation cache (bench.py capture windows; any runtime
 # entrypoint may opt in): compiled executables survive process restarts.
 KUBEFLOW_TPU_COMPILE_CACHE_DIR = "KUBEFLOW_TPU_COMPILE_CACHE_DIR"
@@ -190,6 +197,17 @@ ENV_CONTRACT: dict = {
     "byte budget for the host-RAM block-swap tier — demoted prefix "
     "chains park here instead of being lost, LRU within the budget; "
     "unset/0 disables the tier",
+    KUBEFLOW_TPU_SPEC_DRAFT_LEN: "operator-set on the serving container: "
+    "speculative draft length k — each decode slot contributes 1+k "
+    "verify rows to the fused ragged dispatch; unset/0 disables "
+    "speculation — consumed by models/server.py spec_from_env",
+    KUBEFLOW_TPU_SPEC_ADAPTIVE: "operator-set on the serving container: "
+    "1/true lets the acceptance-rate EMA shrink/grow the per-round "
+    "draft length within [1, SPEC_DRAFT_LEN]; unset/0 keeps it fixed",
+    KUBEFLOW_TPU_LORA_CACHE_SLOTS: "operator-set on the serving "
+    "container: bound of the per-replica hot-adapter cache (LRU, "
+    "eviction counters in /stats); unset/0 leaves adapter residency "
+    "uncapped — consumed by models/server.py lora_cache_from_env",
     KUBEFLOW_TPU_COMPILE_CACHE_DIR: "operator-set (bench watcher env or "
     "notebook container): directory for JAX's persistent compilation "
     "cache; bench.py enables it at startup and stamps the dir into "

@@ -47,14 +47,17 @@ p95 TTFT no worse than random's, with zero churn failures.
 model, skips the artifact and the win gate (executability only) — the
 integration-workflow tier.
 
-Two sibling experiments share the harness: ``--disagg`` (prefill/decode
-tier split, SERVE_r08_disagg.json) and ``--evict-storm`` (HBM economy:
+Sibling experiments share the harness: ``--disagg`` (prefill/decode
+tier split, SERVE_r08_disagg.json), ``--evict-storm`` (HBM economy:
 bf16 evict+re-prefill vs int8 KV + host-RAM swap on one byte budget,
-SERVE_r09_hbm.json).
+SERVE_r09_hbm.json), and ``--spec`` / ``--multilora`` (speculative
+decoding as a ragged scheduling mode, token-exact vs plain; 64-adapter
+multi-LoRA fleet with (prefix, adapter) affinity vs adapter-oblivious
+routing — both into SERVE_r10_spec.json).
 
 Usage: python loadtest/serve_fleet.py [--out SERVE_r07_fleet.json]
        [--replicas 3] [--tenants 6] [--rounds 6] [--smoke]
-       [--disagg | --evict-storm]
+       [--disagg | --evict-storm | --spec --multilora]
 """
 
 from __future__ import annotations
@@ -1065,6 +1068,348 @@ def main_evict(args) -> int:
     return 0 if win else 1
 
 
+# ---------------------------------------------------------------------------
+# --spec / --multilora (r10): speculation as a ragged scheduling mode +
+# multi-LoRA serving with (prefix, adapter) affinity routing.
+# ---------------------------------------------------------------------------
+
+SPEC_SLOTS = 2             # decode slots; each contributes 1+k verify rows
+SPEC_K = 7                 # draft length (verify span = 8 rows/slot)
+SPEC_REQUESTS = 6
+SPEC_DECODE_TOKENS = 32
+SPEC_DAMP = 0.05           # per-layer residual damping (see _spec_models)
+
+ML_REPLICAS = 4
+ML_ADAPTERS = 64
+ML_CACHE_SLOTS = 16        # hot adapters resident per replica
+ML_LOAD_S = 0.02           # simulated adapter-load stall on a cache miss
+ML_ROUNDS = 3
+ML_PREFIX_TOKENS = 16      # ONE system prompt shared by every adapter
+ML_TAIL_TOKENS = 5
+ML_DECODE_TOKENS = 6
+ML_CONCURRENCY = 16
+
+
+def _spec_models():
+    """Target in a draft-friendly regime: damp the per-layer residual
+    contributions so the embed/head pair (SHARED with the truncated
+    draft) dominates the argmax. A 1-layer draft then agrees with the
+    full target often — the high-acceptance regime a trained draft
+    earns — while every miss still exercises the real verify-reject-
+    rollback machinery, and the token-exactness gate is checked against
+    the plain scheduler either way."""
+    import jax.tree_util as jtu
+
+    from kubeflow_tpu.models.speculative import truncated_draft
+
+    params, cfg = _load_model()
+    params = dict(params, layers=jtu.tree_map(
+        lambda x: x * SPEC_DAMP, params["layers"]))
+    dparams, dcfg = truncated_draft(params, cfg, 1)
+    return params, cfg, dparams, dcfg
+
+
+def _bench_decode(engine, prompts):
+    """Warm-up pass (compiles every dispatch shape), then one timed
+    pass of the same prompts: (sorted streams, tokens/sec, wall_s)."""
+    for p in prompts:
+        engine.submit(p)
+    engine.run()
+    t0 = time.perf_counter()
+    for p in prompts:
+        engine.submit(p)
+    out = engine.run()
+    wall = time.perf_counter() - t0
+    toks = sum(len(v) for v in out.values())
+    return (sorted(tuple(v) for v in out.values()),
+            round(toks / wall, 2), round(wall, 3))
+
+
+def run_spec_arm() -> dict:
+    """Engine-level decode bench: plain ragged PagedBatcher vs the SAME
+    engine in speculative scheduling mode (each slot contributing
+    1+k_spec verify rows to the fused dispatch). The streams must be
+    token-identical; the speedup is rounds saved by acceptance."""
+    from kubeflow_tpu.models.paged import PagedBatcher
+    from kubeflow_tpu.models.serving import GenerationConfig
+    from kubeflow_tpu.models.speculative import SpeculativePagedBatcher
+
+    params, cfg, dparams, dcfg = _spec_models()
+    gen = GenerationConfig(max_new_tokens=SPEC_DECODE_TOKENS, eos_id=-1)
+    prompts = [[3 + (s * 37 + i) % (cfg.vocab_size - 4) for i in range(6)]
+               for s in range(SPEC_REQUESTS)]
+    kw = dict(gen=gen, slots=SPEC_SLOTS, num_blocks=64, block_size=8,
+              prompt_bucket=16)
+    plain = PagedBatcher(params, cfg, attn_kernel=False, ragged=True,
+                         token_budget=4 * SPEC_SLOTS, **kw)
+    plain_out, plain_tps, plain_wall = _bench_decode(plain, prompts)
+    spec = SpeculativePagedBatcher(
+        params, cfg, dparams, dcfg, k_spec=SPEC_K, ragged=True,
+        token_budget=SPEC_SLOTS * (SPEC_K + 1), **kw)
+    spec_out, spec_tps, spec_wall = _bench_decode(spec, prompts)
+    return {
+        "requests": SPEC_REQUESTS,
+        "slots": SPEC_SLOTS,
+        "k_spec": SPEC_K,
+        "decode_tokens": SPEC_DECODE_TOKENS,
+        "token_exact": plain_out == spec_out,
+        "plain_tokens_per_sec": plain_tps,
+        "spec_tokens_per_sec": spec_tps,
+        "speedup": round(spec_tps / max(plain_tps, 1e-9), 3),
+        "acceptance_rate": round(spec.acceptance_rate, 4),
+        "verify_rounds": spec.rounds,
+        "plain_wall_s": plain_wall,
+        "spec_wall_s": spec_wall,
+    }
+
+
+def _ml_prompt(adapter_id: int, nonce: int, vocab: int) -> list:
+    """ONE system prompt shared across every adapter (the worst case
+    for an adapter-oblivious prefix router: all 64 adapters' traffic
+    hashes to a single replica) + a unique per-request tail."""
+    prefix = [3 + (i * 7) % (vocab - 4) for i in range(ML_PREFIX_TOKENS)]
+    tail = [3 + (adapter_id * 131 + nonce * 17 + i * 11) % (vocab - 4)
+            for i in range(ML_TAIL_TOKENS)]
+    return prefix + tail
+
+
+def _ml_build_fleet(adapter_affinity: bool):
+    from kubeflow_tpu.models.gateway import ServingGateway
+    from kubeflow_tpu.models.lora import LoraConfig, init_lora_params
+    from kubeflow_tpu.models.multilora import (
+        MultiLoraPagedBatcher,
+        stack_adapters,
+    )
+    from kubeflow_tpu.models.server import InferenceServer
+    from kubeflow_tpu.models.serving import GenerationConfig
+
+    import jax
+
+    params, cfg = _load_model()
+    lcfg = LoraConfig(rank=2, targets=("wq", "wv"))
+    adapters = [init_lora_params(cfg, lcfg, jax.random.PRNGKey(seed))
+                for seed in range(ML_ADAPTERS)]
+    stacked = stack_adapters(adapters, cfg, lcfg)
+    names = [f"ad{i}" for i in range(ML_ADAPTERS)]
+    servers = []
+    for _ in range(ML_REPLICAS):
+        engine = MultiLoraPagedBatcher(
+            params, cfg, stacked, lcfg, adapter_names=names,
+            gen=GenerationConfig(max_new_tokens=ML_DECODE_TOKENS,
+                                 eos_id=-1),
+            slots=4, num_blocks=64, block_size=8, prompt_bucket=32,
+            attn_kernel=False, ragged=True, token_budget=16,
+            lora_cache_slots=ML_CACHE_SLOTS, lora_load_s=ML_LOAD_S,
+        )
+        servers.append(InferenceServer(
+            engine, port=0, drain_s=2.0,
+            max_queue_depth=4 * ML_ADAPTERS,  # queue, don't shed: the
+            # oblivious arm funnels the whole fleet's load to one
+            # replica and the p95 must show that, not 429s
+        ).start())
+    gw = ServingGateway(
+        [f"{s.host}:{s.port}" for s in servers], port=0, block_size=8,
+        health_interval_s=0.2, upstream_timeout_s=600.0,
+        adapter_affinity=adapter_affinity,
+    ).start()
+    return gw, servers, cfg
+
+
+def _ml_stream(gw, prompt, model, timeout: float = 600.0):
+    """One streaming completion with an adapter selection. Returns
+    (ok, ttft_seconds, detail)."""
+    body = {"prompt": prompt, "stream": True,
+            "max_tokens": ML_DECODE_TOKENS}
+    if model is not None:
+        body["model"] = model
+    conn = http.client.HTTPConnection(gw.host, gw.port, timeout=timeout)
+    try:
+        t0 = time.perf_counter()
+        conn.request("POST", "/v1/completions", json.dumps(body).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return False, 0.0, f"HTTP {resp.status}"
+        ttft = None
+        finished = False
+        error = None
+        while True:
+            line = resp.fp.readline()
+            if not line:
+                break
+            if not line.startswith(b"data:"):
+                continue
+            if line == b"data: [DONE]\n":
+                finished = True
+                break
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+            if b'"error"' in line:
+                error = line.decode().strip()
+        if not finished or error:
+            return False, ttft or 0.0, error or "truncated stream"
+        return True, ttft, ""
+    except OSError as err:
+        return False, 0.0, str(err)
+    finally:
+        conn.close()
+
+
+def run_multilora_arm(label: str, adapter_affinity: bool) -> dict:
+    """One routing arm over a fresh fleet: ML_ADAPTERS adapters sharing
+    ONE system prompt over ML_REPLICAS replicas whose hot-adapter cache
+    holds ML_CACHE_SLOTS. (prefix, adapter) affinity spreads the
+    adapters so each replica's share fits its cache; the oblivious
+    router sends everything to the prefix's one ring owner, which then
+    thrashes adapter loads forever (and serves the fleet's whole load
+    alone)."""
+    gw, servers, cfg = _ml_build_fleet(adapter_affinity)
+    try:
+        # Warm-up straight at each replica (no gateway, base model):
+        # both arms compile the same shapes regardless of routing.
+        for s in servers:
+            class _GW:  # _ml_stream wants .host/.port
+                host, port = s.host, s.port
+            ok, _, detail = _ml_stream(_GW, _ml_prompt(0, 10**6,
+                                                       cfg.vocab_size),
+                                       None)
+            if not ok:
+                raise RuntimeError(f"{label} warm-up failure: {detail}")
+        outcomes: list = []
+        sem = threading.Semaphore(ML_CONCURRENCY)
+        t0 = time.perf_counter()
+        for rnd in range(ML_ROUNDS):
+            threads = []
+            for a in range(ML_ADAPTERS):
+                prompt = _ml_prompt(a, rnd, cfg.vocab_size)
+
+                def work(p=prompt, m=f"ad{a}"):
+                    with sem:
+                        got = _ml_stream(gw, p, m)
+                        if not got[0] and "Errno" in got[2]:
+                            # Transient loopback reset under the
+                            # accept burst: one client-side retry,
+                            # like any production client.
+                            got = _ml_stream(gw, p, m)
+                        outcomes.append(got)
+
+                th = threading.Thread(target=work, daemon=True)
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join()
+        wall = time.perf_counter() - t0
+        failures = [d for ok, _, d in outcomes if not ok]
+        ttfts = [t for ok, t, _ in outcomes if ok]
+        cache = {"hits": 0, "misses": 0, "evictions": 0}
+        served_by = []  # adapter-cache touches per replica: the spread
+        for s in servers:
+            st = s.engine.lora_cache_stats()
+            for k in cache:
+                cache[k] += st[k]
+            served_by.append(st["hits"] + st["misses"])
+        total = cache["hits"] + cache["misses"]
+        return {
+            "arm": label,
+            "adapter_affinity": adapter_affinity,
+            "replicas": ML_REPLICAS,
+            "adapters": ML_ADAPTERS,
+            "cache_slots": ML_CACHE_SLOTS,
+            "rounds": ML_ROUNDS,
+            "requests_completed": len(ttfts),
+            "failures": failures,
+            "p95_ttft_ms": _p95_ms(ttfts) if ttfts else None,
+            "mean_ttft_ms": round(sum(ttfts) / len(ttfts) * 1e3, 2)
+            if ttfts else None,
+            "requests_per_sec": round(len(ttfts) / wall, 2),
+            "wall_s": round(wall, 3),
+            "lora_cache": {
+                **cache,
+                "hit_ratio": round(cache["hits"] / total, 4)
+                if total else 0.0,
+            },
+            # How many replicas actually took traffic: the spread the
+            # adapter salt buys (oblivious: 1).
+            "replicas_serving": sum(1 for n in served_by if n > 0),
+            "served_by_replica": served_by,
+        }
+    finally:
+        gw.stop()
+        for s in servers:
+            s.stop()
+
+
+def main_spec(args) -> int:
+    """--spec / --multilora: speculation + multi-LoRA serving record
+    (artifact: SERVE_r10_spec.json, sections for whichever arms ran)."""
+    global SPEC_K, SPEC_REQUESTS, SPEC_DECODE_TOKENS
+    global ML_REPLICAS, ML_ADAPTERS, ML_CACHE_SLOTS, ML_LOAD_S
+    global ML_ROUNDS, ML_CONCURRENCY
+    if args.smoke:
+        SPEC_K, SPEC_REQUESTS, SPEC_DECODE_TOKENS = 4, 2, 8
+        ML_REPLICAS, ML_ADAPTERS, ML_CACHE_SLOTS = 2, 8, 4
+        ML_LOAD_S, ML_ROUNDS, ML_CONCURRENCY = 0.01, 2, 8
+    record: dict = {
+        "model": "tiny",
+        "provenance": "smoke" if args.smoke else "live",
+        "host": _record_host(),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    summary: dict = {}
+    ok = True
+    if args.spec:
+        print(f"# spec arm: {SPEC_REQUESTS} requests x "
+              f"{SPEC_DECODE_TOKENS} tokens, k_spec={SPEC_K} ...",
+              file=sys.stderr)
+        spec = run_spec_arm()
+        record["speculative"] = spec
+        summary.update({
+            "spec_token_exact": spec["token_exact"],
+            "spec_speedup": spec["speedup"],
+            "spec_acceptance_rate": spec["acceptance_rate"],
+        })
+        ok = ok and spec["token_exact"]
+        if not args.smoke:
+            ok = ok and spec["speedup"] >= 1.5
+    if args.multilora:
+        print(f"# multilora affinity arm: {ML_ADAPTERS} adapters over "
+              f"{ML_REPLICAS} replicas x {ML_ROUNDS} rounds ...",
+              file=sys.stderr)
+        affinity = run_multilora_arm("adapter_affinity", True)
+        print("# multilora oblivious arm (fresh fleet) ...",
+              file=sys.stderr)
+        oblivious = run_multilora_arm("adapter_oblivious", False)
+        record["multilora"] = {"affinity": affinity,
+                               "oblivious": oblivious}
+        summary.update({
+            "ml_affinity_p95_ttft_ms": affinity["p95_ttft_ms"],
+            "ml_oblivious_p95_ttft_ms": oblivious["p95_ttft_ms"],
+            "ml_affinity_hit_ratio":
+                affinity["lora_cache"]["hit_ratio"],
+            "ml_oblivious_hit_ratio":
+                oblivious["lora_cache"]["hit_ratio"],
+            "ml_replicas_serving": affinity["replicas_serving"],
+        })
+        ok = ok and not affinity["failures"] and not oblivious["failures"]
+        if not args.smoke:
+            ok = (ok
+                  and affinity["p95_ttft_ms"] < oblivious["p95_ttft_ms"]
+                  and affinity["replicas_serving"] > 1)
+    print(json.dumps(summary))
+    if args.smoke:
+        print("# --smoke: artifact write and win gate skipped",
+              file=sys.stderr)
+        return 0 if ok else 1
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(tmp, args.out)
+    print(f"# wrote {args.out}", file=sys.stderr)
+    if not ok:
+        print("# r10 win gate FAILED", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
@@ -1080,6 +1425,14 @@ def main() -> int:
                     help="run the HBM-economy eviction storm: bf16 "
                          "evict+re-prefill vs int8 KV + host-RAM swap "
                          "(artifact: SERVE_r09_hbm.json)")
+    ap.add_argument("--spec", action="store_true",
+                    help="run the speculative-decoding decode bench: "
+                         "ragged spec scheduling vs plain ragged, "
+                         "token-exact (artifact: SERVE_r10_spec.json)")
+    ap.add_argument("--multilora", action="store_true",
+                    help="run the 64-adapter multi-LoRA fleet: (prefix, "
+                         "adapter) affinity vs adapter-oblivious routing "
+                         "(artifact: SERVE_r10_spec.json)")
     ap.add_argument("--smoke", action="store_true",
                     help="2 replicas x 2 tenants x 2 rounds, no artifact, "
                          "no win gate — CI executability tier")
@@ -1087,9 +1440,12 @@ def main() -> int:
     root = Path(__file__).resolve().parent.parent
     if args.out is None:
         args.out = str(root / (
-            "SERVE_r09_hbm.json" if args.evict_storm
+            "SERVE_r10_spec.json" if args.spec or args.multilora
+            else "SERVE_r09_hbm.json" if args.evict_storm
             else "SERVE_r08_disagg.json" if args.disagg
             else "SERVE_r07_fleet.json"))
+    if args.spec or args.multilora:
+        return main_spec(args)
     if args.evict_storm:
         return main_evict(args)
     if args.disagg:

@@ -140,6 +140,23 @@ class TestApi:
         with pytest.raises(ValueError, match="collides"):
             InferenceServer(mb, port=0)
 
+    def test_paged_requires_ragged(self):
+        from kubeflow_tpu.models.multilora import MultiLoraPagedBatcher
+
+        with pytest.raises(ValueError, match="ragged"):
+            MultiLoraPagedBatcher(PARAMS, CFG, STACKED, LCFG,
+                                  adapter_names=["a0", "a1"],
+                                  num_blocks=40)
+
+    def test_paged_rejects_prefix_sharing(self):
+        from kubeflow_tpu.models.multilora import MultiLoraPagedBatcher
+
+        for kw in ({"prefix_cache": True}, {"prompt_cache": True}):
+            with pytest.raises(ValueError, match="cache"):
+                MultiLoraPagedBatcher(PARAMS, CFG, STACKED, LCFG,
+                                      adapter_names=["a0", "a1"],
+                                      num_blocks=40, ragged=True, **kw)
+
     def test_http_server_routes_model_field(self):
         """The HTTP front door's "model" field selects the adapter."""
         import json
@@ -172,3 +189,108 @@ class TestApi:
             assert err.value.code == 400
         finally:
             srv.stop()
+
+
+class TestPagedRaggedParity:
+    """MultiLoraBatcher ported onto the paged/ragged engine: per-row
+    adapter deltas ride the SAME fused ragged dispatch as base rows, and
+    each row's stream must exactly match a plain ragged PagedBatcher
+    serving merge_lora(params, that row's adapter).
+
+    Adapter seeds here (1, 5) are chosen off bf16 tie edges: the
+    delta-form (x@A@B added) and merged-form (x@(W+AB)) matmuls are
+    mathematically equal but not bitwise, and an adapter whose greedy
+    path grazes a near-tie legitimately forks across the two forms (the
+    same cross-shape standard the serving suites use).
+    """
+
+    ADB = _adapter(5)
+    STACKED2 = stack_adapters([AD0, ADB], CFG, LCFG)
+
+    def _paged_ref(self, adapter, prompts):
+        from kubeflow_tpu.models.paged import PagedBatcher
+
+        params = merge_lora(PARAMS, adapter, LCFG) if adapter else PARAMS
+        pb = PagedBatcher(params, CFG, gen=GEN, slots=2, num_blocks=40,
+                          block_size=8, prompt_bucket=16,
+                          attn_kernel=False, ragged=True, token_budget=16)
+        rids = [pb.submit(p) for p in prompts]
+        out = pb.run()
+        return [out[r] for r in rids]
+
+    def _paged_multilora(self, tags, prompts, **kw):
+        from kubeflow_tpu.models.multilora import MultiLoraPagedBatcher
+
+        mb = MultiLoraPagedBatcher(
+            PARAMS, CFG, self.STACKED2, LCFG, adapter_names=["a0", "ab"],
+            gen=GEN, slots=2, num_blocks=40, block_size=8,
+            prompt_bucket=16, attn_kernel=False, ragged=True,
+            token_budget=16, **kw,
+        )
+        rids = [mb.submit(p, adapter=t) for p, t in zip(prompts, tags)]
+        out = mb.run()
+        return [out[r] for r in rids], mb
+
+    def test_mixed_batch_each_row_its_own_adapter(self):
+        """The decisive case: rows with DIFFERENT adapters (and a base
+        row) share one fused ragged dispatch, and slot reuse hands a
+        freed slot to a different adapter than its previous occupant."""
+        got, _ = self._paged_multilora(["a0", "ab", None], PROMPTS)
+        want = [
+            self._paged_ref(AD0, [PROMPTS[0]])[0],
+            self._paged_ref(self.ADB, [PROMPTS[1]])[0],
+            self._paged_ref(None, [PROMPTS[2]])[0],
+        ]
+        assert got == want
+
+    def test_adapters_actually_differ(self):
+        p = [PROMPTS[0]]
+        outs = {str(self._paged_ref(ad, p)[0])
+                for ad in (AD0, self.ADB, None)}
+        assert len(outs) == 3, "adapter deltas are numerically invisible"
+
+    def test_hot_cache_counts_churn(self):
+        """lora_cache_slots=1 with two adapters in flight: the second
+        adapter's load evicts the first — counters expose the thrash the
+        gateway's (prefix, adapter) affinity exists to avoid."""
+        got, mb = self._paged_multilora(["a0", "ab", "a0"], PROMPTS,
+                                        lora_cache_slots=1)
+        st = mb.lora_cache_stats()
+        assert st["slots"] == 1 and st["resident"] == 1
+        assert st["misses"] >= 2 and st["evictions"] >= 1
+        # Uncapped residency reports no cache at all.
+        _, mb2 = self._paged_multilora(["a0"], [PROMPTS[0]])
+        assert mb2.lora_cache_stats() is None
+
+    def test_http_stats_surface_lora_cache(self):
+        """/stats grows a ``lora_cache`` block the gateway scrape and
+        fleet telemetry key on."""
+        import json
+        import urllib.request
+
+        from kubeflow_tpu.models.multilora import MultiLoraPagedBatcher
+        from kubeflow_tpu.models.server import InferenceServer
+
+        mb = MultiLoraPagedBatcher(
+            PARAMS, CFG, self.STACKED2, LCFG, adapter_names=["a0", "ab"],
+            gen=GEN, slots=2, num_blocks=40, block_size=8,
+            prompt_bucket=16, attn_kernel=False, ragged=True,
+            token_budget=16, lora_cache_slots=2,
+        )
+        srv = InferenceServer(mb, port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions",
+                data=json.dumps({"prompt": PROMPTS[0],
+                                 "model": "a0"}).encode(),
+            )
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                assert json.loads(resp.read())["choices"][0]["tokens"]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/stats", timeout=30
+            ) as resp:
+                stats = json.loads(resp.read())
+        finally:
+            srv.stop()
+        assert stats["lora_cache"]["slots"] == 2
+        assert stats["lora_cache"]["misses"] >= 1

@@ -1,0 +1,404 @@
+"""Speculation as a ragged scheduling mode + multi-LoRA on the paged
+engine (models/speculative.py ragged path, models/multilora.py
+MultiLoraPagedBatcher, gateway adapter affinity).
+
+The contracts under test:
+- a ragged speculative run emits EXACTLY the tokens the plain ragged
+  scheduler emits (the spec engine is a throughput change, never a
+  semantics change) — over bf16 AND int8 pools;
+- a rejected suffix's KV rollback leaves every pool cell outside the
+  committed prefix byte-identical to its pre-round contents;
+- adapter-salted chain keys never collide across adapters and stay in
+  byte parity with the gateway's ``chain_key``;
+- (prefix, adapter) affinity routing keeps each replica's working set
+  of hot adapters smaller than adapter-oblivious routing does.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama as L
+from kubeflow_tpu.models.paged import PagedBatcher
+from kubeflow_tpu.models.serving import GenerationConfig
+from kubeflow_tpu.models.speculative import (
+    SpeculativePagedBatcher,
+    truncated_draft,
+)
+
+
+@pytest.fixture(scope="module")
+def target():
+    cfg = L.LLAMA_CONFIGS["tiny"]
+    return cfg, L.init_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def draft(target):
+    # A truncated-layer draft: wrong often enough to exercise rejection
+    # and rollback on every run (the smoke acceptance rate is ~5%).
+    cfg, params = target
+    dparams, dcfg = truncated_draft(params, cfg, 1)
+    return dcfg, dparams
+
+
+PROMPTS = [[5, 9, 17, 33], [7, 3, 11], [8, 44, 91, 7, 2]]
+
+
+def _run(batcher, prompts):
+    rids = [batcher.submit(p) for p in prompts]
+    out = batcher.run()
+    return [out[r] for r in rids]
+
+
+def _plain(target, kv_bits=0, token_budget=16, max_new=6):
+    cfg, params = target
+    gen = GenerationConfig(max_new_tokens=max_new, eos_id=-1)
+    return PagedBatcher(
+        params, cfg, gen=gen, slots=2, num_blocks=40, block_size=8,
+        prompt_bucket=16, attn_kernel=False, ragged=True,
+        token_budget=token_budget, kv_bits=kv_bits,
+    )
+
+
+def _spec(target, draft, kv_bits=0, k_spec=3, token_budget=16,
+          max_new=6, **kw):
+    cfg, params = target
+    dcfg, dparams = draft
+    gen = GenerationConfig(max_new_tokens=max_new, eos_id=-1)
+    return SpeculativePagedBatcher(
+        params, cfg, dparams, dcfg, gen=gen, slots=2, num_blocks=40,
+        block_size=8, prompt_bucket=16, k_spec=k_spec, kv_bits=kv_bits,
+        ragged=True, token_budget=token_budget, **kw,
+    )
+
+
+class TestRaggedSpecExactness:
+    def test_token_parity_with_plain_ragged(self, target, draft):
+        """THE invariant: verify spans inside the fused dispatch must
+        not move any request off the plain ragged scheduler's stream —
+        with a foreign draft, so rejection + rollback fire for real."""
+        want = _run(_plain(target), PROMPTS)
+        sb = _spec(target, draft)
+        got = _run(sb, PROMPTS)
+        assert got == want
+        assert 0.0 <= sb.acceptance_rate <= 1.0
+        assert sb.rounds > 0
+        # Every block returned to the pool after the run (block 0 null).
+        assert sb.free_blocks == 39
+
+    def test_token_parity_over_int8_pool(self, target, draft):
+        import jax.numpy as jnp
+
+        want = _run(_plain(target, kv_bits=8), PROMPTS[:2])
+        sb = _spec(target, draft, kv_bits=8)
+        assert sb._pb.pool["k"].dtype == jnp.int8
+        got = _run(sb, PROMPTS[:2])
+        assert got == want
+
+    def test_self_draft_accepts_everything(self, target):
+        want = _run(_plain(target), PROMPTS[:2])
+        sb = _spec(target, (target[0], target[1]))
+        got = _run(sb, PROMPTS[:2])
+        assert got == want
+        assert sb.acceptance_rate == 1.0
+
+    @pytest.mark.slow
+    def test_adaptive_draft_len_stays_exact(self, target, draft):
+        """Acceptance-adaptive span lengths re-shape every round; the
+        stream must still be the plain scheduler's, and the draft length
+        must stay inside [1, k_spec]."""
+        want = _run(_plain(target, token_budget=20, max_new=8), PROMPTS)
+        sb = _spec(target, draft, k_spec=4, token_budget=20, max_new=8,
+                   adaptive=True)
+        got = _run(sb, PROMPTS)
+        assert got == want
+        assert 1 <= sb.k_cur <= 4
+        # A mostly-wrong draft must have decayed the span length.
+        assert sb.k_cur < 4
+
+    def test_adaptive_requires_ragged(self, target, draft):
+        cfg, params = target
+        dcfg, dparams = draft
+        with pytest.raises(ValueError, match="adaptive"):
+            SpeculativePagedBatcher(
+                params, cfg, dparams, dcfg, num_blocks=40,
+                adaptive=True,
+            )
+
+    def test_budget_must_hold_a_full_house_round(self, target, draft):
+        cfg, params = target
+        dcfg, dparams = draft
+        with pytest.raises(ValueError, match="token_budget"):
+            SpeculativePagedBatcher(
+                params, cfg, dparams, dcfg, slots=4, k_spec=3,
+                num_blocks=40, ragged=True, token_budget=15,  # < 4*(3+1)
+            )
+
+
+class TestRollback:
+    def test_rejected_suffix_restores_pool_bytes(self, target, draft):
+        """One speculative round against a mostly-wrong draft: after the
+        round, every pool cell OUTSIDE the slot's committed prefix must
+        be byte-identical to its pre-round contents — the rejected
+        suffix's writes are invisible, as if speculation never ran."""
+        sb = _spec(target, draft, max_new=8)
+        pb = sb._pb
+        sb.submit(PROMPTS[0])
+        pb._admit_free_slots()
+        while all(r is None for r in pb._by_slot):
+            pb._step()  # drive admission chunks to completion
+        slot, req = next((i, r) for i, r in enumerate(pb._by_slot)
+                         if r is not None)
+        before = {k: np.asarray(v) for k, v in pb.pool.items()}
+        pos0 = int(pb.positions[slot])
+        pb._step()  # one speculative round (verify + rollback)
+        pos1 = int(pb.positions[slot])
+        assert pos1 > pos0  # at least the verify token committed
+        committed = {
+            (req.blocks[p // pb.block_size], p % pb.block_size)
+            for p in range(pos0, pos1)
+        }
+        # Block 0 is the engine's null sink: padding rows of the pow-2
+        # dispatch width write there and nothing ever reads it back.
+        committed |= {(0, o) for o in range(pb.block_size)}
+        for name, leaf in pb.pool.items():
+            diff = np.asarray(leaf) != before[name]
+            # (L, NB, Hkv, BS, D)-shaped values and (L, NB, Hkv, BS)
+            # scales both reduce to a per-(block, offset) changed mask.
+            axes = tuple(i for i in range(diff.ndim) if i not in (1, 3))
+            changed = np.argwhere(diff.any(axis=axes))
+            got = {(int(b), int(o)) for b, o in changed}
+            assert got <= committed, (
+                f"pool leaf {name!r}: rollback left bytes changed "
+                f"outside the committed prefix: {got - committed}"
+            )
+
+    def test_run_with_rejections_returns_all_blocks(self, target, draft):
+        sb = _spec(target, draft, max_new=10)
+        _run(sb, PROMPTS)
+        assert sb.acceptance_rate < 1.0  # rejections actually happened
+        assert sb.free_blocks == 39
+
+
+class TestGreedyGuard:
+    @pytest.mark.parametrize("temperature", [0.0, None])
+    def test_both_greedy_spellings_accepted(self, target, draft,
+                                            temperature):
+        cfg, params = target
+        dcfg, dparams = draft
+        gen = GenerationConfig(max_new_tokens=4, eos_id=-1,
+                               temperature=temperature)
+        SpeculativePagedBatcher(params, cfg, dparams, dcfg, gen=gen,
+                                num_blocks=40)
+
+    def test_sampling_still_rejected(self, target, draft):
+        cfg, params = target
+        dcfg, dparams = draft
+        gen = GenerationConfig(max_new_tokens=4, temperature=0.8)
+        with pytest.raises(ValueError, match="greedy-only"):
+            SpeculativePagedBatcher(params, cfg, dparams, dcfg, gen=gen,
+                                    num_blocks=40)
+
+
+class TestSpecStatsSurface:
+    def test_stats_block_flows_to_http(self, target, draft):
+        """/stats grows a ``speculative`` block (rounds, accepted,
+        acceptance_rate, draft_len) that the gateway scrape and the
+        fleet telemetry counters key on."""
+        import json
+        import urllib.request
+
+        from kubeflow_tpu.models.server import InferenceServer
+
+        sb = _spec(target, draft, max_new=4)
+        srv = InferenceServer(sb, port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions",
+                data=json.dumps({"prompt": PROMPTS[0]}).encode(),
+            )
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                assert json.loads(resp.read())["choices"][0]["tokens"]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/stats", timeout=30
+            ) as resp:
+                stats = json.loads(resp.read())
+        finally:
+            srv.stop()
+        spec = stats["speculative"]
+        assert spec["rounds"] > 0
+        assert spec["proposed"] > 0
+        assert 0.0 <= spec["acceptance_rate"] <= 1.0
+        assert spec["draft_len"] == 3
+
+
+class TestEnvParsers:
+    def test_spec_from_env(self, monkeypatch):
+        from kubeflow_tpu.models.server import spec_from_env
+        from kubeflow_tpu.webhook.tpu_env import (
+            KUBEFLOW_TPU_SPEC_ADAPTIVE,
+            KUBEFLOW_TPU_SPEC_DRAFT_LEN,
+        )
+
+        monkeypatch.delenv(KUBEFLOW_TPU_SPEC_DRAFT_LEN, raising=False)
+        monkeypatch.delenv(KUBEFLOW_TPU_SPEC_ADAPTIVE, raising=False)
+        assert spec_from_env() == (0, False)
+        monkeypatch.setenv(KUBEFLOW_TPU_SPEC_DRAFT_LEN, "4")
+        monkeypatch.setenv(KUBEFLOW_TPU_SPEC_ADAPTIVE, "true")
+        assert spec_from_env() == (4, True)
+        for bad in ("-1", "four", "3.5"):
+            monkeypatch.setenv(KUBEFLOW_TPU_SPEC_DRAFT_LEN, bad)
+            with pytest.raises(ValueError, match="SPEC_DRAFT_LEN"):
+                spec_from_env()
+        monkeypatch.setenv(KUBEFLOW_TPU_SPEC_DRAFT_LEN, "4")
+        monkeypatch.setenv(KUBEFLOW_TPU_SPEC_ADAPTIVE, "maybe")
+        with pytest.raises(ValueError, match="SPEC_ADAPTIVE"):
+            spec_from_env()
+        # Adaptive without a draft length has no range to adapt over.
+        monkeypatch.delenv(KUBEFLOW_TPU_SPEC_DRAFT_LEN)
+        monkeypatch.setenv(KUBEFLOW_TPU_SPEC_ADAPTIVE, "1")
+        with pytest.raises(ValueError, match="SPEC_ADAPTIVE"):
+            spec_from_env()
+
+    def test_lora_cache_from_env(self, monkeypatch):
+        from kubeflow_tpu.models.server import lora_cache_from_env
+        from kubeflow_tpu.webhook.tpu_env import (
+            KUBEFLOW_TPU_LORA_CACHE_SLOTS,
+        )
+
+        monkeypatch.delenv(KUBEFLOW_TPU_LORA_CACHE_SLOTS, raising=False)
+        assert lora_cache_from_env() == 0
+        monkeypatch.setenv(KUBEFLOW_TPU_LORA_CACHE_SLOTS, "16")
+        assert lora_cache_from_env() == 16
+        for bad in ("-2", "many", "1.5"):
+            monkeypatch.setenv(KUBEFLOW_TPU_LORA_CACHE_SLOTS, bad)
+            with pytest.raises(ValueError, match="LORA_CACHE_SLOTS"):
+                lora_cache_from_env()
+
+
+class TestAdapterChainKeys:
+    def test_adapter_keys_never_cross_hit(self):
+        toks = [1, 2, 3, 4]
+        keys = {
+            PagedBatcher._chain_key(None, toks),
+            PagedBatcher._chain_key(None, toks, adapter=0),
+            PagedBatcher._chain_key(None, toks, adapter=1),
+        }
+        assert len(keys) == 3
+        # The salt lives in the ROOT: children of different adapters'
+        # roots stay disjoint for identical token suffixes too.
+        children = {
+            PagedBatcher._chain_key(k, [5, 6, 7, 8]) for k in keys
+        }
+        assert len(children) == 3
+
+    def test_base_model_key_is_legacy_key(self):
+        """adapter=None must hash exactly like the pre-adapter engine:
+        existing caches and gateway rings stay valid byte for byte."""
+        toks = [9, 8, 7, 6]
+        assert PagedBatcher._chain_key(None, toks) == \
+            PagedBatcher._chain_key(None, toks, adapter=None)
+
+    def test_gateway_parity_including_salt(self):
+        from kubeflow_tpu.models.gateway import chain_key
+
+        toks = [1, 2, 3, 4]
+        for adapter in (None, 0, 7):
+            k_engine = PagedBatcher._chain_key(None, toks,
+                                               adapter=adapter)
+            assert chain_key(None, toks, adapter=adapter) == k_engine
+            assert chain_key(k_engine, [5, 6]) == \
+                PagedBatcher._chain_key(k_engine, [5, 6])
+
+
+class TestAdapterHotCache:
+    def test_lru_and_eviction_counters(self):
+        from kubeflow_tpu.models.multilora import _AdapterHotCache
+
+        c = _AdapterHotCache(2)
+        c.touch(0)
+        c.touch(1)
+        assert c.stats() == {"slots": 2, "resident": 2, "hits": 0,
+                             "misses": 2, "evictions": 0}
+        c.touch(0)  # hit → 0 becomes MRU
+        c.touch(2)  # full → evicts 1 (the LRU), not 0
+        c.touch(0)  # still resident
+        st = c.stats()
+        assert st["hits"] == 2 and st["misses"] == 3
+        assert st["evictions"] == 1 and st["resident"] == 2
+        c.touch(1)  # re-load of the evicted adapter is a miss
+        assert c.stats()["misses"] == 4
+
+    def test_rejects_zero_slots(self):
+        from kubeflow_tpu.models.multilora import _AdapterHotCache
+
+        with pytest.raises(ValueError, match="slots"):
+            _AdapterHotCache(0)
+
+
+class TestGatewayAdapterAffinity:
+    def _gateway(self, adapter_affinity):
+        from kubeflow_tpu.models.gateway import ServingGateway
+
+        # Routing policy is pure ring arithmetic — no .start() needed.
+        return ServingGateway(
+            [f"10.0.0.{i}:80" for i in range(4)], port=0,
+            affinity="prefix", block_size=4,
+            adapter_affinity=adapter_affinity,
+        )
+
+    @staticmethod
+    def _misses(gw, adapters=16, cache_slots=8, rounds=4):
+        """Simulate each replica's bounded hot-adapter cache over the
+        gateway's routing decisions: 16 adapters sharing ONE system
+        prompt, replicas holding 8 — the aggregate miss count is the
+        adapter-thrash the routing policy does (or doesn't) avoid."""
+        from collections import OrderedDict
+
+        prompt = list(range(12))  # the shared 3-block system prefix
+        caches: dict = {}
+        misses = 0
+        for _ in range(rounds):
+            for a in range(adapters):
+                gw._route_key(prompt, adapter=a)  # converge registry
+                key = gw._route_key(prompt, adapter=a)
+                ep = gw._ring.lookup(key)
+                lru = caches.setdefault(ep, OrderedDict())
+                if a in lru:
+                    lru.move_to_end(a)
+                else:
+                    misses += 1
+                    lru[a] = None
+                    if len(lru) > cache_slots:
+                        lru.popitem(last=False)
+        return misses, caches
+
+    def test_affinity_beats_adapter_oblivious_routing(self):
+        """Oblivious routing sends every adapter of a shared prefix to
+        ONE replica (16 adapters thrash its 8-slot cache forever);
+        folding the adapter into the route key spreads them so each
+        replica's share fits — misses collapse to the cold loads."""
+        aff_misses, aff_caches = self._misses(self._gateway(True))
+        obl_misses, obl_caches = self._misses(self._gateway(False))
+        assert len(obl_caches) == 1  # the pathology being fixed
+        assert len(aff_caches) > 1
+        assert aff_misses < obl_misses
+        # Steady state: an oblivious replica churns every round, while
+        # affinity's per-replica working sets stop missing after warmup
+        # unless the ring hashes >8 adapters onto one replica.
+        assert obl_misses == 16 * 4
+
+    def test_adapter_salt_only_applies_when_enabled(self):
+        prompt = list(range(12))
+        gw = self._gateway(True)
+        gw._route_key(prompt)  # warm the prefix registry (converges)
+        keys = {gw._route_key(prompt, adapter=a) for a in (None, 0, 1)}
+        assert len(keys) == 3  # distinct routes per adapter
+        gw_off = self._gateway(False)
+        gw_off._route_key(prompt)
+        keys_off = {gw_off._route_key(prompt, adapter=a)
+                    for a in (None, 0, 1)}
+        assert len(keys_off) == 1  # oblivious: adapter never routes
