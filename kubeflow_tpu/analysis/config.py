@@ -87,15 +87,18 @@ PROM_CONSTRUCTORS = {"Counter", "Gauge", "Histogram", "Summary"}
 
 # -- metric/stats parity (rule metric-stats-parity) --------------------------
 
-# Serving, engine, and gateway metric families must stay visible in the
-# servers' JSON /stats payload; the STATS_PARITY table in
+# Serving, engine, gateway, and autoscaler metric families must stay
+# visible in the servers' JSON /stats payload; the STATS_PARITY table in
 # metrics/metrics.py maps each family to the /stats key that surfaces it
-# (gateway families surface under the gateway's own /stats).
-STATS_PARITY_FAMILY_RE = re.compile(r"^tpu_(serving|engine|gateway)_[a-z0-9_]+$")
+# (gateway/autoscaler families surface under the gateway's own /stats).
+STATS_PARITY_FAMILY_RE = re.compile(
+    r"^tpu_(serving|engine|gateway|autoscaler)_[a-z0-9_]+$"
+)
 
 # Where /stats payloads are assembled: every STATS_PARITY value must
 # appear as a string literal in one of these modules.
 STATS_SURFACE_MODULES = (
     "kubeflow_tpu/models/server.py",
     "kubeflow_tpu/models/gateway.py",
+    "kubeflow_tpu/models/autoscaler.py",
 )

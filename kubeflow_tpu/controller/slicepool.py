@@ -123,6 +123,7 @@ def claim_warm_slice(
     notebook: Optional[dict] = None,
     now: Optional[float] = None,
     pools: Optional[list] = None,
+    deadline: Optional[float] = None,
 ) -> Optional[str]:
     """Claim one warm placeholder matching (accelerator, topology).
 
@@ -131,6 +132,11 @@ def claim_warm_slice(
     falls back to a still-warming one — even a partially-provisioned
     placeholder beats a cold node-pool scale-up. Deleting the StatefulSet
     cascades to its pods, releasing chips for the notebook's pods.
+
+    ``deadline`` (a ``time.perf_counter()`` instant) bounds the candidate
+    walk: a fleet-wide delete-race pileup or a crawling apiserver turns
+    into a clean miss instead of wedging the caller — the gateway's
+    autoscaler treats that miss as a claim failure and backs off.
 
     Demand signals for the autoscaler: a successful claim stamps
     LAST_CLAIM on the owning pool; a miss stamps LAST_MISS and increments
@@ -153,6 +159,8 @@ def claim_warm_slice(
     # candidate instead of going cold while warm capacity remains.
     ordered = sorted(candidates, key=lambda s: not _sts_ready(s))
     for chosen in ordered:
+        if deadline is not None and time.perf_counter() >= deadline:
+            return None  # bounded claim: a timed-out walk is a miss
         pool_name = obj_util.labels_of(chosen).get(sp.POOL_LABEL, "")
         try:
             client.delete(

@@ -78,6 +78,16 @@ INJECTION_TYPES = (
     # decode tier untouched: post-heal traffic keeps streaming through
     # the paged-KV handoff with zero transfer failures.
     "serving-kv-handoff-loss",
+    # Fleet autoscaler coverage (models/autoscaler.py): scale-down under
+    # stream churn. The autoscaler drains the least-loaded replica while
+    # slow streams are in flight across the fleet; the drained replica
+    # must leave the ring immediately (no new routes) yet keep serving
+    # its in-flight streams, the slice must be released only after those
+    # streams finish (within the drain budget), and the whole storm must
+    # end with every stream terminating in [DONE], zero error events,
+    # and zero tenants shed — killing an active stream or shedding an
+    # under-share tenant is the outcome scale-down exists to forbid.
+    "autoscaler-scaledown-storm",
 )
 STEADY_STATE_CHECKS = (
     "sliceReady", "notCulled", "notebookCreatable", "warmPoolReady",
@@ -102,6 +112,9 @@ STEADY_STATE_CHECKS = (
     # the ring, and keeps importing KV payloads after a prefill-tier
     # loss — tier failure must not cascade across the handoff boundary.
     "decodeTierHealthy",
+    # Autoscaler scale-down: every in-flight stream on a draining
+    # replica ran to [DONE] and its slice was released only afterwards.
+    "streamsDrained",
 )
 # Injection ↔ target coherence: a doc must declare the kind its handler
 # actually exercises, or a "pass" certifies a hypothesis that never ran.
@@ -123,6 +136,7 @@ TARGET_KIND_FOR_INJECTION = {
     "checkpoint-disk-full": "CheckpointManager",
     "gateway-replica-kill": "ServingGateway",
     "serving-kv-handoff-loss": "ServingGateway",
+    "autoscaler-scaledown-storm": "ServingGateway",
 }
 
 
@@ -402,6 +416,54 @@ class _CrashableReplica:
             self.crash()
 
 
+class _DrainableReplica(_CrashableReplica):
+    """A :class:`_CrashableReplica` with the PR 2 drain lifecycle the
+    autoscaler's scale-down exercises: ``drain()`` flips /healthz to
+    503 {"status": "draining"} immediately (the gateway must stop
+    routing here) while every in-flight stream runs to its natural
+    ``[DONE]``; new completions are refused like a real draining
+    InferenceServer. ``release()`` tears the listener down and records
+    how many streams it severed — a correct autoscaler releases only
+    after the drain emptied, so that count must be zero."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.draining = False
+        self.severed_at_release = -1
+        replica = self
+        inner_get = self.httpd.RequestHandlerClass.do_GET
+        inner_post = self.httpd.RequestHandlerClass.do_POST
+
+        class Handler(self.httpd.RequestHandlerClass):
+            def do_GET(self):
+                if self.path == "/healthz" and replica.draining:
+                    self._json(503, {"status": "draining"})
+                else:
+                    inner_get(self)
+
+            def do_POST(self):
+                if replica.draining:
+                    self._json(503, {"error": "draining"})
+                else:
+                    inner_post(self)
+
+        self.httpd.RequestHandlerClass = Handler
+
+    def drain(self) -> None:
+        with self.lock:
+            self.draining = True
+
+    @property
+    def drained(self) -> bool:
+        with self.lock:
+            return self.draining and self.inflight == 0
+
+    def release(self) -> None:
+        """Slice teardown; anything still on the wire here was killed
+        by a premature release."""
+        self.severed_at_release = self.crash()
+
+
 class _CrashablePrefill:
     """Minimal prefill-tier replica for the disaggregated fleet: answers
     /healthz and /stats like an InferenceServer, then dies mid-export on
@@ -602,6 +664,8 @@ class ExperimentRunner:
             "checkpoint-disk-full": self._run_checkpoint_disk_full,
             "gateway-replica-kill": self._run_gateway_replica_kill,
             "serving-kv-handoff-loss": self._run_serving_kv_handoff_loss,
+            "autoscaler-scaledown-storm":
+                self._run_autoscaler_scaledown_storm,
         }
 
     def run(self, doc: dict) -> ExperimentResult:
@@ -1833,3 +1897,177 @@ class ExperimentRunner:
             return result
         finally:
             shutil.rmtree(workdir, ignore_errors=True)
+
+    def _run_autoscaler_scaledown_storm(self, doc: dict) -> ExperimentResult:
+        """Scale-down under stream churn. Slow streams run across a
+        3-replica fleet while the autoscaler — fed real telemetry, fast
+        probe cadence — sees ebb and drains replicas toward
+        min_replicas, with a second request wave landing mid-drain. The
+        promise under test: the drained replica leaves the ring at the
+        decision instant yet its in-flight streams all run to [DONE];
+        its slice is released only once it is empty (zero connections
+        severed at release); no stream errors, nothing is shed."""
+        import http.client
+
+        from kubeflow_tpu.models.autoscaler import AutoscalerConfig
+        from kubeflow_tpu.models.gateway import ServingGateway
+        from kubeflow_tpu.observability.signals import (
+            FleetTelemetry,
+            SignalsConfig,
+        )
+
+        params = doc["spec"]["injection"].get("params", {})
+        streams = int(params.get("streams", 6))
+        churn = int(params.get("churnStreams", 4))
+        replica_count = int(params.get("replicas", 3))
+        timeout = float(doc["spec"]["recoveryTimeoutSeconds"])
+
+        replicas = [
+            _DrainableReplica(tokens=30, token_delay_s=0.05).start()
+            for _ in range(replica_count)
+        ]
+        by_ep = {r.endpoint: r for r in replicas}
+
+        class _Prov:
+            # In-process provisioner: the "slice" is the fake replica.
+            def scale_up(self, tier, now=None):
+                return None  # the storm only exercises the down path
+
+            def drain(self, ep):
+                by_ep[ep].drain()
+
+            def drained(self, ep):
+                return by_ep[ep].drained
+
+            def release(self, ep):
+                by_ep[ep].release()
+
+        telemetry = FleetTelemetry(SignalsConfig(window_s=0.5, windows=60))
+        gw = ServingGateway(
+            [r.endpoint for r in replicas], port=0, block_size=4,
+            health_interval_s=0.05, reroute_budget=2,
+            telemetry=telemetry,
+            autoscaler_config=AutoscalerConfig(
+                min_replicas=1, max_replicas=replica_count,
+                down_consecutive=2, down_cooldown_s=0.2,
+                up_cooldown_s=0.2, max_actions_per_window=8,
+                actions_window_s=30.0, drain_budget_s=timeout,
+                stale_after_s=5.0,
+            ),
+            autoscaler_provisioner=_Prov(),
+        ).start()
+        collected: list = [[] for _ in range(streams + churn)]
+
+        def reader(i: int) -> None:
+            conn = http.client.HTTPConnection(gw.host, gw.port,
+                                              timeout=timeout)
+            try:
+                conn.request(
+                    "POST", "/v1/completions",
+                    json.dumps({"prompt": [10 * i + j for j in range(8)],
+                                "stream": True,
+                                "user": f"tenant-{i % 3}"}).encode(),
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                while True:
+                    line = resp.fp.readline()
+                    if not line:
+                        break
+                    if line.startswith(b"data:"):
+                        collected[i].append(line)
+                    if line == b"data: [DONE]\n":
+                        break
+            finally:
+                conn.close()
+
+        try:
+            threads = [
+                threading.Thread(target=reader, args=(i,), daemon=True)
+                for i in range(streams)
+            ]
+            for t in threads:
+                t.start()
+            # Every first-wave stream is mid-flight before any drain.
+            deadline = time.monotonic() + timeout
+            while (any(not lines for lines in collected[:streams])
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            # Ebb under churn: wait for the first scale-down, then land
+            # a second wave while the victim is still draining.
+            scale_downs = 0
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                scale_downs = gw.stats()["autoscaler"]["scale_downs"]
+                if scale_downs:
+                    break
+                time.sleep(0.02)
+            churn_threads = [
+                threading.Thread(target=reader, args=(streams + i,),
+                                 daemon=True)
+                for i in range(churn)
+            ]
+            for t in churn_threads:
+                t.start()
+            for t in threads + churn_threads:
+                t.join(timeout=timeout)
+            # Drains settle: every initiated drain released its slice.
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                scaler = gw.stats()["autoscaler"]
+                if not scaler["draining"]:
+                    break
+                time.sleep(0.02)
+            scaler = gw.stats()["autoscaler"]
+            stats = gw.stats()
+            decisions = gw.autoscaler.debug()["decisions"]
+            releases = [d for d in decisions if d["action"] == "release"]
+            released = [r for r in replicas if r.severed_at_release >= 0]
+            terminated = sum(
+                lines and lines[-1] == b"data: [DONE]\n"
+                for lines in collected
+            )
+            errored = sum(
+                any(b'"error"' in ln for ln in lines)
+                for lines in collected
+            )
+            severed = sum(r.severed_at_release for r in released)
+            budget_blown = sum(
+                "exceeded" in "; ".join(d["reasons"]) for d in releases
+            )
+            passed = (
+                scaler["scale_downs"] >= 1
+                and len(releases) == len(released) >= 1
+                and severed == 0
+                and budget_blown == 0
+                and terminated == streams + churn
+                and errored == 0
+                and stats["shed"] == 0
+                and stats["failed"] == 0
+                and all(r.endpoint not in gw.replica_endpoints()
+                        for r in released)
+            )
+            return ExperimentResult(
+                doc["metadata"]["name"],
+                passed=passed,
+                detail="" if passed else (
+                    f"scale_downs={scaler['scale_downs']} "
+                    f"releases={len(releases)}/{len(released)} "
+                    f"severed_at_release={severed} "
+                    f"budget_blown={budget_blown} "
+                    f"terminated={terminated}/{streams + churn} "
+                    f"errored={errored} shed={stats['shed']} "
+                    f"failed={stats['failed']}"
+                ),
+                observations={
+                    "scale_downs": scaler["scale_downs"],
+                    "releases": len(releases),
+                    "severed_at_release": severed,
+                    "terminated_streams": terminated,
+                    "shed": stats["shed"],
+                },
+            )
+        finally:
+            gw.stop()
+            for r in replicas:
+                r.stop()

@@ -60,6 +60,14 @@ STATS_PARITY = {
     "tpu_serving_lora_cache_hits_total": "hits",
     "tpu_serving_lora_cache_misses_total": "misses",
     "tpu_serving_lora_cache_evictions_total": "evictions",
+    "tpu_autoscaler_scale_up_total": "scale_ups",
+    "tpu_autoscaler_scale_down_total": "scale_downs",
+    "tpu_autoscaler_hold_total": "holds",
+    "tpu_autoscaler_freeze_total": "freezes",
+    "tpu_autoscaler_claim_attempts_total": "claim_attempts",
+    "tpu_autoscaler_claim_failures_total": "claim_failures",
+    "tpu_autoscaler_claim_latency_seconds": "claim_latency_s",
+    "tpu_autoscaler_replicas": "tier_replicas",
 }
 
 
@@ -352,6 +360,54 @@ class Metrics:
         self.serving_lora_cache_evictions_total = Counter(
             "tpu_serving_lora_cache_evictions_total",
             "Adapters evicted from the bounded hot-adapter cache (LRU)",
+            registry=self.registry,
+        )
+        # -- fleet autoscaler (models/autoscaler.py) -----------------------
+        self.autoscaler_scale_up_total = Counter(
+            "tpu_autoscaler_scale_up_total",
+            "Warm-slice claims the autoscaler made on sustained "
+            "up-pressure (successful scale-up actions)",
+            registry=self.registry,
+        )
+        self.autoscaler_scale_down_total = Counter(
+            "tpu_autoscaler_scale_down_total",
+            "Drain-then-release scale-downs the autoscaler initiated on "
+            "sustained ebb",
+            registry=self.registry,
+        )
+        self.autoscaler_hold_total = Counter(
+            "tpu_autoscaler_hold_total",
+            "Desired scale actions suppressed by a guard (cooldown, "
+            "rate limit, min/max bound, headroom, claim backoff)",
+            registry=self.registry,
+        )
+        self.autoscaler_freeze_total = Counter(
+            "tpu_autoscaler_freeze_total",
+            "Freeze episodes: scaling halted on missing or stale "
+            "telemetry instead of acting on garbage",
+            registry=self.registry,
+        )
+        self.autoscaler_claim_attempts_total = Counter(
+            "tpu_autoscaler_claim_attempts_total",
+            "Warm-slice claim attempts issued by the autoscaler",
+            registry=self.registry,
+        )
+        self.autoscaler_claim_failures_total = Counter(
+            "tpu_autoscaler_claim_failures_total",
+            "Claim attempts that returned nothing (warm pool empty or "
+            "claim error) — each starts a jittered backoff",
+            registry=self.registry,
+        )
+        self.autoscaler_claim_latency_seconds = Gauge(
+            "tpu_autoscaler_claim_latency_seconds",
+            "Wall-clock latency of the most recent warm-slice claim",
+            registry=self.registry,
+        )
+        self.autoscaler_replicas = Gauge(
+            "tpu_autoscaler_replicas",
+            "In-ring replicas per serving tier as the autoscaler last "
+            "counted them",
+            ["tier"],
             registry=self.registry,
         )
         # -- SLO burn-rate engine (observability/slo.py) -------------------

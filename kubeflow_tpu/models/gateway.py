@@ -58,6 +58,10 @@ from typing import Optional
 
 import numpy as np
 
+from kubeflow_tpu.models.autoscaler import (
+    FleetAutoscaler,
+    autoscaler_from_env,
+)
 from kubeflow_tpu.models.server import BodyTooLarge, _client_gone, _read_body
 from kubeflow_tpu.observability import tracing
 from kubeflow_tpu.observability.signals import FleetTelemetry, TenantBuckets
@@ -236,13 +240,18 @@ def _parse_endpoint(endpoint: str) -> tuple:
 
 class _Replica:
     __slots__ = ("endpoint", "host", "port", "healthy", "draining", "stats",
-                 "role")
+                 "role", "drain_pinned")
 
     def __init__(self, endpoint: str, role: str = "fused"):
         self.endpoint = endpoint
         self.host, self.port = _parse_endpoint(endpoint)
         self.healthy = True   # optimistic: routable until a probe says no
         self.draining = False
+        # Gateway-side drain pin (autoscaler scale-down): the replica is
+        # held out of the ring even while its healthz still says ok —
+        # its own drain flips that shortly, but new streams must stop
+        # routing here the moment the decision lands, not a probe later.
+        self.drain_pinned = False
         self.stats: Optional[dict] = None  # last /stats scrape (subset)
         # Disaggregated tier membership: "fused" (default), "prefill", or
         # "decode" — from gateway config (tier lists) or the replica's
@@ -279,7 +288,9 @@ class ServingGateway:
                  tier_roles: Optional[dict] = None,
                  kv_transfer_timeout_s: float = 30.0,
                  kv_transfer_max_bytes: int = 64 << 20,
-                 adapter_affinity: bool = True):
+                 adapter_affinity: bool = True,
+                 autoscaler_config=None,
+                 autoscaler_provisioner=None):
         if affinity not in AFFINITY_MODES:
             raise ValueError(
                 f"affinity must be one of {AFFINITY_MODES}, got {affinity!r}"
@@ -374,6 +385,18 @@ class ServingGateway:
         )
         for ep in replicas:
             self.add_replica(ep)
+        # Fleet autoscaler (models/autoscaler.py): same inert-by-default
+        # stance as the telemetry plane — None unless a config is passed
+        # or KUBEFLOW_TPU_AUTOSCALE_ENABLE opts in. Ticks ride probe
+        # passes, so a disabled autoscaler costs literally nothing.
+        scale_cfg = (autoscaler_config if autoscaler_config is not None
+                     else autoscaler_from_env())
+        self.autoscaler = (
+            FleetAutoscaler(self, scale_cfg,
+                            provisioner=autoscaler_provisioner,
+                            metrics=metrics)
+            if scale_cfg is not None else None
+        )
 
     # -- fleet membership --------------------------------------------------
 
@@ -394,6 +417,29 @@ class ServingGateway:
             self._replicas.pop(endpoint, None)
             self._ring.remove(endpoint)
             self._mirror_ring_locked()
+        if self.telemetry is not None:
+            # Drop the rebase state and scrape timestamp: a departed
+            # replica's growing scrape age must not freeze the
+            # autoscaler, and a re-add restarts its counter base.
+            self.telemetry.forget_replica(endpoint)
+
+    def begin_drain(self, endpoint: str) -> bool:
+        """Autoscaler scale-down entry: pull the replica from the ring
+        NOW and pin it out (in-flight streams keep flowing straight to
+        it; new requests route elsewhere, before any probe runs). The
+        pin survives probe passes until ``remove_replica``. Returns
+        False for endpoints this gateway does not know."""
+        with self._lock:
+            rep = self._replicas.get(endpoint)
+            if rep is None:
+                return False
+            rep.drain_pinned = True
+            rep.draining = True
+            rep.healthy = False
+            if endpoint in self._ring.nodes():
+                self._ring.remove(endpoint)
+            self._mirror_ring_locked()
+        return True
 
     def replica_endpoints(self) -> list:
         with self._lock:
@@ -454,8 +500,8 @@ class ServingGateway:
             with self._lock:
                 if rep.endpoint not in self._replicas:
                     continue  # removed while we probed
-                rep.healthy = state == "ok"
-                rep.draining = state == "draining"
+                rep.healthy = state == "ok" and not rep.drain_pinned
+                rep.draining = state == "draining" or rep.drain_pinned
                 in_ring = rep.endpoint in self._ring.nodes()
                 if rep.healthy and not in_ring:
                     self._ring.add(rep.endpoint)
@@ -463,14 +509,21 @@ class ServingGateway:
                     self._ring.remove(rep.endpoint)
                 self._mirror_ring_locked()
             if rep.healthy:
-                rep.stats = self._scrape_stats(rep)
+                scraped = self._scrape_stats(rep)
+                # _scrape_stats hands back the SAME object on a failed
+                # scrape: only a genuinely fresh payload may feed the
+                # telemetry plane, or a replica whose /stats endpoint
+                # wedged would keep refreshing its scrape age and mask
+                # the staleness the autoscaler freeze exists to catch.
+                fresh = scraped is not rep.stats
+                rep.stats = scraped
                 if rep.endpoint not in self._tier_roles:
                     # Tier membership follows the replica's own /stats
                     # advertisement unless the gateway's config pinned it.
                     role = (rep.stats or {}).get("tier_role")
                     if role in ("fused", "prefill", "decode"):
                         rep.role = role
-                if self.telemetry is not None:
+                if self.telemetry is not None and fresh:
                     self.telemetry.ingest_replica(rep.endpoint, rep.stats)
         if self.telemetry is not None:
             with self._lock:
@@ -480,6 +533,10 @@ class ServingGateway:
             # signal rings, and the latch/metric/span emission lives in
             # the engine, not here.
             self.telemetry.evaluate_slo()
+        if self.autoscaler is not None:
+            # The control loop rides the same cadence, AFTER the scrape/
+            # SLO pass so each tick sees this pass's fresh signals.
+            self.autoscaler.tick()
 
     def _probe(self, rep: _Replica) -> str:
         try:
@@ -677,6 +734,7 @@ class ServingGateway:
                     "in_ring": ep in self._ring.nodes(),
                     "healthy": rep.healthy,
                     "draining": rep.draining,
+                    "role": rep.role,
                     **({"stats": rep.stats} if rep.stats else {}),
                 }
                 for ep, rep in sorted(self._replicas.items())
@@ -686,7 +744,7 @@ class ServingGateway:
                 pc = (rep.stats or {}).get("prefix_cache") or {}
                 hits += pc.get("hits", 0)
                 misses += pc.get("misses", 0)
-            return {
+            out = {
                 "affinity": self.affinity,
                 "tier_mode": self.tier_mode,
                 "ring_size": len(self._ring),
@@ -710,6 +768,15 @@ class ServingGateway:
                     if hits + misses else 0.0,
                 },
             }
+        # Assembled OUTSIDE self._lock: the autoscaler's stats() takes
+        # its own lock, and its tick thread nests the locks the other
+        # way around (autoscaler lock → gateway.stats → self._lock).
+        if (self.replica_source is not None
+                and hasattr(self.replica_source, "stats")):
+            out["warm_claims"] = self.replica_source.stats()
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.stats()
+        return out
 
     # -- HTTP --------------------------------------------------------------
 
@@ -770,6 +837,10 @@ class ServingGateway:
                     else:
                         self._json(200, {"enabled": True,
                                          **tel.evaluate_slo()})
+                elif self.path == "/debug/autoscaler":
+                    scaler = gw.autoscaler
+                    self._json(200, scaler.debug() if scaler is not None
+                               else {"enabled": False})
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -1448,25 +1519,72 @@ class WarmSliceReplicaSource:
     signal that grows the pool. The replica's lifecycle closes the loop
     the other way: draining flips its healthz, the gateway drops it from
     the ring, and the slice returns to the pool.
+
+    Hardened for autoscaler claim storms: every ``acquire`` runs under
+    a bounded wall-clock deadline (``claim_deadline_s`` — an apiserver
+    crawling through conflict retries must not wedge the control loop),
+    and attempts/failures/latency are counted for the gateway's /stats
+    ``warm_claims`` block. The conflict-prone slicepool status writes
+    themselves already go through ``retry_on_conflict``.
     """
 
     def __init__(self, client, namespace: str, topo,
-                 recorder=None, notebook=None):
+                 recorder=None, notebook=None,
+                 claim_deadline_s: float = 5.0):
+        if claim_deadline_s <= 0:
+            raise ValueError(
+                f"claim_deadline_s must be > 0, got {claim_deadline_s}"
+            )
         self.client = client
         self.namespace = namespace
         self.topo = topo
         self.recorder = recorder
         self.notebook = notebook
+        self.claim_deadline_s = claim_deadline_s
+        self._lock = threading.Lock()
+        self._attempts = 0
+        self._failures = 0
+        self._last_latency_s = 0.0
+        self._latency_total_s = 0.0
 
     def acquire(self, now: Optional[float] = None,
                 pools: Optional[list] = None) -> Optional[str]:
         from kubeflow_tpu.controller.slicepool import claim_warm_slice
 
-        return claim_warm_slice(
-            self.client, self.namespace, self.topo,
-            recorder=self.recorder, notebook=self.notebook,
-            now=now if now is not None else time.time(), pools=pools,
-        )
+        with self._lock:
+            self._attempts += 1
+        t0 = time.perf_counter()
+        try:
+            pool = claim_warm_slice(
+                self.client, self.namespace, self.topo,
+                recorder=self.recorder, notebook=self.notebook,
+                now=now if now is not None else time.time(), pools=pools,
+                deadline=t0 + self.claim_deadline_s,
+            )
+        except Exception:
+            with self._lock:
+                self._failures += 1
+                self._last_latency_s = time.perf_counter() - t0
+                self._latency_total_s += self._last_latency_s
+            raise
+        with self._lock:
+            self._last_latency_s = time.perf_counter() - t0
+            self._latency_total_s += self._last_latency_s
+            if pool is None:
+                self._failures += 1
+        return pool
+
+    def stats(self) -> dict:
+        """The gateway /stats ``warm_claims`` block (STATS_PARITY
+        surface for the tpu_autoscaler_claim_* families)."""
+        with self._lock:
+            return {
+                "claim_attempts": self._attempts,
+                "claim_failures": self._failures,
+                "claim_latency_s": round(self._last_latency_s, 6),
+                "claim_latency_total_s": round(self._latency_total_s, 6),
+                "claim_deadline_s": self.claim_deadline_s,
+            }
 
 
 def gateway_from_env(metrics=None, replica_source=None) -> ServingGateway:

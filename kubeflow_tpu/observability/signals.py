@@ -476,6 +476,11 @@ class FleetTelemetry:
         )
         self._scrape_lock = threading.Lock()
         self._replica_base: dict = {}  # endpoint -> {stat: last cumulative}
+        # endpoint -> clock() of the last *fresh* /stats ingest. The
+        # autoscaler's staleness freeze reads this: a replica whose
+        # scrape age grows past its threshold means the control loop is
+        # flying blind and must hold capacity rather than act.
+        self._replica_last_scrape: dict = {}
 
     @classmethod
     def from_env(cls, metrics=None,
@@ -588,6 +593,7 @@ class FleetTelemetry:
         _gauge("replica_kv_swap_bytes",
                (stats.get("kv_swap") or {}).get("swap_bytes"))
         with self._scrape_lock:
+            self._replica_last_scrape[endpoint] = self.clock()
             base = self._replica_base.setdefault(endpoint, {})
             for stat, signal in self._REPLICA_COUNTERS:
                 cur: object = stats
@@ -605,6 +611,38 @@ class FleetTelemetry:
                 delta = cur - prev if cur >= prev else cur
                 if delta:
                     hub.inc(signal, float(delta))
+
+    def forget_replica(self, endpoint: str) -> None:
+        """Drop the per-endpoint rebase state and scrape timestamp for a
+        replica that left the fleet — a departed (drained + released)
+        replica's growing scrape age must not freeze the autoscaler, and
+        a later re-add re-establishes its counter base from scratch."""
+        with self._scrape_lock:
+            self._replica_base.pop(endpoint, None)
+            self._replica_last_scrape.pop(endpoint, None)
+
+    def scrape_ages(self, now: Optional[float] = None) -> dict:
+        """Per-endpoint seconds since the last fresh /stats ingest."""
+        now = self.clock() if now is None else now
+        with self._scrape_lock:
+            return {
+                ep: max(0.0, now - t)
+                for ep, t in self._replica_last_scrape.items()
+            }
+
+    # -- autoscaler feed ---------------------------------------------------
+
+    _AUTOSCALE_ACTIONS = ("up", "down", "hold", "freeze")
+
+    def observe_autoscale(self, action: str) -> None:
+        """One autoscaler decision, windowed so /debug/signals shows
+        scale churn next to the load signals that caused it."""
+        if action not in self._AUTOSCALE_ACTIONS:
+            raise ValueError(
+                f"autoscale action must be one of "
+                f"{self._AUTOSCALE_ACTIONS}, got {action!r}"
+            )
+        self.hub.inc(f"autoscale_{action}")
 
     # -- outputs -----------------------------------------------------------
 
@@ -703,6 +741,18 @@ class FleetTelemetry:
                 "replica_prefix_hit_ratio": hub.gauge_children(
                     "replica_prefix_hit_ratio"
                 ),
+                # Staleness signal for the autoscaler freeze: seconds
+                # since each replica's last fresh /stats ingest.
+                "last_scrape_age_s": {
+                    ep: round(age, 3)
+                    for ep, age in sorted(self.scrape_ages(now=now).items())
+                },
+                # Autoscaler decision churn, windowed like every other
+                # fleet rate so ramps and their scale actions line up.
+                "autoscale_up_per_s": _rate("autoscale_up"),
+                "autoscale_down_per_s": _rate("autoscale_down"),
+                "autoscale_hold_per_s": _rate("autoscale_hold"),
+                "autoscale_freeze_per_s": _rate("autoscale_freeze"),
             },
             "tenants": tenants,
         }

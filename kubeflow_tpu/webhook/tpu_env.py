@@ -113,6 +113,22 @@ KUBEFLOW_TPU_SLO_SLOW_BURN = "KUBEFLOW_TPU_SLO_SLOW_BURN"
 KUBEFLOW_TPU_STALL_PROFILE_DIR = "KUBEFLOW_TPU_STALL_PROFILE_DIR"
 KUBEFLOW_TPU_STALL_PROFILE_COOLDOWN_S = "KUBEFLOW_TPU_STALL_PROFILE_COOLDOWN_S"
 KUBEFLOW_TPU_STALL_PROFILE_SECONDS = "KUBEFLOW_TPU_STALL_PROFILE_SECONDS"
+# Fleet autoscaler (models/autoscaler.py autoscaler_from_env): the
+# signals→slices control loop on the gateway; inert unless
+# AUTOSCALE_ENABLE opts in.
+KUBEFLOW_TPU_AUTOSCALE_ENABLE = "KUBEFLOW_TPU_AUTOSCALE_ENABLE"
+KUBEFLOW_TPU_AUTOSCALE_MIN_REPLICAS = "KUBEFLOW_TPU_AUTOSCALE_MIN_REPLICAS"
+KUBEFLOW_TPU_AUTOSCALE_MAX_REPLICAS = "KUBEFLOW_TPU_AUTOSCALE_MAX_REPLICAS"
+KUBEFLOW_TPU_AUTOSCALE_UP_COOLDOWN_S = "KUBEFLOW_TPU_AUTOSCALE_UP_COOLDOWN_S"
+KUBEFLOW_TPU_AUTOSCALE_DOWN_COOLDOWN_S = (
+    "KUBEFLOW_TPU_AUTOSCALE_DOWN_COOLDOWN_S"
+)
+KUBEFLOW_TPU_AUTOSCALE_MAX_ACTIONS = "KUBEFLOW_TPU_AUTOSCALE_MAX_ACTIONS"
+KUBEFLOW_TPU_AUTOSCALE_WINDOW_S = "KUBEFLOW_TPU_AUTOSCALE_WINDOW_S"
+KUBEFLOW_TPU_AUTOSCALE_DRAIN_BUDGET_S = (
+    "KUBEFLOW_TPU_AUTOSCALE_DRAIN_BUDGET_S"
+)
+KUBEFLOW_TPU_AUTOSCALE_STALE_AFTER_S = "KUBEFLOW_TPU_AUTOSCALE_STALE_AFTER_S"
 
 # name -> who produces it and from what. Annotation-projected env names are
 # defined next to their annotations in kubeflow_tpu/api/annotations.py and
@@ -255,6 +271,28 @@ ENV_CONTRACT: dict = {
     "skipped, never queued)",
     KUBEFLOW_TPU_STALL_PROFILE_SECONDS: "operator-set: duration of each "
     "stall-triggered profile capture (default 2.0)",
+    KUBEFLOW_TPU_AUTOSCALE_ENABLE: "operator-set on the gateway container: "
+    "1/true builds the FleetAutoscaler (per-tier signals→slices control "
+    "loop riding the probe cadence, /debug/autoscaler surface); unset/0 "
+    "keeps capacity operator-driven — the autoscaler is inert by default",
+    KUBEFLOW_TPU_AUTOSCALE_MIN_REPLICAS: "operator-set: scale-down floor "
+    "per tier (default 1)",
+    KUBEFLOW_TPU_AUTOSCALE_MAX_REPLICAS: "operator-set: scale-up ceiling "
+    "per tier (default 4)",
+    KUBEFLOW_TPU_AUTOSCALE_UP_COOLDOWN_S: "operator-set: seconds after a "
+    "scale-up before the same tier may scale up again (default 30)",
+    KUBEFLOW_TPU_AUTOSCALE_DOWN_COOLDOWN_S: "operator-set: seconds after a "
+    "scale-down before the same tier may scale down again (default 60)",
+    KUBEFLOW_TPU_AUTOSCALE_MAX_ACTIONS: "operator-set: fleet-wide cap on "
+    "scale actions per rate-limit window (default 4)",
+    KUBEFLOW_TPU_AUTOSCALE_WINDOW_S: "operator-set: the rate-limit window "
+    "in seconds (default 300)",
+    KUBEFLOW_TPU_AUTOSCALE_DRAIN_BUDGET_S: "operator-set: how long a "
+    "scale-down waits for the draining replica's in-flight streams before "
+    "releasing its slice anyway (default 60)",
+    KUBEFLOW_TPU_AUTOSCALE_STALE_AFTER_S: "operator-set: replica scrape "
+    "age past which the autoscaler freezes all scaling instead of acting "
+    "on stale telemetry (default 10)",
     ann.QUANT_ENV_NAME: "webhook: tpu-quantization annotation",
     ann.PROFILING_ENV_NAME: "webhook: tpu-profiling-port annotation",
     ann.SERVING_ENV_NAME: "webhook: tpu-serving-port annotation",

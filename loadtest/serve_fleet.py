@@ -191,7 +191,8 @@ def _build_replicas(n: int, warm_chain_blocks: int):
     return servers, cfg
 
 
-def _stream_once(gw, prompt, tenant: str, timeout: float = 120.0):
+def _stream_once(gw, prompt, tenant: str, timeout: float = 120.0,
+                 max_tokens: int = 0):
     """One streaming completion through the gateway. Returns
     (ok, ttft_seconds, detail)."""
     conn = http.client.HTTPConnection(gw.host, gw.port, timeout=timeout)
@@ -200,7 +201,7 @@ def _stream_once(gw, prompt, tenant: str, timeout: float = 120.0):
         conn.request(
             "POST", "/v1/completions",
             json.dumps({"prompt": prompt, "stream": True,
-                        "max_tokens": DECODE_TOKENS,
+                        "max_tokens": max_tokens or DECODE_TOKENS,
                         "user": tenant}).encode(),
             {"Content-Type": "application/json"},
         )
@@ -874,6 +875,10 @@ def _drive_evict_round(server, tenants: int, nonce_base: int, vocab: int,
         th = threading.Thread(target=work, daemon=True)
         th.start()
         threads.append(th)
+        # The whole tenant set connecting in the same instant overflows
+        # the single replica's accept backlog (ECONNRESET) before the
+        # storm even starts; the spread is negligible vs round duration.
+        time.sleep(0.01)
     for th in threads:
         th.join()
 
@@ -984,6 +989,14 @@ def main_evict(args) -> int:
     hbm_bytes = _evict_block_bytes(0) * (
         _evict_pool_floor() + EVICT_BUDGET_CHAINS * EVICT_PREFIX_BLOCKS
     )
+    if not args.smoke:
+        # The storm must oversubscribe BOTH pools (the smoke shrink
+        # states the same principle): if every tenant chain fits the
+        # ~2x block count the int8 arm buys with this budget, the
+        # treatment never demotes and the swap path goes unexercised —
+        # so size the tenant set off the int8 pool, not the bf16 one.
+        int8_blocks = hbm_bytes // _evict_block_bytes(8)
+        tenants = max(tenants, int8_blocks // EVICT_PREFIX_BLOCKS + 2)
     print(f"# evict-storm baseline: bf16, no swap ({tenants} tenants x "
           f"{rounds} rounds, {hbm_bytes} pool bytes) ...", file=sys.stderr)
     baseline = run_evict_arm("evict_reprefill", 0, False, tenants=tenants,
@@ -1410,6 +1423,506 @@ def main_spec(args) -> int:
     return 0 if ok else 1
 
 
+# -- trace-driven fleet autoscaler: the diurnal wave (--diurnal) ------------
+#
+# One fleet rides a low -> high (~10x) -> low concurrency wave three ways:
+# "auto" starts at ONE replica with the FleetAutoscaler armed over a warm
+# pool it can claim from; "static_small" is one replica forever (cheap,
+# blows the latency band at the crest); "static_big" holds the crest-sized
+# fleet all day (fast, pays peak chips through the trough). The win
+# condition is the paper's elasticity claim: auto holds crest p95 TTFT in
+# the big fleet's band while averaging well under the big fleet's chips,
+# and every scale-down drains before it releases — zero failed streams
+# end to end. A disagg sub-arm replays a long-prompt storm and checks the
+# prefill tier grows while the decode tier does not.
+
+DIURNAL_SLOTS = 4          # gateway admission capacity = 2x slots/replica
+DIURNAL_PROMPT_BLOCKS = 8  # prompt length in full KV blocks
+DIURNAL_DECODE_TOKENS = 32
+DIURNAL_LOW = 1            # trough concurrency
+DIURNAL_HIGH = 10          # the crest: ~10x the trough
+DIURNAL_MAX_REPLICAS = 3
+
+
+def _diurnal_prompt(nonce: int, vocab: int) -> list:
+    """Unique per request (this arm runs WITHOUT a prefix cache): every
+    arrival pays its full prefill, so TTFT degrades the moment the slots
+    saturate — the latency signal the autoscaler closes the loop on."""
+    return [3 + (nonce * 97 + i * 13) % (vocab - 4)
+            for i in range(DIURNAL_PROMPT_BLOCKS * BLOCK_SIZE + 7)]
+
+
+DIURNAL_STEP_FLOOR_S = 0.025
+_PACED_CLS = None
+
+
+def _paced_batcher_cls():
+    """PagedBatcher with a wall-clock floor per engine step. On a TPU
+    the step time is device-bound, so N replicas really are N× decode
+    throughput; on a shared-CPU host N engine threads just steal each
+    other's cores and a bigger 'fleet' gets SLOWER. The floor restores
+    the property the experiment is about — each replica is a fixed-rate
+    server — without touching the serving stack. The floor must
+    dominate the real per-step compute (a few ms for the tiny model)
+    by a wide margin, or a 1-core CI host oversubscribes and the
+    biggest fleet measures slowest."""
+    global _PACED_CLS
+    if _PACED_CLS is None:
+        from kubeflow_tpu.models.paged import PagedBatcher
+
+        class _Paced(PagedBatcher):
+            def _step(self):
+                t0 = time.perf_counter()
+                super()._step()
+                left = DIURNAL_STEP_FLOOR_S - (time.perf_counter() - t0)
+                if left > 0:
+                    time.sleep(left)
+
+        _PACED_CLS = _Paced
+    return _PACED_CLS
+
+
+def _make_diurnal_engine():
+    from kubeflow_tpu.models.serving import GenerationConfig
+
+    params, cfg = _load_model()
+    bucket = (DIURNAL_PROMPT_BLOCKS + 1) * BLOCK_SIZE
+    per_seq = -(-(bucket + DIURNAL_DECODE_TOKENS) // BLOCK_SIZE) + 1
+    return _paced_batcher_cls()(
+        params, cfg,
+        gen=GenerationConfig(max_new_tokens=DIURNAL_DECODE_TOKENS,
+                             eos_id=-1),
+        slots=DIURNAL_SLOTS, num_blocks=DIURNAL_SLOTS * per_seq + 2,
+        block_size=BLOCK_SIZE, prompt_bucket=bucket, prefix_cache=False,
+    )
+
+
+def _build_diurnal_telemetry(ttft_threshold_s: float):
+    """Signals plane tuned to a minutes-long wave: 1s windows, 5s/15s
+    fast burn windows so pressure both appears and clears within the
+    run. TTFT is the only armed objective — its threshold comes from the
+    arm's own measured quiet baseline, so the wave trips it on any host
+    without hand-tuned absolute numbers. Queue wait stays inert on
+    purpose: the replica-side p95 is a 256-sample deque, not
+    time-windowed, so it would keep reporting crest pain long after the
+    ebb and pin the fleet at peak size."""
+    from kubeflow_tpu.observability.signals import (
+        FleetTelemetry,
+        SignalsConfig,
+    )
+    from kubeflow_tpu.observability.slo import default_objectives
+
+    return FleetTelemetry(
+        SignalsConfig(window_s=1.0, windows=120),
+        objectives=default_objectives(
+            ttft_p95_s=ttft_threshold_s, inter_token_p95_s=2.0,
+            queue_wait_p95_s=5.0,
+        ),
+        slo_options={"fast_windows": (5.0, 10.0), "slow_window": 30.0,
+                     "min_events": 6},
+    )
+
+
+def _diurnal_scaler_config():
+    from kubeflow_tpu.models.autoscaler import AutoscalerConfig
+
+    return AutoscalerConfig(
+        min_replicas=1, max_replicas=DIURNAL_MAX_REPLICAS,
+        up_consecutive=2, down_consecutive=5,
+        up_cooldown_s=2.0, down_cooldown_s=3.0,
+        max_actions_per_window=8, actions_window_s=60.0,
+        drain_budget_s=30.0, stale_after_s=5.0,
+        claim_backoff_base_s=0.5, claim_backoff_max_s=5.0,
+    )
+
+
+def _warm_pool_provisioner(gw, by_ep, pool, released):
+    """In-process stand-in for the slice pool: scale-up claims a
+    pre-started warm server for the tier and joins it to the ring; drain
+    stops the victim off-thread (``stop()`` blocks until its in-flight
+    streams finish — exactly the never-kill-a-stream contract); release
+    records the slice as returned."""
+    from kubeflow_tpu.models.autoscaler import WarmSliceProvisioner
+
+    class _Pool(WarmSliceProvisioner):
+        def scale_up(self, tier, now=None):
+            warm = pool.get(tier) or []
+            if not warm:
+                return None
+            ep = warm.pop(0)
+            self.gateway.add_replica(ep)
+            return ep
+
+    def drain(ep):
+        threading.Thread(target=by_ep[ep].stop, daemon=True).start()
+
+    return _Pool(gw, drain_fn=drain, release_fn=released.append)
+
+
+def _drive_diurnal_round(gw, conc: int, nonce_base: int, vocab: int,
+                         outcomes: list, phase: str) -> None:
+    threads = []
+    for i in range(conc):
+        prompt = _diurnal_prompt(nonce_base + i, vocab)
+
+        def work(p=prompt, name=f"tenant-{i}"):
+            ok, ttft, detail = _stream_once(
+                gw, p, name, max_tokens=DIURNAL_DECODE_TOKENS)
+            outcomes.append((phase, ok, ttft, detail))
+
+        th = threading.Thread(target=work, daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+
+
+def _chips_held(gw) -> int:
+    """Slices the fleet is holding right now: in-ring replicas plus
+    draining ones (a draining slice is out of the ring but not yet
+    returned to the pool, so it still counts against the bill)."""
+    draining = gw.stats()["autoscaler"]["draining"]
+    return len(set(gw.ring_nodes()) | set(draining))
+
+
+def run_diurnal_arm(kind: str, *, high: int, high_rounds: int,
+                    low_rounds: int, settle_s: float) -> dict:
+    """One pass of the wave against one fleet flavor. kind: "auto" =
+    1 in-ring replica + a warm pool the autoscaler claims from;
+    "static_small" = 1 replica, scaler inert; "static_big" =
+    DIURNAL_MAX_REPLICAS replicas, scaler inert."""
+    from kubeflow_tpu.models.gateway import ServingGateway
+    from kubeflow_tpu.models.server import InferenceServer
+
+    _, cfg = _load_model()
+    vocab = cfg.vocab_size
+    total = 1 if kind == "static_small" else DIURNAL_MAX_REPLICAS
+    in_ring = 1 if kind == "auto" else total
+    servers = [InferenceServer(_make_diurnal_engine(), port=0,
+                               drain_s=60.0).start()
+               for _ in range(total)]
+    eps = [f"{s.host}:{s.port}" for s in servers]
+    by_ep = dict(zip(eps, servers))
+    released: list = []
+    gw = ServingGateway(
+        eps[:in_ring], port=0, block_size=BLOCK_SIZE,
+        health_interval_s=0.1, reroute_budget=2,
+        # The crest must reach the replicas as QUEUEING (the latency
+        # signal), not as gateway-side tenant shed.
+        max_inflight=4 * high,
+        autoscaler_config=(_diurnal_scaler_config() if kind == "auto"
+                           else None),
+    ).start()
+    if kind == "auto":
+        gw.autoscaler.provisioner = _warm_pool_provisioner(
+            gw, by_ep, {"fused": eps[in_ring:]}, released)
+    outcomes: list = []
+    chips: list = []
+    try:
+        # Calibration: quiet singles with telemetry detached (the scaler
+        # stays frozen on "telemetry disabled") first pay the compiles,
+        # then measure this host's healthy TTFT. The armed threshold is
+        # a multiple of that baseline.
+        warm: list = []
+        for r in range(2):
+            _drive_diurnal_round(gw, 1, 900_000 + r, vocab, warm, "warm")
+        calib: list = []
+        for r in range(3):
+            _drive_diurnal_round(gw, 1, 910_000 + r, vocab, calib,
+                                 "calib")
+        bad = [d for _, ok, _, d in warm + calib if not ok]
+        if bad:
+            raise RuntimeError(f"{kind} calibration failures: {bad}")
+        baseline = max(t for _, _, t, _ in calib)
+        threshold = max(3.0 * baseline, baseline + 0.15)
+        telemetry = _build_diurnal_telemetry(threshold)
+        gw.telemetry = telemetry
+        gw._tenant_buckets = telemetry.tenants
+
+        # Chips are sampled on the wall clock (not per round — rounds
+        # have different durations at different fleet sizes), so the
+        # mean is a time-weighted slice bill.
+        stop_sampling = threading.Event()
+
+        def sample():
+            while not stop_sampling.wait(0.2):
+                chips.append(_chips_held(gw) if kind == "auto"
+                             else total)
+
+        sampler = threading.Thread(target=sample, daemon=True)
+        sampler.start()
+
+        t0 = time.perf_counter()
+        nonce = 0
+        for phase, conc, rounds in (("low", DIURNAL_LOW, low_rounds),
+                                    ("high", high, high_rounds),
+                                    ("ebb", DIURNAL_LOW, low_rounds)):
+            for r in range(rounds):
+                # The crest's second half is the steady state the band
+                # gate reads; the first half (scale-up in flight) stays
+                # in the artifact as the adaptation transient.
+                tag = ("high_steady"
+                       if phase == "high" and r >= rounds // 2
+                       else phase)
+                _drive_diurnal_round(gw, conc, nonce, vocab, outcomes,
+                                     tag)
+                nonce += conc
+        # Ebb settle (auto only): keep trough traffic flowing until the
+        # burn windows clear, the drains finish, and the fleet is back
+        # to one slice — or the settle budget expires.
+        deadline = time.perf_counter() + settle_s
+        while kind == "auto" and time.perf_counter() < deadline:
+            st = gw.stats()["autoscaler"]
+            if (released and not st["draining"]
+                    and sum(st["tier_replicas"].values()) == 1):
+                break
+            _drive_diurnal_round(gw, DIURNAL_LOW, nonce, vocab,
+                                 outcomes, "ebb")
+            nonce += DIURNAL_LOW
+            time.sleep(0.2)
+        # The rest of the night: the trough resumes after the wave, so
+        # the time-weighted bill reflects a day that is mostly trough —
+        # not a run that ends the moment the last slice is released.
+        for _ in range(2 * low_rounds):
+            _drive_diurnal_round(gw, DIURNAL_LOW, nonce, vocab,
+                                 outcomes, "ebb")
+            nonce += DIURNAL_LOW
+        wall = time.perf_counter() - t0
+        stop_sampling.set()
+        sampler.join(timeout=2.0)
+
+        failures = [d for _, ok, _, d in outcomes if not ok]
+
+        def p95(*phases):
+            vals = [t for ph, ok, t, _ in outcomes
+                    if ph in phases and ok]
+            return _p95_ms(vals) if vals else 0.0
+
+        scaler = (gw.stats()["autoscaler"] if kind == "auto"
+                  else {"enabled": False})
+        return {
+            "kind": kind,
+            "requests_completed": sum(
+                1 for _, ok, _, _ in outcomes if ok),
+            "failures": failures,
+            "ttft_threshold_ms": round(threshold * 1e3, 2),
+            "low_p95_ttft_ms": p95("low"),
+            "high_p95_ttft_ms": p95("high", "high_steady"),
+            "high_steady_p95_ttft_ms": p95("high_steady"),
+            "ebb_p95_ttft_ms": p95("ebb"),
+            "chips_mean": round(sum(chips) / max(len(chips), 1), 3),
+            "chips_peak": max(chips) if chips else 0,
+            "chips_steady": min(chips) if chips else 0,
+            "wall_s": round(wall, 2),
+            "released": list(released),
+            "autoscaler": scaler,
+            "decisions": (gw.autoscaler.debug()["decisions"][-40:]
+                          if kind == "auto" else []),
+        }
+    finally:
+        gw.stop()
+        for s in servers:
+            s.stop()
+
+
+def run_diurnal_disagg_arm(*, storm_conc: int, max_storm_rounds: int
+                           ) -> dict:
+    """Long-prompt storm against a disagg fleet with the scaler armed:
+    TTFT burn is a PREFILL-tier objective, so the storm must grow the
+    prefill tier only — the decode tier holds (its inter-token signal
+    stays quiet, and min_replicas stops its ebb)."""
+    from kubeflow_tpu.models.gateway import ServingGateway
+    from kubeflow_tpu.models.server import InferenceServer
+
+    _, cfg = _load_model()
+    vocab = cfg.vocab_size
+    roles = ["prefill", "decode", "prefill", "decode"]
+    servers = [InferenceServer(_make_disagg_engine(), port=0,
+                               drain_s=60.0, tier_role=role).start()
+               for role in roles]
+    eps = [f"{s.host}:{s.port}" for s in servers]
+    by_ep = dict(zip(eps, servers))
+    released: list = []
+    gw = ServingGateway(
+        eps[:2], port=0, block_size=BLOCK_SIZE, health_interval_s=0.1,
+        reroute_budget=2, max_inflight=4 * storm_conc,
+        tier_mode="disagg", tier_roles=dict(zip(eps, roles)),
+        autoscaler_config=_diurnal_scaler_config(),
+    ).start()
+    gw.autoscaler.provisioner = _warm_pool_provisioner(
+        gw, by_ep, {"prefill": [eps[2]], "decode": [eps[3]]}, released)
+    outcomes: list = []
+    short_len = DISAGG_SHORT_TOKENS
+    long_len = DISAGG_LONG_BLOCKS * BLOCK_SIZE + 3
+
+    def drive(conc, nonce_base, length, phase, into=None):
+        threads = []
+        for i in range(conc):
+            prompt = _disagg_prompt(nonce_base + i, length, vocab)
+
+            def work(p=prompt, name=f"tenant-{i}"):
+                ok, ttft, detail = _stream_once(
+                    gw, p, name, max_tokens=DISAGG_DECODE_TOKENS)
+                (outcomes if into is None else into).append(
+                    (phase, ok, ttft, detail))
+
+            th = threading.Thread(target=work, daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+
+    try:
+        # Warm both prompt shapes and the KV handoff, then calibrate the
+        # TTFT threshold on quiet short singles.
+        setup: list = []
+        drive(2, 800_000, long_len, "warm", into=setup)
+        drive(2, 810_000, short_len, "warm", into=setup)
+        calib: list = []
+        for r in range(3):
+            drive(1, 820_000 + r, short_len, "calib", into=calib)
+        bad = [d for _, ok, _, d in setup + calib if not ok]
+        if bad:
+            raise RuntimeError(f"disagg calibration failures: {bad}")
+        baseline = max(t for _, _, t, _ in calib)
+        threshold = max(3.0 * baseline, baseline + 0.15)
+        telemetry = _build_diurnal_telemetry(threshold)
+        gw.telemetry = telemetry
+        gw._tenant_buckets = telemetry.tenants
+
+        rounds_run = 0
+        for r in range(max_storm_rounds):
+            drive(storm_conc, r * storm_conc, long_len, "storm")
+            rounds_run += 1
+            sizes = gw.stats()["autoscaler"]["tier_replicas"]
+            if sizes.get("prefill", 0) >= 2:
+                break
+        gw.probe_once()
+        st = gw.stats()["autoscaler"]
+        decisions = gw.autoscaler.debug()["decisions"]
+        ups = [d for d in decisions if d["action"] == "scale_up"]
+        failures = [d for _, ok, _, d in outcomes if not ok]
+        return {
+            "storm_rounds": rounds_run,
+            "requests_completed": sum(
+                1 for _, ok, _, _ in outcomes if ok),
+            "failures": failures,
+            "ttft_threshold_ms": round(threshold * 1e3, 2),
+            "tier_replicas": st["tier_replicas"],
+            "scale_up_tiers": sorted({d["tier"] for d in ups}),
+            "prefill_grew": st["tier_replicas"].get("prefill", 0) >= 2,
+            "decode_held": st["tier_replicas"].get("decode", 0) == 1,
+        }
+    finally:
+        gw.stop()
+        for s in servers:
+            s.stop()
+
+
+def main_diurnal(args) -> int:
+    """--diurnal: the autoscaler elasticity experiment. --smoke runs the
+    auto arm only on a shrunk wave (gate: >=1 scale-up, >=1 drained
+    release, zero failed streams); live runs all three arms plus the
+    disagg storm and writes SERVE_r11_autoscale.json."""
+    if args.smoke:
+        wave = dict(high=DIURNAL_HIGH, high_rounds=5, low_rounds=2,
+                    settle_s=45.0)
+        print("# diurnal smoke: auto arm only ...", file=sys.stderr)
+        auto = run_diurnal_arm("auto", **wave)
+        summary = {
+            "auto_scale_ups": auto["autoscaler"]["scale_ups"],
+            "auto_releases": len(auto["released"]),
+            "auto_failures": len(auto["failures"]),
+            "auto_holds": auto["autoscaler"]["holds"],
+            "auto_freezes": auto["autoscaler"]["freezes"],
+            "auto_ttft_threshold_ms": auto["ttft_threshold_ms"],
+            "auto_high_p95_ttft_ms": auto["high_p95_ttft_ms"],
+            "auto_chips_peak": auto["chips_peak"],
+        }
+        print(json.dumps(summary))
+        ok = (not auto["failures"]
+              and auto["autoscaler"]["scale_ups"] >= 1
+              and len(auto["released"]) >= 1
+              and not auto["autoscaler"]["draining"])
+        print("# --smoke: artifact write and win gate skipped",
+              file=sys.stderr)
+        return 0 if ok else 1
+
+    wave = dict(high=DIURNAL_HIGH, high_rounds=8, low_rounds=10,
+                settle_s=60.0)
+    arms = {}
+    for kind in ("auto", "static_small", "static_big"):
+        print(f"# diurnal {kind} arm (fresh fleet) ...", file=sys.stderr)
+        arms[kind] = run_diurnal_arm(kind, **wave)
+    print("# diurnal disagg storm (prefill-only growth) ...",
+          file=sys.stderr)
+    disagg = run_diurnal_disagg_arm(storm_conc=4, max_storm_rounds=12)
+
+    auto, small, big = (arms["auto"], arms["static_small"],
+                        arms["static_big"])
+    # The latency band the crest must hold: the static crest-sized
+    # fleet's own p95, plus slack for scale-up transients.
+    band_ms = max(1.5 * big["high_p95_ttft_ms"],
+                  big["high_p95_ttft_ms"] + 100.0)
+    record = {
+        "scenario": (
+            f"diurnal wave {DIURNAL_LOW}->{wave['high']}->{DIURNAL_LOW} "
+            f"concurrent streams over {DIURNAL_SLOTS}-slot replicas; "
+            "auto = 1 replica + warm pool under the trace-driven "
+            f"autoscaler (max {DIURNAL_MAX_REPLICAS}), statics pinned"
+        ),
+        "model": "tiny",
+        "provenance": "live",
+        "host": _record_host(),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                     time.gmtime()),
+        "band_ms": round(band_ms, 2),
+        "arms": arms,
+        "disagg_storm": disagg,
+    }
+    summary = {
+        "auto_high_p95_ttft_ms": auto["high_p95_ttft_ms"],
+        "auto_high_steady_p95_ttft_ms": auto["high_steady_p95_ttft_ms"],
+        "small_high_p95_ttft_ms": small["high_p95_ttft_ms"],
+        "big_high_p95_ttft_ms": big["high_p95_ttft_ms"],
+        "band_ms": round(band_ms, 2),
+        "auto_chips_mean": auto["chips_mean"],
+        "big_chips_mean": big["chips_mean"],
+        "auto_scale_ups": auto["autoscaler"]["scale_ups"],
+        "auto_releases": len(auto["released"]),
+        "failures": sum(len(a["failures"]) for a in arms.values()),
+        "disagg_prefill_grew": disagg["prefill_grew"],
+        "disagg_decode_held": disagg["decode_held"],
+    }
+    print(json.dumps(summary))
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(tmp, args.out)
+    print(f"# wrote {args.out}", file=sys.stderr)
+    win = (
+        all(not a["failures"] for a in arms.values())
+        and not disagg["failures"]
+        # Elasticity: the scaler rode the wave up AND back down.
+        and auto["autoscaler"]["scale_ups"] >= 1
+        and len(auto["released"]) >= 1
+        and auto["chips_steady"] == 1
+        # The crest: once adapted, auto holds the big fleet's latency
+        # band; the trough-sized static fleet blows it. (The adaptation
+        # transient stays visible in high_p95_ttft_ms.)
+        and auto["high_steady_p95_ttft_ms"] <= band_ms
+        and small["high_p95_ttft_ms"] > band_ms
+        # The bill: auto averages well under the crest-sized fleet.
+        and auto["chips_mean"] <= 0.75 * big["chips_mean"]
+        # Disagg: a long-prompt storm grows the prefill tier only.
+        and disagg["prefill_grew"] and disagg["decode_held"]
+        and disagg["scale_up_tiers"] == ["prefill"]
+    )
+    if not win:
+        print("# r11 win gate FAILED", file=sys.stderr)
+    return 0 if win else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
@@ -1433,6 +1946,12 @@ def main() -> int:
                     help="run the 64-adapter multi-LoRA fleet: (prefix, "
                          "adapter) affinity vs adapter-oblivious routing "
                          "(artifact: SERVE_r10_spec.json)")
+    ap.add_argument("--diurnal", action="store_true",
+                    help="run the fleet-autoscaler diurnal wave: auto "
+                         "(1 replica + warm pool, scaler armed) vs "
+                         "static small/big fleets, plus a disagg "
+                         "long-prompt storm "
+                         "(artifact: SERVE_r11_autoscale.json)")
     ap.add_argument("--smoke", action="store_true",
                     help="2 replicas x 2 tenants x 2 rounds, no artifact, "
                          "no win gate — CI executability tier")
@@ -1440,10 +1959,13 @@ def main() -> int:
     root = Path(__file__).resolve().parent.parent
     if args.out is None:
         args.out = str(root / (
-            "SERVE_r10_spec.json" if args.spec or args.multilora
+            "SERVE_r11_autoscale.json" if args.diurnal
+            else "SERVE_r10_spec.json" if args.spec or args.multilora
             else "SERVE_r09_hbm.json" if args.evict_storm
             else "SERVE_r08_disagg.json" if args.disagg
             else "SERVE_r07_fleet.json"))
+    if args.diurnal:
+        return main_diurnal(args)
     if args.spec or args.multilora:
         return main_spec(args)
     if args.evict_storm:

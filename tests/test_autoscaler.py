@@ -1,0 +1,695 @@
+"""Fleet autoscaler tests (models/autoscaler.py).
+
+Fake-clock decision suite — every control-loop invariant exercised
+deterministically against a duck-typed gateway/telemetry pair: ramp
+claims a warm slice, ebb drains-then-releases, hysteresis + cooldowns +
+the fleet-wide rate limit suppress flapping, disagg tiers scale
+independently (a long-prompt storm grows prefill only), stale telemetry
+freezes scaling, and claim failures back off exponentially and degrade
+to hold. Plus one integration pass over a REAL 2-replica
+InferenceServer fleet: organic ebb triggers a scale-down mid-stream and
+no stream is ever dropped — the release happens only once the drained
+replica is empty.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.models.autoscaler import (
+    AutoscalerConfig,
+    FleetAutoscaler,
+    WarmSliceProvisioner,
+    autoscaler_from_env,
+)
+
+EP0, EP1, EP2, EP3 = (f"127.0.0.1:{9000 + i}" for i in range(4))
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def _slo(ttft=(0.0, 0.0), inter=(0.0, 0.0), queue=(0.0, 0.0),
+         queue_thr=0.25):
+    """An SLO report in the engine's shape: two fast windows + slow."""
+
+    def obj(burns, threshold):
+        return {"kind": "latency", "threshold": threshold,
+                "burn": {"60s": burns[0], "300s": burns[1], "1800s": 0.0}}
+
+    return {
+        "objectives": {
+            "ttft_p95": obj(ttft, 0.5),
+            "inter_token_p95": obj(inter, 0.2),
+            "queue_wait_p95": obj(queue, queue_thr),
+        },
+        "breaching": [],
+    }
+
+
+class FakeTelemetry:
+    def __init__(self, clock):
+        self.clock = clock
+        self.ages: dict = {}
+        self.slo = _slo()
+        self.fleet: dict = {}
+        self.actions: list = []
+
+    def scrape_ages(self, now=None):
+        return dict(self.ages)
+
+    def evaluate_slo(self, now=None):
+        return self.slo
+
+    def snapshot(self, now=None):
+        return {"fleet": dict(self.fleet)}
+
+    def observe_autoscale(self, action):
+        self.actions.append(action)
+
+    def forget_replica(self, ep):
+        self.ages.pop(ep, None)
+
+
+class FakeGateway:
+    tier_mode = "fused"
+
+    def __init__(self, telemetry):
+        self.telemetry = telemetry
+        self.replicas: dict = {}
+        self.inflight: dict = {}
+        self.begun: list = []
+        self.removed: list = []
+
+    def add(self, ep, *, role="fused", slots=4, active=0, queued=0):
+        self.replicas[ep] = {
+            "role": role, "in_ring": True,
+            "stats": {"slots": slots, "active_slots": active,
+                      "queued": queued},
+        }
+        self.telemetry.ages[ep] = 0.0
+
+    def ring_nodes(self):
+        return frozenset(ep for ep, r in self.replicas.items()
+                         if r["in_ring"])
+
+    def stats(self):
+        return {
+            "replicas": {ep: dict(r) for ep, r in self.replicas.items()},
+            "inflight": dict(self.inflight),
+        }
+
+    def begin_drain(self, ep):
+        self.begun.append(ep)
+        self.replicas[ep]["in_ring"] = False
+        return True
+
+    def remove_replica(self, ep):
+        self.removed.append(ep)
+        self.replicas.pop(ep, None)
+        self.telemetry.forget_replica(ep)
+
+
+class FakeProvisioner:
+    def __init__(self):
+        self.claim_result = "pool/warm-0"
+        self.claims: list = []
+        self.drains: list = []
+        self.drained_eps: set = set()
+        self.releases: list = []
+
+    def scale_up(self, tier, now=None):
+        self.claims.append(tier)
+        return self.claim_result
+
+    def drain(self, ep):
+        self.drains.append(ep)
+
+    def drained(self, ep):
+        return ep in self.drained_eps
+
+    def release(self, ep):
+        self.releases.append(ep)
+
+
+def _cfg(**kw):
+    base = dict(
+        min_replicas=1, max_replicas=4,
+        up_consecutive=2, down_consecutive=3,
+        up_cooldown_s=5.0, down_cooldown_s=5.0,
+        max_actions_per_window=4, actions_window_s=60.0,
+        drain_budget_s=30.0, stale_after_s=10.0,
+        claim_backoff_base_s=2.0, claim_backoff_max_s=60.0,
+        claim_backoff_jitter=0.0,
+    )
+    base.update(kw)
+    return AutoscalerConfig(**base)
+
+
+def _mk(n=2, *, config=None, tier_mode="fused", roles=None, **add_kw):
+    clock = FakeClock()
+    tel = FakeTelemetry(clock)
+    gw = FakeGateway(tel)
+    gw.tier_mode = tier_mode
+    eps = [EP0, EP1, EP2, EP3][:n]
+    for i, ep in enumerate(eps):
+        gw.add(ep, role=(roles[i] if roles else "fused"), **add_kw)
+    prov = FakeProvisioner()
+    scaler = FleetAutoscaler(
+        gw, config or _cfg(), provisioner=prov, clock=clock,
+        rng=lambda: 0.0,
+    )
+    return scaler, gw, tel, prov, clock
+
+
+def _tick(scaler, clock, n=1, dt=1.0):
+    out = []
+    for _ in range(n):
+        out.extend(scaler.tick())
+        clock.advance(dt)
+    return out
+
+
+def _actions(decisions, action):
+    return [d for d in decisions if d["action"] == action]
+
+
+class TestConfig:
+    def test_defaults_valid_and_frozen(self):
+        cfg = AutoscalerConfig()
+        assert cfg.min_replicas <= cfg.max_replicas
+        with pytest.raises(Exception):
+            cfg.max_replicas = 99  # frozen
+
+    @pytest.mark.parametrize("kw", [
+        dict(min_replicas=3, max_replicas=2),
+        dict(max_replicas=0),
+        dict(down_burn=1.5, up_burn=1.0),
+        dict(low_batch_fill=0.9, high_batch_fill=0.5),
+        dict(up_consecutive=0),
+        dict(up_cooldown_s=-1),
+        dict(max_actions_per_window=0),
+        dict(actions_window_s=0),
+        dict(drain_budget_s=0),
+        dict(stale_after_s=0),
+        dict(claim_backoff_jitter=-0.1),
+        dict(headroom=0.5),
+        dict(decision_ring=0),
+    ])
+    def test_bad_knobs_fail_fast(self, kw):
+        with pytest.raises(ValueError, match="AutoscalerConfig"):
+            AutoscalerConfig(**kw)
+
+
+class TestRamp:
+    def test_sustained_burn_claims_a_warm_slice(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        tel.slo = _slo(ttft=(1.5, 1.2))
+        assert _tick(scaler, clock) == []  # streak 1 < up_consecutive
+        done = _tick(scaler, clock)
+        assert [d["action"] for d in done] == ["scale_up"]
+        assert done[0]["endpoint"] == "pool/warm-0"
+        assert any("ttft_p95" in r for r in done[0]["reasons"])
+        assert prov.claims == ["fused"]
+        st = scaler.stats()
+        assert st["scale_ups"] == 1
+        assert st["claim_attempts"] == 1
+        assert st["claim_failures"] == 0
+        assert "up" in tel.actions
+
+    def test_one_hot_window_is_not_a_ramp(self):
+        """Hysteresis: pressure must PERSIST up_consecutive ticks —
+        a blip, a quiet tick, and another blip never scale."""
+        scaler, gw, tel, prov, clock = _mk(2)
+        for hot in (True, False, True, False, True):
+            tel.slo = _slo(ttft=(1.5, 1.2)) if hot else _slo()
+            tel.fleet = {}
+            _tick(scaler, clock)
+        assert prov.claims == []
+        assert scaler.stats()["scale_ups"] == 0
+
+    def test_burn_in_one_fast_window_only_is_not_pressure(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        tel.slo = _slo(ttft=(1.5, 0.0))  # fast spike, 300s window calm
+        _tick(scaler, clock, n=4)
+        assert prov.claims == []
+
+    def test_up_cooldown_holds_once_per_episode(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        tel.slo = _slo(ttft=(1.5, 1.2))
+        _tick(scaler, clock, n=2)
+        assert scaler.stats()["scale_ups"] == 1
+        # Pressure persists; attempts land inside the 5s cooldown.
+        done = _tick(scaler, clock, n=2)
+        holds = _actions(done, "hold")
+        assert len(holds) == 1  # deduped: one hold per episode
+        assert any("cooldown" in r for r in holds[0]["reasons"])
+        # Past the cooldown the claim goes through.
+        clock.advance(5.0)
+        done = _tick(scaler, clock, n=2)
+        assert scaler.stats()["scale_ups"] == 2
+
+    def test_rate_limit_is_fleet_wide_and_window_scoped(self):
+        scaler, gw, tel, prov, clock = _mk(
+            2, config=_cfg(up_cooldown_s=0.001, max_actions_per_window=1,
+                           actions_window_s=60.0))
+        tel.slo = _slo(ttft=(1.5, 1.2))
+        _tick(scaler, clock, n=2)
+        assert scaler.stats()["scale_ups"] == 1
+        done = _tick(scaler, clock, n=3)
+        holds = _actions(done, "hold")
+        assert holds and any("rate limit" in r for r in holds[0]["reasons"])
+        assert scaler.stats()["scale_ups"] == 1
+        clock.advance(61.0)  # the action falls out of the window
+        _tick(scaler, clock, n=2)
+        assert scaler.stats()["scale_ups"] == 2
+
+    def test_at_max_replicas_holds(self):
+        scaler, gw, tel, prov, clock = _mk(
+            2, config=_cfg(max_replicas=2))
+        tel.slo = _slo(ttft=(1.5, 1.2))
+        done = _tick(scaler, clock, n=3)
+        holds = _actions(done, "hold")
+        assert holds and any("max_replicas" in r for r in holds[0]["reasons"])
+        assert prov.claims == []
+
+
+class TestEbb:
+    def test_ebb_drains_then_releases_least_loaded(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        gw.replicas[EP0]["stats"]["active_slots"] = 3  # EP1 least loaded
+        done = _tick(scaler, clock, n=3)
+        downs = _actions(done, "scale_down")
+        assert [d["endpoint"] for d in downs] == [EP1]
+        assert prov.drains == [EP1]
+        assert gw.begun == [EP1]
+        assert EP1 not in gw.ring_nodes()  # out of the ring at decision
+        assert gw.removed == [] and prov.releases == []  # NOT yet released
+        assert scaler.stats()["draining"] == [EP1]
+        # Still busy: no release while the provisioner says not drained.
+        assert _actions(_tick(scaler, clock), "release") == []
+        prov.drained_eps.add(EP1)
+        done = _tick(scaler, clock)
+        rel = _actions(done, "release")
+        assert [d["endpoint"] for d in rel] == [EP1]
+        assert any("drained in" in r for r in rel[0]["reasons"])
+        assert prov.releases == [EP1]
+        assert gw.removed == [EP1]
+        assert scaler.stats()["draining"] == []
+        assert "down" in tel.actions
+
+    def test_drain_budget_expiry_force_releases(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        _tick(scaler, clock, n=3)
+        assert scaler.stats()["scale_downs"] == 1
+        clock.advance(31.0)  # past drain_budget_s=30, never drained
+        done = _tick(scaler, clock)
+        rel = _actions(done, "release")
+        assert rel and any("budget" in r and "exceeded" in r
+                           for r in rel[0]["reasons"])
+        assert prov.releases and gw.removed
+
+    def test_queued_work_blocks_ebb(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        tel.fleet = {"replica_queue_depth": {EP0: 2, EP1: 0}}
+        _tick(scaler, clock, n=5)
+        assert scaler.stats()["scale_downs"] == 0
+
+    def test_at_min_replicas_holds(self):
+        scaler, gw, tel, prov, clock = _mk(
+            2, config=_cfg(min_replicas=2))
+        done = _tick(scaler, clock, n=4)
+        holds = _actions(done, "hold")
+        assert holds and any("min_replicas" in r for r in holds[0]["reasons"])
+        assert prov.drains == []
+
+    def test_headroom_guard_never_forces_a_shed(self):
+        scaler, gw, tel, prov, clock = _mk(2)  # slots=4 → cap 8 after
+        gw.replicas[EP0]["stats"]["active_slots"] = 2  # EP1 least loaded
+        gw.inflight = {"tenant-a": 4, "tenant-b": 3}  # 7 × 1.2 > 8
+        done = _tick(scaler, clock, n=4)
+        holds = _actions(done, "hold")
+        assert holds and any("headroom" in r for r in holds[0]["reasons"])
+        assert prov.drains == []
+        # Load ebbs for real → the same pressure drains.
+        gw.inflight = {"tenant-a": 1}
+        _tick(scaler, clock, n=2)
+        assert prov.drains == [EP1]
+
+    def test_drain_failure_degrades_to_hold(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        prov.drain = lambda ep: (_ for _ in ()).throw(RuntimeError("boom"))
+        done = _tick(scaler, clock, n=3)
+        holds = _actions(done, "hold")
+        assert holds and any("drain" in r and "failed" in r
+                             for r in holds[-1]["reasons"])
+        assert gw.begun == []  # nothing left the ring
+        assert scaler.stats()["scale_downs"] == 0
+
+
+class TestDisagg:
+    def _mk_disagg(self, **cfg_kw):
+        return _mk(4, tier_mode="disagg",
+                   roles=["prefill", "prefill", "decode", "decode"],
+                   config=_cfg(**cfg_kw))
+
+    def test_long_prompt_storm_grows_prefill_tier_only(self):
+        scaler, gw, tel, prov, clock = self._mk_disagg()
+        # TTFT burning + a prefill member's queue-wait over threshold;
+        # decode inter-token is perfectly calm.
+        tel.slo = _slo(ttft=(2.0, 1.6))
+        tel.fleet = {"replica_queue_wait_p95_s": {EP0: 0.9}}
+        done = _tick(scaler, clock, n=2)
+        ups = _actions(done, "scale_up")
+        assert [d["tier"] for d in ups] == ["prefill"]
+        assert prov.claims == ["prefill"]
+        assert scaler.stats()["tier_replicas"] == {
+            "prefill": 2, "decode": 2,
+        }
+
+    def test_decode_pressure_grows_decode_tier_only(self):
+        scaler, gw, tel, prov, clock = self._mk_disagg()
+        tel.slo = _slo(inter=(1.4, 1.1))
+        done = _tick(scaler, clock, n=2)
+        assert [d["tier"] for d in _actions(done, "scale_up")] == ["decode"]
+        assert prov.claims == ["decode"]
+
+    def test_decode_queue_wait_never_grows_prefill(self):
+        """The fleet-wide queue-wait gauge on a DECODE member must not
+        count as prefill pressure — tier routing is per-member."""
+        scaler, gw, tel, prov, clock = self._mk_disagg()
+        tel.fleet = {"replica_queue_wait_p95_s": {EP2: 0.9}}  # decode ep
+        _tick(scaler, clock, n=3)
+        assert "prefill" not in prov.claims
+
+    def test_tiers_ebb_independently(self):
+        scaler, gw, tel, prov, clock = self._mk_disagg(down_consecutive=2)
+        # Decode quiet, prefill burning: decode shrinks, prefill grows.
+        tel.slo = _slo(ttft=(2.0, 1.6))
+        done = _tick(scaler, clock, n=2)
+        by_tier = {(d["tier"], d["action"]) for d in done}
+        assert ("prefill", "scale_up") in by_tier
+        assert ("decode", "scale_down") in by_tier
+        victims = [d["endpoint"] for d in _actions(done, "scale_down")]
+        assert victims and all(v in (EP2, EP3) for v in victims)
+
+
+class TestFreeze:
+    def test_stale_scrape_freezes_until_fresh(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        tel.slo = _slo(ttft=(1.5, 1.2))
+        tel.ages[EP1] = 99.0  # way past stale_after_s=10
+        done = _tick(scaler, clock)
+        assert [d["action"] for d in done] == ["freeze"]
+        assert any("stale" in r for r in done[0]["reasons"])
+        st = scaler.stats()
+        assert st["frozen"] is True and st["freezes"] == 1
+        # One freeze per episode, and streaks reset while frozen.
+        assert _tick(scaler, clock, n=3) == []
+        assert scaler.stats()["freezes"] == 1
+        assert prov.claims == []
+        # Fresh signals thaw it; pressure must re-accumulate from zero.
+        tel.ages[EP1] = 0.0
+        done = _tick(scaler, clock, n=2)
+        assert scaler.stats()["frozen"] is False
+        assert [d["action"] for d in _actions(done, "scale_up")] == \
+            ["scale_up"]
+        assert "freeze" in tel.actions
+
+    def test_missing_scrape_and_missing_telemetry_freeze(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        del tel.ages[EP0]
+        done = _tick(scaler, clock)
+        assert [d["action"] for d in done] == ["freeze"]
+        assert any("no scrape yet" in r for r in done[0]["reasons"])
+        gw.telemetry = None
+        done = _tick(scaler, clock)
+        assert _actions(done, "freeze") == []  # same episode: no re-log
+        assert scaler.stats()["frozen"] is True
+
+    def test_draining_replica_age_never_freezes(self):
+        """A drain-pinned replica is not scraped; its growing age must
+        not freeze the loop — staleness is judged in-ring only."""
+        scaler, gw, tel, prov, clock = _mk(2)
+        gw.replicas[EP0]["stats"]["active_slots"] = 1  # EP1 least loaded
+        _tick(scaler, clock, n=3)  # quiet fleet → EP1 draining
+        assert scaler.stats()["draining"] == [EP1]
+        tel.ages[EP1] = 500.0
+        _tick(scaler, clock)
+        assert scaler.stats()["frozen"] is False
+
+
+class TestClaimBackoff:
+    def test_claim_failure_backs_off_exponentially_and_holds(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        prov.claim_result = None
+        tel.slo = _slo(ttft=(1.5, 1.2))
+        done = _tick(scaler, clock, n=2)
+        holds = _actions(done, "hold")
+        assert holds and any("claim failed" in r
+                             for r in holds[0]["reasons"])
+        st = scaler.stats()
+        assert st["claim_attempts"] == 1 and st["claim_failures"] == 1
+        assert st["scale_ups"] == 0
+        # Inside the 2s backoff: no new attempt even under pressure.
+        _tick(scaler, clock, n=1)
+        assert scaler.stats()["claim_attempts"] == 1
+        # Past it: retry → failure #2 → backoff doubles to 4s.
+        clock.advance(2.0)
+        _tick(scaler, clock)
+        assert scaler.stats()["claim_failures"] == 2
+        clock.advance(2.0)  # 4s backoff not yet over (1s tick + 2s)
+        _tick(scaler, clock)
+        assert scaler.stats()["claim_attempts"] == 2
+        # Pool recovers → next attempt claims and resets the ladder.
+        prov.claim_result = "pool/warm-1"
+        clock.advance(10.0)
+        _tick(scaler, clock, n=2)
+        st = scaler.stats()
+        assert st["scale_ups"] == 1 and st["claim_attempts"] == 3
+
+    def test_scale_up_exception_is_a_failure_not_a_crash(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        prov.scale_up = lambda tier, now=None: (
+            (_ for _ in ()).throw(RuntimeError("pool gone"))
+        )
+        tel.slo = _slo(ttft=(1.5, 1.2))
+        done = _tick(scaler, clock, n=2)
+        holds = _actions(done, "hold")
+        assert holds and any("pool gone" in r for r in holds[0]["reasons"])
+        assert scaler.stats()["claim_failures"] == 1
+
+
+class TestSurfaces:
+    def test_debug_payload_has_config_tiers_and_decisions(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        tel.slo = _slo(ttft=(1.5, 1.2))
+        _tick(scaler, clock, n=2)
+        dbg = scaler.debug()
+        assert dbg["config"]["max_replicas"] == 4
+        assert dbg["tiers"]["fused"]["size"] == 2
+        assert dbg["decisions"][-1]["action"] == "scale_up"
+        assert dbg["scale_ups"] == 1
+
+    def test_decision_ring_is_bounded(self):
+        scaler, gw, tel, prov, clock = _mk(
+            2, config=_cfg(decision_ring=4, up_cooldown_s=0.001,
+                           max_actions_per_window=1000,
+                           actions_window_s=1.0))
+        tel.slo = _slo(ttft=(1.5, 1.2))
+        _tick(scaler, clock, n=20)
+        assert len(scaler.debug()["decisions"]) <= 4
+
+
+class TestEnvContract:
+    def test_inert_by_default(self, monkeypatch):
+        monkeypatch.delenv("KUBEFLOW_TPU_AUTOSCALE_ENABLE", raising=False)
+        assert autoscaler_from_env() is None
+
+    def test_enable_with_overrides(self, monkeypatch):
+        monkeypatch.setenv("KUBEFLOW_TPU_AUTOSCALE_ENABLE", "1")
+        monkeypatch.setenv("KUBEFLOW_TPU_AUTOSCALE_MAX_REPLICAS", "8")
+        monkeypatch.setenv("KUBEFLOW_TPU_AUTOSCALE_UP_COOLDOWN_S", "12.5")
+        monkeypatch.setenv("KUBEFLOW_TPU_AUTOSCALE_STALE_AFTER_S", "3")
+        cfg = autoscaler_from_env()
+        assert cfg is not None
+        assert cfg.max_replicas == 8
+        assert cfg.up_cooldown_s == 12.5
+        assert cfg.stale_after_s == 3.0
+
+    @pytest.mark.parametrize("name,value", [
+        ("KUBEFLOW_TPU_AUTOSCALE_ENABLE", "maybe"),
+        ("KUBEFLOW_TPU_AUTOSCALE_MAX_REPLICAS", "zero"),
+        ("KUBEFLOW_TPU_AUTOSCALE_MAX_REPLICAS", "0"),
+        ("KUBEFLOW_TPU_AUTOSCALE_DRAIN_BUDGET_S", "-5"),
+    ])
+    def test_garbage_fails_fast(self, monkeypatch, name, value):
+        monkeypatch.setenv("KUBEFLOW_TPU_AUTOSCALE_ENABLE", "1")
+        monkeypatch.setenv(name, value)
+        with pytest.raises(ValueError, match="KUBEFLOW_TPU_AUTOSCALE"):
+            autoscaler_from_env()
+
+
+class TestProvisionerDrainedProbe:
+    def test_unreachable_replica_counts_as_drained(self):
+        prov = WarmSliceProvisioner(object(), probe_timeout_s=0.2)
+        assert prov.drained("127.0.0.1:1") is True  # nothing listens
+
+    def test_hooks_take_precedence(self):
+        seen = []
+        prov = WarmSliceProvisioner(
+            object(), drain_fn=seen.append,
+            drained_fn=lambda ep: False, release_fn=seen.append,
+        )
+        prov.drain("a:1")
+        assert prov.drained("a:1") is False
+        prov.release("a:1")
+        assert seen == ["a:1", "a:1"]
+
+
+class TestRealFleetIntegration:
+    def test_scale_down_never_drops_a_stream(self):
+        """Organic ebb over a REAL 2-replica InferenceServer fleet: the
+        autoscaler drains one replica while streams are in flight; every
+        stream ends in [DONE] with its full token count, nothing is shed
+        or failed, and the slice is released only once the drained
+        server emptied (HTTP-poll drained probe)."""
+        import jax
+
+        from kubeflow_tpu.models import llama as L
+        from kubeflow_tpu.models.gateway import ServingGateway
+        from kubeflow_tpu.models.paged import PagedBatcher
+        from kubeflow_tpu.models.server import InferenceServer
+        from kubeflow_tpu.models.serving import GenerationConfig
+        from kubeflow_tpu.observability.signals import (
+            FleetTelemetry,
+            SignalsConfig,
+        )
+        from kubeflow_tpu.observability.slo import default_objectives
+
+        cfg = L.LLAMA_CONFIGS["tiny"]
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = 12
+        servers = [
+            InferenceServer(
+                PagedBatcher(
+                    params, cfg,
+                    gen=GenerationConfig(max_new_tokens=tokens, eos_id=-1),
+                    slots=8, num_blocks=128, block_size=16,
+                    prompt_bucket=64,
+                ),
+                port=0, drain_s=60.0,
+            ).start()
+            for _ in range(2)
+        ]
+        by_ep = {f"{s.host}:{s.port}": s for s in servers}
+        released: list = []
+
+        def drain_fn(ep):
+            # A real teardown is SIGTERM → the server's own graceful
+            # drain; in-process that is stop(), which blocks until the
+            # in-flight work finishes — so off-thread.
+            threading.Thread(target=by_ep[ep].stop, daemon=True).start()
+
+        # Unreachable thresholds: burns stay 0, so the only pressure the
+        # loop can see is ebb — exactly the scale-down-mid-stream case.
+        telemetry = FleetTelemetry(
+            SignalsConfig(window_s=0.5, windows=60),
+            objectives=default_objectives(
+                ttft_p95_s=1000.0, inter_token_p95_s=1000.0,
+                queue_wait_p95_s=1000.0,
+            ),
+        )
+        gw = ServingGateway(
+            sorted(by_ep), port=0, block_size=16, health_interval_s=0.1,
+            telemetry=telemetry,
+            autoscaler_config=AutoscalerConfig(
+                min_replicas=1, max_replicas=2, down_consecutive=2,
+                down_cooldown_s=0.2, up_cooldown_s=0.2,
+                max_actions_per_window=8, actions_window_s=30.0,
+                drain_budget_s=60.0, stale_after_s=5.0,
+                low_batch_fill=0.94, high_batch_fill=0.95,
+            ),
+        )
+        gw.autoscaler.provisioner = WarmSliceProvisioner(
+            gw, drain_fn=drain_fn, release_fn=released.append,
+        )
+        gw.start()
+        streams = 6
+        collected: list = [[] for _ in range(streams)]
+
+        def reader(i):
+            conn = http.client.HTTPConnection(gw.host, gw.port,
+                                              timeout=120.0)
+            try:
+                conn.request(
+                    "POST", "/v1/completions",
+                    json.dumps({
+                        "prompt": list(range(5 * i + 3, 5 * i + 19)),
+                        "stream": True, "max_tokens": tokens,
+                    }).encode(),
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                while True:
+                    line = resp.fp.readline()
+                    if not line:
+                        break
+                    if line.startswith(b"data:"):
+                        collected[i].append(line)
+                    if line == b"data: [DONE]\n":
+                        break
+            finally:
+                conn.close()
+
+        try:
+            threads = [
+                threading.Thread(target=reader, args=(i,), daemon=True)
+                for i in range(streams)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180.0)
+            assert not any(t.is_alive() for t in threads)
+            # Every stream complete: full token count then [DONE].
+            for i, lines in enumerate(collected):
+                assert lines and lines[-1] == b"data: [DONE]\n", i
+                assert not any(b'"error"' in ln for ln in lines), i
+                assert len(lines) >= tokens, i
+            # The ebb decision landed and the drain ran to release.
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                scaler = gw.stats()["autoscaler"]
+                if released and not scaler["draining"]:
+                    break
+                time.sleep(0.05)
+            scaler = gw.stats()["autoscaler"]
+            assert scaler["scale_downs"] >= 1
+            assert len(released) >= 1
+            assert not scaler["draining"]
+            assert released[0] not in gw.replica_endpoints()
+            stats = gw.stats()
+            assert stats["shed"] == 0
+            assert stats["failed"] == 0
+        finally:
+            gw.stop()
+            for s in servers:
+                try:
+                    s.stop()
+                except Exception:
+                    pass

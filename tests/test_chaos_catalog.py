@@ -57,6 +57,9 @@ def test_catalog_has_reference_parity_experiments():
         # handoff re-routes within budget, never silent truncation, and
         # the decode tier stays healthy.
         "serving-kv-handoff-loss",
+        # Fleet autoscaler (models/autoscaler.py): scale-down under
+        # stream churn — drain before release, never kill a stream.
+        "autoscaler-scaledown-storm",
     }
 
 

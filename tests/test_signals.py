@@ -623,6 +623,54 @@ class TestFleetTelemetry:
         assert set(snap["tenants"]) == {"t1", TENANT_OTHER}
         assert snap["tenants"][TENANT_OTHER]["requests"] == 2.0
 
+    def test_scrape_ages_track_fresh_ingests_only(self):
+        """The autoscaler's staleness freeze reads these: the age must
+        grow from the last FRESH ingest — a failed scrape (None) must
+        not refresh it and mask a wedged /stats endpoint."""
+        tel, clock = _telemetry()
+        tel.ingest_replica("ep1", {"served": 1})
+        clock.t += 4.0
+        tel.ingest_replica("ep2", {"served": 1})
+        assert tel.scrape_ages() == {"ep1": 4.0, "ep2": 0.0}
+        clock.t += 2.0
+        tel.ingest_replica("ep1", None)  # failed scrape: age keeps aging
+        assert tel.scrape_ages()["ep1"] == 6.0
+        snap = tel.snapshot()
+        assert snap["fleet"]["last_scrape_age_s"] == {
+            "ep1": 6.0, "ep2": 2.0,
+        }
+
+    def test_forget_replica_drops_age_and_counter_base(self):
+        tel, clock = _telemetry()
+        tel.ingest_replica("ep1", {"served": 50})
+        tel.ingest_replica("ep1", {"served": 60})
+        assert tel.hub.counter_total("fleet_served") == 10.0
+        tel.forget_replica("ep1")
+        assert tel.scrape_ages() == {}
+        # Re-added after removal: first sight is base-only again, so a
+        # departed replica's history is never double counted.
+        tel.ingest_replica("ep1", {"served": 100})
+        assert tel.hub.counter_total("fleet_served") == 10.0
+        tel.forget_replica("ep-never-seen")  # idempotent
+
+    def test_autoscale_actions_are_windowed_in_the_snapshot(self):
+        tel, clock = _telemetry()
+        tel.observe_autoscale("up")
+        tel.observe_autoscale("hold")
+        tel.observe_autoscale("hold")
+        with pytest.raises(ValueError):
+            tel.observe_autoscale("explode")
+        snap = tel.snapshot(over_s=60.0)
+        fleet = snap["fleet"]
+        assert fleet["autoscale_up_per_s"] == pytest.approx(
+            1.0 / 60.0, abs=1e-6
+        )
+        assert fleet["autoscale_hold_per_s"] == pytest.approx(
+            2.0 / 60.0, abs=1e-6
+        )
+        assert fleet["autoscale_down_per_s"] == 0.0
+        assert fleet["autoscale_freeze_per_s"] == 0.0
+
 
 # -- stall -> profile capture hook -------------------------------------------
 
