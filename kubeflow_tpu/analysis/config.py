@@ -87,12 +87,13 @@ PROM_CONSTRUCTORS = {"Counter", "Gauge", "Histogram", "Summary"}
 
 # -- metric/stats parity (rule metric-stats-parity) --------------------------
 
-# Serving, engine, gateway, and autoscaler metric families must stay
-# visible in the servers' JSON /stats payload; the STATS_PARITY table in
-# metrics/metrics.py maps each family to the /stats key that surfaces it
-# (gateway/autoscaler families surface under the gateway's own /stats).
+# Serving, engine, gateway, autoscaler, and migration metric families
+# must stay visible in the servers' JSON /stats payload; the STATS_PARITY
+# table in metrics/metrics.py maps each family to the /stats key that
+# surfaces it (gateway/autoscaler families surface under the gateway's
+# own /stats; migration families under the orchestrator's stats block).
 STATS_PARITY_FAMILY_RE = re.compile(
-    r"^tpu_(serving|engine|gateway|autoscaler)_[a-z0-9_]+$"
+    r"^tpu_(serving|engine|gateway|autoscaler|migration)_[a-z0-9_]+$"
 )
 
 # Where /stats payloads are assembled: every STATS_PARITY value must
@@ -101,4 +102,5 @@ STATS_SURFACE_MODULES = (
     "kubeflow_tpu/models/server.py",
     "kubeflow_tpu/models/gateway.py",
     "kubeflow_tpu/models/autoscaler.py",
+    "kubeflow_tpu/runtime/migration.py",
 )

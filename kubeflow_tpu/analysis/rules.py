@@ -886,6 +886,58 @@ class ChaosParity(Rule):
         return findings
 
 
+class UndeadlinedClaim(Rule):
+    id = "undeadlined-claim"
+    description = (
+        "Warm-slice claim (claim_warm_slice) without deadline=, or a "
+        "cross-slice HTTP connection (http.client.HTTP[S]Connection) "
+        "without timeout=. Both sit on migration/recovery paths where an "
+        "unbounded wait wedges the very pipeline that exists to beat a "
+        "deadline: the fenced claim walk can loop while concurrent "
+        "claimants steal every candidate, and a flip/restore probe can "
+        "hang on a half-dead slice. Migration degrades to the reactive "
+        "ladder on a blown budget — but only if every wait is bounded."
+    )
+
+    _HTTP_CONSTRUCTORS = ("HTTPConnection", "HTTPSConnection")
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        findings = []
+        for node in mod.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            callee = resolved_callee(mod, node)
+            if callee is None:
+                continue
+            leaf = callee.split(".")[-1]
+            if leaf == "claim_warm_slice":
+                # The definition site itself is not a call; every actual
+                # call must carry an explicit bound on the candidate walk.
+                if "deadline" not in _kwarg_names(node):
+                    findings.append(
+                        self.finding(
+                            mod, node,
+                            "claim_warm_slice without deadline=: the "
+                            "fenced candidate walk is unbounded under "
+                            "claim contention; pass deadline="
+                            "time.perf_counter() + budget so the caller "
+                            "falls back instead of wedging",
+                        )
+                    )
+            elif leaf in self._HTTP_CONSTRUCTORS:
+                if "timeout" not in _kwarg_names(node):
+                    findings.append(
+                        self.finding(
+                            mod, node,
+                            f"{leaf} without timeout=: a cross-slice "
+                            "HTTP call on a recovery/migration path can "
+                            "hang on a half-dead host; every connection "
+                            "needs an explicit timeout",
+                        )
+                    )
+        return findings
+
+
 class SuppressionHygiene(Rule):
     id = "suppression-hygiene"
     description = (
@@ -940,6 +992,7 @@ ALL_RULES = [
     SpanUnended(),
     AnnotationLiteral(),
     ChaosParity(),
+    UndeadlinedClaim(),
     SuppressionHygiene(),
 ]
 

@@ -61,6 +61,13 @@ TPU_RECOVERY_LAST_ESCALATION = "notebooks.kubeflow.org/tpu-recovery-last-escalat
 TPU_LAST_INTERRUPTION_DURATION = (
     "notebooks.kubeflow.org/tpu-last-interruption-duration"
 )
+# Operator-set migration trigger (runtime/migration.py): stamping any value
+# asks the controller to run one proactive live migration (save → warm-claim
+# → restore → flip) for this Notebook's slice. The controller clears the
+# annotation when it picks the trigger up, so the observed value doubles as
+# a "migration requested but not yet started" marker. Controller-owned once
+# consumed; never copied to pod templates.
+TPU_MIGRATE_NOW = "notebooks.kubeflow.org/tpu-migrate-now"
 # Event re-emission cursor: resourceVersion of the newest namespace Event
 # already surfaced onto this Notebook (one read per reconcile, zero writes
 # to Event objects, restart-safe because it lives on the Notebook).

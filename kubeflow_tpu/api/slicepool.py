@@ -38,6 +38,15 @@ STATE_WARM = "warm"
 # Annotation recorded on the Notebook when its slice came from a pool.
 CLAIMED_FROM = "notebooks.kubeflow.org/claimed-from-pool"
 
+# Claim fence stamped ON THE PLACEHOLDER StatefulSet by the claim path,
+# immediately before the delete, via an optimistic-concurrency update: the
+# listed resourceVersion rides the write, so of two claimants racing one
+# placeholder exactly one fence lands — the loser gets a Conflict and moves
+# to the next candidate (controller.slicepool.ClaimLost). Without it the
+# delete itself is check-then-act and both racers can believe they claimed
+# the same slice.
+CLAIMED_BY = "slicepools.kubeflow.org/claimed-by"
+
 # Demand signals stamped ON THE POOL by the notebook reconciler's claim
 # path (autoscaled pools only); the autoscaler keys off them. LAST_* are
 # unix seconds (idle detection); MISS_COUNT is a monotonic counter so N

@@ -217,6 +217,7 @@ class CullingReconciler(Reconciler):
         metrics: Optional[Metrics] = None,
         recorder: Optional[EventRecorder] = None,
         clock: Optional[Callable[[], float]] = None,
+        migration_trigger: Optional[Callable[[dict, str], None]] = None,
     ):
         self.client = client
         self.config = config or CullerConfig(enable_culling=True)
@@ -224,6 +225,12 @@ class CullingReconciler(Reconciler):
         self.metrics = metrics or Metrics(client)
         self.recorder = recorder or EventRecorder(client, component="culler")
         self.clock = clock or time.time
+        # Optional hook into runtime/migration.py: called with (notebook
+        # object, "idle-cull") just before the stop annotation lands, so
+        # an emergency save can start while the slice still exists. The
+        # cull itself proceeds regardless — migration is an optimization,
+        # never a gate on reclaiming idle chips.
+        self.migration_trigger = migration_trigger
 
     def register(self, manager: Manager) -> None:
         manager.register(self, for_kind="Notebook", name="Culler")
@@ -370,6 +377,16 @@ class CullingReconciler(Reconciler):
                 chips = nb.tpu.slice_topology().chips
             except Exception:
                 chips = 0
+
+        if self.migration_trigger is not None:
+            # Fire BEFORE the stop annotation: the save step needs the
+            # slice pods alive. A hook crash must not block the cull.
+            try:
+                self.migration_trigger(nb.obj, "idle-cull")
+            except Exception:
+                log.exception(
+                    "migration trigger (idle-cull) raised; culling anyway"
+                )
 
         def write():
             fresh = self.client.get("Notebook", nb.name, nb.namespace)

@@ -447,6 +447,11 @@ class NotebookReconciler(Reconciler):
         pool = claim_warm_slice(
             self.client, nb.namespace, topo, recorder=self.recorder,
             notebook=obj, now=self.clock(), pools=pools,
+            # Bound the fenced candidate walk: this runs inside the
+            # single-threaded reconcile loop, and a claim stampede must
+            # cost one scale-up its placeholder, not wedge every queued
+            # reconcile behind the walk.
+            deadline=time.perf_counter() + 5.0,
         )
         if not pool:
             self.metrics.pool_claim_misses_total.inc()

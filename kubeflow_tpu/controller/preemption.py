@@ -82,6 +82,10 @@ class RecoveryConfig:
     # Requeue period once terminal: still level-triggered (capacity coming
     # back recovers the slice), but no longer burning API calls.
     terminal_requeue_s: float = 1800.0
+    # Bound on a single warm-pool claim walk during escalation: the ladder
+    # must keep moving (to STS recreate) even if the pool listing is slow
+    # or every candidate is being fenced away by concurrent claimants.
+    claim_deadline_s: float = 5.0
 
     @classmethod
     def from_env(cls, env: dict) -> "RecoveryConfig":
@@ -92,6 +96,9 @@ class RecoveryConfig:
             max_escalations=int(env.get("SLICE_RECOVERY_MAX_ESCALATIONS", "2")),
             terminal_requeue_s=float(
                 env.get("SLICE_RECOVERY_TERMINAL_REQUEUE_SECONDS", "1800")
+            ),
+            claim_deadline_s=float(
+                env.get("SLICE_RECOVERY_CLAIM_DEADLINE_SECONDS", "5")
             ),
         )
 
@@ -137,12 +144,20 @@ class SliceHealthReconciler(Reconciler):
         recorder: Optional[EventRecorder] = None,
         clock: Optional[Callable[[], float]] = None,
         config: Optional[RecoveryConfig] = None,
+        migration_trigger: Optional[Callable[[dict, str], None]] = None,
     ):
         self.client = client
         self.metrics = metrics or Metrics(client)
         self.recorder = recorder or EventRecorder(client, component="slice-health")
         self.clock = clock or time.time
         self.config = config or RecoveryConfig()
+        # Optional hook into runtime/migration.py: called with (notebook
+        # object, trigger name) when a preemption notice lands or the
+        # operator stamps tpu-migrate-now. Fire-and-notify — the reactive
+        # ladder below proceeds regardless, so a migration that fails (or
+        # a hook that raises) costs nothing the ladder wasn't already
+        # going to pay. None (the default) keeps recovery purely reactive.
+        self.migration_trigger = migration_trigger
 
     def register(self, manager: Manager) -> None:
         manager.register(
@@ -179,6 +194,13 @@ class SliceHealthReconciler(Reconciler):
             return Result()
 
         now = self.clock()
+        if ann.TPU_MIGRATE_NOW in nb.annotations:
+            # Operator-requested migration: consume the annotation first
+            # (clearing it marks the trigger picked up, and makes a retry
+            # an explicit re-stamp rather than an accidental loop), then
+            # fire the hook.
+            self._consume_migrate_annotation(nb)
+            self._fire_migration(obj, "operator")
         pods = self.client.list(
             "Pod", nb.namespace, {ann.NOTEBOOK_NAME_LABEL: nb.name}
         )
@@ -203,6 +225,11 @@ class SliceHealthReconciler(Reconciler):
             tracing.current_span().add_event("slice_interrupted", {
                 "reason": failed[0][1], "pods_lost": len(failed),
             })
+            # Proactive path first (save → warm-claim → restore → flip),
+            # but the reactive poll below is scheduled unconditionally:
+            # a migration that falls back leaves the ladder mid-stride,
+            # exactly where it would have been without the attempt.
+            self._fire_migration(obj, "preemption-notice")
             # Recovery is now OURS to drive: poll on a timer instead of
             # hoping replacement-pod events keep arriving.
             return Result(requeue_after=self.config.poll_initial_s)
@@ -221,6 +248,32 @@ class SliceHealthReconciler(Reconciler):
             self._complete_recovery(nb, obj, hosts, now)
             return Result()
         return self._poll_or_escalate(nb, obj, ready, hosts, now)
+
+    # -- proactive migration hand-off --------------------------------------
+
+    def _fire_migration(self, obj: dict, trigger: str) -> None:
+        if self.migration_trigger is None:
+            return
+        try:
+            self.migration_trigger(obj, trigger)
+        except Exception:
+            # Migration is an optimization, never a new failure mode: a
+            # hook crash must not take the reactive reconcile down with it.
+            log.exception(
+                "migration trigger (%s) raised; reactive recovery continues",
+                trigger,
+            )
+
+    def _consume_migrate_annotation(self, nb: Notebook) -> None:
+        def write():
+            try:
+                fresh = self.client.get("Notebook", nb.name, nb.namespace)
+            except NotFoundError:
+                return
+            if obj_util.remove_annotation(fresh, ann.TPU_MIGRATE_NOW):
+                self.client.update(fresh)
+
+        retry_on_conflict(write)
 
     # -- interruption lifecycle --------------------------------------------
 
@@ -312,6 +365,8 @@ class SliceHealthReconciler(Reconciler):
         pool = claim_warm_slice(
             self.client, nb.namespace, topo,
             recorder=self.recorder, notebook=obj, now=now,
+            claimant=f"recovery-{nb.namespace}-{nb.name}",
+            deadline=time.perf_counter() + self.config.claim_deadline_s,
         )
         if pool is not None:
             # claim_warm_slice already emitted ClaimedWarmSlice; deleting the

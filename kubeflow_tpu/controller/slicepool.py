@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import copy
 import logging
+import secrets
 import time
 from typing import Callable, Optional
 
@@ -29,7 +30,11 @@ from kubeflow_tpu.api.names import derived_name
 from kubeflow_tpu.api.notebook import MAX_NAME_LENGTH
 from kubeflow_tpu.k8s import objects as obj_util
 from kubeflow_tpu.k8s.client import Client, retry_on_conflict
-from kubeflow_tpu.k8s.errors import AlreadyExistsError, NotFoundError
+from kubeflow_tpu.k8s.errors import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
 from kubeflow_tpu.k8s.events import EventRecorder
 from kubeflow_tpu.k8s.manager import Manager, Reconciler, Request, Result
 from kubeflow_tpu.metrics import Metrics
@@ -115,6 +120,47 @@ def _sts_ready(sts: dict) -> bool:
     return want > 0 and status.get("readyReplicas", 0) >= want
 
 
+class ClaimLost(Exception):
+    """Another claimant won this placeholder between our read and our
+    write. Raised per candidate by the fenced claim; ``claim_warm_slice``
+    catches it and walks on to the next candidate, so two racing claimants
+    end up on DISTINCT slices (or one takes a clean miss) — never both
+    holding the same one."""
+
+
+def _claim_candidate(client: Client, chosen: dict, claimant: str) -> None:
+    """Atomically take ownership of one placeholder, then delete it.
+
+    The fence is an optimistic-concurrency update: we re-read the
+    StatefulSet, reject it if another claimant's CLAIMED_BY fence is
+    already on it, stamp our own, and write it back carrying the read's
+    resourceVersion. The apiserver's conflict check makes that write the
+    atomic claim — a bare delete is check-then-act, and two in-flight
+    claimants (an autoscaler tick and a migration, say) can both "win" it.
+    Raises ClaimLost when anyone else got there first at any point.
+    """
+    name = obj_util.name_of(chosen)
+    namespace = obj_util.namespace_of(chosen)
+    try:
+        fresh = client.get("StatefulSet", name, namespace)
+    except NotFoundError as err:
+        raise ClaimLost(f"{name}: placeholder already deleted") from err
+    owner = obj_util.annotations_of(fresh).get(sp.CLAIMED_BY)
+    if owner and owner != claimant:
+        raise ClaimLost(f"{name}: fenced by {owner}")
+    obj_util.set_annotation(fresh, sp.CLAIMED_BY, claimant)
+    try:
+        client.update(fresh)
+    except (ConflictError, NotFoundError) as err:
+        raise ClaimLost(f"{name}: fence write lost ({err})") from err
+    try:
+        client.delete("StatefulSet", name, namespace)
+    except NotFoundError as err:
+        # Deleted despite a won fence (e.g. an out-of-band GC): the slice
+        # is gone either way — surface it as a lost claim, not a success.
+        raise ClaimLost(f"{name}: deleted after fence") from err
+
+
 def claim_warm_slice(
     client: Client,
     namespace: str,
@@ -124,6 +170,7 @@ def claim_warm_slice(
     now: Optional[float] = None,
     pools: Optional[list] = None,
     deadline: Optional[float] = None,
+    claimant: Optional[str] = None,
 ) -> Optional[str]:
     """Claim one warm placeholder matching (accelerator, topology).
 
@@ -132,6 +179,12 @@ def claim_warm_slice(
     falls back to a still-warming one — even a partially-provisioned
     placeholder beats a cold node-pool scale-up. Deleting the StatefulSet
     cascades to its pods, releasing chips for the notebook's pods.
+
+    Each candidate is taken through the CLAIMED_BY fence (see
+    ``_claim_candidate``): concurrent claimants — recovery escalation, a
+    migration, the fleet autoscaler — conflict-retry onto distinct slices
+    instead of double-claiming one. ``claimant`` names this claim in the
+    fence annotation; a fresh random identity is minted when omitted.
 
     ``deadline`` (a ``time.perf_counter()`` instant) bounds the candidate
     walk: a fleet-wide delete-race pileup or a crawling apiserver turns
@@ -154,20 +207,20 @@ def claim_warm_slice(
             sp.TOPOLOGY_LABEL: topo.topology_str,
         },
     )
-    # Ready placeholders first, then still-warming ones; on a lost delete
-    # race (a concurrent claim got there first) fall through to the next
-    # candidate instead of going cold while warm capacity remains.
+    # Ready placeholders first, then still-warming ones; on a lost claim
+    # race (a concurrent claimant's fence or delete got there first) fall
+    # through to the next candidate instead of going cold while warm
+    # capacity remains.
     ordered = sorted(candidates, key=lambda s: not _sts_ready(s))
+    claimant = claimant or f"claim-{secrets.token_hex(4)}"
     for chosen in ordered:
         if deadline is not None and time.perf_counter() >= deadline:
             return None  # bounded claim: a timed-out walk is a miss
         pool_name = obj_util.labels_of(chosen).get(sp.POOL_LABEL, "")
         try:
-            client.delete(
-                "StatefulSet", obj_util.name_of(chosen),
-                obj_util.namespace_of(chosen),
-            )
-        except NotFoundError:
+            _claim_candidate(client, chosen, claimant)
+        except ClaimLost as lost:
+            log.info("warm-slice claim by %s moved on: %s", claimant, lost)
             continue
         if recorder is not None and notebook is not None:
             recorder.eventf(

@@ -88,6 +88,16 @@ INJECTION_TYPES = (
     # and zero tenants shed — killing an active stream or shedding an
     # under-share tenant is the outcome scale-down exists to forbid.
     "autoscaler-scaledown-storm",
+    # Live slice migration (runtime/migration.py): repeated preemption
+    # notices against a live tiny trainer, each driving the full save →
+    # warm-claim → restore → flip pipeline. Training throughput may dip
+    # during a migration but must never zero, every migration must resume
+    # token/loss-exact (the checkpoint experiments' zero-divergence
+    # assertion), the old slice must release drain-style only after the
+    # flip, and each migration must read as ONE complete trace with a
+    # span per step — a migration that hangs, loses work, or silently
+    # degrades is the outcome the budgeted pipeline exists to forbid.
+    "migration-storm",
 )
 STEADY_STATE_CHECKS = (
     "sliceReady", "notCulled", "notebookCreatable", "warmPoolReady",
@@ -115,6 +125,10 @@ STEADY_STATE_CHECKS = (
     # Autoscaler scale-down: every in-flight stream on a draining
     # replica ran to [DONE] and its slice was released only afterwards.
     "streamsDrained",
+    # Live migration: every triggered migration completed all four
+    # budgeted steps as one trace, training resumed loss-exact on the
+    # new slice, and the old slice drained only after the flip.
+    "migrationComplete",
 )
 # Injection ↔ target coherence: a doc must declare the kind its handler
 # actually exercises, or a "pass" certifies a hypothesis that never ran.
@@ -137,6 +151,7 @@ TARGET_KIND_FOR_INJECTION = {
     "gateway-replica-kill": "ServingGateway",
     "serving-kv-handoff-loss": "ServingGateway",
     "autoscaler-scaledown-storm": "ServingGateway",
+    "migration-storm": "MigrationOrchestrator",
 }
 
 
@@ -666,6 +681,7 @@ class ExperimentRunner:
             "serving-kv-handoff-loss": self._run_serving_kv_handoff_loss,
             "autoscaler-scaledown-storm":
                 self._run_autoscaler_scaledown_storm,
+            "migration-storm": self._run_migration_storm,
         }
 
     def run(self, doc: dict) -> ExperimentResult:
@@ -2071,3 +2087,186 @@ class ExperimentRunner:
             gw.stop()
             for r in replicas:
                 r.stop()
+
+    # -- live migration handler --------------------------------------------
+
+    def _run_migration_storm(self, doc: dict) -> ExperimentResult:
+        """Repeated preemption notices against a LIVE tiny trainer, each
+        one driving a full proactive migration (runtime/migration.py):
+        emergency-save -> warm-slice claim -> restore -> routing flip.
+        Throughput may dip between segments but never zeroes; every
+        migration must resume token/loss-exact against the uninterrupted
+        reference curve (same zero-divergence oracle as the checkpoint
+        experiments); and each migration must leave ONE complete
+        ``migration`` trace with a child span per pipeline step."""
+        import shutil
+        import tempfile
+
+        from kubeflow_tpu.observability import tracing
+        from kubeflow_tpu.runtime import checkpoint as ck
+        from kubeflow_tpu.runtime.migration import (
+            MIGRATION_STEPS,
+            MigrationConfig,
+            MigrationOrchestrator,
+        )
+
+        params = doc["spec"]["injection"].get("params", {})
+        migrations = int(params.get("migrations", 2))
+        steps_between = int(params.get("stepsBetween", 1))
+
+        step_fn, fresh_state, batches = self.training_factory()
+        # Uninterrupted reference run: the zero-divergence oracle every
+        # post-migration segment is held to, batch index by batch index.
+        _, ref_losses = self._losses(step_fn, fresh_state(0), batches)
+
+        workdir = Path(tempfile.mkdtemp(prefix="chaos-migration-storm-"))
+        exporter = tracing.InMemoryExporter()
+        tracing.set_tracer_provider(tracing.TracerProvider(exporter=exporter))
+        try:
+            # The "live trainer": cursor counts batches consumed; every
+            # step commits synchronously with the start_batch cursor in
+            # metadata (the train_with_checkpointing convention), so an
+            # emergency save always has a fresh commit to skip to.
+            live = {
+                "mgr": ck.CheckpointManager(workdir, max_to_keep=10),
+                "state": fresh_state(0),
+                "cursor": 0,
+            }
+            trained: list = []  # (batch index, float loss)
+
+            def train(n_steps: int) -> int:
+                done = 0
+                while done < n_steps and live["cursor"] < len(batches):
+                    i = live["cursor"]
+                    live["state"], loss = step_fn(live["state"], batches[i])
+                    live["cursor"] = i + 1
+                    live["mgr"].save(
+                        live["cursor"], live["state"],
+                        metadata={"start_batch": live["cursor"]},
+                    )
+                    trained.append((i, float(loss)))
+                    done += 1
+                return done
+
+            class _LiveCheckpoint:
+                """The orchestrator holds ONE checkpoint handle, but the
+                live manager changes identity on every restore (each
+                restore is a new 'process'); delegate per call."""
+
+                @staticmethod
+                def last_commit_age():
+                    return live["mgr"].last_commit_age()
+
+                @staticmethod
+                def latest_step():
+                    return live["mgr"].latest_step()
+
+                @staticmethod
+                def emergency_save(grace_s=None):
+                    return live["mgr"].emergency_save(grace_s=grace_s)
+
+            warm = [f"warm-{i}" for i in range(migrations)]
+            claimed: list = []
+            routing = {"active": "slice-0", "drained": []}
+
+            def claim_fn(claimant, deadline):
+                if not warm:
+                    return None
+                pool = warm.pop(0)
+                claimed.append((claimant, pool))
+                return pool
+
+            def restore_fn(deadline):
+                # A fresh manager on the warm slice ("new process"),
+                # restoring into a DIFFERENT init (key 7): matching
+                # losses afterwards can only come from checkpoint bytes.
+                mgr2 = ck.CheckpointManager(workdir, max_to_keep=10)
+                restored, at = mgr2.restore_latest(fresh_state(7))
+                if at is None:
+                    return None
+                live["mgr"] = mgr2
+                live["state"] = restored
+                live["cursor"] = ck.resume_start_batch(mgr2, at)
+                return {"step": at, "start_batch": live["cursor"]}
+
+            def flip_fn(deadline):
+                if not claimed:
+                    return False
+                routing["drained"].append(routing["active"])
+                routing["active"] = claimed[-1][1]
+                return True
+
+            fallbacks: list = []
+            orch = MigrationOrchestrator(
+                # fresh_within_s=0 so every migration exercises the real
+                # emergency-save path (its internal skip-if-fresh still
+                # applies when the last step already committed).
+                MigrationConfig(fresh_within_s=0.0),
+                checkpoint=_LiveCheckpoint(),
+                claim_fn=claim_fn,
+                restore_fn=restore_fn,
+                flip_fn=flip_fn,
+                fallback_fn=lambda step, reason: fallbacks.append(
+                    (step, reason)),
+            )
+
+            reports = []
+            segments = []
+            for _ in range(migrations):
+                segments.append(train(steps_between))
+                reports.append(orch.migrate("preemption-notice"))
+            # Final segment drains the remaining batches on the last
+            # warm slice — proof the flip left a trainable replica.
+            segments.append(train(len(batches) - live["cursor"]))
+
+            roots = exporter.by_name("migration")
+            want_children = sorted(f"migration.{s}" for s in MIGRATION_STEPS)
+            complete_traces = sum(
+                root.attributes.get("completed") is True
+                and sorted(
+                    s.name for s in exporter.spans
+                    if s.parent_id == root.span_id
+                ) == want_children
+                for root in roots
+            )
+
+            exact = all(loss == ref_losses[i] for i, loss in trained)
+            throughput_ok = (
+                all(s >= 1 for s in segments)
+                and live["cursor"] == len(batches)
+            )
+            stats = orch.stats()
+            passed = (
+                all(r.completed for r in reports)
+                and not fallbacks
+                and exact
+                and throughput_ok
+                and complete_traces == len(roots) == migrations
+                and len(claimed) == migrations and not warm
+                and routing["active"] == f"warm-{migrations - 1}"
+                and stats["migrations_completed"] == migrations
+                and stats["migrations_fell_back"] == 0
+            )
+            return ExperimentResult(
+                doc["metadata"]["name"],
+                passed=passed,
+                detail="" if passed else (
+                    f"completed={[r.completed for r in reports]} "
+                    f"fallbacks={fallbacks} exact={exact} "
+                    f"segments={segments} cursor={live['cursor']}/"
+                    f"{len(batches)} traces={complete_traces}/{len(roots)} "
+                    f"(want {migrations}) claimed={claimed} "
+                    f"routing={routing} stats={stats}"
+                ),
+                observations={
+                    "migrations": migrations,
+                    "segments": segments,
+                    "restored_steps": [r.restored_step for r in reports],
+                    "trained_losses": [loss for _, loss in trained],
+                    "complete_traces": complete_traces,
+                    "active_replica": routing["active"],
+                },
+            )
+        finally:
+            tracing.set_tracer_provider(tracing.TracerProvider())
+            shutil.rmtree(workdir, ignore_errors=True)

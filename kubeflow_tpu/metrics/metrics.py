@@ -68,6 +68,10 @@ STATS_PARITY = {
     "tpu_autoscaler_claim_failures_total": "claim_failures",
     "tpu_autoscaler_claim_latency_seconds": "claim_latency_s",
     "tpu_autoscaler_replicas": "tier_replicas",
+    "tpu_migration_started_total": "migrations_started",
+    "tpu_migration_completed_total": "migrations_completed",
+    "tpu_migration_fallback_total": "migrations_fell_back",
+    "tpu_migration_seconds": "migration_last_s",
 }
 
 
@@ -408,6 +412,31 @@ class Metrics:
             "In-ring replicas per serving tier as the autoscaler last "
             "counted them",
             ["tier"],
+            registry=self.registry,
+        )
+        # -- live slice migration (runtime/migration.py) -------------------
+        self.migration_started_total = Counter(
+            "tpu_migration_started_total",
+            "Proactive migrations started (preemption notice, idle-cull, "
+            "or operator trigger)",
+            registry=self.registry,
+        )
+        self.migration_completed_total = Counter(
+            "tpu_migration_completed_total",
+            "Migrations that completed all four steps (save, claim, "
+            "restore, flip) within their budgets",
+            registry=self.registry,
+        )
+        self.migration_fallback_total = Counter(
+            "tpu_migration_fallback_total",
+            "Migrations that blew a step budget or hit a step failure and "
+            "degraded to the reactive recovery ladder",
+            registry=self.registry,
+        )
+        self.migration_seconds = Gauge(
+            "tpu_migration_seconds",
+            "Wall-clock duration of the most recent migration attempt "
+            "(completed or fallen back)",
             registry=self.registry,
         )
         # -- SLO burn-rate engine (observability/slo.py) -------------------

@@ -567,7 +567,19 @@ class FleetAutoscaler:
             return ((s.get("active_slots") or 0) + (s.get("queued") or 0),
                     ep)
 
-        victim = min(in_ring, key=_load)
+        # A live migration restoring onto a replica pins it: draining it
+        # now would release the very slice the migration is landing on.
+        pinned_fn = getattr(self.gateway, "migration_pinned", None)
+        pinned = pinned_fn() if pinned_fn is not None else frozenset()
+        eligible = [ep for ep in in_ring if ep not in pinned]
+        if not eligible:
+            self._hold(
+                now, tier, st, "migration_pinned",
+                f"all {len(in_ring)} in-ring replicas are migration "
+                f"restore targets; holding scale-down", reasons, done,
+            )
+            return
+        victim = min(eligible, key=_load)
         # Headroom guard over the WHOLE fleet: the capacity left after
         # this removal must still cover every in-flight stream with
         # margin, or tenant-fair admission could start shedding a tenant

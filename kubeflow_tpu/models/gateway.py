@@ -364,6 +364,9 @@ class ServingGateway:
         self._router = PrefixRouter(block_size=block_size)
         self._spread = itertools.count()  # "random" arm: uniform, RNG-free
         self._replicas: dict = {}
+        # Live-migration restore targets (pin_for_migration): excluded
+        # from autoscaler scale-down victim selection until unpinned.
+        self._migration_pins: set = set()
         # Tenant-fair admission state + the routing-report counters.
         self._inflight: dict = {}
         self._total_inflight = 0
@@ -440,6 +443,33 @@ class ServingGateway:
                 self._ring.remove(endpoint)
             self._mirror_ring_locked()
         return True
+
+    def pin_for_migration(self, endpoint: str) -> bool:
+        """Mark a replica as a live-migration restore target: the
+        autoscaler must not pick it as a scale-down victim while a
+        checkpoint is being rebuilt onto it (a drain mid-restore would
+        release the very slice the migration is landing on). Idempotent;
+        returns False for endpoints this gateway does not know."""
+        with self._lock:
+            if endpoint not in self._replicas:
+                return False
+            self._migration_pins.add(endpoint)
+        return True
+
+    def unpin_for_migration(self, endpoint: str) -> None:
+        """Release the migration pin (flip done or migration fell back);
+        the endpoint becomes an ordinary scale-down candidate again.
+        Unknown endpoints are a no-op — the pin set is self-cleaning."""
+        with self._lock:
+            self._migration_pins.discard(endpoint)
+
+    def migration_pinned(self) -> frozenset:
+        """Endpoints currently pinned as migration restore targets."""
+        with self._lock:
+            # Pins for replicas that left the fleet entirely must not
+            # accumulate: intersect with live membership on read.
+            self._migration_pins &= set(self._replicas)
+            return frozenset(self._migration_pins)
 
     def replica_endpoints(self) -> list:
         with self._lock:

@@ -644,6 +644,21 @@ class FleetTelemetry:
             )
         self.hub.inc(f"autoscale_{action}")
 
+    # -- migration feed ----------------------------------------------------
+
+    _MIGRATION_EVENTS = ("started", "completed", "fell_back")
+
+    def observe_migration(self, event: str) -> None:
+        """One live-migration lifecycle event (runtime/migration.py),
+        windowed so /debug/signals shows migration churn next to the
+        preemption and load signals that triggered it."""
+        if event not in self._MIGRATION_EVENTS:
+            raise ValueError(
+                f"migration event must be one of "
+                f"{self._MIGRATION_EVENTS}, got {event!r}"
+            )
+        self.hub.inc(f"migration_{event}")
+
     # -- outputs -----------------------------------------------------------
 
     def evaluate_slo(self, now: Optional[float] = None) -> dict:
@@ -753,6 +768,11 @@ class FleetTelemetry:
                 "autoscale_down_per_s": _rate("autoscale_down"),
                 "autoscale_hold_per_s": _rate("autoscale_hold"),
                 "autoscale_freeze_per_s": _rate("autoscale_freeze"),
+                # Live-migration churn: starts vs completions vs ladder
+                # fallbacks, windowed like the preemption signals above.
+                "migration_started_per_s": _rate("migration_started"),
+                "migration_completed_per_s": _rate("migration_completed"),
+                "migration_fell_back_per_s": _rate("migration_fell_back"),
             },
             "tenants": tenants,
         }

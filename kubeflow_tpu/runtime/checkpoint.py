@@ -60,7 +60,7 @@ import threading
 import time
 import zlib
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import numpy as np
 
@@ -166,7 +166,17 @@ class CheckpointManager:
         process_index: Optional[int] = None,
         process_count: Optional[int] = None,
         env: Optional[dict] = None,
+        clock: Optional[Callable[[], float]] = None,
     ):
+        # Every budget/freshness computation in this manager reads THIS
+        # clock, monotonic by default. Wall clock is not an option here:
+        # the emergency path runs exactly when preemptions land, and
+        # maintenance events correlate with NTP steps on the host — a
+        # backwards jump mid-grace-window would inflate "remaining" and
+        # start a save SIGKILL then tears. Injectable so tests can prove
+        # the budget math under a controlled (or deliberately jumpy)
+        # source.
+        self._clock = clock or time.monotonic
         self.directory = Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
         self.max_to_keep = max(1, int(max_to_keep))
@@ -204,6 +214,12 @@ class CheckpointManager:
         self._last_saved_step: Optional[int] = None  # interval gate
         self._last_committed_step: Optional[int] = self.latest_step()
         self._last_save_duration: Optional[float] = None
+        # Clock instant of the last durable commit THIS process performed.
+        # None for a step inherited from disk at construction: its age is
+        # unknowable by a monotonic clock (file mtimes are wall time), so
+        # last_commit_age() reports +inf and freshness-gated callers save
+        # rather than trust it.
+        self._last_commit_at: Optional[float] = None
         # Newest (step, host_leaves, treedef-free paths, metadata) handed to
         # save(), committed or not — what emergency_save flushes.
         self._pending: Optional[tuple] = None
@@ -288,7 +304,7 @@ class CheckpointManager:
         mutex or the write lock. The pending snapshot supersedes anything
         still queued, so giving up on the drain loses nothing.
         """
-        t0 = time.monotonic()
+        t0 = self._clock()
         if grace_s is None:
             drain_timeout = _DEFAULT_EMERGENCY_DRAIN_S
         else:
@@ -314,7 +330,7 @@ class CheckpointManager:
             )
             return False
         if grace_s is not None:
-            remaining = float(grace_s) - (time.monotonic() - t0)
+            remaining = float(grace_s) - (self._clock() - t0)
             estimate = self._last_save_duration
             if remaining <= 0 or (estimate is not None and estimate > remaining):
                 log.error(
@@ -326,7 +342,7 @@ class CheckpointManager:
                 )
                 return False
         if grace_s is not None:
-            lock_timeout = max(0.0, float(grace_s) - (time.monotonic() - t0))
+            lock_timeout = max(0.0, float(grace_s) - (self._clock() - t0))
         else:
             lock_timeout = _DEFAULT_EMERGENCY_DRAIN_S
         locked = self._lock.acquire(timeout=lock_timeout)
@@ -353,7 +369,7 @@ class CheckpointManager:
             log.warning(
                 "emergency save: committed step %d in %.2fs",
                 step,
-                time.monotonic() - t0,
+                self._clock() - t0,
             )
         return ok
 
@@ -373,7 +389,7 @@ class CheckpointManager:
         must outlive a sick disk, and its staging dir is cleaned up.
         Everything else propagates and abandons the staging dir exactly
         as SIGKILL would: invisible to restore, evidence for debugging."""
-        t0 = time.monotonic()
+        t0 = self._clock()
         final = self._root / str(step)
         with self._seq_lock:
             self._seq += 1
@@ -442,9 +458,10 @@ class CheckpointManager:
             log.error("checkpoint save of step %d failed: %s", step, err)
             shutil.rmtree(staged, ignore_errors=True)
             return False
-        duration = time.monotonic() - t0
+        duration = self._clock() - t0
         self._last_save_duration = duration
         self._last_committed_step = step
+        self._last_commit_at = self._clock()
         hist = getattr(self.metrics, "checkpoint_save_seconds", None)
         if hist is not None:
             hist.observe(duration)
@@ -513,12 +530,12 @@ class CheckpointManager:
         if timeout is None:
             q.join()
             return True
-        deadline = time.monotonic() + timeout
+        deadline = self._clock() + timeout
         if not q.all_tasks_done.acquire(timeout=timeout):
             return False
         try:
             while q.unfinished_tasks:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - self._clock()
                 if remaining <= 0:
                     return False
                 q.all_tasks_done.wait(remaining)
@@ -543,6 +560,18 @@ class CheckpointManager:
         size/checksum validation happens at restore."""
         steps = self._committed_steps()
         return steps[-1] if steps else None
+
+    def last_commit_age(self) -> float:
+        """Seconds since THIS process last durably committed (or restored)
+        a step, measured on the injected monotonic clock — immune to the
+        wall-clock jumps that cluster preemptions love to coincide with.
+        +inf when no commit has been observed this process lifetime (steps
+        inherited on disk have only wall-time mtimes, whose age a
+        monotonic clock cannot vouch for), so freshness-gated callers
+        save rather than trust."""
+        if self._last_commit_at is None:
+            return float("inf")
+        return max(0.0, self._clock() - self._last_commit_at)
 
     def _local_steps(self) -> list:
         return sorted(
@@ -609,6 +638,9 @@ class CheckpointManager:
             state = _restore_into_template(template, arrays, step_dir)
             self.restored_metadata = meta
             self._last_committed_step = step
+            # A restore just validated these bytes, so "as fresh as a
+            # commit made now" is the honest monotonic reading.
+            self._last_commit_at = self._clock()
             return state, step
         return template, None
 

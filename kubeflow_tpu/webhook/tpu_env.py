@@ -129,6 +129,17 @@ KUBEFLOW_TPU_AUTOSCALE_DRAIN_BUDGET_S = (
     "KUBEFLOW_TPU_AUTOSCALE_DRAIN_BUDGET_S"
 )
 KUBEFLOW_TPU_AUTOSCALE_STALE_AFTER_S = "KUBEFLOW_TPU_AUTOSCALE_STALE_AFTER_S"
+# Live slice migration (runtime/migration.py migration_from_env): per-step
+# budgets for the save → warm-claim → restore → flip pipeline; inert unless
+# MIGRATE_ENABLE opts in.
+KUBEFLOW_TPU_MIGRATE_ENABLE = "KUBEFLOW_TPU_MIGRATE_ENABLE"
+KUBEFLOW_TPU_MIGRATE_SAVE_BUDGET_S = "KUBEFLOW_TPU_MIGRATE_SAVE_BUDGET_S"
+KUBEFLOW_TPU_MIGRATE_CLAIM_BUDGET_S = "KUBEFLOW_TPU_MIGRATE_CLAIM_BUDGET_S"
+KUBEFLOW_TPU_MIGRATE_RESTORE_BUDGET_S = (
+    "KUBEFLOW_TPU_MIGRATE_RESTORE_BUDGET_S"
+)
+KUBEFLOW_TPU_MIGRATE_FLIP_BUDGET_S = "KUBEFLOW_TPU_MIGRATE_FLIP_BUDGET_S"
+KUBEFLOW_TPU_MIGRATE_FRESH_WITHIN_S = "KUBEFLOW_TPU_MIGRATE_FRESH_WITHIN_S"
 
 # name -> who produces it and from what. Annotation-projected env names are
 # defined next to their annotations in kubeflow_tpu/api/annotations.py and
@@ -293,6 +304,22 @@ ENV_CONTRACT: dict = {
     KUBEFLOW_TPU_AUTOSCALE_STALE_AFTER_S: "operator-set: replica scrape "
     "age past which the autoscaler freezes all scaling instead of acting "
     "on stale telemetry (default 10)",
+    KUBEFLOW_TPU_MIGRATE_ENABLE: "operator-set on the controller container: "
+    "1/true arms proactive live migration (save → warm-claim → restore → "
+    "flip on preemption notice / idle-cull / tpu-migrate-now annotation); "
+    "unset/0 keeps recovery purely reactive — migration is inert by default",
+    KUBEFLOW_TPU_MIGRATE_SAVE_BUDGET_S: "operator-set: emergency-save step "
+    "budget in seconds (default 30; the step falls back to the reactive "
+    "ladder when blown)",
+    KUBEFLOW_TPU_MIGRATE_CLAIM_BUDGET_S: "operator-set: warm-slice claim "
+    "step budget in seconds (default 10)",
+    KUBEFLOW_TPU_MIGRATE_RESTORE_BUDGET_S: "operator-set: restore step "
+    "budget in seconds (default 60)",
+    KUBEFLOW_TPU_MIGRATE_FLIP_BUDGET_S: "operator-set: routing-flip step "
+    "budget in seconds (default 10)",
+    KUBEFLOW_TPU_MIGRATE_FRESH_WITHIN_S: "operator-set: a checkpoint "
+    "commit younger than this (monotonic seconds, default 5) makes the "
+    "save step a skip",
     ann.QUANT_ENV_NAME: "webhook: tpu-quantization annotation",
     ann.PROFILING_ENV_NAME: "webhook: tpu-profiling-port annotation",
     ann.SERVING_ENV_NAME: "webhook: tpu-serving-port annotation",

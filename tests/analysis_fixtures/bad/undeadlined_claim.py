@@ -1,0 +1,18 @@
+"""BAD: unbounded waits on a recovery/migration path — the claim walk
+can loop under contention and the cross-slice probe can hang on a
+half-dead host; either wedges the pipeline that exists to beat a
+deadline."""
+
+import http.client
+
+from kubeflow_tpu.controller.slicepool import claim_warm_slice
+
+
+def escalate_recovery(client, namespace, topo):
+    return claim_warm_slice(client, namespace, topo)
+
+
+def probe_new_slice(host, port):
+    conn = http.client.HTTPConnection(host, port)
+    conn.request("GET", "/healthz")
+    return conn.getresponse().status

@@ -1,0 +1,19 @@
+"""GOOD: every wait on the recovery/migration path carries an explicit
+bound, so a blown budget becomes a fallback instead of a hang."""
+
+import http.client
+import time
+
+from kubeflow_tpu.controller.slicepool import claim_warm_slice
+
+
+def escalate_recovery(client, namespace, topo):
+    return claim_warm_slice(
+        client, namespace, topo, deadline=time.perf_counter() + 5.0
+    )
+
+
+def probe_new_slice(host, port):
+    conn = http.client.HTTPConnection(host, port, timeout=2.0)
+    conn.request("GET", "/healthz")
+    return conn.getresponse().status

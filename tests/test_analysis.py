@@ -35,6 +35,7 @@ RULE_FOR_FIXTURE = {
     "span_unended": "span-unended",
     "annotation_literal": "annotation-literal",
     "suppression_hygiene": "suppression-hygiene",
+    "undeadlined_claim": "undeadlined-claim",
     "parse_error": "parse-error",
 }
 

@@ -94,6 +94,10 @@ class FakeGateway:
         self.inflight: dict = {}
         self.begun: list = []
         self.removed: list = []
+        self.pins: set = set()
+
+    def migration_pinned(self):
+        return frozenset(self.pins)
 
     def add(self, ep, *, role="fused", slots=4, active=0, queued=0):
         self.replicas[ep] = {
@@ -359,6 +363,48 @@ class TestEbb:
                              for r in holds[-1]["reasons"])
         assert gw.begun == []  # nothing left the ring
         assert scaler.stats()["scale_downs"] == 0
+
+
+class TestMigrationPin:
+    """Scale-down × live migration: a replica a migration is restoring
+    onto (gateway.pin_for_migration) must never be picked as the drain
+    victim — draining it would release the very slice the migration is
+    landing on."""
+
+    def test_pinned_replica_is_never_the_victim(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        gw.replicas[EP0]["stats"]["active_slots"] = 3  # EP1 least loaded
+        gw.pins.add(EP1)  # ...but a migration is restoring onto it
+        done = _tick(scaler, clock, n=3)
+        downs = _actions(done, "scale_down")
+        assert [d["endpoint"] for d in downs] == [EP0]
+        assert prov.drains == [EP0]
+        assert EP1 in gw.ring_nodes()  # the restore target held
+
+    def test_all_pinned_holds_until_unpin(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        gw.pins.update({EP0, EP1})
+        done = _tick(scaler, clock, n=4)
+        holds = _actions(done, "hold")
+        assert holds and any("migration" in r for r in holds[0]["reasons"])
+        assert prov.drains == []
+        assert set(gw.ring_nodes()) == {EP0, EP1}
+        # Flip done → unpin → the held scale-down proceeds normally.
+        gw.pins.clear()
+        clock.advance(6.0)  # clear the down cooldown set by nothing: safe
+        _tick(scaler, clock, n=3)
+        assert len(prov.drains) == 1
+
+    def test_gateway_without_pin_api_still_scales_down(self):
+        scaler, gw, tel, prov, clock = _mk(2)
+        del FakeGateway.migration_pinned  # simulate an older gateway
+        try:
+            _tick(scaler, clock, n=3)
+            assert len(prov.drains) == 1
+        finally:
+            FakeGateway.migration_pinned = (
+                lambda self: frozenset(self.pins)
+            )
 
 
 class TestDisagg:

@@ -60,6 +60,10 @@ def test_catalog_has_reference_parity_experiments():
         # Fleet autoscaler (models/autoscaler.py): scale-down under
         # stream churn — drain before release, never kill a stream.
         "autoscaler-scaledown-storm",
+        # Live slice migration (runtime/migration.py): preemption-notice
+        # storm — every migration resumes loss-exact, throughput never
+        # zeroes, one complete trace per migration.
+        "migration-storm",
     }
 
 

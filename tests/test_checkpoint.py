@@ -421,6 +421,72 @@ def test_emergency_save_skips_when_budget_too_small(tmp_path):
     assert ckpt.latest_step() == 1
 
 
+class _FakeClock:
+    """Injectable monotonic clock: advances only when the test says so."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def test_injected_clock_is_the_single_time_source(tmp_path):
+    """Every freshness/duration figure comes off the injected clock, so a
+    wall-clock jump (NTP step, suspend/resume — preemption windows love
+    these) cannot skew them."""
+    clk = _FakeClock()
+    ckpt = CheckpointManager(tmp_path / "clk", clock=clk)
+    assert ckpt.last_commit_age() == float("inf")  # nothing committed yet
+    assert ckpt.save(1, {"w": np.zeros(4)})
+    assert ckpt.last_commit_age() == 0.0
+    clk.advance(12.5)
+    assert ckpt.last_commit_age() == 12.5
+    # The save's measured duration is fake-clock elapsed (zero), even
+    # though real wall time passed while the bytes hit disk.
+    assert ckpt._last_save_duration == 0.0
+    clk.advance(-100.0)  # monotonic source misused backwards: clamp, not negative
+    assert ckpt.last_commit_age() == 0.0
+
+
+def test_emergency_budget_counts_on_injected_clock(tmp_path):
+    """Grace accounting reads ONLY the injected clock. The real wall clock
+    advances by orders of magnitude more than this 1ms budget while the
+    save runs, so if any budget arithmetic still read the wall clock the
+    save would be mis-skipped as over budget."""
+    clk = _FakeClock()
+    ckpt = CheckpointManager(
+        tmp_path / "jump", save_interval_steps=100, clock=clk
+    )
+    assert ckpt.save(1, {"w": np.zeros(4)})
+    assert not ckpt.save(2, {"w": np.ones(4)})  # gated by interval; pending
+    assert ckpt.emergency_save(grace_s=0.001) is True
+    assert ckpt.latest_step() == 2
+
+
+def test_inherited_step_age_is_unknown_until_restore(tmp_path):
+    """A step found on disk at construction has no trustworthy monotonic
+    age (mtimes are wall time): last_commit_age() says +inf so freshness-
+    gated callers save rather than trust. A validating restore is the
+    moment the bytes are vouched for, and stamps freshness."""
+    d = tmp_path / "inherit"
+    first = CheckpointManager(d)
+    assert first.save(1, {"w": np.arange(4.0)})
+
+    clk = _FakeClock(1000.0)
+    second = CheckpointManager(d, clock=clk)
+    assert second.latest_step() == 1
+    assert second.last_commit_age() == float("inf")
+    state, step = second.restore_latest({"w": np.zeros(4)})
+    assert step == 1
+    assert second.last_commit_age() == 0.0
+    clk.advance(3.0)
+    assert second.last_commit_age() == 3.0
+
+
 def test_save_failure_is_contained_and_recovers(tmp_path):
     """ENOSPC mid-training: save() returns False (never raises), cleans
     its staging dir, keeps the previous step restorable, and commits again

@@ -590,6 +590,26 @@ class TestWarmSliceSource:
         finally:
             gw.stop()
 
+    def test_migration_pin_lifecycle(self):
+        gw = ServingGateway(["a:1", "b:2"])
+        try:
+            # Unknown endpoints cannot be pinned (a typo must not
+            # silently disable scale-down forever).
+            assert gw.pin_for_migration("nope:9") is False
+            assert gw.pin_for_migration("a:1") is True
+            assert gw.pin_for_migration("a:1") is True  # idempotent
+            assert gw.migration_pinned() == frozenset({"a:1"})
+            gw.unpin_for_migration("a:1")
+            gw.unpin_for_migration("a:1")  # no-op twice
+            assert gw.migration_pinned() == frozenset()
+            # A pinned replica that leaves the fleet self-cleans: the
+            # pin set never accumulates dead endpoints.
+            assert gw.pin_for_migration("b:2") is True
+            gw.remove_replica("b:2")
+            assert gw.migration_pinned() == frozenset()
+        finally:
+            gw.stop()
+
 
 class TestRealReplicaIntegration:
     def test_prefix_counters_flow_engine_to_stats_to_gateway(self):

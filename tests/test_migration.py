@@ -1,0 +1,313 @@
+"""runtime/migration.py: the deadline-budgeted live-migration pipeline.
+
+The acceptance bar from the issue: forced failure of ANY single step
+(save timeout, claim exhaustion, restore corruption, flip conflict)
+must degrade to the reactive ladder — never hang, never silently lose
+the notebook — and every attempt must read as one complete `migration`
+trace with per-step spans.
+"""
+
+import threading
+
+import pytest
+
+from kubeflow_tpu.k8s.events import EventRecorder
+from kubeflow_tpu.k8s.fake import FakeCluster
+from kubeflow_tpu.metrics import Metrics
+from kubeflow_tpu.observability import tracing
+from kubeflow_tpu.observability.signals import FleetTelemetry, SignalsConfig
+from kubeflow_tpu.runtime.migration import (
+    MIGRATION_STEPS,
+    MigrationConfig,
+    MigrationOrchestrator,
+    migration_from_env,
+)
+
+
+class _FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class _FakeCheckpoint:
+    """Just enough CheckpointManager surface for the save step."""
+
+    def __init__(self, age=float("inf"), latest=None, commit_ok=True):
+        self.age = age
+        self.latest = latest
+        self.commit_ok = commit_ok
+        self.emergency_calls = []
+
+    def last_commit_age(self):
+        return self.age
+
+    def latest_step(self):
+        return self.latest
+
+    def emergency_save(self, grace_s):
+        self.emergency_calls.append(grace_s)
+        if self.commit_ok:
+            self.latest = (self.latest or 0) + 1
+            return True
+        return False
+
+
+def _orchestrator(clock=None, exporter=None, **kw):
+    """A fully-wired orchestrator whose steps all succeed by default."""
+    clock = clock or _FakeClock()
+    kw.setdefault("checkpoint", _FakeCheckpoint(latest=3))
+    kw.setdefault("claim_fn", lambda claimant, deadline: "pool-a")
+    kw.setdefault("restore_fn", lambda deadline: {"step": 3, "start_batch": 4})
+    kw.setdefault("flip_fn", lambda deadline: True)
+    fallbacks = []
+    kw.setdefault("fallback_fn", lambda step, reason: fallbacks.append((step, reason)))
+    orch = MigrationOrchestrator(
+        kw.pop("config", MigrationConfig()), clock=clock, **kw
+    )
+    orch._test_fallbacks = fallbacks
+    return orch
+
+
+@pytest.fixture()
+def exporter():
+    exp = tracing.InMemoryExporter()
+    tracing.set_tracer_provider(tracing.TracerProvider(exporter=exp))
+    yield exp
+    tracing.set_tracer_provider(tracing.TracerProvider())
+
+
+class TestPipeline:
+    def test_happy_path_completes_with_full_trace(self, exporter):
+        orch = _orchestrator()
+        report = orch.migrate("preemption-notice")
+        assert report.completed and not report.fell_back
+        assert report.pool == "pool-a"
+        assert report.restored_step == 3 and report.start_batch == 4
+        assert set(report.steps) == set(MIGRATION_STEPS)
+        assert all(s["ok"] for s in report.steps.values())
+        # One complete trace: the root span plus one child per step.
+        roots = exporter.by_name("migration")
+        assert len(roots) == 1
+        root = roots[0]
+        assert root.attributes["completed"] is True
+        for step in MIGRATION_STEPS:
+            spans = exporter.by_name(f"migration.{step}")
+            assert len(spans) == 1, f"missing span for step {step}"
+            assert spans[0].parent_id == root.span_id
+            assert spans[0].attributes["budget_s"] > 0
+
+    def test_save_skipped_when_commit_is_fresh(self, exporter):
+        ckpt = _FakeCheckpoint(age=1.0, latest=7)
+        orch = _orchestrator(checkpoint=ckpt)
+        report = orch.migrate("operator")
+        assert report.completed
+        assert ckpt.emergency_calls == []  # fresh → no redundant save
+        assert "skipped" in report.steps["save"]["detail"]
+
+    def test_stale_commit_forces_emergency_save(self):
+        ckpt = _FakeCheckpoint(age=120.0, latest=7)
+        orch = _orchestrator(checkpoint=ckpt)
+        report = orch.migrate("operator")
+        assert report.completed
+        assert len(ckpt.emergency_calls) == 1
+        # The save grace handed down is the step budget (minus epsilon).
+        assert 0 < ckpt.emergency_calls[0] <= MigrationConfig().save_budget_s
+
+    def test_concurrent_trigger_does_not_double_claim(self):
+        claims = []
+        release = threading.Event()
+
+        def slow_claim(claimant, deadline):
+            claims.append(claimant)
+            release.wait(timeout=5.0)
+            return "pool-a"
+
+        orch = _orchestrator(claim_fn=slow_claim)
+        t = threading.Thread(target=orch.migrate, args=("preemption-notice",),
+                             daemon=True)
+        t.start()
+        while not claims:  # first migration is inside the claim step
+            pass
+        second = orch.migrate("operator")
+        release.set()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        assert second.reason == "migration already in progress"
+        assert not second.completed and not second.fell_back
+        assert len(claims) == 1
+
+
+class TestForcedStepFailures:
+    """Each step's failure mode degrades to the ladder: fallback_fn is
+    invoked with the failing step, the report says which step, the trace
+    records the error — and nothing hangs or raises."""
+
+    def _assert_fell_back(self, orch, report, step):
+        assert report.fell_back and not report.completed
+        assert report.failed_step == step
+        assert orch._test_fallbacks and orch._test_fallbacks[0][0] == step
+        stats = orch.stats()
+        assert stats["migrations_started"] == 1
+        assert stats["migrations_fell_back"] == 1
+        assert stats["migrations_completed"] == 0
+        assert stats["last_failed_step"] == step
+
+    def test_save_timeout_falls_back(self, exporter):
+        clock = _FakeClock()
+        ckpt = _FakeCheckpoint(age=120.0, latest=None, commit_ok=False)
+
+        def slow_save(grace_s):
+            clock.advance(MigrationConfig().save_budget_s + 1.0)
+            return False
+
+        ckpt.emergency_save = slow_save
+        orch = _orchestrator(clock=clock, checkpoint=ckpt)
+        report = orch.migrate("preemption-notice")
+        self._assert_fell_back(orch, report, "save")
+        root = exporter.by_name("migration")[0]
+        assert root.attributes["failed_step"] == "save"
+        # The claim step never ran: no slice was leaked on a failed save.
+        assert not exporter.by_name("migration.claim")
+
+    def test_save_with_nothing_durable_falls_back(self):
+        ckpt = _FakeCheckpoint(age=float("inf"), latest=None, commit_ok=False)
+        orch = _orchestrator(checkpoint=ckpt)
+        report = orch.migrate("preemption-notice")
+        self._assert_fell_back(orch, report, "save")
+        assert "none on disk" in report.reason
+
+    def test_claim_exhaustion_falls_back(self):
+        orch = _orchestrator(claim_fn=lambda claimant, deadline: None)
+        report = orch.migrate("preemption-notice")
+        self._assert_fell_back(orch, report, "claim")
+        assert "exhausted" in report.reason
+
+    def test_restore_corruption_falls_back(self):
+        def corrupt_restore(deadline):
+            raise RuntimeError("checksum mismatch: quarantined corrupt-3")
+
+        orch = _orchestrator(restore_fn=corrupt_restore)
+        report = orch.migrate("preemption-notice")
+        self._assert_fell_back(orch, report, "restore")
+        assert "checksum mismatch" in report.reason
+
+    def test_flip_conflict_falls_back(self):
+        orch = _orchestrator(flip_fn=lambda deadline: False)
+        report = orch.migrate("preemption-notice")
+        self._assert_fell_back(orch, report, "flip")
+        assert "conflict" in report.reason or "refused" in report.reason
+
+    def test_budget_blowout_mid_step_falls_back(self, exporter):
+        clock = _FakeClock()
+
+        def slow_restore(deadline):
+            clock.advance(MigrationConfig().restore_budget_s + 5.0)
+            return {"step": 3, "start_batch": 4}
+
+        orch = _orchestrator(clock=clock, restore_fn=slow_restore)
+        report = orch.migrate("preemption-notice")
+        self._assert_fell_back(orch, report, "restore")
+        assert "budget blown" in report.reason
+        # Flip never ran: routing was not touched after the blowout.
+        assert not exporter.by_name("migration.flip")
+
+    def test_fallback_hook_crash_is_contained(self):
+        def bad_hook(step, reason):
+            raise RuntimeError("ladder hook exploded")
+
+        orch = _orchestrator(claim_fn=lambda c, d: None, fallback_fn=bad_hook)
+        report = orch.migrate("preemption-notice")  # must not raise
+        assert report.fell_back and report.failed_step == "claim"
+
+
+class TestObservability:
+    def test_events_and_metrics_and_signals(self):
+        client = FakeCluster()
+        recorder = EventRecorder(client, component="migration")
+        metrics = Metrics(client)
+        telemetry = FleetTelemetry(SignalsConfig(window_s=60.0, windows=10))
+        nb = {"apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+              "metadata": {"name": "nb", "namespace": "ns"}}
+        orch = _orchestrator(metrics=metrics, telemetry=telemetry,
+                             recorder=recorder, notebook=nb)
+        report = orch.migrate("preemption-notice")
+        assert report.completed
+        reasons = {e["reason"] for e in client.list("Event", "ns")}
+        assert "MigrationProgress" in reasons
+        assert "MigrationCompleted" in reasons
+        text = metrics.expose().decode()
+        assert "tpu_migration_started_total 1.0" in text
+        assert "tpu_migration_completed_total 1.0" in text
+        assert "tpu_migration_fallback_total 0.0" in text
+        snap = telemetry.snapshot()
+        assert snap["fleet"]["migration_started_per_s"] > 0
+        assert snap["fleet"]["migration_completed_per_s"] > 0
+        assert snap["fleet"]["migration_fell_back_per_s"] == 0
+
+    def test_fallback_emits_warning_event_and_counter(self):
+        client = FakeCluster()
+        recorder = EventRecorder(client, component="migration")
+        metrics = Metrics(client)
+        nb = {"apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+              "metadata": {"name": "nb", "namespace": "ns"}}
+        orch = _orchestrator(metrics=metrics, recorder=recorder, notebook=nb,
+                             claim_fn=lambda c, d: None)
+        orch.migrate("idle-cull")
+        events = client.list("Event", "ns")
+        fell = [e for e in events if e["reason"] == "MigrationFellBack"]
+        assert fell and fell[0]["type"] == "Warning"
+        assert "reactive recovery ladder takes over" in fell[0]["message"]
+        text = metrics.expose().decode()
+        assert "tpu_migration_fallback_total 1.0" in text
+
+    def test_stats_block_keys(self):
+        orch = _orchestrator()
+        orch.migrate("operator")
+        stats = orch.stats()
+        # Key literals double as the STATS_PARITY surface.
+        for key in ("migrations_started", "migrations_completed",
+                    "migrations_fell_back", "migration_last_s"):
+            assert key in stats
+
+
+class TestConfig:
+    def test_validation_rejects_nonpositive_budgets(self):
+        with pytest.raises(ValueError):
+            MigrationConfig(claim_budget_s=0)
+        with pytest.raises(ValueError):
+            MigrationConfig(fresh_within_s=-1)
+
+    def test_env_off_by_default(self):
+        assert migration_from_env({}) is None
+        assert migration_from_env({"KUBEFLOW_TPU_MIGRATE_ENABLE": "0"}) is None
+
+    def test_env_opt_in_with_overrides(self):
+        cfg = migration_from_env({
+            "KUBEFLOW_TPU_MIGRATE_ENABLE": "true",
+            "KUBEFLOW_TPU_MIGRATE_SAVE_BUDGET_S": "12",
+            "KUBEFLOW_TPU_MIGRATE_FRESH_WITHIN_S": "0",
+        })
+        assert cfg is not None
+        assert cfg.save_budget_s == 12.0
+        assert cfg.fresh_within_s == 0.0
+        assert cfg.claim_budget_s == MigrationConfig().claim_budget_s
+
+    def test_env_fail_fast_on_garbage(self):
+        with pytest.raises(ValueError):
+            migration_from_env({"KUBEFLOW_TPU_MIGRATE_ENABLE": "yes"})
+        with pytest.raises(ValueError):
+            migration_from_env({
+                "KUBEFLOW_TPU_MIGRATE_ENABLE": "1",
+                "KUBEFLOW_TPU_MIGRATE_CLAIM_BUDGET_S": "banana",
+            })
+        with pytest.raises(ValueError):
+            migration_from_env({
+                "KUBEFLOW_TPU_MIGRATE_ENABLE": "1",
+                "KUBEFLOW_TPU_MIGRATE_FLIP_BUDGET_S": "0.1",
+            })

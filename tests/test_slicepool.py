@@ -6,9 +6,12 @@ envtest tier — pool warms a slice, notebook claims it, pods land on the
 freed capacity, pool refills.
 """
 
+import pytest
+
 from kubeflow_tpu.api import slicepool as sp
 from kubeflow_tpu.api.notebook import TPUSpec
 from kubeflow_tpu.api.slicepool import new_slicepool
+from kubeflow_tpu.controller import slicepool as ctrl_sp
 from kubeflow_tpu.k8s import objects as obj_util
 from kubeflow_tpu.k8s.events import events_for
 
@@ -381,6 +384,24 @@ class TestClaimPath:
         assert sp.CLAIMED_FROM not in nb["metadata"].get("annotations", {})
 
 
+    def test_fenced_claim_survives_interleaving(self):
+        # See TestClaimFencing for the race matrix; this is the smoke
+        # check that the normal claim path still works end-to-end with
+        # the fence in it (CLAIMED_BY never leaks onto the refill).
+        env = make_env(
+            node_pools=(
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+                ("tpu-v5-lite-podslice", "4x4", 4, 4),
+            )
+        )
+        env.cluster.create(_pool(warm=1))
+        env.manager.run_until_idle()
+        env.cluster.create(tpu_notebook())
+        env.manager.run_until_idle()
+        refill = _warm_stses(env)
+        assert len(refill) == 1
+        assert sp.CLAIMED_BY not in obj_util.annotations_of(refill[0])
+
     def test_multislice_notebook_claims_one_placeholder_per_slice(self):
         """Each slice of a multislice notebook is its own warm-capacity
         claim: the per-slice claim markers (CLAIMED_FROM, CLAIMED_FROM.1)
@@ -420,3 +441,115 @@ class TestClaimPath:
             "annotations", {})
         assert sp.CLAIMED_FROM not in anns
         assert f"{sp.CLAIMED_FROM}.1" not in anns
+
+
+class _InterposingClient:
+    """Delegates everything to the real cluster, but fires ``trap`` once,
+    just before the victim's first StatefulSet update (the fence write) —
+    the exact window in which a concurrent claimant can race. FakeCluster
+    is not thread-safe, so the race is reproduced by deterministic
+    interposition rather than by threads (a thread race would exercise
+    the fake's missing locks, not the fence)."""
+
+    def __init__(self, cluster, trap):
+        self._cluster = cluster
+        self._trap = trap
+        self.deleted = []
+
+    def __getattr__(self, name):
+        return getattr(self._cluster, name)
+
+    def update(self, obj):
+        if self._trap is not None and obj.get("kind") == "StatefulSet":
+            trap, self._trap = self._trap, None
+            trap()
+        return self._cluster.update(obj)
+
+    def delete(self, kind, name, namespace=None):
+        self.deleted.append((kind, name))
+        return self._cluster.delete(kind, name, namespace)
+
+
+class TestClaimFencing:
+    """Satellite invariant: two claimants racing the same warm slice must
+    conflict-retry onto DISTINCT slices, or take a clean ClaimLost/miss —
+    never both 'successfully' claim one placeholder. The fence is the
+    CLAIMED_BY annotation written with the read's resourceVersion; the
+    unfenced delete it replaced was check-then-act and let both racers
+    win."""
+
+    topo = TPUSpec(accelerator="v5e", topology="4x4").slice_topology()
+
+    def _env(self, warm):
+        env = make_env(
+            node_pools=tuple(
+                ("tpu-v5-lite-podslice", "4x4", 4, 4) for _ in range(warm)
+            )
+        )
+        env.cluster.create(_pool(warm=warm))
+        env.manager.run_until_idle()
+        assert len(_warm_stses(env)) == warm
+        return env
+
+    def test_racing_claimants_get_distinct_slices(self):
+        env = self._env(warm=2)
+        stolen = []
+
+        def steal():
+            before = {obj_util.name_of(s) for s in _warm_stses(env)}
+            assert ctrl_sp.claim_warm_slice(
+                env.cluster, "ns", self.topo, claimant="adversary",
+            ) == "pool"
+            after = {obj_util.name_of(s) for s in _warm_stses(env)}
+            stolen.extend(before - after)
+
+        client = _InterposingClient(env.cluster, steal)
+        assert ctrl_sp.claim_warm_slice(
+            client, "ns", self.topo, claimant="victim",
+        ) == "pool"
+
+        victim_deleted = [n for k, n in client.deleted if k == "StatefulSet"]
+        assert len(stolen) == 1 and len(victim_deleted) == 1
+        assert victim_deleted[0] != stolen[0], (
+            "both claimants claimed the same placeholder"
+        )
+        assert not _warm_stses(env)  # exactly two slices for two claimants
+
+    def test_losing_the_last_slice_is_a_clean_miss(self):
+        env = self._env(warm=1)
+
+        def steal():
+            assert ctrl_sp.claim_warm_slice(
+                env.cluster, "ns", self.topo, claimant="adversary",
+            ) == "pool"
+
+        client = _InterposingClient(env.cluster, steal)
+        assert ctrl_sp.claim_warm_slice(
+            client, "ns", self.topo, claimant="victim",
+        ) is None
+        # The victim never issued a delete for a slice it did not own.
+        assert not [n for k, n in client.deleted if k == "StatefulSet"]
+
+    def test_prefenced_placeholder_is_skipped(self):
+        """A placeholder carrying someone else's live fence is another
+        claimant's slice mid-claim: walk past it, never contest it."""
+        env = self._env(warm=2)
+        first, second = sorted(_warm_stses(env), key=obj_util.name_of)
+        fresh = env.cluster.get(
+            "StatefulSet", obj_util.name_of(first), "ns"
+        )
+        obj_util.set_annotation(fresh, sp.CLAIMED_BY, "other-claimant")
+        env.cluster.update(fresh)
+
+        assert ctrl_sp.claim_warm_slice(
+            env.cluster, "ns", self.topo, claimant="victim",
+        ) == "pool"
+        left = _warm_stses(env)
+        assert [obj_util.name_of(s) for s in left] == [obj_util.name_of(first)]
+
+    def test_claim_candidate_raises_claimlost_when_deleted(self):
+        env = self._env(warm=1)
+        chosen = _warm_stses(env)[0]
+        env.cluster.delete("StatefulSet", obj_util.name_of(chosen), "ns")
+        with pytest.raises(ctrl_sp.ClaimLost):
+            ctrl_sp._claim_candidate(env.cluster, chosen, "victim")
