@@ -938,6 +938,124 @@ class UndeadlinedClaim(Rule):
         return findings
 
 
+class UnboundedFanout(Rule):
+    id = "kftpu-unbounded-fanout"
+    description = (
+        "Loop issuing HTTP requests over ring members (peers / "
+        "successors / ring_nodes) without a fanout bound or without a "
+        "per-hop timeout. The peer-fetch and reroute ladders multiply "
+        "every per-hop cost by the peer count: an unsliced walk over "
+        "the whole ring turns one slow replica into a fleet-wide stall, "
+        "and a timeout-less hop inside the loop hangs the entire walk "
+        "on the first half-dead host. Bound the peer set at the loop "
+        "header (slice, islice, or an explicit successors() budget) or "
+        "break on a fanout counter, and give every in-loop connection "
+        "an explicit timeout."
+    )
+
+    _HTTP_CONSTRUCTORS = ("HTTPConnection", "HTTPSConnection")
+    _RINGISH = ("peers", "successors", "ring_nodes")
+    _UNWRAP = ("enumerate", "sorted", "list", "reversed", "tuple")
+
+    def _unwrap(self, expr):
+        # enumerate(peers) / sorted(peers) etc. — the bound (or its
+        # absence) belongs to the inner iterable.
+        while (isinstance(expr, ast.Call) and expr.args
+               and isinstance(expr.func, ast.Name)
+               and expr.func.id in self._UNWRAP):
+            expr = expr.args[0]
+        return expr
+
+    def _leaf_name(self, expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        return None
+
+    def _classify_iter(self, expr) -> Optional[bool]:
+        """None if not ring-ish, else whether the walk is bounded at
+        the loop header."""
+        expr = self._unwrap(expr)
+        if isinstance(expr, ast.Subscript) and isinstance(
+                expr.slice, ast.Slice):
+            # peers[:fanout] — bounded regardless of the inner name.
+            return True if self._classify_iter(expr.value) is not None \
+                else None
+        if isinstance(expr, ast.Call):
+            leaf = None
+            if isinstance(expr.func, ast.Attribute):
+                leaf = expr.func.attr
+            elif isinstance(expr.func, ast.Name):
+                leaf = expr.func.id
+            if leaf == "islice":
+                return True
+            if leaf == "successors":
+                # successors(key, limit) carries an explicit budget —
+                # unless the limit is len(<ring>), i.e. the whole ring.
+                limit = expr.args[1] if len(expr.args) > 1 else None
+                if (isinstance(limit, ast.Call)
+                        and isinstance(limit.func, ast.Name)
+                        and limit.func.id == "len"):
+                    return False
+                return limit is not None
+            return None
+        name = self._leaf_name(expr)
+        if name is not None and any(
+                r in name.lower() for r in self._RINGISH):
+            return False
+        return None
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        findings = []
+        for node in mod.walk():
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            bounded = self._classify_iter(node.iter)
+            if bounded is None:
+                continue
+            http_calls = []
+            has_break = False
+            for sub in _direct_nodes(node.body):
+                if isinstance(sub, ast.Break):
+                    has_break = True
+                if not isinstance(sub, ast.Call):
+                    continue
+                callee = resolved_callee(mod, sub)
+                if callee is None:
+                    continue
+                leaf = callee.split(".")[-1]
+                if leaf in self._HTTP_CONSTRUCTORS or callee.endswith(
+                        "urlopen"):
+                    http_calls.append((sub, leaf))
+            if not http_calls:
+                continue
+            if not bounded and not has_break:
+                findings.append(
+                    self.finding(
+                        mod, node,
+                        "HTTP fan-out over an unbounded ring walk: "
+                        "slice the peer set (peers[:fanout]), pass an "
+                        "explicit successors() budget, or break on a "
+                        "fanout counter so one walk cannot visit the "
+                        "whole fleet",
+                    )
+                )
+            for call, leaf in http_calls:
+                if leaf in self._HTTP_CONSTRUCTORS and \
+                        "timeout" not in _kwarg_names(call):
+                    findings.append(
+                        self.finding(
+                            mod, call,
+                            f"{leaf} inside a ring fan-out loop without "
+                            "timeout=: the walk's whole budget hangs on "
+                            "the first half-dead peer; every hop needs "
+                            "its own deadline",
+                        )
+                    )
+        return findings
+
+
 class SuppressionHygiene(Rule):
     id = "suppression-hygiene"
     description = (
@@ -993,6 +1111,7 @@ ALL_RULES = [
     AnnotationLiteral(),
     ChaosParity(),
     UndeadlinedClaim(),
+    UnboundedFanout(),
     SuppressionHygiene(),
 ]
 

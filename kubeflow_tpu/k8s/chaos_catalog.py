@@ -78,6 +78,16 @@ INJECTION_TYPES = (
     # decode tier untouched: post-heal traffic keeps streaming through
     # the paged-KV handoff with zero transfer failures.
     "serving-kv-handoff-loss",
+    # Fleet KV tier coverage (models/gateway.py peer prefix fetch): the
+    # peer that answered /kv/probe with a full-chain match dies mid-way
+    # through the /kv/chain export, leaving the gateway a torn payload
+    # with a client stream already open. The fetch ladder must degrade
+    # to a plain re-prefill on the routed replica — the client still
+    # gets every token and [DONE], never an error or silent truncation
+    # — the dead peer must land in the negative cache (no repeat probes
+    # while it holds), and the ring must heal. A peer-tier failure that
+    # becomes client-visible is the outcome the ladder exists to forbid.
+    "serving-kv-peer-loss",
     # Fleet autoscaler coverage (models/autoscaler.py): scale-down under
     # stream churn. The autoscaler drains the least-loaded replica while
     # slow streams are in flight across the fleet; the drained replica
@@ -122,6 +132,10 @@ STEADY_STATE_CHECKS = (
     # the ring, and keeps importing KV payloads after a prefill-tier
     # loss — tier failure must not cascade across the handoff boundary.
     "decodeTierHealthy",
+    # Fleet KV tier: every failed peer fetch degraded to re-prefill
+    # with zero client-visible failures, and the dead peer is
+    # negative-cached so the ladder stops probing a corpse.
+    "peerFetchDegraded",
     # Autoscaler scale-down: every in-flight stream on a draining
     # replica ran to [DONE] and its slice was released only afterwards.
     "streamsDrained",
@@ -150,6 +164,7 @@ TARGET_KIND_FOR_INJECTION = {
     "checkpoint-disk-full": "CheckpointManager",
     "gateway-replica-kill": "ServingGateway",
     "serving-kv-handoff-loss": "ServingGateway",
+    "serving-kv-peer-loss": "ServingGateway",
     "autoscaler-scaledown-storm": "ServingGateway",
     "migration-storm": "MigrationOrchestrator",
 }
@@ -573,6 +588,113 @@ class _CrashablePrefill:
         self.crash()
 
 
+class _CrashablePeer:
+    """Fused-fleet peer replica for the peer-prefix-fetch experiment:
+    healthy on /healthz, answers ``/kv/probe`` with a full-chain match
+    (the bait), then dies mid-body on the ``/kv/chain`` pull — torn
+    export on the wire, pod gone. That is a peer SIGKILLed between the
+    probe and the pull; the gateway's degrade-to-re-prefill ladder is
+    the system under test, so no engine lives behind this replica."""
+
+    def __init__(self):
+        from http.server import (
+            BaseHTTPRequestHandler,
+            ThreadingHTTPServer,
+        )
+
+        self.lock = threading.Lock()
+        self.probe_hits = 0
+        self.chain_hits = 0
+        self.crashed = False
+        replica = self
+
+        class QuietServer(ThreadingHTTPServer):
+            daemon_threads = True
+
+            def handle_error(self, request, client_address):
+                pass  # crash() tears sockets mid-write by design
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._json(200, {"status": "ok"})
+                elif self.path == "/stats":
+                    self._json(200, {"slots": 2, "active_slots": 0,
+                                     "queued": 0, "served": 0,
+                                     "tier_role": "fused"})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                if self.path == "/kv/probe":
+                    with replica.lock:
+                        replica.probe_hits += 1
+                    try:
+                        keys = json.loads(body).get("keys", [])
+                    except ValueError:
+                        keys = []
+                    # Full-chain bait: deep enough to beat whatever the
+                    # target holds, small enough to clear the byte cap.
+                    self._json(200, {"matched": len(keys),
+                                     "block_bytes": 2048,
+                                     "payload_bytes": 4096})
+                    return
+                if self.path != "/kv/chain":
+                    self._json(404, {"error": "not found"})
+                    return
+                with replica.lock:
+                    replica.chain_hits += 1
+                # Die mid-export: declare a body, ship a fragment, take
+                # the pod down — the gateway reads a torn payload, not a
+                # clean refusal.
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", "4096")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(b'{"matched": 2, "payload": {"blo')
+                self.wfile.flush()
+                replica.crash()
+
+        self.httpd = QuietServer(("127.0.0.1", 0), Handler)
+        self.host, self.port = self.httpd.server_address[:2]
+        self.endpoint = f"{self.host}:{self.port}"
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+
+    def start(self) -> "_CrashablePeer":
+        self.thread.start()
+        return self
+
+    def crash(self) -> None:
+        with self.lock:
+            if self.crashed:
+                return
+            self.crashed = True
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def stop(self) -> None:
+        self.crash()
+
+
 def _paged_serving_factory(*, tier_role: str):
     """Tiny paged-engine serving stack for the disaggregated-fleet
     experiments: prefix_cache on (KV export/import requires the chain
@@ -679,6 +801,7 @@ class ExperimentRunner:
             "checkpoint-disk-full": self._run_checkpoint_disk_full,
             "gateway-replica-kill": self._run_gateway_replica_kill,
             "serving-kv-handoff-loss": self._run_serving_kv_handoff_loss,
+            "serving-kv-peer-loss": self._run_serving_kv_peer_loss,
             "autoscaler-scaledown-storm":
                 self._run_autoscaler_scaledown_storm,
             "migration-storm": self._run_migration_storm,
@@ -1743,6 +1866,150 @@ class ExperimentRunner:
             victim.stop()
             prefill.stop()
             decode.stop()
+
+    def _run_serving_kv_peer_loss(self, doc: dict) -> ExperimentResult:
+        """The peer that won the /kv/probe auction dies mid-/kv/chain
+        export: the gateway holds a torn payload with the client stream
+        already open. The fetch ladder must fall through to a plain
+        re-prefill on the routed replica (every token + [DONE], zero
+        error events), negative-cache the corpse so it is not re-probed,
+        and the health loop must drop it from the ring; post-heal
+        traffic keeps serving with zero new fetch failures."""
+        import http.client
+
+        from kubeflow_tpu.models.gateway import ServingGateway
+
+        params = doc["spec"]["injection"].get("params", {})
+        decode_tokens = int(params.get("decodeTokens", 5))
+        post_heal = int(params.get("postHealRequests", 3))
+        timeout = float(doc["spec"]["recoveryTimeoutSeconds"])
+
+        victim = _CrashablePeer().start()
+        replica = _paged_serving_factory(tier_role="fused").start()
+        r_ep = f"{replica.host}:{replica.port}"
+        gw = ServingGateway(
+            [victim.endpoint, r_ep], port=0, block_size=8,
+            health_interval_s=0.1, kv_peer_fanout=2,
+        ).start()
+
+        def stream(prompt):
+            """(sse_lines, tokens) for one streamed completion."""
+            conn = http.client.HTTPConnection(gw.host, gw.port,
+                                              timeout=timeout)
+            lines, toks = [], []
+            try:
+                conn.request(
+                    "POST", "/v1/completions",
+                    json.dumps({"prompt": prompt, "stream": True,
+                                "max_tokens": decode_tokens}).encode(),
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                while True:
+                    line = resp.fp.readline()
+                    if not line:
+                        break
+                    if line.startswith(b"data:"):
+                        lines.append(line)
+                    if line == b"data: [DONE]\n":
+                        break
+                for ln in lines:
+                    if ln == b"data: [DONE]\n":
+                        continue
+                    body = json.loads(ln[5:])
+                    if "token" in body:
+                        toks.append(body["token"])
+            finally:
+                conn.close()
+            return lines, toks
+
+        try:
+            deadline = time.monotonic() + timeout
+            while (len(gw.ring_nodes()) < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            # A prompt the fused walk routes to the REAL replica — the
+            # victim must be a probed peer, not the route target. The
+            # prefix router learns a chain on first sight, so warm it
+            # once and target with the stable key the request recomputes.
+            prompt = None
+            for nonce in range(3, 250):
+                cand = [nonce, 5, 7, 11, 13, 17, 19, 23, 29, 31]
+                gw._route_key(cand)
+                walk = gw._candidates(gw._route_key(cand))
+                if walk and walk[0] == r_ep:
+                    prompt = cand
+                    break
+            if prompt is None:
+                return ExperimentResult(
+                    doc["metadata"]["name"], passed=False,
+                    detail="no prompt routed to the real replica",
+                )
+            sev_lines, sev_toks = stream(prompt)
+            mid = gw.stats()
+            degraded = (
+                victim.probe_hits >= 1
+                and victim.chain_hits == 1
+                and bool(sev_lines)
+                and sev_lines[-1] == b"data: [DONE]\n"
+                and len(sev_toks) == decode_tokens
+                and not any(b'"error"' in ln for ln in sev_lines)
+                and mid["kv_peer_fetches"] == 0
+                and mid["kv_peer_fetch_failures"] >= 1
+                and victim.endpoint in mid["kv_peer"]["negative_cached"]
+            )
+            # Ring heals: the dead peer leaves within the window.
+            healed = False
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if gw.ring_nodes() == frozenset({r_ep}):
+                    healed = True
+                    break
+                time.sleep(0.02)
+            # Post-heal: fresh prompts keep streaming; a peerless walk
+            # is a clean no-peer-chain, never a counted failure.
+            completed = 0
+            for i in range(post_heal):
+                lines, toks = stream(
+                    [80 + i, 81, 82, 83, 84, 85, 86, 87, 88, 89]
+                )
+                completed += (bool(lines)
+                              and lines[-1] == b"data: [DONE]\n"
+                              and len(toks) == decode_tokens)
+            stats = gw.stats()
+            post_ok = (
+                completed == post_heal
+                and stats["kv_peer_fetch_failures"]
+                == mid["kv_peer_fetch_failures"]
+            )
+            passed = degraded and healed and post_ok
+            return ExperimentResult(
+                doc["metadata"]["name"],
+                passed=passed,
+                detail="" if passed else (
+                    f"degraded={degraded} (probes={victim.probe_hits} "
+                    f"pulls={victim.chain_hits} "
+                    f"toks={len(sev_toks)}/{decode_tokens} "
+                    f"fetches={mid['kv_peer_fetches']} "
+                    f"failures={mid['kv_peer_fetch_failures']} "
+                    f"negative={mid['kv_peer']['negative_cached']}) "
+                    f"healed={healed} post_ok={post_ok} "
+                    f"(completed={completed}/{post_heal})"
+                ),
+                observations={
+                    "victim_probe_hits": victim.probe_hits,
+                    "victim_chain_hits": victim.chain_hits,
+                    "kv_peer_fetch_failures":
+                        stats["kv_peer_fetch_failures"],
+                    "negative_cached":
+                        list(mid["kv_peer"]["negative_cached"]),
+                    "healed": healed,
+                },
+            )
+        finally:
+            gw.stop()
+            victim.stop()
+            replica.stop()
 
     def _run_checkpoint_kill_mid_save(self, doc: dict) -> ExperimentResult:
         """SIGKILL lands mid-save: the IO layer dies between file writes

@@ -51,6 +51,10 @@ STATS_PARITY = {
     "tpu_serving_kv_transfer_failures_total": "kv_transfer_failures",
     "tpu_serving_kv_transfer_bytes_total": "kv_transfer_bytes",
     "tpu_serving_kv_transfer_latency_seconds": "kv_transfer_latency_s",
+    "tpu_serving_kv_peer_fetch_total": "kv_peer_fetches",
+    "tpu_serving_kv_peer_fetch_failures_total": "kv_peer_fetch_failures",
+    "tpu_serving_kv_peer_bytes_total": "kv_peer_bytes",
+    "tpu_serving_kv_peer_fetch_latency_seconds": "kv_peer_fetch_latency_s",
     "tpu_serving_kv_swap_out_total": "swap_out",
     "tpu_serving_kv_swap_in_total": "swap_in",
     "tpu_serving_kv_swap_restored_tokens_total": "restored_tokens",
@@ -310,6 +314,30 @@ class Metrics:
             "tpu_serving_kv_transfer_latency_seconds",
             "Duration of the most recent KV transfer hop (payload POST "
             "through decode-side import acknowledgement)",
+            registry=self.registry,
+        )
+        # -- fleet KV tier (peer prefix fetch, models/gateway.py) ----------
+        self.serving_kv_peer_fetch_total = Counter(
+            "tpu_serving_kv_peer_fetch_total",
+            "Peer prefix chains fetched from a ring successor and "
+            "imported instead of re-prefilling",
+            registry=self.registry,
+        )
+        self.serving_kv_peer_fetch_failures_total = Counter(
+            "tpu_serving_kv_peer_fetch_failures_total",
+            "Peer prefix fetches that degraded to local re-prefill "
+            "(dead peer, budget, oversized, quarantine, import refusal)",
+            registry=self.registry,
+        )
+        self.serving_kv_peer_bytes_total = Counter(
+            "tpu_serving_kv_peer_bytes_total",
+            "Serialized chain payload bytes pulled from peers",
+            registry=self.registry,
+        )
+        self.serving_kv_peer_fetch_latency_seconds = Gauge(
+            "tpu_serving_kv_peer_fetch_latency_seconds",
+            "Duration of the most recent peer fetch (chain pull through "
+            "target-side import acknowledgement)",
             registry=self.registry,
         )
         # -- HBM economy (host-RAM block swap, models/paged.py) ------------

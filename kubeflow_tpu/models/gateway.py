@@ -288,6 +288,9 @@ class ServingGateway:
                  tier_roles: Optional[dict] = None,
                  kv_transfer_timeout_s: float = 30.0,
                  kv_transfer_max_bytes: int = 64 << 20,
+                 kv_peer_fanout: int = 0,
+                 kv_peer_timeout_s: float = 5.0,
+                 kv_peer_max_bytes: int = 64 << 20,
                  adapter_affinity: bool = True,
                  autoscaler_config=None,
                  autoscaler_provisioner=None):
@@ -313,6 +316,18 @@ class ServingGateway:
                 f"kv_transfer_max_bytes must be >= 1, got "
                 f"{kv_transfer_max_bytes}"
             )
+        if kv_peer_fanout < 0:
+            raise ValueError(
+                f"kv_peer_fanout must be >= 0, got {kv_peer_fanout}"
+            )
+        if kv_peer_timeout_s <= 0:
+            raise ValueError(
+                f"kv_peer_timeout_s must be > 0, got {kv_peer_timeout_s}"
+            )
+        if kv_peer_max_bytes < 1:
+            raise ValueError(
+                f"kv_peer_max_bytes must be >= 1, got {kv_peer_max_bytes}"
+            )
         # Same opt-in as the replicas: KUBEFLOW_TPU_TRACE_* switches the
         # process-wide provider on; default stays the no-op tracer.
         tracing.configure_from_env()
@@ -337,6 +352,28 @@ class ServingGateway:
         self._kv_transfer_failures = 0
         self._kv_transfer_bytes = 0
         self._kv_transfer_last_s = 0.0
+        # Fleet KV tier (peer prefix fetch): read-through fetch of warm
+        # prefix chains from bounded ring successors. kv_peer_fanout=0
+        # (the default, and FANOUT unset in gateway_from_env) keeps the
+        # tier fully inert: the hot path never computes chain keys for
+        # it and never opens a peer socket.
+        self.kv_peer_fanout = int(kv_peer_fanout)
+        self.kv_peer_timeout_s = float(kv_peer_timeout_s)
+        self.kv_peer_max_bytes = int(kv_peer_max_bytes)
+        self._kv_peer_fetches = 0
+        self._kv_peer_fetch_failures = 0
+        self._kv_peer_bytes = 0
+        self._kv_peer_fetch_last_s = 0.0
+        self._kv_peer_fail_reasons: dict = {}
+        self._kv_peer_quarantined = 0
+        self._kv_peer_quarantine: list = []  # bounded: last 8 refusals
+        self._kv_peer_single_flight_skips = 0
+        self._kv_peer_negative_hits = 0
+        # endpoint -> (monotonic deadline, consecutive failures): the
+        # per-peer negative cache with exponential backoff.
+        self._kv_peer_negative: dict = {}
+        # chain tail keys (hex) with a fetch in flight: single-flight.
+        self._kv_peer_inflight: set = set()
         self.health_interval_s = health_interval_s
         self.health_timeout_s = health_timeout_s
         self.upstream_timeout_s = upstream_timeout_s
@@ -757,6 +794,75 @@ class ServingGateway:
         if self.telemetry is not None:
             self.telemetry.observe_kv_transfer(nbytes, latency_s, ok=ok)
 
+    # -- fleet KV tier (peer prefix fetch) bookkeeping ---------------------
+
+    def _count_kv_peer_fetch(self, ok: bool, nbytes: int, latency_s: float,
+                             reason: Optional[str] = None) -> None:
+        with self._lock:
+            if ok:
+                self._kv_peer_fetches += 1
+                self._kv_peer_bytes += nbytes
+                self._kv_peer_fetch_last_s = latency_s
+            else:
+                self._kv_peer_fetch_failures += 1
+                if reason:
+                    self._kv_peer_fail_reasons[reason] = (
+                        self._kv_peer_fail_reasons.get(reason, 0) + 1
+                    )
+        if self.metrics is not None:
+            if ok:
+                self.metrics.serving_kv_peer_fetch_total.inc()
+                self.metrics.serving_kv_peer_bytes_total.inc(nbytes)
+                self.metrics.serving_kv_peer_fetch_latency_seconds.set(
+                    latency_s
+                )
+            else:
+                self.metrics.serving_kv_peer_fetch_failures_total.inc()
+        if self.telemetry is not None:
+            self.telemetry.observe_kv_peer_fetch(nbytes, latency_s, ok=ok)
+
+    def _kv_peer_backoff(self, endpoint: str) -> None:
+        """A dead/slow/refusing peer trips the negative cache with
+        per-peer exponential backoff: the fetch ladder must never probe
+        a corpse twice in a row, and a flapping peer earns a longer
+        hold each consecutive failure."""
+        with self._lock:
+            _, fails = self._kv_peer_negative.get(endpoint, (0.0, 0))
+            fails += 1
+            hold = min(self.kv_peer_timeout_s * (2 ** (fails - 1)), 60.0)
+            self._kv_peer_negative[endpoint] = (
+                time.monotonic() + hold, fails
+            )
+
+    def _kv_peer_blocked(self, endpoint: str) -> bool:
+        """True while the peer's negative-cache hold is live. An expired
+        hold admits ONE fresh probe: success clears the entry, another
+        failure doubles the hold."""
+        with self._lock:
+            entry = self._kv_peer_negative.get(endpoint)
+            if entry is None:
+                return False
+            if time.monotonic() >= entry[0]:
+                return False
+            self._kv_peer_negative_hits += 1
+            return True
+
+    def _kv_peer_recovered(self, endpoint: str) -> None:
+        with self._lock:
+            self._kv_peer_negative.pop(endpoint, None)
+
+    def _kv_peer_quarantine_payload(self, endpoint: str,
+                                    error: str) -> None:
+        """Import validation failure (geometry, chain-key, version):
+        record the refusal so an operator can see WHICH peer ships
+        incompatible payloads — the request itself just re-prefills."""
+        with self._lock:
+            self._kv_peer_quarantined += 1
+            self._kv_peer_quarantine.append(
+                {"endpoint": endpoint, "error": error[:200]}
+            )
+            del self._kv_peer_quarantine[:-8]
+
     def stats(self) -> dict:
         with self._lock:
             replicas = {
@@ -787,6 +893,32 @@ class ServingGateway:
                 "kv_transfer_failures": self._kv_transfer_failures,
                 "kv_transfer_bytes": self._kv_transfer_bytes,
                 "kv_transfer_latency_s": round(self._kv_transfer_last_s, 6),
+                # Fleet KV tier (STATS_PARITY surface for the
+                # tpu_serving_kv_peer_* families) + the robustness
+                # ladder's own scoreboard.
+                "kv_peer_fetches": self._kv_peer_fetches,
+                "kv_peer_fetch_failures": self._kv_peer_fetch_failures,
+                "kv_peer_bytes": self._kv_peer_bytes,
+                "kv_peer_fetch_latency_s": round(
+                    self._kv_peer_fetch_last_s, 6
+                ),
+                "kv_peer": {
+                    "enabled": bool(self.kv_peer_fanout),
+                    "fanout": self.kv_peer_fanout,
+                    "timeout_s": self.kv_peer_timeout_s,
+                    "max_bytes": self.kv_peer_max_bytes,
+                    "quarantined": self._kv_peer_quarantined,
+                    "quarantine": list(self._kv_peer_quarantine),
+                    "single_flight_skips":
+                        self._kv_peer_single_flight_skips,
+                    "negative_hits": self._kv_peer_negative_hits,
+                    "negative_cached": sorted(
+                        ep for ep, (until, _)
+                        in self._kv_peer_negative.items()
+                        if until > time.monotonic()
+                    ),
+                    "failure_reasons": dict(self._kv_peer_fail_reasons),
+                },
                 "inflight": dict(self._inflight),
                 # The fleet-level prefix-cache view, aggregated from the
                 # per-replica /stats scrapes (satellite: the gateway's
@@ -942,6 +1074,25 @@ class ServingGateway:
                     # hop failed) — the fused retry must not double it.
                     counted = outcome == "fallback-counted"
                 candidates = gw._candidates(key)
+                if gw.kv_peer_fanout and candidates:
+                    # Fleet KV tier (fused): warm the affinity target's
+                    # prefix cache from a ring peer before routing.
+                    # Base-model chains only — chain keys carry a
+                    # replica-local adapter salt the gateway cannot
+                    # recompute. Advisory and exception-contained: any
+                    # failure means a plain local prefill.
+                    prompt = req.get("prompt")
+                    if not req.get("model") and isinstance(
+                        prompt, list
+                    ) and prompt and all(
+                        isinstance(t, int) and not isinstance(t, bool)
+                        for t in prompt
+                    ):
+                        try:
+                            self._kv_peer_fetch(prompt, key,
+                                                candidates[0])
+                        except Exception:
+                            pass
                 # The routing decision is its own span: affinity mode,
                 # candidate walk, and every re-route attempt (as events)
                 # in one place.
@@ -1065,12 +1216,13 @@ class ServingGateway:
                 ) as span:
                     return self._disagg_span(
                         req, arrival, tenant, prompt, prefills, decodes,
-                        remaining, span,
+                        remaining, span, key,
                     )
 
             def _disagg_span(self, req: dict, arrival: float,
                              tenant: str, prompt: list, prefills: list,
-                             decodes: list, remaining, span) -> str:
+                             decodes: list, remaining, span,
+                             key: bytes) -> str:
                 # Probe the affinity-preferred decode replica for cached
                 # prefix chains so the prefill tier exports only suffix
                 # blocks — the same chain keys PagedBatcher stamps.
@@ -1079,8 +1231,28 @@ class ServingGateway:
                         prompt, gw._router.block_size
                     )
                 ]
-                matched = self._kv_probe_replica(decodes[0], keys_hex) \
-                    if keys_hex else 0
+                probe = self._kv_probe_replica(decodes[0], keys_hex) \
+                    if keys_hex else None
+                matched = probe[0] if probe else 0
+                if (gw.kv_peer_fanout and keys_hex
+                        and not req.get("model")
+                        and matched < len(keys_hex)):
+                    # Fleet KV tier (disagg): before the prefill tier
+                    # re-computes the missing prefix, try to pull it
+                    # from a ring peer into the decode replica so the
+                    # prefill export shrinks to suffix blocks. Advisory:
+                    # any failure leaves `matched` as probed.
+                    try:
+                        registered = self._kv_peer_fetch(
+                            prompt, key, decodes[0], held=matched
+                        )
+                        if registered:
+                            matched = max(matched, min(
+                                int(registered), len(keys_hex)
+                            ))
+                    except Exception as exc:
+                        span.add_event("kv_peer_fetch_error",
+                                       {"error": str(exc)})
                 skip = keys_hex[:matched]
                 span.set_attribute("prefix_blocks_skipped", len(skip))
                 result = None
@@ -1174,34 +1346,308 @@ class ServingGateway:
                 })
                 return "fallback-counted"
 
-            def _kv_probe_replica(self, endpoint: str,
-                                  keys_hex: list) -> int:
-                """How many consecutive prompt chain keys the decode
-                replica already holds. Advisory only (no pinning): a
-                racing eviction surfaces as an import 409 and the
-                request falls back to fused."""
+            def _kv_trace_headers(self) -> dict:
+                """Every /kv/* hop carries the trace: traceparent joins
+                the replica-side span to this request's trace, and
+                X-Request-Id survives even with tracing off."""
+                headers = {"Content-Type": "application/json"}
+                tp = tracing.format_traceparent(tracing.current_span())
+                if tp:
+                    headers["traceparent"] = tp
+                if self._req_id:
+                    headers["X-Request-Id"] = self._req_id
+                return headers
+
+            def _kv_probe_replica(self, endpoint: str, keys_hex: list,
+                                  timeout: Optional[float] = None):
+                """How many consecutive prompt chain keys the replica
+                already holds, plus its per-chain payload byte estimate:
+                ``(matched, payload_bytes)``, or None when the replica
+                was unreachable/refused (a peer fetcher negative-caches
+                that; a plain miss is ``(0, 0)``). Advisory only (no
+                pinning): a racing eviction surfaces at import time and
+                the request falls back."""
                 rep = gw._replicas.get(endpoint)
                 if rep is None:
-                    return 0
+                    return None
                 try:
                     conn = http.client.HTTPConnection(
-                        rep.host, rep.port, timeout=gw.health_timeout_s
+                        rep.host, rep.port,
+                        timeout=(timeout if timeout is not None
+                                 else gw.health_timeout_s),
                     )
                     try:
                         conn.request(
                             "POST", "/kv/probe",
                             json.dumps({"keys": keys_hex}).encode(),
-                            {"Content-Type": "application/json"},
+                            self._kv_trace_headers(),
                         )
                         resp = conn.getresponse()
                         body = resp.read()
                     finally:
                         conn.close()
                     if resp.status != 200:
-                        return 0
-                    return max(0, int(json.loads(body).get("matched", 0)))
+                        return None
+                    out = json.loads(body)
+                    matched = max(0, int(out.get("matched", 0)))
+                    pbytes = max(0, int(out.get("payload_bytes", 0)))
+                    return matched, pbytes
                 except (OSError, ValueError, http.client.HTTPException):
-                    return 0
+                    return None
+
+            # -- fleet KV tier (peer prefix fetch) -------------------------
+
+            def _kv_peer_fetch(self, prompt: list, key: bytes,
+                               target: str, held=None):
+                """Read-through peer fetch: probe up to kv_peer_fanout
+                ring successors for the prompt's chain keys, pick the
+                longest matching chain (swap-resident links included —
+                the peer promotes before export), pull it under the
+                per-hop deadline + whole-fetch budget, and push it into
+                ``target``'s prefix cache. Wholly advisory: every
+                failure mode returns None and the request re-prefills
+                locally. Concurrent fetches for the same chain are
+                single-flighted. Returns the number of leading chain
+                keys resident on the target after a successful import."""
+                keys_hex = [
+                    k.hex() for k in prompt_chain_keys(
+                        prompt, gw._router.block_size
+                    )
+                ]
+                if not keys_hex:
+                    return None
+                # Whole-fetch budget: one per-hop deadline for the
+                # target probe plus one per probed peer. The ladder
+                # stops wherever the budget runs out.
+                deadline = time.monotonic() + gw.kv_peer_timeout_s * (
+                    gw.kv_peer_fanout + 1
+                )
+                tail = keys_hex[-1]
+                with gw._lock:
+                    if tail in gw._kv_peer_inflight:
+                        gw._kv_peer_single_flight_skips += 1
+                        return None
+                    gw._kv_peer_inflight.add(tail)
+                try:
+                    with tracing.get_tracer("gateway").start_span(
+                        "kv_peer_fetch", target=target,
+                        chain_blocks=len(keys_hex),
+                    ) as span:
+                        return self._kv_peer_fetch_span(
+                            keys_hex, prompt, key, target, held,
+                            deadline, span,
+                        )
+                finally:
+                    with gw._lock:
+                        gw._kv_peer_inflight.discard(tail)
+
+            def _kv_peer_fetch_span(self, keys_hex: list, prompt: list,
+                                    key: bytes, target: str, held,
+                                    deadline: float, span):
+                def rem():
+                    return deadline - time.monotonic()
+
+                def hop_timeout():
+                    return max(0.001, min(gw.kv_peer_timeout_s, rem()))
+
+                if held is None:
+                    probe = self._kv_probe_replica(
+                        target, keys_hex, timeout=hop_timeout()
+                    )
+                    held = probe[0] if probe else 0
+                span.set_attribute("target_matched", held)
+                if held >= len(keys_hex):
+                    span.set_attribute("outcome", "already-warm")
+                    return None
+                # Bounded ring walk: at most kv_peer_fanout successors
+                # of the route key, skipping the target itself and any
+                # negative-cached peer.
+                with gw._lock:
+                    walk = gw._ring.successors(key, len(gw._ring))
+                peers = []
+                for ep in walk:
+                    if ep == target:
+                        continue
+                    peers.append(ep)
+                    if len(peers) >= gw.kv_peer_fanout:
+                        break
+                best = None  # (endpoint, matched, payload_bytes)
+                for ep in peers:
+                    if rem() <= 0:
+                        gw._count_kv_peer_fetch(
+                            False, 0, 0.0, reason="budget_exhausted"
+                        )
+                        span.set_attribute("outcome", "budget-exhausted")
+                        return None
+                    if gw._kv_peer_blocked(ep):
+                        span.add_event("peer_skipped", {
+                            "endpoint": ep, "reason": "negative-cache",
+                        })
+                        continue
+                    probe = self._kv_probe_replica(
+                        ep, keys_hex, timeout=hop_timeout()
+                    )
+                    if probe is None:
+                        gw._kv_peer_backoff(ep)
+                        gw._count_kv_peer_fetch(
+                            False, 0, 0.0, reason="dead_peer"
+                        )
+                        span.add_event("peer_dead", {"endpoint": ep})
+                        continue
+                    matched, pbytes = probe
+                    if matched <= held:
+                        continue
+                    if best is None or matched > best[1]:
+                        best = (ep, matched, pbytes)
+                    if matched >= len(keys_hex):
+                        break
+                if best is None:
+                    span.set_attribute("outcome", "no-peer-chain")
+                    return None
+                ep, matched, pbytes = best
+                span.set_attribute("peer", ep)
+                span.set_attribute("peer_matched", matched)
+                if pbytes > gw.kv_peer_max_bytes:
+                    # The probe's byte advisory: refuse BEFORE pulling.
+                    gw._count_kv_peer_fetch(
+                        False, pbytes, 0.0, reason="oversized"
+                    )
+                    span.set_attribute("outcome", "oversized")
+                    return None
+                if rem() <= 0:
+                    gw._count_kv_peer_fetch(
+                        False, 0, 0.0, reason="budget_exhausted"
+                    )
+                    span.set_attribute("outcome", "budget-exhausted")
+                    return None
+                t0 = time.monotonic()
+                pulled = self._kv_chain_pull(
+                    ep, keys_hex[:matched], hop_timeout()
+                )
+                if pulled is None:
+                    # Transport failure mid-export: the peer died or
+                    # tore the response — a corpse is not re-probed.
+                    gw._kv_peer_backoff(ep)
+                    gw._count_kv_peer_fetch(
+                        False, 0, time.monotonic() - t0,
+                        reason="fetch_failed",
+                    )
+                    span.set_attribute("outcome", "fetch-failed")
+                    return None
+                nbytes, chain = pulled
+                if chain is None:
+                    reason = ("oversized"
+                              if nbytes > gw.kv_peer_max_bytes
+                              else "chain_gone")
+                    gw._count_kv_peer_fetch(
+                        False, nbytes, time.monotonic() - t0,
+                        reason=reason,
+                    )
+                    span.set_attribute("outcome", reason)
+                    return None
+                status, registered = self._kv_chain_push(
+                    target, prompt, chain, hop_timeout()
+                )
+                if status != 200:
+                    if status == 400:
+                        # Validation refusal (geometry/chain-key/
+                        # version): quarantine, never retry the payload.
+                        gw._kv_peer_quarantine_payload(
+                            ep, registered if isinstance(registered, str)
+                            else "validation refused"
+                        )
+                        reason = "quarantined"
+                    else:
+                        reason = "import_failed"
+                    gw._count_kv_peer_fetch(
+                        False, nbytes, time.monotonic() - t0,
+                        reason=reason,
+                    )
+                    span.set_attribute("outcome", reason)
+                    return None
+                gw._kv_peer_recovered(ep)
+                gw._count_kv_peer_fetch(
+                    True, nbytes, time.monotonic() - t0
+                )
+                span.set_attribute("outcome", "imported")
+                span.set_attribute("registered", registered)
+                return registered
+
+            def _kv_chain_pull(self, endpoint: str, keys_hex: list,
+                               timeout: float):
+                """POST /kv/chain to the chosen peer. Returns
+                ``(nbytes, payload_dict)`` — payload None when the body
+                blew the byte cap or the peer no longer holds the chain
+                — or None on transport failure (caller backs off)."""
+                rep = gw._replicas.get(endpoint)
+                if rep is None:
+                    return None
+                try:
+                    conn = http.client.HTTPConnection(
+                        rep.host, rep.port, timeout=timeout
+                    )
+                    try:
+                        conn.request(
+                            "POST", "/kv/chain",
+                            json.dumps({"keys": keys_hex}).encode(),
+                            self._kv_trace_headers(),
+                        )
+                        resp = conn.getresponse()
+                        # Cap enforcement while reading: one byte past
+                        # the cap is enough to refuse the payload.
+                        body = resp.read(gw.kv_peer_max_bytes + 1)
+                    finally:
+                        conn.close()
+                    if resp.status != 200:
+                        return None
+                    if len(body) > gw.kv_peer_max_bytes:
+                        return len(body), None
+                    out = json.loads(body)
+                    payload = (out.get("payload")
+                               if isinstance(out, dict) else None)
+                    return len(body), (payload if isinstance(
+                        payload, dict) else None)
+                except (OSError, ValueError, http.client.HTTPException):
+                    return None
+
+            def _kv_chain_push(self, endpoint: str, prompt: list,
+                               payload: dict, timeout: float):
+                """POST /kv/chain/import to the target replica. Returns
+                ``(status, registered_count)`` on an answered hop —
+                status 400 carries the validation error string instead
+                of a count — or ``(None, 0)`` on transport failure."""
+                rep = gw._replicas.get(endpoint)
+                if rep is None:
+                    return None, 0
+                body = json.dumps({
+                    "tokens": [int(t) for t in prompt],
+                    "payload": payload,
+                }).encode()
+                try:
+                    conn = http.client.HTTPConnection(
+                        rep.host, rep.port, timeout=timeout
+                    )
+                    try:
+                        conn.request("POST", "/kv/chain/import", body,
+                                     self._kv_trace_headers())
+                        resp = conn.getresponse()
+                        rbody = resp.read()
+                    finally:
+                        conn.close()
+                    if resp.status != 200:
+                        detail = ""
+                        try:
+                            detail = str(
+                                json.loads(rbody).get("error", "")
+                            )
+                        except ValueError:
+                            pass
+                        return resp.status, detail
+                    return 200, max(
+                        0, int(json.loads(rbody).get("registered", 0))
+                    )
+                except (OSError, ValueError, http.client.HTTPException):
+                    return None, 0
 
             def _kv_prefill_replica(self, endpoint: str, req: dict,
                                     skip: list, rem):
@@ -1632,6 +2078,9 @@ def gateway_from_env(metrics=None, replica_source=None) -> ServingGateway:
         KUBEFLOW_TPU_GATEWAY_TIER_DECODE,
         KUBEFLOW_TPU_GATEWAY_TIER_MODE,
         KUBEFLOW_TPU_GATEWAY_TIER_PREFILL,
+        KUBEFLOW_TPU_KV_PEER_FANOUT,
+        KUBEFLOW_TPU_KV_PEER_MAX_BYTES,
+        KUBEFLOW_TPU_KV_PEER_TIMEOUT_S,
         KUBEFLOW_TPU_KV_TRANSFER_MAX_BYTES,
         KUBEFLOW_TPU_KV_TRANSFER_TIMEOUT_S,
     )
@@ -1704,10 +2153,29 @@ def gateway_from_env(metrics=None, replica_source=None) -> ServingGateway:
             f"want a number > 0"
         )
     kv_max_bytes = _int(KUBEFLOW_TPU_KV_TRANSFER_MAX_BYTES, 64 << 20, 1)
+    # Peer tier: unset fanout keeps it fully inert (zero hot-path cost,
+    # zero new sockets); a set value must be a sane bound.
+    kv_peer_fanout = _int(KUBEFLOW_TPU_KV_PEER_FANOUT, 0, 1)
+    raw_peer_timeout = os.environ.get(
+        KUBEFLOW_TPU_KV_PEER_TIMEOUT_S, "").strip()
+    try:
+        kv_peer_timeout = float(raw_peer_timeout) if raw_peer_timeout \
+            else 5.0
+    except ValueError:
+        kv_peer_timeout = 0.0
+    if kv_peer_timeout <= 0:
+        raise ValueError(
+            f"{KUBEFLOW_TPU_KV_PEER_TIMEOUT_S}={raw_peer_timeout!r}: "
+            f"want a number > 0"
+        )
+    kv_peer_max_bytes = _int(KUBEFLOW_TPU_KV_PEER_MAX_BYTES, 64 << 20, 1)
     return ServingGateway(
         replicas=replicas, port=port, affinity=affinity, hash_seed=seed,
         reroute_budget=budget, metrics=metrics,
         replica_source=replica_source, tier_mode=tier_mode,
         tier_roles=tier_roles, kv_transfer_timeout_s=kv_timeout,
         kv_transfer_max_bytes=kv_max_bytes,
+        kv_peer_fanout=kv_peer_fanout,
+        kv_peer_timeout_s=kv_peer_timeout,
+        kv_peer_max_bytes=kv_peer_max_bytes,
     )

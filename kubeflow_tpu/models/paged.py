@@ -904,6 +904,13 @@ class PagedBatcher(_BatcherBase):
         self.kv_import_blocks_reused = 0
         self.kv_import_blocks_written = 0
         self._kv_pending_first: list[tuple] = []
+        # Fleet KV tier (peer prefix fetch): cache-chain export/import
+        # counters, kept separate from the live-request handoff above so
+        # the two transfer paths stay individually observable.
+        self.kv_chain_exports = 0
+        self.kv_chain_imports = 0
+        self.kv_chain_blocks_sent = 0
+        self.kv_chain_blocks_written = 0
         self._init_base(self.gen, slots, prompt_bucket)
 
     @property
@@ -1447,6 +1454,211 @@ class PagedBatcher(_BatcherBase):
             payload.get("pending_logprob"),
         ))
         return req.rid
+
+    # -- fleet KV tier (peer prefix fetch) ---------------------------------
+
+    def chain_block_bytes(self) -> int:
+        """Wire-format bytes ONE full block costs in an exported chain
+        payload: base64 of every pool leaf's rows for a single block
+        plus a small JSON envelope. The /kv/probe byte advisory — a
+        fetcher multiplies by the matched chain length to enforce its
+        max-bytes cap BEFORE pulling a transfer."""
+        raw = sum(
+            int(leaf.nbytes) // int(leaf.shape[1])
+            for leaf in self.pool.values()
+        )
+        return 4 * ((raw + 2) // 3) + 96
+
+    def export_chain(self, keys) -> Optional[dict]:
+        """Serialize the longest held prefix of a chain-key walk straight
+        from the PREFIX CACHE — no live request involved. Swap-resident
+        links are promoted into the device pool first (the host-RAM tier
+        is part of the advertised chain, and promotion leaves this
+        replica warm too). Returns the version-1 wire format minus the
+        live-request fields — no tokens or pending token: the importing
+        side validates the keys against its own prompt — or None when
+        not even the first requested key is held."""
+        if not self._prefix_cache_enabled:
+            raise RuntimeError(
+                "export_chain requires prefix_cache=True (the chain "
+                "registry is the export source)"
+            )
+        raw = [k if isinstance(k, bytes) else bytes.fromhex(k)
+               for k in keys]
+        ents: list[tuple] = []
+        parent: Optional[bytes] = None
+        for key in raw:
+            ent = self._prefix_entries.get(key)
+            if ent is None and self._swap:
+                ent = self._swap_promote(key, parent)
+            if ent is None:
+                break
+            ents.append((key, ent))
+            parent = key
+        if not ents:
+            return None
+        blk_ids = np.asarray([e["block"] for _, e in ents], np.int32)
+        leaf_rows = {
+            name: np.asarray(self.pool[name][:, jnp.asarray(blk_ids)])
+            for name in self.pool
+        }
+        blocks = []
+        for i, (key, _) in enumerate(ents):
+            blocks.append({
+                "key": key.hex(),
+                "data": {
+                    name: base64.b64encode(
+                        np.ascontiguousarray(rows[:, i]).tobytes()
+                    ).decode("ascii")
+                    for name, rows in leaf_rows.items()
+                },
+            })
+        for key, _ in ents:  # an export is a hit: refresh recency
+            self._prefix_entries[key] = self._prefix_entries.pop(key)
+        self.kv_chain_exports += 1
+        self.kv_chain_blocks_sent += len(blocks)
+        return {
+            "version": 1,
+            "block_size": self.block_size,
+            "kv_bits": 8 if "k_scale" in self.pool else 0,
+            "adapter": None,
+            "leaves": {
+                name: {
+                    "dtype": str(self.pool[name].dtype),
+                    "shape": list(self.pool[name].shape[:1]
+                                  + self.pool[name].shape[2:]),
+                }
+                for name in self.pool
+            },
+            "blocks": blocks,
+        }
+
+    def import_chain(self, payload: dict, tokens) -> int:
+        """Register an exported cache chain into THIS engine's prefix
+        cache without installing a request — the peer-fetch import.
+        Chain keys are recomputed from the fetching request's own prompt
+        tokens (base-model salt) and checked positionally against the
+        payload; version skew, geometry skew, or a key mismatch raise
+        ValueError so the fetcher quarantines the payload and falls
+        through to re-prefill. Registration is best-effort under the
+        admission watermark: the walk stops at the first block the pool
+        cannot spare. Returns how many leading chain keys are now
+        resident — a subsequent submit() of the same prompt counts them
+        as prefix hits."""
+        if not self._prefix_cache_enabled:
+            raise ValueError(
+                "import_chain requires prefix_cache=True (there is no "
+                "chain registry to import into)"
+            )
+        if not isinstance(payload, dict) or payload.get("version") != 1:
+            raise ValueError(
+                "kv chain payload: missing or unsupported version"
+            )
+        if int(payload.get("block_size", -1)) != self.block_size:
+            raise ValueError(
+                f"kv chain payload block_size "
+                f"{payload.get('block_size')!r} != engine block_size "
+                f"{self.block_size}"
+            )
+        kv_bits = 8 if "k_scale" in self.pool else 0
+        if int(payload.get("kv_bits", -1)) != kv_bits:
+            raise ValueError(
+                f"kv chain payload kv_bits {payload.get('kv_bits')!r} "
+                f"does not match this pool's storage format "
+                f"(kv_bits={kv_bits})"
+            )
+        leaves = payload.get("leaves") or {}
+        if set(leaves) != set(self.pool):
+            raise ValueError(
+                "kv chain payload leaves do not match this pool"
+            )
+        shapes: dict[str, tuple] = {}
+        for name, spec in leaves.items():
+            want = self.pool[name].shape[:1] + self.pool[name].shape[2:]
+            if (tuple(spec.get("shape") or ()) != want
+                    or spec.get("dtype") != str(self.pool[name].dtype)):
+                raise ValueError(
+                    f"kv chain payload leaf {name!r}: shape/dtype "
+                    f"{spec.get('shape')}/{spec.get('dtype')} != local "
+                    f"{list(want)}/{self.pool[name].dtype}"
+                )
+            shapes[name] = want
+        toks = [int(t) for t in tokens]
+        bs = self.block_size
+        registrable = max(0, (len(toks) - 1) // bs)
+        entries = payload.get("blocks") or []
+        if not entries or len(entries) > registrable:
+            raise ValueError(
+                f"kv chain payload carries {len(entries)} blocks for a "
+                f"prompt with {registrable} registrable blocks"
+            )
+        keys: list[bytes] = []
+        parent: Optional[bytes] = None
+        for j in range(len(entries)):
+            parent = self._chain_key(parent, toks[j * bs:(j + 1) * bs])
+            sent = entries[j].get("key")
+            if sent != parent.hex():
+                raise ValueError(
+                    f"kv chain payload chain-key mismatch at block {j}: "
+                    "the exporting peer's chain diverged from this "
+                    "prompt's"
+                )
+            if "data" not in entries[j]:
+                raise ValueError(
+                    f"kv chain payload block {j} is a stub — chain "
+                    "exports always carry full block data"
+                )
+            keys.append(parent)
+        # Pin each link as the walk advances: _reserve_take may evict
+        # unreferenced leaves to make room, and the block registered one
+        # iteration ago is exactly such a leaf until its child links in.
+        resident = 0
+        written = 0
+        pinned: list[int] = []
+        chain_parent: Optional[bytes] = None
+        try:
+            for j, key in enumerate(keys):
+                ent = self._prefix_entries.get(key)
+                if ent is None and self._swap:
+                    ent = self._swap_promote(key, chain_parent)
+                if ent is None:
+                    take = self._reserve_take(1)
+                    if take is None:
+                        break  # pool pressure: keep what landed
+                    (blk,) = take
+                    for name in self.pool:
+                        dtype = _np_leaf_dtype(leaves[name]["dtype"])
+                        row = np.frombuffer(
+                            base64.b64decode(entries[j]["data"][name]),
+                            dtype=dtype,
+                        ).reshape(shapes[name])
+                        self.pool[name] = self.pool[name].at[:, blk].set(
+                            jnp.asarray(row)
+                        )
+                    self._prefix_entries[key] = {
+                        "block": blk, "parent": chain_parent,
+                        "children": 0,
+                    }
+                    if chain_parent is not None:
+                        self._prefix_entries[chain_parent]["children"] += 1
+                    self._shared_refs[blk] = 1
+                    written += 1
+                    ent = self._prefix_entries[key]
+                else:
+                    # Reuse refreshes recency, like any chain hit.
+                    self._prefix_entries[key] = self._prefix_entries.pop(
+                        key
+                    )
+                self._shared_refs[ent["block"]] += 1
+                pinned.append(ent["block"])
+                resident += 1
+                chain_parent = key
+        finally:
+            for blk in pinned:
+                self._shared_refs[blk] -= 1
+        self.kv_chain_imports += 1
+        self.kv_chain_blocks_written += written
+        return resident
 
     def _deliver_imported(self) -> None:
         """Feed imported requests' pending first tokens through the

@@ -1139,6 +1139,15 @@ class InferenceServer:
                                     server.engine.kv_import_blocks_reused,
                                 "import_blocks_written":
                                     server.engine.kv_import_blocks_written,
+                                # Fleet KV tier: cache-chain peer
+                                # export/import traffic (no live
+                                # request attached).
+                                "chain_exports": getattr(
+                                    server.engine, "kv_chain_exports", 0
+                                ),
+                                "chain_imports": getattr(
+                                    server.engine, "kv_chain_imports", 0
+                                ),
                             }
                         swap = None
                         if getattr(server.engine, "swap_bytes_limit", 0):
@@ -1260,16 +1269,17 @@ class InferenceServer:
                     self._json(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path == "/kv/probe":
-                    self._kv_probe()
-                    return
-                if self.path not in ("/v1/completions", "/kv/prefill"):
+                if self.path not in ("/v1/completions", "/kv/prefill",
+                                     "/kv/probe", "/kv/chain",
+                                     "/kv/chain/import"):
                     self._json(404, {"error": "not found"})
                     return
                 # Root span for the replica-side request. A gateway hop
                 # arrives with a traceparent header — the span joins
                 # that trace so the export shows one gateway→server→
-                # engine chain per request.
+                # engine chain per request. Every /kv/* hop joins too:
+                # a peer fetch appears in the same trace as the request
+                # that triggered it.
                 with tracing.get_tracer("server").start_span(
                     "server.request",
                     traceparent=self.headers.get("traceparent"),
@@ -1279,12 +1289,18 @@ class InferenceServer:
                         or span.trace_id
                         or tracing.new_trace_id()
                     )
-                    if self.path == "/kv/prefill":
+                    if self.path == "/kv/probe":
+                        self._kv_probe(span)
+                    elif self.path == "/kv/chain":
+                        self._kv_chain(span)
+                    elif self.path == "/kv/chain/import":
+                        self._kv_chain_import(span)
+                    elif self.path == "/kv/prefill":
                         self._kv_prefill(span)
                     else:
                         self._completions(span)
 
-            def _kv_probe(self):
+            def _kv_probe(self, span):
                 """Suffix-transfer negotiation: given the payload's chain
                 keys (hex, chain order), how many leading blocks does
                 this replica's prefix cache already hold? Swap-resident
@@ -1309,6 +1325,7 @@ class InferenceServer:
                     self._json(400, {"error": str(err)})
                     return
                 matched = 0
+                block_bytes = 0
                 with server._lock:
                     entries = getattr(server.engine, "_prefix_entries", None)
                     if entries is not None and getattr(
@@ -1321,7 +1338,95 @@ class InferenceServer:
                             if k not in entries and not swap_has(k):
                                 break
                             matched += 1
-                self._json(200, {"matched": matched})
+                    bb = getattr(server.engine, "chain_block_bytes", None)
+                    if bb is not None:
+                        block_bytes = int(bb())
+                span.set_attribute("kv_probe_matched", matched)
+                # The byte advisory: per-block wire cost and the whole
+                # matched chain's estimate, so a peer fetcher can refuse
+                # an oversized transfer BEFORE pulling it.
+                self._json(200, {
+                    "matched": matched,
+                    "block_bytes": block_bytes,
+                    "payload_bytes": matched * block_bytes,
+                })
+
+            def _kv_chain(self, span):
+                """Peer-fetch export hop: serialize the longest held
+                prefix of the requested chain keys straight from the
+                prefix cache (swap-resident links promoted first). No
+                request state is touched — the chains stay registered
+                and warm on this replica too."""
+                try:
+                    body = _read_body(self, server.max_body_bytes)
+                    req = json.loads(body or b"{}")
+                    keys = req.get("keys") or []
+                    if not isinstance(keys, list) or not all(
+                        isinstance(k, str) for k in keys
+                    ):
+                        raise ValueError(
+                            "keys must be a list of hex strings"
+                        )
+                    raw = [bytes.fromhex(k) for k in keys]
+                except BodyTooLarge as err:
+                    self._json(413, {"error": str(err)})
+                    return
+                except (ValueError, json.JSONDecodeError) as err:
+                    self._json(400, {"error": str(err)})
+                    return
+                export = getattr(server.engine, "export_chain", None)
+                if export is None or not raw:
+                    self._json(200, {"matched": 0, "payload": None})
+                    return
+                try:
+                    with server._lock:
+                        payload = export(raw)
+                except RuntimeError as err:
+                    self._json(409, {"error": str(err)})
+                    return
+                matched = len(payload["blocks"]) if payload else 0
+                span.set_attribute("kv_chain_blocks", matched)
+                self._json(200, {"matched": matched, "payload": payload})
+
+            def _kv_chain_import(self, span):
+                """Peer-fetch import hop: validate + register an exported
+                cache chain against this request's own prompt tokens.
+                Validation failures are 400s — the fetching gateway
+                quarantines the payload and the request re-prefills
+                locally; nothing on this path can fail a user request."""
+                try:
+                    body = _read_body(self, server.max_body_bytes)
+                    req = json.loads(body or b"{}")
+                    tokens = req.get("tokens")
+                    if not (isinstance(tokens, list) and tokens and all(
+                        isinstance(t, int) and not isinstance(t, bool)
+                        for t in tokens
+                    )):
+                        raise ValueError(
+                            "tokens must be a non-empty list of ints"
+                        )
+                    payload = req.get("payload")
+                except BodyTooLarge as err:
+                    self._json(413, {"error": str(err)})
+                    return
+                except (ValueError, json.JSONDecodeError) as err:
+                    self._json(400, {"error": str(err)})
+                    return
+                imp = getattr(server.engine, "import_chain", None)
+                if imp is None:
+                    self._json(409, {
+                        "error": "this replica's engine cannot import "
+                                 "cache chains"
+                    })
+                    return
+                try:
+                    with server._lock:
+                        registered = imp(payload, tokens)
+                except ValueError as err:
+                    self._json(400, {"error": str(err)})
+                    return
+                span.set_attribute("kv_chain_registered", registered)
+                self._json(200, {"registered": registered})
 
             def _kv_prefill(self, span):
                 """Prefill-tier hop: run the prompt's chunked prefill,

@@ -543,6 +543,20 @@ class FleetTelemetry:
         else:
             hub.inc("kv_transfer_failures")
 
+    def observe_kv_peer_fetch(self, nbytes: int, latency_s: float,
+                              ok: bool = True) -> None:
+        """One fleet-KV-tier peer prefix fetch attempt: byte volume +
+        whole-fetch latency, failures counted separately so
+        /debug/signals shows the degrade-to-re-prefill rate next to the
+        fetch rate."""
+        hub = self.hub
+        if ok:
+            hub.inc("kv_peer_fetches")
+            hub.inc("kv_peer_bytes", float(nbytes))
+            hub.observe("kv_peer_fetch_s", latency_s)
+        else:
+            hub.inc("kv_peer_fetch_failures")
+
     def ingest_ring(self, size: int) -> None:
         self.hub.set_gauge("ring_size", float(size))
 
@@ -720,6 +734,13 @@ class FleetTelemetry:
                 "kv_transfer_failures_per_s": _rate("kv_transfer_failures"),
                 "kv_transfer_bytes_per_s": _rate("kv_transfer_bytes"),
                 "kv_transfer_s": _hist("kv_transfer_s"),
+                # Fleet KV tier: peer prefix fetch volume + latency.
+                "kv_peer_fetches_per_s": _rate("kv_peer_fetches"),
+                "kv_peer_fetch_failures_per_s": _rate(
+                    "kv_peer_fetch_failures"
+                ),
+                "kv_peer_bytes_per_s": _rate("kv_peer_bytes"),
+                "kv_peer_fetch_s": _hist("kv_peer_fetch_s"),
                 # HBM economy: swap-tier churn as windowed rates, plus
                 # the per-replica resident swap bytes.
                 "kv_swap_out_per_s": _rate("fleet_kv_swap_out"),

@@ -71,6 +71,12 @@ KUBEFLOW_TPU_GATEWAY_TIER_DECODE = "KUBEFLOW_TPU_GATEWAY_TIER_DECODE"
 KUBEFLOW_TPU_GATEWAY_TIER_ROLE = "KUBEFLOW_TPU_GATEWAY_TIER_ROLE"
 KUBEFLOW_TPU_KV_TRANSFER_TIMEOUT_S = "KUBEFLOW_TPU_KV_TRANSFER_TIMEOUT_S"
 KUBEFLOW_TPU_KV_TRANSFER_MAX_BYTES = "KUBEFLOW_TPU_KV_TRANSFER_MAX_BYTES"
+# Fleet KV tier (models/gateway.py peer prefix fetch): on a local prefix
+# miss the gateway probes ring successors for the chain and imports it
+# instead of re-prefilling. Inert unless FANOUT is set.
+KUBEFLOW_TPU_KV_PEER_FANOUT = "KUBEFLOW_TPU_KV_PEER_FANOUT"
+KUBEFLOW_TPU_KV_PEER_TIMEOUT_S = "KUBEFLOW_TPU_KV_PEER_TIMEOUT_S"
+KUBEFLOW_TPU_KV_PEER_MAX_BYTES = "KUBEFLOW_TPU_KV_PEER_MAX_BYTES"
 # HBM economy (models/server.py kv_pool_from_env → PagedBatcher): KV
 # quantization bits, HBM-fraction pool sizing, and the host-RAM swap
 # tier's byte budget — a replica runs a quantized, HBM-sized,
@@ -212,6 +218,18 @@ ENV_CONTRACT: dict = {
     "container: serialized KV payload ceiling in bytes — larger "
     "transfers fall back to fused routing (default 64 MiB; replica "
     "max_body_bytes must admit at least this much)",
+    KUBEFLOW_TPU_KV_PEER_FANOUT: "operator-set on the gateway "
+    "container: how many ring successors a peer prefix fetch may probe "
+    "on a local miss; unset keeps the fleet KV tier fully inert (zero "
+    "hot-path cost, zero new sockets), set must be an integer >= 1",
+    KUBEFLOW_TPU_KV_PEER_TIMEOUT_S: "operator-set on the gateway "
+    "container: per-hop deadline for one peer probe/pull/import hop in "
+    "seconds (default 5); the whole fetch is budgeted at "
+    "TIMEOUT_S * (FANOUT + 1) and every expiry degrades to re-prefill",
+    KUBEFLOW_TPU_KV_PEER_MAX_BYTES: "operator-set on the gateway "
+    "container: peer chain payload ceiling in bytes — the probe's byte "
+    "advisory refuses oversized chains before pulling, and the pull "
+    "re-checks while reading (default 64 MiB)",
     KUBEFLOW_TPU_KV_BITS: "operator-set on the serving container: KV "
     "block-pool storage width — 8 stores int8 values + bf16 scales "
     "(half the KV HBM; composes with the ragged kernel), unset/0 keeps "

@@ -50,7 +50,9 @@ integration-workflow tier.
 Sibling experiments share the harness: ``--disagg`` (prefill/decode
 tier split, SERVE_r08_disagg.json), ``--evict-storm`` (HBM economy:
 bf16 evict+re-prefill vs int8 KV + host-RAM swap on one byte budget,
-SERVE_r09_hbm.json), and ``--spec`` / ``--multilora`` (speculative
+SERVE_r09_hbm.json), ``--ring-churn`` (fleet KV tier: join/leave churn,
+peer prefix fetch vs re-prefill vs a static ring,
+SERVE_r12_peerkv.json), and ``--spec`` / ``--multilora`` (speculative
 decoding as a ragged scheduling mode, token-exact vs plain; 64-adapter
 multi-LoRA fleet with (prefix, adapter) affinity vs adapter-oblivious
 routing — both into SERVE_r10_spec.json).
@@ -1923,6 +1925,349 @@ def main_diurnal(args) -> int:
     return 0 if win else 1
 
 
+# -- fleet KV tier ring-churn arm (--ring-churn) ------------------------
+
+# Each tenant's shared chain, in full KV blocks. Long enough that the
+# chain's re-prefill dominates the peer path's fixed costs (three
+# loopback hops + the per-block import writes) — the same reasoning as
+# PREFIX_BLOCKS above, and the same length.
+CHURN_PREFIX_BLOCKS = 16
+CHURN_TAIL_TOKENS = 5      # unique per-request suffix
+CHURN_DECODE_TOKENS = 6
+CHURN_TENANTS = 6          # per churn cycle: stayers + movers
+CHURN_MOVERS = 2           # tenants whose owner the join steals
+CHURN_CYCLES = 2
+CHURN_SLOTS = 2
+
+
+def _churn_prefix(seed: int, vocab: int) -> list:
+    """One tenant's shared chain (full blocks only, deterministic)."""
+    n = CHURN_PREFIX_BLOCKS * BLOCK_SIZE
+    return [3 + (seed * 389 + i * 11) % (vocab - 4) for i in range(n)]
+
+
+def _churn_tail(nonce: int, vocab: int) -> list:
+    return [3 + (nonce * 29 + i * 13) % (vocab - 4)
+            for i in range(CHURN_TAIL_TOKENS)]
+
+
+def _make_churn_engine():
+    from kubeflow_tpu.models.paged import PagedBatcher
+    from kubeflow_tpu.models.serving import GenerationConfig
+
+    params, cfg = _load_model()
+    prompt_len = CHURN_PREFIX_BLOCKS * BLOCK_SIZE + CHURN_TAIL_TOKENS
+    per_seq = -(-(prompt_len + CHURN_DECODE_TOKENS) // BLOCK_SIZE) + 1
+    # Every cycle's tenant chains must stay resident fleet-wide (plus
+    # the export/import warm chain), or an evicted donor chain turns a
+    # peer fetch into chain_gone noise.
+    chains = (CHURN_CYCLES * CHURN_TENANTS + 1) * (CHURN_PREFIX_BLOCKS + 2)
+    return PagedBatcher(
+        params, cfg,
+        gen=GenerationConfig(max_new_tokens=CHURN_DECODE_TOKENS,
+                             eos_id=-1),
+        slots=CHURN_SLOTS, num_blocks=CHURN_SLOTS * per_seq + chains + 2,
+        block_size=BLOCK_SIZE,
+        prompt_bucket=(CHURN_PREFIX_BLOCKS + 1) * BLOCK_SIZE,
+        prefix_cache=True,
+    )
+
+
+def _build_churn_fleet(peer_fanout: int):
+    """3 fused in-ring replicas + 1 started standby (out of the ring
+    until the join event). The probe loop is parked far out — churn is
+    driven explicitly via add_replica/remove_replica, and an in-process
+    probe racing a JIT compile must not reshuffle the ring mid-round."""
+    from kubeflow_tpu.models.gateway import ServingGateway, \
+        prompt_chain_keys
+    from kubeflow_tpu.models.server import InferenceServer
+
+    _, cfg = _load_model()
+    engines = [_make_churn_engine() for _ in range(4)]
+    # Compile the export/import shapes (per-block device<->host copies)
+    # before the engines go behind servers: the first measured peer
+    # fetch must pay transfer cost, not compile cost. The jit cache is
+    # process-wide, so only the first fleet of the run pays anything.
+    warm = _churn_prefix(7, cfg.vocab_size) + _churn_tail(0, cfg.vocab_size)
+    engines[0].submit(warm, max_new_tokens=1)
+    engines[0].run()
+    payload = engines[0].export_chain(
+        prompt_chain_keys(warm, BLOCK_SIZE))
+    engines[1].import_chain(payload, warm)
+    servers = [
+        InferenceServer(eng, port=0, drain_s=2.0).start()
+        for eng in engines
+    ]
+    eps = [f"{s.host}:{s.port}" for s in servers]
+    gw = ServingGateway(
+        eps[:3], port=0, affinity="prefix", block_size=BLOCK_SIZE,
+        health_interval_s=30.0, reroute_budget=2,
+        kv_peer_fanout=peer_fanout,
+    ).start()
+    gw.probe_once()
+    return gw, servers, eps, cfg
+
+
+def _stream_ttft(gw, prompt, tenant: str, timeout: float = 120.0):
+    """One streaming completion; returns (ok, ttft_seconds, detail).
+    TTFT — request start to first SSE data line at the client — is the
+    cost a peer fetch must beat re-prefill on."""
+    conn = http.client.HTTPConnection(gw.host, gw.port, timeout=timeout)
+    try:
+        t0 = time.perf_counter()
+        conn.request(
+            "POST", "/v1/completions",
+            json.dumps({"prompt": prompt, "stream": True,
+                        "max_tokens": CHURN_DECODE_TOKENS,
+                        "user": tenant}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return False, 0.0, f"HTTP {resp.status}"
+        ttft = None
+        finished = False
+        error = None
+        while True:
+            line = resp.fp.readline()
+            if not line:
+                break
+            if not line.startswith(b"data:"):
+                continue
+            if line == b"data: [DONE]\n":
+                finished = True
+                break
+            if b'"error"' in line:
+                error = line.decode().strip()
+                continue
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+        if not finished or error or ttft is None:
+            return False, 0.0, error or "truncated stream"
+        return True, ttft, ""
+    except OSError as err:
+        return False, 0.0, str(err)
+    finally:
+        conn.close()
+
+
+def _churn_pick_tenants(gw, standby_ep: str, vocab: int, seed0: int):
+    """Tenant chains for one cycle, split by what the standby's join
+    does to their route: ``movers`` are stolen by the standby,
+    ``stayers`` keep their owner. Ownership is read off the REAL ring
+    (tentative add/remove), so the split can never drift from routing;
+    the prefix router learns a chain on first sight, so each candidate
+    is keyed twice and judged on the stable key."""
+    movers, stayers = [], []
+    want_stay = CHURN_TENANTS - CHURN_MOVERS
+    seed = seed0
+    while len(movers) < CHURN_MOVERS or len(stayers) < want_stay:
+        seed += 1
+        if seed > seed0 + 500:
+            raise RuntimeError("no ring split found for churn tenants")
+        prefix = _churn_prefix(seed, vocab)
+        gw._route_key(prefix)
+        key = gw._route_key(prefix)
+        before = gw._candidates(key)
+        gw.add_replica(standby_ep)
+        after = gw._candidates(key)
+        gw.remove_replica(standby_ep)
+        if not before or not after:
+            continue
+        if after[0] == standby_ep and len(movers) < CHURN_MOVERS:
+            movers.append(prefix)
+        elif after[0] == before[0] and len(stayers) < want_stay:
+            stayers.append(prefix)
+    return movers, stayers
+
+
+def _fleet_prefix_counters(servers) -> tuple:
+    hits = sum(s.engine.prefix_hits for s in servers)
+    misses = sum(s.engine.prefix_misses for s in servers)
+    return hits, misses
+
+
+def run_churn_arm(mode: str, *, cycles: int) -> dict:
+    """mode="static": no churn, the prefix-hit-ratio baseline.
+    mode="peer": join/leave churn with the peer-fetch tier armed.
+    mode="noPeer": the same churn, fanout=0 — every moved chain
+    re-prefills from scratch."""
+    fanout = 2 if mode == "peer" else 0
+    gw, servers, eps, cfg = _build_churn_fleet(fanout)
+    standby = eps[3]
+    vocab = cfg.vocab_size
+    nonce = iter(range(1, 1 << 20))
+    outcomes: list = []
+    moved_ttfts: list = []
+    measured_hits = measured_misses = 0
+
+    def drive(prompts, bucket=None):
+        nonlocal measured_hits, measured_misses
+        h0, m0 = _fleet_prefix_counters(servers)
+        for i, prefix in enumerate(prompts):
+            prompt = prefix + _churn_tail(next(nonce), vocab)
+            ok, ttft, detail = _stream_ttft(gw, prompt, f"tenant-{i}")
+            outcomes.append((ok, detail))
+            if bucket is not None and ok:
+                bucket.append(ttft)
+        h1, m1 = _fleet_prefix_counters(servers)
+        measured_hits += h1 - h0
+        measured_misses += m1 - m0
+
+    telemetry = _build_telemetry()
+    try:
+        for cycle in range(cycles):
+            movers, stayers = _churn_pick_tenants(
+                gw, standby, vocab, seed0=1000 * (cycle + 1))
+            tenants = movers + stayers
+            # Warm pass 1 registers each chain (full prefill); pass 2
+            # confirms residency and compiles the full-hit suffix shape.
+            # Neither is measured.
+            for prefix in tenants:
+                _stream_ttft(gw, prefix + _churn_tail(next(nonce), vocab),
+                             "warm")
+            for prefix in tenants:
+                _stream_ttft(gw, prefix + _churn_tail(next(nonce), vocab),
+                             "warm")
+            if cycle == 0:
+                gw.telemetry = telemetry
+                gw._tenant_buckets = telemetry.tenants
+            drive(tenants)                      # steady, warm ring
+            if mode == "static":
+                drive(tenants)
+                continue
+            gw.add_replica(standby)             # JOIN: movers go cold
+            drive(movers, bucket=moved_ttfts)   # the gated TTFT sample
+            drive(stayers)
+            drive(tenants)                      # steady on the 4-ring
+            gw.remove_replica(standby)          # LEAVE: back to warm owners
+            drive(tenants)
+        gw.probe_once()
+        stats = gw.stats()
+        signals = _debug_json(gw, "/debug/signals")
+        failures = [d for ok, d in outcomes if not ok]
+        total = measured_hits + measured_misses
+        return {
+            "mode": mode,
+            "requests_completed": sum(1 for ok, _ in outcomes if ok),
+            "failures": failures,
+            "prefix_hit_ratio": round(measured_hits / max(total, 1), 4),
+            "prefix_hits": measured_hits,
+            "prefix_misses": measured_misses,
+            "moved_ttft_p95_ms": (_p95_ms(moved_ttfts)
+                                  if moved_ttfts else None),
+            "moved_requests": len(moved_ttfts),
+            "kv_peer": {
+                "fetches": stats["kv_peer_fetches"],
+                "fetch_failures": stats["kv_peer_fetch_failures"],
+                "bytes": stats["kv_peer_bytes"],
+                "fetch_latency_s": stats["kv_peer_fetch_latency_s"],
+                "max_bytes": stats["kv_peer"]["max_bytes"],
+                "failure_reasons": stats["kv_peer"]["failure_reasons"],
+            },
+            "signals_kv_peer_fetch_s": (signals.get("fleet") or {}).get(
+                "kv_peer_fetch_s"),
+        }
+    finally:
+        gw.stop()
+        for s in servers:
+            s.stop()
+
+
+def main_ring_churn(args) -> int:
+    """--ring-churn: replicas join and leave mid-run. The peer-fetch
+    tier must hold the fleet prefix hit ratio within 10% of a static
+    ring's, beat re-prefill on moved-chain p95 TTFT, keep every fetch
+    under the byte cap, and fail nothing — while the fanout=0 control
+    shows what churn costs without it."""
+    global CHURN_PREFIX_BLOCKS, CHURN_TENANTS, CHURN_MOVERS
+    cycles = CHURN_CYCLES
+    if args.smoke:
+        CHURN_PREFIX_BLOCKS, CHURN_TENANTS, CHURN_MOVERS = 4, 4, 1
+        cycles = 1
+    print(f"# ring-churn static baseline: 3 replicas, "
+          f"{CHURN_TENANTS} tenants x {cycles} cycles ...",
+          file=sys.stderr)
+    static = run_churn_arm("static", cycles=cycles)
+    print("# ring-churn peer arm: join/leave churn, kv_peer_fanout=2 "
+          "...", file=sys.stderr)
+    peer = run_churn_arm("peer", cycles=cycles)
+    print("# ring-churn control arm: same churn, peer tier off ...",
+          file=sys.stderr)
+    no_peer = run_churn_arm("noPeer", cycles=cycles)
+
+    record = {
+        "scenario": (
+            f"{CHURN_TENANTS} tenants with {CHURN_PREFIX_BLOCKS}-block "
+            "shared chains over 3 prefix-cached fused replicas; a "
+            "standby joins and leaves the ring each cycle, moving "
+            f"{CHURN_MOVERS} tenants' chains — peer prefix fetch vs "
+            "re-prefill vs a static ring"
+        ),
+        "model": "tiny",
+        "block_size": BLOCK_SIZE,
+        "prefix_blocks": CHURN_PREFIX_BLOCKS,
+        "tenants": CHURN_TENANTS,
+        "movers": CHURN_MOVERS,
+        "cycles": cycles,
+        "provenance": "smoke" if args.smoke else "live",
+        "host": _record_host(),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "static": static,
+        "peer": peer,
+        "no_peer": no_peer,
+    }
+    print(json.dumps({
+        "static_hit_ratio": static["prefix_hit_ratio"],
+        "peer_hit_ratio": peer["prefix_hit_ratio"],
+        "no_peer_hit_ratio": no_peer["prefix_hit_ratio"],
+        "peer_moved_p95_ttft_ms": peer["moved_ttft_p95_ms"],
+        "no_peer_moved_p95_ttft_ms": no_peer["moved_ttft_p95_ms"],
+        "kv_peer_fetches": peer["kv_peer"]["fetches"],
+        "kv_peer_fetch_failures": peer["kv_peer"]["fetch_failures"],
+        "kv_peer_bytes": peer["kv_peer"]["bytes"],
+    }))
+    clean = (
+        not static["failures"] and not peer["failures"]
+        and not no_peer["failures"]
+        and peer["kv_peer"]["fetches"] >= 1
+        and peer["kv_peer"]["fetch_failures"] == 0
+        and no_peer["kv_peer"]["fetches"] == 0
+        and peer["kv_peer"]["bytes"]
+        <= peer["kv_peer"]["fetches"] * peer["kv_peer"]["max_bytes"]
+    )
+    if not clean:
+        print("# ring-churn gate FAILED: " + json.dumps({
+            "failures": {"static": static["failures"],
+                         "peer": peer["failures"],
+                         "no_peer": no_peer["failures"]},
+            "kv_peer": peer["kv_peer"],
+            "no_peer_fetches": no_peer["kv_peer"]["fetches"],
+        }), file=sys.stderr)
+    if args.smoke:
+        print("# --smoke: artifact write and win gate skipped",
+              file=sys.stderr)
+        return 0 if clean else 1
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(tmp, args.out)
+    print(f"# wrote {args.out}", file=sys.stderr)
+    ratio_held = (peer["prefix_hit_ratio"]
+                  >= 0.9 * static["prefix_hit_ratio"])
+    ttft_wins = (peer["moved_ttft_p95_ms"] is not None
+                 and no_peer["moved_ttft_p95_ms"] is not None
+                 and peer["moved_ttft_p95_ms"]
+                 < no_peer["moved_ttft_p95_ms"])
+    win = clean and ratio_held and ttft_wins
+    if not win:
+        print("# win gate: " + json.dumps({
+            "hit_ratio_within_10pct": ratio_held,
+            "peer_beats_reprefill_ttft": ttft_wins,
+        }), file=sys.stderr)
+    return 0 if win else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
@@ -1952,6 +2297,11 @@ def main() -> int:
                          "static small/big fleets, plus a disagg "
                          "long-prompt storm "
                          "(artifact: SERVE_r11_autoscale.json)")
+    ap.add_argument("--ring-churn", action="store_true",
+                    help="run the fleet-KV-tier churn experiment: "
+                         "replicas join/leave mid-run, peer prefix "
+                         "fetch vs re-prefill vs a static ring "
+                         "(artifact: SERVE_r12_peerkv.json)")
     ap.add_argument("--smoke", action="store_true",
                     help="2 replicas x 2 tenants x 2 rounds, no artifact, "
                          "no win gate — CI executability tier")
@@ -1959,11 +2309,14 @@ def main() -> int:
     root = Path(__file__).resolve().parent.parent
     if args.out is None:
         args.out = str(root / (
-            "SERVE_r11_autoscale.json" if args.diurnal
+            "SERVE_r12_peerkv.json" if args.ring_churn
+            else "SERVE_r11_autoscale.json" if args.diurnal
             else "SERVE_r10_spec.json" if args.spec or args.multilora
             else "SERVE_r09_hbm.json" if args.evict_storm
             else "SERVE_r08_disagg.json" if args.disagg
             else "SERVE_r07_fleet.json"))
+    if args.ring_churn:
+        return main_ring_churn(args)
     if args.diurnal:
         return main_diurnal(args)
     if args.spec or args.multilora:

@@ -1,0 +1,28 @@
+"""BAD: HTTP fan-out over ring members with no fanout bound and no
+per-hop timeout — one walk can visit the whole fleet, and the first
+half-dead peer hangs the entire walk."""
+
+import http.client
+import urllib.request
+
+
+def probe_all_peers(peers, keys):
+    matched = {}
+    for ep in peers:
+        host, port = ep.rsplit(":", 1)
+        conn = http.client.HTTPConnection(host, int(port), timeout=1.0)
+        conn.request("POST", "/kv/probe", keys)
+        matched[ep] = conn.getresponse().read()
+    return matched
+
+
+def walk_whole_ring(ring, key):
+    for ep in ring.successors(key, len(ring)):
+        urllib.request.urlopen(f"http://{ep}/healthz")
+
+
+def hang_on_first_corpse(peers, fanout):
+    for ep in peers[:fanout]:
+        host, port = ep.rsplit(":", 1)
+        conn = http.client.HTTPConnection(host, int(port))
+        conn.request("GET", "/healthz")

@@ -1,0 +1,37 @@
+"""GOOD: every ring fan-out bounds the peer set at the loop header (or
+breaks on a fanout counter) and gives each hop its own timeout, so a
+walk costs at most fanout x hop_timeout."""
+
+import http.client
+
+
+def probe_some_peers(peers, keys, fanout):
+    matched = {}
+    for ep in peers[:fanout]:
+        host, port = ep.rsplit(":", 1)
+        conn = http.client.HTTPConnection(host, int(port), timeout=1.0)
+        conn.request("POST", "/kv/probe", keys)
+        matched[ep] = conn.getresponse().read()
+    return matched
+
+
+def walk_with_budget(ring, key, budget):
+    out = []
+    for ep in ring.successors(key, budget):
+        host, port = ep.rsplit(":", 1)
+        conn = http.client.HTTPConnection(host, int(port), timeout=0.5)
+        conn.request("GET", "/healthz")
+        out.append((ep, conn.getresponse().status))
+    return out
+
+
+def counter_bounded_walk(peers, fanout):
+    probed = 0
+    for ep in peers:
+        host, port = ep.rsplit(":", 1)
+        conn = http.client.HTTPConnection(host, int(port), timeout=0.5)
+        conn.request("GET", "/healthz")
+        probed += 1
+        if probed >= fanout:
+            break
+    return probed

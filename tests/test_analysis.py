@@ -36,6 +36,7 @@ RULE_FOR_FIXTURE = {
     "annotation_literal": "annotation-literal",
     "suppression_hygiene": "suppression-hygiene",
     "undeadlined_claim": "undeadlined-claim",
+    "unbounded_fanout": "kftpu-unbounded-fanout",
     "parse_error": "parse-error",
 }
 

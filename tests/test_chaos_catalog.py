@@ -57,6 +57,10 @@ def test_catalog_has_reference_parity_experiments():
         # handoff re-routes within budget, never silent truncation, and
         # the decode tier stays healthy.
         "serving-kv-handoff-loss",
+        # Fleet KV tier (models/gateway.py peer prefix fetch): the
+        # probed peer dies mid-export — the fetch degrades to
+        # re-prefill, the corpse is negative-cached, no client notices.
+        "serving-kv-peer-loss",
         # Fleet autoscaler (models/autoscaler.py): scale-down under
         # stream churn — drain before release, never kill a stream.
         "autoscaler-scaledown-storm",

@@ -270,6 +270,37 @@ class TestSwapInterop:
         finally:
             srv.stop()
 
+    def test_export_chain_promotes_swap_resident(self, tiny):
+        """Peer export must reach through the swap tier: a chain demoted
+        to host RAM is promoted back, exported byte-exact vs a
+        never-demoted replica, and lands as a prefix hit on the
+        importer."""
+        b = _engine(tiny, swap_bytes=1 << 22)
+        b.submit(PROMPT, max_new_tokens=8)
+        b.run()
+        while b._evict_prefix_leaf():
+            pass
+        (key,) = prompt_chain_keys(PROMPT, BS)
+        assert b.swap_contains(key) and not b._prefix_entries
+        payload = b.export_chain([key])
+        assert payload is not None
+        assert b.kv_swap_in == 1 and not b.swap_contains(key)
+        assert b.kv_chain_exports == 1
+        a = _engine(tiny)
+        a.submit(PROMPT, max_new_tokens=8)
+        a.run()
+        ref = a.export_chain([key])
+        assert [e["data"] for e in payload["blocks"]] == \
+            [e["data"] for e in ref["blocks"]]
+        c = _engine(tiny)
+        assert c.import_chain(payload, PROMPT) == 1
+        rid = c.submit(PROMPT, max_new_tokens=8)
+        got = c.run()[rid]
+        assert c.prefix_hits == 1
+        d = _engine(tiny)
+        r = d.submit(PROMPT, max_new_tokens=8)
+        assert got == d.run()[r]
+
 
 class TestPoolFromHbm:
     def test_cpu_falls_back_to_constant(self, tiny):

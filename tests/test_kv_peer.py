@@ -1,0 +1,579 @@
+"""Fleet KV tier: read-through peer prefix fetch.
+
+Robustness is the product under test: the happy path imports a peer's
+chain instead of re-prefilling (fused AND disagg routing), and EVERY
+failure mode — dead peer, slow peer, oversized payload, version skew,
+validation quarantine, concurrent duplicate fetch, negative-cache
+expiry — degrades to local re-prefill with the request still streaming
+every token + ``[DONE]``. The tier must also be fully inert when the
+fanout knob is unset: zero hot-path cost, zero new sockets.
+"""
+
+from __future__ import annotations
+
+import http.client
+import http.server
+import json
+import threading
+import time
+
+import jax
+import pytest
+
+from kubeflow_tpu.models import llama as L
+from kubeflow_tpu.models.gateway import (
+    ServingGateway,
+    gateway_from_env,
+    prompt_chain_keys,
+)
+from kubeflow_tpu.models.paged import PagedBatcher
+from kubeflow_tpu.models.server import InferenceServer
+from kubeflow_tpu.models.serving import GenerationConfig
+
+BS = 8
+PROMPT_LEN = 20  # → 2 registrable chain blocks
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = L.LLAMA_CONFIGS["tiny"]
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _engine(tiny, **kw):
+    cfg, params = tiny
+    kw.setdefault("slots", 2)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("prompt_bucket", 32)
+    kw.setdefault("prefix_cache", True)
+    return PagedBatcher(
+        params, cfg, gen=GenerationConfig(max_new_tokens=8, eos_id=-1),
+        block_size=BS, **kw,
+    )
+
+
+def _targeted_prompt(gw, endpoint: str, exclude=()) -> list:
+    """A prompt whose fused affinity target is ``endpoint`` — the same
+    nonce search the chaos catalog uses for victim targeting."""
+    for nonce in range(3, 250):
+        prompt = [nonce] + list(range(2, PROMPT_LEN + 1))
+        if tuple(prompt) in exclude:
+            continue
+        # The prefix router learns the chain on first sight (a fresh
+        # prompt routes by its first block, later calls by its deepest
+        # block), so warm it once and target with the stable key the
+        # actual request will also compute.
+        gw._route_key(prompt)
+        cands = gw._candidates(gw._route_key(prompt))
+        if cands and cands[0] == endpoint:
+            return prompt
+    raise AssertionError(f"no prompt routed to {endpoint}")
+
+
+def _stream(host, port, prompt, max_tokens=6, timeout=120) -> list:
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    conn.request(
+        "POST", "/v1/completions",
+        json.dumps({"prompt": prompt, "max_tokens": max_tokens,
+                    "stream": True}).encode(),
+        {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    toks, done = [], False
+    while True:
+        line = resp.fp.readline()
+        if not line:
+            break
+        if line == b"data: [DONE]\n":
+            done = True
+            break
+        if line.startswith(b"data:"):
+            body = json.loads(line[5:])
+            assert "error" not in body, body
+            toks.append(body["token"])
+    conn.close()
+    assert done, "stream ended without [DONE]"
+    return toks
+
+
+def _reference(tiny, prompt, max_tokens=6) -> list:
+    eng = _engine(tiny)
+    rid = eng.submit(prompt, max_new_tokens=max_tokens)
+    return eng.run()[rid]
+
+
+def _warm(srv, prompt) -> None:
+    """Warm a replica's prefix cache by running the prompt directly on
+    it (not through any gateway)."""
+    conn = http.client.HTTPConnection(srv.host, srv.port, timeout=60)
+    conn.request(
+        "POST", "/v1/completions",
+        json.dumps({"prompt": prompt, "max_tokens": 2}).encode(),
+        {"Content-Type": "application/json"})
+    assert conn.getresponse().status == 200
+    conn.close()
+
+
+class _FakePeer:
+    """Replica impostor: healthy on /healthz (so it stays in the ring)
+    but misbehaves on the peer-fetch endpoints per the injected
+    behaviors. ``probe``/``chain`` are dicts to answer with, or None to
+    tear the connection (a corpse / mid-export crash)."""
+
+    def __init__(self, probe=None, chain=None, probe_delay=0.0):
+        self.probe_hits = 0
+        self.chain_hits = 0
+        peer = self
+
+        class _H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._json(200, {"status": "ok"})
+                else:
+                    self._json(200, {})
+
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get(
+                    "Content-Length", 0) or 0))
+                if self.path == "/kv/probe":
+                    peer.probe_hits += 1
+                    if probe_delay:
+                        time.sleep(probe_delay)
+                    if probe is None:
+                        self.connection.close()
+                        return
+                    self._json(200, probe)
+                elif self.path == "/kv/chain":
+                    peer.chain_hits += 1
+                    if chain is None:
+                        self.connection.close()
+                        return
+                    self._json(200, chain)
+                else:
+                    self._json(404, {"error": "not found"})
+
+        self._srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self.host, self.port = self._srv.server_address[:2]
+        self.endpoint = f"{self.host}:{self.port}"
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def _chain_payload(tiny, prompt):
+    """A genuine export of ``prompt``'s registrable chain, for fakes to
+    serve (and tests to tamper with)."""
+    eng = _engine(tiny)
+    eng.submit(prompt, max_new_tokens=1)
+    eng.run()
+    return eng.export_chain(prompt_chain_keys(prompt, BS))
+
+
+class TestInertWhenUnset:
+    def test_default_gateway_never_touches_the_peer_tier(self, tiny):
+        """No fanout knob → zero peer probes, zero chain traffic, and
+        the /stats block says so."""
+        srvs = [InferenceServer(_engine(tiny), port=0,
+                                drain_s=0.5).start() for _ in range(2)]
+        gw = ServingGateway(
+            [f"{s.host}:{s.port}" for s in srvs], port=0, block_size=BS,
+            health_interval_s=30.0,
+        ).start()
+        gw.probe_once()
+        try:
+            assert gw.kv_peer_fanout == 0
+            prompt = [7] + list(range(2, PROMPT_LEN + 1))
+            toks = _stream(gw.host, gw.port, prompt)
+            assert len(toks) == 6
+            stats = gw.stats()
+            assert stats["kv_peer"]["enabled"] is False
+            assert stats["kv_peer_fetches"] == 0
+            assert stats["kv_peer_fetch_failures"] == 0
+            for s in srvs:
+                assert s.engine.kv_chain_exports == 0
+                assert s.engine.kv_chain_imports == 0
+        finally:
+            gw.stop()
+            for s in srvs:
+                s.stop()
+
+    def test_from_env_defaults_inert_and_parses_fail_fast(
+            self, monkeypatch):
+        monkeypatch.setenv("KUBEFLOW_TPU_GATEWAY_PORT", "0")
+        monkeypatch.setenv("KUBEFLOW_TPU_GATEWAY_REPLICAS",
+                           "10.0.0.1:8000")
+        gw = gateway_from_env()
+        assert gw.kv_peer_fanout == 0
+        assert gw.kv_peer_timeout_s == 5.0
+        assert gw.kv_peer_max_bytes == 64 << 20
+
+        monkeypatch.setenv("KUBEFLOW_TPU_KV_PEER_FANOUT", "3")
+        monkeypatch.setenv("KUBEFLOW_TPU_KV_PEER_TIMEOUT_S", "2.5")
+        monkeypatch.setenv("KUBEFLOW_TPU_KV_PEER_MAX_BYTES", "1048576")
+        gw = gateway_from_env()
+        assert gw.kv_peer_fanout == 3
+        assert gw.kv_peer_timeout_s == 2.5
+        assert gw.kv_peer_max_bytes == 1048576
+
+        # Garbage must raise, not silently disable the tier.
+        for name, bad in (
+            ("KUBEFLOW_TPU_KV_PEER_FANOUT", "0"),
+            ("KUBEFLOW_TPU_KV_PEER_FANOUT", "many"),
+            ("KUBEFLOW_TPU_KV_PEER_TIMEOUT_S", "0"),
+            ("KUBEFLOW_TPU_KV_PEER_TIMEOUT_S", "fast"),
+            ("KUBEFLOW_TPU_KV_PEER_MAX_BYTES", "-1"),
+        ):
+            with monkeypatch.context() as m:
+                m.setenv(name, bad)
+                with pytest.raises(ValueError, match=name):
+                    gateway_from_env()
+
+    def test_constructor_validates_knobs(self):
+        with pytest.raises(ValueError, match="kv_peer_fanout"):
+            ServingGateway([], port=0, kv_peer_fanout=-1)
+        with pytest.raises(ValueError, match="kv_peer_timeout_s"):
+            ServingGateway([], port=0, kv_peer_timeout_s=0)
+        with pytest.raises(ValueError, match="kv_peer_max_bytes"):
+            ServingGateway([], port=0, kv_peer_max_bytes=0)
+
+
+class TestFusedPeerFetch:
+    def test_peer_chain_imported_instead_of_reprefill(self, tiny):
+        """Warm the ring NEIGHBOR, stream through the gateway: the
+        target imports the neighbor's chain, counts a prefix hit, and
+        the tokens match a fresh single-engine reference."""
+        srvs = [InferenceServer(_engine(tiny), port=0,
+                                drain_s=0.5).start() for _ in range(2)]
+        eps = [f"{s.host}:{s.port}" for s in srvs]
+        gw = ServingGateway(eps, port=0, block_size=BS,
+                            health_interval_s=30.0,
+                            kv_peer_fanout=2).start()
+        gw.probe_once()  # full ring before nonce-targeting
+        try:
+            target = eps[0]
+            prompt = _targeted_prompt(gw, target)
+            peer_srv = srvs[1]
+            _warm(peer_srv, prompt)
+            toks = _stream(gw.host, gw.port, prompt)
+            assert toks == _reference(tiny, prompt)
+            stats = gw.stats()
+            assert stats["kv_peer_fetches"] == 1
+            assert stats["kv_peer_fetch_failures"] == 0
+            assert stats["kv_peer_bytes"] > 0
+            assert stats["kv_peer_fetch_latency_s"] > 0
+            assert stats["kv_peer"]["failure_reasons"] == {}
+            assert peer_srv.engine.kv_chain_exports == 1
+            assert srvs[0].engine.kv_chain_imports == 1
+            assert srvs[0].engine.prefix_hits >= 1
+            assert srvs[0].engine.prefix_misses == 0
+        finally:
+            gw.stop()
+            for s in srvs:
+                s.stop()
+
+    def test_single_flight_skips_duplicate_fetch(self, tiny):
+        """A fetch already in flight for the same tail chain key makes
+        the second request SKIP the ladder (straight to re-prefill) —
+        no duplicate peer traffic, no waiting."""
+        srv = InferenceServer(_engine(tiny), port=0, drain_s=0.5).start()
+        gw = ServingGateway([f"{srv.host}:{srv.port}"], port=0,
+                            block_size=BS, health_interval_s=30.0,
+                            kv_peer_fanout=1).start()
+        gw.probe_once()
+        try:
+            prompt = [5] + list(range(2, PROMPT_LEN + 1))
+            tail = prompt_chain_keys(prompt, BS)[-1].hex()
+            gw._kv_peer_inflight.add(tail)  # a fetch "in flight"
+            toks = _stream(gw.host, gw.port, prompt)
+            assert len(toks) == 6  # re-prefilled, stream intact
+            stats = gw.stats()
+            assert stats["kv_peer"]["single_flight_skips"] == 1
+            assert stats["kv_peer_fetches"] == 0
+            gw._kv_peer_inflight.discard(tail)
+        finally:
+            gw.stop()
+            srv.stop()
+
+
+def _one_real_one_fake(tiny, fake, **gw_kw):
+    srv = InferenceServer(_engine(tiny), port=0, drain_s=0.5).start()
+    eps = [f"{srv.host}:{srv.port}", fake.endpoint]
+    gw_kw.setdefault("kv_peer_fanout", 2)
+    gw = ServingGateway(eps, port=0, block_size=BS,
+                        health_interval_s=30.0, **gw_kw).start()
+    gw.probe_once()  # both in the ring before the first request
+    return srv, gw
+
+
+class TestFailureModesDegradeToReprefill:
+    """One fleet per failure mode: a real target replica plus a fake
+    peer misbehaving in exactly one way. Every test asserts the stream
+    still delivered all tokens + [DONE] and the mode landed in the
+    failure-reason scoreboard."""
+
+    def _run(self, tiny, fake, reason, gw_kw=None, n=1):
+        srv, gw = _one_real_one_fake(tiny, fake, **(gw_kw or {}))
+        try:
+            used = set()
+            for _ in range(n):
+                prompt = _targeted_prompt(
+                    gw, f"{srv.host}:{srv.port}", exclude=used)
+                used.add(tuple(prompt))
+                toks = _stream(gw.host, gw.port, prompt)
+                assert len(toks) == 6
+            stats = gw.stats()
+            assert stats["kv_peer_fetches"] == 0
+            assert stats["kv_peer"]["failure_reasons"].get(reason, 0) >= 1
+            return gw, stats
+        finally:
+            gw.stop()
+            srv.stop()
+
+    def test_dead_peer_negative_cached_and_not_reprobed(self, tiny):
+        fake = _FakePeer(probe=None)  # tears every probe connection
+        try:
+            srv, gw = _one_real_one_fake(tiny, fake)
+            try:
+                used = set()
+                for i in range(2):
+                    prompt = _targeted_prompt(
+                        gw, f"{srv.host}:{srv.port}", exclude=used)
+                    used.add(tuple(prompt))
+                    toks = _stream(gw.host, gw.port, prompt)
+                    assert len(toks) == 6
+                stats = gw.stats()
+                # Probed ONCE: the second request hit the negative cache
+                # instead of re-probing the corpse.
+                assert fake.probe_hits == 1
+                assert stats["kv_peer"]["failure_reasons"] == {
+                    "dead_peer": 1}
+                assert stats["kv_peer"]["negative_cached"] == [
+                    fake.endpoint]
+                assert stats["kv_peer"]["negative_hits"] >= 1
+            finally:
+                gw.stop()
+                srv.stop()
+        finally:
+            fake.stop()
+
+    def test_negative_cache_expiry_admits_one_fresh_probe(self, tiny):
+        fake = _FakePeer(probe=None)
+        try:
+            srv, gw = _one_real_one_fake(tiny, fake)
+            try:
+                real = f"{srv.host}:{srv.port}"
+                used = set()
+                prompt = _targeted_prompt(gw, real, exclude=used)
+                used.add(tuple(prompt))
+                _stream(gw.host, gw.port, prompt)
+                assert fake.probe_hits == 1
+                # Force the hold to expire: the next miss may probe the
+                # peer again (it might have healed).
+                deadline, fails = gw._kv_peer_negative[fake.endpoint]
+                gw._kv_peer_negative[fake.endpoint] = (0.0, fails)
+                prompt = _targeted_prompt(gw, real, exclude=used)
+                _stream(gw.host, gw.port, prompt)
+                assert fake.probe_hits == 2
+                # Still dead → backoff escalates, not resets.
+                assert gw._kv_peer_negative[fake.endpoint][1] == fails + 1
+            finally:
+                gw.stop()
+                srv.stop()
+        finally:
+            fake.stop()
+
+    def test_slow_peer_times_out_as_dead(self, tiny):
+        fake = _FakePeer(probe={"matched": 2, "payload_bytes": 64},
+                         probe_delay=1.5)
+        try:
+            gw, stats = self._run(
+                tiny, fake, "dead_peer",
+                gw_kw={"kv_peer_timeout_s": 0.3})
+            assert fake.chain_hits == 0
+        finally:
+            fake.stop()
+
+    def test_oversized_chain_refused_before_pulling(self, tiny):
+        """The probe's payload byte advisory is enforced BEFORE the
+        transfer: no /kv/chain request ever reaches the peer."""
+        fake = _FakePeer(probe={"matched": 2,
+                                "payload_bytes": 999 << 20})
+        try:
+            self._run(tiny, fake, "oversized")
+            assert fake.probe_hits == 1
+            assert fake.chain_hits == 0
+        finally:
+            fake.stop()
+
+    def test_peer_dying_mid_export_backs_off(self, tiny):
+        """Probe succeeds, the chain pull tears mid-response: the peer
+        is treated as dead for the backoff window and the request
+        re-prefills."""
+        fake = _FakePeer(probe={"matched": 2, "payload_bytes": 64},
+                         chain=None)
+        try:
+            gw, stats = self._run(tiny, fake, "fetch_failed")
+            assert fake.chain_hits == 1
+            assert stats["kv_peer"]["negative_cached"] == [fake.endpoint]
+        finally:
+            fake.stop()
+
+    def test_version_skew_quarantined(self, tiny):
+        prompt = [3] + list(range(2, PROMPT_LEN + 1))
+        payload = _chain_payload(tiny, prompt)
+        skewed = {**payload, "version": 2}
+        fake = _FakePeer(
+            probe={"matched": 2, "payload_bytes": 64},
+            chain={"matched": 2, "payload": skewed})
+        try:
+            gw, stats = self._run(tiny, fake, "quarantined")
+            assert stats["kv_peer"]["quarantined"] == 1
+            (entry,) = stats["kv_peer"]["quarantine"]
+            assert entry["endpoint"] == fake.endpoint
+            assert "version" in entry["error"]
+        finally:
+            fake.stop()
+
+    def test_chain_key_mismatch_quarantined(self, tiny):
+        """A peer whose hashing diverged must be quarantined, not
+        decoded from: the target validates every key against its own
+        prompt tokens."""
+        prompt = [3] + list(range(2, PROMPT_LEN + 1))
+        payload = _chain_payload(tiny, prompt)
+        tampered = json.loads(json.dumps(payload))
+        tampered["blocks"][0]["key"] = "00" * 20
+        fake = _FakePeer(
+            probe={"matched": 2, "payload_bytes": 64},
+            chain={"matched": 2, "payload": tampered})
+        try:
+            gw, stats = self._run(tiny, fake, "quarantined")
+            (entry,) = stats["kv_peer"]["quarantine"]
+            assert "chain-key mismatch" in entry["error"]
+        finally:
+            fake.stop()
+
+
+class TestDisaggPeerFetch:
+    def test_decode_tier_warmed_from_sibling_replica(self, tiny):
+        """Disagg routing: the probed decode replica is cold but its
+        sibling holds the chain — the gateway imports it into the
+        target decode replica, the prefill tier ships suffix-only, and
+        the stream is token-exact."""
+        roles = {}
+        srvs = {}
+        for name, role in (("prefill", "prefill"), ("d1", "decode"),
+                           ("d2", "decode")):
+            srvs[name] = InferenceServer(
+                _engine(tiny), port=0, drain_s=0.5, tier_role=role,
+            ).start()
+            roles[f"{srvs[name].host}:{srvs[name].port}"] = role
+        gw = ServingGateway(
+            list(roles), port=0, block_size=BS, health_interval_s=30.0,
+            tier_mode="disagg", tier_roles=roles, kv_peer_fanout=2,
+        ).start()
+        gw.probe_once()
+        try:
+            by_ep = {f"{s.host}:{s.port}": s for s in srvs.values()}
+            prompt = None
+            for nonce in range(3, 250):
+                cand = [nonce] + list(range(2, PROMPT_LEN + 1))
+                gw._route_key(cand)  # let the prefix router learn it
+                decodes = gw._tier_candidates(
+                    "decode", gw._route_key(cand))
+                if len(decodes) == 2:
+                    prompt, target, donor = cand, decodes[0], decodes[1]
+                    break
+            assert prompt is not None
+            _warm(by_ep[donor], prompt)
+            toks = _stream(gw.host, gw.port, prompt)
+            assert toks == _reference(tiny, prompt)
+            stats = gw.stats()
+            assert stats["kv_peer_fetches"] == 1
+            assert stats["kv_transfers"] == 1
+            assert by_ep[donor].engine.kv_chain_exports == 1
+            assert by_ep[target].engine.kv_chain_imports == 1
+        finally:
+            gw.stop()
+            for s in srvs.values():
+                s.stop()
+
+
+class TestChainPrimitives:
+    """Engine-level export_chain/import_chain: the wire format the HTTP
+    hops carry."""
+
+    def test_roundtrip_registers_and_hits(self, tiny):
+        prompt = list(range(1, PROMPT_LEN + 1))
+        a = _engine(tiny)
+        a.submit(prompt, max_new_tokens=1)
+        a.run()
+        keys = prompt_chain_keys(prompt, BS)
+        payload = a.export_chain(keys)
+        assert a.kv_chain_exports == 1
+        assert len(payload["blocks"]) == 2
+        assert all("data" in e for e in payload["blocks"])
+        b = _engine(tiny)
+        assert b.import_chain(payload, prompt) == 2
+        assert b.kv_chain_imports == 1
+        rid = b.submit(prompt, max_new_tokens=6)
+        got = b.run()[rid]
+        # prefix_hits counts per block, and both imported blocks land.
+        assert b.prefix_hits == 2
+        assert got == _reference(tiny, prompt)
+
+    def test_export_partial_and_empty(self, tiny):
+        prompt = list(range(1, PROMPT_LEN + 1))
+        a = _engine(tiny)
+        a.submit(prompt, max_new_tokens=1)
+        a.run()
+        keys = prompt_chain_keys(prompt, BS)
+        # A foreign tail key truncates the export to the held prefix.
+        partial = a.export_chain([keys[0], b"\x00" * 20])
+        assert len(partial["blocks"]) == 1
+        assert a.export_chain([b"\x00" * 20]) is None
+        cold = _engine(tiny)
+        assert cold.export_chain(keys) is None
+
+    def test_import_validates(self, tiny):
+        prompt = list(range(1, PROMPT_LEN + 1))
+        a = _engine(tiny)
+        a.submit(prompt, max_new_tokens=1)
+        a.run()
+        payload = a.export_chain(prompt_chain_keys(prompt, BS))
+        b = _engine(tiny)
+        with pytest.raises(ValueError, match="version"):
+            b.import_chain({**payload, "version": 2}, prompt)
+        with pytest.raises(ValueError, match="block_size"):
+            b.import_chain({**payload, "block_size": 16}, prompt)
+        with pytest.raises(ValueError, match="kv_bits"):
+            b.import_chain({**payload, "kv_bits": 8}, prompt)
+        tampered = json.loads(json.dumps(payload))
+        tampered["blocks"][0]["key"] = "00" * 20
+        with pytest.raises(ValueError, match="chain-key mismatch"):
+            b.import_chain(tampered, prompt)
+        # More chain blocks than the prompt can register → refused.
+        with pytest.raises(ValueError, match="chain"):
+            b.import_chain(payload, prompt[:9])
+        assert b.kv_chain_imports == 0
+
+    def test_requires_prefix_cache(self, tiny):
+        plain = _engine(tiny, prefix_cache=False)
+        with pytest.raises(RuntimeError, match="prefix_cache"):
+            plain.export_chain([b"\x00" * 20])
+        with pytest.raises(ValueError, match="prefix_cache"):
+            plain.import_chain({"version": 1}, list(range(20)))
