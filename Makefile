@@ -58,6 +58,9 @@ dryrun: ## Multi-chip sharding compile check on a virtual 8-device mesh
 lint: ## kftpu-lint: AST engine with cross-module contract checks (+ semgrep if present)
 	bash ci/lint.sh
 
+lint-baseline: ## Regenerate kftpu-lint's baseline (rule rollout only — the standing bar is empty)
+	$(PYTHON) -m kubeflow_tpu.analysis kubeflow_tpu/ --update-baseline
+
 native: ## Build native C++ components (data loader, slice prober)
 	$(MAKE) -C native
 

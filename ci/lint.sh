@@ -2,20 +2,50 @@
 # Static-analysis gate, two tiers:
 #   1. kftpu-lint — the in-repo AST engine (kubeflow_tpu/analysis): cross-
 #      module contract checks (env contract, metric registry, annotation
-#      vocabulary, chaos parity) plus concurrency lints. JSON mode; any
-#      unsuppressed finding fails the build. Required — it runs on the
+#      vocabulary, chaos parity) plus interprocedural concurrency and JAX
+#      hot-path rules. JSON mode; any gating finding (unsuppressed,
+#      unbaselined, in-diff) fails the build. Required — it runs on the
 #      same Python the tests use.
 #   2. semgrep — the pattern tier (semgrep.yaml). Optional: skipped with a
 #      notice when the tool is unavailable, mirroring ci/kind_e2e.sh.
+#
+# Modes:
+#   bash ci/lint.sh                  full-repo gate (the tier-1 bar: the
+#                                    checked-in baseline is empty, so this
+#                                    is "zero gating findings anywhere")
+#   LINT_PR_MODE=1 bash ci/lint.sh   PR gate: --diff origin/main..HEAD —
+#                                    findings outside the PR's changed
+#                                    lines never gate (rule-rollout safe)
+#   LINT_DIFF_RANGE=a..b             explicit range, overrides PR mode
+#   LINT_SARIF=path.sarif            SARIF 2.1.0 artifact destination
+#                                    (default kftpu-lint.sarif, for code-
+#                                    scanning upload)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "--- kftpu-lint (AST engine, JSON mode)"
+diff_args=()
+if [[ -n "${LINT_DIFF_RANGE:-}" ]]; then
+  diff_args=(--diff "$LINT_DIFF_RANGE")
+elif [[ "${LINT_PR_MODE:-0}" == "1" ]]; then
+  if git rev-parse --verify --quiet origin/main >/dev/null; then
+    diff_args=(--diff origin/main..HEAD)
+  else
+    echo "WARN: LINT_PR_MODE=1 but origin/main is unknown; full-repo gate"
+  fi
+fi
+
+sarif_out="${LINT_SARIF:-kftpu-lint.sarif}"
+echo "--- kftpu-lint (SARIF artifact: $sarif_out)"
+python -m kubeflow_tpu.analysis kubeflow_tpu/ --sarif "${diff_args[@]+"${diff_args[@]}"}" \
+  > "$sarif_out" || true
+
+echo "--- kftpu-lint (AST engine, JSON gate${diff_args[0]:+, ${diff_args[*]}})"
 out=$(mktemp)
-if ! python -m kubeflow_tpu.analysis kubeflow_tpu/ --format json > "$out"; then
-  echo "FAIL: unsuppressed kftpu-lint findings:"
-  python -m kubeflow_tpu.analysis kubeflow_tpu/ || true
-  rm -f "$out"
+trap 'rm -f "$out"' EXIT
+if ! python -m kubeflow_tpu.analysis kubeflow_tpu/ --format json \
+    "${diff_args[@]+"${diff_args[@]}"}" > "$out"; then
+  echo "FAIL: gating kftpu-lint findings:"
+  python -m kubeflow_tpu.analysis kubeflow_tpu/ "${diff_args[@]+"${diff_args[@]}"}" || true
   exit 1
 fi
 python - "$out" <<'EOF'
@@ -23,11 +53,12 @@ import json, sys
 report = json.load(open(sys.argv[1]))
 print(
     f"kftpu-lint: {report['checked_files']} files checked, "
-    f"{report['unsuppressed']} unsuppressed, "
-    f"{report['suppressed']} suppressed"
+    f"{report['gating']} gating "
+    f"({report['suppressed']} suppressed, "
+    f"{report['baselined']} baselined, "
+    f"{report['out_of_diff']} outside diff)"
 )
 EOF
-rm -f "$out"
 
 if command -v semgrep >/dev/null 2>&1; then
   echo "--- semgrep (pattern tier)"

@@ -1,21 +1,38 @@
-"""CLI: python -m kubeflow_tpu.analysis [paths ...] [--format json]."""
+"""CLI: python -m kubeflow_tpu.analysis [paths ...] [--format json]
+       [--diff RANGE] [--sarif] [--baseline FILE] [--update-baseline].
+"""
 
 from __future__ import annotations
 
 import argparse
 import json
 import sys
+from pathlib import Path
 
+from kubeflow_tpu.analysis import baseline as baseline_mod
 from kubeflow_tpu.analysis.engine import run_analysis
 from kubeflow_tpu.analysis.rules import ALL_RULES
+from kubeflow_tpu.analysis.sarif import report_to_sarif
+
+
+def _print_rules() -> None:
+    for rule in ALL_RULES:
+        print(f"{rule.id}\n    {' '.join(rule.description.split())}")
+        for incident in getattr(rule, "incidents", ()):
+            print(f"    incident: {' '.join(incident.split())}")
+        docs = getattr(rule, "docs", "")
+        if docs:
+            print(f"    docs: {docs}")
+    print("parse-error\n    File could not be parsed as Python (engine-emitted).")
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m kubeflow_tpu.analysis",
         description=(
-            "kftpu-lint: AST analysis with cross-module contract checks. "
-            "Exits 1 when unsuppressed findings exist."
+            "kftpu-lint: AST analysis with cross-module contract and "
+            "interprocedural concurrency checks. Exits 1 when gating "
+            "(unsuppressed, unbaselined, in-diff) findings exist."
         ),
     )
     parser.add_argument(
@@ -32,18 +49,54 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--list-rules", action="store_true",
-        help="print every rule id and description, then exit",
+        help="print every rule id, description, incident citations and "
+             "docs links, then exit",
+    )
+    parser.add_argument(
+        "--baseline", metavar="FILE", default=None,
+        help="baseline file to gate against (default: the checked-in "
+             "kubeflow_tpu/analysis/baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: every unsuppressed finding gates",
+    )
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="write the current unsuppressed findings to the baseline "
+             "file and exit 0 (use via `make lint-baseline`)",
+    )
+    parser.add_argument(
+        "--diff", metavar="RANGE", default=None,
+        help="git range (e.g. origin/main..HEAD); findings outside the "
+             "range's changed lines do not gate",
+    )
+    parser.add_argument(
+        "--sarif", action="store_true",
+        help="emit SARIF 2.1.0 JSON on stdout (overrides --format)",
     )
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for rule in ALL_RULES:
-            print(f"{rule.id}\n    {' '.join(rule.description.split())}")
-        print("parse-error\n    File could not be parsed as Python (engine-emitted).")
+        _print_rules()
         return 0
 
-    report = run_analysis(paths=args.paths or None)
-    if args.format == "json":
+    baseline_path = Path(args.baseline) if args.baseline else None
+    report = run_analysis(
+        paths=args.paths or None,
+        baseline_path=False if args.no_baseline else baseline_path,
+        diff_range=args.diff,
+    )
+
+    if args.update_baseline:
+        target = baseline_path or baseline_mod.BASELINE_PATH
+        count = baseline_mod.write_baseline(report, report.index, target)
+        print(f"kftpu-lint: baseline written to {target} ({count} entries)")
+        return 0
+
+    if args.sarif:
+        print(json.dumps(report_to_sarif(report, ALL_RULES), indent=2))
+    elif args.format == "json":
         print(json.dumps(report.as_dict(), indent=2, sort_keys=True))
     else:
         print(report.render_text(include_suppressed=args.include_suppressed))

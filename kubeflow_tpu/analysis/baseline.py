@@ -1,0 +1,147 @@
+"""kftpu-lint baseline + diff gating.
+
+Two mechanisms that make a new rule shippable against a mature repo
+without a flag day:
+
+- **baseline** (``analysis/baseline.json``, checked in): known findings,
+  fingerprinted by (rule, path, normalized source-line text) so entries
+  survive line-number drift from unrelated edits. A finding matching an
+  unconsumed baseline entry is marked ``baselined`` and does not gate;
+  ``make lint-baseline`` regenerates the file. The repo's standing bar is
+  an **empty** baseline — the mechanism exists for rule rollout, not as a
+  parking lot (a justified inline suppression is the long-term answer).
+
+- **diff mode** (``--diff <git-range>``): findings outside the range's
+  changed lines are marked ``out_of_diff`` and do not gate — PR CI gets
+  "you may not add findings" even mid-rollout of a noisy rule.
+
+Gating findings = unsuppressed - baselined - out_of_diff; the exit code
+rides on that.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+BASELINE_VERSION = 1
+
+_HUNK_RE = re.compile(r"^@@ -\d+(?:,\d+)? \+(\d+)(?:,(\d+))? @@")
+
+
+def fingerprint(finding, index) -> str:
+    """Stable identity: rule + path + the stripped source line. Survives
+    pure line-shift; a same-rule finding on an identical duplicated line
+    is disambiguated by consumption order (each entry matches once)."""
+    mod = index.by_rel.get(finding.path)
+    line_text = ""
+    if mod is not None and 0 < finding.line <= len(mod.lines):
+        line_text = mod.lines[finding.line - 1].strip()
+    digest = hashlib.sha1(
+        f"{finding.rule}\n{finding.path}\n{line_text}".encode("utf-8")
+    )
+    return digest.hexdigest()[:16]
+
+
+def load_baseline(path: Optional[Path] = None) -> list:
+    target = Path(path) if path else BASELINE_PATH
+    if not target.is_file():
+        return []
+    data = json.loads(target.read_text(encoding="utf-8"))
+    return list(data.get("findings", []))
+
+
+def apply_baseline(report, entries: list, index) -> None:
+    """Mark unsuppressed findings matching an unconsumed entry."""
+    unused = {}
+    for entry in entries:
+        key = (entry.get("rule"), entry.get("path"), entry.get("fingerprint"))
+        unused[key] = unused.get(key, 0) + 1
+    for finding in report.findings:
+        if finding.suppressed:
+            continue
+        key = (finding.rule, finding.path, fingerprint(finding, index))
+        if unused.get(key, 0) > 0:
+            unused[key] -= 1
+            finding.baselined = True
+
+
+def write_baseline(report, index, path: Optional[Path] = None) -> int:
+    """Snapshot every unsuppressed finding; returns the entry count."""
+    entries = [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "fingerprint": fingerprint(f, index),
+            "line": f.line,  # informational only; matching is by fingerprint
+            "message": f.message,
+        }
+        for f in report.unsuppressed
+    ]
+    target = Path(path) if path else BASELINE_PATH
+    target.write_text(
+        json.dumps(
+            {"version": BASELINE_VERSION, "findings": entries},
+            indent=2,
+            sort_keys=True,
+        )
+        + "\n",
+        encoding="utf-8",
+    )
+    return len(entries)
+
+
+def changed_lines(git_range: str, repo_root: Path) -> Optional[dict]:
+    """rel posix path -> set of changed (new-side) line numbers for the
+    range, or None when git cannot answer (not a repo, bad range)."""
+    try:
+        proc = subprocess.run(
+            ["git", "diff", "--unified=0", "--no-color", git_range, "--", "*.py"],
+            cwd=str(repo_root),
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    out: dict = {}
+    current: Optional[str] = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("+++ "):
+            name = line[4:].strip()
+            current = None if name == "/dev/null" else name.removeprefix("b/")
+            continue
+        m = _HUNK_RE.match(line)
+        if m and current is not None:
+            start = int(m.group(1))
+            count = int(m.group(2)) if m.group(2) is not None else 1
+            if count:
+                out.setdefault(current, set()).update(
+                    range(start, start + count)
+                )
+            else:
+                # pure deletion: keep the file keyed so file-level
+                # findings (line 1 parse errors etc.) still gate
+                out.setdefault(current, set())
+    return out
+
+
+def apply_diff_filter(report, changed: dict) -> None:
+    """Mark findings outside the changed lines as out_of_diff."""
+    for finding in report.findings:
+        if finding.suppressed:
+            continue
+        lines = changed.get(finding.path)
+        if lines is None:
+            finding.out_of_diff = True
+        elif finding.line not in lines and finding.line != 1:
+            # line-1 findings are file-level (parse-error); any change to
+            # the file keeps them gating
+            finding.out_of_diff = True

@@ -1,0 +1,340 @@
+"""kftpu-lint call graph: the interprocedural substrate every v2 rule rides.
+
+PR 4's rules were single-module pattern matchers; the one interprocedural
+walker (BlockingInSignalHandler's worklist over same-module defs) was
+private to that rule and could not cross files. This module extracts and
+generalizes it:
+
+- a repo-wide **class map** (classes, their bases, their methods, and the
+  attribute types learned from ``self.x = SomeClass(...)`` assignments in
+  any method, plus the declared hints in config.ATTR_TYPE_HINTS for
+  attributes that are only ever assigned from constructor parameters);
+- **call-site resolution**: bare names through the module's def table and
+  import table, ``self.m()``/``cls.m()`` through the class map with base
+  walking, ``self.attr.m()`` through the learned attribute types, dotted
+  names through imports, and a *bounded* dynamic-dispatch fallback (an
+  unqualified ``obj.m()`` resolves only when at most
+  config.DISPATCH_CAP classes in the repo define ``m`` and ``m`` is not a
+  ubiquitous name) — unresolvable calls contribute no edges rather than
+  guesses;
+- **bounded-depth reachability** with full witness paths, so rules can
+  report *how* a handler reaches a blocking call, not just that it does.
+
+Lock-protocol methods (acquire/release/wait/...) never resolve through
+the dynamic-dispatch fallback, and receivers whose name looks like a
+synchronization primitive never produce edges at all: a spurious edge
+from ``q.all_tasks_done.acquire()`` into some repo class's ``acquire``
+would poison every concurrency rule downstream.
+
+Everything stays pure ``ast``: no analyzed code is imported.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from kubeflow_tpu.analysis import config
+from kubeflow_tpu.analysis.core import SourceModule, dotted_parts
+
+_LOCKISH_RE = re.compile(r"lock|cond|sem|mutex|event|busy", re.IGNORECASE)
+
+
+def is_lockish_name(name: str) -> bool:
+    """Does this identifier look like a synchronization primitive?"""
+    return bool(_LOCKISH_RE.search(name))
+
+
+def direct_nodes(stmts) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested def/lambda bodies —
+    nested functions only run when called, and calls are followed
+    explicitly by the reachability walker."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@dataclass
+class FunctionNode:
+    """One function or method definition in the repo."""
+
+    key: str  # unique: "<rel>::<Class.>name[#lineno]"
+    mod: SourceModule
+    cls: Optional[str]  # owning class name, None for module-level defs
+    name: str
+    node: ast.AST  # FunctionDef / AsyncFunctionDef
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+    def where(self) -> str:
+        return f"{self.mod.rel}:{self.node.lineno}"
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    mod: SourceModule
+    node: ast.ClassDef
+    bases: list = field(default_factory=list)  # base-class leaf names
+    methods: dict = field(default_factory=dict)  # name -> FunctionNode
+    # attribute -> set of class names learned from `self.attr = Cls(...)`
+    attr_types: dict = field(default_factory=dict)
+
+
+class CallGraph:
+    """Repo-wide call graph over a RepoIndex's modules."""
+
+    def __init__(self, index):
+        self.index = index
+        self.functions: dict = {}  # key -> FunctionNode
+        self.classes: dict = {}  # class name -> [ClassInfo] (collisions kept)
+        self.class_of_node: dict = {}  # id(ClassDef) -> ClassInfo
+        self.module_defs: dict = {}  # mod.name -> {fn name -> [FunctionNode]}
+        self.edges: dict = {}  # caller key -> [(ast.Call, FunctionNode)]
+        self._fn_for_def: dict = {}  # id(def node) -> FunctionNode
+        self._build()
+
+    # -- construction --------------------------------------------------------
+
+    def _build(self) -> None:
+        for mod in self.index.modules.values():
+            if mod.tree is None:
+                continue
+            self._collect_module(mod)
+        for infos in self.classes.values():
+            for info in infos:
+                self._learn_attr_types(info)
+        for fn in self.functions.values():
+            self.edges[fn.key] = self._resolve_edges(fn)
+
+    def _collect_module(self, mod: SourceModule) -> None:
+        defs: dict = self.module_defs.setdefault(mod.name, {})
+
+        def add_fn(node, cls: Optional[str]) -> FunctionNode:
+            key = f"{mod.rel}::{cls + '.' if cls else ''}{node.name}#{node.lineno}"
+            fn = FunctionNode(key, mod, cls, node.name, node)
+            self.functions[key] = fn
+            self._fn_for_def[id(node)] = fn
+            defs.setdefault(node.name, []).append(fn)
+            return fn
+
+        for node in mod.walk():
+            if isinstance(node, ast.ClassDef):
+                info = ClassInfo(node.name, mod, node)
+                for base in node.bases:
+                    parts = dotted_parts(base)
+                    if parts:
+                        info.bases.append(parts[-1])
+                for child in node.body:
+                    if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        info.methods[child.name] = add_fn(child, node.name)
+                self.classes.setdefault(node.name, []).append(info)
+                self.class_of_node[id(node)] = info
+        # Defs not directly under a class body (module level and nested).
+        for node in mod.walk():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if id(node) not in self._fn_for_def:
+                    add_fn(node, None)
+
+    def _learn_attr_types(self, info: ClassInfo) -> None:
+        for hint_key, (type_name, _reason) in config.ATTR_TYPE_HINTS.items():
+            cls_name, attr = hint_key
+            if cls_name == info.name:
+                info.attr_types.setdefault(attr, set()).add(type_name)
+        for method in info.methods.values():
+            for node in direct_nodes(method.node.body):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for target in node.targets:
+                    if not (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                    ):
+                        continue
+                    for cls_name in self._constructed_classes(
+                        method.mod, node.value
+                    ):
+                        info.attr_types.setdefault(target.attr, set()).add(
+                            cls_name
+                        )
+
+    def _constructed_classes(self, mod: SourceModule, expr: ast.AST) -> set:
+        """Class names constructed anywhere in expr (IfExp/BoolOp branches
+        included) that resolve to classes known to the repo."""
+        out: set = set()
+        candidates = [expr]
+        if isinstance(expr, ast.IfExp):
+            candidates = [expr.body, expr.orelse]
+        elif isinstance(expr, ast.BoolOp):
+            candidates = list(expr.values)
+        for cand in candidates:
+            if not isinstance(cand, ast.Call):
+                continue
+            parts = dotted_parts(cand.func)
+            if not parts:
+                continue
+            leaf = parts[-1]
+            if leaf in self.classes:
+                out.add(leaf)
+        return out
+
+    # -- resolution ----------------------------------------------------------
+
+    def fn_for(self, def_node: ast.AST) -> Optional[FunctionNode]:
+        return self._fn_for_def.get(id(def_node))
+
+    def class_method(
+        self, info: ClassInfo, name: str, _seen: Optional[set] = None
+    ) -> Optional[FunctionNode]:
+        """Look up a method on a class, walking base classes by name."""
+        if name in info.methods:
+            return info.methods[name]
+        seen = _seen if _seen is not None else set()
+        seen.add(info.name)
+        for base in info.bases:
+            if base in seen:
+                continue
+            for base_info in self.classes.get(base, []):
+                found = self.class_method(base_info, name, seen)
+                if found is not None:
+                    return found
+        return None
+
+    def _dispatch(self, method: str) -> list:
+        """Bounded dynamic-dispatch fallback for an untyped receiver."""
+        if method in config.DISPATCH_SKIP_NAMES:
+            return []
+        if method in config.LOCK_PROTOCOL_METHODS:
+            return []
+        candidates = [
+            info.methods[method]
+            for infos in self.classes.values()
+            for info in infos
+            if method in info.methods
+        ]
+        if 1 <= len(candidates) <= config.DISPATCH_CAP:
+            return candidates
+        return []
+
+    def _lookup_dotted(self, dotted: str) -> list:
+        parts = dotted.split(".")
+        if len(parts) < 2:
+            return []
+        owner, leaf = ".".join(parts[:-1]), parts[-1]
+        mod = self.index.modules.get(owner)
+        if mod is None:
+            return []
+        for fn in self.module_defs.get(mod.name, {}).get(leaf, []):
+            if fn.cls is None:
+                return [fn]
+        # Imported class constructed directly: edge into its __init__.
+        for info in self.classes.get(leaf, []):
+            if info.mod is mod and "__init__" in info.methods:
+                return [info.methods["__init__"]]
+        return []
+
+    def resolve_call(self, caller: FunctionNode, call: ast.Call) -> list:
+        parts = dotted_parts(call.func)
+        if parts is None:
+            return []
+        mod = caller.mod
+        if len(parts) == 1:
+            name = parts[0]
+            local = [
+                fn
+                for fn in self.module_defs.get(mod.name, {}).get(name, [])
+                if fn.cls is None
+            ]
+            if local:
+                return local
+            if name in self.classes:
+                for info in self.classes[name]:
+                    if "__init__" in info.methods:
+                        return [info.methods["__init__"]]
+                return []
+            target = mod.imports.get(name)
+            if target and "." in target:
+                return self._lookup_dotted(target)
+            return []
+        leaf = parts[-1]
+        receiver_leaf = parts[-2]
+        if is_lockish_name(receiver_leaf):
+            return []  # lock.acquire()/cond.wait() are not repo methods
+        if parts[0] in ("self", "cls") and caller.cls:
+            infos = [
+                info
+                for info in self.classes.get(caller.cls, [])
+                if info.mod is caller.mod
+            ]
+            if len(parts) == 2 and infos:
+                found = self.class_method(infos[0], leaf)
+                return [found] if found else self._dispatch(leaf)
+            if len(parts) == 3 and infos:
+                types = infos[0].attr_types.get(parts[1], set())
+                resolved = []
+                for type_name in types:
+                    for type_info in self.classes.get(type_name, []):
+                        found = self.class_method(type_info, leaf)
+                        if found:
+                            resolved.append(found)
+                return resolved or self._dispatch(leaf)
+            return self._dispatch(leaf)
+        # Dotted through the import table: module.func / pkg.mod.func.
+        head = mod.imports.get(parts[0])
+        if head:
+            dotted = ".".join([head] + parts[1:])
+            found = self._lookup_dotted(dotted)
+            if found:
+                return found
+            if head.startswith("kubeflow_tpu"):
+                return []  # repo-internal but unknown: no guessing
+        return self._dispatch(leaf)
+
+    def _resolve_edges(self, fn: FunctionNode) -> list:
+        edges = []
+        for node in direct_nodes(fn.node.body):
+            if isinstance(node, ast.Call):
+                for target in self.resolve_call(fn, node):
+                    edges.append((node, target))
+        return edges
+
+    # -- reachability --------------------------------------------------------
+
+    def reachable(
+        self, start: FunctionNode, max_depth: Optional[int] = None
+    ) -> Iterator[tuple]:
+        """BFS from start, yielding (fn, depth, path) where path is a tuple
+        of (caller FunctionNode, ast.Call) hops leading to fn. Depth 0 is
+        start itself with an empty path. Recursion-safe: each function is
+        visited once at its shallowest depth."""
+        depth_cap = config.CALLGRAPH_MAX_DEPTH if max_depth is None else max_depth
+        seen = {start.key}
+        frontier = [(start, 0, ())]
+        while frontier:
+            fn, depth, path = frontier.pop(0)
+            yield fn, depth, path
+            if depth >= depth_cap:
+                continue
+            for call, target in self.edges.get(fn.key, []):
+                if target.key in seen:
+                    continue
+                seen.add(target.key)
+                frontier.append((target, depth + 1, path + ((fn, call),)))
+
+    def render_path(self, path: tuple, final: FunctionNode) -> str:
+        """'a (x.py:10) -> b (y.py:20) -> c' for a reachability path."""
+        hops = [
+            f"{caller.qualname} ({caller.mod.rel}:{call.lineno})"
+            for caller, call in path
+        ]
+        hops.append(final.qualname)
+        return " -> ".join(hops)

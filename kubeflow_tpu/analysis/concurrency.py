@@ -1,0 +1,726 @@
+"""kftpu-lint concurrency rules: the interprocedural family.
+
+Built on callgraph.CallGraph plus a repo-wide **lock model**:
+
+- every ``self.x = threading.Lock()/RLock()/Condition()/Semaphore()``
+  attribute and every module-level lock, with ``Condition(self._lock)``
+  aliased to the lock it wraps (waiting on the condition IS holding the
+  lock);
+- per-function scans recording, for each call site and each attribute
+  access, the **lock-set held** at that point (``with <lock>:`` regions
+  only — bare ``acquire()/release()`` pairs are deliberately untracked,
+  because pairing them textually is guesswork; the repo's bounded
+  ``acquire(timeout=)`` idiom stays invisible and that is the honest
+  answer);
+- lock-sets propagated over the call graph, bounded by
+  config.LOCK_PROPAGATION_DEPTH, carrying witness paths.
+
+Three rules ship on top:
+
+- ``kftpu-lock-order-cycle`` — a cycle in the fleet-wide
+  lock-acquisition-order graph, reported with a witness acquisition path
+  for every edge on the cycle (PR 3's deadlock was exactly an order
+  inversion the single-function rules could not see);
+- ``kftpu-lock-held-await`` — a lock held across a call-graph-reachable
+  blocking call (HTTP, queue ops, unbounded wait, subprocess, the k8s
+  warm-slice claim walk). Depth >= 1 only: the depth-0 case is
+  lock-held-blocking-call's single-function territory;
+- ``kftpu-unguarded-shared-write`` — an attribute of a lock-owning class
+  written from >= 2 entry paths (Thread targets, signal handlers, HTTP
+  ``do_*`` methods, the loop-method conventions) with no common lock
+  across the write sites (PR 11's stream-accounting race).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kubeflow_tpu.analysis import config
+from kubeflow_tpu.analysis.callgraph import (
+    FunctionNode,
+    direct_nodes,
+    is_lockish_name,
+)
+from kubeflow_tpu.analysis.core import (
+    Finding,
+    SourceModule,
+    dotted_parts,
+    resolved_callee,
+)
+
+_LOCK_CONSTRUCTORS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "threading.Semaphore": "Semaphore",
+    "threading.BoundedSemaphore": "Semaphore",
+}
+
+_DUNDER_INIT = {"__init__", "__post_init__", "__new__", "__enter__"}
+
+
+def _lock_constructor(mod: SourceModule, expr: ast.AST) -> Optional[tuple]:
+    """(kind, wrapped_expr|None) when expr constructs a threading
+    primitive; wrapped_expr is Condition's first positional arg."""
+    if not isinstance(expr, ast.Call):
+        return None
+    callee = resolved_callee(mod, expr)
+    kind = _LOCK_CONSTRUCTORS.get(callee or "")
+    if kind is None:
+        return None
+    wrapped = expr.args[0] if (kind == "Condition" and expr.args) else None
+    return kind, wrapped
+
+
+class LockModel:
+    """Every lock the repo declares, plus helpers to resolve a
+    ``with <expr>:`` context expression to a canonical lock id.
+
+    Lock ids: ``Class.attr`` for instance locks, ``module:NAME`` for
+    module-level locks, ``~leaf`` for lockish-named expressions the model
+    cannot resolve (tracked as held, excluded from the order graph — an
+    anonymous id colliding across unrelated locks would invent cycles).
+    """
+
+    def __init__(self, graph):
+        self.graph = graph
+        self.kinds: dict = {}  # lock id -> Lock/RLock/Condition/Semaphore
+        self.class_locks: dict = {}  # class name -> {attr -> lock id}
+        self.module_locks: dict = {}  # mod name -> {var -> lock id}
+        self._scans: dict = {}  # FunctionNode.key -> _Scan
+        self._build()
+
+    def _build(self) -> None:
+        for infos in self.graph.classes.values():
+            for info in infos:
+                table = self.class_locks.setdefault(info.name, {})
+                # Two passes so Condition(self._lock) can alias a lock
+                # assigned later in the same __init__.
+                raw: list = []
+                for method in info.methods.values():
+                    for node in direct_nodes(method.node.body):
+                        if not isinstance(node, ast.Assign):
+                            continue
+                        made = _lock_constructor(method.mod, node.value)
+                        if made is None:
+                            continue
+                        for target in node.targets:
+                            if (
+                                isinstance(target, ast.Attribute)
+                                and isinstance(target.value, ast.Name)
+                                and target.value.id == "self"
+                            ):
+                                raw.append((target.attr, made))
+                for attr, (kind, _wrapped) in raw:
+                    if kind != "Condition":
+                        lock_id = f"{info.name}.{attr}"
+                        table[attr] = lock_id
+                        self.kinds[lock_id] = kind
+                for attr, (kind, wrapped) in raw:
+                    if kind != "Condition":
+                        continue
+                    parts = dotted_parts(wrapped) if wrapped is not None else None
+                    if (
+                        parts
+                        and len(parts) == 2
+                        and parts[0] == "self"
+                        and parts[1] in table
+                    ):
+                        table[attr] = table[parts[1]]  # alias to wrapped lock
+                    else:
+                        lock_id = f"{info.name}.{attr}"
+                        table[attr] = lock_id
+                        self.kinds[lock_id] = kind
+        for mod in self.graph.index.modules.values():
+            if mod.tree is None:
+                continue
+            table = self.module_locks.setdefault(mod.name, {})
+            for node in mod.tree.body:
+                if not isinstance(node, ast.Assign):
+                    continue
+                made = _lock_constructor(mod, node.value)
+                if made is None:
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        lock_id = f"{mod.name}:{target.id}"
+                        table[target.id] = lock_id
+                        self.kinds[lock_id] = made[0]
+
+    # -- resolution ----------------------------------------------------------
+
+    def resolve_lock_expr(self, fn: FunctionNode, expr: ast.AST) -> Optional[str]:
+        parts = dotted_parts(expr)
+        if parts is None:
+            return None
+        leaf = parts[-1]
+        if len(parts) == 2 and parts[0] == "self" and fn.cls:
+            table = self.class_locks.get(fn.cls, {})
+            if leaf in table:
+                return table[leaf]
+        if len(parts) == 1:
+            table = self.module_locks.get(fn.mod.name, {})
+            if leaf in table:
+                return table[leaf]
+        if len(parts) == 3 and parts[0] == "self" and fn.cls:
+            # self.collab._lock through the learned attribute types.
+            for info in self.graph.classes.get(fn.cls, []):
+                if info.mod is not fn.mod:
+                    continue
+                for type_name in info.attr_types.get(parts[1], set()):
+                    lock_id = self.class_locks.get(type_name, {}).get(leaf)
+                    if lock_id:
+                        return lock_id
+        if is_lockish_name(leaf):
+            return f"~{leaf}"  # held, but anonymous: no order edges
+        return None
+
+    @staticmethod
+    def is_anonymous(lock_id: str) -> bool:
+        return lock_id.startswith("~")
+
+    def scan(self, fn: FunctionNode) -> "_Scan":
+        cached = self._scans.get(fn.key)
+        if cached is None:
+            cached = _scan_function(self, fn)
+            self._scans[fn.key] = cached
+        return cached
+
+
+@dataclass
+class _Scan:
+    """One function's lock-relevant events, each with the locally held
+    lock-set (with-regions inside this function only)."""
+
+    calls: list = field(default_factory=list)  # (ast.Call, frozenset)
+    acquisitions: list = field(default_factory=list)  # (With, id, frozenset before)
+    writes: list = field(default_factory=list)  # (attr, node, frozenset, is_aug)
+
+
+def _scan_function(model: LockModel, fn: FunctionNode) -> _Scan:
+    out = _Scan()
+
+    def visit(node: ast.AST, held: frozenset) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in node.items:
+                visit(item.context_expr, held)
+                lock_id = model.resolve_lock_expr(fn, item.context_expr)
+                if lock_id is not None:
+                    out.acquisitions.append((node, lock_id, frozenset(held)))
+                    inner.add(lock_id)
+            for child in node.body:
+                visit(child, frozenset(inner))
+            return
+        if isinstance(node, ast.Call):
+            out.calls.append((node, held))
+        elif isinstance(node, ast.Assign):
+            for target in node.targets:
+                elts = target.elts if isinstance(target, ast.Tuple) else [target]
+                for elt in elts:
+                    if (
+                        isinstance(elt, ast.Attribute)
+                        and isinstance(elt.value, ast.Name)
+                        and elt.value.id == "self"
+                    ):
+                        out.writes.append((elt.attr, node, held, False))
+        elif isinstance(node, ast.AugAssign):
+            target = node.target
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                out.writes.append((target.attr, node, held, True))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fn.node.body:
+        visit(stmt, frozenset())
+    return out
+
+
+# -- blocking classification for kftpu-lock-held-await -----------------------
+
+
+def _kwarg_names(call: ast.Call) -> set:
+    return {kw.arg for kw in call.keywords if kw.arg}
+
+
+def _queueish_receiver(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    parts = dotted_parts(call.func.value)
+    if not parts:
+        return False
+    low = parts[-1].lower()
+    return low == "q" or "queue" in low
+
+
+def _await_reason(mod: SourceModule, call: ast.Call) -> Optional[str]:
+    """Why this direct call can block for await purposes, or None."""
+    callee = resolved_callee(mod, call) or ""
+    if callee in config.BLOCKING_AWAIT_CALLEES:
+        return config.BLOCKING_AWAIT_CALLEES[callee]
+    leaf = callee.rsplit(".", 1)[-1] if callee else ""
+    if leaf in ("HTTPConnection", "HTTPSConnection"):
+        return "HTTP connection"
+    if leaf == "urlopen":
+        return "network I/O (urlopen)"
+    if leaf in config.BLOCKING_AWAIT_FUNCTIONS:
+        return config.BLOCKING_AWAIT_FUNCTIONS[leaf]
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    bare = not call.args and not call.keywords
+    if attr in ("wait", "join") and bare:
+        if not isinstance(call.func.value, ast.Constant):
+            return f"unbounded {attr}()"
+    if attr in ("put", "get") and _queueish_receiver(call):
+        kwargs = _kwarg_names(call)
+        if "timeout" in kwargs:
+            return None
+        for kw in call.keywords:
+            if (
+                kw.arg == "block"
+                and isinstance(kw.value, ast.Constant)
+                and not kw.value.value
+            ):
+                return None
+        return f"blocking queue .{attr}()"
+    return None
+
+
+# -- the rules ---------------------------------------------------------------
+
+
+class ConcurrencyRule:
+    """Base: lazily builds (and caches on the index) the shared LockModel."""
+
+    id = ""
+    description = ""
+    incidents: tuple = ()
+    docs = ""
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        return []
+
+    def check_repo(self, index, checked: dict) -> list:
+        return []
+
+    @staticmethod
+    def model(index) -> LockModel:
+        cached = getattr(index, "_lock_model", None)
+        if cached is None:
+            cached = LockModel(index.callgraph())
+            index._lock_model = cached
+        return cached
+
+
+def _call_targets(graph, fn: FunctionNode) -> dict:
+    """id(ast.Call) -> [FunctionNode] for a function's resolved edges."""
+    out: dict = {}
+    for call, target in graph.edges.get(fn.key, []):
+        out.setdefault(id(call), []).append(target)
+    return out
+
+
+class LockOrderCycle(ConcurrencyRule):
+    id = "kftpu-lock-order-cycle"
+    description = (
+        "Two code paths acquire the same locks in opposite orders "
+        "(directly or through calls): threads interleaving the paths "
+        "deadlock. The fleet's documented order is autoscaler lock -> "
+        "gateway.stats -> gateway._lock and never the reverse; this rule "
+        "makes that invariant mechanical. Reported with a witness "
+        "acquisition path for every edge on the cycle."
+    )
+    incidents = (
+        "PR 3: emergency-save deadlock — a signal handler re-entered a "
+        "queue mutex its own interrupted thread held",
+    )
+    docs = "ARCHITECTURE.md#static-analysis — lock-order graph"
+
+    def check_repo(self, index, checked: dict) -> list:
+        model = self.model(index)
+        graph = model.graph
+        # (held -> acquired) -> witness dict
+        edges: dict = {}
+
+        def record(held_id, acq_id, witness):
+            if held_id == acq_id:
+                return  # RLock re-entry / same lock: not an order edge
+            if model.is_anonymous(held_id) or model.is_anonymous(acq_id):
+                return
+            edges.setdefault((held_id, acq_id), witness)
+
+        for fn in graph.functions.values():
+            scan = model.scan(fn)
+            for with_node, acq_id, held_before in scan.acquisitions:
+                for held_id in held_before:
+                    record(
+                        held_id,
+                        acq_id,
+                        {
+                            "fn": fn,
+                            "node": with_node,
+                            "path": (),
+                            "holder": fn,
+                        },
+                    )
+            targets = _call_targets(graph, fn)
+            for call, held in scan.calls:
+                if not held or id(call) not in targets:
+                    continue
+                self._propagate(
+                    model, graph, fn, call, held, targets[id(call)], record
+                )
+
+        findings = []
+        adj: dict = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+        reported: set = set()
+        for (a, b) in sorted(edges):
+            cycle = self._path(adj, b, a)
+            if cycle is None:
+                continue
+            nodes = frozenset([a] + cycle)
+            if nodes in reported:
+                continue
+            reported.add(nodes)
+            ring = [a, b] + cycle[1:]  # a -> b -> ... -> a
+            legs = []
+            for i in range(len(ring) - 1):
+                witness = edges.get((ring[i], ring[i + 1]))
+                if witness is None:
+                    continue
+                legs.append(self._render_witness(ring[i], ring[i + 1], witness))
+            first = edges[(a, b)]
+            # Report where the inversion STARTS: the holder's call site
+            # (for a propagated edge) or the nested with (direct) — the
+            # place already holding lock a when lock b gets taken.
+            site_fn = first["holder"]
+            site_node = (
+                first["path"][0][1] if first["path"] else first["node"]
+            )
+            rel = site_fn.mod.rel
+            if rel not in checked:
+                continue
+            order = " -> ".join(ring)
+            findings.append(
+                Finding(
+                    self.id,
+                    rel,
+                    site_node.lineno,
+                    site_node.col_offset,
+                    f"lock-order cycle {order}: "
+                    + "; ".join(legs)
+                    + " — threads interleaving these paths deadlock; pick "
+                    "one fleet-wide acquisition order (see "
+                    "ARCHITECTURE.md#static-analysis)",
+                )
+            )
+        return findings
+
+    def _propagate(self, model, graph, origin, call, held, targets, record):
+        seen = set()
+        frontier = [(t, ((origin, call),)) for t in targets]
+        while frontier:
+            fn, path = frontier.pop(0)
+            if fn.key in seen or len(path) > config.LOCK_PROPAGATION_DEPTH:
+                continue
+            seen.add(fn.key)
+            scan = model.scan(fn)
+            for with_node, acq_id, held_before in scan.acquisitions:
+                for held_id in held | held_before:
+                    record(
+                        held_id,
+                        acq_id,
+                        {"fn": fn, "node": with_node, "path": path,
+                         "holder": origin},
+                    )
+            fn_targets = _call_targets(graph, fn)
+            for inner_call, inner_held in scan.calls:
+                for target in fn_targets.get(id(inner_call), []):
+                    frontier.append((target, path + ((fn, inner_call),)))
+                if inner_held:
+                    # locks taken deeper are handled when that frame is
+                    # visited; nothing extra to do here.
+                    pass
+
+    @staticmethod
+    def _path(adj, src, dst):
+        """Shortest node path src..dst through adj, or None."""
+        frontier = [(src, [src])]
+        seen = {src}
+        while frontier:
+            node, path = frontier.pop(0)
+            if node == dst:
+                return path
+            for nxt in sorted(adj.get(node, ())):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append((nxt, path + [nxt]))
+        return None
+
+    @staticmethod
+    def _render_witness(held_id, acq_id, witness) -> str:
+        where = f"{witness['fn'].mod.rel}:{witness['node'].lineno}"
+        if witness["path"]:
+            hops = " -> ".join(
+                f"{caller.qualname} ({caller.mod.rel}:{call.lineno})"
+                for caller, call in witness["path"]
+            )
+            via = f" via {hops} -> {witness['fn'].qualname}"
+        else:
+            via = f" in {witness['fn'].qualname}"
+        return (
+            f"holding '{held_id}', acquires '{acq_id}' at {where}{via}"
+        )
+
+
+class LockHeldAwait(ConcurrencyRule):
+    id = "kftpu-lock-held-await"
+    description = (
+        "A lock is held across a call that can block — HTTP, a blocking "
+        "queue op, an unbounded wait()/join(), subprocess, or the k8s "
+        "warm-slice claim walk — reached through the call graph (depth "
+        ">= 1; the single-function case is lock-held-blocking-call). "
+        "Every thread needing the lock stalls for the full round trip: "
+        "do the slow work outside the critical section and re-take the "
+        "lock to publish the result."
+    )
+    incidents = (
+        "PR 3: emergency-save deadlock — blocking work reached from a "
+        "context that could not afford to wait",
+    )
+    docs = "CONTRIBUTING.md#modeling-locks-and-thread-entry-points"
+
+    def check_repo(self, index, checked: dict) -> list:
+        model = self.model(index)
+        graph = model.graph
+        findings = []
+        for fn in graph.functions.values():
+            if fn.mod.rel not in checked:
+                continue
+            scan = model.scan(fn)
+            targets = _call_targets(graph, fn)
+            reported: set = set()
+            for call, held in scan.calls:
+                if not held or id(call) not in targets:
+                    continue
+                locks = ", ".join(sorted(h.lstrip("~") for h in held))
+                frontier = [(t, ((fn, call),)) for t in targets[id(call)]]
+                seen: set = set()
+                while frontier:
+                    node, path = frontier.pop(0)
+                    if node.key in seen or len(path) > config.LOCK_AWAIT_DEPTH:
+                        continue
+                    seen.add(node.key)
+                    node_scan = model.scan(node)
+                    for inner_call, _inner_held in node_scan.calls:
+                        reason = _await_reason(node.mod, inner_call)
+                        if reason is None:
+                            continue
+                        key = (call.lineno, node.mod.rel, inner_call.lineno)
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        hops = " -> ".join(
+                            [
+                                f"{c.qualname} ({c.mod.rel}:{cl.lineno})"
+                                for c, cl in path
+                            ]
+                            + [node.qualname]
+                        )
+                        findings.append(
+                            Finding(
+                                self.id,
+                                fn.mod.rel,
+                                call.lineno,
+                                call.col_offset,
+                                f"'{locks}' held across {reason} at "
+                                f"{node.mod.rel}:{inner_call.lineno} "
+                                f"(path: {hops}); move the blocking work "
+                                "outside the critical section and "
+                                "re-take the lock to publish the result",
+                            )
+                        )
+                    node_targets = _call_targets(graph, node)
+                    for inner_call, _h in node_scan.calls:
+                        for target in node_targets.get(id(inner_call), []):
+                            frontier.append(
+                                (target, path + ((node, inner_call),))
+                            )
+        return findings
+
+
+class UnguardedSharedWrite(ConcurrencyRule):
+    id = "kftpu-unguarded-shared-write"
+    description = (
+        "An attribute of a lock-owning class is written from >= 2 entry "
+        "paths — Thread(target=...), a signal handler, an HTTP do_* "
+        "method, or a loop-method convention (run/tick/_drive/_drain) — "
+        "and the write sites share no common lock (one path writes "
+        "unlocked, or the paths use different locks). Lost updates and "
+        "torn multi-field state follow. __init__ writes and plain "
+        "never-locked flag stores are exempt; fire needs an augmented "
+        "write or an inconsistently-guarded write."
+    )
+    incidents = (
+        "PR 11: stream-accounting race — a client hanging up right "
+        "after [DONE] was miscounted as a cancel because two threads "
+        "updated the tally through different guards",
+    )
+    docs = "CONTRIBUTING.md#modeling-locks-and-thread-entry-points"
+
+    def check_repo(self, index, checked: dict) -> list:
+        model = self.model(index)
+        graph = model.graph
+        findings = []
+        for infos in graph.classes.values():
+            for info in infos:
+                if info.mod.rel not in checked:
+                    continue
+                lock_attrs = model.class_locks.get(info.name, {})
+                if not lock_attrs:
+                    continue
+                findings.extend(self._check_class(model, graph, info, lock_attrs))
+        return findings
+
+    def _entry_roots(self, graph, info) -> dict:
+        """method name -> entry kind, for structurally-detected entries."""
+        entries: dict = {}
+        httpish = any("HTTPRequestHandler" in b for b in info.bases)
+        for name in info.methods:
+            if name in config.THREAD_ENTRY_METHODS:
+                entries[name] = "loop method"
+            if name.startswith("do_") and httpish:
+                entries[name] = "HTTP handler"
+        for method in info.methods.values():
+            for node in direct_nodes(method.node.body):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = resolved_callee(method.mod, node) or ""
+                leaf = callee.rsplit(".", 1)[-1]
+                target_expr = None
+                if leaf == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target_expr = kw.value
+                elif callee == "signal.signal" and len(node.args) >= 2:
+                    target_expr = node.args[1]
+                if target_expr is None:
+                    continue
+                parts = dotted_parts(target_expr)
+                if parts and len(parts) == 2 and parts[0] == "self":
+                    if parts[1] in info.methods:
+                        kind = (
+                            "Thread target" if leaf == "Thread"
+                            else "signal handler"
+                        )
+                        entries[parts[1]] = kind
+        return entries
+
+    def _check_class(self, model, graph, info, lock_attrs) -> list:
+        entries = self._entry_roots(graph, info)
+        called_internally: set = set()
+        same_class_targets: dict = {}  # method name -> {id(call) -> [names]}
+        for name, method in info.methods.items():
+            per_call: dict = {}
+            for call, target in graph.edges.get(method.key, []):
+                if target.cls == info.name and target.mod is info.mod:
+                    per_call.setdefault(id(call), []).append(target.name)
+                    called_internally.add(target.name)
+            same_class_targets[name] = per_call
+
+        roots = {
+            name
+            for name in info.methods
+            if name not in called_internally or name in entries
+        }
+        # attr -> list of {root, method, node, held, aug}
+        accesses: dict = {}
+        for root in sorted(roots):
+            if root in _DUNDER_INIT:
+                continue
+            frontier = [(root, frozenset())]
+            seen: set = set()
+            while frontier:
+                name, held = frontier.pop(0)
+                state = (name, held)
+                if state in seen or name in _DUNDER_INIT:
+                    continue
+                seen.add(state)
+                method = info.methods[name]
+                scan = model.scan(method)
+                for attr, node, local_held, is_aug in scan.writes:
+                    if attr in lock_attrs or attr.startswith("__"):
+                        continue
+                    accesses.setdefault(attr, []).append(
+                        {
+                            "root": root,
+                            "method": name,
+                            "node": node,
+                            "held": held | local_held,
+                            "aug": is_aug,
+                        }
+                    )
+                per_call = same_class_targets[name]
+                for call, local_held in scan.calls:
+                    for target_name in per_call.get(id(call), []):
+                        frontier.append((target_name, held | local_held))
+
+        findings = []
+        for attr in sorted(accesses):
+            records = accesses[attr]
+            writer_roots = {r["root"] for r in records}
+            if len(writer_roots) < 2:
+                continue
+            if not any(root in entries for root in writer_roots):
+                continue
+            held_sets = [set(r["held"]) for r in records]
+            common = set.intersection(*held_sets) if held_sets else set()
+            if common:
+                continue
+            some_locked = any(r["held"] for r in records)
+            some_aug = any(r["aug"] for r in records)
+            if not (some_locked or some_aug):
+                continue  # plain never-locked flag stores stay exempt
+            worst = next(
+                (r for r in records if not r["held"]), records[0]
+            )
+            contexts = []
+            for root in sorted(writer_roots):
+                root_records = [r for r in records if r["root"] == root]
+                locks = sorted(
+                    {h.lstrip("~") for r in root_records for h in r["held"]}
+                )
+                kind = entries.get(root, "external caller")
+                guard = f"under {', '.join(locks)}" if locks else "unlocked"
+                lines = sorted({r["node"].lineno for r in root_records})
+                contexts.append(
+                    f"{root} [{kind}] writes {guard} "
+                    f"(line {', '.join(str(n) for n in lines)})"
+                )
+            findings.append(
+                Finding(
+                    self.id,
+                    info.mod.rel,
+                    worst["node"].lineno,
+                    worst["node"].col_offset,
+                    f"self.{attr} of {info.name} is written from "
+                    f"{len(writer_roots)} entry paths with no common "
+                    f"lock: " + "; ".join(contexts) + " — guard every "
+                    f"mutation of {attr} with the same lock",
+                )
+            )
+        return findings
+
+
+CONCURRENCY_RULES = [LockOrderCycle(), LockHeldAwait(), UnguardedSharedWrite()]

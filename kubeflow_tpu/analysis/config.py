@@ -85,6 +85,117 @@ METRICS_OBJECT_API = {
 # possible; a bare Name falls back to this set).
 PROM_CONSTRUCTORS = {"Counter", "Gauge", "Histogram", "Summary"}
 
+# -- interprocedural analysis (callgraph.py / concurrency.py / jaxrules.py) --
+
+# Reachability bound for the shared call graph. Deep enough for every
+# real chain in the repo (handler -> gateway -> replica source -> claim
+# walk is 4 hops); bounded so a pathological cycle cannot explode a rule.
+CALLGRAPH_MAX_DEPTH = 8
+
+# Dynamic-dispatch fallback: an untyped `obj.m()` resolves only when at
+# most this many repo classes define `m`. Above the cap the call
+# contributes no edges — a wrong edge is worse than a missing one.
+DISPATCH_CAP = 3
+
+# Method names too ubiquitous to dispatch on receiver-blind: almost every
+# container/IO/logging object has these, so a name match means nothing.
+DISPATCH_SKIP_NAMES = {
+    "get", "put", "items", "keys", "values", "append", "pop", "add",
+    "close", "read", "write", "inc", "dec", "set", "observe", "labels",
+    "info", "debug", "warning", "error", "exception", "join", "split",
+    "update", "copy", "encode", "decode", "strip", "lower", "upper",
+    "format", "start", "send", "recv", "flush", "clear", "discard",
+    "remove", "extend", "insert", "count", "index", "setdefault",
+}
+
+# Lock-protocol method names never resolve through the dispatch fallback:
+# `q.all_tasks_done.acquire()` must not grow an edge into some repo
+# class's `acquire`. They still resolve through *typed* receivers
+# (learned attr types or ATTR_TYPE_HINTS below).
+LOCK_PROTOCOL_METHODS = {
+    "acquire", "release", "wait", "wait_for", "notify", "notify_all",
+    "locked",
+}
+
+# Attribute types the analyzer cannot learn from `self.x = Cls(...)`
+# because the attribute is only ever assigned from a constructor
+# parameter: (class, attr) -> (type, reason). Extend this table when you
+# add a new injected collaborator whose methods matter to the
+# concurrency rules (see CONTRIBUTING.md "Modeling locks and threads").
+ATTR_TYPE_HINTS = {
+    ("ServingGateway", "replica_source"): (
+        "WarmSliceReplicaSource",
+        "injected via __init__ param; acquire() walks the k8s claim "
+        "deadline and must be visible to kftpu-lock-held-await",
+    ),
+    ("FleetAutoscaler", "gateway"): (
+        "ServingGateway",
+        "injected via __init__ param; tick() reads gateway.stats() and "
+        "the lock-order rules must see the edge",
+    ),
+    ("WarmSliceProvisioner", "gateway"): (
+        "ServingGateway",
+        "injected via __init__ param; scale paths re-enter the gateway",
+    ),
+}
+
+# Methods that are thread entry points by convention even without an
+# explicit Thread(target=...) in scope: the repo's loop-method naming.
+# Thread targets, signal registrations, and BaseHTTPRequestHandler do_*
+# methods are discovered structurally; this set only adds the loops
+# whose Thread(...) spawn site passes them by variable.
+THREAD_ENTRY_METHODS = {
+    "run", "tick", "_drive", "_drain", "_loop", "_probe_loop",
+    "_health_loop",
+}
+
+# kftpu-lock-held-await follows calls this many hops past the with-block
+# (depth >= 1 only — depth-0 blocking calls are lock-held-blocking-call's
+# single-function territory).
+LOCK_AWAIT_DEPTH = 4
+
+# Call-graph depth for lock-set propagation in the shared-write and
+# lock-order analyses.
+LOCK_PROPAGATION_DEPTH = 6
+
+# Dotted-callee suffixes that can block indefinitely when reached with a
+# lock held (kftpu-lock-held-await), beyond the _blocking_reason set.
+BLOCKING_AWAIT_CALLEES = {
+    "http.client.HTTPConnection": "HTTP connection",
+    "http.client.HTTPSConnection": "HTTPS connection",
+    "urllib.request.urlopen": "network I/O (urlopen)",
+    "subprocess.run": "subprocess",
+    "subprocess.Popen": "subprocess",
+    "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "time.sleep": "time.sleep()",
+}
+
+# Functions whose body is a bounded-deadline remote walk: blocking by
+# nature, so reaching one with a lock held is a finding by itself.
+BLOCKING_AWAIT_FUNCTIONS = {
+    "claim_warm_slice": "k8s warm-slice claim walk (bounded, but seconds)",
+}
+
+# -- kftpu-host-sync-in-hot-path ---------------------------------------------
+
+# The engine-step hot set: serving-path functions where a hidden
+# device->host sync serializes the data path. Reachability from these
+# roots (bounded by HOT_PATH_DEPTH) defines "hot".
+HOT_PATH_ROOTS = {"drive_once", "_step", "_step_ragged", "ragged_paged_attention"}
+HOT_PATH_MODULE_PREFIXES = ("kubeflow_tpu/models/", "kubeflow_tpu/ops/")
+HOT_PATH_DEPTH = 2
+
+# Local names bound from calls matching this pattern are treated as
+# device arrays (jnp./jax. calls are recognized structurally; this covers
+# the repo's jitted step-callable naming: _cb_step, _paged_step, ...).
+DEVICE_PRODUCER_RE = re.compile(r"^_?(cb_\w+|\w*_step|\w*step_ragged)$")
+
+# Naming convention: a local assigned to `host_*` marks a *deliberate*
+# device->host readback (the one per-step sync the batchers budget for).
+HOST_READBACK_PREFIX = "host_"
+
 # -- metric/stats parity (rule metric-stats-parity) --------------------------
 
 # Serving, engine, gateway, autoscaler, and migration metric families

@@ -35,9 +35,13 @@ class Finding:
     message: str
     suppressed: bool = False
     justification: str = ""
+    baselined: bool = False  # matched a checked-in baseline entry
+    out_of_diff: bool = False  # outside the --diff range's changed lines
 
     def render(self) -> str:
-        mark = " (suppressed)" if self.suppressed else ""
+        mark = " (suppressed)" if self.suppressed else (
+            " (baselined)" if self.baselined else ""
+        )
         return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}{mark}"
 
     def as_dict(self) -> dict:
@@ -49,6 +53,8 @@ class Finding:
             "message": self.message,
             "suppressed": self.suppressed,
             "justification": self.justification,
+            "baselined": self.baselined,
+            "out_of_diff": self.out_of_diff,
         }
 
 
@@ -86,6 +92,7 @@ class SourceModule:
     imports: dict = field(default_factory=dict)
     parents: dict = field(default_factory=dict)  # ast node -> parent node
     parse_error: Optional[str] = None
+    _nodes: Optional[tuple] = field(default=None, repr=False)  # walk() cache
 
     def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
         for sup in self.suppressions:
@@ -94,9 +101,14 @@ class SourceModule:
         return None
 
     def walk(self) -> Iterator[ast.AST]:
+        # ~20 rules each walk every module; flatten once and hand out
+        # iterators over the cached tuple (the tree is never mutated).
         if self.tree is None:
             return iter(())
-        return ast.walk(self.tree)
+        nodes = self._nodes
+        if nodes is None:
+            nodes = self._nodes = tuple(ast.walk(self.tree))
+        return iter(nodes)
 
     def enclosing_function(self, node: ast.AST):
         cur = self.parents.get(node)

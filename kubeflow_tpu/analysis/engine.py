@@ -1,9 +1,15 @@
-"""kftpu-lint engine: load -> index -> check -> suppress -> report.
+"""kftpu-lint engine: load -> index -> check -> suppress -> gate -> report.
 
 The whole kubeflow_tpu package is always loaded into the index (contract
 tables live in webhook/, metrics/, api/, k8s/ and rules must resolve
 references into them no matter which subset of files is being checked);
 the target paths only decide which modules get *checked*.
+
+Gating (v2): after suppressions, the checked-in findings baseline
+(analysis/baseline.json) and the optional --diff changed-line filter
+mark findings `baselined` / `out_of_diff`; the exit code rides on what
+remains (Report.gating). With the repo's standing empty baseline and no
+diff range, gating == unsuppressed — PR 4 behavior unchanged.
 """
 
 from __future__ import annotations
@@ -12,6 +18,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Iterable, Optional
 
+from kubeflow_tpu.analysis import baseline as baseline_mod
 from kubeflow_tpu.analysis import config
 from kubeflow_tpu.analysis.core import Finding, load_module
 from kubeflow_tpu.analysis.index import RepoIndex
@@ -39,8 +46,27 @@ class Report:
         return [f for f in self.findings if f.suppressed]
 
     @property
+    def baselined(self) -> list:
+        return [f for f in self.unsuppressed if getattr(f, "baselined", False)]
+
+    @property
+    def out_of_diff(self) -> list:
+        return [f for f in self.unsuppressed if getattr(f, "out_of_diff", False)]
+
+    @property
+    def gating(self) -> list:
+        """What actually fails the build: unsuppressed findings that are
+        neither baselined nor outside the requested diff range."""
+        return [
+            f
+            for f in self.unsuppressed
+            if not getattr(f, "baselined", False)
+            and not getattr(f, "out_of_diff", False)
+        ]
+
+    @property
     def exit_code(self) -> int:
-        return 1 if self.unsuppressed else 0
+        return 1 if self.gating else 0
 
     def as_dict(self) -> dict:
         return {
@@ -48,15 +74,20 @@ class Report:
             "findings": [f.as_dict() for f in self.findings],
             "unsuppressed": len(self.unsuppressed),
             "suppressed": len(self.suppressed),
+            "baselined": len(self.baselined),
+            "out_of_diff": len(self.out_of_diff),
+            "gating": len(self.gating),
         }
 
     def render_text(self, include_suppressed: bool = False) -> str:
-        shown = self.findings if include_suppressed else self.unsuppressed
+        shown = self.findings if include_suppressed else self.gating
         lines = [f.render() for f in shown]
         lines.append(
             f"kftpu-lint: {len(self.checked)} files checked, "
-            f"{len(self.unsuppressed)} findings "
-            f"({len(self.suppressed)} suppressed)"
+            f"{len(self.gating)} gating findings "
+            f"({len(self.suppressed)} suppressed, "
+            f"{len(self.baselined)} baselined, "
+            f"{len(self.out_of_diff)} outside diff)"
         )
         return "\n".join(lines)
 
@@ -80,9 +111,33 @@ def _iter_py_files(target: Path) -> Iterable[Path]:
         yield path
 
 
+# Parsed-module cache for the always-loaded package tree. The test suite
+# calls run_analysis() ~20 times per process (repo gate, revert tests,
+# baseline/diff/SARIF workflows) and re-parsing 96 modules each time
+# dominated its runtime. SourceModules are read-only after load, and the
+# (mtime_ns, size) key invalidates entries when a test rewrites a file.
+# Target paths outside kubeflow_tpu/ (fixtures, tmp copies) are always
+# loaded fresh.
+_MODULE_CACHE: dict = {}
+
+
+def _load_package_module(path: Path, rel: str, name: str):
+    try:
+        stat = path.stat()
+        key = (str(path), stat.st_mtime_ns, stat.st_size)
+    except OSError:
+        return load_module(path, rel, name)
+    cached = _MODULE_CACHE.get(key)
+    if cached is None or cached.rel != rel:
+        cached = _MODULE_CACHE[key] = load_module(path, rel, name)
+    return cached
+
+
 def run_analysis(
     paths: Optional[Iterable] = None,
     repo_root: Optional[Path] = None,
+    baseline_path: Optional[Path] = None,
+    diff_range: Optional[str] = None,
 ) -> Report:
     root = Path(repo_root).resolve() if repo_root else REPO_ROOT
     targets = [Path(p).resolve() for p in (paths or [])] or [root / "kubeflow_tpu"]
@@ -92,7 +147,7 @@ def run_analysis(
     if package_dir.is_dir():
         for path in _iter_py_files(package_dir):
             rel, name = _rel_and_name(path, root)
-            index.add(load_module(path, rel, name))
+            index.add(_load_package_module(path, rel, name))
 
     checked: dict = {}  # rel -> SourceModule
     for target in targets:
@@ -133,4 +188,21 @@ def run_analysis(
             finding.justification = sup.justification
 
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return Report(findings=findings, checked=sorted(checked))
+    report = Report(findings=findings, checked=sorted(checked))
+
+    # baseline_path=False disables the baseline entirely (--no-baseline)
+    entries = (
+        [] if baseline_path is False
+        else baseline_mod.load_baseline(baseline_path)
+    )
+    if entries:
+        baseline_mod.apply_baseline(report, entries, index)
+    if diff_range:
+        changed = baseline_mod.changed_lines(diff_range, root)
+        if changed is None:
+            raise SystemExit(
+                f"kftpu-lint: git diff failed for range {diff_range!r}"
+            )
+        baseline_mod.apply_diff_filter(report, changed)
+    report.index = index  # for baseline regeneration / fingerprinting
+    return report

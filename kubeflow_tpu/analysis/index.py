@@ -27,6 +27,10 @@ def _assign_targets(node: ast.AST):
 
 
 class RepoIndex:
+    # (frozenset of module ids, CallGraph) — last graph built anywhere in
+    # the process; see callgraph() for why sharing is sound.
+    _graph_cache: tuple = (None, None)
+
     def __init__(self, repo_root: Path):
         self.repo_root = repo_root
         self.modules: dict = {}  # dotted name -> SourceModule
@@ -47,6 +51,30 @@ class RepoIndex:
         # chaos YAMLs: injection type -> rel path of the experiment file
         self.chaos_yaml_types: dict = {}
         self.chaos_yaml_error: Optional[str] = None
+        # interprocedural layer: built lazily so index-only tests (and
+        # the contract rules) never pay for it.
+        self._callgraph = None
+
+    def callgraph(self):
+        """The shared repo-wide call graph (callgraph.CallGraph).
+
+        Graphs are pure functions of the module set, and engine-level
+        module caching means successive run_analysis() calls in one
+        process usually index the *same* SourceModule objects — so an
+        identical module set reuses the previous index's graph instead
+        of re-resolving every edge.
+        """
+        if self._callgraph is None:
+            from kubeflow_tpu.analysis.callgraph import CallGraph
+
+            key = frozenset(id(m) for m in self.by_rel.values())
+            cached_key, cached = RepoIndex._graph_cache
+            if key == cached_key and cached is not None:
+                self._callgraph = cached
+            else:
+                self._callgraph = CallGraph(self)
+                RepoIndex._graph_cache = (key, self._callgraph)
+        return self._callgraph
 
     def add(self, mod: SourceModule) -> None:
         self.modules[mod.name] = mod

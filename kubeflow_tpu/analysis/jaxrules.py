@@ -1,0 +1,247 @@
+"""kftpu-lint JAX rules: hidden device->host syncs on the serving path.
+
+The serving engines budget for exactly one device->host readback per
+step (the sampled-token fetch), and mark it with the ``host_`` naming
+convention (``host_next = np.asarray(nxt)``). Anything else that forces
+a sync inside the engine-step hot set — ``.item()``, ``float()/int()``
+on a device array, ``np.asarray`` on a device value, ``jax.device_get``,
+or a per-token Python loop dispatching device ops — serializes the
+dispatch pipeline the ragged fused path exists to keep full (Ragged
+Paged Attention, PAPERS.md arxiv 2604.15464).
+
+"Hot" = reachable within config.HOT_PATH_DEPTH call-graph hops from the
+roots in config.HOT_PATH_ROOTS (drive_once / _step / _step_ragged / the
+ragged dispatch wrapper). Host-vs-device classification is local and
+deliberately conservative: a local is *device* when bound from a
+``jnp.*``/``jax.*`` call or a step-callable (config.DEVICE_PRODUCER_RE),
+*host* when bound from ``np.*``, literals, or a ``host_*`` name —
+everything else (parameters, attributes) is ambiguous and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from kubeflow_tpu.analysis import config
+from kubeflow_tpu.analysis.callgraph import direct_nodes
+from kubeflow_tpu.analysis.core import (
+    Finding,
+    SourceModule,
+    dotted_parts,
+    resolved_callee,
+)
+
+_NP_CONVERTERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
+_HOST_CALL_HEADS = ("np.", "numpy.")
+_DEVICE_CALL_HEADS = ("jnp.", "jax.", "jax.numpy.")
+
+
+def _is_device_callee(callee: Optional[str]) -> bool:
+    if not callee:
+        return False
+    if callee.startswith(_DEVICE_CALL_HEADS):
+        return True
+    leaf = callee.rsplit(".", 1)[-1]
+    return bool(config.DEVICE_PRODUCER_RE.match(leaf))
+
+
+def _is_host_callee(callee: Optional[str]) -> bool:
+    if not callee:
+        return False
+    return callee.startswith(_HOST_CALL_HEADS) or callee in (
+        "int", "float", "len", "list", "sorted", "tuple", "dict",
+    )
+
+
+class _Locals:
+    """Host/device classification of a function's simple local bindings."""
+
+    def __init__(self, mod: SourceModule, fn_node: ast.AST):
+        self.device: set = set()
+        self.host: set = set()
+        for node in direct_nodes(fn_node.body):
+            if not isinstance(node, ast.Assign):
+                continue
+            side = self._side_of(mod, node.value)
+            if side is None:
+                continue
+            for target in node.targets:
+                elts = target.elts if isinstance(target, ast.Tuple) else [target]
+                for elt in elts:
+                    if isinstance(elt, ast.Name):
+                        getattr(self, side).add(elt.id)
+
+    def _side_of(self, mod: SourceModule, value: ast.AST) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            callee = resolved_callee(mod, value)
+            if callee is None:
+                parts = dotted_parts(value.func)
+                callee = parts[-1] if parts else None
+            if _is_device_callee(callee):
+                return "device"
+            if _is_host_callee(callee):
+                return "host"
+            return None
+        if isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.Constant)):
+            return "host"
+        if isinstance(value, ast.Name):
+            if value.id in self.device:
+                return "device"
+            if value.id in self.host or value.id.startswith(
+                config.HOST_READBACK_PREFIX
+            ):
+                return "host"
+        return None
+
+    def _base_name(self, expr: ast.AST) -> Optional[str]:
+        cur = expr
+        while isinstance(cur, (ast.Subscript, ast.Attribute)):
+            cur = cur.value
+        return cur.id if isinstance(cur, ast.Name) else None
+
+    def is_device(self, expr: ast.AST) -> bool:
+        name = self._base_name(expr)
+        return name is not None and name in self.device and not isinstance(
+            expr, ast.Attribute
+        )
+
+    def is_host(self, expr: ast.AST) -> bool:
+        name = self._base_name(expr)
+        if name is None:
+            return False
+        return name in self.host or name.startswith(config.HOST_READBACK_PREFIX)
+
+
+class HostSyncInHotPath:
+    id = "kftpu-host-sync-in-hot-path"
+    description = (
+        "A hidden device->host sync (.item(), float()/int() on a device "
+        "array, np.asarray of a device value, jax.device_get, or a "
+        "per-token Python loop dispatching jnp/jax ops) inside the "
+        "engine-step hot set (drive_once/_step/_step_ragged/the ragged "
+        "dispatch wrapper). Each sync stalls dispatch for a full "
+        "device round trip per step; batch the readback and bind the "
+        "one deliberate per-step sync to a host_-prefixed local."
+    )
+    incidents = (
+        "Ragged fused dispatch (PAPERS.md arxiv 2604.15464) exists to "
+        "keep the device pipeline full; one stray .item() in _step "
+        "re-serializes it",
+    )
+    docs = "ARCHITECTURE.md#static-analysis — JAX hot-path rules"
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        return []
+
+    def check_repo(self, index, checked: dict) -> list:
+        graph = index.callgraph()
+        hot: dict = {}  # key -> FunctionNode
+        for fn in graph.functions.values():
+            if fn.name not in config.HOT_PATH_ROOTS:
+                continue
+            rel = fn.mod.rel
+            in_package = rel.startswith("kubeflow_tpu/")
+            if in_package and not rel.startswith(
+                config.HOT_PATH_MODULE_PREFIXES
+            ):
+                continue
+            for node, _depth, _path in graph.reachable(
+                fn, max_depth=config.HOT_PATH_DEPTH
+            ):
+                hot.setdefault(node.key, node)
+        findings = []
+        for fn in hot.values():
+            if fn.mod.rel in checked:
+                findings.extend(self._check_function(fn))
+        return findings
+
+    def _finding(self, fn, node, message) -> Finding:
+        return Finding(
+            self.id, fn.mod.rel, node.lineno, node.col_offset,
+            f"{message} in hot-path function {fn.qualname}; " +
+            "each hidden sync stalls the dispatch pipeline for a device "
+            "round trip per step",
+        )
+
+    def _assign_target_is_host(self, mod: SourceModule, call: ast.Call) -> bool:
+        parent = mod.parents.get(call)
+        if isinstance(parent, ast.Assign):
+            for target in parent.targets:
+                if isinstance(target, ast.Name) and target.id.startswith(
+                    config.HOST_READBACK_PREFIX
+                ):
+                    return True
+        return False
+
+    def _check_function(self, fn) -> list:
+        mod = fn.mod
+        locals_ = _Locals(mod, fn.node)
+        findings = []
+        for node in direct_nodes(fn.node.body):
+            if isinstance(node, ast.Call):
+                callee = resolved_callee(mod, node) or ""
+                if callee == "jax.device_get":
+                    findings.append(
+                        self._finding(fn, node, "jax.device_get() forces a "
+                                      "device->host transfer")
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                    and not node.args
+                    and not locals_.is_host(node.func.value)
+                ):
+                    findings.append(
+                        self._finding(fn, node, ".item() is a blocking "
+                                      "device->host sync")
+                    )
+                elif callee in _NP_CONVERTERS and node.args:
+                    if locals_.is_device(node.args[0]) and \
+                            not self._assign_target_is_host(mod, node):
+                        findings.append(
+                            self._finding(
+                                fn, node,
+                                f"{callee}() on a device value is a "
+                                "blocking sync — if this is the one "
+                                "deliberate per-step readback, bind it "
+                                "to a host_-prefixed local",
+                            )
+                        )
+                elif callee in ("float", "int") and node.args:
+                    if locals_.is_device(node.args[0]):
+                        findings.append(
+                            self._finding(
+                                fn, node,
+                                f"{callee}() on a device array syncs; "
+                                "read it back once via a host_ local "
+                                "and index that",
+                            )
+                        )
+            elif isinstance(node, ast.For):
+                findings.extend(self._check_loop(fn, mod, node))
+        return findings
+
+    def _check_loop(self, fn, mod: SourceModule, loop: ast.For) -> list:
+        if not (
+            isinstance(loop.iter, ast.Call)
+            and (resolved_callee(mod, loop.iter) or "") == "range"
+        ):
+            return []
+        for node in direct_nodes(loop.body):
+            if isinstance(node, ast.Call):
+                callee = resolved_callee(mod, node) or ""
+                if callee.startswith(_DEVICE_CALL_HEADS):
+                    return [
+                        self._finding(
+                            fn, loop,
+                            f"per-token Python loop dispatches {callee} "
+                            "each iteration — fuse it into the batched "
+                            "dispatch or jit the loop body",
+                        )
+                    ]
+        return []
+
+
+JAX_RULES = [HostSyncInHotPath()]

@@ -32,6 +32,12 @@ from kubeflow_tpu.analysis.core import (
 class Rule:
     id = ""
     description = ""
+    # Incident citations: the shipped bugs (by PR) this rule would have
+    # caught — shown by --list-rules so a finding reads as "this class of
+    # bug bit us", not "the linter is opinionated".
+    incidents: tuple = ()
+    # Pointer into ARCHITECTURE.md / CONTRIBUTING.md for the rule's model.
+    docs = ""
 
     def check_module(self, mod: SourceModule, index) -> list:
         return []
@@ -140,63 +146,83 @@ class BlockingInSignalHandler(Rule):
         "deadlock: queue.Queue ops in a SIGTERM handler); do the work on "
         "a dedicated thread and join it with a timeout."
     )
+    incidents = (
+        "PR 3: emergency-save deadlock — queue.Queue ops in a SIGTERM "
+        "handler re-entered the mutex the interrupted thread held",
+    )
+    docs = "ARCHITECTURE.md#static-analysis — call-graph layer"
 
-    def check_module(self, mod: SourceModule, index) -> list:
-        defs = _function_defs(mod)
-        handlers: list = []
-        for node in mod.walk():
-            if not isinstance(node, ast.Call):
-                continue
-            if resolved_callee(mod, node) != "signal.signal":
-                continue
-            if len(node.args) < 2:
-                continue
-            target = node.args[1]
-            name = None
-            if isinstance(target, ast.Name):
-                name = target.id
-            elif isinstance(target, ast.Attribute):
-                name = target.attr
-            if name and name in defs:
-                for fn in defs[name]:
-                    handlers.append((fn, node.lineno))
-            elif isinstance(target, ast.Lambda):
-                handlers.append((target, node.lineno))
+    def _handler_nodes(self, graph, mod: SourceModule, reg: ast.Call) -> list:
+        """Resolve signal.signal's handler argument to FunctionNodes."""
+        target = reg.args[1]
+        parts = dotted_parts(target)
+        if parts is None:
+            return []
+        name = parts[-1]
+        if len(parts) == 2 and parts[0] == "self":
+            enclosing = mod.enclosing_function(reg)
+            caller = graph.fn_for(enclosing) if enclosing is not None else None
+            if caller is not None and caller.cls:
+                for info in graph.classes.get(caller.cls, []):
+                    if info.mod is mod:
+                        found = graph.class_method(info, name)
+                        if found is not None:
+                            return [found]
+        return list(graph.module_defs.get(mod.name, {}).get(name, []))
+
+    def check_repo(self, index, checked: dict) -> list:
+        graph = index.callgraph()
         findings = []
-        seen: set = set()
-        queue = list(handlers)
-        while queue:
-            fn, reg_line = queue.pop()
-            if id(fn) in seen:
+        reported: set = set()
+        for rel in sorted(checked):
+            mod = checked[rel]
+            if mod is None or mod.tree is None:
                 continue
-            seen.add(id(fn))
-            body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
-            for node in _direct_nodes(body):
-                if not isinstance(node, ast.Call):
+            for reg in mod.walk():
+                if not isinstance(reg, ast.Call):
                     continue
-                reason = _blocking_reason(mod, node, in_signal_handler=True)
-                if reason:
-                    findings.append(
-                        self.finding(
-                            mod, node,
-                            f"{reason} reachable from the signal handler "
-                            f"registered at line {reg_line}; run it on a "
-                            "dedicated thread and join with a timeout "
-                            "instead (PR 3 emergency-save deadlock)",
-                        )
-                    )
+                if resolved_callee(mod, reg) != "signal.signal":
                     continue
-                callee_name = None
-                if isinstance(node.func, ast.Name):
-                    callee_name = node.func.id
-                elif isinstance(node.func, ast.Attribute) and isinstance(
-                    node.func.value, ast.Name
-                ) and node.func.value.id in ("self", "cls"):
-                    callee_name = node.func.attr
-                if callee_name and callee_name in defs:
-                    for callee_fn in defs[callee_name]:
-                        queue.append((callee_fn, reg_line))
+                if len(reg.args) < 2:
+                    continue
+                if isinstance(reg.args[1], ast.Lambda):
+                    for node in _direct_nodes([reg.args[1].body]):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        reason = _blocking_reason(mod, node, True)
+                        if reason:
+                            findings.append(self._report(
+                                mod, node, reason,
+                                f"{mod.rel}:{reg.lineno}", ""))
+                    continue
+                for handler in self._handler_nodes(graph, mod, reg):
+                    for fn, _depth, path in graph.reachable(handler):
+                        if fn.mod.rel not in checked:
+                            continue
+                        for node in _direct_nodes(fn.node.body):
+                            if not isinstance(node, ast.Call):
+                                continue
+                            reason = _blocking_reason(fn.mod, node, True)
+                            if not reason:
+                                continue
+                            key = (fn.mod.rel, node.lineno, reg.lineno)
+                            if key in reported:
+                                continue
+                            reported.add(key)
+                            via = graph.render_path(path, fn) if path else ""
+                            findings.append(self._report(
+                                fn.mod, node, reason,
+                                f"{mod.rel}:{reg.lineno}", via))
         return findings
+
+    def _report(self, mod, node, reason, reg_at, via) -> Finding:
+        via_txt = f" (path: {via})" if via else ""
+        return self.finding(
+            mod, node,
+            f"{reason} reachable from the signal handler registered at "
+            f"{reg_at}{via_txt}; run it on a dedicated thread and "
+            "join with a timeout instead (PR 3 emergency-save deadlock)",
+        )
 
 
 class LockHeldBlockingCall(Rule):
@@ -1096,6 +1122,13 @@ class SuppressionHygiene(Rule):
         return findings
 
 
+# Interprocedural rule families live in their own modules (they ride the
+# shared call graph + lock model); imported here so ALL_RULES stays the
+# single registry the engine and rule_ids() consume. Imported late to
+# avoid a cycle (concurrency/jaxrules use the Rule helpers above).
+from kubeflow_tpu.analysis.concurrency import CONCURRENCY_RULES  # noqa: E402
+from kubeflow_tpu.analysis.jaxrules import JAX_RULES  # noqa: E402
+
 ALL_RULES = [
     BlockingInSignalHandler(),
     LockHeldBlockingCall(),
@@ -1113,6 +1146,8 @@ ALL_RULES = [
     UndeadlinedClaim(),
     UnboundedFanout(),
     SuppressionHygiene(),
+    *CONCURRENCY_RULES,
+    *JAX_RULES,
 ]
 
 # `parse-error` is emitted by the engine itself for unparseable files.

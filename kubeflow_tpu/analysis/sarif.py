@@ -1,0 +1,96 @@
+"""kftpu-lint SARIF 2.1.0 output.
+
+One run, one driver, one result per finding. Suppressed findings are
+included with a SARIF ``suppressions`` entry (kind ``inSource``) so
+viewers show the justification instead of hiding the history; baselined
+findings carry ``baselineState: unchanged`` and gating ones ``new``.
+"""
+
+from __future__ import annotations
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA_URI = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+_INFO_URI = "https://github.com/opendatahub-io/kubeflow"
+
+
+def _rule_descriptor(rule) -> dict:
+    out = {
+        "id": rule.id,
+        "shortDescription": {"text": " ".join(rule.description.split())},
+    }
+    props = {}
+    incidents = getattr(rule, "incidents", ())
+    if incidents:
+        props["incidents"] = list(incidents)
+    docs = getattr(rule, "docs", "")
+    if docs:
+        props["docs"] = docs
+    if props:
+        out["properties"] = props
+    return out
+
+
+def report_to_sarif(report, rules) -> dict:
+    """Render a Report (engine.Report) as a SARIF 2.1.0 log dict."""
+    descriptors = [_rule_descriptor(rule) for rule in rules]
+    descriptors.append(
+        {
+            "id": "parse-error",
+            "shortDescription": {
+                "text": "File could not be parsed as Python (engine-emitted)."
+            },
+        }
+    )
+    results = []
+    for finding in report.findings:
+        result = {
+            "ruleId": finding.rule,
+            "level": "warning" if finding.suppressed else "error",
+            "message": {"text": finding.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": finding.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {
+                            "startLine": max(finding.line, 1),
+                            "startColumn": finding.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        if finding.suppressed:
+            result["suppressions"] = [
+                {
+                    "kind": "inSource",
+                    "justification": finding.justification,
+                }
+            ]
+        elif getattr(finding, "baselined", False):
+            result["baselineState"] = "unchanged"
+        else:
+            result["baselineState"] = "new"
+        results.append(result)
+    return {
+        "$schema": SARIF_SCHEMA_URI,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "kftpu-lint",
+                        "informationUri": _INFO_URI,
+                        "rules": descriptors,
+                    }
+                },
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+                "results": results,
+            }
+        ],
+    }

@@ -242,7 +242,18 @@ class FleetAutoscaler:
         self._clock = clock
         self.rng = rng or random.random
         self.metrics = metrics
-        # RLock: tick() → gateway.stats() → this.stats() re-enters.
+        # Two locks with distinct jobs (kftpu-lock-held-await forced the
+        # split: a tick used to hold the state lock across provisioner
+        # HTTP and the k8s claim walk, starving stats()/debug readers
+        # for seconds):
+        #  - _tick_lock single-flights the control loop; taken with
+        #    blocking=False so an overlapping cadence tick returns
+        #    immediately instead of queueing behind a slow claim;
+        #  - _lock guards the reader-visible state (counters, decision
+        #    ring, _draining, tier sizes) and is only ever held for
+        #    brief mutations/reads — never across a provisioner or
+        #    gateway call. RLock: debug() re-enters via stats().
+        self._tick_lock = threading.Lock()
         self._lock = threading.RLock()
         self._tier_state: dict = {}
         self._tier_sizes: dict = {}
@@ -271,8 +282,14 @@ class FleetAutoscaler:
 
     def tick(self, now: Optional[float] = None) -> list:
         """One control pass; returns the decisions it recorded (empty
-        on a quiet tick). At most one scale action per tier per tick."""
-        with self._lock:
+        on a quiet tick, or when another tick is still in flight — the
+        loop is single-flighted so a slow claim walk never queues
+        ticks). At most one scale action per tier per tick. The state
+        lock is never held across provisioner/gateway I/O, so stats()
+        and /debug/autoscaler stay responsive mid-tick."""
+        if not self._tick_lock.acquire(blocking=False):
+            return []
+        try:
             now = self._now() if now is None else now
             done: list = []
             self._advance_drains(now, done)
@@ -280,7 +297,8 @@ class FleetAutoscaler:
             if freeze is not None:
                 self._freeze(now, freeze, done)
                 return done
-            self._frozen = False
+            with self._lock:
+                self._frozen = False
             tel = self.gateway.telemetry
             gwstats = self.gateway.stats()
             slo = tel.evaluate_slo(now=now)
@@ -288,6 +306,8 @@ class FleetAutoscaler:
             for tier in self._tiers():
                 self._evaluate_tier(tier, gwstats, slo, snap, now, done)
             return done
+        finally:
+            self._tick_lock.release()
 
     def _tiers(self):
         if getattr(self.gateway, "tier_mode", "fused") == "disagg":
@@ -315,18 +335,19 @@ class FleetAutoscaler:
         return None
 
     def _freeze(self, now: float, reason: str, done: list) -> None:
-        if self._frozen:
-            return  # one freeze decision per episode, not per tick
-        self._frozen = True
-        self._freezes += 1
+        with self._lock:
+            if self._frozen:
+                return  # one freeze decision per episode, not per tick
+            self._frozen = True
+            self._freezes += 1
+            for st in self._tier_state.values():
+                st.up_streak = st.down_streak = 0
+                st.last_hold_key = ""
         if self.metrics is not None:
             self.metrics.autoscaler_freeze_total.inc()
         tel = self.gateway.telemetry
         if tel is not None:
             tel.observe_autoscale("freeze")
-        for st in self._tier_state.values():
-            st.up_streak = st.down_streak = 0
-            st.last_hold_key = ""
         self._record(now, "fleet", "freeze", None, [reason], done)
 
     # -- pressure signals --------------------------------------------------
@@ -441,22 +462,24 @@ class FleetAutoscaler:
                        if r.get("role") == tier}
         in_ring = sorted(ep for ep, r in members.items()
                          if r.get("in_ring"))
-        self._tier_sizes[tier] = len(in_ring)
+        with self._lock:
+            self._tier_sizes[tier] = len(in_ring)
         if self.metrics is not None:
             self.metrics.autoscaler_replicas.labels(tier=tier).set(
                 len(in_ring)
             )
         up = self._up_pressure(tier, slo, snap, in_ring)
         down = [] if up else self._down_pressure(tier, slo, snap, in_ring)
-        if up:
-            st.up_streak += 1
-            st.down_streak = 0
-        elif down:
-            st.down_streak += 1
-            st.up_streak = 0
-        else:
-            st.up_streak = st.down_streak = 0
-            st.last_hold_key = ""
+        with self._lock:
+            if up:
+                st.up_streak += 1
+                st.down_streak = 0
+            elif down:
+                st.down_streak += 1
+                st.up_streak = 0
+            else:
+                st.up_streak = st.down_streak = 0
+                st.last_hold_key = ""
         if up and st.up_streak >= self.config.up_consecutive:
             self._try_scale_up(tier, st, in_ring, up, now, done)
         elif down and st.down_streak >= self.config.down_consecutive:
@@ -464,10 +487,11 @@ class FleetAutoscaler:
                                  now, done)
 
     def _rate_limit_ok(self, now: float) -> bool:
-        cutoff = now - self.config.actions_window_s
-        while self._action_times and self._action_times[0] <= cutoff:
-            self._action_times.popleft()
-        return len(self._action_times) < self.config.max_actions_per_window
+        with self._lock:
+            cutoff = now - self.config.actions_window_s
+            while self._action_times and self._action_times[0] <= cutoff:
+                self._action_times.popleft()
+            return len(self._action_times) < self.config.max_actions_per_window
 
     def _try_scale_up(self, tier: str, st: _TierState, in_ring,
                       reasons: list, now: float, done: list) -> None:
@@ -493,43 +517,48 @@ class FleetAutoscaler:
                        f"rate limit: {cfg.max_actions_per_window} actions "
                        f"per {cfg.actions_window_s:g}s", reasons, done)
             return
-        self._claim_attempts += 1
+        with self._lock:
+            self._claim_attempts += 1
         if self.metrics is not None:
             self.metrics.autoscaler_claim_attempts_total.inc()
         t0 = time.perf_counter()
         err = None
         try:
+            # The claim walk (k8s list + slice claim + provisioner HTTP)
+            # runs unlocked: only _tick_lock single-flights it.
             got = self.provisioner.scale_up(tier, now=now)
         except Exception as exc:  # a claim error is a failure, not a crash
             got, err = None, repr(exc)
-        self._claim_latency_last = time.perf_counter() - t0
+        latency = time.perf_counter() - t0
+        with self._lock:
+            self._claim_latency_last = latency
         if self.metrics is not None:
-            self.metrics.autoscaler_claim_latency_seconds.set(
-                self._claim_latency_last
-            )
+            self.metrics.autoscaler_claim_latency_seconds.set(latency)
         if got is None:
-            st.claim_failures += 1
-            self._claim_failures += 1
+            with self._lock:
+                st.claim_failures += 1
+                self._claim_failures += 1
+                backoff = min(
+                    cfg.claim_backoff_base_s * 2 ** (st.claim_failures - 1),
+                    cfg.claim_backoff_max_s,
+                ) * (1.0 + cfg.claim_backoff_jitter * self.rng())
+                st.claim_backoff_until = now + backoff
             if self.metrics is not None:
                 self.metrics.autoscaler_claim_failures_total.inc()
-            backoff = min(
-                cfg.claim_backoff_base_s * 2 ** (st.claim_failures - 1),
-                cfg.claim_backoff_max_s,
-            ) * (1.0 + cfg.claim_backoff_jitter * self.rng())
-            st.claim_backoff_until = now + backoff
             why = (f"warm-slice claim failed"
                    f"{' (' + err + ')' if err else ''}; holding capacity, "
                    f"backoff {backoff:.1f}s")
             self._hold(now, tier, st, "claim_failed", why, reasons, done,
                        force=True)
             return
-        st.claim_failures = 0
-        st.claim_backoff_until = 0.0
-        st.up_cooldown_until = now + cfg.up_cooldown_s
-        st.up_streak = 0
-        st.last_hold_key = ""
-        self._action_times.append(now)
-        self._scale_ups += 1
+        with self._lock:
+            st.claim_failures = 0
+            st.claim_backoff_until = 0.0
+            st.up_cooldown_until = now + cfg.up_cooldown_s
+            st.up_streak = 0
+            st.last_hold_key = ""
+            self._action_times.append(now)
+            self._scale_ups += 1
         if self.metrics is not None:
             self.metrics.autoscaler_scale_up_total.inc()
         tel = self.gateway.telemetry
@@ -537,8 +566,7 @@ class FleetAutoscaler:
             tel.observe_autoscale("up")
         self._record(
             now, tier, "scale_up", str(got),
-            reasons + [f"claimed {got} in "
-                       f"{self._claim_latency_last * 1000:.0f}ms"],
+            reasons + [f"claimed {got} in {latency * 1000:.0f}ms"],
             done,
         )
 
@@ -611,15 +639,16 @@ class FleetAutoscaler:
         # Out of the ring the instant the drain starts: new streams
         # route elsewhere, in-flight ones keep flowing to the victim.
         self.gateway.begin_drain(victim)
-        self._draining[victim] = {
-            "tier": tier, "since": now,
-            "deadline": now + cfg.drain_budget_s,
-        }
-        st.down_cooldown_until = now + cfg.down_cooldown_s
-        st.down_streak = 0
-        st.last_hold_key = ""
-        self._action_times.append(now)
-        self._scale_downs += 1
+        with self._lock:
+            self._draining[victim] = {
+                "tier": tier, "since": now,
+                "deadline": now + cfg.drain_budget_s,
+            }
+            st.down_cooldown_until = now + cfg.down_cooldown_s
+            st.down_streak = 0
+            st.last_hold_key = ""
+            self._action_times.append(now)
+            self._scale_downs += 1
         if self.metrics is not None:
             self.metrics.autoscaler_scale_down_total.inc()
         tel = self.gateway.telemetry
@@ -633,8 +662,12 @@ class FleetAutoscaler:
         )
 
     def _advance_drains(self, now: float, done: list) -> None:
-        for ep in sorted(self._draining):
-            d = self._draining[ep]
+        # Snapshot under the lock, poll the provisioner (HTTP) outside it:
+        # a slow drained() probe must not block stats()/debug() readers.
+        with self._lock:
+            draining = {ep: dict(d) for ep, d in self._draining.items()}
+        for ep in sorted(draining):
+            d = draining[ep]
             over = now >= d["deadline"]
             try:
                 idle = self.provisioner.drained(ep)
@@ -642,7 +675,9 @@ class FleetAutoscaler:
                 idle = False
             if not idle and not over:
                 continue
-            del self._draining[ep]
+            with self._lock:
+                if self._draining.pop(ep, None) is None:
+                    continue  # raced with a concurrent reconfigure
             reasons = []
             if idle:
                 reasons.append(
@@ -666,10 +701,11 @@ class FleetAutoscaler:
     def _hold(self, now: float, tier: str, st: _TierState, kind: str,
               why: str, pressure: list, done: list, *,
               force: bool = False) -> None:
-        if not force and st.last_hold_key == kind:
-            return  # same suppression as last tick: one hold per episode
-        st.last_hold_key = kind
-        self._holds += 1
+        with self._lock:
+            if not force and st.last_hold_key == kind:
+                return  # same suppression as last tick: one hold per episode
+            st.last_hold_key = kind
+            self._holds += 1
         if self.metrics is not None:
             self.metrics.autoscaler_hold_total.inc()
         tel = self.gateway.telemetry
@@ -684,7 +720,8 @@ class FleetAutoscaler:
                  "reasons": list(reasons)}
         if endpoint:
             entry["endpoint"] = endpoint
-        self._decisions.append(entry)
+        with self._lock:
+            self._decisions.append(entry)
         done.append(entry)
         if tracing.enabled():
             attrs = {"autoscaler.tier": tier,

@@ -879,6 +879,7 @@ class ContinuousBatcher(_BatcherBase):
         if getattr(self, "_admit_chunk", None):
             self._admit_one_chunk()
             return
+        # kftpu-lint: disable=kftpu-host-sync-in-hot-path — bounded per-slot admission host->device upload (at most `slots` iterations when requests are queued), not a per-token readback
         for slot in range(self.slots):
             if self._by_slot[slot] is not None or not self._queue:
                 continue

@@ -188,6 +188,7 @@ def _paged_admit(
         buf = new_pool[name]
         # temp[name][:, 0] is (L, Hkv, S, D) for values, (L, Hkv, S) for
         # scale leaves — the sequence axis is 2 in both.
+        # kftpu-lint: disable=kftpu-host-sync-in-hot-path — per-BLOCK relayout of one prompt's kv at admission time (bounded by prompt length / block_size), not a per-token decode readback
         for j in range(lb // block_size):
             chunk = jax.lax.dynamic_slice_in_dim(
                 temp[name][:, 0], j * block_size, block_size, axis=2
@@ -1684,6 +1685,7 @@ class PagedBatcher(_BatcherBase):
         if self._prefix_cache_enabled:
             self._admit_free_slots_prefix()
             return
+        # kftpu-lint: disable=kftpu-host-sync-in-hot-path — bounded per-slot admission host->device upload (at most `slots` iterations), not a per-token readback
         for slot in range(self.slots):
             if self._by_slot[slot] is not None:
                 continue
@@ -1801,6 +1803,7 @@ class PagedBatcher(_BatcherBase):
         token budget, so admission never stalls in-flight decodes and a
         short prompt's first token can arrive with the SAME dispatch
         that finishes its prefill."""
+        # kftpu-lint: disable=kftpu-host-sync-in-hot-path — bounded per-slot admission host->device upload feeding ragged chunk rows, not a per-token readback
         for slot in range(self.slots):
             if (self._by_slot[slot] is not None
                     or slot in self._ragged_admit):
@@ -1864,6 +1867,7 @@ class PagedBatcher(_BatcherBase):
         is simply all-True (pad slots would be future positions, which
         causality already hides; see _paged_prefix_admit)."""
         bs = self.block_size
+        # kftpu-lint: disable=kftpu-host-sync-in-hot-path — bounded per-slot admission host->device upload on the prefix-cache path, not a per-token readback
         for slot in range(self.slots):
             if self._by_slot[slot] is not None:
                 continue

@@ -206,9 +206,16 @@ class CheckpointManager:
         self.save_failures = 0
         # Serializes whole checkpoint writes. The emergency path acquires
         # it with a timeout (never blocking the exit path on a frozen
-        # writer); _seq has its own lock so a write can proceed even when
-        # this one could not be taken.
+        # writer); the save-outcome state has its own lock (_seq_lock) so
+        # outcome bookkeeping stays consistent even on the emergency path
+        # that writes WITHOUT _lock after the acquire timed out.
         self._lock = threading.RLock()
+        # Guards _seq plus the save-outcome state (last_save_error,
+        # save_failures, _last_save_duration, _last_committed_step,
+        # _last_commit_at): written from the async worker thread, the
+        # caller's save(), the signal-path emergency save, and restore.
+        # Always taken after _lock (never around I/O) — keeping the
+        # documented _lock -> _seq_lock order cycle-free.
         self._seq_lock = threading.Lock()
         self._seq = 0  # staging-dir uniquifier (reentrant saves)
         self._last_saved_step: Optional[int] = None  # interval gate
@@ -453,15 +460,17 @@ class CheckpointManager:
                 shutil.rmtree(final)
             self.io.commit(staged, final)
         except OSError as err:
-            self.last_save_error = err
-            self.save_failures += 1
+            with self._seq_lock:
+                self.last_save_error = err
+                self.save_failures += 1
             log.error("checkpoint save of step %d failed: %s", step, err)
             shutil.rmtree(staged, ignore_errors=True)
             return False
         duration = self._clock() - t0
-        self._last_save_duration = duration
-        self._last_committed_step = step
-        self._last_commit_at = self._clock()
+        with self._seq_lock:
+            self._last_save_duration = duration
+            self._last_committed_step = step
+            self._last_commit_at = self._clock()
         hist = getattr(self.metrics, "checkpoint_save_seconds", None)
         if hist is not None:
             hist.observe(duration)
@@ -503,8 +512,9 @@ class CheckpointManager:
                     # (unserializable metadata, MemoryError) must not kill
                     # the worker and wedge every later wait()/close() in
                     # queue.join() — record it and keep draining.
-                    self.last_save_error = err
-                    self.save_failures += 1
+                    with self._seq_lock:
+                        self.last_save_error = err
+                        self.save_failures += 1
                     log.exception(
                         "async checkpoint save of step %d failed", step
                     )
@@ -569,9 +579,11 @@ class CheckpointManager:
         inherited on disk have only wall-time mtimes, whose age a
         monotonic clock cannot vouch for), so freshness-gated callers
         save rather than trust."""
-        if self._last_commit_at is None:
+        with self._seq_lock:
+            last = self._last_commit_at
+        if last is None:
             return float("inf")
-        return max(0.0, self._clock() - self._last_commit_at)
+        return max(0.0, self._clock() - last)
 
     def _local_steps(self) -> list:
         return sorted(
@@ -637,22 +649,26 @@ class CheckpointManager:
                 continue
             state = _restore_into_template(template, arrays, step_dir)
             self.restored_metadata = meta
-            self._last_committed_step = step
-            # A restore just validated these bytes, so "as fresh as a
-            # commit made now" is the honest monotonic reading.
-            self._last_commit_at = self._clock()
+            with self._seq_lock:
+                self._last_committed_step = step
+                # A restore just validated these bytes, so "as fresh as a
+                # commit made now" is the honest monotonic reading.
+                self._last_commit_at = self._clock()
             return state, step
         return template, None
 
     def _quarantine(
         self, step_dir: Path, step: int, err: CorruptCheckpointError
     ) -> None:
-        with self._seq_lock:
-            self._seq += 1
-            dest = self._root / f"{CORRUPT_PREFIX}{step}-{self._seq}"
-            while dest.exists():
+        # The existence probe runs outside _seq_lock: the lock also guards
+        # the save-outcome state, and this path runs during restore — it
+        # must not stall a concurrent save's bookkeeping on disk stats.
+        while True:
+            with self._seq_lock:
                 self._seq += 1
                 dest = self._root / f"{CORRUPT_PREFIX}{step}-{self._seq}"
+            if not dest.exists():
+                break
         log.error(
             "checkpoint step %d failed validation (%s); quarantined as %s",
             step, err, dest.name,

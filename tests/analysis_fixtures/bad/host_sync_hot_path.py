@@ -1,0 +1,20 @@
+"""bad: hidden device->host syncs in the engine-step hot set
+(kftpu-host-sync-in-hot-path).
+
+drive_once and _step are hot-path roots; np.asarray of a device value
+and float() of a device array each force a blocking readback that
+serializes the dispatch pipeline.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+
+def drive_once(batch):
+    logits = jnp.matmul(batch, batch)
+    probs = np.asarray(logits)
+    return probs
+
+
+def _step(state):
+    out = jnp.add(state, 1)
+    return float(out)

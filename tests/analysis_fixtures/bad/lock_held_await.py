@@ -1,0 +1,23 @@
+"""bad: lock held across a call-graph-reachable blocking call
+(kftpu-lock-held-await).
+
+refresh() never blocks *directly* — the single-function rule
+(lock-held-blocking-call) sees nothing — but the _fetch() it calls
+under the lock does network I/O. Every thread needing _plock stalls
+for the full HTTP round trip.
+"""
+import threading
+import urllib.request
+
+
+class WarmPoolView:
+    def __init__(self):
+        self._plock = threading.Lock()
+        self.cached = None
+
+    def refresh(self):
+        with self._plock:
+            self.cached = self._fetch()
+
+    def _fetch(self):
+        return urllib.request.urlopen("http://pool/status").read()

@@ -1,0 +1,26 @@
+"""bad: two-module lock-order cycle (kftpu-lock-order-cycle).
+
+checkout() holds SliceLedgerA._alock and calls into the peer module,
+where settle() takes TierLedgerB._block — while the peer's reconcile()
+holds TierLedgerB._block and calls back into credit(), which takes
+SliceLedgerA._alock. Opposite orders across two files: threads
+interleaving checkout() and reconcile() deadlock.
+"""
+import threading
+
+from lock_order_cycle_peer import TierLedgerB
+
+
+class SliceLedgerA:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self.peer = TierLedgerB()
+        self.total = 0
+
+    def checkout(self):
+        with self._alock:
+            self.peer.settle()
+
+    def credit(self):
+        with self._alock:
+            self.total += 1

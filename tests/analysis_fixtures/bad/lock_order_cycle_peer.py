@@ -1,0 +1,24 @@
+"""bad (peer): the second half of the two-module lock-order cycle.
+
+reconcile() holds TierLedgerB._block across a call back into the other
+module's credit(), which acquires SliceLedgerA._alock — the reverse of
+the order checkout() uses. The circular import is harmless to the
+linter: analysis is pure ast, nothing here is executed.
+"""
+import threading
+
+from lock_order_cycle import SliceLedgerA
+
+
+class TierLedgerB:
+    def __init__(self):
+        self._block = threading.Lock()
+        self.owner = SliceLedgerA()
+
+    def settle(self):
+        with self._block:
+            pass
+
+    def reconcile(self):
+        with self._block:
+            self.owner.credit()

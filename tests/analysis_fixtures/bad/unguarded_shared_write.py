@@ -1,0 +1,24 @@
+"""bad: shared counter written from two thread entry paths with no
+common lock (kftpu-unguarded-shared-write).
+
+run() is a loop-method entry (spawned as a Thread target from the peer
+module — see unguarded_shared_write_peer.py) and bumps the tally
+unlocked; note_done() is called by request threads and bumps it under
+StreamTally._wlock. Different guards on the same counter: increments
+from the two paths can be lost.
+"""
+import threading
+
+
+class StreamTally:
+    def __init__(self):
+        self._wlock = threading.Lock()
+        self.completed = 0
+
+    def run(self):
+        while True:
+            self.completed += 1
+
+    def note_done(self):
+        with self._wlock:
+            self.completed += 1

@@ -1,0 +1,13 @@
+"""bad (peer): the cross-file thread spawn that makes StreamTally.run a
+second writer thread. The race itself is reported in
+unguarded_shared_write.py — this module shows why run() is an entry.
+"""
+import threading
+
+from unguarded_shared_write import StreamTally
+
+
+def start_tally() -> StreamTally:
+    tally = StreamTally()
+    threading.Thread(target=tally.run, daemon=True).start()
+    return tally

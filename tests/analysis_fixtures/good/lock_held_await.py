@@ -1,0 +1,19 @@
+"""good: the slow work runs outside the critical section; the lock is
+re-taken only to publish the result.
+"""
+import threading
+import urllib.request
+
+
+class WarmPoolView:
+    def __init__(self):
+        self._plock = threading.Lock()
+        self.cached = None
+
+    def refresh(self):
+        payload = self._fetch()
+        with self._plock:
+            self.cached = payload
+
+    def _fetch(self):
+        return urllib.request.urlopen("http://pool/status").read()

@@ -1,0 +1,24 @@
+"""good: the same two modules with one fleet-wide acquisition order.
+
+A's lock is always taken before B's (checkout -> settle), and the
+peer's reconcile() drops its own lock before calling back into
+credit() — no opposite-order path exists, so no cycle.
+"""
+import threading
+
+from lock_order_cycle_peer import TierLedgerB
+
+
+class SliceLedgerA:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self.peer = TierLedgerB()
+        self.total = 0
+
+    def checkout(self):
+        with self._alock:
+            self.peer.settle()
+
+    def credit(self):
+        with self._alock:
+            self.total += 1

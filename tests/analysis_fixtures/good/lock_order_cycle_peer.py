@@ -1,0 +1,26 @@
+"""good (peer): callback runs after the local lock is released.
+
+reconcile() snapshots what it needs under TierLedgerB._block, exits the
+with-block, and only then calls credit() — so TierLedgerB._block is
+never held while SliceLedgerA._alock is acquired.
+"""
+import threading
+
+from lock_order_cycle import SliceLedgerA
+
+
+class TierLedgerB:
+    def __init__(self):
+        self._block = threading.Lock()
+        self.owner = SliceLedgerA()
+        self.pending = 0
+
+    def settle(self):
+        with self._block:
+            self.pending = 0
+
+    def reconcile(self):
+        with self._block:
+            due = self.pending
+        for _ in range(due):
+            self.owner.credit()

@@ -1,0 +1,19 @@
+"""good: every mutation of the shared counter goes through the same
+lock, whichever thread performs it.
+"""
+import threading
+
+
+class StreamTally:
+    def __init__(self):
+        self._wlock = threading.Lock()
+        self.completed = 0
+
+    def run(self):
+        while True:
+            with self._wlock:
+                self.completed += 1
+
+    def note_done(self):
+        with self._wlock:
+            self.completed += 1

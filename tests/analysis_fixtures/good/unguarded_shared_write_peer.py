@@ -1,0 +1,12 @@
+"""good (peer): same cross-file spawn; harmless now that every write in
+unguarded_shared_write.py shares one lock.
+"""
+import threading
+
+from unguarded_shared_write import StreamTally
+
+
+def start_tally() -> StreamTally:
+    tally = StreamTally()
+    threading.Thread(target=tally.run, daemon=True).start()
+    return tally

@@ -6,6 +6,8 @@ webhook<->runtime env contract (and the metric/annotation vocabularies) if
 re-introducing a drifted literal turns the suite red.
 """
 
+import json
+import subprocess
 from pathlib import Path
 
 import pytest
@@ -13,10 +15,15 @@ import pytest
 from kubeflow_tpu.analysis import rule_ids, run_analysis
 from kubeflow_tpu.analysis import config as lint_config
 from kubeflow_tpu.analysis.__main__ import main as lint_main
+from kubeflow_tpu.analysis.baseline import (
+    apply_diff_filter,
+    changed_lines,
+)
 from kubeflow_tpu.analysis.core import load_module
 from kubeflow_tpu.analysis.engine import REPO_ROOT
 from kubeflow_tpu.analysis.index import RepoIndex
-from kubeflow_tpu.analysis.rules import ChaosParity
+from kubeflow_tpu.analysis.rules import ALL_RULES, ChaosParity
+from kubeflow_tpu.analysis.sarif import report_to_sarif
 
 FIXTURES = Path(__file__).resolve().parent / "analysis_fixtures"
 
@@ -38,7 +45,16 @@ RULE_FOR_FIXTURE = {
     "undeadlined_claim": "undeadlined-claim",
     "unbounded_fanout": "kftpu-unbounded-fanout",
     "parse_error": "parse-error",
+    "lock_order_cycle": "kftpu-lock-order-cycle",
+    "lock_held_await": "kftpu-lock-held-await",
+    "unguarded_shared_write": "kftpu-unguarded-shared-write",
+    "host_sync_hot_path": "kftpu-host-sync-in-hot-path",
 }
+
+# Multi-file fixtures: peer modules that exist to complete a cross-file
+# scenario (the second half of a lock-order cycle, the thread spawn that
+# makes a method an entry). Good-corpus peers must lint clean too.
+PEER_FIXTURES = ("lock_order_cycle_peer", "unguarded_shared_write_peer")
 
 
 @pytest.fixture(scope="module")
@@ -77,6 +93,29 @@ class TestFixtureCorpus:
                 if f.path.endswith(f"/{stem}.py")
             )
         )
+
+    @pytest.mark.parametrize("stem", PEER_FIXTURES)
+    def test_good_peer_fixture_is_clean(self, good_report, stem):
+        assert not _rules_for(good_report, stem), (
+            f"good/{stem}.py should be clean; got "
+            + "\n".join(
+                f.render()
+                for f in good_report.unsuppressed
+                if f.path.endswith(f"/{stem}.py")
+            )
+        )
+
+    def test_lock_order_cycle_reports_both_witness_paths(self, bad_report):
+        findings = [
+            f for f in bad_report.unsuppressed
+            if f.rule == "kftpu-lock-order-cycle"
+        ]
+        assert findings, "two-module cycle fixture should fire"
+        msg = findings[0].message
+        # Both legs of the cycle, each with its own witness acquisition.
+        assert "SliceLedgerA._alock" in msg and "TierLedgerB._block" in msg
+        assert "lock_order_cycle.py" in msg
+        assert "lock_order_cycle_peer.py" in msg
 
     def test_bad_corpus_covers_at_least_eight_distinct_rules(self, bad_report):
         distinct = {f.rule for f in bad_report.unsuppressed}
@@ -167,6 +206,52 @@ class TestRepoGate:
             for f in report.unsuppressed
         )
 
+    def test_reverting_autoscaler_lock_split_fails_the_gate(self, tmp_path):
+        """Re-holding the autoscaler state lock across the provisioner's
+        drained() HTTP probe (the kftpu-lock-held-await finding this PR
+        fixed by splitting _tick_lock from _lock) must fire again."""
+        src = (REPO_ROOT / "kubeflow_tpu/models/autoscaler.py").read_text()
+        anchor = "                idle = self.provisioner.drained(ep)"
+        assert anchor in src  # the fix this test guards
+        reverted = src.replace(
+            anchor,
+            "                with self._lock:\n"
+            "                    idle = self.provisioner.drained(ep)",
+        )
+        path = tmp_path / "autoscaler_reverted.py"
+        path.write_text(reverted)
+        report = run_analysis([path])
+        assert any(
+            f.rule == "kftpu-lock-held-await"
+            and "FleetAutoscaler._lock" in f.message
+            for f in report.unsuppressed
+        ), "\n".join(f.render() for f in report.unsuppressed)
+
+    def test_reverting_checkpoint_outcome_guard_fails_the_gate(self, tmp_path):
+        """Dropping the _seq_lock guard on the async worker's
+        save-outcome writes (the kftpu-unguarded-shared-write finding
+        this PR fixed) must fire again."""
+        src = (REPO_ROOT / "kubeflow_tpu/runtime/checkpoint.py").read_text()
+        anchor = (
+            "                    with self._seq_lock:\n"
+            "                        self.last_save_error = err\n"
+            "                        self.save_failures += 1"
+        )
+        assert anchor in src  # the fix this test guards
+        reverted = src.replace(
+            anchor,
+            "                    self.last_save_error = err\n"
+            "                    self.save_failures += 1",
+        )
+        path = tmp_path / "checkpoint_reverted.py"
+        path.write_text(reverted)
+        report = run_analysis([path])
+        assert any(
+            f.rule == "kftpu-unguarded-shared-write"
+            and ("save_failures" in f.message or "last_save_error" in f.message)
+            for f in report.unsuppressed
+        ), "\n".join(f.render() for f in report.unsuppressed)
+
 
 class TestChaosParity:
     def _index(self):
@@ -201,9 +286,25 @@ class TestCli:
         for rule in sorted(rule_ids()):
             assert rule in out
 
-    def test_json_output_clean_corpus(self, capsys):
-        import json
+    def test_list_rules_cites_incidents_and_docs(self, capsys):
+        """Each interprocedural rule carries the PR incident(s) it was
+        distilled from and a docs anchor, and --list-rules prints both."""
+        assert lint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        assert "incident:" in out
+        assert "ARCHITECTURE.md#static-analysis" in out
+        assert "CONTRIBUTING.md#modeling-locks-and-thread-entry-points" in out
+        for rule in ALL_RULES:
+            if rule.id in (
+                "kftpu-lock-order-cycle",
+                "kftpu-lock-held-await",
+                "kftpu-unguarded-shared-write",
+                "kftpu-host-sync-in-hot-path",
+            ):
+                assert rule.incidents, f"{rule.id} cites no incident"
+                assert rule.docs, f"{rule.id} has no docs link"
 
+    def test_json_output_clean_corpus(self, capsys):
         assert lint_main([str(FIXTURES / "good"), "--format", "json"]) == 0
         data = json.loads(capsys.readouterr().out)
         assert data["unsuppressed"] == 0
@@ -212,3 +313,244 @@ class TestCli:
 
     def test_nonzero_exit_on_findings(self, capsys):
         assert lint_main([str(FIXTURES / "bad")]) == 1
+
+
+# The subset of the SARIF 2.1.0 schema that kftpu-lint emits: log-level
+# required fields, driver identity, and the result/location/suppression
+# shapes viewers depend on. Kept inline so the test has no network or
+# vendored-schema dependency.
+SARIF_SCHEMA_SUBSET = {
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "version": {"const": "2.1.0"},
+        "runs": {
+            "type": "array",
+            "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["tool", "results"],
+                "properties": {
+                    "tool": {
+                        "type": "object",
+                        "required": ["driver"],
+                        "properties": {
+                            "driver": {
+                                "type": "object",
+                                "required": ["name"],
+                                "properties": {
+                                    "name": {"type": "string"},
+                                    "rules": {
+                                        "type": "array",
+                                        "items": {
+                                            "type": "object",
+                                            "required": [
+                                                "id",
+                                                "shortDescription",
+                                            ],
+                                        },
+                                    },
+                                },
+                            }
+                        },
+                    },
+                    "results": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["ruleId", "message", "locations"],
+                            "properties": {
+                                "ruleId": {"type": "string"},
+                                "level": {
+                                    "enum": [
+                                        "none",
+                                        "note",
+                                        "warning",
+                                        "error",
+                                    ]
+                                },
+                                "message": {
+                                    "type": "object",
+                                    "required": ["text"],
+                                },
+                                "baselineState": {
+                                    "enum": [
+                                        "new",
+                                        "unchanged",
+                                        "updated",
+                                        "absent",
+                                    ]
+                                },
+                                "locations": {
+                                    "type": "array",
+                                    "minItems": 1,
+                                    "items": {
+                                        "type": "object",
+                                        "required": ["physicalLocation"],
+                                        "properties": {
+                                            "physicalLocation": {
+                                                "type": "object",
+                                                "required": [
+                                                    "artifactLocation"
+                                                ],
+                                                "properties": {
+                                                    "artifactLocation": {
+                                                        "type": "object",
+                                                        "required": ["uri"],
+                                                    },
+                                                    "region": {
+                                                        "type": "object",
+                                                        "properties": {
+                                                            "startLine": {
+                                                                "type": "integer",
+                                                                "minimum": 1,
+                                                            },
+                                                            "startColumn": {
+                                                                "type": "integer",
+                                                                "minimum": 1,
+                                                            },
+                                                        },
+                                                    },
+                                                },
+                                            }
+                                        },
+                                    },
+                                },
+                                "suppressions": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "object",
+                                        "required": ["kind"],
+                                        "properties": {
+                                            "kind": {
+                                                "enum": [
+                                                    "inSource",
+                                                    "external",
+                                                ]
+                                            }
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    },
+}
+
+
+class TestSarif:
+    def test_log_validates_against_schema_subset(self):
+        jsonschema = pytest.importorskip("jsonschema")
+        report = run_analysis([FIXTURES / "bad", FIXTURES / "good"])
+        log = report_to_sarif(report, ALL_RULES)
+        jsonschema.validate(log, SARIF_SCHEMA_SUBSET)
+
+    def test_suppressions_and_baseline_state(self):
+        report = run_analysis([FIXTURES / "bad", FIXTURES / "good"])
+        log = report_to_sarif(report, ALL_RULES)
+        results = log["runs"][0]["results"]
+        suppressed = [r for r in results if "suppressions" in r]
+        assert suppressed, "good corpus suppression should appear"
+        assert all(
+            r["suppressions"][0]["kind"] == "inSource"
+            and r["suppressions"][0]["justification"]
+            for r in suppressed
+        )
+        gating = [r for r in results if r.get("baselineState") == "new"]
+        assert gating, "bad corpus findings should be baselineState=new"
+
+    def test_cli_sarif_flag_emits_parseable_log(self, capsys):
+        assert lint_main([str(FIXTURES / "bad"), "--sarif"]) == 1
+        log = json.loads(capsys.readouterr().out)
+        assert log["version"] == "2.1.0"
+        assert log["runs"][0]["tool"]["driver"]["name"] == "kftpu-lint"
+
+
+class TestBaselineAndDiff:
+    def test_checked_in_baseline_is_empty(self):
+        """The repo's standing bar: baseline.json exists for rule rollout
+        but must stay empty — findings get fixed or suppressed inline."""
+        data = json.loads(
+            (REPO_ROOT / "kubeflow_tpu/analysis/baseline.json").read_text()
+        )
+        assert data["findings"] == []
+
+    def test_update_baseline_then_gate_passes(self, tmp_path, capsys):
+        bad = str(FIXTURES / "bad")
+        bl = tmp_path / "baseline.json"
+        assert lint_main([bad, "--baseline", str(bl), "--update-baseline"]) == 0
+        capsys.readouterr()
+        data = json.loads(bl.read_text())
+        assert data["version"] == 1 and data["findings"]
+        assert all(
+            e["rule"] and e["path"] and len(e["fingerprint"]) == 16
+            for e in data["findings"]
+        )
+        # Baselined findings no longer gate...
+        assert lint_main([bad, "--baseline", str(bl)]) == 0
+        capsys.readouterr()
+        # ...but --no-baseline restores the hard gate.
+        assert lint_main([bad, "--no-baseline"]) == 1
+        capsys.readouterr()
+
+    def test_baselined_findings_are_reported_not_hidden(self, tmp_path, capsys):
+        bad = str(FIXTURES / "bad")
+        bl = tmp_path / "baseline.json"
+        lint_main([bad, "--baseline", str(bl), "--update-baseline"])
+        capsys.readouterr()
+        assert lint_main([bad, "--baseline", str(bl), "--format", "json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["gating"] == 0
+        assert data["baselined"] == data["unsuppressed"] > 0
+
+    def test_diff_filter_gates_only_changed_lines(self):
+        report = run_analysis([FIXTURES / "bad" / "sleep_in_reconcile.py"])
+        finding = next(
+            f for f in report.unsuppressed if f.rule == "sleep-in-reconcile"
+        )
+        apply_diff_filter(report, {finding.path: {finding.line}})
+        assert finding in report.gating and report.exit_code == 1
+
+        report2 = run_analysis([FIXTURES / "bad" / "sleep_in_reconcile.py"])
+        f2 = next(
+            f for f in report2.unsuppressed if f.rule == "sleep-in-reconcile"
+        )
+        # The PR touched the file, but not the offending line.
+        apply_diff_filter(report2, {f2.path: {f2.line + 100}})
+        assert f2 in report2.out_of_diff and f2 not in report2.gating
+
+    def test_diff_filter_untouched_file_never_gates(self):
+        report = run_analysis([FIXTURES / "bad" / "sleep_in_reconcile.py"])
+        apply_diff_filter(report, {})
+        assert report.gating == [] and report.exit_code == 0
+
+    def test_changed_lines_parses_a_real_git_range(self, tmp_path):
+        def git(*argv):
+            subprocess.run(
+                ["git", *argv], cwd=tmp_path, check=True, capture_output=True
+            )
+
+        git("init", "-q")
+        git("config", "user.email", "t@example.com")
+        git("config", "user.name", "t")
+        mod = tmp_path / "mod.py"
+        mod.write_text("a = 1\nb = 2\nc = 3\n")
+        git("add", "mod.py")
+        git("commit", "-qm", "seed")
+        mod.write_text("a = 1\nb = 20\nc = 3\nd = 4\n")
+        git("add", "mod.py")
+        git("commit", "-qm", "edit")
+        changed = changed_lines("HEAD~1..HEAD", tmp_path)
+        assert changed == {"mod.py": {2, 4}}
+
+    def test_changed_lines_bad_range_returns_none(self, tmp_path):
+        def git(*argv):
+            subprocess.run(
+                ["git", *argv], cwd=tmp_path, check=True, capture_output=True
+            )
+
+        git("init", "-q")
+        assert changed_lines("no-such-ref..HEAD", tmp_path) is None

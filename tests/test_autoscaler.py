@@ -739,3 +739,66 @@ class TestRealFleetIntegration:
                     s.stop()
                 except Exception:
                     pass
+
+
+class TestLockSplit:
+    """The tick/_lock split (kftpu-lock-held-await fix): the state lock
+    is never held across provisioner I/O, so reader surfaces stay
+    responsive mid-tick, and ticks are single-flighted."""
+
+    class _BlockingProvisioner(FakeProvisioner):
+        def __init__(self):
+            super().__init__()
+            self.entered = threading.Event()
+            self.unblock = threading.Event()
+
+        def drained(self, ep):
+            self.entered.set()
+            assert self.unblock.wait(10), "test never unblocked the probe"
+            return True
+
+    def _blocked_tick(self):
+        scaler, gw, tel, _, clock = _mk(1)
+        prov = self._BlockingProvisioner()
+        scaler.provisioner = prov
+        # A drain already past its budget: tick()'s first move is the
+        # drained() probe, which parks on the event.
+        scaler._draining[EP0] = {
+            "tier": "fused", "since": clock.t - 60.0,
+            "deadline": clock.t - 1.0,
+        }
+        tick_thread = threading.Thread(target=scaler.tick, daemon=True)
+        tick_thread.start()
+        assert prov.entered.wait(5)
+        return scaler, prov, tick_thread
+
+    def test_stats_and_debug_respond_while_probe_blocks(self):
+        scaler, prov, tick_thread = self._blocked_tick()
+        try:
+            got: list = []
+            reader = threading.Thread(
+                target=lambda: got.append((scaler.stats(), scaler.debug())),
+                daemon=True,
+            )
+            reader.start()
+            reader.join(2.0)
+            assert got, "stats()/debug() blocked behind a provisioner probe"
+            stats, debug = got[0]
+            assert EP0 in stats["draining"]
+            assert "decisions" in debug
+        finally:
+            prov.unblock.set()
+            tick_thread.join(5.0)
+            assert not tick_thread.is_alive()
+
+    def test_overlapping_tick_is_single_flighted(self):
+        scaler, prov, tick_thread = self._blocked_tick()
+        try:
+            # The cadence fires again while the probe is still parked:
+            # the overlapping tick must return immediately and empty,
+            # not queue behind the slow claim walk.
+            assert scaler.tick() == []
+        finally:
+            prov.unblock.set()
+            tick_thread.join(5.0)
+            assert not tick_thread.is_alive()

@@ -1,0 +1,304 @@
+"""Unit tests for the shared interprocedural substrate: call-graph
+construction/resolution (kubeflow_tpu.analysis.callgraph) and the lock
+model (kubeflow_tpu.analysis.concurrency.LockModel).
+
+Each test builds a tiny throwaway corpus in tmp_path and constructs the
+graph directly — no kubeflow_tpu modules in the index, so dispatch
+candidate counts and class-name lookups are fully controlled.
+"""
+
+import textwrap
+
+from kubeflow_tpu.analysis import config
+from kubeflow_tpu.analysis.concurrency import LockModel
+from kubeflow_tpu.analysis.core import load_module
+from kubeflow_tpu.analysis.index import RepoIndex
+
+
+def make_graph(tmp_path, sources: dict):
+    index = RepoIndex(tmp_path)
+    for name, src in sources.items():
+        path = tmp_path / f"{name}.py"
+        path.write_text(textwrap.dedent(src))
+        index.add(load_module(path, f"{name}.py", name))
+    return index.callgraph()
+
+
+def fn_named(graph, qualname):
+    for fn in graph.functions.values():
+        if fn.qualname == qualname:
+            return fn
+    raise AssertionError(f"no function {qualname!r} in graph")
+
+
+class TestResolution:
+    def test_bare_name_resolves_to_local_def(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            def helper():
+                pass
+
+            def caller():
+                helper()
+        """})
+        targets = [t.qualname for _, t in graph.edges[fn_named(graph, "caller").key]]
+        assert targets == ["helper"]
+
+    def test_self_method_resolves_through_base_class(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            class Base:
+                def shared(self):
+                    pass
+
+            class Child(Base):
+                def go(self):
+                    self.shared()
+        """})
+        targets = [t.qualname for _, t in graph.edges[fn_named(graph, "Child.go").key]]
+        assert targets == ["Base.shared"]
+
+    def test_attr_call_resolves_through_learned_type(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            class Pool:
+                def drain_all(self):
+                    pass
+
+            class Engine:
+                def __init__(self):
+                    self.pool = Pool()
+
+                def go(self):
+                    self.pool.drain_all()
+        """})
+        targets = [t.qualname for _, t in graph.edges[fn_named(graph, "Engine.go").key]]
+        assert targets == ["Pool.drain_all"]
+
+    def test_cross_module_import_resolves(self, tmp_path):
+        graph = make_graph(tmp_path, {
+            "a": """
+                from b import remote_work
+
+                def caller():
+                    remote_work()
+            """,
+            "b": """
+                def remote_work():
+                    pass
+            """,
+        })
+        targets = [t.qualname for _, t in graph.edges[fn_named(graph, "caller").key]]
+        assert targets == ["remote_work"]
+
+
+class TestDynamicDispatch:
+    def test_untyped_receiver_falls_back_when_under_cap(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            class A:
+                def frobnicate(self):
+                    pass
+
+            class B:
+                def frobnicate(self):
+                    pass
+
+            def use(x):
+                x.frobnicate()
+        """})
+        targets = sorted(
+            t.qualname for _, t in graph.edges[fn_named(graph, "use").key]
+        )
+        assert targets == ["A.frobnicate", "B.frobnicate"]
+
+    def test_over_cap_contributes_no_edges(self, tmp_path):
+        classes = "\n".join(
+            f"class C{i}:\n    def frobnicate(self):\n        pass\n"
+            for i in range(config.DISPATCH_CAP + 1)
+        )
+        graph = make_graph(
+            tmp_path, {"m": classes + "\ndef use(x):\n    x.frobnicate()\n"}
+        )
+        assert graph.edges[fn_named(graph, "use").key] == []
+
+    def test_ubiquitous_names_never_dispatch(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            class Store:
+                def get(self):
+                    pass
+
+            def use(x):
+                x.get()
+        """})
+        assert graph.edges[fn_named(graph, "use").key] == []
+
+    def test_lock_protocol_methods_never_dispatch(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            class Claimer:
+                def acquire(self):
+                    pass
+
+            def use(x):
+                x.acquire()
+        """})
+        assert graph.edges[fn_named(graph, "use").key] == []
+
+    def test_lockish_receiver_contributes_no_edges(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            class Claimer:
+                def grab_slice(self):
+                    pass
+
+            def use(self_lock):
+                self_lock.grab_slice()
+        """})
+        assert graph.edges[fn_named(graph, "use").key] == []
+
+
+class TestReachability:
+    def test_recursion_terminates_and_visits_once(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            def ping():
+                pong()
+
+            def pong():
+                ping()
+        """})
+        visited = [
+            fn.qualname
+            for fn, _, _ in graph.reachable(fn_named(graph, "ping"))
+        ]
+        assert visited == ["ping", "pong"]
+
+    def test_depth_bound_cuts_the_walk(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            def f0():
+                f1()
+
+            def f1():
+                f2()
+
+            def f2():
+                f3()
+
+            def f3():
+                pass
+        """})
+        at_2 = {
+            fn.qualname
+            for fn, _, _ in graph.reachable(fn_named(graph, "f0"), max_depth=2)
+        }
+        assert at_2 == {"f0", "f1", "f2"}
+
+    def test_witness_path_renders_hops(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            def outer():
+                inner()
+
+            def inner():
+                leaf()
+
+            def leaf():
+                pass
+        """})
+        for fn, depth, path in graph.reachable(fn_named(graph, "outer")):
+            if fn.qualname == "leaf":
+                assert depth == 2
+                rendered = graph.render_path(path, fn)
+                assert rendered == "outer (m.py:3) -> inner (m.py:6) -> leaf"
+                break
+        else:
+            raise AssertionError("leaf not reached")
+
+
+class TestLockModel:
+    def test_class_and_module_locks_get_canonical_ids(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            import threading
+
+            _MOD_LOCK = threading.Lock()
+
+
+            class Owner:
+                def __init__(self):
+                    self._lock = threading.RLock()
+        """})
+        model = LockModel(graph)
+        assert model.class_locks["Owner"]["_lock"] == "Owner._lock"
+        assert model.kinds["Owner._lock"] == "RLock"
+        assert model.module_locks["m"]["_MOD_LOCK"] == "m:_MOD_LOCK"
+
+    def test_condition_aliases_to_wrapped_lock(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            import threading
+
+
+            class Waiter:
+                def __init__(self):
+                    self._cond = threading.Condition(self._lock)
+                    self._lock = threading.Lock()
+        """})
+        model = LockModel(graph)
+        # Two-pass build: the alias resolves even though the Condition is
+        # assigned before the lock it wraps.
+        assert model.class_locks["Waiter"]["_cond"] == "Waiter._lock"
+
+    def test_with_regions_track_held_sets(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            import threading
+
+
+            class Owner:
+                def __init__(self):
+                    self._alock = threading.Lock()
+                    self._block = threading.Lock()
+
+                def nested(self):
+                    with self._alock:
+                        with self._block:
+                            self.flush()
+
+                def flush(self):
+                    pass
+        """})
+        model = LockModel(graph)
+        scan = model.scan(fn_named(graph, "Owner.nested"))
+        acq = {lock_id: held for _, lock_id, held in scan.acquisitions}
+        assert acq["Owner._alock"] == frozenset()
+        assert acq["Owner._block"] == frozenset({"Owner._alock"})
+        (call, held), = [
+            (c, h) for c, h in scan.calls
+            if getattr(c.func, "attr", "") == "flush"
+        ]
+        assert held == frozenset({"Owner._alock", "Owner._block"})
+
+    def test_unresolvable_lockish_expr_is_anonymous(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            def f(busy_lock):
+                with busy_lock:
+                    pass
+        """})
+        model = LockModel(graph)
+        scan = model.scan(fn_named(graph, "f"))
+        (_, lock_id, _), = scan.acquisitions
+        assert lock_id == "~busy_lock"
+        assert LockModel.is_anonymous(lock_id)
+
+    def test_bare_acquire_release_is_deliberately_untracked(self, tmp_path):
+        graph = make_graph(tmp_path, {"m": """
+            import threading
+
+
+            class Owner:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def manual(self):
+                    self._lock.acquire(timeout=5)
+                    self.flush()
+                    self._lock.release()
+
+                def flush(self):
+                    pass
+        """})
+        model = LockModel(graph)
+        scan = model.scan(fn_named(graph, "Owner.manual"))
+        assert scan.acquisitions == []
+        assert all(held == frozenset() for _, held in scan.calls)
