@@ -338,6 +338,11 @@ def _stamp_provenance(entries: list, provenance: str = "live") -> list:
     for e in entries:
         e.setdefault("provenance", provenance)
         e.setdefault("host", host)
+        # Mesh shape next to host: bench engines are single-device
+        # unless the entry stamped its own axes (tensor-parallel
+        # serving replicas write e.g. {"tp": 4}); a mesh number must
+        # never be conflated with a single-chip one.
+        e.setdefault("mesh", {"tp": 1})
         if _COMPILE_CACHE_DIR is not None:
             e.setdefault("compile_cache", _COMPILE_CACHE_DIR)
         if trace is not None:

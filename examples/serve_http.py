@@ -74,6 +74,7 @@ def main() -> None:
     from kubeflow_tpu.models.server import (
         InferenceServer,
         serving_port_from_env,
+        serving_tp_from_env,
     )
 
     if args.port is None:
@@ -98,6 +99,26 @@ def main() -> None:
         from kubeflow_tpu.models.quant import quantize_params
 
         params = quantize_params(params, free_source=True)
+
+    # Tensor-parallel replica: KUBEFLOW_TPU_SERVING_TP spans this replica's
+    # engine over a tp-degree mesh — weights shard on the tp axis and the
+    # paged KV pool head-shards (per-chip pool bytes drop by the degree)
+    # while the replica stays one HTTP endpoint. Every rejection here fires
+    # at startup, before any weight lands on a device.
+    from kubeflow_tpu.models.tp_serving import serving_plan
+
+    try:
+        tp = serving_tp_from_env()
+        plan = serving_plan(tp, cfg=cfg)
+    except ValueError as err:
+        raise SystemExit(str(err))
+    if plan is not None:
+        if args.admit_chunk:
+            raise SystemExit(
+                "--admit-chunk is a single-chip continuous-engine feature; "
+                "drop it or unset KUBEFLOW_TPU_SERVING_TP")
+        print(f"tensor-parallel replica: tp={tp} "
+              f"(mesh axes {plan.axes}, head-sharded KV pool)", flush=True)
 
     gen = GenerationConfig(max_new_tokens=args.max_new_tokens,
                            temperature=args.temperature)
@@ -155,7 +176,7 @@ def main() -> None:
                 prompt_bucket=args.prompt_bucket,
                 k_spec=draft_len, adaptive=adaptive,
                 ragged=True, token_budget=token_budget,
-                kv_bits=kv_kw.get("kv_bits", 0),
+                kv_bits=kv_kw.get("kv_bits", 0), plan=plan,
             )
         else:
             engine = PagedBatcher(
@@ -164,7 +185,7 @@ def main() -> None:
                 prompt_bucket=args.prompt_bucket,
                 ragged=ragged, token_budget=token_budget,
                 prefix_cache=kv_kw.get("swap_bytes", 0) > 0,
-                **kv_kw,
+                plan=plan, **kv_kw,
             )
     else:
         from kubeflow_tpu.models.continuous import ContinuousBatcher
@@ -172,7 +193,7 @@ def main() -> None:
         engine = ContinuousBatcher(
             params, cfg, gen=gen, slots=args.slots,
             cache_len=args.cache_len, prompt_bucket=args.prompt_bucket,
-            admit_chunk=args.admit_chunk,
+            admit_chunk=args.admit_chunk, plan=plan,
         )
 
     srv = InferenceServer(engine, host=args.host, port=args.port,

@@ -1,4 +1,5 @@
-"""kftpu-lint JAX rules: hidden device->host syncs on the serving path.
+"""kftpu-lint JAX rules: hidden device->host syncs and eager collectives
+on the serving path.
 
 The serving engines budget for exactly one device->host readback per
 step (the sampled-token fetch), and mark it with the ``host_`` naming
@@ -16,6 +17,17 @@ deliberately conservative: a local is *device* when bound from a
 ``jnp.*``/``jax.*`` call or a step-callable (config.DEVICE_PRODUCER_RE),
 *host* when bound from ``np.*``, literals, or a ``host_*`` name —
 everything else (parameters, attributes) is ambiguous and never flagged.
+
+The second rule (CollectiveOutsideJit) guards the tensor-parallel
+serving story: ``jax.lax.psum``/``all_gather``-family collectives only
+make sense under a trace — inside jit (GSPMD inserts and fuses them) or
+shard_map (the axis name exists there). An eager collective on the hot
+path either crashes (unbound axis name) or, worse, silently runs a
+gathered un-sharded fallback per step. "Traced" is the call-graph
+closure of every function that is jit/pmap/shard_map-wrapped — by
+decorator or by being passed (possibly through functools.partial) into
+a wrapper call — so helpers like the ring-attention bodies that only
+ever run inside a shard_map are never flagged.
 """
 
 from __future__ import annotations
@@ -244,4 +256,161 @@ class HostSyncInHotPath:
         return []
 
 
-JAX_RULES = [HostSyncInHotPath()]
+# Collectives only exist under a trace: psum/all_gather resolve their
+# axis name against the enclosing jit's mesh or shard_map's axis binding.
+_COLLECTIVE_LEAVES = {
+    "psum", "pmean", "pmax", "pmin",
+    "all_gather", "all_to_all", "ppermute", "psum_scatter",
+}
+_TRACE_WRAPPER_LEAVES = {"jit", "pmap", "shard_map"}
+
+
+def _wrapper_leaf(callee: Optional[str]) -> Optional[str]:
+    if not callee:
+        return None
+    leaf = callee.rsplit(".", 1)[-1]
+    return leaf if leaf in _TRACE_WRAPPER_LEAVES else None
+
+
+def _collective_callee(mod: SourceModule, call: ast.Call) -> Optional[str]:
+    """'jax.lax.psum' when the call is a lax-family collective, else None."""
+    callee = resolved_callee(mod, call)
+    if callee is None:
+        parts = dotted_parts(call.func)
+        callee = ".".join(parts) if parts else None
+    if not callee:
+        return None
+    parts = callee.split(".")
+    if parts[-1] not in _COLLECTIVE_LEAVES:
+        return None
+    if "lax" in parts[:-1] or parts[0] == "jax":
+        return callee
+    return None
+
+
+class CollectiveOutsideJit:
+    id = "kftpu-collective-outside-jit"
+    description = (
+        "A jax.lax collective (psum/pmean/pmax/pmin/all_gather/all_to_all/"
+        "ppermute/psum_scatter) called from the serving hot set outside any "
+        "jitted or shard_map region. Collectives resolve their axis name "
+        "against the enclosing trace; eagerly they raise an unbound-axis "
+        "error at best and serialize a per-step gathered fallback at "
+        "worst. Move the collective into the jitted step body, or wrap "
+        "the caller in jax.jit/shard_map."
+    )
+    incidents = (
+        "Tensor-parallel serving replicas (models/tp_serving.py) rely on "
+        "every tp psum living inside the jitted fused step; one eager "
+        "collective on the drive path breaks the mesh replica while the "
+        "single-chip engine keeps passing",
+    )
+    docs = "ARCHITECTURE.md#static-analysis — JAX hot-path rules"
+
+    def check_module(self, mod: SourceModule, index) -> list:
+        return []
+
+    def check_repo(self, index, checked: dict) -> list:
+        graph = index.callgraph()
+        traced = self._traced_closure(graph)
+        hot: dict = {}
+        for fn in graph.functions.values():
+            if fn.name not in config.HOT_PATH_ROOTS:
+                continue
+            rel = fn.mod.rel
+            in_package = rel.startswith("kubeflow_tpu/")
+            if in_package and not rel.startswith(
+                config.HOT_PATH_MODULE_PREFIXES
+            ):
+                continue
+            for node, _depth, _path in graph.reachable(
+                fn, max_depth=config.HOT_PATH_DEPTH
+            ):
+                hot.setdefault(node.key, node)
+        findings = []
+        for fn in hot.values():
+            if fn.mod.rel not in checked or fn.key in traced:
+                continue
+            for node in direct_nodes(fn.node.body):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _collective_callee(fn.mod, node)
+                if callee is None:
+                    continue
+                findings.append(Finding(
+                    self.id, fn.mod.rel, node.lineno, node.col_offset,
+                    f"{callee}() in hot-path function {fn.qualname} runs "
+                    "outside any jit/shard_map region; the axis name is "
+                    "unbound eagerly — move the collective into the "
+                    "jitted step body or wrap the caller",
+                ))
+        return findings
+
+    # -- traced-region closure ----------------------------------------------
+
+    def _traced_closure(self, graph) -> set:
+        """Keys of every function under a trace: jit/pmap/shard_map-wrapped
+        (decorator, or passed — possibly via functools.partial — into a
+        wrapper call anywhere in its module) plus everything call-graph
+        reachable from one; a traced caller traces its callees."""
+        entries = [
+            fn for fn in graph.functions.values() if self._decorated(fn)
+        ]
+        for mod in graph.index.modules.values():
+            if mod.tree is None:
+                continue
+            names = self._wrapped_names(mod)
+            if not names:
+                continue
+            for fname, fns in graph.module_defs.get(mod.name, {}).items():
+                if fname in names:
+                    entries.extend(fns)
+        traced: set = set()
+        for entry in entries:
+            if entry.key in traced:
+                continue
+            for node, _depth, _path in graph.reachable(entry, max_depth=None):
+                traced.add(node.key)
+        return traced
+
+    def _decorated(self, fn) -> bool:
+        for dec in fn.node.decorator_list:
+            if isinstance(dec, ast.Call):
+                callee = resolved_callee(fn.mod, dec) or ""
+                if _wrapper_leaf(callee):
+                    return True
+                if callee.rsplit(".", 1)[-1] == "partial" and dec.args:
+                    parts = dotted_parts(dec.args[0])
+                    if parts and parts[-1] in _TRACE_WRAPPER_LEAVES:
+                        return True
+            else:
+                parts = dotted_parts(dec)
+                if parts and parts[-1] in _TRACE_WRAPPER_LEAVES:
+                    return True
+        return False
+
+    def _wrapped_names(self, mod: SourceModule) -> set:
+        """Function names passed into a jit/pmap/shard_map call in mod,
+        directly or as the first argument of a functools.partial."""
+        names: set = set()
+        for node in mod.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            callee = resolved_callee(mod, node)
+            if callee is None:
+                parts = dotted_parts(node.func)
+                callee = ".".join(parts) if parts else None
+            if not _wrapper_leaf(callee):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+                elif isinstance(arg, ast.Call):
+                    inner = resolved_callee(mod, arg) or ""
+                    if inner.rsplit(".", 1)[-1] == "partial" and arg.args \
+                            and isinstance(arg.args[0], ast.Name):
+                        names.add(arg.args[0].id)
+        return names
+
+
+JAX_RULES = [HostSyncInHotPath(), CollectiveOutsideJit()]

@@ -868,6 +868,11 @@ class ContinuousBatcher(_BatcherBase):
             )
             if sp > 1:
                 self._decode_attn = make_sharded_sp_decode(mesh)
+        self.plan = plan
+        # Mesh observability (/stats `mesh` block, bench provenance):
+        # None for the classic one-chip engine so its records stay
+        # byte-identical; same convention as PagedBatcher.
+        self.mesh_axes = plan.axes if plan is not None else None
         self._init_base(self.gen, slots, prompt_bucket)
 
     # -- internals ---------------------------------------------------------

@@ -498,7 +498,12 @@ class MultiLoraPagedBatcher(_AdapterRegistry, PagedBatcher):
                 "dispatch; the legacy alternating path admits through "
                 "base-only prefill programs"
             )
-        for unsupported in ("plan", "prompt_cache", "prefix_cache"):
+        # plan= composes: the base weights shard per the plan while the
+        # stacked adapter deltas stay replicated (skinny (in, r) factors
+        # are a rounding error next to the base matmuls) — GSPMD keeps
+        # the adapted projections partitioned and psums once at the
+        # output, same as the base path.
+        for unsupported in ("prompt_cache", "prefix_cache"):
             if kw.get(unsupported):
                 raise ValueError(
                     f"MultiLoraPagedBatcher does not support "

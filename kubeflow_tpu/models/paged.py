@@ -96,10 +96,20 @@ def init_block_pool(
     return _kv_cache_leaves(shape, cfg.dtype, kv_bits)
 
 
-def _kv_block_bytes(cfg: LlamaConfig, block_size: int, kv_bits: int = 0) -> int:
+def _kv_block_bytes(cfg: LlamaConfig, block_size: int, kv_bits: int = 0,
+                    tp: int = 1) -> int:
     """Raw bytes ONE pool block occupies across every leaf (k + v, plus
-    the bf16 scale leaves under kv_bits=8)."""
-    rows = cfg.n_layers * cfg.n_kv_heads * block_size
+    the bf16 scale leaves under kv_bits=8).
+
+    ``tp`` > 1 returns the PER-SHARD cost under a head-sharded pool
+    (parallel.mesh.MeshPlan.shard_kv_cache puts the kv-head axis over
+    tp): each shard holds n_kv_heads/tp heads' rows, so per-chip pool
+    bytes drop by exactly the TP degree."""
+    if tp < 1 or cfg.n_kv_heads % tp:
+        raise ValueError(
+            f"tp={tp} must be >= 1 and divide n_kv_heads={cfg.n_kv_heads}"
+        )
+    rows = cfg.n_layers * (cfg.n_kv_heads // tp) * block_size
     if kv_bits == 8:
         # int8 values + one bf16 scale per (layer, head, offset) row.
         return 2 * rows * cfg.head_dim + 2 * rows * 2
@@ -115,12 +125,17 @@ def pool_blocks_from_hbm(
     fallback: int = 64,
     device=None,
     with_source: bool = False,
+    tp: int = 1,
 ):
     """Size a block pool from the accelerator's live memory stats: spend
     ``fraction`` of the device's free HBM (bytes_limit - bytes_in_use) on
     KV blocks. Backends without memory_stats (CPU, some plugins) return
     ``fallback`` — today's constant block counts keep working there, so
     notebooks stay runnable off-TPU while TPU pools scale with the chip.
+
+    ``tp`` > 1 sizes off PER-SHARD headroom: a head-sharded pool costs
+    each chip only 1/tp of a block's bytes, so the same free HBM holds
+    tp× the blocks — the capacity win of tensor-parallel serving.
 
     ``with_source`` returns ``(blocks, source)`` with source ``"hbm"``
     (sized from live memory stats) or ``"fallback"`` — the /stats
@@ -149,7 +164,7 @@ def pool_blocks_from_hbm(
                 or stats.get("bytes_reservable_limit") or 0)
     in_use = int(stats.get("bytes_in_use") or 0)
     budget = int((limit - in_use) * fraction)
-    per_block = _kv_block_bytes(cfg, block_size, kv_bits)
+    per_block = _kv_block_bytes(cfg, block_size, kv_bits, tp=tp)
     if budget <= 0 or per_block <= 0:
         return _ret(fallback, "fallback")
     # Block 0 is the null block; 2 is the smallest pool with a usable one.
@@ -754,14 +769,12 @@ class PagedBatcher(_BatcherBase):
         # _step assembles one flattened batch per engine step — every
         # decoding slot's token plus each admitting slot's next prompt
         # chunk, bounded by token_budget — and runs ONE fused dispatch
-        # (_paged_ragged_step). Sharing tiers and tp plans keep the
-        # legacy alternating path.
+        # (_paged_ragged_step). Sharing tiers keep the legacy alternating
+        # path. A tp plan composes with ragged: the gathered ragged body
+        # is pure jnp, so GSPMD runs it identically on every shard with
+        # the tp psums inserted inside the jitted step (the single-device
+        # pallas kernel stays rejected by the attn_kernel guard above).
         if ragged:
-            if plan is not None:
-                raise ValueError(
-                    "ragged=True does not compose with plan= (the ragged "
-                    "kernel is single-device; drop one of the two)"
-                )
             if prompt_cache or prefix_cache:
                 raise ValueError(
                     "ragged=True does not compose with prompt_cache/"
@@ -788,13 +801,19 @@ class PagedBatcher(_BatcherBase):
         self.cfg = cfg
         self.slots = slots
         self.block_size = block_size
+        tp_degree = (int(plan.mesh.shape.get("tp", 1))
+                     if plan is not None else 1)
         if hbm_fraction is not None:
             # Satellite of the paged pool: size from the accelerator's
             # live memory stats, with num_blocks as the CPU fallback.
+            # Under a tp plan the pool is head-sharded, so sizing runs
+            # off PER-SHARD headroom: each chip pays 1/tp of a block.
             num_blocks, self.pool_source = pool_blocks_from_hbm(
                 cfg, block_size, kv_bits,
                 fraction=hbm_fraction, fallback=num_blocks,
-                with_source=True,
+                with_source=True, tp=tp_degree,
+                device=(plan.mesh.devices.flat[0]
+                        if plan is not None else None),
             )
         else:
             self.pool_source = "config"
@@ -832,6 +851,19 @@ class PagedBatcher(_BatcherBase):
             # validation, and must fire before params are placed.
             self.pool = plan.shard_kv_cache(self.pool)
             self.params = plan.shard_params(params)
+        self.plan = plan
+        # Mesh observability (/stats `mesh` block + bench provenance):
+        # the non-trivial axes this engine's replica spans. None for the
+        # classic one-chip engine, so stats stay byte-identical there.
+        self.mesh_axes = plan.axes if plan is not None else None
+        # Committed per-leaf pool shardings: host-side pool WRITES
+        # (swap promotion, KV import) rebuild a leaf from numpy and must
+        # re-pin it, or one import would silently gather the pool onto
+        # a single device. device_put is a no-op when already placed.
+        self._pool_shardings = (
+            {name: leaf.sharding for name, leaf in self.pool.items()}
+            if plan is not None else None
+        )
         self.kv_mask = jnp.zeros((slots, self.max_blocks * block_size), bool)
         self.tables = np.zeros((slots, self.max_blocks), np.int32)
         self.positions = np.zeros((slots,), np.int32)
@@ -976,6 +1008,17 @@ class PagedBatcher(_BatcherBase):
 
     # -- host-RAM block swap ----------------------------------------------
 
+    def _pin_pool_leaf(self, name: str, leaf):
+        """Re-commit one pool leaf to its plan sharding after a
+        host-sourced write (.at[].set of numpy data). The update op's
+        output sharding follows GSPMD propagation, which may differ from
+        the pool's committed head-sharded layout; an unpinned leaf would
+        gather the whole pool onto one chip at the next step. No-op
+        (and identity) without a plan or when already placed."""
+        if self._pool_shardings is None:
+            return leaf
+        return jax.device_put(leaf, self._pool_shardings[name])
+
     def _swap_out(self, key: bytes, ent: dict) -> None:
         """Demote one prefix-chain leaf's block to the host-RAM tier:
         copy every pool leaf's rows for the block to numpy, keyed by the
@@ -1017,8 +1060,8 @@ class PagedBatcher(_BatcherBase):
             return None
         (blk,) = blocks
         for name, host in entry["leaves"].items():
-            self.pool[name] = self.pool[name].at[:, blk].set(
-                jnp.asarray(host)
+            self.pool[name] = self._pin_pool_leaf(
+                name, self.pool[name].at[:, blk].set(jnp.asarray(host))
             )
         del self._swap[key]
         self.swap_bytes_used -= entry["bytes"]
@@ -1415,8 +1458,8 @@ class PagedBatcher(_BatcherBase):
                 ],
                 axis=1,
             )
-            self.pool[name] = self.pool[name].at[:, idxs].set(
-                jnp.asarray(stacked)
+            self.pool[name] = self._pin_pool_leaf(
+                name, self.pool[name].at[:, idxs].set(jnp.asarray(stacked))
             )
         # Register the imported FULL blocks on the chain (same refcount
         # convention as prefix admission: cache ref + this request).
@@ -1633,8 +1676,9 @@ class PagedBatcher(_BatcherBase):
                             base64.b64decode(entries[j]["data"][name]),
                             dtype=dtype,
                         ).reshape(shapes[name])
-                        self.pool[name] = self.pool[name].at[:, blk].set(
-                            jnp.asarray(row)
+                        self.pool[name] = self._pin_pool_leaf(
+                            name,
+                            self.pool[name].at[:, blk].set(jnp.asarray(row)),
                         )
                     self._prefix_entries[key] = {
                         "block": blk, "parent": chain_parent,

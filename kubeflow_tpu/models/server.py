@@ -342,6 +342,33 @@ def spec_from_env() -> tuple[int, bool]:
     return draft_len, adaptive
 
 
+def serving_tp_from_env() -> int:
+    """Consuming end of the tensor-parallel serving knob: the tp degree
+    for ``models/tp_serving.serving_plan`` — the replica's engine spans
+    a tp-device mesh (weights model-sharded, paged KV head-sharded)
+    while staying one HTTP endpoint. Unset/1 keeps the classic
+    single-chip engine. Raises on garbage — a hand-set env var must not
+    silently fall back to one chip; model-shape and device-count
+    validation happens at plan construction (fail-fast at startup)."""
+    import os
+
+    from kubeflow_tpu.webhook.tpu_env import KUBEFLOW_TPU_SERVING_TP
+
+    raw = os.environ.get(KUBEFLOW_TPU_SERVING_TP, "").strip()
+    if not raw:
+        return 1
+    try:
+        tp = int(raw)
+    except ValueError:
+        tp = 0
+    if tp < 1:
+        raise ValueError(
+            f"{KUBEFLOW_TPU_SERVING_TP}={raw!r}: want an integer >= 1 "
+            "(1 keeps the single-chip engine)"
+        )
+    return tp
+
+
 def lora_cache_from_env() -> int:
     """Consuming end of the hot-adapter cache bound: slots for
     ``MultiLoraPagedBatcher(lora_cache_slots=...)`` (0 = uncapped
@@ -1169,6 +1196,10 @@ class InferenceServer:
                                     server.engine, "pool_source", "config"
                                 ),
                             }
+                        # Tensor-parallel replica: the engine spans a
+                        # mesh. Absent (not null) for one-chip engines,
+                        # so their /stats bytes are unchanged.
+                        mesh = getattr(server.engine, "mesh_axes", None)
                         rag = None
                         if getattr(server.engine, "ragged", False):
                             steps = server.engine.ragged_steps
@@ -1248,6 +1279,7 @@ class InferenceServer:
                         # operator can tell a measured-HBM pool from the
                         # conservative fallback floor.
                         **({"kv_pool": pool} if pool is not None else {}),
+                        **({"mesh": mesh} if mesh is not None else {}),
                         **({"ragged": rag} if rag is not None else {}),
                         **({"speculative": spec}
                            if spec is not None else {}),

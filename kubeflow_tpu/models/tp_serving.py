@@ -1,0 +1,99 @@
+"""Tensor-parallel serving replicas: a "replica" is a MESH, not a chip.
+
+The serving engines (PagedBatcher and everything stacked on it — the
+ragged fused dispatch, disagg handoff, host-RAM swap, speculation,
+multi-LoRA) take a ``plan=`` MeshPlan and run their existing jitted
+steps unchanged: weights are NamedSharding-partitioned on the ``tp``
+axis (attention heads / MLP hidden split; embeddings and lm_head
+vocab-sharded), the paged KV block pool is HEAD-sharded (each chip
+holds only its heads' K/V rows, so per-chip pool bytes drop by the TP
+degree), and GSPMD inserts the two collectives the math requires — a
+psum on ``tp`` after the attention output projection and after the MLP
+down-projection — INSIDE the jitted step. Host bookkeeping (allocator,
+tables, chain keys) never changes: np.asarray on a sharded leaf
+gathers, so export/import and swap wire formats are TP-invariant.
+
+This module is the thin serving-specific layer over parallel.mesh:
+validation that fails FAST at replica startup (a bad degree must kill
+the pod before it takes traffic, tpu_env.py discipline), the
+one-replica mesh constructor, and the fleet-side device partitioner
+that carves a host's chips into TP replica groups.
+
+Token-exactness is the contract (pinned by tests/test_tp_serving.py):
+a tp=N replica matches the 1-chip engine token-for-token.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from kubeflow_tpu.models.llama import LlamaConfig
+from kubeflow_tpu.parallel.mesh import MeshPlan, make_mesh
+
+
+def validate_serving_tp(cfg: LlamaConfig, tp: int,
+                        n_devices: Optional[int] = None) -> int:
+    """Fail-fast validation of a serving TP degree against a model
+    config (and optionally the visible device count). Returns the
+    degree. Raises ValueError with an operator-actionable message —
+    serve_http surfaces it at startup, before the replica takes
+    traffic. The kv-head rule is the hard one (a finer-than-head split
+    silently corrupts attention; mesh.shard_kv_cache re-checks it at
+    pool placement as the last line of defense)."""
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"serving tp degree must be >= 1, got {tp}")
+    if cfg.n_kv_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide n_kv_heads={cfg.n_kv_heads}: the paged "
+            "pool shards by kv head, and a finer split would cut a head "
+            "in half"
+        )
+    if cfg.n_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide n_heads={cfg.n_heads}: query heads "
+            "partition over the tp axis"
+        )
+    if n_devices is not None and tp > n_devices:
+        raise ValueError(
+            f"tp={tp} needs {tp} devices, have {n_devices}"
+        )
+    return tp
+
+
+def serving_plan(tp: int, devices=None,
+                 cfg: Optional[LlamaConfig] = None) -> Optional[MeshPlan]:
+    """The one-replica serving mesh: a pure-tp MeshPlan over the first
+    ``tp`` devices (or the given explicit list). tp=1 returns None —
+    the classic single-chip engine, with zero plan-path overhead — so
+    callers can thread the result straight into ``plan=``. ``cfg``
+    opts into the model-shape validation up front."""
+    tp = int(tp)
+    if cfg is not None:
+        validate_serving_tp(
+            cfg, tp,
+            n_devices=len(devices) if devices is not None else None,
+        )
+    if tp <= 1:
+        return None
+    pool = list(devices) if devices is not None else jax.devices()
+    if len(pool) < tp:
+        raise ValueError(
+            f"serving tp={tp} needs {tp} devices, have {len(pool)}"
+        )
+    return MeshPlan(make_mesh(tp=tp, devices=pool[:tp]))
+
+
+def replica_device_groups(tp: int, devices=None) -> list:
+    """Carve the visible chips into disjoint tp-sized replica groups —
+    the fleet-side partitioner: N chips host N//tp mesh replicas, each
+    one HTTP endpoint (the gateway never learns the difference). The
+    remainder chips (len % tp) are left out rather than forming a
+    ragged replica."""
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    pool = list(devices) if devices is not None else jax.devices()
+    return [pool[i:i + tp] for i in range(0, len(pool) - tp + 1, tp)]

@@ -56,6 +56,20 @@ class MeshPlan:
 
     mesh: Mesh
 
+    @property
+    def axes(self) -> dict:
+        """Non-trivial mesh axes, ``{name: size}`` — the shape stamp
+        /stats and the bench/loadtest provenance records carry so
+        multi-chip and single-chip numbers are never conflated. Falls
+        back to ``{"tp": 1}`` for a degenerate all-ones mesh (a plan
+        was requested, so the record must still say so)."""
+        sizes = {
+            name: int(size)
+            for name, size in self.mesh.shape.items()
+            if int(size) > 1
+        }
+        return sizes or {"tp": 1}
+
     # -- activations -------------------------------------------------------
     @property
     def batch_spec(self) -> P:

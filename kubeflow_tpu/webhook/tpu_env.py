@@ -91,6 +91,10 @@ KUBEFLOW_TPU_KV_SWAP_BYTES = "KUBEFLOW_TPU_KV_SWAP_BYTES"
 KUBEFLOW_TPU_SPEC_DRAFT_LEN = "KUBEFLOW_TPU_SPEC_DRAFT_LEN"
 KUBEFLOW_TPU_SPEC_ADAPTIVE = "KUBEFLOW_TPU_SPEC_ADAPTIVE"
 KUBEFLOW_TPU_LORA_CACHE_SLOTS = "KUBEFLOW_TPU_LORA_CACHE_SLOTS"
+# Tensor-parallel serving replicas (models/server.py serving_tp_from_env
+# → models/tp_serving.py serving_plan): the replica's engine spans a
+# tp-degree mesh — weights model-sharded, paged KV head-sharded.
+KUBEFLOW_TPU_SERVING_TP = "KUBEFLOW_TPU_SERVING_TP"
 # Persistent JAX compilation cache (bench.py capture windows; any runtime
 # entrypoint may opt in): compiled executables survive process restarts.
 KUBEFLOW_TPU_COMPILE_CACHE_DIR = "KUBEFLOW_TPU_COMPILE_CACHE_DIR"
@@ -253,6 +257,14 @@ ENV_CONTRACT: dict = {
     "container: bound of the per-replica hot-adapter cache (LRU, "
     "eviction counters in /stats); unset/0 leaves adapter residency "
     "uncapped — consumed by models/server.py lora_cache_from_env",
+    KUBEFLOW_TPU_SERVING_TP: "operator-set on the serving container: "
+    "tensor-parallel degree of this replica's engine mesh — weights "
+    "shard on the tp axis, the paged KV pool head-shards (per-chip "
+    "pool bytes drop by the degree), the replica stays ONE HTTP "
+    "endpoint; must be an integer >= 1 dividing the model's kv-head "
+    "count and <= visible devices (startup fails fast otherwise); "
+    "unset/1 keeps the classic single-chip engine — consumed by "
+    "models/server.py serving_tp_from_env",
     KUBEFLOW_TPU_COMPILE_CACHE_DIR: "operator-set (bench watcher env or "
     "notebook container): directory for JAX's persistent compilation "
     "cache; bench.py enables it at startup and stamps the dir into "

@@ -701,6 +701,7 @@ def main_disagg(args) -> int:
         "per_round": per_round,
         "provenance": "smoke" if args.smoke else "live",
         "host": _record_host(),
+        "mesh": {"tp": 1},  # single-chip replicas
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "disagg": disagg,
         "fused": fused,
@@ -1030,6 +1031,7 @@ def main_evict(args) -> int:
         "pool_byte_budget": hbm_bytes,
         "provenance": "smoke" if args.smoke else "live",
         "host": _record_host(),
+        "mesh": {"tp": 1},  # single-chip replicas
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "baseline": baseline,
         "treatment": treatment,
@@ -1230,11 +1232,12 @@ def _ml_build_fleet(adapter_affinity: bool):
     return gw, servers, cfg
 
 
-def _ml_stream(gw, prompt, model, timeout: float = 600.0):
+def _ml_stream(gw, prompt, model, timeout: float = 600.0,
+               max_tokens: int = None):
     """One streaming completion with an adapter selection. Returns
     (ok, ttft_seconds, detail)."""
     body = {"prompt": prompt, "stream": True,
-            "max_tokens": ML_DECODE_TOKENS}
+            "max_tokens": max_tokens or ML_DECODE_TOKENS}
     if model is not None:
         body["model"] = model
     conn = http.client.HTTPConnection(gw.host, gw.port, timeout=timeout)
@@ -1368,6 +1371,7 @@ def main_spec(args) -> int:
         "model": "tiny",
         "provenance": "smoke" if args.smoke else "live",
         "host": _record_host(),
+        "mesh": {"tp": 1},  # single-chip replicas
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     summary: dict = {}
@@ -1422,6 +1426,254 @@ def main_spec(args) -> int:
     print(f"# wrote {args.out}", file=sys.stderr)
     if not ok:
         print("# r10 win gate FAILED", file=sys.stderr)
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# --tp (r13): one tensor-parallel mesh replica vs a fleet of 1-chip ones.
+#
+# A serving "replica" is a MESH, not a chip (models/tp_serving.py): the
+# tp=TP_DEGREE arm runs ONE ragged PagedBatcher whose weights shard over
+# the tp axis and whose block pool head-shards — one HTTP endpoint over
+# TP_DEGREE chips — against a fleet of TP_DEGREE single-chip replicas on
+# the same chip budget. Token streams must match the single-chip engine
+# exactly; the structural win is per-chip pool bytes dropping by the TP
+# degree (the headroom a big model's weights need).
+# ---------------------------------------------------------------------------
+
+TP_DEGREE = 4
+TP_SLOTS = 2
+TP_REQUESTS = 12
+TP_DECODE_TOKENS = 24
+TP_CONCURRENCY = 4
+TP_NUM_BLOCKS = 64
+
+
+def _tp_build_engine(plan):
+    from kubeflow_tpu.models.paged import PagedBatcher
+    from kubeflow_tpu.models.serving import GenerationConfig
+
+    params, cfg = _load_model()
+    return PagedBatcher(
+        params, cfg,
+        gen=GenerationConfig(max_new_tokens=TP_DECODE_TOKENS, eos_id=-1),
+        slots=TP_SLOTS, num_blocks=TP_NUM_BLOCKS, block_size=8,
+        prompt_bucket=16, attn_kernel=False, ragged=True,
+        token_budget=4 * TP_SLOTS, plan=plan,
+    )
+
+
+def _tp_pool_bytes_per_chip(engine) -> int:
+    """Pool bytes resident on ONE chip: the engine's pool shards homed
+    on its first device (a 1-chip engine has exactly one shard per
+    leaf, so this is the whole pool)."""
+    total, dev = 0, None
+    for leaf in engine.pool.values():
+        shards = leaf.addressable_shards
+        if dev is None:
+            dev = shards[0].device
+        total += sum(s.data.nbytes for s in shards if s.device == dev)
+    return total
+
+
+def _tp_greedy_consistent(prompts, streams) -> bool:
+    """tp's psum order can fork a bf16 near-tie (the --spec arm's known
+    caveat): a diverged stream still passes if every token sits on the
+    greedy path of its own prompt within ~1.5 bf16 ulps (0.05 at these
+    logit magnitudes — a wrong token misses by whole logits)."""
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import llama as L
+
+    params, cfg = _load_model()
+    for prompt, toks in zip(prompts, streams):
+        full = jnp.asarray([list(prompt) + list(toks)])
+        logits = L.forward(params, cfg, full)[0]
+        for i, tok in enumerate(toks):
+            row = logits[len(prompt) - 1 + i]
+            if float(row.max() - row[tok]) > 0.05:
+                return False
+    return True
+
+
+def run_tp_arm(tp: int) -> dict:
+    """One fleet arm: tp>1 → ONE mesh replica spanning tp chips behind
+    the gateway; tp==1 → TP_DEGREE single-chip replicas. Same gateway
+    plumbing, same workload, same chip budget."""
+    from kubeflow_tpu.models.gateway import ServingGateway
+    from kubeflow_tpu.models.server import InferenceServer
+    from kubeflow_tpu.models.tp_serving import serving_plan
+
+    _, cfg = _load_model()
+    n_replicas = 1 if tp > 1 else TP_DEGREE
+    engines = [
+        _tp_build_engine(serving_plan(tp, cfg=cfg) if tp > 1 else None)
+        for _ in range(n_replicas)
+    ]
+    servers = [
+        InferenceServer(e, port=0, drain_s=2.0,
+                        max_queue_depth=4 * TP_REQUESTS).start()
+        for e in engines
+    ]
+    gw = ServingGateway(
+        [f"{s.host}:{s.port}" for s in servers], port=0, block_size=8,
+        health_interval_s=0.2, upstream_timeout_s=600.0,
+    ).start()
+    try:
+        prompts = [
+            [3 + (r * 29 + i * 13) % (cfg.vocab_size - 4)
+             for i in range(6 + r % 5)]
+            for r in range(TP_REQUESTS)
+        ]
+        # Warm-up straight at each replica: both arms compile their
+        # dispatch shapes outside the timed window.
+        for s in servers:
+            class _GW:  # _ml_stream wants .host/.port
+                host, port = s.host, s.port
+            ok, _, detail = _ml_stream(_GW, prompts[0], None,
+                                       max_tokens=TP_DECODE_TOKENS)
+            if not ok:
+                raise RuntimeError(f"tp arm warm-up failure: {detail}")
+        outcomes: list = []
+        sem = threading.Semaphore(TP_CONCURRENCY)
+        threads = []
+        t0 = time.perf_counter()
+        for prompt in prompts:
+
+            def work(p=prompt):
+                with sem:
+                    got = _ml_stream(gw, p, None,
+                                     max_tokens=TP_DECODE_TOKENS)
+                    if not got[0] and "Errno" in got[2]:
+                        # Transient loopback reset under the accept
+                        # burst: one client-side retry.
+                        got = _ml_stream(gw, p, None,
+                                         max_tokens=TP_DECODE_TOKENS)
+                    outcomes.append(got)
+
+            th = threading.Thread(target=work, daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        failures = [d for ok, _, d in outcomes if not ok]
+        ttfts = [t for ok, t, _ in outcomes if ok]
+        return {
+            "arm": f"tp{tp}_mesh_replica" if tp > 1 else "single_chip_fleet",
+            "replicas": n_replicas,
+            "chips": n_replicas * max(1, tp),
+            "mesh": getattr(engines[0], "mesh_axes", None) or {"tp": 1},
+            "requests_completed": len(ttfts),
+            "failures": failures,
+            "p95_ttft_ms": _p95_ms(ttfts) if ttfts else None,
+            "decode_tokens_per_sec":
+                round(len(ttfts) * TP_DECODE_TOKENS / wall, 2),
+            "wall_s": round(wall, 3),
+            "pool_bytes_per_chip": _tp_pool_bytes_per_chip(engines[0]),
+        }
+    finally:
+        gw.stop()
+        for s in servers:
+            s.stop()
+
+
+def main_tp(args) -> int:
+    """--tp: one tensor-parallel mesh replica vs a same-chip-budget
+    fleet of single-chip replicas (artifact: SERVE_r13_tp.json)."""
+    global TP_REQUESTS, TP_DECODE_TOKENS, TP_CONCURRENCY
+    if os.environ.get("JAX_PLATFORMS", "cpu").startswith("cpu"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            # CPU runners: enough virtual devices for the mesh. Only
+            # effective before the first backend touch — which is why
+            # this runs before anything imports a model.
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{TP_DEGREE}").strip()
+    import jax
+
+    if jax.device_count() < TP_DEGREE:
+        print(f"# --tp needs {TP_DEGREE} devices, have "
+              f"{jax.device_count()} (set XLA_FLAGS="
+              "--xla_force_host_platform_device_count)", file=sys.stderr)
+        return 1
+    if args.smoke:
+        TP_REQUESTS, TP_DECODE_TOKENS, TP_CONCURRENCY = 4, 6, 2
+
+    from kubeflow_tpu.models.tp_serving import serving_plan
+
+    record: dict = {
+        "model": "tiny",
+        "provenance": "smoke" if args.smoke else "live",
+        "host": _record_host(),
+        "mesh": {"tp": TP_DEGREE},
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "tp_degree": TP_DEGREE,
+    }
+    # Engine-level token parity first: the mesh replica must emit the
+    # SAME streams as a single-chip engine before any fleet numbers
+    # mean anything (near-tie forks fall back to greedy-consistency).
+    parity_prompts = [[5, 9, 17], [3, 41, 90, 7], [11] * 9]
+
+    def _streams(plan):
+        eng = _tp_build_engine(plan)
+        rids = [eng.submit(p) for p in parity_prompts]
+        out = eng.run()
+        return [list(out[r]) for r in rids]
+
+    want = _streams(None)
+    got = _streams(serving_plan(TP_DEGREE, cfg=_load_model()[1]))
+    token_exact = want == got
+    greedy_ok = token_exact or _tp_greedy_consistent(parity_prompts, got)
+    record["token_exact"] = token_exact
+    record["greedy_consistent"] = greedy_ok
+
+    print(f"# tp arm: ONE tp={TP_DEGREE} mesh replica, "
+          f"{TP_REQUESTS} requests ...", file=sys.stderr)
+    mesh_arm = run_tp_arm(TP_DEGREE)
+    print(f"# 1-chip fleet arm: {TP_DEGREE} replicas (fresh fleet) ...",
+          file=sys.stderr)
+    fleet_arm = run_tp_arm(1)
+    record["mesh_replica"] = mesh_arm
+    record["single_chip_fleet"] = fleet_arm
+    ratio = (fleet_arm["pool_bytes_per_chip"]
+             / max(1, mesh_arm["pool_bytes_per_chip"]))
+    record["pool_bytes_per_chip_ratio"] = round(ratio, 3)
+    print(json.dumps({
+        "tp_token_exact": token_exact,
+        "tp_greedy_consistent": greedy_ok,
+        "tp_p95_ttft_ms": mesh_arm["p95_ttft_ms"],
+        "fleet_p95_ttft_ms": fleet_arm["p95_ttft_ms"],
+        "tp_decode_tokens_per_sec": mesh_arm["decode_tokens_per_sec"],
+        "fleet_decode_tokens_per_sec":
+            fleet_arm["decode_tokens_per_sec"],
+        "pool_bytes_per_chip_ratio": record["pool_bytes_per_chip_ratio"],
+    }))
+    # The gate is structural, not a CPU horse race: token parity (exact,
+    # or greedy-consistent when a bf16 near-tie forks under tp's psum
+    # order), zero failures, and the head-sharded pool's per-chip bytes
+    # down by ~the TP degree. Tokens/sec is recorded, judged on chips.
+    ok = (greedy_ok
+          and not mesh_arm["failures"] and not fleet_arm["failures"]
+          and ratio >= TP_DEGREE * 0.9)
+    if args.smoke:
+        print("# --smoke: artifact write and win gate skipped",
+              file=sys.stderr)
+        return 0 if ok else 1
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(tmp, args.out)
+    print(f"# wrote {args.out}", file=sys.stderr)
+    if not ok:
+        print("# r13 gate FAILED: " + json.dumps({
+            "token_exact": token_exact,
+            "greedy_consistent": greedy_ok,
+            "pool_ratio_ge": ratio >= TP_DEGREE * 0.9,
+            "mesh_failures": mesh_arm["failures"],
+            "fleet_failures": fleet_arm["failures"],
+        }), file=sys.stderr)
     return 0 if ok else 1
 
 
@@ -1876,6 +2128,7 @@ def main_diurnal(args) -> int:
         "model": "tiny",
         "provenance": "live",
         "host": _record_host(),
+        "mesh": {"tp": 1},  # single-chip replicas
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                      time.gmtime()),
         "band_ms": round(band_ms, 2),
@@ -2212,6 +2465,7 @@ def main_ring_churn(args) -> int:
         "cycles": cycles,
         "provenance": "smoke" if args.smoke else "live",
         "host": _record_host(),
+        "mesh": {"tp": 1},  # single-chip replicas
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "static": static,
         "peer": peer,
@@ -2297,6 +2551,12 @@ def main() -> int:
                          "static small/big fleets, plus a disagg "
                          "long-prompt storm "
                          "(artifact: SERVE_r11_autoscale.json)")
+    ap.add_argument("--tp", action="store_true",
+                    help="run the tensor-parallel replica experiment: "
+                         "ONE tp=4 mesh replica (head-sharded block "
+                         "pool, one HTTP endpoint) vs a fleet of 4 "
+                         "single-chip replicas, token-exact "
+                         "(artifact: SERVE_r13_tp.json)")
     ap.add_argument("--ring-churn", action="store_true",
                     help="run the fleet-KV-tier churn experiment: "
                          "replicas join/leave mid-run, peer prefix "
@@ -2309,12 +2569,15 @@ def main() -> int:
     root = Path(__file__).resolve().parent.parent
     if args.out is None:
         args.out = str(root / (
-            "SERVE_r12_peerkv.json" if args.ring_churn
+            "SERVE_r13_tp.json" if args.tp
+            else "SERVE_r12_peerkv.json" if args.ring_churn
             else "SERVE_r11_autoscale.json" if args.diurnal
             else "SERVE_r10_spec.json" if args.spec or args.multilora
             else "SERVE_r09_hbm.json" if args.evict_storm
             else "SERVE_r08_disagg.json" if args.disagg
             else "SERVE_r07_fleet.json"))
+    if args.tp:
+        return main_tp(args)
     if args.ring_churn:
         return main_ring_churn(args)
     if args.diurnal:
@@ -2368,6 +2631,7 @@ def main() -> int:
         "prefix_blocks": PREFIX_BLOCKS,
         "provenance": "smoke" if args.smoke else "live",
         "host": _record_host(),
+        "mesh": {"tp": 1},  # single-chip replicas
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "affinity": affinity,
         "random": random_arm,

@@ -1,0 +1,20 @@
+"""bad: an eager jax.lax collective on the serving hot set
+(kftpu-collective-outside-jit).
+
+drive_once and _step are hot-path roots and neither is jit/shard_map-
+wrapped, so the psum/all_gather axis names are unbound at call time —
+the tp collective must live inside the jitted step body.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def drive_once(batch):
+    logits = jnp.matmul(batch, batch)
+    return jax.lax.psum(logits, "tp")
+
+
+def _step(state):
+    out = jnp.add(state, 1)
+    gathered = jax.lax.all_gather(out, "tp")
+    return gathered

@@ -1,0 +1,40 @@
+"""good: every collective lives under a trace. _step is jit-decorated;
+drive_once is jit-wrapped at module level, which traces _fused through
+the call-graph closure; _merge only ever runs through a shard_map wrap
+(the engines' pattern for sp/tp bodies). The axis names are always
+bound when these bodies execute.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.parallel.compat import shard_map
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _step(state):
+    out = jnp.add(state, 1)
+    return jax.lax.psum(out, "tp")
+
+
+def _fused(batch):
+    return jax.lax.psum(jnp.matmul(batch, batch), "tp")
+
+
+def drive_once(batch):
+    return _fused(batch)
+
+
+step = jax.jit(drive_once)
+
+
+def _merge(parts):
+    return jax.lax.psum(parts, "tp")
+
+
+def build_merge(mesh, specs):
+    return shard_map(
+        functools.partial(_merge),
+        mesh=mesh, in_specs=specs, out_specs=specs,
+    )

@@ -49,6 +49,7 @@ RULE_FOR_FIXTURE = {
     "lock_held_await": "kftpu-lock-held-await",
     "unguarded_shared_write": "kftpu-unguarded-shared-write",
     "host_sync_hot_path": "kftpu-host-sync-in-hot-path",
+    "collective_outside_jit": "kftpu-collective-outside-jit",
 }
 
 # Multi-file fixtures: peer modules that exist to complete a cross-file
